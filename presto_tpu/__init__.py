@@ -1,0 +1,28 @@
+"""presto_tpu — a TPU-native distributed SQL query engine.
+
+A from-scratch rebuild of the capabilities of Presto (reference:
+``johnnypav/presto``; see SURVEY.md for the structural analysis) designed
+TPU-first rather than ported:
+
+- host-side Python control plane: parser -> analyzer -> logical planner ->
+  rule/cost optimizer -> fragmenter -> scheduler (reference layers L0-L3,
+  SURVEY.md §1)
+- device-side data plane: whole plan fragments compile to ``jax.jit`` /
+  ``shard_map`` programs over fixed-shape, dictionary-encoded columnar pages
+  (reference layers L4-L6 collapsed into XLA)
+- shuffle = ``all_to_all`` over ICI inside a slice; token-acked paged
+  exchange over DCN between hosts (reference: HTTP paged exchange,
+  SURVEY.md §2.5)
+
+x64 is enabled globally: SQL BIGINT/DECIMAL semantics require 64-bit
+integers, and exact decimal arithmetic runs on scaled int64 (verified to
+work on TPU v5e, where int64 is emulated on int32 lanes by XLA).
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from presto_tpu.session import Session  # noqa: E402,F401

@@ -1,0 +1,339 @@
+"""Columnar Block/Page data model — device-resident, static-shape.
+
+Reference parity: ``presto-common`` ``Block`` hierarchy (LongArrayBlock,
+IntArrayBlock, VariableWidthBlock, DictionaryBlock, RunLengthEncodedBlock)
+and ``Page`` — SURVEY.md §2.1 "Block/Page data model".
+
+TPU-first redesign (SURVEY.md §7 "Design stance"):
+
+- A ``Block`` is a pytree of fixed-shape JAX arrays: ``data`` plus an
+  optional ``valid`` null-mask. There is no VariableWidthBlock — strings are
+  dictionary ids (int32) with the dictionary held host-side (strings never
+  touch the device; the VPU only ever sees fixed-width lanes).
+- A ``Page`` carries a traced scalar ``num_valid``: the first ``num_valid``
+  rows are live, the rest is padding. Filters *compact* survivors to the
+  front (static-shape ``jnp.nonzero(size=...)``) instead of shrinking the
+  array, so every downstream kernel sees the same shapes and XLA compiles
+  each fragment exactly once per capacity bucket.
+- Capacity (array length) is static metadata; the planner picks capacity
+  buckets so selective filters can step pages down to smaller compiled
+  shapes between fragments (host-side re-bucketing).
+
+Blocks/Pages are registered as pytree dataclasses: ``data``/``valid``/
+``num_valid`` are leaves (traced), everything else is static aux data that
+participates in the jit cache key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+
+
+class Dictionary:
+    """Host-side, order-preserving string dictionary.
+
+    Ids are assigned in sorted order of the distinct values, so integer
+    comparison of ids agrees with lexicographic comparison of the strings
+    they encode (within a single dictionary). This is what lets <, =,
+    BETWEEN, ORDER BY, and min/max on varchar run entirely on-device over
+    int32 lanes; LIKE and other string functions evaluate host-side over
+    the (small) dictionary into a boolean lookup table that is then
+    gathered on-device (SURVEY.md §7 "Strings on TPU").
+
+    Immutable and hashable (content digest) — safe as static jit metadata.
+    """
+
+    __slots__ = ("values", "_str_values", "_index", "_digest")
+
+    def __init__(self, sorted_values: np.ndarray):
+        self.values = np.asarray(sorted_values)
+        self._str_values = self.values.astype(str)
+        self._index: Optional[dict] = None
+        h = hashlib.blake2b(digest_size=16)
+        h.update(str(len(self.values)).encode())
+        for v in self._str_values:
+            h.update(v.encode())
+            h.update(b"\x00")
+        self._digest = h.digest()
+
+    @classmethod
+    def build(cls, values: Sequence[str]) -> "Dictionary":
+        return cls(np.unique(np.asarray(values, dtype=object)))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __hash__(self):
+        return hash(self._digest)
+
+    def __eq__(self, other):
+        return isinstance(other, Dictionary) and self._digest == other._digest
+
+    def id_of(self, value: str) -> int:
+        """Exact id of value, or -1 if absent."""
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.values)}
+        return self._index.get(value, -1)
+
+    def searchsorted(self, value: str, side: str = "left") -> int:
+        """Insertion point of value — supports range predicates on absent
+        literals (e.g. ``c < 'm'`` where 'm' is not in the dictionary)."""
+        return int(np.searchsorted(self._str_values, value, side=side))
+
+    def decode(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids)
+        if len(self.values) == 0:  # all-NULL column
+            return np.full(ids.shape, None, dtype=object)
+        out = self.values[np.clip(ids, 0, len(self.values) - 1)]
+        return np.where(ids < 0, None, out)
+
+    def predicate_lut(self, fn) -> np.ndarray:
+        """Evaluate a host predicate over every dictionary entry -> bool LUT
+        (device gathers LUT[id] to evaluate e.g. LIKE)."""
+        return np.asarray([bool(fn(v)) for v in self.values], dtype=bool)
+
+
+def encode_strings(values: Sequence) -> tuple[np.ndarray, np.ndarray, Dictionary]:
+    """Encode strings -> (int32 ids, valid mask, order-preserving dict).
+
+    None values get id -1 and valid=False.
+    """
+    arr = np.asarray(values, dtype=object)
+    isnull = np.array([v is None for v in arr], dtype=bool)
+    present = arr[~isnull].astype(str) if (~isnull).any() else np.array([], str)
+    dictionary = Dictionary(np.unique(present))
+    ids = np.full(len(arr), -1, dtype=np.int32)
+    if len(present):
+        ids[~isnull] = np.searchsorted(
+            dictionary._str_values, present
+        ).astype(np.int32)
+    return ids, ~isnull, dictionary
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["data", "valid"],
+    meta_fields=["dtype", "dictionary"],
+)
+@dataclasses.dataclass
+class Block:
+    """One column: fixed-width device array + optional null mask.
+
+    ``valid`` is None when the column is known null-free (the common case
+    for TPC-H) — that knowledge is static, so XLA never materialises or
+    computes masks for non-null columns.
+    """
+
+    data: jnp.ndarray
+    valid: Optional[jnp.ndarray]  # bool, True = non-null; None = all valid
+    dtype: T.DataType
+    dictionary: Optional[Dictionary] = None
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @classmethod
+    def from_numpy(
+        cls,
+        values: np.ndarray,
+        dtype: T.DataType,
+        valid: Optional[np.ndarray] = None,
+        dictionary: Optional[Dictionary] = None,
+    ) -> "Block":
+        data = jnp.asarray(np.asarray(values), dtype=dtype.jnp_dtype)
+        v = None if valid is None else jnp.asarray(valid, dtype=jnp.bool_)
+        return cls(data=data, valid=v, dtype=dtype, dictionary=dictionary)
+
+    @classmethod
+    def from_pylist(cls, values: Sequence, dtype: T.DataType) -> "Block":
+        """Build from Python values (None = NULL). Handles dictionary
+        encoding for varchar and scaling for decimals."""
+        if dtype.is_string:
+            ids, valid, dictionary = encode_strings(values)
+            v = None if valid.all() else valid
+            return cls.from_numpy(ids, dtype, v, dictionary)
+        isnull = np.array([v is None for v in values], dtype=bool)
+        if dtype.is_decimal:
+            # SQL half-up rounding, exact via decimal.Decimal (float
+            # multiply mis-rounds e.g. 0.005 at scale 2).
+            import decimal as _dec
+
+            q = _dec.Decimal(1).scaleb(-dtype.scale)
+            filled = [
+                0
+                if v is None
+                else int(
+                    _dec.Decimal(str(v)).quantize(
+                        q, rounding=_dec.ROUND_HALF_UP
+                    ).scaleb(dtype.scale)
+                )
+                for v in values
+            ]
+            arr = np.asarray(filled, dtype=np.int64)
+        else:
+            filled = [0 if v is None else v for v in values]
+            arr = np.asarray(filled).astype(dtype.np_dtype)
+        v = None if not isnull.any() else ~isnull
+        return cls.from_numpy(arr, dtype, v)
+
+    def to_numpy(self, n: Optional[int] = None):
+        """Materialise first n rows host-side as (values, valid) numpy pair.
+        Dictionary ids and decimal scaling are NOT decoded here — see
+        Page.to_pylist for full decoding."""
+        data = np.asarray(self.data[:n] if n is not None else self.data)
+        if self.valid is None:
+            valid = np.ones(len(data), dtype=bool)
+        else:
+            valid = np.asarray(self.valid[:n] if n is not None else self.valid)
+        return data, valid
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["blocks", "num_valid"],
+    meta_fields=["names"],
+)
+@dataclasses.dataclass
+class Page:
+    """An ordered set of equal-capacity Blocks + live-row count.
+
+    ``names`` is static (tuple of column names); ``blocks`` is the matching
+    tuple of Blocks. The first ``num_valid`` rows are live; padding rows
+    carry unspecified data and must be masked via ``row_mask()``.
+    """
+
+    blocks: tuple
+    num_valid: jnp.ndarray  # scalar int32
+    names: tuple
+
+    @property
+    def capacity(self) -> int:
+        return self.blocks[0].capacity if self.blocks else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.blocks)
+
+    def block(self, name: str) -> Block:
+        return self.blocks[self.names.index(name)]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.names
+
+    def row_mask(self) -> jnp.ndarray:
+        """Boolean mask over capacity: True for live rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_valid
+
+    def with_blocks(self, names: Sequence[str], blocks: Sequence[Block]) -> "Page":
+        return Page(
+            blocks=tuple(blocks),
+            num_valid=self.num_valid,
+            names=tuple(names),
+        )
+
+    @classmethod
+    def from_pydict(
+        cls, data: Dict[str, Sequence], schema: Dict[str, T.DataType],
+        capacity: Optional[int] = None,
+    ) -> "Page":
+        """Test/ingest helper: build a page from {name: python values}.
+
+        Pads every column to ``capacity`` (default: exact length)."""
+        names = tuple(schema.keys())
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity if capacity is not None else max(n, 1)
+        n = min(n, cap)  # truncated blocks must truncate the live count too
+        blocks = []
+        for name in names:
+            vals = list(data[name])
+            vals = vals + [None] * (cap - n) if cap > n else vals[:cap]
+            b = Block.from_pylist(vals, schema[name])
+            # padding validity is irrelevant (masked by num_valid) but keep
+            # masks only when real nulls exist in the live region
+            if b.valid is not None:
+                live_valid = np.asarray(b.valid)[:n]
+                if live_valid.all():
+                    b = dataclasses.replace(b, valid=None)
+            blocks.append(b)
+        return cls(
+            blocks=tuple(blocks),
+            num_valid=jnp.asarray(n, dtype=jnp.int32),
+            names=names,
+        )
+
+    def to_pylist(self) -> List[dict]:
+        """Decode live rows to a list of {name: python value} dicts
+        (dictionary ids -> strings, decimals -> Decimal-free floats kept
+        exact via int/10**s, dates -> datetime.date)."""
+        import datetime
+
+        n = int(self.num_valid)
+        out_cols = {}
+        for name, blk in zip(self.names, self.blocks):
+            data, valid = blk.to_numpy(n)
+            col = []
+            for i in range(n):
+                if not valid[i]:
+                    col.append(None)
+                    continue
+                v = data[i]
+                t = blk.dtype
+                if t.is_string:
+                    col.append(str(blk.dictionary.values[int(v)]))
+                elif t.is_decimal:
+                    col.append(int(v) / (10 ** t.scale))
+                elif t.name == "date":
+                    col.append(
+                        datetime.date(1970, 1, 1)
+                        + datetime.timedelta(days=int(v))
+                    )
+                elif t.name == "boolean":
+                    col.append(bool(v))
+                elif t.is_integer or t.name == "timestamp":
+                    col.append(int(v))
+                else:
+                    col.append(float(v))
+            out_cols[name] = col
+        return [
+            {name: out_cols[name][i] for name in self.names} for i in range(n)
+        ]
+
+    def schema(self) -> Dict[str, T.DataType]:
+        return {n: b.dtype for n, b in zip(self.names, self.blocks)}
+
+
+def pad_capacity(page: Page, capacity: int) -> Page:
+    """Re-bucket a page to a new (>= live rows) capacity host-side.
+
+    This is the fragment-boundary shape-step: selective filters hand a
+    large-capacity page to a smaller compiled bucket. Runs on host between
+    fragments (device->device realloc via XLA pad/slice)."""
+    blocks = []
+    for blk in page.blocks:
+        cap = blk.capacity
+        if capacity == cap:
+            blocks.append(blk)
+        elif capacity > cap:
+            pad = [(0, capacity - cap)]
+            data = jnp.pad(blk.data, pad)
+            valid = None if blk.valid is None else jnp.pad(blk.valid, pad)
+            blocks.append(dataclasses.replace(blk, data=data, valid=valid))
+        else:
+            data = blk.data[:capacity]
+            valid = None if blk.valid is None else blk.valid[:capacity]
+            blocks.append(dataclasses.replace(blk, data=data, valid=valid))
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.minimum(page.num_valid, capacity).astype(jnp.int32),
+        names=page.names,
+    )

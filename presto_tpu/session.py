@@ -1,0 +1,177 @@
+"""Session & configuration system.
+
+Reference parity: the three config tiers of SURVEY.md §5.6 —
+  1. static node config (``etc/config.properties`` -> @Config POJOs),
+  2. catalog config (``etc/catalog/*.properties``),
+  3. per-query session properties (``SET SESSION k=v``,
+     SystemSessionProperties).
+
+Here: tier 1 = ``NodeConfig`` (dict + typed accessors, unknown keys fail
+fast at boot, like airlift ConfigBinder); tier 3 = ``Session`` with typed,
+validated, defaulted properties. The ``tpu_offload`` gate required by
+BASELINE.json is a tier-3 property: when False, fragments execute on the
+CPU backend (jax CPU), giving the reference's Java-worker/native-worker
+dual-backend seam (SURVEY.md preamble) — same plans, different executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    """One typed session property (reference: PropertyMetadata<T>)."""
+
+    name: str
+    description: str
+    py_type: type
+    default: Any
+    validate: Optional[Callable[[Any], None]] = None
+
+    def coerce(self, value: Any) -> Any:
+        if self.py_type is bool and isinstance(value, str):
+            v = value.strip().lower()
+            if v not in ("true", "false"):
+                raise ValueError(f"{self.name}: expected boolean, got {value!r}")
+            value = v == "true"
+        else:
+            value = self.py_type(value)
+        if self.validate:
+            self.validate(value)
+        return value
+
+
+def _positive(name):
+    def check(v):
+        if v <= 0:
+            raise ValueError(f"{name} must be positive, got {v}")
+
+    return check
+
+
+#: Engine-wide session properties (reference: SystemSessionProperties).
+SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
+    p.name: p
+    for p in [
+        PropertyMetadata(
+            "tpu_offload",
+            "Execute plan fragments on the TPU backend (False = CPU oracle "
+            "backend; the BASELINE.json per-session gate)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
+            "task_concurrency",
+            "Local drivers per task (device lanes for vmapped fragments)",
+            int,
+            1,
+            _positive("task_concurrency"),
+        ),
+        PropertyMetadata(
+            "join_distribution_type",
+            "AUTOMATIC | PARTITIONED | BROADCAST (reference: AddExchanges "
+            "join distribution choice)",
+            str,
+            "AUTOMATIC",
+        ),
+        PropertyMetadata(
+            "page_capacity",
+            "Default device page capacity bucket (rows)",
+            int,
+            1 << 20,
+            _positive("page_capacity"),
+        ),
+        PropertyMetadata(
+            "hash_partition_count",
+            "Number of partitions for hash-distributed exchanges "
+            "(defaults to mesh device count at execution time when 0)",
+            int,
+            0,
+        ),
+        PropertyMetadata(
+            "spill_enabled",
+            "Allow spilling oversized build/group state to host RAM",
+            bool,
+            False,
+        ),
+        PropertyMetadata(
+            "query_max_run_time_s",
+            "Per-query wall-clock limit (seconds)",
+            float,
+            3600.0,
+            _positive("query_max_run_time_s"),
+        ),
+    ]
+}
+
+
+class Session:
+    """Per-query context: catalog/schema + typed session properties.
+
+    Reference parity: presto Session + SystemSessionProperties resolution
+    (typed, validated, defaulted from static config) — SURVEY.md §5.6.
+    """
+
+    def __init__(
+        self,
+        catalog: str = "tpch",
+        schema: str = "tiny",
+        properties: Optional[Dict[str, Any]] = None,
+        user: str = "presto_tpu",
+    ):
+        self.catalog = catalog
+        self.schema = schema
+        self.user = user
+        self._props: Dict[str, Any] = {}
+        for k, v in (properties or {}).items():
+            self.set(k, v)
+
+    def set(self, name: str, value: Any) -> None:
+        """SET SESSION name = value (unknown keys fail fast)."""
+        meta = SYSTEM_SESSION_PROPERTIES.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        self._props[name] = meta.coerce(value)
+
+    def get(self, name: str) -> Any:
+        meta = SYSTEM_SESSION_PROPERTIES.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        return self._props.get(name, meta.default)
+
+    def reset(self, name: str) -> None:
+        self._props.pop(name, None)
+
+    @property
+    def tpu_offload(self) -> bool:
+        return self.get("tpu_offload")
+
+
+class NodeConfig:
+    """Tier-1 static node config; unknown keys fail fast at boot."""
+
+    KNOWN = {
+        "node.id": str,
+        "node.environment": str,
+        "coordinator": bool,
+        "http-server.port": int,
+        "discovery.uri": str,
+        "query.max-memory-per-node": str,
+        "exchange.max-buffer-size": str,
+        "task.concurrency": int,
+    }
+
+    def __init__(self, props: Optional[Dict[str, str]] = None):
+        self.props: Dict[str, Any] = {}
+        for k, v in (props or {}).items():
+            if k not in self.KNOWN:
+                raise KeyError(f"unknown config key: {k}")
+            t = self.KNOWN[k]
+            self.props[k] = (
+                v.lower() == "true" if t is bool and isinstance(v, str) else t(v)
+            )
+
+    def get(self, key: str, default=None):
+        return self.props.get(key, default)

@@ -1,0 +1,314 @@
+"""SQL type system.
+
+Reference parity: ``presto-common`` ``Type`` / ``TypeSignature`` hierarchy
+(BigintType ... DecimalType, VarcharType, ArrayType/MapType/RowType) —
+SURVEY.md §2.1 "Type system".
+
+TPU-first design decisions (SURVEY.md §7 step 1):
+
+- Every SQL type maps to a fixed-width device representation so that all
+  pages are static-shape JAX arrays:
+
+    BIGINT            -> int64
+    INTEGER           -> int32
+    SMALLINT/TINYINT  -> int32 (widened on device; narrowing on output)
+    DOUBLE / REAL     -> float64 / float32
+    BOOLEAN           -> bool
+    DATE              -> int32  (days since 1970-01-01, like the reference)
+    TIMESTAMP         -> int64  (microseconds since epoch)
+    DECIMAL(p<=18, s) -> int64  (unscaled value; exact arithmetic)
+    DECIMAL(p>18, s)  -> int64 pair (hi, lo) — emulated int128 (future);
+                         round 1 gates p<=18 which covers all of TPC-H
+    VARCHAR / CHAR    -> int32 dictionary ids + host-side order-preserving
+                         dictionary (see presto_tpu.page.Dictionary)
+
+- Types are immutable, interned value objects; they are *static* metadata
+  (never traced), safe to hash into jit cache keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """Base SQL type. Frozen/hashable: types are static jit-cache metadata."""
+
+    name: str
+
+    @property
+    def jnp_dtype(self):
+        raise NotImplementedError
+
+    @property
+    def np_dtype(self):
+        return np.dtype(self.jnp_dtype)
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def is_integer(self) -> bool:
+        return False
+
+    @property
+    def is_string(self) -> bool:
+        return False
+
+    @property
+    def is_decimal(self) -> bool:
+        return False
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class BigintType(DataType):
+    name: str = "bigint"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int64
+
+    @property
+    def is_numeric(self):
+        return True
+
+    @property
+    def is_integer(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerType(DataType):
+    name: str = "integer"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_numeric(self):
+        return True
+
+    @property
+    def is_integer(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(DataType):
+    name: str = "double"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.float64
+
+    @property
+    def is_numeric(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class RealType(DataType):
+    name: str = "real"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.float32
+
+    @property
+    def is_numeric(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(DataType):
+    name: str = "boolean"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.bool_
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(DataType):
+    """Days since 1970-01-01 (matches the reference's DateType encoding)."""
+
+    name: str = "date"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_numeric(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(DataType):
+    """Microseconds since epoch."""
+
+    name: str = "timestamp"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(DataType):
+    """Exact decimal as an unscaled int64 (short decimal path).
+
+    Reference parity: presto-common DecimalType; short decimals (p<=18) are
+    long-backed there too, long decimals (p<=38) are int128-backed (future
+    round: int64-pair emulation; TPC-H needs only p<=15).
+    """
+
+    precision: int = 38
+    scale: int = 0
+    name: str = "decimal"
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "name", f"decimal({self.precision},{self.scale})"
+        )
+        if self.precision > 18:
+            raise NotImplementedError(
+                "long decimal (p>18) lands with int128 emulation; "
+                "TPC-H needs p<=15"
+            )
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int64
+
+    @property
+    def is_numeric(self):
+        return True
+
+    @property
+    def is_decimal(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(DataType):
+    """Dictionary-encoded string: device arrays hold int32 dictionary ids.
+
+    The dictionary itself (presto_tpu.page.Dictionary) lives host-side and
+    is order-preserving (ids sorted by string value), so <, <=, =, >=, >
+    on ids agree with string comparison within one dictionary.
+    """
+
+    length: Optional[int] = None  # None = unbounded
+    name: str = "varchar"
+
+    def __post_init__(self):
+        if self.length is not None:
+            object.__setattr__(self, "name", f"varchar({self.length})")
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_string(self):
+        return True
+
+
+# Interned singletons — reference parity with presto-common's static
+# instances (BigintType.BIGINT etc.).
+BIGINT = BigintType()
+INTEGER = IntegerType()
+DOUBLE = DoubleType()
+REAL = RealType()
+BOOLEAN = BooleanType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+VARCHAR = VarcharType()
+
+
+def decimal(precision: int, scale: int) -> DecimalType:
+    return DecimalType(precision=precision, scale=scale)
+
+
+def varchar(length: Optional[int] = None) -> VarcharType:
+    return VarcharType(length=length)
+
+
+_BY_NAME = {
+    "bigint": BIGINT,
+    "integer": INTEGER,
+    "int": INTEGER,
+    "double": DOUBLE,
+    "real": REAL,
+    "boolean": BOOLEAN,
+    "date": DATE,
+    "timestamp": TIMESTAMP,
+    "varchar": VARCHAR,
+}
+
+
+def parse_type(text: str) -> DataType:
+    """Parse a SQL type string, e.g. ``decimal(12,2)`` or ``varchar(25)``."""
+    t = text.strip().lower()
+    if t in _BY_NAME:
+        return _BY_NAME[t]
+    if t in ("decimal", "char"):  # bare forms: SQL defaults
+        return decimal(18, 0) if t == "decimal" else varchar(1)
+    if t.startswith("decimal(") and t.endswith(")"):
+        inner = t[len("decimal(") : -1]
+        p, s = (int(x) for x in inner.split(","))
+        return decimal(p, s)
+    if (t.startswith("varchar(") or t.startswith("char(")) and t.endswith(")"):
+        inner = t[t.index("(") + 1 : -1]
+        return varchar(int(inner))
+    raise ValueError(f"unknown type: {text}")
+
+
+# --- coercion lattice (reference: presto-common TypeCoercion) -------------
+
+_NUMERIC_ORDER = ["integer", "bigint", "real", "double"]
+
+
+def common_super_type(a: DataType, b: DataType) -> DataType:
+    """Least common type two operands coerce to (simplified lattice)."""
+    if a == b:
+        return a
+    if a.is_decimal and b.is_decimal:
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        if intd + scale > 18:
+            raise NotImplementedError(
+                f"decimal merge of {a} and {b} needs precision "
+                f"{intd + scale} > 18 (int128 emulation not yet built)"
+            )
+        return decimal(intd + scale, scale)
+    if a.is_decimal and b.is_integer:
+        # widen integer digits to the int64 ceiling; precision is
+        # capacity-advisory (all short-decimal arithmetic runs on int64)
+        return decimal(18, a.scale)
+    if b.is_decimal and a.is_integer:
+        return decimal(18, b.scale)
+    if a.is_decimal and b.name == "double":
+        return DOUBLE
+    if b.is_decimal and a.name == "double":
+        return DOUBLE
+    if a.is_numeric and b.is_numeric:
+        ia = _NUMERIC_ORDER.index(a.name)
+        ib = _NUMERIC_ORDER.index(b.name)
+        return _BY_NAME[_NUMERIC_ORDER[max(ia, ib)]]
+    if a.is_string and b.is_string:
+        return VARCHAR
+    if {a.name, b.name} == {"date", "timestamp"}:
+        return TIMESTAMP
+    raise TypeError(f"no common type for {a} and {b}")
