@@ -1,0 +1,788 @@
+"""Typed expression IR + JAX lowering.
+
+Reference parity: the row-expression layer that presto-main compiles to JVM
+bytecode per query (``ExpressionCompiler`` / ``PageProcessor`` /
+``CursorProcessor`` — SURVEY.md §2.1 "Expression JIT"). TPU-first redesign
+(SURVEY.md §7 step 2): instead of emitting bytecode, expressions *lower to
+jaxprs* — ``eval_expr`` is called at trace time inside the fragment's
+``jax.jit``, so XLA is the codegen and fuses the whole expression tree into
+the surrounding kernel. There is no interpreter at runtime.
+
+Null semantics are SQL three-valued logic, carried as (data, valid) pairs
+where ``valid=None`` statically means "no nulls" so XLA never materialises
+masks for null-free columns.
+
+String expressions never touch string bytes on device: dictionary columns
+are int32 ids with an order-preserving host dictionary (presto_tpu.page),
+so =/< compare ids against host-resolved literal ids, and LIKE & friends
+evaluate host-side over the dictionary into a boolean LUT that the device
+gathers (SURVEY.md §7 "Strings on TPU"). Dictionaries are static pytree
+metadata, so all of that folds at trace time.
+
+Decimal semantics (exact, scaled int64):
+  a ± b   -> rescale to max(scale)        (exact)
+  a * b   -> scale_a + scale_b            (exact; raises if scale > 18)
+  a / b   -> DOUBLE                       (documented deviation: the
+             reference returns decimal; int128 division lands later)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.page import Page
+
+
+# --------------------------------------------------------------------------
+# IR nodes (analyzer output; see SURVEY.md §2.1 "Analyzer")
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    """Base expression; ``dtype`` is resolved at analysis time."""
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+    @property
+    def dtype(self) -> T.DataType:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str
+    _dtype: T.DataType
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    """A constant. Decimal literals carry their *unscaled* int value;
+    date literals carry epoch days; string literals carry the python str
+    (resolved against the column dictionary at lowering time)."""
+
+    value: Any
+    _dtype: T.DataType
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __str__(self):
+        return repr(self.value)
+
+    @classmethod
+    def of(cls, value: Any) -> "Literal":
+        """Infer a literal from a python value (analyzer convenience)."""
+        if value is None:
+            return cls(None, T.BIGINT)
+        if isinstance(value, bool):
+            return cls(value, T.BOOLEAN)
+        if isinstance(value, int):
+            return cls(value, T.BIGINT)
+        if isinstance(value, float):
+            return cls(value, T.DOUBLE)
+        if isinstance(value, str):
+            return cls(value, T.VARCHAR)
+        if isinstance(value, datetime.date):
+            days = (value - datetime.date(1970, 1, 1)).days
+            return cls(days, T.DATE)
+        raise TypeError(f"cannot infer literal type for {value!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Arithmetic(Expr):
+    op: str  # + - * / %
+    left: Expr
+    right: Expr
+    _dtype: T.DataType
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Negate(Expr):
+    arg: Expr
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return self.arg.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Compare(Expr):
+    op: str  # = <> < <= > >=
+    left: Expr
+    right: Expr
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class And(Expr):
+    terms: Tuple[Expr, ...]
+
+    def children(self):
+        return self.terms
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Or(Expr):
+    terms: Tuple[Expr, ...]
+
+    def children(self):
+        return self.terms
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Expr):
+    arg: Expr
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expr):
+    arg: Expr
+    negate: bool = False
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expr):
+    """Searched CASE: WHEN cond THEN value ... ELSE default."""
+
+    whens: Tuple[Tuple[Expr, Expr], ...]
+    default: Optional[Expr]
+    _dtype: T.DataType
+
+    def children(self):
+        out: List[Expr] = []
+        for c, v in self.whens:
+            out += [c, v]
+        if self.default is not None:
+            out.append(self.default)
+        return tuple(out)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr
+    to: T.DataType
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return self.to
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Expr):
+    arg: Expr
+    low: Expr
+    high: Expr
+    negate: bool = False
+
+    def children(self):
+        return (self.arg, self.low, self.high)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expr):
+    arg: Expr
+    values: Tuple[Expr, ...]  # literals
+    negate: bool = False
+
+    def children(self):
+        return (self.arg,) + self.values
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expr):
+    """LIKE with a literal pattern — evaluated host-side over the
+    dictionary into a boolean LUT, gathered on device."""
+
+    arg: Expr
+    pattern: str
+    negate: bool = False
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Expr):
+    """EXTRACT(field FROM date) — field in year/month/day/quarter."""
+
+    field: str
+    arg: Expr
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BIGINT
+
+
+@dataclasses.dataclass(frozen=True)
+class Coalesce(Expr):
+    args: Tuple[Expr, ...]
+    _dtype: T.DataType
+
+    def children(self):
+        return self.args
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+
+# --- analyzer-facing constructors (type inference for binary ops) ---------
+
+
+def arith(op: str, left: Expr, right: Expr) -> Arithmetic:
+    lt, rt = left.dtype, right.dtype
+    if (lt.is_decimal or rt.is_decimal) and (
+        lt.name in ("double", "real") or rt.name in ("double", "real")
+    ):
+        out = T.DOUBLE  # decimal op double -> double (reference semantics)
+    elif op == "/" and (lt.is_decimal or rt.is_decimal):
+        out = T.DOUBLE  # documented deviation: int128 division later
+    elif lt.is_decimal or rt.is_decimal:
+        a = lt if lt.is_decimal else T.decimal(18, 0)
+        b = rt if rt.is_decimal else T.decimal(18, 0)
+        if op == "*":
+            scale = a.scale + b.scale
+            if scale > 18:
+                raise NotImplementedError(
+                    f"decimal multiply scale {scale} > 18"
+                )
+            out = T.decimal(18, scale)
+        else:
+            out = T.decimal(18, max(a.scale, b.scale))
+    else:
+        out = T.common_super_type(lt, rt)
+    return Arithmetic(op, left, right, out)
+
+
+# --------------------------------------------------------------------------
+# Lowering: eval_expr(expr, page) -> (data, valid|None), traced under jit
+# --------------------------------------------------------------------------
+
+def like_to_regex(pattern: str, escape: Optional[str] = None) -> re.Pattern:
+    out, i = [], 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _rescale(data, from_scale: int, to_scale: int):
+    if to_scale > from_scale:
+        return data * (10 ** (to_scale - from_scale))
+    if to_scale < from_scale:
+        # SQL half-up rounding away from zero (matches ingest in page.py)
+        factor = 10 ** (from_scale - to_scale)
+        half = factor // 2
+        q = (jnp.abs(data) + half) // factor
+        return jnp.sign(data) * q
+    return data
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _numeric_pair(left: Expr, right: Expr, ld, rd):
+    """Align two numeric operands to a common device representation.
+    Returns (l, r, kind) where kind is 'decimal:<scale>' | 'float' | 'int'."""
+    lt, rt = left.dtype, right.dtype
+    if lt.is_decimal or rt.is_decimal:
+        if lt.name == "double" or rt.name == "double" or lt.name == "real" or rt.name == "real":
+            ls = 10.0 ** -(lt.scale if lt.is_decimal else 0)
+            rs = 10.0 ** -(rt.scale if rt.is_decimal else 0)
+            return (
+                ld.astype(jnp.float64) * (ls if lt.is_decimal else 1.0),
+                rd.astype(jnp.float64) * (rs if rt.is_decimal else 1.0),
+                "float",
+            )
+        scale = max(
+            lt.scale if lt.is_decimal else 0,
+            rt.scale if rt.is_decimal else 0,
+        )
+        l = _rescale(ld.astype(jnp.int64), lt.scale if lt.is_decimal else 0, scale)
+        r = _rescale(rd.astype(jnp.int64), rt.scale if rt.is_decimal else 0, scale)
+        return l, r, f"decimal:{scale}"
+    if lt.name in ("double", "real") or rt.name in ("double", "real"):
+        return ld.astype(jnp.float64), rd.astype(jnp.float64), "float"
+    return ld.astype(jnp.int64), rd.astype(jnp.int64), "int"
+
+
+def _civil_from_days(z):
+    """Epoch days -> (year, month, day), branch-free integer math on device
+    (Howard Hinnant's civil_from_days; operands kept non-negative)."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146096), 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365
+    )
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+class ExprLowerer:
+    """Lowers an Expr tree over one Page at trace time.
+
+    One instance per fragment compilation; results are (data, valid) with
+    valid=None meaning statically null-free.
+    """
+
+    def __init__(self, page: Page):
+        self.page = page
+
+    def eval(self, expr: Expr):
+        method = getattr(self, "_eval_" + type(expr).__name__.lower(), None)
+        if method is None:
+            raise NotImplementedError(
+                f"no lowering for {type(expr).__name__}"
+            )
+        return method(expr)
+
+    # -- leaves ------------------------------------------------------------
+
+    def _eval_columnref(self, e: ColumnRef):
+        blk = self.page.block(e.name)
+        return blk.data, blk.valid
+
+    def _eval_literal(self, e: Literal):
+        if e.value is None:
+            zero = jnp.zeros((self.page.capacity,), dtype=e.dtype.jnp_dtype)
+            return zero, jnp.zeros((self.page.capacity,), dtype=jnp.bool_)
+        if e.dtype.is_string:
+            raise NotImplementedError(
+                "bare string literal outside comparison context"
+            )
+        v = e.value
+        return jnp.asarray(v, dtype=e.dtype.jnp_dtype), None
+
+    # -- arithmetic --------------------------------------------------------
+
+    def _eval_arithmetic(self, e: Arithmetic):
+        ld, lv = self.eval(e.left)
+        rd, rv = self.eval(e.right)
+        valid = _and_valid(lv, rv)
+        lt, rt = e.left.dtype, e.right.dtype
+        if e.op == "/" and (lt.is_decimal or rt.is_decimal):
+            ls = 10.0 ** -(lt.scale if lt.is_decimal else 0)
+            rs = 10.0 ** -(rt.scale if rt.is_decimal else 0)
+            lf = ld.astype(jnp.float64) * ls
+            rf = rd.astype(jnp.float64) * rs
+            return lf / jnp.where(rf == 0, 1.0, rf), (
+                valid
+                if not _maybe_zero(e.right)
+                else _and_valid(valid, rf != 0)
+            )
+        if e.op == "*" and lt.is_decimal and rt.is_decimal:
+            # exact: unscaled product, scale adds
+            return ld.astype(jnp.int64) * rd.astype(jnp.int64), valid
+        if e.op == "*" and (lt.is_decimal or rt.is_decimal):
+            dec, other = (ld, rd) if lt.is_decimal else (rd, ld)
+            ot = rt if lt.is_decimal else lt
+            if ot.is_integer:
+                return dec.astype(jnp.int64) * other.astype(jnp.int64), valid
+            return (
+                dec.astype(jnp.float64) * other.astype(jnp.float64)
+            ), valid
+        l, r, kind = _numeric_pair(e.left, e.right, ld, rd)
+        if e.op == "+":
+            return l + r, valid
+        if e.op == "-":
+            return l - r, valid
+        if e.op == "*":
+            return l * r, valid
+        if e.op == "/":
+            if kind == "float":
+                return l / jnp.where(r == 0, 1.0, r), _and_valid(valid, r != 0)
+            # SQL integer division truncates toward zero
+            q = jnp.sign(l) * jnp.sign(r) * (jnp.abs(l) // jnp.maximum(jnp.abs(r), 1))
+            return q.astype(jnp.int64), _and_valid(valid, r != 0)
+        if e.op == "%":
+            r_safe = jnp.where(r == 0, 1, r)
+            m = l - (jnp.sign(l) * jnp.sign(r) * (jnp.abs(l) // jnp.abs(r_safe))) * r
+            return m, _and_valid(valid, r != 0)
+        raise ValueError(f"unknown arithmetic op {e.op}")
+
+    def _eval_negate(self, e: Negate):
+        d, v = self.eval(e.arg)
+        return -d, v
+
+    # -- comparisons -------------------------------------------------------
+
+    def _cmp(self, op: str, l, r):
+        if op == "=":
+            return l == r
+        if op in ("<>", "!="):
+            return l != r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        raise ValueError(f"unknown comparison {op}")
+
+    def _string_literal_compare(self, op: str, col: ColumnRef, lit: str):
+        """Compare a dictionary column against a string literal by id —
+        folds to an int32 compare (order-preserving dictionary)."""
+        blk = self.page.block(col.name)
+        d = blk.dictionary
+        ids = blk.data
+        if op == "=":
+            i = d.id_of(lit)
+            res = (ids == i) if i >= 0 else jnp.zeros(ids.shape, jnp.bool_)
+        elif op in ("<>", "!="):
+            i = d.id_of(lit)
+            res = (ids != i) if i >= 0 else jnp.ones(ids.shape, jnp.bool_)
+        elif op == "<":
+            res = ids < d.searchsorted(lit, "left")
+        elif op == "<=":
+            res = ids < d.searchsorted(lit, "right")
+        elif op == ">":
+            res = ids >= d.searchsorted(lit, "right")
+        elif op == ">=":
+            res = ids >= d.searchsorted(lit, "left")
+        else:
+            raise ValueError(op)
+        return res, blk.valid
+
+    def _eval_compare(self, e: Compare):
+        lt, rt = e.left.dtype, e.right.dtype
+        if lt.is_string and isinstance(e.right, Literal):
+            assert isinstance(e.left, ColumnRef), "analyzer guarantees ref"
+            return self._string_literal_compare(e.op, e.left, e.right.value)
+        if rt.is_string and isinstance(e.left, Literal):
+            flip = {
+                "<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                "=": "=", "<>": "<>", "!=": "!=",
+            }
+            assert isinstance(e.right, ColumnRef)
+            return self._string_literal_compare(
+                flip[e.op], e.right, e.left.value
+            )
+        ld, lv = self.eval(e.left)
+        rd, rv = self.eval(e.right)
+        if lt.is_string and rt.is_string:
+            # both sides dictionary columns: ids comparable only if same
+            # dictionary (planner re-encodes otherwise)
+            lb = self.page.block(e.left.name) if isinstance(e.left, ColumnRef) else None
+            rb = self.page.block(e.right.name) if isinstance(e.right, ColumnRef) else None
+            if lb is not None and rb is not None and lb.dictionary != rb.dictionary:
+                raise NotImplementedError(
+                    "cross-dictionary string comparison requires re-encode"
+                )
+            return self._cmp(e.op, ld, rd), _and_valid(lv, rv)
+        l, r, _ = _numeric_pair(e.left, e.right, ld, rd)
+        return self._cmp(e.op, l, r), _and_valid(lv, rv)
+
+    # -- boolean (Kleene three-valued) -------------------------------------
+
+    def _eval_and(self, e: And):
+        data, valid = None, None
+        for t in e.terms:
+            d, v = self.eval(t)
+            if data is None:
+                data, valid = d, v
+                continue
+            # three-valued AND: false dominates null
+            new_valid = (
+                None
+                if valid is None and v is None
+                else _tv_and_valid(data, valid, d, v)
+            )
+            data = data & d
+            valid = new_valid
+        return data, valid
+
+    def _eval_or(self, e: Or):
+        data, valid = None, None
+        for t in e.terms:
+            d, v = self.eval(t)
+            if data is None:
+                data, valid = d, v
+                continue
+            new_valid = (
+                None
+                if valid is None and v is None
+                else _tv_or_valid(data, valid, d, v)
+            )
+            data = data | d
+            valid = new_valid
+        return data, valid
+
+    def _eval_not(self, e: Not):
+        d, v = self.eval(e.arg)
+        return ~d, v
+
+    def _eval_isnull(self, e: IsNull):
+        _, v = self.eval(e.arg)
+        if v is None:
+            res = jnp.zeros((self.page.capacity,), dtype=jnp.bool_)
+        else:
+            res = ~v
+        if e.negate:
+            res = ~res
+        return res, None
+
+    # -- conditional -------------------------------------------------------
+
+    def _eval_case(self, e: Case):
+        # evaluate all branches, select first matching WHEN (SQL order)
+        conds = []
+        vals = []
+        for c, v in e.whens:
+            cd, cv = self.eval(c)
+            cd = cd & cv if cv is not None else cd  # null cond = no match
+            vd, vv = self.eval(v)
+            conds.append(cd)
+            vals.append((vd, vv))
+        if e.default is not None:
+            dd, dv = self.eval(e.default)
+        else:
+            dd = jnp.zeros((self.page.capacity,), dtype=e.dtype.jnp_dtype)
+            dv = jnp.zeros((self.page.capacity,), dtype=jnp.bool_)
+        out_d, out_v = dd, dv
+        needs_valid = dv is not None or any(vv is not None for _, vv in vals)
+        if needs_valid and out_v is None:
+            out_v = jnp.ones(jnp.shape(out_d), dtype=jnp.bool_)
+        branch_types = [v.dtype for _, v in e.whens]
+        for cd, (vd, vv), bt in zip(
+            reversed(conds), reversed(vals), reversed(branch_types)
+        ):
+            vd = _coerce_to(vd, bt, e.dtype)
+            out_d = jnp.where(cd, vd, out_d)
+            if needs_valid:
+                branch_v = vv if vv is not None else jnp.ones(jnp.shape(vd), jnp.bool_)
+                out_v = jnp.where(cd, branch_v, out_v)
+        return out_d, (out_v if needs_valid else None)
+
+    def _eval_coalesce(self, e: Coalesce):
+        out_d, out_v = self.eval(e.args[0])
+        out_d = _coerce_to(out_d, e.args[0].dtype, e.dtype)
+        for a in e.args[1:]:
+            if out_v is None:
+                return out_d, None
+            d, v = self.eval(a)
+            d = _coerce_to(d, a.dtype, e.dtype)
+            out_d = jnp.where(out_v, out_d, d)
+            out_v = out_v | (v if v is not None else True)
+        return out_d, out_v
+
+    def _eval_cast(self, e: Cast):
+        d, v = self.eval(e.arg)
+        src, dst = e.arg.dtype, e.to
+        if src == dst:
+            return d, v
+        if dst.is_decimal:
+            if src.is_decimal:
+                return _rescale(d, src.scale, dst.scale), v
+            if src.is_integer:
+                return d.astype(jnp.int64) * (10 ** dst.scale), v
+            if src.name in ("double", "real"):
+                scaled = d.astype(jnp.float64) * (10 ** dst.scale)
+                # half-up away from zero (jnp.round is half-to-even)
+                return (
+                    jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+                ).astype(jnp.int64), v
+        if src.is_decimal:
+            if dst.name in ("double", "real"):
+                return (
+                    d.astype(jnp.float64) / (10 ** src.scale)
+                ).astype(dst.jnp_dtype), v
+            if dst.is_integer:
+                return _rescale(d, src.scale, 0).astype(dst.jnp_dtype), v
+        return d.astype(dst.jnp_dtype), v
+
+    # -- predicates --------------------------------------------------------
+
+    def _eval_between(self, e: Between):
+        lo = Compare(">=", e.arg, e.low)
+        hi = Compare("<=", e.arg, e.high)
+        d, v = self._eval_and(And((lo, hi)))
+        return (~d if e.negate else d), v
+
+    def _eval_inlist(self, e: InList):
+        if e.arg.dtype.is_string:
+            assert isinstance(e.arg, ColumnRef)
+            blk = self.page.block(e.arg.name)
+            ids = [
+                blk.dictionary.id_of(lit.value)
+                for lit in e.values
+                if isinstance(lit, Literal)
+            ]
+            ids = [i for i in ids if i >= 0]
+            if not ids:
+                res = jnp.zeros((self.page.capacity,), jnp.bool_)
+            else:
+                res = jnp.isin(blk.data, jnp.asarray(ids, jnp.int32))
+            return (~res if e.negate else res), blk.valid
+        d, v = self.eval(e.arg)
+        vals = jnp.asarray(
+            [lit.value for lit in e.values], dtype=e.arg.dtype.jnp_dtype
+        )
+        res = jnp.isin(d, vals)
+        return (~res if e.negate else res), v
+
+    def _eval_like(self, e: Like):
+        assert isinstance(e.arg, ColumnRef) and e.arg.dtype.is_string
+        blk = self.page.block(e.arg.name)
+        rx = like_to_regex(e.pattern)
+        lut = blk.dictionary.predicate_lut(lambda s: rx.match(s) is not None)
+        if len(lut) == 0:
+            res = jnp.zeros((self.page.capacity,), jnp.bool_)
+        else:
+            res = jnp.asarray(lut)[jnp.clip(blk.data, 0, len(lut) - 1)]
+        return (~res if e.negate else res), blk.valid
+
+    def _eval_extract(self, e: Extract):
+        d, v = self.eval(e.arg)
+        y, m, day = _civil_from_days(d)
+        f = e.field.lower()
+        if f == "year":
+            return y, v
+        if f == "month":
+            return m, v
+        if f == "day":
+            return day, v
+        if f == "quarter":
+            return (m + 2) // 3, v
+        raise NotImplementedError(f"extract({e.field})")
+
+
+def _maybe_zero(e: Expr) -> bool:
+    return not (isinstance(e, Literal) and e.value not in (0, None))
+
+
+def _tv_and_valid(ld, lv, rd, rv):
+    """Validity of (l AND r): known iff both known, or either is known-false."""
+    lk = lv if lv is not None else True
+    rk = rv if rv is not None else True
+    known_false = ((ld == False) & lk) | ((rd == False) & rk)  # noqa: E712
+    return (lk & rk) | known_false
+
+
+def _tv_or_valid(ld, lv, rd, rv):
+    lk = lv if lv is not None else True
+    rk = rv if rv is not None else True
+    known_true = (ld & lk) | (rd & rk)
+    return (lk & rk) | known_true
+
+
+def _coerce_to(data, from_t: T.DataType, to_t: T.DataType):
+    if from_t == to_t:
+        return data
+    if to_t.is_decimal and from_t.is_decimal:
+        return _rescale(data, from_t.scale, to_t.scale)
+    if to_t.is_decimal and from_t.is_integer:
+        return data.astype(jnp.int64) * (10 ** to_t.scale)
+    return data.astype(to_t.jnp_dtype)
+
+
+def eval_expr(expr: Expr, page: Page):
+    """Lower ``expr`` over ``page`` -> (data, valid|None). Trace-time API."""
+    return ExprLowerer(page).eval(expr)
+
+
+def eval_predicate(expr: Expr, page: Page) -> jnp.ndarray:
+    """Predicate as a keep-mask over live rows: NULL -> False (SQL WHERE),
+    padding rows -> False."""
+    d, v = eval_expr(expr, page)
+    mask = d if v is None else (d & v)
+    return mask & page.row_mask()

@@ -1,0 +1,11 @@
+"""Device-side operator kernels.
+
+Reference parity: presto-main ``…/operator/`` (SURVEY.md §2.1 "Operators").
+TPU-first redesign: operators here are *trace-time kernel compositions* —
+pure functions over Page pytrees called inside a fragment's ``jax.jit`` —
+not runtime objects pumping pages through a Driver loop. XLA fuses
+adjacent operators; the fragment is the compilation unit (SURVEY.md §7
+"Design stance").
+"""
+
+from presto_tpu.ops.filter_project import filter_project, project  # noqa: F401

@@ -1,0 +1,106 @@
+"""Fused filter + project over a Page.
+
+Reference parity: ``ScanFilterAndProjectOperator`` / ``FilterAndProject-
+Operator`` driven by the bytecode-compiled ``PageProcessor`` (selected
+positions + projected blocks) — SURVEY.md §2.1, §3.3.
+
+TPU-first shape: the predicate lowers to a boolean mask, survivors are
+*compacted to the front* with a static-size ``jnp.nonzero`` so the output
+page has the same capacity (XLA static shapes) and a traced ``num_valid``.
+Projections are evaluated over the full page and gathered through the
+selection — XLA fuses mask, select and projection into one kernel, which
+is exactly what the reference's JIT'd PageProcessor does on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.expr import ColumnRef, Expr, eval_expr, eval_predicate
+from presto_tpu.page import Block, Page
+
+
+def _result_dictionary(expr: Expr, page: Page):
+    """Propagate the host-side dictionary for string-typed results (only
+    ColumnRef can produce strings in round 1 — no string-valued funcs)."""
+    if expr.dtype.is_string and isinstance(expr, ColumnRef):
+        return page.block(expr.name).dictionary
+    if expr.dtype.is_string:
+        raise NotImplementedError(
+            "string-valued expression other than column reference"
+        )
+    return None
+
+
+def project(
+    page: Page, projections: Sequence[Tuple[str, Expr]]
+) -> Page:
+    """Pure projection (no selection)."""
+    names, blocks = [], []
+    for name, expr in projections:
+        data, valid = eval_expr(expr, page)
+        data = jnp.broadcast_to(data, (page.capacity,))
+        if valid is not None:
+            valid = jnp.broadcast_to(valid, (page.capacity,))
+        blocks.append(
+            Block(
+                data=data,
+                valid=valid,
+                dtype=expr.dtype,
+                dictionary=_result_dictionary(expr, page),
+            )
+        )
+        names.append(name)
+    return Page(
+        blocks=tuple(blocks), num_valid=page.num_valid, names=tuple(names)
+    )
+
+
+def filter_project(
+    page: Page,
+    predicate: Optional[Expr],
+    projections: Sequence[Tuple[str, Expr]],
+    out_capacity: Optional[int] = None,
+) -> Page:
+    """Filter by ``predicate`` (None = keep all live rows), then project.
+
+    Output capacity defaults to input capacity; pass a smaller
+    ``out_capacity`` when the planner knows a tighter bound (static shape
+    step-down without a host round-trip)."""
+    if predicate is None:
+        out = project(page, projections)
+        if out_capacity is not None and out_capacity != page.capacity:
+            from presto_tpu.page import pad_capacity
+
+            out = pad_capacity(out, out_capacity)
+        return out
+
+    cap = out_capacity if out_capacity is not None else page.capacity
+    mask = eval_predicate(predicate, page)
+    count = jnp.sum(mask).astype(jnp.int32)
+    (sel,) = jnp.nonzero(mask, size=cap, fill_value=0)
+
+    names, blocks = [], []
+    for name, expr in projections:
+        data, valid = eval_expr(expr, page)
+        data = jnp.broadcast_to(data, (page.capacity,))[sel]
+        if valid is not None:
+            valid = jnp.broadcast_to(valid, (page.capacity,))[sel]
+        blocks.append(
+            Block(
+                data=data,
+                valid=valid,
+                dtype=expr.dtype,
+                dictionary=_result_dictionary(expr, page),
+            )
+        )
+        names.append(name)
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.minimum(count, cap),
+        names=tuple(names),
+    )

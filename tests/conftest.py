@@ -10,7 +10,9 @@ Must set env vars BEFORE jax initialises its backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set (not setdefault): the environment may pin JAX_PLATFORMS to a
+# real accelerator platform; correctness CI must run CPU-only.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,4 +21,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# Config-level override as well: an accelerator plugin loaded at
+# interpreter startup (sitecustomize) may have called
+# jax.config.update("jax_platforms", ...), which outranks the env var.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,196 @@
+"""Expression lowering + fused filter/project tests (SURVEY.md §7 step 2).
+
+Hand-built Pages in the style of the reference's operator unit tests
+(SURVEY.md §4.1, assertOperatorEquals pattern).
+"""
+
+import datetime
+
+import jax
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.expr import (
+    And,
+    Arithmetic,
+    Between,
+    Case,
+    Cast,
+    ColumnRef,
+    Coalesce,
+    Compare,
+    Extract,
+    InList,
+    IsNull,
+    Like,
+    Literal,
+    Not,
+    Or,
+    arith,
+    eval_expr,
+    eval_predicate,
+    like_to_regex,
+)
+from presto_tpu.ops import filter_project, project
+from presto_tpu.page import Page
+
+
+def make_page(**cols):
+    """Build a page from name=(values, type) kwargs."""
+    data = {k: v[0] for k, v in cols.items()}
+    schema = {k: v[1] for k, v in cols.items()}
+    return Page.from_pydict(data, schema)
+
+
+def col(page, name):
+    return ColumnRef(name, page.schema()[name])
+
+
+def test_arith_decimal_exact():
+    p = make_page(
+        price=([10.25, 99.99, 0.01], T.decimal(12, 2)),
+        disc=([0.05, 0.00, 0.10], T.decimal(12, 2)),
+    )
+    # price * (1 - disc): the TPC-H Q1 expression
+    one = Literal(100, T.decimal(12, 2))  # unscaled for scale 2
+    e = arith("*", col(p, "price"), arith("-", one, col(p, "disc")))
+    assert e.dtype.is_decimal and e.dtype.scale == 4
+    d, v = eval_expr(e, p)
+    assert v is None
+    # 10.25*0.95 = 9.7375 -> unscaled 97375 at scale 4
+    assert np.asarray(d)[:3].tolist() == [97375, 999900, 90]
+
+
+def test_arith_null_propagation():
+    p = make_page(a=([1, None, 3], T.BIGINT), b=([10, 20, None], T.BIGINT))
+    d, v = eval_expr(arith("+", col(p, "a"), col(p, "b")), p)
+    assert list(np.asarray(v)) == [True, False, False]
+    assert int(np.asarray(d)[0]) == 11
+
+
+def test_division_semantics():
+    p = make_page(a=([7, -7, 5], T.BIGINT), b=([2, 2, 0], T.BIGINT))
+    d, v = eval_expr(arith("/", col(p, "a"), col(p, "b")), p)
+    # SQL integer division truncates toward zero; x/0 -> NULL
+    assert np.asarray(d)[:2].tolist() == [3, -3]
+    assert list(np.asarray(v)) == [True, True, False]
+
+
+def test_kleene_and_or():
+    p = make_page(
+        a=([True, True, None, False], T.BOOLEAN),
+        b=([True, None, None, None], T.BOOLEAN),
+    )
+    d, v = eval_expr(And((col(p, "a"), col(p, "b"))), p)
+    # T&T=T, T&N=N, N&N=N, F&N=F (false dominates)
+    vals = np.asarray(d)
+    valid = np.asarray(v)
+    assert (valid[0], bool(vals[0])) == (True, True)
+    assert not valid[1] and not valid[2]
+    assert valid[3] and not vals[3]
+    d, v = eval_expr(Or((col(p, "a"), col(p, "b"))), p)
+    # T|T=T, T|N=T (true dominates), N|N=N, F|N=N
+    valid = np.asarray(v)
+    assert valid[0] and valid[1] and not valid[2] and not valid[3]
+
+
+def test_string_compares_and_like():
+    p = make_page(s=(["apple", "banana", None, "cherry"], T.VARCHAR))
+    d, v = eval_expr(Compare("=", col(p, "s"), Literal("banana", T.VARCHAR)), p)
+    assert list(np.asarray(d))[:2] == [False, True]
+    assert not np.asarray(v)[2]
+    d, _ = eval_expr(Compare("<", col(p, "s"), Literal("b", T.VARCHAR)), p)
+    assert list(np.asarray(d))[:2] == [True, False]
+    # literal absent from dictionary: range still correct
+    d, _ = eval_expr(Compare(">=", col(p, "s"), Literal("bb", T.VARCHAR)), p)
+    assert [bool(x) for x in np.asarray(d)[:4:3]] == [False, True]
+    d, _ = eval_expr(Like(col(p, "s"), "%an%"), p)
+    assert [bool(x) for x in np.asarray(d)[:2]] == [False, True]
+    d, _ = eval_expr(InList(col(p, "s"), (Literal("apple", T.VARCHAR), Literal("zzz", T.VARCHAR))), p)
+    assert [bool(x) for x in np.asarray(d)[:2]] == [True, False]
+
+
+def test_like_regex_translation():
+    assert like_to_regex("a%b_c").match("aXXbYc")
+    assert not like_to_regex("a%b_c").match("aXXbYYc")
+    assert like_to_regex("10.5%").match("10.5extra")
+    assert not like_to_regex("10.5%").match("1035")
+
+
+def test_between_case_cast_coalesce():
+    p = make_page(x=([1, 5, 10, None], T.BIGINT))
+    d, v = eval_expr(Between(col(p, "x"), Literal(2, T.BIGINT), Literal(9, T.BIGINT)), p)
+    assert [bool(b) for b in np.asarray(d)[:3]] == [False, True, False]
+    assert not np.asarray(v)[3]
+
+    c = Case(
+        whens=((Compare("<", col(p, "x"), Literal(5, T.BIGINT)), Literal(1, T.BIGINT)),),
+        default=Literal(0, T.BIGINT),
+        _dtype=T.BIGINT,
+    )
+    d, v = eval_expr(c, p)
+    assert np.asarray(d)[:3].tolist() == [1, 0, 0]
+
+    d, v = eval_expr(Cast(col(p, "x"), T.decimal(10, 2)), p)
+    assert np.asarray(d)[:3].tolist() == [100, 500, 1000]
+
+    d, v = eval_expr(Coalesce((col(p, "x"), Literal(-1, T.BIGINT)), T.BIGINT), p)
+    assert np.asarray(d)[3] == -1 or not (v is not None and not np.asarray(v)[3])
+
+
+def test_extract_dates():
+    days = [
+        (datetime.date(1995, 3, 15) - datetime.date(1970, 1, 1)).days,
+        (datetime.date(1970, 1, 1) - datetime.date(1970, 1, 1)).days,
+        (datetime.date(1969, 12, 31) - datetime.date(1970, 1, 1)).days,
+        (datetime.date(2000, 2, 29) - datetime.date(1970, 1, 1)).days,
+    ]
+    p = make_page(d=(days, T.DATE))
+    y, _ = eval_expr(Extract("year", col(p, "d")), p)
+    m, _ = eval_expr(Extract("month", col(p, "d")), p)
+    dd, _ = eval_expr(Extract("day", col(p, "d")), p)
+    assert np.asarray(y).tolist() == [1995, 1970, 1969, 2000]
+    assert np.asarray(m).tolist() == [3, 1, 12, 2]
+    assert np.asarray(dd).tolist() == [15, 1, 31, 29]
+
+
+def test_filter_project_end_to_end():
+    p = make_page(
+        k=([1, 2, 3, 4, 5], T.BIGINT),
+        price=([10.00, 20.00, 30.00, 40.00, 50.00], T.decimal(10, 2)),
+        tag=(["a", "b", "a", "c", "a"], T.VARCHAR),
+    )
+    pred = And(
+        (
+            Compare(">", col(p, "k"), Literal(1, T.BIGINT)),
+            Compare("=", col(p, "tag"), Literal("a", T.VARCHAR)),
+        )
+    )
+    out = jax.jit(
+        lambda page: filter_project(
+            page,
+            pred,
+            [
+                ("k", col(p, "k")),
+                ("double_price", arith("*", col(p, "price"), Literal(2, T.BIGINT))),
+                ("tag", col(p, "tag")),
+            ],
+        )
+    )(p)
+    rows = out.to_pylist()
+    assert [r["k"] for r in rows] == [3, 5]
+    assert [r["double_price"] for r in rows] == [60.0, 100.0]
+    assert [r["tag"] for r in rows] == ["a", "a"]
+
+
+def test_filter_null_is_false():
+    p = make_page(x=([1, None, 3], T.BIGINT))
+    mask = eval_predicate(Compare(">", col(p, "x"), Literal(0, T.BIGINT)), p)
+    assert [bool(b) for b in np.asarray(mask)] == [True, False, True]
+
+
+def test_project_scalar_broadcast():
+    p = make_page(x=([1, 2], T.BIGINT))
+    out = project(p, [("one", Literal(1, T.BIGINT)), ("x", col(p, "x"))])
+    assert [r["one"] for r in out.to_pylist()] == [1, 1]
