@@ -1,0 +1,302 @@
+"""Grouped aggregation kernel.
+
+Reference parity: ``HashAggregationOperator`` + ``GroupByHash`` +
+``InMemoryHashAggregationBuilder`` and the annotation-generated
+accumulators (SURVEY.md §2.1 "Operators", "Function registry").
+
+TPU-first redesign (SURVEY.md §7 step 3): instead of an open-addressing
+hash table mutated row-at-a-time, grouping is *sort-based* — a stable
+multi-key sort brings equal keys together, group boundaries fall out of a
+vectorized neighbour-compare, and every accumulator is a segmented
+reduction (``jax.ops.segment_*``), which XLA lowers to fast batched
+scatter-reduces. Shapes stay static: the planner supplies ``max_groups``
+(the output capacity bucket); kernels report overflow instead of
+reallocating, and the host re-runs at a bigger bucket on overflow
+(SURVEY.md §7 "Hard parts: dynamic shapes").
+
+Aggregate functions: count(*), count(x), sum, min, max, avg. Null
+semantics match SQL: aggregates skip nulls; count(*) counts rows;
+min/max on dictionary ids are valid because dictionaries are
+order-preserving. ``count(DISTINCT x)`` is a planner rewrite into a
+two-level aggregation, not a kernel (see presto_tpu.plan).
+
+Result types: sum(int)->bigint, sum(decimal(p,s))->decimal(18,s) exact on
+int64, sum(double)->double, count->bigint, avg->double (deviation: the
+reference returns decimal for decimal inputs; exact decimal avg lands
+with int128), min/max preserve the input type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.expr import Expr, eval_expr
+from presto_tpu.ops.common import boundaries, sort_order
+from presto_tpu.page import Block, Page
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall:
+    """One aggregate: func in {count, count_star, sum, min, max, avg}."""
+
+    func: str
+    arg: Optional[Expr]  # None only for count_star
+    out_name: str
+
+    def result_type(self) -> T.DataType:
+        if self.func in ("count", "count_star"):
+            return T.BIGINT
+        t = self.arg.dtype
+        if self.func == "sum":
+            if t.is_decimal:
+                return T.decimal(18, t.scale)
+            if t.is_integer:
+                return T.BIGINT
+            return T.DOUBLE
+        if self.func == "avg":
+            return T.DOUBLE
+        if self.func in ("min", "max"):
+            return t
+        raise NotImplementedError(f"aggregate {self.func}")
+
+
+def hash_aggregate(
+    page: Page,
+    group_keys: Sequence[Tuple[str, Expr]],
+    aggs: Sequence[AggCall],
+    max_groups: int,
+) -> Tuple[Page, jnp.ndarray]:
+    """Group ``page`` by key expressions, compute aggregates.
+
+    Returns (result_page, overflow) where overflow is a traced bool: True
+    when the data had more than ``max_groups`` groups (host must re-run
+    with a larger bucket; surplus groups were dropped).
+
+    Global aggregation (no keys) is the ``max_groups=1`` degenerate case.
+    """
+    live = page.row_mask()
+
+    if not group_keys:
+        return _global_aggregate(page, aggs, live)
+
+    keys = [(name, *eval_expr(e, page), e) for name, e in group_keys]
+    order = sort_order(
+        [(d, v, e.dtype) for _, d, v, e in keys], live
+    )
+    live_s = live[order]
+    keys_s = [
+        (name, d[order], None if v is None else v[order], e)
+        for name, d, v, e in keys
+    ]
+    bnd = boundaries([(d, v) for _, d, v, _ in keys_s], live_s)
+    # group id per sorted row; dead rows -> max_groups (dropped by the
+    # out-of-range scatter semantics of segment_*)
+    gid = jnp.cumsum(bnd.astype(jnp.int32)) - 1
+    gid = jnp.where(live_s, gid, max_groups)
+    gid = jnp.where(gid >= max_groups, max_groups, gid)
+    num_groups = jnp.sum(bnd).astype(jnp.int32)
+    overflow = num_groups > max_groups
+
+    cap = page.capacity
+    positions = jnp.arange(cap, dtype=jnp.int32)
+    first_pos = jax.ops.segment_min(
+        positions, gid, num_segments=max_groups + 1
+    )[:max_groups]
+    first_pos = jnp.where(
+        jnp.arange(max_groups) < jnp.minimum(num_groups, max_groups),
+        first_pos,
+        0,
+    )
+
+    names: List[str] = []
+    blocks: List[Block] = []
+    for name, d, v, e in keys_s:
+        names.append(name)
+        dictionary = None
+        if e.dtype.is_string:
+            from presto_tpu.expr import ColumnRef
+
+            assert isinstance(e, ColumnRef)
+            dictionary = page.block(e.name).dictionary
+        blocks.append(
+            Block(
+                data=d[first_pos],
+                valid=None if v is None else v[first_pos],
+                dtype=e.dtype,
+                dictionary=dictionary,
+            )
+        )
+
+    for agg in aggs:
+        blk = _segment_agg(agg, page, order, live_s, gid, max_groups)
+        names.append(agg.out_name)
+        blocks.append(blk)
+
+    out = Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.minimum(num_groups, max_groups).astype(jnp.int32),
+        names=tuple(names),
+    )
+    return out, overflow
+
+
+def _segment_agg(
+    agg: AggCall,
+    page: Page,
+    order: jnp.ndarray,
+    live_s: jnp.ndarray,
+    gid: jnp.ndarray,
+    max_groups: int,
+) -> Block:
+    nseg = max_groups + 1  # +1 absorbs dead rows routed to max_groups
+    rt = agg.result_type()
+
+    if agg.func == "count_star":
+        data = jax.ops.segment_sum(
+            live_s.astype(jnp.int64), gid, num_segments=nseg
+        )[:max_groups]
+        return Block(data=data, valid=None, dtype=T.BIGINT)
+
+    d, v = eval_expr(agg.arg, page)
+    d = jnp.broadcast_to(d, (page.capacity,))[order]
+    valid_s = live_s if v is None else (
+        live_s & jnp.broadcast_to(v, (page.capacity,))[order]
+    )
+
+    if agg.func == "count":
+        data = jax.ops.segment_sum(
+            valid_s.astype(jnp.int64), gid, num_segments=nseg
+        )[:max_groups]
+        return Block(data=data, valid=None, dtype=T.BIGINT)
+
+    cnt = jax.ops.segment_sum(
+        valid_s.astype(jnp.int64), gid, num_segments=nseg
+    )[:max_groups]
+    group_has_value = cnt > 0
+
+    if agg.func in ("sum", "avg"):
+        at = agg.arg.dtype
+        if at.name in ("double", "real") or agg.func == "avg":
+            x = d.astype(jnp.float64)
+            if at.is_decimal:
+                x = x / (10 ** at.scale)
+            x = jnp.where(valid_s, x, 0.0)
+            s = jax.ops.segment_sum(x, gid, num_segments=nseg)[:max_groups]
+            if agg.func == "avg":
+                data = s / jnp.maximum(cnt, 1)
+                return Block(
+                    data=data, valid=group_has_value, dtype=T.DOUBLE
+                )
+            return Block(data=s, valid=group_has_value, dtype=T.DOUBLE)
+        x = jnp.where(valid_s, d.astype(jnp.int64), 0)
+        s = jax.ops.segment_sum(x, gid, num_segments=nseg)[:max_groups]
+        return Block(data=s, valid=group_has_value, dtype=rt)
+
+    if agg.func in ("min", "max"):
+        at = agg.arg.dtype
+        if at.name in ("double", "real"):
+            fill = jnp.inf if agg.func == "min" else -jnp.inf
+            x = jnp.where(valid_s, d.astype(jnp.float64), fill)
+            op = jax.ops.segment_min if agg.func == "min" else jax.ops.segment_max
+            data = op(x, gid, num_segments=nseg)[:max_groups]
+            data = data.astype(at.jnp_dtype)
+        else:
+            info = jnp.iinfo(jnp.int64)
+            fill = info.max if agg.func == "min" else info.min
+            x = jnp.where(valid_s, d.astype(jnp.int64), fill)
+            op = jax.ops.segment_min if agg.func == "min" else jax.ops.segment_max
+            data = op(x, gid, num_segments=nseg)[:max_groups]
+            data = data.astype(at.jnp_dtype)
+        dictionary = None
+        if at.is_string:
+            from presto_tpu.expr import ColumnRef
+
+            if isinstance(agg.arg, ColumnRef):
+                dictionary = page.block(agg.arg.name).dictionary
+        return Block(
+            data=data, valid=group_has_value, dtype=at, dictionary=dictionary
+        )
+
+    raise NotImplementedError(f"aggregate {agg.func}")
+
+
+def _global_aggregate(
+    page: Page, aggs: Sequence[AggCall], live: jnp.ndarray
+) -> Tuple[Page, jnp.ndarray]:
+    """No GROUP BY: one output row (even over zero input rows, per SQL)."""
+    names, blocks = [], []
+    for agg in aggs:
+        if agg.func == "count_star":
+            data = jnp.sum(live.astype(jnp.int64))[None]
+            blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
+            names.append(agg.out_name)
+            continue
+        d, v = eval_expr(agg.arg, page)
+        d = jnp.broadcast_to(d, (page.capacity,))
+        valid = live if v is None else (live & jnp.broadcast_to(v, (page.capacity,)))
+        cnt = jnp.sum(valid.astype(jnp.int64))
+        has = (cnt > 0)[None]
+        if agg.func == "count":
+            blocks.append(Block(data=cnt[None], valid=None, dtype=T.BIGINT))
+        elif agg.func in ("sum", "avg"):
+            at = agg.arg.dtype
+            if at.name in ("double", "real") or agg.func == "avg":
+                x = d.astype(jnp.float64)
+                if at.is_decimal:
+                    x = x / (10 ** at.scale)
+                s = jnp.sum(jnp.where(valid, x, 0.0))
+                if agg.func == "avg":
+                    blocks.append(
+                        Block(
+                            data=(s / jnp.maximum(cnt, 1))[None],
+                            valid=has,
+                            dtype=T.DOUBLE,
+                        )
+                    )
+                else:
+                    blocks.append(
+                        Block(data=s[None], valid=has, dtype=T.DOUBLE)
+                    )
+            else:
+                s = jnp.sum(jnp.where(valid, d.astype(jnp.int64), 0))
+                blocks.append(
+                    Block(data=s[None], valid=has, dtype=agg.result_type())
+                )
+        elif agg.func in ("min", "max"):
+            at = agg.arg.dtype
+            if at.name in ("double", "real"):
+                fill = jnp.inf if agg.func == "min" else -jnp.inf
+                x = jnp.where(valid, d.astype(jnp.float64), fill)
+                s = (jnp.min(x) if agg.func == "min" else jnp.max(x)).astype(
+                    at.jnp_dtype
+                )
+            else:
+                info = jnp.iinfo(jnp.int64)
+                fill = info.max if agg.func == "min" else info.min
+                x = jnp.where(valid, d.astype(jnp.int64), fill)
+                s = (jnp.min(x) if agg.func == "min" else jnp.max(x)).astype(
+                    at.jnp_dtype
+                )
+            dictionary = None
+            if at.is_string:
+                from presto_tpu.expr import ColumnRef
+
+                if isinstance(agg.arg, ColumnRef):
+                    dictionary = page.block(agg.arg.name).dictionary
+            blocks.append(
+                Block(data=s[None], valid=has, dtype=at, dictionary=dictionary)
+            )
+        else:
+            raise NotImplementedError(agg.func)
+        names.append(agg.out_name)
+    out = Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.asarray(1, jnp.int32),
+        names=tuple(names),
+    )
+    return out, jnp.asarray(False)

@@ -1,0 +1,93 @@
+"""Shared kernel utilities: orderable keys, lexicographic sort orders.
+
+The TPU has no comparator trees for structs — multi-column orderings are
+expressed as a sequence of stable int64 sorts (XLA sorts are fast,
+vectorized, and fuse with the surrounding gather). Every SQL type maps to
+an *order-preserving* int64 image (``orderable_i64``), so one code path
+serves sort, group-by boundary detection, merge and join kernels.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+
+
+def orderable_i64(data: jnp.ndarray, dtype: T.DataType) -> jnp.ndarray:
+    """Map a column to int64 such that int comparison == SQL comparison.
+
+    - ints/dates/decimals/dict-ids: widen to int64 (dict ids are
+      order-preserving by construction, presto_tpu.page.Dictionary)
+    - floats: sign-magnitude bit trick (IEEE754 totally ordered for
+      non-NaN; NaN sorts last as in the reference's ORDER BY)
+    """
+    if dtype.name in ("double", "real"):
+        bits = jnp.asarray(data, jnp.float64).view(jnp.int64)
+        # IEEE754 total order as signed int64: positives keep their bit
+        # pattern in [0, 2^63); negatives map to ~bits with the sign bit
+        # set, landing in [-2^63, 0) in reversed-magnitude order.
+        return jnp.where(bits >= 0, bits, (~bits) | jnp.int64(-(2 ** 63)))
+    if dtype.name == "boolean":
+        return data.astype(jnp.int64)
+    return jnp.asarray(data).astype(jnp.int64)
+
+
+def sort_order(
+    keys: Sequence[Tuple[jnp.ndarray, Optional[jnp.ndarray], T.DataType]],
+    live: jnp.ndarray,
+    descending: Optional[Sequence[bool]] = None,
+    nulls_first: Optional[Sequence[bool]] = None,
+) -> jnp.ndarray:
+    """Permutation sorting rows by keys (list of (data, valid, dtype)),
+    live rows first. SQL default: nulls last in ASC, first in DESC
+    (reference: NULLS LAST semantics for ASC ordering).
+    """
+    n = len(keys)
+    descending = descending or [False] * n
+    nulls_first = nulls_first or [d for d in descending]
+    lex: List[jnp.ndarray] = []
+    # jnp.lexsort: LAST key is primary -> emit least-significant first
+    for (data, valid, dtype), desc, nf in zip(
+        reversed(list(keys)), reversed(list(descending)), reversed(list(nulls_first))
+    ):
+        k = orderable_i64(data, dtype)
+        if desc:
+            k = -k
+        null_rank = (
+            jnp.zeros(k.shape, jnp.int64)
+            if valid is None
+            else jnp.where(valid, 0, -1 if nf else 1)
+        )
+        lex.append(k)
+        lex.append(null_rank)  # more significant than the value
+    lex.append(jnp.where(live, 0, 1).astype(jnp.int64))  # live first
+    return jnp.lexsort(lex)
+
+
+def boundaries(
+    sorted_keys: Sequence[Tuple[jnp.ndarray, Optional[jnp.ndarray]]],
+    live_sorted: jnp.ndarray,
+) -> jnp.ndarray:
+    """True where a new group starts (first live row or any key change).
+    Inputs already sorted; nulls group together (SQL GROUP BY)."""
+    first = jnp.zeros(live_sorted.shape, jnp.bool_).at[0].set(True)
+    change = first
+    for data, valid in sorted_keys:
+        d = jnp.asarray(data)
+        diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), d[1:] != d[:-1]])
+        if valid is not None:
+            v = jnp.asarray(valid)
+            vdiff = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), v[1:] != v[:-1]]
+            )
+            diff = diff | vdiff
+            # two nulls are the same group regardless of payload data
+            both_null = jnp.concatenate(
+                [jnp.zeros((1,), jnp.bool_), (~v[1:]) & (~v[:-1])]
+            )
+            diff = diff & ~both_null
+        change = change | diff
+    return change & live_sorted

@@ -1,0 +1,226 @@
+"""Equi-join kernels: inner / left outer / semi / anti.
+
+Reference parity: ``HashBuilderOperator`` -> ``PagesIndex`` ->
+``LookupSourceFactory`` bridged to ``LookupJoinOperator`` (+``JoinProbe``)
+— the two-pipeline build/probe split of SURVEY.md §3.3.
+
+TPU-first redesign (SURVEY.md §7 step 3): no pointer-chasing hash table.
+The build side is *sorted by key* once (XLA sort), and every probe row
+finds its match range with two vectorized ``searchsorted`` binary
+searches — a batched, branch-free probe that keeps the VPU lanes full.
+Duplicate build keys become [lo, hi) ranges; the output expansion is the
+classic prefix-sum + inverse-searchsorted trick, entirely static-shape:
+the planner supplies ``out_capacity`` and the kernel reports overflow
+(host re-runs at a bigger bucket), mirroring the engine-wide
+capacity-bucket protocol (SURVEY.md §7 "Hard parts").
+
+Keys are single int64 columns; the planner packs two int32-representable
+key columns bijectively via ``pack_keys`` (wider composites: future
+round). NULL keys never match (SQL equi-join); anti join keeps unmatched
+probe rows (NOT EXISTS semantics — NOT IN null handling is a planner
+rewrite). Join keys of exactly int64-max are unsupported (sentinel);
+unreachable for real workloads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.ops.common import orderable_i64
+from presto_tpu.page import Block, Page
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+def pack_keys(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Bijectively pack two int32-representable key columns into int64."""
+    return (a.astype(jnp.int64) << 32) | (b.astype(jnp.int64) & 0xFFFFFFFF)
+
+
+def _key_of(page: Page, key_cols: Sequence[str]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(int64 key, ok-mask) for live rows with non-null key columns."""
+    ok = page.row_mask()
+    datas = []
+    widths = []
+    for name in key_cols:
+        blk = page.block(name)
+        datas.append(orderable_i64(blk.data, blk.dtype))
+        widths.append(blk.dtype.np_dtype.itemsize)
+        if blk.valid is not None:
+            ok = ok & blk.valid
+    if len(datas) == 1:
+        key = datas[0]
+    elif len(datas) == 2:
+        # pack is bijective only for 32-bit key columns; wider values
+        # would wrap modulo 2^64 and silently collide. The planner must
+        # cast bigint keys down (stats-bounded) before using a pair key.
+        if any(w > 4 for w in widths):
+            raise NotImplementedError(
+                "two-column join keys must be 32-bit columns "
+                f"(got widths {widths}); planner must narrow first"
+            )
+        key = pack_keys(datas[0], datas[1])
+    else:
+        raise NotImplementedError(
+            ">2 join key columns (pack wider composites in the planner)"
+        )
+    return key, ok
+
+
+def _gather_page(page: Page, idx: jnp.ndarray, num_valid, names=None) -> Page:
+    blocks = []
+    use_names = names if names is not None else page.names
+    for name in use_names:
+        blk = page.block(name)
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[idx],
+                valid=None if blk.valid is None else blk.valid[idx],
+            )
+        )
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.asarray(num_valid, jnp.int32),
+        names=tuple(use_names),
+    )
+
+
+def _compact(page: Page, keep: jnp.ndarray) -> Page:
+    count = jnp.sum(keep).astype(jnp.int32)
+    (sel,) = jnp.nonzero(keep, size=page.capacity, fill_value=0)
+    return _gather_page(page, sel, count)
+
+
+def hash_join(
+    probe: Page,
+    build: Page,
+    probe_keys: Sequence[str],
+    build_keys: Sequence[str],
+    join_type: str = "inner",
+    build_payload: Optional[Sequence[str]] = None,
+    build_unique: bool = False,
+    out_capacity: Optional[int] = None,
+    payload_rename: Optional[dict] = None,
+) -> Tuple[Page, jnp.ndarray]:
+    """Join ``probe`` with ``build`` on equality of packed keys.
+
+    Returns (result, overflow). Result columns = all probe columns plus
+    ``build_payload`` columns (optionally renamed via ``payload_rename``).
+    join_type: inner | left | semi | anti.
+    """
+    build_payload = list(build_payload or [])
+    payload_rename = payload_rename or {}
+
+    pk, p_ok = _key_of(probe, probe_keys)
+    bk, b_ok = _key_of(build, build_keys)
+
+    # sort build by key; unmatchable rows carry the sentinel and sort last
+    b_sort_key = jnp.where(b_ok, bk, _I64_MAX)
+    b_order = jnp.argsort(b_sort_key, stable=True)
+    bk_s = b_sort_key[b_order]
+    nb = jnp.sum(b_ok).astype(jnp.int32)
+
+    pk_eff = jnp.where(p_ok, pk, _I64_MAX)
+    lo = jnp.searchsorted(bk_s, pk_eff, side="left")
+    hi = jnp.searchsorted(bk_s, pk_eff, side="right")
+    lo = jnp.minimum(lo, nb)
+    hi = jnp.minimum(hi, nb)
+    m = jnp.where(p_ok, hi - lo, 0)  # matches per probe row
+
+    if join_type == "semi":
+        return _compact(probe, m > 0), jnp.asarray(False)
+    if join_type == "anti":
+        keep = (m == 0) & probe.row_mask()
+        return _compact(probe, keep), jnp.asarray(False)
+
+    if build_unique:
+        # PK side: m in {0,1}; output row i <-> probe row i (static!)
+        matched = m > 0
+        b_idx = b_order[jnp.clip(lo, 0, build.capacity - 1)]
+        out = _join_output(
+            probe,
+            build,
+            jnp.arange(probe.capacity),
+            b_idx,
+            matched,
+            build_payload,
+            payload_rename,
+            left_outer=(join_type == "left"),
+        )
+        if join_type == "inner":
+            keep = matched & probe.row_mask()
+            return _compact(out, keep), jnp.asarray(False)
+        return out, jnp.asarray(False)
+
+    # general duplicate-capable expansion
+    if out_capacity is None:
+        raise ValueError("non-unique inner/left join requires out_capacity")
+    m_eff = jnp.maximum(m, 1) if join_type == "left" else m
+    m_eff = jnp.where(probe.row_mask(), m_eff, 0)
+    total = jnp.cumsum(m_eff)
+    out_count = total[-1] if probe.capacity else jnp.asarray(0, jnp.int64)
+    overflow = out_count > out_capacity
+
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
+    p_idx = jnp.searchsorted(total, j, side="right")
+    p_idx = jnp.minimum(p_idx, probe.capacity - 1)
+    prev = jnp.where(p_idx > 0, total[jnp.maximum(p_idx - 1, 0)], 0)
+    offset = j - prev
+    row_m = m[p_idx]
+    matched = row_m > 0
+    b_pos = lo[p_idx] + jnp.minimum(offset, jnp.maximum(row_m - 1, 0))
+    b_idx = b_order[jnp.clip(b_pos, 0, build.capacity - 1)]
+    out = _join_output(
+        probe,
+        build,
+        p_idx,
+        b_idx,
+        matched,
+        build_payload,
+        payload_rename,
+        left_outer=(join_type == "left"),
+    )
+    out = dataclasses.replace(
+        out, num_valid=jnp.minimum(out_count, out_capacity).astype(jnp.int32)
+    )
+    return out, overflow
+
+
+def _join_output(
+    probe: Page,
+    build: Page,
+    p_idx: jnp.ndarray,
+    b_idx: jnp.ndarray,
+    matched: jnp.ndarray,
+    build_payload: Sequence[str],
+    payload_rename: dict,
+    left_outer: bool,
+) -> Page:
+    names: List[str] = []
+    blocks: List[Block] = []
+    for name in probe.names:
+        blk = probe.block(name)
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[p_idx],
+                valid=None if blk.valid is None else blk.valid[p_idx],
+            )
+        )
+        names.append(name)
+    for name in build_payload:
+        blk = build.block(name)
+        data = blk.data[b_idx]
+        valid = None if blk.valid is None else blk.valid[b_idx]
+        if left_outer:
+            valid = matched if valid is None else (valid & matched)
+        blocks.append(dataclasses.replace(blk, data=data, valid=valid))
+        names.append(payload_rename.get(name, name))
+    return Page(
+        blocks=tuple(blocks), num_valid=probe.num_valid, names=tuple(names)
+    )

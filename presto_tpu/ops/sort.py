@@ -1,0 +1,88 @@
+"""Ordering operators: ORDER BY, TopN, LIMIT, DISTINCT.
+
+Reference parity: ``OrderByOperator``, ``TopNOperator``, ``LimitOperator``,
+``DistinctLimitOperator``, ``MarkDistinctOperator`` (SURVEY.md §2.1).
+
+TPU-first: all orderings are stable multi-key int64 sorts (ops.common);
+TopN slices the sorted permutation (XLA sorts are O(n log n) bitonic-ish
+and bandwidth-bound — for the small-N case the planner can step the
+output capacity down to N so downstream fragments compile at the small
+shape). DISTINCT reuses the group-by machinery with zero aggregates.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from presto_tpu.expr import Expr, eval_expr
+from presto_tpu.ops.common import sort_order
+from presto_tpu.page import Block, Page
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    expr: Expr
+    descending: bool = False
+    nulls_first: Optional[bool] = None  # SQL default: last in ASC, first in DESC
+
+
+def order_by(
+    page: Page, keys: Sequence[SortKey], limit: Optional[int] = None
+) -> Page:
+    """Sort live rows; optionally keep only the first ``limit`` (TopN).
+
+    Output capacity = input capacity unless ``limit`` is given, in which
+    case the output page is sliced to capacity ``limit`` (static shape
+    step-down inside the fragment — the TopN fast path)."""
+    evaluated = [
+        (*eval_expr(k.expr, page), k.expr.dtype) for k in keys
+    ]
+    order = sort_order(
+        [(d, v, t) for d, v, t in evaluated],
+        page.row_mask(),
+        descending=[k.descending for k in keys],
+        nulls_first=[
+            k.nulls_first if k.nulls_first is not None else k.descending
+            for k in keys
+        ],
+    )
+    if limit is not None:
+        order = order[:limit]
+    blocks = []
+    for blk in page.blocks:
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[order],
+                valid=None if blk.valid is None else blk.valid[order],
+            )
+        )
+    num = page.num_valid if limit is None else jnp.minimum(
+        page.num_valid, limit
+    )
+    return Page(blocks=tuple(blocks), num_valid=num, names=page.names)
+
+
+def limit(page: Page, n: int) -> Page:
+    """LIMIT n: clamp the live-row count (no data movement)."""
+    return dataclasses.replace(
+        page, num_valid=jnp.minimum(page.num_valid, n).astype(jnp.int32)
+    )
+
+
+def distinct(page: Page, max_groups: Optional[int] = None):
+    """SELECT DISTINCT over all columns of ``page``.
+
+    Returns (page, overflow) like hash_aggregate."""
+    from presto_tpu.expr import ColumnRef
+    from presto_tpu.ops.aggregation import hash_aggregate
+
+    schema = page.schema()
+    keys = [(n, ColumnRef(n, schema[n])) for n in page.names]
+    return hash_aggregate(
+        page, keys, [], max_groups or page.capacity
+    )

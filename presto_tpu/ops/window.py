@@ -1,0 +1,314 @@
+"""Window function kernel.
+
+Reference parity: ``WindowOperator`` + window function registry
+(row_number, rank, dense_rank, aggregate windows) — SURVEY.md §2.1,
+BASELINE.json config "Window functions (rank/row_number OVER PARTITION
+BY)".
+
+TPU-first: one stable sort by (partition keys, order keys), then every
+window function is a *segmented scan* — partition starts and peer-group
+starts fall out of neighbour-compares, ranks are index arithmetic against
+segment-start gathers, and running aggregates are cumulative sums with
+the partition prefix subtracted (all O(n) vectorized, no per-partition
+loops; SURVEY.md §7 step 3 "window (segmented scans)").
+
+Default SQL frame semantics: with ORDER BY, aggregates run over RANGE
+UNBOUNDED PRECEDING..CURRENT ROW (peers share the value of their last
+peer row); without ORDER BY, over the whole partition. Output rows are
+emitted in (partition, order) sorted order — row order between operators
+is unspecified in SQL, the final ORDER BY governs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.expr import Expr, eval_expr
+from presto_tpu.ops.common import boundaries, sort_order
+from presto_tpu.ops.sort import SortKey
+from presto_tpu.page import Block, Page
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """func in {row_number, rank, dense_rank, sum, count, avg, min, max}."""
+
+    func: str
+    arg: Optional[Expr]  # None for row_number/rank/dense_rank/count(*)
+    out_name: str
+
+    def result_type(self) -> T.DataType:
+        if self.func in ("row_number", "rank", "dense_rank", "count"):
+            return T.BIGINT
+        t = self.arg.dtype
+        if self.func == "sum":
+            if t.is_decimal:
+                return T.decimal(18, t.scale)
+            if t.is_integer:
+                return T.BIGINT
+            return T.DOUBLE
+        if self.func == "avg":
+            return T.DOUBLE
+        if self.func in ("min", "max"):
+            return t
+        raise NotImplementedError(f"window function {self.func}")
+
+
+def window(
+    page: Page,
+    partition_by: Sequence[Expr],
+    order_by: Sequence[SortKey],
+    calls: Sequence[WindowCall],
+) -> Page:
+    """Append window-function columns to ``page`` (sorted order output)."""
+    cap = page.capacity
+    live = page.row_mask()
+    part_eval = [(*eval_expr(e, page), e.dtype) for e in partition_by]
+    order_eval = [(*eval_expr(k.expr, page), k.expr.dtype) for k in order_by]
+
+    perm = sort_order(
+        part_eval + order_eval,
+        live,
+        descending=[False] * len(part_eval)
+        + [k.descending for k in order_by],
+        nulls_first=[False] * len(part_eval)
+        + [
+            k.nulls_first if k.nulls_first is not None else k.descending
+            for k in order_by
+        ],
+    )
+    live_s = live[perm]
+    part_s = [(d[perm], None if v is None else v[perm]) for d, v, _ in part_eval]
+    order_s = [(d[perm], None if v is None else v[perm]) for d, v, _ in order_eval]
+
+    part_bnd = (
+        boundaries(part_s, live_s)
+        if part_s
+        else (jnp.zeros((cap,), jnp.bool_).at[0].set(True) & live_s)
+    )
+    peer_bnd = boundaries(part_s + order_s, live_s) if order_s else part_bnd
+
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    pid = jnp.cumsum(part_bnd.astype(jnp.int32)) - 1
+    pid = jnp.where(live_s, pid, cap)  # dead rows -> dropped segment
+    peer_gid = jnp.cumsum(peer_bnd.astype(jnp.int32)) - 1
+    peer_gid = jnp.where(live_s, peer_gid, cap)
+
+    nseg = cap + 1
+    part_start = jax.ops.segment_min(pos, pid, num_segments=nseg)
+    peer_start = jax.ops.segment_min(pos, peer_gid, num_segments=nseg)
+    # last row position of each peer group (for RANGE frame value sharing)
+    peer_end = jax.ops.segment_max(pos, peer_gid, num_segments=nseg)
+
+    safe_pid = jnp.minimum(pid, cap)
+    safe_peer = jnp.minimum(peer_gid, cap)
+
+    names = list(page.names)
+    blocks = [
+        dataclasses.replace(
+            blk,
+            data=blk.data[perm],
+            valid=None if blk.valid is None else blk.valid[perm],
+        )
+        for blk in page.blocks
+    ]
+
+    for call in calls:
+        rt = call.result_type()
+        if call.func == "row_number":
+            data = pos - part_start[safe_pid] + 1
+            blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
+        elif call.func == "rank":
+            data = peer_start[safe_peer] - part_start[safe_pid] + 1
+            blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
+        elif call.func == "dense_rank":
+            first_peer_of_part = jax.ops.segment_min(
+                peer_gid, pid, num_segments=nseg
+            )
+            data = peer_gid - first_peer_of_part[safe_pid] + 1
+            blocks.append(
+                Block(data=data.astype(jnp.int64), valid=None, dtype=T.BIGINT)
+            )
+        elif call.func in ("sum", "count", "avg", "min", "max"):
+            blocks.append(
+                _window_agg(
+                    call,
+                    page,
+                    perm,
+                    live_s,
+                    pid,
+                    safe_pid,
+                    peer_gid,
+                    safe_peer,
+                    part_start,
+                    peer_end,
+                    pos,
+                    running=bool(order_by),
+                    nseg=nseg,
+                )
+            )
+        else:
+            raise NotImplementedError(call.func)
+        names.append(call.out_name)
+
+    return Page(
+        blocks=tuple(blocks), num_valid=page.num_valid, names=tuple(names)
+    )
+
+
+def _window_agg(
+    call: WindowCall,
+    page: Page,
+    perm,
+    live_s,
+    pid,
+    safe_pid,
+    peer_gid,
+    safe_peer,
+    part_start,
+    peer_end,
+    pos,
+    running: bool,
+    nseg: int,
+):
+    rt = call.result_type()
+    if call.arg is not None:
+        d, v = eval_expr(call.arg, page)
+        d = jnp.broadcast_to(d, (page.capacity,))[perm]
+        valid = live_s if v is None else (
+            live_s & jnp.broadcast_to(v, (page.capacity,))[perm]
+        )
+    else:  # count(*)
+        d = jnp.ones((page.capacity,), jnp.int64)
+        valid = live_s
+
+    at = call.arg.dtype if call.arg is not None else T.BIGINT
+    is_float = (
+        call.func == "avg" or at.name in ("double", "real")
+    ) and call.func not in ("min", "max", "count")
+
+    if is_float:
+        x = d.astype(jnp.float64)
+        if at.is_decimal:
+            x = x / (10 ** at.scale)
+        x = jnp.where(valid, x, 0.0)
+    elif call.func == "count":
+        x = valid.astype(jnp.int64)
+    else:
+        x = jnp.where(valid, d.astype(jnp.int64), 0)
+
+    if call.func in ("min", "max"):
+        # running min/max: cumulative within partition
+        if at.name in ("double", "real"):
+            fill = jnp.inf if call.func == "min" else -jnp.inf
+            xv = jnp.where(valid, d.astype(jnp.float64), fill)
+        else:
+            info = jnp.iinfo(jnp.int64)
+            fill = info.max if call.func == "min" else info.min
+            xv = jnp.where(valid, d.astype(jnp.int64), fill)
+        if running:
+            op = jnp.minimum if call.func == "min" else jnp.maximum
+            # segmented cumulative min/max: scan reset at partition starts
+            def step(carry, inp):
+                val, cur_pid = carry
+                xi, pi = inp
+                val = jnp.where(pi != cur_pid, xi, op(val, xi))
+                return (val, pi), val
+
+            (_, _), out = jax.lax.scan(
+                step, (xv[0], pid[0] - 1), (xv, pid)
+            )
+            # RANGE frame: peers share the value at the last peer row
+            data = out[peer_end[safe_peer]]
+            # validity from the RUNNING non-null count up to the frame end
+            cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
+            cnt_before = jnp.where(
+                part_start[safe_pid] > 0,
+                cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
+                jnp.zeros((), jnp.int64),
+            )
+            has = (cnt_cs - cnt_before)[peer_end[safe_peer]] > 0
+        else:
+            seg = (
+                jax.ops.segment_min if call.func == "min" else jax.ops.segment_max
+            )(xv, pid, num_segments=nseg)
+            data = seg[safe_pid]
+            cnt_seg = jax.ops.segment_sum(
+                valid.astype(jnp.int64), pid, num_segments=nseg
+            )
+            has = cnt_seg[safe_pid] > 0
+        dictionary = None
+        if at.is_string:
+            from presto_tpu.expr import ColumnRef
+
+            if isinstance(call.arg, ColumnRef):
+                dictionary = page.block(call.arg.name).dictionary
+        return Block(
+            data=data.astype(at.jnp_dtype),
+            valid=has,
+            dtype=at,
+            dictionary=dictionary,
+        )
+
+    if running:
+        cs = jnp.cumsum(x)
+        before_part = jnp.where(
+            part_start[safe_pid] > 0,
+            cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
+            jnp.zeros((), cs.dtype),
+        )
+        within = cs - before_part
+        # RANGE frame: peers share the value at the last peer row
+        data = within[peer_end[safe_peer]]
+        if call.func in ("count",):
+            return Block(data=data.astype(jnp.int64), valid=None, dtype=T.BIGINT)
+        if call.func == "avg":
+            cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
+            cnt_before = jnp.where(
+                part_start[safe_pid] > 0,
+                cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
+                jnp.zeros((), jnp.int64),
+            )
+            cnt_within = (cnt_cs - cnt_before)[peer_end[safe_peer]]
+            has = cnt_within > 0
+            return Block(
+                data=data / jnp.maximum(cnt_within, 1),
+                valid=has,
+                dtype=T.DOUBLE,
+            )
+        # sum
+        cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
+        cnt_before = jnp.where(
+            part_start[safe_pid] > 0,
+            cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
+            jnp.zeros((), jnp.int64),
+        )
+        has = (cnt_cs - cnt_before)[peer_end[safe_peer]] > 0
+        if is_float:
+            return Block(data=data, valid=has, dtype=T.DOUBLE)
+        return Block(data=data.astype(jnp.int64), valid=has, dtype=rt)
+
+    # whole-partition aggregate
+    seg = jax.ops.segment_sum(x, pid, num_segments=nseg)
+    cnt_seg = jax.ops.segment_sum(
+        valid.astype(jnp.int64), pid, num_segments=nseg
+    )
+    if call.func == "count":
+        return Block(
+            data=seg[safe_pid].astype(jnp.int64), valid=None, dtype=T.BIGINT
+        )
+    has = cnt_seg[safe_pid] > 0
+    if call.func == "avg":
+        return Block(
+            data=seg[safe_pid] / jnp.maximum(cnt_seg[safe_pid], 1),
+            valid=has,
+            dtype=T.DOUBLE,
+        )
+    if is_float:
+        return Block(data=seg[safe_pid], valid=has, dtype=T.DOUBLE)
+    return Block(data=seg[safe_pid].astype(jnp.int64), valid=has, dtype=rt)

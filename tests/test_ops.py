@@ -1,0 +1,358 @@
+"""Kernel operator tests: aggregation, sort/topN/limit/distinct, join,
+window (SURVEY.md §7 step 3), in the reference's hand-built-page style
+(SURVEY.md §4.1)."""
+
+import jax
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.expr import ColumnRef, Literal, arith
+from presto_tpu.ops import (
+    AggCall,
+    SortKey,
+    WindowCall,
+    distinct,
+    hash_aggregate,
+    hash_join,
+    limit,
+    order_by,
+    window,
+)
+from presto_tpu.page import Page
+
+
+def make_page(capacity=None, **cols):
+    data = {k: v[0] for k, v in cols.items()}
+    schema = {k: v[1] for k, v in cols.items()}
+    return Page.from_pydict(data, schema, capacity=capacity)
+
+
+def col(page, name):
+    return ColumnRef(name, page.schema()[name])
+
+
+# ----------------------------------------------------------- aggregation
+
+
+def test_hash_aggregate_basic():
+    p = make_page(
+        capacity=8,
+        k=(["a", "b", "a", "c", "b", "a"], T.VARCHAR),
+        x=([1, 2, 3, 4, 5, None], T.BIGINT),
+    )
+    out, overflow = jax.jit(
+        lambda pg: hash_aggregate(
+            pg,
+            [("k", col(p, "k"))],
+            [
+                AggCall("sum", col(p, "x"), "s"),
+                AggCall("count", col(p, "x"), "c"),
+                AggCall("count_star", None, "cs"),
+                AggCall("min", col(p, "x"), "mn"),
+                AggCall("max", col(p, "x"), "mx"),
+                AggCall("avg", col(p, "x"), "a"),
+            ],
+            max_groups=8,
+        )
+    )(p)
+    assert not bool(overflow)
+    rows = {r["k"]: r for r in out.to_pylist()}
+    assert set(rows) == {"a", "b", "c"}
+    # group a: x = 1, 3, NULL
+    assert rows["a"]["s"] == 4 and rows["a"]["c"] == 2 and rows["a"]["cs"] == 3
+    assert rows["a"]["mn"] == 1 and rows["a"]["mx"] == 3
+    assert abs(rows["a"]["a"] - 2.0) < 1e-12
+    assert rows["b"]["s"] == 7 and rows["c"]["s"] == 4
+
+
+def test_hash_aggregate_decimal_exact_and_null_group():
+    p = make_page(
+        capacity=8,
+        g=([1, 1, None, None, 2], T.BIGINT),
+        d=([10.25, 0.75, 5.00, 1.00, 3.50], T.decimal(10, 2)),
+    )
+    out, _ = hash_aggregate(
+        p, [("g", col(p, "g"))], [AggCall("sum", col(p, "d"), "s")], 8
+    )
+    rows = {r["g"]: r["s"] for r in out.to_pylist()}
+    # nulls form ONE group
+    assert rows[1] == 11.0 and rows[None] == 6.0 and rows[2] == 3.5
+
+
+def test_hash_aggregate_overflow_flag():
+    p = make_page(capacity=8, k=([1, 2, 3, 4, 5], T.BIGINT))
+    out, overflow = hash_aggregate(
+        p, [("k", col(p, "k"))], [AggCall("count_star", None, "c")], 3
+    )
+    assert bool(overflow)
+    assert int(out.num_valid) == 3
+
+
+def test_global_aggregate_empty_input():
+    p = make_page(capacity=4, x=([], T.BIGINT))
+    out, _ = hash_aggregate(
+        p,
+        [],
+        [AggCall("count_star", None, "c"), AggCall("sum", col(p, "x"), "s")],
+        1,
+    )
+    rows = out.to_pylist()
+    assert rows == [{"c": 0, "s": None}]  # SQL: sum over empty = NULL
+
+
+# ----------------------------------------------------------------- sort
+
+
+def test_order_by_multi_key_desc_nulls():
+    p = make_page(
+        capacity=8,
+        a=([2, 1, 2, None, 1], T.BIGINT),
+        b=([1.5, 9.9, 0.5, 7.7, 1.1], T.DOUBLE),
+    )
+    out = order_by(
+        p, [SortKey(col(p, "a")), SortKey(col(p, "b"), descending=True)]
+    )
+    rows = out.to_pylist()
+    assert [r["a"] for r in rows] == [1, 1, 2, 2, None]  # nulls last (ASC)
+    assert [r["b"] for r in rows][:4] == [9.9, 1.1, 1.5, 0.5]
+
+
+def test_topn_and_limit():
+    p = make_page(capacity=8, x=([5, 3, 9, 1, 7], T.BIGINT))
+    out = order_by(p, [SortKey(col(p, "x"))], limit=3)
+    assert out.capacity == 3
+    assert [r["x"] for r in out.to_pylist()] == [1, 3, 5]
+    l = limit(p, 2)
+    assert int(l.num_valid) == 2
+
+
+def test_distinct():
+    p = make_page(capacity=8, x=([1, 2, 1, 3, 2], T.BIGINT))
+    out, _ = distinct(p)
+    assert sorted(r["x"] for r in out.to_pylist()) == [1, 2, 3]
+
+
+# ----------------------------------------------------------------- join
+
+
+def _join_pages():
+    probe = make_page(
+        capacity=8,
+        pk=([10, 20, 30, 40, 10], T.BIGINT),
+        pv=(["a", "b", "c", "d", "e"], T.VARCHAR),
+    )
+    build = make_page(
+        capacity=4,
+        bk=([10, 20, 50], T.BIGINT),
+        bv=([100.0, 200.0, 500.0], T.DOUBLE),
+    )
+    return probe, build
+
+
+def test_join_inner_unique():
+    probe, build = _join_pages()
+    out, ov = jax.jit(
+        lambda p, b: hash_join(
+            p, b, ["pk"], ["bk"],
+            join_type="inner", build_payload=["bv"], build_unique=True,
+        )
+    )(probe, build)
+    rows = sorted(out.to_pylist(), key=lambda r: (r["pk"], r["pv"]))
+    assert [(r["pk"], r["bv"]) for r in rows] == [
+        (10, 100.0), (10, 100.0), (20, 200.0),
+    ]
+
+
+def test_join_left_unique():
+    probe, build = _join_pages()
+    out, _ = hash_join(
+        probe, build, ["pk"], ["bk"],
+        join_type="left", build_payload=["bv"], build_unique=True,
+    )
+    rows = {(r["pk"], r["pv"]): r["bv"] for r in out.to_pylist()}
+    assert rows[(30, "c")] is None and rows[(40, "d")] is None
+    assert rows[(10, "a")] == 100.0
+
+
+def test_join_semi_anti():
+    probe, build = _join_pages()
+    semi, _ = hash_join(probe, build, ["pk"], ["bk"], join_type="semi")
+    assert sorted(r["pk"] for r in semi.to_pylist()) == [10, 10, 20]
+    anti, _ = hash_join(probe, build, ["pk"], ["bk"], join_type="anti")
+    assert sorted(r["pk"] for r in anti.to_pylist()) == [30, 40]
+
+
+def test_join_duplicates_expansion():
+    probe = make_page(capacity=4, k=([1, 2, 3], T.BIGINT))
+    build = make_page(
+        capacity=8,
+        k2=([1, 1, 2, 9, 1], T.BIGINT),
+        w=([10, 11, 20, 90, 12], T.BIGINT),
+    )
+    out, ov = hash_join(
+        probe, build, ["k"], ["k2"],
+        join_type="inner", build_payload=["w"], out_capacity=8,
+    )
+    assert not bool(ov)
+    got = sorted((r["k"], r["w"]) for r in out.to_pylist())
+    assert got == [(1, 10), (1, 11), (1, 12), (2, 20)]
+    # overflow: capacity 2 < 4 matches
+    out, ov = hash_join(
+        probe, build, ["k"], ["k2"],
+        join_type="inner", build_payload=["w"], out_capacity=2,
+    )
+    assert bool(ov) and int(out.num_valid) == 2
+
+
+def test_join_left_duplicates():
+    probe = make_page(capacity=4, k=([1, 7], T.BIGINT))
+    build = make_page(capacity=4, k2=([1, 1], T.BIGINT), w=([10, 11], T.BIGINT))
+    out, _ = hash_join(
+        probe, build, ["k"], ["k2"],
+        join_type="left", build_payload=["w"], out_capacity=4,
+    )
+    got = sorted(
+        ((r["k"], r["w"]) for r in out.to_pylist()),
+        key=lambda t: (t[0], t[1] if t[1] is not None else -1),
+    )
+    assert got == [(1, 10), (1, 11), (7, None)]
+
+
+def test_join_null_keys_never_match():
+    probe = make_page(capacity=4, k=([1, None], T.BIGINT))
+    build = make_page(capacity=4, k2=([1, None], T.BIGINT), w=([10, 99], T.BIGINT))
+    out, _ = hash_join(
+        probe, build, ["k"], ["k2"],
+        join_type="inner", build_payload=["w"], out_capacity=4,
+    )
+    assert [(r["k"], r["w"]) for r in out.to_pylist()] == [(1, 10)]
+    anti, _ = hash_join(probe, build, ["k"], ["k2"], join_type="anti")
+    # NOT EXISTS semantics: the null-key probe row is kept
+    assert [r["k"] for r in anti.to_pylist()] == [None]
+
+
+def test_join_two_column_key():
+    probe = make_page(
+        capacity=4, a=([1, 1, 2], T.INTEGER), b=([5, 6, 5], T.INTEGER)
+    )
+    build = make_page(
+        capacity=4, a2=([1, 2], T.INTEGER), b2=([5, 5], T.INTEGER),
+        w=([100, 200], T.BIGINT),
+    )
+    out, _ = hash_join(
+        probe, build, ["a", "b"], ["a2", "b2"],
+        join_type="inner", build_payload=["w"], build_unique=True,
+    )
+    got = sorted((r["a"], r["b"], r["w"]) for r in out.to_pylist())
+    assert got == [(1, 5, 100), (2, 5, 200)]
+
+
+def test_join_two_column_key_rejects_wide_types():
+    import pytest
+
+    probe = make_page(capacity=4, a=([1], T.BIGINT), b=([5], T.BIGINT))
+    build = make_page(capacity=4, a2=([1], T.BIGINT), b2=([5], T.BIGINT))
+    with pytest.raises(NotImplementedError):
+        hash_join(probe, build, ["a", "b"], ["a2", "b2"], join_type="semi")
+
+
+# --------------------------------------------------------------- window
+
+
+def test_window_row_number_rank():
+    p = make_page(
+        capacity=8,
+        g=(["x", "x", "x", "y", "y"], T.VARCHAR),
+        v=([10, 10, 20, 5, 7], T.BIGINT),
+    )
+    out = window(
+        p,
+        [col(p, "g")],
+        [SortKey(col(p, "v"))],
+        [
+            WindowCall("row_number", None, "rn"),
+            WindowCall("rank", None, "rk"),
+            WindowCall("dense_rank", None, "dr"),
+        ],
+    )
+    rows = out.to_pylist()
+    by_g = {}
+    for r in rows:
+        by_g.setdefault(r["g"], []).append((r["v"], r["rn"], r["rk"], r["dr"]))
+    assert by_g["x"] == [(10, 1, 1, 1), (10, 2, 1, 1), (20, 3, 3, 2)]
+    assert by_g["y"] == [(5, 1, 1, 1), (7, 2, 2, 2)]
+
+
+def test_window_partition_aggregate():
+    p = make_page(
+        capacity=8,
+        g=([1, 1, 2], T.BIGINT),
+        v=([10.0, 30.0, 5.0], T.DOUBLE),
+    )
+    out = window(
+        p, [col(p, "g")], [], [WindowCall("sum", col(p, "v"), "s")]
+    )
+    rows = {(r["g"], r["v"]): r["s"] for r in out.to_pylist()}
+    assert rows[(1, 10.0)] == 40.0 and rows[(1, 30.0)] == 40.0
+    assert rows[(2, 5.0)] == 5.0
+
+
+def test_window_running_sum_with_peers():
+    p = make_page(
+        capacity=8,
+        g=([1, 1, 1, 1], T.BIGINT),
+        o=([1, 2, 2, 3], T.BIGINT),
+        v=([10, 20, 30, 40], T.BIGINT),
+    )
+    out = window(
+        p,
+        [col(p, "g")],
+        [SortKey(col(p, "o"))],
+        [WindowCall("sum", col(p, "v"), "s")],
+    )
+    rows = [(r["o"], r["s"]) for r in out.to_pylist()]
+    # RANGE frame: peers (o=2) share the running total including both
+    assert rows == [(1, 10), (2, 60), (2, 60), (3, 100)]
+
+
+def test_window_running_min():
+    p = make_page(
+        capacity=4,
+        g=([1, 1, 2], T.BIGINT),
+        o=([1, 2, 1], T.BIGINT),
+        v=([5, 3, 9], T.BIGINT),
+    )
+    out = window(
+        p,
+        [col(p, "g")],
+        [SortKey(col(p, "o"))],
+        [WindowCall("min", col(p, "v"), "m")],
+    )
+    rows = [(r["g"], r["o"], r["m"]) for r in out.to_pylist()]
+    assert rows == [(1, 1, 5), (1, 2, 3), (2, 1, 9)]
+
+
+def test_window_running_min_peer_sharing():
+    # RANGE frame: tied ORDER BY rows are peers and share the frame value
+    p = make_page(
+        capacity=4, g=([1, 1], T.BIGINT), o=([1, 1], T.BIGINT),
+        v=([5, 3], T.BIGINT),
+    )
+    out = window(
+        p, [col(p, "g")], [SortKey(col(p, "o"))],
+        [WindowCall("min", col(p, "v"), "m")],
+    )
+    assert [r["m"] for r in out.to_pylist()] == [3, 3]
+
+
+def test_window_running_min_null_frame():
+    # first row's frame contains only NULL -> result NULL
+    p = make_page(
+        capacity=4, g=([1, 1], T.BIGINT), o=([1, 2], T.BIGINT),
+        v=([None, 5], T.BIGINT),
+    )
+    out = window(
+        p, [col(p, "g")], [SortKey(col(p, "o"))],
+        [WindowCall("min", col(p, "v"), "m")],
+    )
+    assert [r["m"] for r in out.to_pylist()] == [None, 5]
