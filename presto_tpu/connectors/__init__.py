@@ -1,0 +1,42 @@
+"""Connector SPI + built-in connectors.
+
+Reference parity: ``presto-spi`` / ``presto-common`` plugin contract —
+``ConnectorFactory``, ``ConnectorMetadata``, ``ConnectorSplitManager``,
+``ConnectorPageSourceProvider`` (SURVEY.md §2.2). This boundary is the
+gate BASELINE.json says to preserve: the engine sees only the SPI;
+connectors own table metadata, split enumeration, and page production.
+
+Built-ins (mirroring the reference's test/bench fixtures):
+- ``tpch``      — deterministic TPC-H data generated on the fly from the
+                  scale factor (SURVEY.md §2.2 presto-tpch)
+- ``memory``    — writable in-memory tables (presto-memory)
+- ``blackhole`` — null source/sink with configurable fake rows
+                  (presto-blackhole, for scheduler/perf tests)
+- ``system``    — runtime introspection catalog (presto-system),
+                  registered by the server runtime
+"""
+
+from presto_tpu.connectors.spi import (  # noqa: F401
+    Connector,
+    ConnectorMetadata,
+    ConnectorSplit,
+    SplitSource,
+    TableHandle,
+)
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: F401
+from presto_tpu.connectors.memory import MemoryConnector  # noqa: F401
+from presto_tpu.connectors.blackhole import BlackholeConnector  # noqa: F401
+
+
+CONNECTOR_FACTORIES = {
+    "tpch": TpchConnector,
+    "memory": MemoryConnector,
+    "blackhole": BlackholeConnector,
+}
+
+
+def create_connector(name: str, **config) -> Connector:
+    """The ConnectorFactory seam (``connector.name=`` in catalog config)."""
+    if name not in CONNECTOR_FACTORIES:
+        raise KeyError(f"unknown connector: {name}")
+    return CONNECTOR_FACTORIES[name](**config)

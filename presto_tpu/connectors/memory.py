@@ -1,0 +1,87 @@
+"""In-memory writable connector (reference: ``presto-memory``,
+SURVEY.md §2.2 — the writable test fixture)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import (
+    Connector,
+    ConnectorMetadata,
+    ConnectorSplit,
+    SplitSource,
+    TableHandle,
+    TableStats,
+)
+
+
+class _MemMetadata(ConnectorMetadata):
+    def __init__(self, store):
+        self._store = store
+
+    def list_schemas(self):
+        return sorted({s for s, _ in self._store.tables})
+
+    def list_tables(self, schema):
+        return sorted(t for s, t in self._store.tables if s == schema)
+
+    def get_table_schema(self, handle: TableHandle):
+        key = (handle.schema, handle.table)
+        if key not in self._store.tables:
+            raise KeyError(f"table not found: {handle.schema}.{handle.table}")
+        return dict(self._store.tables[key][0])
+
+    def get_table_stats(self, handle: TableHandle):
+        key = (handle.schema, handle.table)
+        schema, data = self._store.tables[key]
+        n = len(next(iter(data.values()))) if data else 0
+        return TableStats(row_count=float(n))
+
+
+class _Store:
+    def __init__(self):
+        self.tables: Dict[tuple, tuple] = {}  # (schema, table) -> (schema, cols)
+
+
+class MemoryConnector(Connector):
+    def __init__(self, **config):
+        self._store = _Store()
+        self._metadata = _MemMetadata(self._store)
+
+    def metadata(self):
+        return self._metadata
+
+    def supports_writes(self):
+        return True
+
+    def create_table(self, handle: TableHandle, schema: Dict[str, T.DataType]):
+        self._store.tables[(handle.schema, handle.table)] = (dict(schema), {})
+
+    def append_rows(self, handle: TableHandle, data: Dict[str, np.ndarray]):
+        key = (handle.schema, handle.table)
+        schema, existing = self._store.tables[key]
+        merged = {}
+        for col in schema:
+            new = np.asarray(data[col], dtype=object)
+            merged[col] = (
+                np.concatenate([existing[col], new]) if existing else new
+            )
+        self._store.tables[key] = (schema, merged)
+
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+        schema, data = self._store.tables[(handle.schema, handle.table)]
+        n = len(next(iter(data.values()))) if data else 0
+        splits = [
+            ConnectorSplit(handle, lo, min(lo + target_split_rows, n))
+            for lo in range(0, n, target_split_rows)
+        ] or [ConnectorSplit(handle, 0, 0)]
+        return SplitSource(splits)
+
+    def create_page_source(self, split: ConnectorSplit, columns: Sequence[str]):
+        schema, data = self._store.tables[
+            (split.table.schema, split.table.table)
+        ]
+        return {c: data[c][split.row_start : split.row_end] for c in columns}

@@ -1,0 +1,128 @@
+"""Connector SPI: the engine <-> data-source contract.
+
+Reference parity: ``presto-spi`` interfaces — ``ConnectorMetadata`` (table
+/schema resolution, statistics), ``ConnectorSplitManager`` (split
+enumeration), ``ConnectorPageSourceProvider`` (split -> pages) — SURVEY.md
+§2.2. Pushdown surface kept minimal for round 1: column pruning (the
+``columns`` argument) and row-range splits; constraint/limit pushdown are
+later rounds.
+
+TPU-first note: a page source yields *host* columnar data (numpy) plus
+type metadata; the execution layer stages it into device Pages at the
+fragment boundary (SURVEY.md §7 step 1 "host-side encode/decode").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    """Opaque engine-side reference to a connector table."""
+
+    catalog: str
+    schema: str
+    table: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """Per-column statistics for the cost-based optimizer (reference:
+    ConnectorTableStatistics / StatsCalculator inputs)."""
+
+    distinct_count: Optional[float] = None
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    null_fraction: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TableStats:
+    row_count: Optional[float] = None
+    columns: Dict[str, ColumnStats] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConnectorSplit:
+    """One unit of scan parallelism (reference: ConnectorSplit).
+
+    Row-range based: [row_start, row_end) of the table's row space.
+    ``addresses`` is the locality hint for the scheduler."""
+
+    table: TableHandle
+    row_start: int
+    row_end: int
+    addresses: Sequence[str] = ()
+
+    @property
+    def num_rows(self) -> int:
+        return self.row_end - self.row_start
+
+
+class SplitSource:
+    """Batched split enumeration (reference: SplitSource.getNextBatch)."""
+
+    def __init__(self, splits: List[ConnectorSplit]):
+        self._splits = splits
+        self._pos = 0
+
+    def next_batch(self, max_size: int) -> List[ConnectorSplit]:
+        batch = self._splits[self._pos : self._pos + max_size]
+        self._pos += len(batch)
+        return batch
+
+    @property
+    def exhausted(self) -> bool:
+        return self._pos >= len(self._splits)
+
+
+class ConnectorMetadata:
+    def list_schemas(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_table_schema(self, handle: TableHandle) -> Dict[str, T.DataType]:
+        raise NotImplementedError
+
+    def get_table_stats(self, handle: TableHandle) -> TableStats:
+        return TableStats()
+
+
+class Connector:
+    """One mounted catalog (reference: Connector from ConnectorFactory)."""
+
+    def metadata(self) -> ConnectorMetadata:
+        raise NotImplementedError
+
+    def get_splits(
+        self, handle: TableHandle, target_split_rows: int = 1 << 20
+    ) -> SplitSource:
+        raise NotImplementedError
+
+    def create_page_source(
+        self, split: ConnectorSplit, columns: Sequence[str]
+    ) -> Dict[str, np.ndarray]:
+        """Produce host columnar data for a split, pruned to ``columns``.
+
+        Returns {column -> numpy array}; None entries in object arrays
+        mark SQL NULLs. (Reference: ConnectorPageSource.getNextPage.)"""
+        raise NotImplementedError
+
+    # -- write path (optional; reference: ConnectorPageSink) --------------
+
+    def supports_writes(self) -> bool:
+        return False
+
+    def create_table(self, handle: TableHandle, schema: Dict[str, T.DataType]):
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def append_rows(self, handle: TableHandle, data: Dict[str, np.ndarray]):
+        raise NotImplementedError(f"{type(self).__name__} is read-only")

@@ -1,0 +1,10 @@
+"""Execution layer: staging, local runner, fragment execution.
+
+Reference parity: the worker task runtime (SqlTaskManager /
+LocalExecutionPlanner / Driver — SURVEY.md §2.1 "Task runtime") collapsed
+TPU-first: a plan fragment compiles to one jitted program per capacity
+bucket; the host side only stages pages and sequences fragments
+(SURVEY.md §7 "Design stance").
+"""
+
+from presto_tpu.exec.staging import bucket_capacity, stage_page  # noqa: F401

@@ -1,0 +1,99 @@
+"""Host -> device page staging.
+
+Reference parity: the page-source -> Page boundary (ConnectorPageSource
+feeding the operator pipeline, SURVEY.md §3.3) plus the native worker's
+page staging (SURVEY.md §2.3 "presto_cpp ... page staging").
+
+SPI column payloads (see connectors.spi.Connector.create_page_source):
+- numeric numpy array in *native repr* (unscaled ints for decimals,
+  epoch-days for dates) -> zero-copy device put
+- object numpy array of Python values (None = NULL) -> logical ingest
+- DictColumn (pre-encoded ids + sorted dictionary) -> direct
+
+Capacity bucketing: capacities are rounded up to power-of-two buckets so
+every split of similar size reuses the same compiled fragment
+(SURVEY.md §7 "Hard parts: dynamic shapes" — bucketed padding).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.tpch import DictColumn
+from presto_tpu.page import Block, Dictionary, Page
+
+MIN_BUCKET = 1 << 10
+
+
+def bucket_capacity(n: int) -> int:
+    """Round up to the next power-of-two bucket (min 1024)."""
+    cap = MIN_BUCKET
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def stage_page(
+    data: Dict[str, object],
+    schema: Dict[str, T.DataType],
+    capacity: Optional[int] = None,
+) -> Page:
+    """Build a device Page from SPI column payloads."""
+    names = tuple(schema.keys())
+    n = 0
+    for v in data.values():
+        n = len(v.ids) if isinstance(v, DictColumn) else len(v)
+        break
+    cap = capacity if capacity is not None else bucket_capacity(n)
+    blocks = []
+    for name in names:
+        t = schema[name]
+        v = data[name]
+        if isinstance(v, DictColumn):
+            ids = np.asarray(v.ids, dtype=np.int32)
+            pad = np.zeros(cap - len(ids), dtype=np.int32)
+            blocks.append(
+                Block(
+                    data=jnp.asarray(np.concatenate([ids, pad])),
+                    valid=None,
+                    dtype=t,
+                    dictionary=Dictionary(v.values),
+                )
+            )
+        elif isinstance(v, np.ndarray) and v.dtype != object:
+            arr = v.astype(t.np_dtype, copy=False)
+            padded = np.zeros(cap, dtype=t.np_dtype)
+            padded[: len(arr)] = arr
+            blocks.append(
+                Block(data=jnp.asarray(padded), valid=None, dtype=t)
+            )
+        else:
+            vals = list(v) + [None] * (cap - len(v))
+            blocks.append(Block.from_pylist(vals, t))
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.asarray(n, jnp.int32),
+        names=names,
+    )
+
+
+class CatalogManager:
+    """Mounted catalogs (reference: catalog config tier, SURVEY.md §5.6)."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, object] = {}
+
+    def register(self, name: str, connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str):
+        if name not in self._catalogs:
+            raise KeyError(f"catalog not found: {name}")
+        return self._catalogs[name]
+
+    def names(self):
+        return sorted(self._catalogs)

@@ -228,71 +228,15 @@ def _segment_agg(
 def _global_aggregate(
     page: Page, aggs: Sequence[AggCall], live: jnp.ndarray
 ) -> Tuple[Page, jnp.ndarray]:
-    """No GROUP BY: one output row (even over zero input rows, per SQL)."""
+    """No GROUP BY: the max_groups=1 degenerate case of the segmented
+    path — all live rows route to segment 0. One output row always (SQL:
+    global aggregates over zero rows emit one row; sum -> NULL via the
+    empty-group validity rule, count -> 0)."""
+    gid = jnp.where(live, 0, 1)
+    order = jnp.arange(page.capacity, dtype=jnp.int32)  # identity
     names, blocks = [], []
     for agg in aggs:
-        if agg.func == "count_star":
-            data = jnp.sum(live.astype(jnp.int64))[None]
-            blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
-            names.append(agg.out_name)
-            continue
-        d, v = eval_expr(agg.arg, page)
-        d = jnp.broadcast_to(d, (page.capacity,))
-        valid = live if v is None else (live & jnp.broadcast_to(v, (page.capacity,)))
-        cnt = jnp.sum(valid.astype(jnp.int64))
-        has = (cnt > 0)[None]
-        if agg.func == "count":
-            blocks.append(Block(data=cnt[None], valid=None, dtype=T.BIGINT))
-        elif agg.func in ("sum", "avg"):
-            at = agg.arg.dtype
-            if at.name in ("double", "real") or agg.func == "avg":
-                x = d.astype(jnp.float64)
-                if at.is_decimal:
-                    x = x / (10 ** at.scale)
-                s = jnp.sum(jnp.where(valid, x, 0.0))
-                if agg.func == "avg":
-                    blocks.append(
-                        Block(
-                            data=(s / jnp.maximum(cnt, 1))[None],
-                            valid=has,
-                            dtype=T.DOUBLE,
-                        )
-                    )
-                else:
-                    blocks.append(
-                        Block(data=s[None], valid=has, dtype=T.DOUBLE)
-                    )
-            else:
-                s = jnp.sum(jnp.where(valid, d.astype(jnp.int64), 0))
-                blocks.append(
-                    Block(data=s[None], valid=has, dtype=agg.result_type())
-                )
-        elif agg.func in ("min", "max"):
-            at = agg.arg.dtype
-            if at.name in ("double", "real"):
-                fill = jnp.inf if agg.func == "min" else -jnp.inf
-                x = jnp.where(valid, d.astype(jnp.float64), fill)
-                s = (jnp.min(x) if agg.func == "min" else jnp.max(x)).astype(
-                    at.jnp_dtype
-                )
-            else:
-                info = jnp.iinfo(jnp.int64)
-                fill = info.max if agg.func == "min" else info.min
-                x = jnp.where(valid, d.astype(jnp.int64), fill)
-                s = (jnp.min(x) if agg.func == "min" else jnp.max(x)).astype(
-                    at.jnp_dtype
-                )
-            dictionary = None
-            if at.is_string:
-                from presto_tpu.expr import ColumnRef
-
-                if isinstance(agg.arg, ColumnRef):
-                    dictionary = page.block(agg.arg.name).dictionary
-            blocks.append(
-                Block(data=s[None], valid=has, dtype=at, dictionary=dictionary)
-            )
-        else:
-            raise NotImplementedError(agg.func)
+        blocks.append(_segment_agg(agg, page, order, live, gid, max_groups=1))
         names.append(agg.out_name)
     out = Page(
         blocks=tuple(blocks),

@@ -25,7 +25,9 @@ def orderable_i64(data: jnp.ndarray, dtype: T.DataType) -> jnp.ndarray:
       non-NaN; NaN sorts last as in the reference's ORDER BY)
     """
     if dtype.name in ("double", "real"):
-        bits = jnp.asarray(data, jnp.float64).view(jnp.int64)
+        f = jnp.asarray(data, jnp.float64)
+        f = jnp.where(f == 0, 0.0, f)  # -0.0 and +0.0 are SQL-equal
+        bits = f.view(jnp.int64)
         # IEEE754 total order as signed int64: positives keep their bit
         # pattern in [0, 2^63); negatives map to ~bits with the sign bit
         # set, landing in [-2^63, 0) in reversed-magnitude order.
@@ -55,7 +57,7 @@ def sort_order(
     ):
         k = orderable_i64(data, dtype)
         if desc:
-            k = -k
+            k = ~k  # bitwise-not reverses order without INT64_MIN overflow
         null_rank = (
             jnp.zeros(k.shape, jnp.int64)
             if valid is None
@@ -77,7 +79,11 @@ def boundaries(
     change = first
     for data, valid in sorted_keys:
         d = jnp.asarray(data)
-        diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), d[1:] != d[:-1]])
+        neq = d[1:] != d[:-1]
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            # NaN != NaN, but SQL grouping puts all NaNs in one group
+            neq = neq & ~(jnp.isnan(d[1:]) & jnp.isnan(d[:-1]))
+        diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
         if valid is not None:
             v = jnp.asarray(valid)
             vdiff = jnp.concatenate(

@@ -116,6 +116,17 @@ def hash_join(
     build_payload = list(build_payload or [])
     payload_rename = payload_rename or {}
 
+    for pc, bc in zip(probe_keys, build_keys):
+        pb, bb = probe.block(pc), build.block(bc)
+        if pb.dtype.is_string or bb.dtype.is_string:
+            # ids are only comparable within ONE dictionary; the planner
+            # re-encodes one side before a string-keyed join
+            if pb.dictionary != bb.dictionary:
+                raise NotImplementedError(
+                    f"string join key {pc}={bc} across different "
+                    "dictionaries: planner must re-encode first"
+                )
+
     pk, p_ok = _key_of(probe, probe_keys)
     bk, b_ok = _key_of(build, build_keys)
 

@@ -202,8 +202,19 @@ def _window_agg(
     else:
         x = jnp.where(valid, d.astype(jnp.int64), 0)
 
+    run_cnt = None
+    if running:
+        # running non-null count up to the frame end (shared by every
+        # running aggregate's validity and by avg's divisor)
+        cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
+        cnt_before = jnp.where(
+            part_start[safe_pid] > 0,
+            cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
+            jnp.zeros((), jnp.int64),
+        )
+        run_cnt = (cnt_cs - cnt_before)[peer_end[safe_peer]]
+
     if call.func in ("min", "max"):
-        # running min/max: cumulative within partition
         if at.name in ("double", "real"):
             fill = jnp.inf if call.func == "min" else -jnp.inf
             xv = jnp.where(valid, d.astype(jnp.float64), fill)
@@ -213,26 +224,17 @@ def _window_agg(
             xv = jnp.where(valid, d.astype(jnp.int64), fill)
         if running:
             op = jnp.minimum if call.func == "min" else jnp.maximum
-            # segmented cumulative min/max: scan reset at partition starts
-            def step(carry, inp):
-                val, cur_pid = carry
-                xi, pi = inp
-                val = jnp.where(pi != cur_pid, xi, op(val, xi))
-                return (val, pi), val
 
-            (_, _), out = jax.lax.scan(
-                step, (xv[0], pid[0] - 1), (xv, pid)
-            )
+            # segmented cumulative min/max in O(log n) parallel depth
+            def combine(a, b):
+                ap, av = a
+                bp, bv = b
+                return bp, jnp.where(ap == bp, op(av, bv), bv)
+
+            _, out = jax.lax.associative_scan(combine, (pid, xv))
             # RANGE frame: peers share the value at the last peer row
             data = out[peer_end[safe_peer]]
-            # validity from the RUNNING non-null count up to the frame end
-            cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
-            cnt_before = jnp.where(
-                part_start[safe_pid] > 0,
-                cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
-                jnp.zeros((), jnp.int64),
-            )
-            has = (cnt_cs - cnt_before)[peer_end[safe_peer]] > 0
+            has = run_cnt > 0
         else:
             seg = (
                 jax.ops.segment_min if call.func == "min" else jax.ops.segment_max
@@ -265,33 +267,18 @@ def _window_agg(
         within = cs - before_part
         # RANGE frame: peers share the value at the last peer row
         data = within[peer_end[safe_peer]]
-        if call.func in ("count",):
+        if call.func == "count":
             return Block(data=data.astype(jnp.int64), valid=None, dtype=T.BIGINT)
         if call.func == "avg":
-            cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
-            cnt_before = jnp.where(
-                part_start[safe_pid] > 0,
-                cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
-                jnp.zeros((), jnp.int64),
-            )
-            cnt_within = (cnt_cs - cnt_before)[peer_end[safe_peer]]
-            has = cnt_within > 0
             return Block(
-                data=data / jnp.maximum(cnt_within, 1),
-                valid=has,
+                data=data / jnp.maximum(run_cnt, 1),
+                valid=run_cnt > 0,
                 dtype=T.DOUBLE,
             )
         # sum
-        cnt_cs = jnp.cumsum(valid.astype(jnp.int64))
-        cnt_before = jnp.where(
-            part_start[safe_pid] > 0,
-            cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
-            jnp.zeros((), jnp.int64),
-        )
-        has = (cnt_cs - cnt_before)[peer_end[safe_peer]] > 0
         if is_float:
-            return Block(data=data, valid=has, dtype=T.DOUBLE)
-        return Block(data=data.astype(jnp.int64), valid=has, dtype=rt)
+            return Block(data=data, valid=run_cnt > 0, dtype=T.DOUBLE)
+        return Block(data=data.astype(jnp.int64), valid=run_cnt > 0, dtype=rt)
 
     # whole-partition aggregate
     seg = jax.ops.segment_sum(x, pid, num_segments=nseg)

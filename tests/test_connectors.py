@@ -1,0 +1,133 @@
+"""Connector SPI + TPC-H generator tests (SURVEY.md §4.4: deterministic
+fixtures are the test data)."""
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors import create_connector
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.connectors.tpch import (
+    DictColumn,
+    TABLE_SCHEMAS,
+    _counts,
+    _lineitem_count,
+    _lineitem_order,
+    _orderkey,
+)
+from presto_tpu.exec import bucket_capacity, stage_page
+
+
+def test_counts_closed_form():
+    c = _counts(0.01)
+    assert c["lineitem"] == _lineitem_count(c["orders"])
+    # closed form vs brute force
+    for n in [1, 6, 7, 8, 20, 100]:
+        brute = sum((k % 7) + 1 for k in range(n))
+        assert _lineitem_count(n) == brute
+
+
+def test_lineitem_order_mapping_bijective():
+    n_orders = 50
+    total = _lineitem_count(n_orders)
+    rows = np.arange(total)
+    order_idx, linenumber = _lineitem_order(rows)
+    # each order k has (k%7)+1 lines numbered 1..count
+    for k in range(n_orders):
+        mask = order_idx == k
+        expect = (k % 7) + 1
+        assert mask.sum() == expect
+        assert sorted(linenumber[mask]) == list(range(1, expect + 1))
+
+
+def test_tpch_split_determinism_and_fk_validity():
+    conn = create_connector("tpch")
+    h = TableHandle("tpch", "tiny", "lineitem")
+    counts = _counts(0.01)
+    src = conn.get_splits(h, target_split_rows=10_000)
+    s1 = src.next_batch(100)
+    assert not src.exhausted or len(s1) > 0
+    cols = ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity", "l_shipdate", "l_returnflag"]
+    a = conn.create_page_source(s1[0], cols)
+    b = conn.create_page_source(s1[0], cols)  # regenerate: identical
+    assert np.array_equal(a["l_orderkey"], b["l_orderkey"])
+    assert np.array_equal(a["l_returnflag"].ids, b["l_returnflag"].ids)
+    assert (a["l_partkey"] >= 1).all() and (a["l_partkey"] <= counts["part"]).all()
+    assert (a["l_suppkey"] >= 1).all() and (a["l_suppkey"] <= counts["supplier"]).all()
+    assert (a["l_quantity"] >= 100).all() and (a["l_quantity"] <= 5000).all()
+
+
+def test_tpch_orderkeys_sparse_unique():
+    ok = _orderkey(np.arange(100))
+    assert len(np.unique(ok)) == 100
+    assert ok.max() > 100  # sparse
+
+
+def test_tpch_orders_dates_in_range():
+    from presto_tpu.connectors.tpch import ENDDATE, STARTDATE
+
+    conn = create_connector("tpch")
+    h = TableHandle("tpch", "tiny", "orders")
+    split = conn.get_splits(h).next_batch(1)[0]
+    d = conn.create_page_source(split, ["o_orderdate"])["o_orderdate"]
+    assert (d >= STARTDATE).all() and (d <= ENDDATE - 151).all()
+
+
+def test_tpch_q13_q16_patterns_reachable():
+    conn = create_connector("tpch")
+    h = TableHandle("tpch", "tiny", "orders")
+    split = conn.get_splits(h).next_batch(1)[0]
+    c = conn.create_page_source(split, ["o_comment"])["o_comment"]
+    assert isinstance(c, DictColumn)
+    phrases = c.values[np.unique(c.ids)]
+    assert any("special" in p and "requests" in p for p in phrases)
+
+
+def test_stage_page_roundtrip():
+    conn = create_connector("tpch")
+    h = TableHandle("tpch", "tiny", "nation")
+    split = conn.get_splits(h).next_batch(1)[0]
+    schema = conn.metadata().get_table_schema(h)
+    data = conn.create_page_source(split, list(schema))
+    page = stage_page(data, schema)
+    assert page.capacity == bucket_capacity(25)
+    rows = page.to_pylist()
+    assert len(rows) == 25
+    assert rows[0]["n_nationkey"] == 0 and rows[0]["n_name"] == "ALGERIA"
+    assert rows[24]["n_name"] == "UNITED STATES" and rows[24]["n_regionkey"] == 1
+
+
+def test_memory_connector_write_read():
+    conn = create_connector("memory")
+    h = TableHandle("mem", "default", "t")
+    schema = {"a": T.BIGINT, "b": T.VARCHAR}
+    conn.create_table(h, schema)
+    conn.append_rows(h, {"a": np.asarray([1, 2]), "b": np.asarray(["x", "y"], dtype=object)})
+    conn.append_rows(h, {"a": np.asarray([3]), "b": np.asarray([None], dtype=object)})
+    split = conn.get_splits(h).next_batch(10)[0]
+    data = conn.create_page_source(split, ["a", "b"])
+    page = stage_page(data, schema)
+    rows = page.to_pylist()
+    assert [r["a"] for r in rows] == [1, 2, 3]
+    assert [r["b"] for r in rows] == ["x", "y", None]
+
+
+def test_blackhole_connector():
+    conn = create_connector("blackhole", rows_per_table=100)
+    h = TableHandle("bh", "default", "t")
+    conn.create_table(h, {"x": T.BIGINT, "s": T.VARCHAR})
+    splits = conn.get_splits(h).next_batch(10)
+    data = conn.create_page_source(splits[0], ["x", "s"])
+    assert len(data["x"]) == 100
+    page = stage_page(data, {"x": T.BIGINT, "s": T.VARCHAR})
+    assert int(page.num_valid) == 100
+
+
+def test_all_tables_generate_all_columns():
+    conn = create_connector("tpch")
+    for table, schema in TABLE_SCHEMAS.items():
+        h = TableHandle("tpch", "tiny", table)
+        split = conn.get_splits(h, target_split_rows=1000).next_batch(1)[0]
+        data = conn.create_page_source(split, list(schema))
+        assert set(data) == set(schema), table
+        page = stage_page(data, schema)
+        assert int(page.num_valid) == split.num_rows
