@@ -46,6 +46,8 @@ class ColumnStats:
 class TableStats:
     row_count: Optional[float] = None
     columns: Dict[str, ColumnStats] = dataclasses.field(default_factory=dict)
+    # declared key (possibly composite) with at most one row per value
+    primary_key: Optional[tuple] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -614,15 +614,57 @@ class _TpchMetadata(ConnectorMetadata):
             raise KeyError(f"unknown tpch table: {handle.table}")
         return dict(TABLE_SCHEMAS[handle.table])
 
+    PRIMARY_KEYS = {
+        "region": ("r_regionkey",),
+        "nation": ("n_nationkey",),
+        "supplier": ("s_suppkey",),
+        "customer": ("c_custkey",),
+        "part": ("p_partkey",),
+        "partsupp": ("ps_partkey", "ps_suppkey"),
+        "orders": ("o_orderkey",),
+        "lineitem": ("l_orderkey", "l_linenumber"),
+    }
+
+    # foreign keys: column -> referenced table (distinct count source)
+    FOREIGN_KEYS = {
+        "n_regionkey": "region",
+        "s_nationkey": "nation",
+        "c_nationkey": "nation",
+        "ps_partkey": "part",
+        "ps_suppkey": "supplier",
+        "o_custkey": "customer",
+        "l_orderkey": "orders",
+        "l_partkey": "part",
+        "l_suppkey": "supplier",
+    }
+
     def get_table_stats(self, handle: TableHandle):
         sf = SCHEMAS[handle.schema]
         counts = _counts(sf)
         n = counts[handle.table]
+        pk = self.PRIMARY_KEYS[handle.table]
+
+        def key_max(table: str) -> int:
+            # orderkeys are sparse (8 of every 32): domain max != rowcount
+            if table == "orders":
+                return int(_orderkey(np.asarray([counts["orders"] - 1]))[0])
+            return counts[table]
+
         cols: Dict[str, ColumnStats] = {}
-        for name, t in TABLE_SCHEMAS[handle.table].items():
-            if name.endswith("key"):
-                cols[name] = ColumnStats(distinct_count=n, min_value=1, max_value=n)
-        return TableStats(row_count=float(n), columns=cols)
+        for name in TABLE_SCHEMAS[handle.table]:
+            if len(pk) == 1 and name == pk[0]:
+                cols[name] = ColumnStats(
+                    distinct_count=n, min_value=1, max_value=key_max(handle.table)
+                )
+            elif name in self.FOREIGN_KEYS:
+                ref_table = self.FOREIGN_KEYS[name]
+                ref = counts[ref_table]
+                cols[name] = ColumnStats(
+                    distinct_count=min(ref, n),
+                    min_value=1,
+                    max_value=key_max(ref_table),
+                )
+        return TableStats(row_count=float(n), columns=cols, primary_key=pk)
 
 
 class TpchConnector(Connector):

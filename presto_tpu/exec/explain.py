@@ -1,0 +1,76 @@
+"""EXPLAIN / EXPLAIN ANALYZE rendering.
+
+Reference parity: presto's EXPLAIN plan rendering and EXPLAIN ANALYZE
+stats-in-plan output (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import time
+
+from presto_tpu.plan import nodes as N
+from presto_tpu.plan.optimizer import prune_columns
+from presto_tpu.plan.planner import plan_statement
+from presto_tpu.sql import ast
+
+
+def _describe(node: N.PlanNode) -> str:
+    if isinstance(node, N.TableScanNode):
+        return (
+            f"TableScan[{node.handle.catalog}.{node.handle.schema}."
+            f"{node.handle.table} columns={list(node.columns)}]"
+        )
+    if isinstance(node, N.FilterNode):
+        return f"Filter[{node.predicate}]"
+    if isinstance(node, N.ProjectNode):
+        return f"Project[{[n for n, _ in node.projections]}]"
+    if isinstance(node, N.AggregationNode):
+        return (
+            f"Aggregate[keys={[n for n, _ in node.group_keys]} "
+            f"aggs={[f'{a.func}->{a.out_name}' for a in node.aggs]} "
+            f"max_groups={node.max_groups}]"
+        )
+    if isinstance(node, N.JoinNode):
+        return (
+            f"{node.join_type.capitalize()}Join[{node.left_keys} = "
+            f"{node.right_keys} unique={node.build_unique} "
+            f"cap={node.out_capacity}]"
+        )
+    if isinstance(node, N.CrossJoinNode):
+        return "CrossJoin[broadcast single row]"
+    if isinstance(node, N.SortNode):
+        return f"Sort[{len(node.keys)} keys limit={node.limit}]"
+    if isinstance(node, N.LimitNode):
+        return f"Limit[{node.count}]"
+    if isinstance(node, N.DistinctNode):
+        return f"Distinct[max_groups={node.max_groups}]"
+    if isinstance(node, N.WindowNode):
+        return f"Window[{[c.func for c in node.calls]}]"
+    if isinstance(node, N.OutputNode):
+        return f"Output[{[o for o, _ in node.columns]}]"
+    if isinstance(node, N.ValuesNode):
+        return "Values[1 row]"
+    return type(node).__name__
+
+
+def render_plan(node: N.PlanNode, indent: int = 0) -> str:
+    lines = ["    " * indent + "- " + _describe(node)]
+    for c in node.children():
+        lines.append(render_plan(c, indent + 1))
+    return "\n".join(lines)
+
+
+def explain_text(runner, stmt: ast.Explain) -> str:
+    plan = plan_statement(stmt.statement, runner.catalogs, runner.session)
+    root = prune_columns(plan.root)
+    text = render_plan(root)
+    if stmt.analyze:
+        t0 = time.perf_counter()
+        result = runner.execute_plan(plan)
+        elapsed = time.perf_counter() - t0
+        n = len(result.rows())
+        text += (
+            f"\n\nEXPLAIN ANALYZE: {n} rows in {elapsed * 1000:.1f} ms "
+            f"(wall, includes staging + compile on first run)"
+        )
+    return text

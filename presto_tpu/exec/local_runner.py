@@ -1,0 +1,475 @@
+"""Single-process query runner: SQL string -> rows.
+
+Reference parity: ``LocalQueryRunner`` (presto-main testing) — full
+parse -> plan -> execute in one process, no HTTP, no scheduler
+(SURVEY.md §4.2). It is both the correctness-test harness and the
+single-chip execution engine.
+
+TPU-first execution model (SURVEY.md §7 "Design stance"): the WHOLE
+optimized plan compiles to ONE ``jax.jit`` program over the staged scan
+pages — operators are trace-time kernel compositions, XLA fuses across
+them, and there is no per-operator host round trip. Data-dependent
+capacity overruns (group counts, join fan-out) surface as overflow flags
+returned from the program; the host reacts by scaling the static
+capacity buckets and re-running (the dynamic-shape protocol of SURVEY.md
+§7 "Hard parts").
+
+Scalar subqueries execute first (recursively), and their results are
+substituted as literals before the main plan compiles — a Param is a
+plan-time placeholder, never a runtime value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu import expr as E
+from presto_tpu.connectors import create_connector
+from presto_tpu.exec.staging import CatalogManager, bucket_capacity, stage_page
+from presto_tpu.ops import (
+    filter_project,
+    hash_aggregate,
+    hash_join,
+    limit as limit_op,
+    order_by as order_by_op,
+    project,
+    window as window_op,
+)
+from presto_tpu.page import Block, Page
+from presto_tpu.plan import nodes as N
+from presto_tpu.plan.optimizer import prune_columns
+from presto_tpu.plan.planner import Plan, plan_statement
+from presto_tpu.session import Session
+from presto_tpu.sql import parse_statement
+from presto_tpu.sql import ast
+
+
+class ExecutionError(RuntimeError):
+    pass
+
+
+class QueryResult:
+    def __init__(self, columns: Tuple[str, ...], page: Page):
+        self.columns = columns
+        self.page = page
+
+    def rows(self) -> List[tuple]:
+        return [
+            tuple(r[c] for c in self.columns) for r in self.page.to_pylist()
+        ]
+
+    def row_dicts(self) -> List[dict]:
+        return self.page.to_pylist()
+
+
+class LocalQueryRunner:
+    """Parse -> analyze/plan -> optimize -> one-jit-program execution."""
+
+    MAX_RETRIES = 4
+
+    def __init__(
+        self,
+        catalogs: Optional[CatalogManager] = None,
+        session: Optional[Session] = None,
+    ):
+        if catalogs is None:
+            catalogs = CatalogManager()
+            catalogs.register("tpch", create_connector("tpch"))
+        self.catalogs = catalogs
+        self.session = session or Session()
+        self._compiled: Dict[object, object] = {}
+        self._table_cache: Dict[Tuple, Page] = {}
+
+    # ------------------------------------------------------------- public
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.SetSession):
+            self.session.set(stmt.name, stmt.value)
+            return QueryResult(("result",), _message_page("SET SESSION"))
+        if isinstance(stmt, ast.Explain):
+            from presto_tpu.exec.explain import explain_text
+
+            text = explain_text(self, stmt)
+            return QueryResult(("Query Plan",), _lines_page(text))
+        if isinstance(stmt, ast.ShowSession):
+            from presto_tpu.session import SYSTEM_SESSION_PROPERTIES
+
+            lines = [
+                f"{k}={self.session.get(k)}"
+                for k in sorted(SYSTEM_SESSION_PROPERTIES)
+            ]
+            return QueryResult(
+                ("Session",), _lines_page("\n".join(lines), "Session")
+            )
+        if isinstance(stmt, ast.ShowSchemas):
+            conn = self.catalogs.get(stmt.catalog or self.session.catalog)
+            return QueryResult(
+                ("Schema",),
+                _lines_page(
+                    "\n".join(conn.metadata().list_schemas()), "Schema"
+                ),
+            )
+        if isinstance(stmt, ast.ShowTables):
+            conn = self.catalogs.get(self.session.catalog)
+            return QueryResult(
+                ("Table",),
+                _lines_page(
+                    "\n".join(
+                        conn.metadata().list_tables(
+                            stmt.schema or self.session.schema
+                        )
+                    ),
+                    "Table",
+                ),
+            )
+        plan = plan_statement(stmt, self.catalogs, self.session)
+        return self.execute_plan(plan)
+
+    def execute_plan(self, plan: Plan) -> QueryResult:
+        root = self._bind_params(plan)
+        root = prune_columns(root)
+        page = self._run(root)
+        return QueryResult(plan.output_names, page)
+
+    # ------------------------------------------------- params (subqueries)
+
+    def _bind_params(self, plan: Plan) -> N.PlanNode:
+        bindings: Dict[int, E.Literal] = {}
+        for pid, sub in plan.params:
+            sub_root = self._bind_params(sub)
+            sub_root = prune_columns(sub_root)
+            page = self._run(sub_root)
+            col = sub.output_names[0]
+            bindings[pid] = _scalar_literal(page, col)
+        if not bindings:
+            return plan.root
+        return _substitute_params_node(plan.root, bindings)
+
+    # ---------------------------------------------------------- execution
+
+    def _run(self, root: N.PlanNode) -> Page:
+        scans = [
+            n for n in N.walk(root) if isinstance(n, N.TableScanNode)
+        ]
+        pages = [self._load_table(s) for s in scans]
+        scan_ids = {id(s): i for i, s in enumerate(scans)}
+
+        tries = 0
+        while True:
+            entry = self._compiled.get(root)
+            if entry is None:
+                msgs_cell: List[str] = []
+
+                def trace(pages_in, _root=root, _ids=scan_ids, _m=msgs_cell):
+                    flags: List = []
+                    errors: List = []
+                    out = _execute_node(_root, pages_in, _ids, flags, errors)
+                    _m.clear()
+                    _m.extend(m for m, _ in errors)
+                    return out, flags, [e for _, e in errors]
+
+                entry = (jax.jit(trace), msgs_cell)
+                self._compiled[root] = entry
+            fn, msgs_cell = entry
+            page, flags, error_flags = fn(pages)
+            for msg, flag in zip(msgs_cell, error_flags):
+                if bool(flag):
+                    raise ExecutionError(msg)
+            if not any(bool(f) for f in flags):
+                return page
+            tries += 1
+            if tries >= self.MAX_RETRIES:
+                raise ExecutionError(
+                    "capacity overflow persisted after retries "
+                    "(join fan-out or group count beyond buckets)"
+                )
+            root = _scale_capacities(root, 4)
+
+    def _load_table(self, scan: N.TableScanNode) -> Page:
+        key = (scan.handle, scan.columns)
+        if key in self._table_cache:
+            return self._table_cache[key]
+        conn = self.catalogs.get(scan.handle.catalog)
+        src = conn.get_splits(scan.handle, target_split_rows=1 << 22)
+        datas = []
+        while not src.exhausted:
+            for split in src.next_batch(64):
+                datas.append(
+                    conn.create_page_source(split, list(scan.columns))
+                )
+        merged = _merge_split_payloads(datas, list(scan.columns))
+        page = stage_page(merged, dict(scan.schema))
+        self._table_cache[key] = page
+        return page
+
+
+# ---------------------------------------------------------- trace helpers
+
+
+def _execute_node(node, pages, scan_ids, flags, errors) -> Page:
+    run = lambda n: _execute_node(  # noqa: E731
+        n, pages, scan_ids, flags, errors
+    )
+
+    if isinstance(node, N.TableScanNode):
+        return pages[scan_ids[id(node)]]
+    if isinstance(node, N.ValuesNode):
+        return Page(
+            blocks=(
+                Block(
+                    data=jnp.zeros((8,), jnp.int64), valid=None, dtype=T.BIGINT
+                ),
+            ),
+            num_valid=jnp.asarray(1, jnp.int32),
+            names=("$dummy",),
+        )
+    if isinstance(node, N.FilterNode):
+        src = run(node.source)
+        schema = node.source.output_schema()
+        projs = [(n, E.ColumnRef(n, t)) for n, t in schema.items()]
+        return filter_project(src, node.predicate, projs)
+    if isinstance(node, N.ProjectNode):
+        return project(run(node.source), node.projections)
+    if isinstance(node, N.AggregationNode):
+        out, overflow = hash_aggregate(
+            run(node.source),
+            node.group_keys,
+            node.aggs,
+            node.max_groups,
+        )
+        flags.append(overflow)
+        return out
+    if isinstance(node, N.DistinctNode):
+        from presto_tpu.ops import distinct as distinct_op
+
+        out, overflow = distinct_op(run(node.source), node.max_groups)
+        flags.append(overflow)
+        return out
+    if isinstance(node, N.JoinNode):
+        probe = run(node.left)
+        build = run(node.right)
+        out, overflow = hash_join(
+            probe,
+            build,
+            node.left_keys,
+            node.right_keys,
+            join_type=node.join_type,
+            build_payload=node.payload,
+            build_unique=node.build_unique,
+            out_capacity=node.out_capacity,
+            payload_rename=dict(node.payload_rename),
+        )
+        flags.append(overflow)
+        if node.residual is not None:
+            schema = out.schema()
+            projs = [(n, E.ColumnRef(n, t)) for n, t in schema.items()]
+            out = filter_project(out, node.residual, projs)
+        return out
+    if isinstance(node, N.CrossJoinNode):
+        left = run(node.left)
+        right = run(node.right)
+        # single-row broadcast (scalar-aggregate shape); >1 row is a hard
+        # error, not a capacity overflow — retries cannot fix it
+        errors.append(("cross join build produced more than one row",
+                       right.num_valid > 1))
+        blocks = list(left.blocks)
+        names = list(left.names)
+        for bname, blk in zip(right.names, right.blocks):
+            v = blk.valid[0] if blk.valid is not None else None
+            data = jnp.broadcast_to(blk.data[0], (left.capacity,))
+            valid = (
+                None
+                if v is None
+                else jnp.broadcast_to(v, (left.capacity,))
+            )
+            blocks.append(dataclasses.replace(blk, data=data, valid=valid))
+            names.append(bname)
+        num = jnp.where(right.num_valid > 0, left.num_valid, 0).astype(
+            jnp.int32
+        )
+        return Page(blocks=tuple(blocks), num_valid=num, names=tuple(names))
+    if isinstance(node, N.SortNode):
+        return order_by_op(run(node.source), node.keys, limit=node.limit)
+    if isinstance(node, N.LimitNode):
+        return limit_op(run(node.source), node.count)
+    if isinstance(node, N.WindowNode):
+        return window_op(
+            run(node.source), node.partition_by, node.order_by, node.calls
+        )
+    if isinstance(node, N.OutputNode):
+        src = run(node.source)
+        blocks = []
+        for out, col in node.columns:
+            blocks.append(src.block(col))
+        return Page(
+            blocks=tuple(blocks),
+            num_valid=src.num_valid,
+            names=tuple(o for o, _ in node.columns),
+        )
+    raise ExecutionError(f"cannot execute {type(node).__name__}")
+
+
+# ----------------------------------------------------------- param binding
+
+
+def _substitute_params_expr(e: E.Expr, bindings) -> E.Expr:
+    if isinstance(e, E.Param):
+        lit = bindings.get(e.param_id)
+        if lit is None:
+            raise ExecutionError(f"unbound param {e.param_id}")
+        return lit
+    if not dataclasses.is_dataclass(e):
+        return e
+    changes = {}
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr):
+            nv = _substitute_params_expr(v, bindings)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple):
+            nt = tuple(
+                _substitute_params_expr(x, bindings)
+                if isinstance(x, E.Expr)
+                else (
+                    tuple(
+                        _substitute_params_expr(y, bindings)
+                        if isinstance(y, E.Expr)
+                        else y
+                        for y in x
+                    )
+                    if isinstance(x, tuple)
+                    else x
+                )
+                for x in v
+            )
+            if nt != v:
+                changes[f.name] = nt
+    return dataclasses.replace(e, **changes) if changes else e
+
+
+def _substitute_params_node(node: N.PlanNode, bindings) -> N.PlanNode:
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            changes[f.name] = _substitute_params_node(v, bindings)
+        elif isinstance(v, E.Expr):
+            changes[f.name] = _substitute_params_expr(v, bindings)
+        elif isinstance(v, tuple) and v and isinstance(v[0], tuple):
+            nt = []
+            for item in v:
+                nt.append(
+                    tuple(
+                        _substitute_params_expr(x, bindings)
+                        if isinstance(x, E.Expr)
+                        else x
+                        for x in item
+                    )
+                )
+            changes[f.name] = tuple(nt)
+        elif isinstance(v, tuple):
+            nt2 = []
+            for item in v:
+                if isinstance(item, E.Expr):
+                    nt2.append(_substitute_params_expr(item, bindings))
+                elif hasattr(item, "arg") and isinstance(
+                    getattr(item, "arg", None), E.Expr
+                ):
+                    nt2.append(
+                        dataclasses.replace(
+                            item,
+                            arg=_substitute_params_expr(item.arg, bindings),
+                        )
+                    )
+                elif hasattr(item, "expr") and isinstance(
+                    getattr(item, "expr", None), E.Expr
+                ):
+                    nt2.append(
+                        dataclasses.replace(
+                            item,
+                            expr=_substitute_params_expr(item.expr, bindings),
+                        )
+                    )
+                else:
+                    nt2.append(item)
+            changes[f.name] = tuple(nt2)
+    return dataclasses.replace(node, **changes) if changes else node
+
+
+def _scale_capacities(node: N.PlanNode, factor: int) -> N.PlanNode:
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            changes[f.name] = _scale_capacities(v, factor)
+    if isinstance(node, (N.AggregationNode, N.DistinctNode)):
+        changes["max_groups"] = node.max_groups * factor
+    if isinstance(node, N.JoinNode) and node.out_capacity is not None:
+        changes["out_capacity"] = node.out_capacity * factor
+    return dataclasses.replace(node, **changes) if changes else node
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _scalar_literal(page: Page, col: str) -> E.Literal:
+    blk = page.block(col)
+    n = int(page.num_valid)
+    if n == 0:
+        return E.Literal(None, blk.dtype)
+    if n > 1:
+        raise ExecutionError("scalar subquery returned more than one row")
+    data, valid = blk.to_numpy(1)
+    if not valid[0]:
+        return E.Literal(None, blk.dtype)
+    v = data[0]
+    if blk.dtype.is_string:
+        return E.Literal(str(blk.dictionary.values[int(v)]), blk.dtype)
+    if blk.dtype.is_decimal or blk.dtype.is_integer or blk.dtype.name in (
+        "date",
+        "timestamp",
+    ):
+        return E.Literal(int(v), blk.dtype)
+    if blk.dtype.name == "boolean":
+        return E.Literal(bool(v), blk.dtype)
+    return E.Literal(float(v), blk.dtype)
+
+
+def _merge_split_payloads(datas: List[Dict], columns: List[str]) -> Dict:
+    from presto_tpu.connectors.tpch import DictColumn
+
+    if len(datas) == 1:
+        return datas[0]
+    out = {}
+    for c in columns:
+        first = datas[0][c]
+        if isinstance(first, DictColumn):
+            # same closed-form dictionary across splits by construction
+            out[c] = DictColumn(
+                ids=np.concatenate([d[c].ids for d in datas]),
+                values=first.values,
+            )
+        else:
+            out[c] = np.concatenate([d[c] for d in datas])
+    return out
+
+
+def _message_page(msg: str) -> Page:
+    return Page.from_pydict(
+        {"result": [msg]}, {"result": T.VARCHAR}, capacity=1
+    )
+
+
+def _lines_page(text: str, column: str = "Query Plan") -> Page:
+    lines = text.split("\n")
+    return Page.from_pydict(
+        {column: lines}, {column: T.VARCHAR}, capacity=len(lines)
+    )

@@ -303,6 +303,59 @@ class Coalesce(Expr):
         return self._dtype
 
 
+@dataclasses.dataclass(frozen=True)
+class Param(Expr):
+    """A scalar placeholder bound before fragment compilation (used for
+    uncorrelated scalar subqueries: the executor runs the subplan, then
+    substitutes the resulting Literal — reference analogue: the planner's
+    ApplyNode for scalar subqueries, resolved at runtime)."""
+
+    param_id: int
+    _dtype: T.DataType
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class DictTransform(Expr):
+    """String-valued function of a dictionary column, evaluated host-side
+    over the dictionary entries (substring, lower, ...). On device it is
+    an int32 LUT gather old-id -> new-id; the result column carries the
+    transformed (re-sorted) dictionary. ``fn`` maps str -> str."""
+
+    arg: Expr  # string-typed
+    fn_key: str
+    fn: object = dataclasses.field(hash=False, compare=False)
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.VARCHAR
+
+
+@dataclasses.dataclass(frozen=True)
+class DictPredicate(Expr):
+    """Boolean predicate over a dictionary column evaluated *host-side*
+    per dictionary entry (e.g. predicates over substring()/lower()): the
+    device just gathers the LUT (SURVEY.md §7 "Strings on TPU").
+    ``fn_key`` keeps the node hashable; ``fn`` maps str -> bool."""
+
+    arg: Expr  # ColumnRef to a varchar column
+    fn_key: str
+    fn: object = dataclasses.field(hash=False, compare=False)
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
 # --- analyzer-facing constructors (type inference for binary ops) ---------
 
 
@@ -425,6 +478,37 @@ class ExprLowerer:
 
     def __init__(self, page: Page):
         self.page = page
+        self._transform_cache = {}
+
+    def dictionary_of(self, expr: Expr):
+        """Host dictionary of a string-typed expression's result."""
+        if isinstance(expr, ColumnRef):
+            return self.page.block(expr.name).dictionary
+        if isinstance(expr, DictTransform):
+            return self._transform(expr)[0]
+        raise NotImplementedError(
+            f"no dictionary for string expression {type(expr).__name__}"
+        )
+
+    def _transform(self, e: DictTransform):
+        """(new_dictionary, old-id -> new-id LUT), cached per node."""
+        src = self.dictionary_of(e.arg)
+        key = (e.fn_key, src)
+        if key not in self._transform_cache:
+            from presto_tpu.page import Dictionary
+
+            transformed = np.asarray(
+                [str(e.fn(v)) for v in src.values], dtype=object
+            )
+            uniq = np.unique(transformed.astype(str)) if len(transformed) else np.array([], dtype=object)
+            new_dict = Dictionary(np.asarray(uniq, dtype=object))
+            lut = (
+                np.searchsorted(uniq, transformed.astype(str)).astype(np.int32)
+                if len(transformed)
+                else np.zeros(0, np.int32)
+            )
+            self._transform_cache[key] = (new_dict, lut)
+        return self._transform_cache[key]
 
     def eval(self, expr: Expr):
         method = getattr(self, "_eval_" + type(expr).__name__.lower(), None)
@@ -475,10 +559,9 @@ class ExprLowerer:
             dec, other = (ld, rd) if lt.is_decimal else (rd, ld)
             ot = rt if lt.is_decimal else lt
             if ot.is_integer:
+                # exact: unscaled decimal * integer keeps the scale
                 return dec.astype(jnp.int64) * other.astype(jnp.int64), valid
-            return (
-                dec.astype(jnp.float64) * other.astype(jnp.float64)
-            ), valid
+            # decimal * double falls through: _numeric_pair descales
         l, r, kind = _numeric_pair(e.left, e.right, ld, rd)
         if e.op == "+":
             return l + r, valid
@@ -519,12 +602,14 @@ class ExprLowerer:
             return l >= r
         raise ValueError(f"unknown comparison {op}")
 
-    def _string_literal_compare(self, op: str, col: ColumnRef, lit: str):
-        """Compare a dictionary column against a string literal by id —
-        folds to an int32 compare (order-preserving dictionary)."""
-        blk = self.page.block(col.name)
-        d = blk.dictionary
-        ids = blk.data
+    def _string_literal_compare(self, op: str, col: Expr, lit):
+        """Compare a dictionary-typed expression against a string literal
+        by id — folds to an int32 compare (order-preserving dictionary)."""
+        ids, valid = self.eval(col)
+        if lit is None:  # NULL literal (e.g. empty scalar subquery)
+            zeros = jnp.zeros(jnp.shape(ids), jnp.bool_)
+            return zeros, zeros
+        d = self.dictionary_of(col)
         if op == "=":
             i = d.id_of(lit)
             res = (ids == i) if i >= 0 else jnp.zeros(ids.shape, jnp.bool_)
@@ -541,30 +626,26 @@ class ExprLowerer:
             res = ids >= d.searchsorted(lit, "left")
         else:
             raise ValueError(op)
-        return res, blk.valid
+        return res, valid
 
     def _eval_compare(self, e: Compare):
         lt, rt = e.left.dtype, e.right.dtype
         if lt.is_string and isinstance(e.right, Literal):
-            assert isinstance(e.left, ColumnRef), "analyzer guarantees ref"
             return self._string_literal_compare(e.op, e.left, e.right.value)
         if rt.is_string and isinstance(e.left, Literal):
             flip = {
                 "<": ">", "<=": ">=", ">": "<", ">=": "<=",
                 "=": "=", "<>": "<>", "!=": "!=",
             }
-            assert isinstance(e.right, ColumnRef)
             return self._string_literal_compare(
                 flip[e.op], e.right, e.left.value
             )
         ld, lv = self.eval(e.left)
         rd, rv = self.eval(e.right)
         if lt.is_string and rt.is_string:
-            # both sides dictionary columns: ids comparable only if same
+            # both sides dictionary-typed: ids comparable only within ONE
             # dictionary (planner re-encodes otherwise)
-            lb = self.page.block(e.left.name) if isinstance(e.left, ColumnRef) else None
-            rb = self.page.block(e.right.name) if isinstance(e.right, ColumnRef) else None
-            if lb is not None and rb is not None and lb.dictionary != rb.dictionary:
+            if self.dictionary_of(e.left) != self.dictionary_of(e.right):
                 raise NotImplementedError(
                     "cross-dictionary string comparison requires re-encode"
                 )
@@ -700,10 +781,10 @@ class ExprLowerer:
 
     def _eval_inlist(self, e: InList):
         if e.arg.dtype.is_string:
-            assert isinstance(e.arg, ColumnRef)
-            blk = self.page.block(e.arg.name)
+            data, valid = self.eval(e.arg)
+            d = self.dictionary_of(e.arg)
             ids = [
-                blk.dictionary.id_of(lit.value)
+                d.id_of(lit.value)
                 for lit in e.values
                 if isinstance(lit, Literal)
             ]
@@ -711,8 +792,8 @@ class ExprLowerer:
             if not ids:
                 res = jnp.zeros((self.page.capacity,), jnp.bool_)
             else:
-                res = jnp.isin(blk.data, jnp.asarray(ids, jnp.int32))
-            return (~res if e.negate else res), blk.valid
+                res = jnp.isin(data, jnp.asarray(ids, jnp.int32))
+            return (~res if e.negate else res), valid
         d, v = self.eval(e.arg)
         vals = jnp.asarray(
             [lit.value for lit in e.values], dtype=e.arg.dtype.jnp_dtype
@@ -720,16 +801,40 @@ class ExprLowerer:
         res = jnp.isin(d, vals)
         return (~res if e.negate else res), v
 
-    def _eval_like(self, e: Like):
-        assert isinstance(e.arg, ColumnRef) and e.arg.dtype.is_string
-        blk = self.page.block(e.arg.name)
-        rx = like_to_regex(e.pattern)
-        lut = blk.dictionary.predicate_lut(lambda s: rx.match(s) is not None)
+    def _dict_lut_eval(self, arg: Expr, fn):
+        data, valid = self.eval(arg)
+        lut = self.dictionary_of(arg).predicate_lut(fn)
         if len(lut) == 0:
             res = jnp.zeros((self.page.capacity,), jnp.bool_)
         else:
-            res = jnp.asarray(lut)[jnp.clip(blk.data, 0, len(lut) - 1)]
-        return (~res if e.negate else res), blk.valid
+            res = jnp.asarray(lut)[jnp.clip(data, 0, len(lut) - 1)]
+        return res, valid
+
+    def _eval_like(self, e: Like):
+        assert e.arg.dtype.is_string
+        rx = like_to_regex(e.pattern)
+        res, valid = self._dict_lut_eval(
+            e.arg, lambda s: rx.match(s) is not None
+        )
+        return (~res if e.negate else res), valid
+
+    def _eval_param(self, e: Param):
+        raise NotImplementedError(
+            f"unbound scalar-subquery parameter ${e.param_id}: the executor "
+            "must substitute Params before fragment compilation"
+        )
+
+    def _eval_dictpredicate(self, e: DictPredicate):
+        assert e.arg.dtype.is_string
+        return self._dict_lut_eval(e.arg, e.fn)
+
+    def _eval_dicttransform(self, e: DictTransform):
+        data, valid = self.eval(e.arg)
+        _, lut = self._transform(e)
+        if len(lut) == 0:
+            return jnp.zeros((self.page.capacity,), jnp.int32), valid
+        mapped = jnp.asarray(lut)[jnp.clip(data, 0, len(lut) - 1)]
+        return mapped, valid
 
     def _eval_extract(self, e: Extract):
         d, v = self.eval(e.arg)

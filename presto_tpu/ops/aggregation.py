@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from presto_tpu import types as T
-from presto_tpu.expr import Expr, eval_expr
+from presto_tpu.expr import Expr, ExprLowerer
 from presto_tpu.ops.common import boundaries, sort_order
 from presto_tpu.page import Block, Page
 
@@ -80,11 +80,12 @@ def hash_aggregate(
     Global aggregation (no keys) is the ``max_groups=1`` degenerate case.
     """
     live = page.row_mask()
+    lowerer = ExprLowerer(page)
 
     if not group_keys:
-        return _global_aggregate(page, aggs, live)
+        return _global_aggregate(page, aggs, live, lowerer)
 
-    keys = [(name, *eval_expr(e, page), e) for name, e in group_keys]
+    keys = [(name, *lowerer.eval(e), e) for name, e in group_keys]
     order = sort_order(
         [(d, v, e.dtype) for _, d, v, e in keys], live
     )
@@ -119,10 +120,7 @@ def hash_aggregate(
         names.append(name)
         dictionary = None
         if e.dtype.is_string:
-            from presto_tpu.expr import ColumnRef
-
-            assert isinstance(e, ColumnRef)
-            dictionary = page.block(e.name).dictionary
+            dictionary = lowerer.dictionary_of(e)
         blocks.append(
             Block(
                 data=d[first_pos],
@@ -133,7 +131,7 @@ def hash_aggregate(
         )
 
     for agg in aggs:
-        blk = _segment_agg(agg, page, order, live_s, gid, max_groups)
+        blk = _segment_agg(agg, page, order, live_s, gid, max_groups, lowerer)
         names.append(agg.out_name)
         blocks.append(blk)
 
@@ -152,6 +150,7 @@ def _segment_agg(
     live_s: jnp.ndarray,
     gid: jnp.ndarray,
     max_groups: int,
+    lowerer: ExprLowerer,
 ) -> Block:
     nseg = max_groups + 1  # +1 absorbs dead rows routed to max_groups
     rt = agg.result_type()
@@ -162,7 +161,7 @@ def _segment_agg(
         )[:max_groups]
         return Block(data=data, valid=None, dtype=T.BIGINT)
 
-    d, v = eval_expr(agg.arg, page)
+    d, v = lowerer.eval(agg.arg)
     d = jnp.broadcast_to(d, (page.capacity,))[order]
     valid_s = live_s if v is None else (
         live_s & jnp.broadcast_to(v, (page.capacity,))[order]
@@ -214,10 +213,7 @@ def _segment_agg(
             data = data.astype(at.jnp_dtype)
         dictionary = None
         if at.is_string:
-            from presto_tpu.expr import ColumnRef
-
-            if isinstance(agg.arg, ColumnRef):
-                dictionary = page.block(agg.arg.name).dictionary
+            dictionary = lowerer.dictionary_of(agg.arg)
         return Block(
             data=data, valid=group_has_value, dtype=at, dictionary=dictionary
         )
@@ -226,7 +222,10 @@ def _segment_agg(
 
 
 def _global_aggregate(
-    page: Page, aggs: Sequence[AggCall], live: jnp.ndarray
+    page: Page,
+    aggs: Sequence[AggCall],
+    live: jnp.ndarray,
+    lowerer: ExprLowerer,
 ) -> Tuple[Page, jnp.ndarray]:
     """No GROUP BY: the max_groups=1 degenerate case of the segmented
     path — all live rows route to segment 0. One output row always (SQL:
@@ -236,7 +235,9 @@ def _global_aggregate(
     order = jnp.arange(page.capacity, dtype=jnp.int32)  # identity
     names, blocks = [], []
     for agg in aggs:
-        blocks.append(_segment_agg(agg, page, order, live, gid, max_groups=1))
+        blocks.append(
+            _segment_agg(agg, page, order, live, gid, 1, lowerer)
+        )
         names.append(agg.out_name)
     out = Page(
         blocks=tuple(blocks),

@@ -20,29 +20,18 @@ from typing import List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 
 from presto_tpu import types as T
-from presto_tpu.expr import ColumnRef, Expr, eval_expr, eval_predicate
+from presto_tpu.expr import Expr, ExprLowerer, eval_predicate
 from presto_tpu.page import Block, Page
-
-
-def _result_dictionary(expr: Expr, page: Page):
-    """Propagate the host-side dictionary for string-typed results (only
-    ColumnRef can produce strings in round 1 — no string-valued funcs)."""
-    if expr.dtype.is_string and isinstance(expr, ColumnRef):
-        return page.block(expr.name).dictionary
-    if expr.dtype.is_string:
-        raise NotImplementedError(
-            "string-valued expression other than column reference"
-        )
-    return None
 
 
 def project(
     page: Page, projections: Sequence[Tuple[str, Expr]]
 ) -> Page:
     """Pure projection (no selection)."""
+    lowerer = ExprLowerer(page)
     names, blocks = [], []
     for name, expr in projections:
-        data, valid = eval_expr(expr, page)
+        data, valid = lowerer.eval(expr)
         data = jnp.broadcast_to(data, (page.capacity,))
         if valid is not None:
             valid = jnp.broadcast_to(valid, (page.capacity,))
@@ -51,7 +40,11 @@ def project(
                 data=data,
                 valid=valid,
                 dtype=expr.dtype,
-                dictionary=_result_dictionary(expr, page),
+                dictionary=(
+                    lowerer.dictionary_of(expr)
+                    if expr.dtype.is_string
+                    else None
+                ),
             )
         )
         names.append(name)
@@ -84,9 +77,10 @@ def filter_project(
     count = jnp.sum(mask).astype(jnp.int32)
     (sel,) = jnp.nonzero(mask, size=cap, fill_value=0)
 
+    lowerer = ExprLowerer(page)
     names, blocks = [], []
     for name, expr in projections:
-        data, valid = eval_expr(expr, page)
+        data, valid = lowerer.eval(expr)
         data = jnp.broadcast_to(data, (page.capacity,))[sel]
         if valid is not None:
             valid = jnp.broadcast_to(valid, (page.capacity,))[sel]
@@ -95,7 +89,11 @@ def filter_project(
                 data=data,
                 valid=valid,
                 dtype=expr.dtype,
-                dictionary=_result_dictionary(expr, page),
+                dictionary=(
+                    lowerer.dictionary_of(expr)
+                    if expr.dtype.is_string
+                    else None
+                ),
             )
         )
         names.append(name)

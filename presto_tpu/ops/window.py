@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from presto_tpu import types as T
-from presto_tpu.expr import Expr, eval_expr
+from presto_tpu.expr import Expr, ExprLowerer
 from presto_tpu.ops.common import boundaries, sort_order
 from presto_tpu.ops.sort import SortKey
 from presto_tpu.page import Block, Page
@@ -68,8 +68,11 @@ def window(
     """Append window-function columns to ``page`` (sorted order output)."""
     cap = page.capacity
     live = page.row_mask()
-    part_eval = [(*eval_expr(e, page), e.dtype) for e in partition_by]
-    order_eval = [(*eval_expr(k.expr, page), k.expr.dtype) for k in order_by]
+    lowerer = ExprLowerer(page)
+    part_eval = [(*lowerer.eval(e), e.dtype) for e in partition_by]
+    order_eval = [
+        (*lowerer.eval(k.expr), k.expr.dtype) for k in order_by
+    ]
 
     perm = sort_order(
         part_eval + order_eval,
@@ -150,6 +153,7 @@ def window(
                     pos,
                     running=bool(order_by),
                     nseg=nseg,
+                    lowerer=lowerer,
                 )
             )
         else:
@@ -175,10 +179,11 @@ def _window_agg(
     pos,
     running: bool,
     nseg: int,
+    lowerer: ExprLowerer = None,
 ):
     rt = call.result_type()
     if call.arg is not None:
-        d, v = eval_expr(call.arg, page)
+        d, v = lowerer.eval(call.arg)
         d = jnp.broadcast_to(d, (page.capacity,))[perm]
         valid = live_s if v is None else (
             live_s & jnp.broadcast_to(v, (page.capacity,))[perm]
@@ -246,10 +251,7 @@ def _window_agg(
             has = cnt_seg[safe_pid] > 0
         dictionary = None
         if at.is_string:
-            from presto_tpu.expr import ColumnRef
-
-            if isinstance(call.arg, ColumnRef):
-                dictionary = page.block(call.arg.name).dictionary
+            dictionary = lowerer.dictionary_of(call.arg)
         return Block(
             data=data.astype(at.jnp_dtype),
             valid=has,

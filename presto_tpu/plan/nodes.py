@@ -1,0 +1,209 @@
+"""Logical plan node hierarchy.
+
+Reference parity: presto-main's ``PlanNode`` tree — TableScanNode,
+FilterNode, ProjectNode, AggregationNode, JoinNode, SortNode (TopN fused
+via limit), LimitNode, WindowNode, OutputNode, ValuesNode (SURVEY.md
+§2.1 "Logical planner"). SemiJoin/anti are JoinNode join_types, as in the
+executor kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.expr import Expr
+from presto_tpu.ops.aggregation import AggCall
+from presto_tpu.ops.sort import SortKey
+from presto_tpu.ops.window import WindowCall
+
+
+class PlanNode:
+    def output_schema(self) -> Dict[str, T.DataType]:
+        raise NotImplementedError
+
+    def children(self) -> Sequence["PlanNode"]:
+        return ()
+
+    def fingerprint(self) -> str:
+        """Stable id for the jit plan cache."""
+        return repr(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScanNode(PlanNode):
+    handle: TableHandle
+    columns: Tuple[str, ...]
+    schema: Tuple[Tuple[str, T.DataType], ...]  # ordered (name, type)
+
+    def output_schema(self):
+        return dict(self.schema)
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesNode(PlanNode):
+    """Single-row relation for FROM-less SELECT (reference: ValuesNode)."""
+
+    schema: Tuple[Tuple[str, T.DataType], ...] = ()
+
+    def output_schema(self):
+        return dict(self.schema)
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: Expr
+
+    def output_schema(self):
+        return self.source.output_schema()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectNode(PlanNode):
+    source: PlanNode
+    projections: Tuple[Tuple[str, Expr], ...]
+
+    def output_schema(self):
+        return {n: e.dtype for n, e in self.projections}
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregationNode(PlanNode):
+    source: PlanNode
+    group_keys: Tuple[Tuple[str, Expr], ...]
+    aggs: Tuple[AggCall, ...]
+    max_groups: int = 1 << 16  # capacity bucket; optimizer refines by stats
+
+    def output_schema(self):
+        out = {n: e.dtype for n, e in self.group_keys}
+        for a in self.aggs:
+            out[a.out_name] = a.result_type()
+        return out
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinNode(PlanNode):
+    left: PlanNode  # probe
+    right: PlanNode  # build
+    join_type: str  # inner | left | semi | anti
+    left_keys: Tuple[str, ...]
+    right_keys: Tuple[str, ...]
+    payload: Tuple[str, ...]  # build columns carried to output
+    payload_rename: Tuple[Tuple[str, str], ...] = ()
+    build_unique: bool = False
+    out_capacity: Optional[int] = None  # None: planner fills from stats
+    residual: Optional[Expr] = None  # non-equi conjuncts applied post-join
+
+    def output_schema(self):
+        out = dict(self.left.output_schema())
+        rename = dict(self.payload_rename)
+        if self.join_type in ("inner", "left"):
+            rs = self.right.output_schema()
+            for c in self.payload:
+                out[rename.get(c, c)] = rs[c]
+        return out
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class CrossJoinNode(PlanNode):
+    """Cross product; round 1 supports only a single-row right side
+    (scalar-aggregate broadcast — the common SQL shape)."""
+
+    left: PlanNode
+    right: PlanNode
+
+    def output_schema(self):
+        return {**self.left.output_schema(), **self.right.output_schema()}
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortNode(PlanNode):
+    source: PlanNode
+    keys: Tuple[SortKey, ...]
+    limit: Optional[int] = None  # fused TopN
+
+    def output_schema(self):
+        return self.source.output_schema()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class LimitNode(PlanNode):
+    source: PlanNode
+    count: int
+
+    def output_schema(self):
+        return self.source.output_schema()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistinctNode(PlanNode):
+    source: PlanNode
+    max_groups: int = 1 << 16
+
+    def output_schema(self):
+        return self.source.output_schema()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowNode(PlanNode):
+    source: PlanNode
+    partition_by: Tuple[Expr, ...]
+    order_by: Tuple[SortKey, ...]
+    calls: Tuple[WindowCall, ...]
+
+    def output_schema(self):
+        out = dict(self.source.output_schema())
+        for c in self.calls:
+            out[c.out_name] = c.result_type()
+        return out
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputNode(PlanNode):
+    """Final column selection + user-visible names (reference: OutputNode)."""
+
+    source: PlanNode
+    columns: Tuple[Tuple[str, str], ...]  # (output name, source column)
+
+    def output_schema(self):
+        src = self.source.output_schema()
+        return {out: src[col] for out, col in self.columns}
+
+    def children(self):
+        return (self.source,)
+
+
+def walk(node: PlanNode):
+    yield node
+    for c in node.children():
+        yield from walk(c)

@@ -1,0 +1,256 @@
+"""Plan optimizer: stats estimation, structural properties, rule passes.
+
+Reference parity: ``PlanOptimizers``' rule pipeline with
+``StatsCalculator``/``CostCalculator`` inputs (SURVEY.md §2.1
+"Optimizer"). Round 1 carries the load-bearing subset:
+
+- ``estimate_rows``: cardinality estimates from connector stats with
+  classic selectivity constants (drives greedy join ordering and the
+  static capacity buckets XLA needs)
+- ``unique_key_sets``: key-uniqueness inference (drives the PK-FK
+  ``build_unique`` fast path in the join kernel)
+- ``prune_columns``: column pruning down to scans (the reference's
+  PruneUnreferencedOutputs), which on this engine also shrinks
+  host->device staging traffic
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set
+
+from presto_tpu import expr as E
+from presto_tpu.plan import nodes as N
+
+FILTER_SELECTIVITY = 0.33
+
+
+def estimate_rows(node: N.PlanNode, catalogs) -> float:
+    if isinstance(node, N.TableScanNode):
+        stats = catalogs.get(node.handle.catalog).metadata().get_table_stats(
+            node.handle
+        )
+        return stats.row_count or 1000.0
+    if isinstance(node, N.ValuesNode):
+        return 1.0
+    if isinstance(node, N.FilterNode):
+        return max(estimate_rows(node.source, catalogs) * FILTER_SELECTIVITY, 1.0)
+    if isinstance(node, (N.ProjectNode, N.WindowNode, N.OutputNode)):
+        return estimate_rows(node.source, catalogs)
+    if isinstance(node, N.AggregationNode):
+        src = estimate_rows(node.source, catalogs)
+        if not node.group_keys:
+            return 1.0
+        return max(min(src * 0.1, float(node.max_groups)), 1.0)
+    if isinstance(node, N.DistinctNode):
+        return max(estimate_rows(node.source, catalogs) * 0.5, 1.0)
+    if isinstance(node, N.SortNode):
+        src = estimate_rows(node.source, catalogs)
+        return min(src, node.limit) if node.limit else src
+    if isinstance(node, N.LimitNode):
+        return min(estimate_rows(node.source, catalogs), node.count)
+    if isinstance(node, N.JoinNode):
+        probe = estimate_rows(node.left, catalogs)
+        if node.join_type in ("semi", "anti"):
+            return max(probe * 0.5, 1.0)
+        if node.build_unique:
+            return probe
+        build = estimate_rows(node.right, catalogs)
+        return max(probe, build)
+    # unknown node (e.g. planner-internal): be conservative
+    total = 1.0
+    for c in node.children():
+        total *= max(estimate_rows(c, catalogs), 1.0)
+    return total
+
+
+def unique_key_sets(node: N.PlanNode, catalogs) -> List[FrozenSet[str]]:
+    """Column sets guaranteed unique per row of ``node`` (PK inference)."""
+    if isinstance(node, N.TableScanNode):
+        stats = catalogs.get(node.handle.catalog).metadata().get_table_stats(
+            node.handle
+        )
+        out = []
+        rc = stats.row_count
+        for col, cs in (stats.columns or {}).items():
+            if (
+                rc
+                and cs.distinct_count
+                and cs.distinct_count >= rc
+                and col in node.columns
+            ):
+                out.append(frozenset([col]))
+        if stats.primary_key and all(
+            c in node.columns for c in stats.primary_key
+        ):
+            pk = frozenset(stats.primary_key)
+            if pk not in out:
+                out.append(pk)
+        return out
+    if isinstance(node, N.FilterNode):
+        return unique_key_sets(node.source, catalogs)
+    if isinstance(node, (N.SortNode, N.LimitNode, N.WindowNode)):
+        return unique_key_sets(node.source, catalogs)
+    if isinstance(node, N.ProjectNode):
+        # identity projections propagate uniqueness through renames
+        rename: Dict[str, str] = {}
+        for out_name, e in node.projections:
+            if isinstance(e, E.ColumnRef):
+                rename.setdefault(e.name, out_name)
+        child = unique_key_sets(node.source, catalogs)
+        out = []
+        for ks in child:
+            if all(k in rename for k in ks):
+                out.append(frozenset(rename[k] for k in ks))
+        return out
+    if isinstance(node, N.OutputNode):
+        child = unique_key_sets(node.source, catalogs)
+        rename = {src: out for out, src in node.columns}
+        out = []
+        for ks in child:
+            if all(k in rename for k in ks):
+                out.append(frozenset(rename[k] for k in ks))
+        return out
+    if isinstance(node, N.AggregationNode):
+        if node.group_keys:
+            return [frozenset(n for n, _ in node.group_keys)]
+        return [frozenset()]  # single row
+    if isinstance(node, N.DistinctNode):
+        return [frozenset(node.output_schema())]
+    if isinstance(node, N.JoinNode):
+        if node.join_type in ("semi", "anti"):
+            return unique_key_sets(node.left, catalogs)
+        if node.build_unique:
+            return unique_key_sets(node.left, catalogs)
+        return []
+    return []
+
+
+def is_build_unique(
+    build: N.PlanNode, build_keys, catalogs
+) -> bool:
+    keys = set(build_keys)
+    for ks in unique_key_sets(build, catalogs):
+        if ks <= keys:
+            return True
+    return False
+
+
+# ------------------------------------------------------------ column pruning
+
+
+def _expr_columns(e: E.Expr, out: Set[str]) -> None:
+    if isinstance(e, E.ColumnRef):
+        out.add(e.name)
+    for c in e.children():
+        _expr_columns(c, out)
+
+
+def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
+    """Drop unused columns, pushing requirements down to scans
+    (reference: PruneUnreferencedOutputs / pushdown of column sets into
+    ConnectorPageSource — SURVEY.md §2.2 pushdown surface)."""
+    if isinstance(node, N.OutputNode):
+        need = {src for _, src in node.columns}
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, need)
+        )
+    if required is None:
+        required = set(node.output_schema())
+
+    if isinstance(node, N.TableScanNode):
+        cols = tuple(c for c in node.columns if c in required) or node.columns[:1]
+        return dataclasses.replace(
+            node,
+            columns=cols,
+            schema=tuple((n, t) for n, t in node.schema if n in cols),
+        )
+    if isinstance(node, N.FilterNode):
+        need = set(required)
+        _expr_columns(node.predicate, need)
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, need)
+        )
+    if isinstance(node, N.ProjectNode):
+        projs = tuple(
+            (n, e) for n, e in node.projections if n in required
+        )
+        need: Set[str] = set()
+        for _, e in projs:
+            _expr_columns(e, need)
+        return dataclasses.replace(
+            node,
+            projections=projs,
+            source=prune_columns(node.source, need),
+        )
+    if isinstance(node, N.AggregationNode):
+        need: Set[str] = set()
+        for _, e in node.group_keys:
+            _expr_columns(e, need)
+        for a in node.aggs:
+            if a.arg is not None:
+                _expr_columns(a.arg, need)
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, need)
+        )
+    if isinstance(node, N.JoinNode):
+        rename = dict(node.payload_rename)
+        lneed = {c for c in required if c in node.left.output_schema()}
+        lneed.update(node.left_keys)
+        inv = {rename.get(c, c): c for c in node.payload}
+        rneed = {
+            inv[c] for c in required if c in inv
+        }
+        rneed.update(node.right_keys)
+        if node.residual is not None:
+            resid_cols: Set[str] = set()
+            _expr_columns(node.residual, resid_cols)
+            lsch = node.left.output_schema()
+            for c in resid_cols:
+                if c in lsch:
+                    lneed.add(c)
+                elif c in inv:
+                    rneed.add(inv[c])
+                else:
+                    rneed.add(c)
+        payload = tuple(
+            c for c in node.payload
+            if rename.get(c, c) in required or c in rneed
+        )
+        return dataclasses.replace(
+            node,
+            left=prune_columns(node.left, lneed),
+            right=prune_columns(node.right, rneed),
+            payload=payload,
+        )
+    if isinstance(node, N.SortNode):
+        need = set(required)
+        for k in node.keys:
+            _expr_columns(k.expr, need)
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, need)
+        )
+    if isinstance(node, N.LimitNode):
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, set(required))
+        )
+    if isinstance(node, N.DistinctNode):
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, set(node.source.output_schema()))
+        )
+    if isinstance(node, N.WindowNode):
+        need = set(required) - {c.out_name for c in node.calls}
+        for e in node.partition_by:
+            _expr_columns(e, need)
+        for k in node.order_by:
+            _expr_columns(k.expr, need)
+        for c in node.calls:
+            if c.arg is not None:
+                _expr_columns(c.arg, need)
+        # window preserves all source columns; required source cols only
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, need)
+        )
+    if isinstance(node, N.ValuesNode):
+        return node
+    return node

@@ -1,0 +1,235 @@
+"""Untyped parse-tree AST.
+
+Reference parity: presto-parser's ``Statement``/``Expression`` node
+hierarchy (SURVEY.md §2.1). Types are resolved later by the analyzer
+(presto_tpu.plan.analyzer), which lowers these into the typed
+presto_tpu.expr IR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# ----------------------------------------------------------- expressions
+
+
+@dataclasses.dataclass(frozen=True)
+class Ident(Node):
+    parts: Tuple[str, ...]  # a / t.a / catalog.schema.t.a
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLit(Node):
+    text: str  # kept verbatim: "1", "0.05" (decimal!), "1e9" (double)
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLit(Node):
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLit(Node):
+    value: str
+    unit: str  # day | month | year
+    negative: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BoolLit(Node):
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Node):
+    qualifier: Optional[str] = None  # t.* keeps t
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str  # + - * / % = <> != < <= > >= and or
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # - not
+    arg: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncCall(Node):
+    name: str
+    args: Tuple[Node, ...]
+    distinct: bool = False
+    window: Optional["Over"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Over(Node):
+    partition_by: Tuple[Node, ...]
+    order_by: Tuple["SortItem", ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CaseExpr(Node):
+    operand: Optional[Node]  # CASE x WHEN v ... vs searched CASE
+    whens: Tuple[Tuple[Node, Node], ...]
+    default: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class CastExpr(Node):
+    arg: Node
+    type_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class BetweenExpr(Node):
+    arg: Node
+    low: Node
+    high: Node
+    negate: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Node):
+    arg: Node
+    values: Tuple[Node, ...]
+    negate: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Node):
+    arg: Node
+    query: "Select"
+    negate: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Node):
+    query: "Select"
+    negate: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Select"
+
+
+@dataclasses.dataclass(frozen=True)
+class LikeExpr(Node):
+    arg: Node
+    pattern: Node
+    negate: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNullExpr(Node):
+    arg: Node
+    negate: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtractExpr(Node):
+    field: str
+    arg: Node
+
+
+# ------------------------------------------------------------- relations
+
+
+@dataclasses.dataclass(frozen=True)
+class TableRef(Node):
+    parts: Tuple[str, ...]  # [catalog.][schema.]table
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRef(Node):
+    query: "Select"
+    alias: str
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinRel(Node):
+    left: Node
+    right: Node
+    join_type: str  # inner | left | right | full | cross
+    on: Optional[Node] = None
+
+
+# ------------------------------------------------------------ statements
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    expr: Node
+    descending: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Select(Node):
+    items: Tuple[SelectItem, ...]
+    from_: Optional[Node]  # TableRef | SubqueryRef | JoinRel | None
+    where: Optional[Node] = None
+    group_by: Tuple[Node, ...] = ()
+    having: Optional[Node] = None
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: Tuple[Tuple[str, "Select"], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: object
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    statement: Node
+    analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    schema: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSchemas(Node):
+    catalog: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass

@@ -1,0 +1,532 @@
+"""Recursive-descent SQL parser.
+
+Reference parity: presto-parser's ``SqlParser.createStatement`` +
+``AstBuilder`` (SURVEY.md §2.1); grammar shape follows standard SQL
+precedence (OR < AND < NOT < predicate < additive < multiplicative <
+unary < postfix/primary).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from presto_tpu.sql import ast
+from presto_tpu.sql.tokenizer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+def parse_statement(sql: str) -> ast.Node:
+    return _Parser(tokenize(sql)).parse_statement()
+
+
+class _Parser:
+    def __init__(self, tokens: List[Token]):
+        self.tokens = tokens
+        self.pos = 0
+
+    # ------------------------------------------------------- token plumbing
+
+    @property
+    def cur(self) -> Token:
+        return self.tokens[self.pos]
+
+    def peek_kw(self, *kws: str) -> bool:
+        t = self.cur
+        return t.kind == "kw" and t.value in kws
+
+    def peek_op(self, *ops: str) -> bool:
+        t = self.cur
+        return t.kind == "op" and t.value in ops
+
+    def advance(self) -> Token:
+        t = self.cur
+        self.pos += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        if self.peek_kw(*kws):
+            return self.advance().value
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.peek_op(*ops):
+            return self.advance().value
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ParseError(
+                f"expected {kw.upper()} but found "
+                f"{self.cur.value!r} at position {self.cur.pos}"
+            )
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise ParseError(
+                f"expected {op!r} but found "
+                f"{self.cur.value!r} at position {self.cur.pos}"
+            )
+
+    def expect_ident(self) -> str:
+        t = self.cur
+        if t.kind == "ident":
+            return self.advance().value
+        # soft keywords usable as identifiers in table/column position
+        if t.kind == "kw" and t.value in (
+            "date", "year", "month", "day", "values", "tables", "schemas",
+            "first", "last",
+        ):
+            return self.advance().value
+        raise ParseError(
+            f"expected identifier but found {t.value!r} at position {t.pos}"
+        )
+
+    # ---------------------------------------------------------- statements
+
+    def parse_statement(self) -> ast.Node:
+        if self.accept_kw("explain"):
+            analyze = bool(self.accept_kw("analyze"))
+            stmt = self.parse_statement()
+            return ast.Explain(stmt, analyze)
+        if self.accept_kw("set"):
+            self.expect_kw("session")
+            name = self.expect_ident()
+            self.expect_op("=")
+            t = self.advance()
+            if t.kind == "string":
+                value: object = t.value
+            elif t.kind == "number":
+                value = float(t.value) if "." in t.value else int(t.value)
+            elif t.kind == "kw" and t.value in ("true", "false"):
+                value = t.value == "true"
+            else:
+                value = t.value
+            self._finish()
+            return ast.SetSession(name, value)
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                schema = None
+                if self.accept_kw("from"):
+                    schema = self.expect_ident()
+                self._finish()
+                return ast.ShowTables(schema)
+            if self.accept_kw("schemas"):
+                catalog = None
+                if self.accept_kw("from"):
+                    catalog = self.expect_ident()
+                self._finish()
+                return ast.ShowSchemas(catalog)
+            if self.accept_kw("session"):
+                self._finish()
+                return ast.ShowSession()
+            raise ParseError(f"unsupported SHOW at {self.cur.pos}")
+        sel = self.parse_select()
+        self._finish()
+        return sel
+
+    def _finish(self):
+        self.accept_op(";")
+        if self.cur.kind != "eof":
+            raise ParseError(
+                f"unexpected trailing input {self.cur.value!r} "
+                f"at position {self.cur.pos}"
+            )
+
+    # ------------------------------------------------------------- queries
+
+    def parse_select(self) -> ast.Select:
+        ctes: List[Tuple[str, ast.Select]] = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                ctes.append((name, self.parse_select()))
+                self.expect_op(")")
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self._relation()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        group_by: List[ast.Node] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.accept_kw("having") else None
+        order_by: List[ast.SortItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
+                order_by.append(self._sort_item())
+        limit = None
+        if self.accept_kw("limit"):
+            t = self.advance()
+            if t.kind != "number":
+                raise ParseError(f"LIMIT expects a number at {t.pos}")
+            limit = int(t.value)
+        return ast.Select(
+            items=tuple(items),
+            from_=from_,
+            where=where,
+            group_by=tuple(group_by),
+            having=having,
+            order_by=tuple(order_by),
+            limit=limit,
+            distinct=distinct,
+            ctes=tuple(ctes),
+        )
+
+    def _select_item(self) -> ast.SelectItem:
+        if self.peek_op("*"):
+            self.advance()
+            return ast.SelectItem(ast.Star(), None)
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "ident":
+            alias = self.advance().value
+        # t.* style
+        if (
+            isinstance(expr, ast.Ident)
+            and alias is None
+            and self.peek_op(".")
+        ):  # pragma: no cover - handled in primary
+            pass
+        return ast.SelectItem(expr, alias)
+
+    def _sort_item(self) -> ast.SortItem:
+        expr = self.parse_expr()
+        descending = False
+        if self.accept_kw("desc"):
+            descending = True
+        else:
+            self.accept_kw("asc")
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return ast.SortItem(expr, descending, nulls_first)
+
+    # ----------------------------------------------------------- relations
+
+    def _relation(self) -> ast.Node:
+        rel = self._join_relation()
+        while self.accept_op(","):
+            right = self._join_relation()
+            rel = ast.JoinRel(rel, right, "cross", None)
+        return rel
+
+    def _join_relation(self) -> ast.Node:
+        rel = self._primary_relation()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self._primary_relation()
+                rel = ast.JoinRel(rel, right, "cross", None)
+                continue
+            jt = None
+            if self.peek_kw("join"):
+                jt = "inner"
+            elif self.peek_kw("inner"):
+                self.advance()
+                jt = "inner"
+            elif self.peek_kw("left"):
+                self.advance()
+                self.accept_kw("outer")
+                jt = "left"
+            elif self.peek_kw("right"):
+                self.advance()
+                self.accept_kw("outer")
+                jt = "right"
+            elif self.peek_kw("full"):
+                self.advance()
+                self.accept_kw("outer")
+                jt = "full"
+            if jt is None:
+                return rel
+            self.expect_kw("join")
+            right = self._primary_relation()
+            self.expect_kw("on")
+            on = self.parse_expr()
+            rel = ast.JoinRel(rel, right, jt, on)
+
+    def _primary_relation(self) -> ast.Node:
+        if self.accept_op("("):
+            q = self.parse_select()
+            self.expect_op(")")
+            alias = self._relation_alias()
+            if alias is None:
+                raise ParseError(
+                    f"derived table requires an alias at {self.cur.pos}"
+                )
+            return ast.SubqueryRef(q, alias)
+        parts = [self.expect_ident()]
+        while self.accept_op("."):
+            parts.append(self.expect_ident())
+        alias = self._relation_alias()
+        return ast.TableRef(tuple(parts), alias)
+
+    def _relation_alias(self) -> Optional[str]:
+        if self.accept_kw("as"):
+            return self.expect_ident()
+        if self.cur.kind == "ident":
+            return self.advance().value
+        return None
+
+    # --------------------------------------------------------- expressions
+
+    def parse_expr(self) -> ast.Node:
+        return self._or_expr()
+
+    def _or_expr(self) -> ast.Node:
+        left = self._and_expr()
+        while self.accept_kw("or"):
+            left = ast.BinaryOp("or", left, self._and_expr())
+        return left
+
+    def _and_expr(self) -> ast.Node:
+        left = self._not_expr()
+        while self.accept_kw("and"):
+            left = ast.BinaryOp("and", left, self._not_expr())
+        return left
+
+    def _not_expr(self) -> ast.Node:
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self._not_expr())
+        return self._predicate()
+
+    def _predicate(self) -> ast.Node:
+        left = self._additive()
+        while True:
+            negate = False
+            save = self.pos
+            if self.accept_kw("not"):
+                negate = True
+            if self.accept_kw("between"):
+                low = self._additive()
+                self.expect_kw("and")
+                high = self._additive()
+                left = ast.BetweenExpr(left, low, high, negate)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.peek_kw("select", "with"):
+                    q = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negate)
+                else:
+                    values = [self.parse_expr()]
+                    while self.accept_op(","):
+                        values.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(values), negate)
+                continue
+            if self.accept_kw("like"):
+                pattern = self._additive()
+                if self.accept_kw("escape"):
+                    self._additive()  # escape char: accepted, default '\'
+                left = ast.LikeExpr(left, pattern, negate)
+                continue
+            if negate:
+                self.pos = save  # NOT belongs to something else
+                return left
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = ast.IsNullExpr(left, neg)
+                continue
+            op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+            if op:
+                right = self._additive()
+                left = ast.BinaryOp(op, left, right)
+                continue
+            return left
+
+    def _additive(self) -> ast.Node:
+        left = self._multiplicative()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return left
+            left = ast.BinaryOp(op, left, self._multiplicative())
+
+    def _multiplicative(self) -> ast.Node:
+        left = self._unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return left
+            left = ast.BinaryOp(op, left, self._unary())
+
+    def _unary(self) -> ast.Node:
+        if self.accept_op("-"):
+            return ast.UnaryOp("-", self._unary())
+        if self.accept_op("+"):
+            return self._unary()
+        return self._primary()
+
+    def _primary(self) -> ast.Node:
+        t = self.cur
+        if t.kind == "number":
+            self.advance()
+            return ast.NumberLit(t.value)
+        if t.kind == "string":
+            self.advance()
+            return ast.StringLit(t.value)
+        if self.accept_kw("null"):
+            return ast.NullLit()
+        if self.accept_kw("true"):
+            return ast.BoolLit(True)
+        if self.accept_kw("false"):
+            return ast.BoolLit(False)
+        if self.peek_kw("date"):
+            # DATE 'yyyy-mm-dd' (else treat as identifier)
+            if self.tokens[self.pos + 1].kind == "string":
+                self.advance()
+                lit = self.advance()
+                return ast.DateLit(lit.value)
+        if self.accept_kw("interval"):
+            neg = bool(self.accept_op("-"))
+            lit = self.advance()
+            if lit.kind != "string":
+                raise ParseError(f"INTERVAL expects a string at {lit.pos}")
+            unit_tok = self.advance()
+            if unit_tok.value not in ("day", "month", "year"):
+                raise ParseError(
+                    f"unsupported interval unit {unit_tok.value!r}"
+                )
+            return ast.IntervalLit(lit.value, unit_tok.value, neg)
+        if self.accept_kw("case"):
+            operand = None
+            if not self.peek_kw("when"):
+                operand = self.parse_expr()
+            whens = []
+            while self.accept_kw("when"):
+                cond = self.parse_expr()
+                self.expect_kw("then")
+                val = self.parse_expr()
+                whens.append((cond, val))
+            default = None
+            if self.accept_kw("else"):
+                default = self.parse_expr()
+            self.expect_kw("end")
+            return ast.CaseExpr(operand, tuple(whens), default)
+        if self.accept_kw("cast"):
+            self.expect_op("(")
+            arg = self.parse_expr()
+            self.expect_kw("as")
+            type_parts = [self.expect_ident()]
+            if self.accept_op("("):
+                inner = [self.advance().value]
+                while self.accept_op(","):
+                    inner.append(self.advance().value)
+                self.expect_op(")")
+                type_parts.append("(" + ",".join(inner) + ")")
+            self.expect_op(")")
+            return ast.CastExpr(arg, "".join(type_parts))
+        if self.accept_kw("extract"):
+            self.expect_op("(")
+            field_tok = self.advance()
+            self.expect_kw("from")
+            arg = self.parse_expr()
+            self.expect_op(")")
+            return ast.ExtractExpr(field_tok.value, arg)
+        if self.peek_kw("substring", "substr"):
+            name = self.advance().value
+            self.expect_op("(")
+            arg = self.parse_expr()
+            if not self.accept_kw("from"):
+                self.expect_op(",")
+            start = self.parse_expr()
+            length = None
+            if self.accept_kw("for") or self.accept_op(","):
+                length = self.parse_expr()
+            self.expect_op(")")
+            args = (arg, start) + ((length,) if length is not None else ())
+            return ast.FuncCall("substring", args)
+        if self.accept_kw("exists"):
+            self.expect_op("(")
+            q = self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(q)
+        if self.accept_op("("):
+            if self.peek_kw("select", "with"):
+                q = self.parse_select()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        # identifier / function call / qualified name
+        if t.kind == "ident" or (
+            t.kind == "kw"
+            and t.value in ("date", "year", "month", "day", "values",
+                            "first", "last")
+        ):
+            name = self.expect_ident()
+            if self.accept_op("("):
+                return self._func_call(name)
+            parts = [name]
+            while self.peek_op("."):
+                if self.tokens[self.pos + 1].kind == "op" and self.tokens[
+                    self.pos + 1
+                ].value == "*":
+                    self.advance()
+                    self.advance()
+                    return ast.Star(qualifier=".".join(parts))
+                self.advance()
+                parts.append(self.expect_ident())
+            return ast.Ident(tuple(parts))
+        raise ParseError(
+            f"unexpected token {t.value!r} at position {t.pos}"
+        )
+
+    def _func_call(self, name: str) -> ast.Node:
+        distinct = False
+        args: List[ast.Node] = []
+        if self.peek_op("*"):
+            self.advance()
+            self.expect_op(")")
+        else:
+            if self.accept_kw("distinct"):
+                distinct = True
+            if not self.peek_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+        win = None
+        if self.accept_kw("over"):
+            self.expect_op("(")
+            pby: List[ast.Node] = []
+            oby: List[ast.SortItem] = []
+            if self.accept_kw("partition"):
+                self.expect_kw("by")
+                pby.append(self.parse_expr())
+                while self.accept_op(","):
+                    pby.append(self.parse_expr())
+            if self.accept_kw("order"):
+                self.expect_kw("by")
+                oby.append(self._sort_item())
+                while self.accept_op(","):
+                    oby.append(self._sort_item())
+            self.expect_op(")")
+            win = ast.Over(tuple(pby), tuple(oby))
+        return ast.FuncCall(name, tuple(args), distinct, win)

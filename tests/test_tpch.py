@@ -1,0 +1,33 @@
+"""TPC-H correctness suite: every query verified against the sqlite
+oracle over the SAME generated data (SURVEY.md §4.5 plan-correctness
+harness + §4.7 cross-engine verifier pattern)."""
+
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.verifier import SqliteOracle, verify_query
+
+from tpch_queries import QUERIES
+
+# queries whose decorrelation pattern is not implemented yet
+NOT_YET = {
+    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
+}
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle("tiny")
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_query(qnum, runner, oracle):
+    if qnum in NOT_YET:
+        pytest.xfail(NOT_YET[qnum])
+    diff = verify_query(runner, oracle, QUERIES[qnum], rel_tol=1e-6)
+    assert diff is None, f"Q{qnum} mismatch: {diff}"
