@@ -159,6 +159,11 @@ class LocalQueryRunner:
             n for n in N.walk(root) if isinstance(n, N.TableScanNode)
         ]
         pages = [self._load_table(s) for s in scans]
+        return self._run_with_pages(root, scans, pages)
+
+    def _run_with_pages(
+        self, root: N.PlanNode, scans: List[N.PlanNode], pages: List[Page]
+    ) -> Page:
         scan_ids = {id(s): i for i, s in enumerate(scans)}
 
         tries = 0
@@ -196,6 +201,13 @@ class LocalQueryRunner:
         key = (scan.handle, scan.columns)
         if key in self._table_cache:
             return self._table_cache[key]
+        merged = self._load_merged_payload(scan)
+        page = stage_page(merged, dict(scan.schema))
+        self._table_cache[key] = page
+        return page
+
+    def _load_merged_payload(self, scan: N.TableScanNode) -> Dict:
+        """Fetch all splits of a scan and merge their column payloads."""
         conn = self.catalogs.get(scan.handle.catalog)
         src = conn.get_splits(scan.handle, target_split_rows=1 << 22)
         datas = []
@@ -204,10 +216,7 @@ class LocalQueryRunner:
                 datas.append(
                     conn.create_page_source(split, list(scan.columns))
                 )
-        merged = _merge_split_payloads(datas, list(scan.columns))
-        page = stage_page(merged, dict(scan.schema))
-        self._table_cache[key] = page
-        return page
+        return _merge_split_payloads(datas, list(scan.columns))
 
 
 # ---------------------------------------------------------- trace helpers
@@ -218,7 +227,7 @@ def _execute_node(node, pages, scan_ids, flags, errors) -> Page:
         n, pages, scan_ids, flags, errors
     )
 
-    if isinstance(node, N.TableScanNode):
+    if isinstance(node, (N.TableScanNode, N.RemoteSourceNode)):
         return pages[scan_ids[id(node)]]
     if isinstance(node, N.ValuesNode):
         return Page(
@@ -279,22 +288,7 @@ def _execute_node(node, pages, scan_ids, flags, errors) -> Page:
         # error, not a capacity overflow — retries cannot fix it
         errors.append(("cross join build produced more than one row",
                        right.num_valid > 1))
-        blocks = list(left.blocks)
-        names = list(left.names)
-        for bname, blk in zip(right.names, right.blocks):
-            v = blk.valid[0] if blk.valid is not None else None
-            data = jnp.broadcast_to(blk.data[0], (left.capacity,))
-            valid = (
-                None
-                if v is None
-                else jnp.broadcast_to(v, (left.capacity,))
-            )
-            blocks.append(dataclasses.replace(blk, data=data, valid=valid))
-            names.append(bname)
-        num = jnp.where(right.num_valid > 0, left.num_valid, 0).astype(
-            jnp.int32
-        )
-        return Page(blocks=tuple(blocks), num_valid=num, names=tuple(names))
+        return cross_join_single_row(left, right)
     if isinstance(node, N.SortNode):
         return order_by_op(run(node.source), node.keys, limit=node.limit)
     if isinstance(node, N.LimitNode):
@@ -314,6 +308,23 @@ def _execute_node(node, pages, scan_ids, flags, errors) -> Page:
             names=tuple(o for o, _ in node.columns),
         )
     raise ExecutionError(f"cannot execute {type(node).__name__}")
+
+
+def cross_join_single_row(left: Page, right: Page) -> Page:
+    """Cross product against a single-row right side (scalar-aggregate
+    broadcast). Caller is responsible for flagging right.num_valid > 1."""
+    blocks = list(left.blocks)
+    names = list(left.names)
+    for bname, blk in zip(right.names, right.blocks):
+        v = blk.valid[0] if blk.valid is not None else None
+        data = jnp.broadcast_to(blk.data[0], (left.capacity,))
+        valid = (
+            None if v is None else jnp.broadcast_to(v, (left.capacity,))
+        )
+        blocks.append(dataclasses.replace(blk, data=data, valid=valid))
+        names.append(bname)
+    num = jnp.where(right.num_valid > 0, left.num_valid, 0).astype(jnp.int32)
+    return Page(blocks=tuple(blocks), num_valid=num, names=tuple(names))
 
 
 # ----------------------------------------------------------- param binding
@@ -405,6 +416,9 @@ def _substitute_params_node(node: N.PlanNode, bindings) -> N.PlanNode:
 
 
 def _scale_capacities(node: N.PlanNode, factor: int) -> N.PlanNode:
+    if isinstance(node, N.RemoteSourceNode):
+        # fragment already executed; identity keeps gathered-page mapping
+        return node
     changes = {}
     for f in dataclasses.fields(node):
         v = getattr(node, f.name)

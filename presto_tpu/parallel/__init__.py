@@ -1,0 +1,19 @@
+"""In-slice distribution: mesh fragments, ICI exchanges, partial aggs.
+
+Reference parity: the distributed half of the engine — scheduler-driven
+stages, exchanges, PARTIAL/FINAL aggregation (SURVEY.md §2.4/§2.5) —
+re-expressed as shard_map + XLA collectives (SURVEY.md §7 step 6).
+"""
+
+from presto_tpu.parallel.distributed_runner import (  # noqa: F401
+    DistributedQueryRunner,
+)
+from presto_tpu.parallel.exchange import (  # noqa: F401
+    partition_exchange,
+    partition_hash,
+    replicate,
+)
+from presto_tpu.parallel.fragmenter import (  # noqa: F401
+    insert_gathers,
+    is_distributable,
+)

@@ -1,0 +1,121 @@
+"""Partial/final aggregation decomposition for distributed group-by.
+
+Reference parity: Presto's two-step aggregation — ``AggregationNode``
+with PARTIAL step on the data-parallel stage and FINAL step after the
+hash repartition, with the accumulator's combine function merging
+partial states (SURVEY.md §2.1 "Function registry":
+@CombineFunction; §3.3 HashAggregationOperator).
+
+Here the decomposition is a pure plan rewrite: each AggCall splits into
+a partial call (runs per worker on its shard) and a final merge call
+(runs after the key-hash exchange), plus an optional post-projection
+that reassembles non-linear aggregates (avg = sum/count) from their
+mergeable parts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from presto_tpu import types as T
+from presto_tpu import expr as E
+from presto_tpu.ops.aggregation import AggCall
+
+#: partial-agg funcs whose merge is simply the same func over partials
+_SELF_MERGE = {"min": "min", "max": "max", "sum": "sum"}
+
+
+def split_aggregation(
+    group_keys: Tuple[Tuple[str, E.Expr], ...],
+    aggs: Tuple[AggCall, ...],
+):
+    """Split (group_keys, aggs) into distributed stages.
+
+    Returns (partial_aggs, final_group_keys, final_aggs, post_projs):
+
+    - partial stage: ``hash_aggregate(shard, group_keys, partial_aggs)``
+    - exchange: hash-partition partial rows by the key output columns
+    - final stage: ``hash_aggregate(routed, final_group_keys, final_aggs)``
+    - post_projs: None when every output column is already exact, else
+      the full ordered projection list (keys + aggregates) with avg
+      reassembled as sum/count.
+    """
+    partial_aggs: List[AggCall] = []
+    final_aggs: List[AggCall] = []
+    post: List[Tuple[str, E.Expr]] = [
+        (name, E.ColumnRef(name, e.dtype)) for name, e in group_keys
+    ]
+    needs_post = False
+
+    final_group_keys = tuple(
+        (name, E.ColumnRef(name, e.dtype)) for name, e in group_keys
+    )
+
+    for i, a in enumerate(aggs):
+        if a.func == "avg":
+            s_name, c_name = f"$p{i}_sum", f"$p{i}_cnt"
+            p_sum = AggCall("sum", a.arg, s_name)
+            p_cnt = AggCall("count", a.arg, c_name)
+            partial_aggs += [p_sum, p_cnt]
+            sum_t = p_sum.result_type()
+            final_aggs += [
+                AggCall("sum", E.ColumnRef(s_name, sum_t), s_name),
+                AggCall("sum", E.ColumnRef(c_name, T.BIGINT), c_name),
+            ]
+            # avg = sum/count; NULL over empty groups (count = 0)
+            f_sum_t = T.BIGINT if sum_t.is_integer else sum_t
+            sum_ref = E.ColumnRef(s_name, f_sum_t)
+            cnt_ref = E.ColumnRef(c_name, T.BIGINT)
+            division = E.Arithmetic(
+                "/",
+                E.Cast(sum_ref, T.DOUBLE),
+                E.Cast(cnt_ref, T.DOUBLE),
+                T.DOUBLE,
+            )
+            post.append(
+                (
+                    a.out_name,
+                    E.Case(
+                        whens=(
+                            (
+                                E.Compare(
+                                    "=", cnt_ref, E.Literal(0, T.BIGINT)
+                                ),
+                                E.Literal(None, T.DOUBLE),
+                            ),
+                        ),
+                        default=division,
+                        _dtype=T.DOUBLE,
+                    ),
+                )
+            )
+            needs_post = True
+            continue
+
+        rt = a.result_type()
+        if a.func in ("count", "count_star"):
+            partial_aggs.append(a)
+            final_aggs.append(
+                AggCall("sum", E.ColumnRef(a.out_name, T.BIGINT), a.out_name)
+            )
+        elif a.func in _SELF_MERGE:
+            partial_aggs.append(a)
+            final_aggs.append(
+                AggCall(
+                    _SELF_MERGE[a.func],
+                    E.ColumnRef(a.out_name, rt),
+                    a.out_name,
+                )
+            )
+        else:
+            raise NotImplementedError(
+                f"no distributed decomposition for aggregate {a.func}"
+            )
+        post.append((a.out_name, E.ColumnRef(a.out_name, rt)))
+
+    return (
+        tuple(partial_aggs),
+        final_group_keys,
+        tuple(final_aggs),
+        tuple(post) if needs_post else None,
+    )

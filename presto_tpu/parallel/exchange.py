@@ -1,0 +1,217 @@
+"""In-slice exchange: Presto's shuffle fabric as ICI collectives.
+
+Reference parity: the exchange layer — ``PartitionedOutputOperator`` /
+``OutputBuffer`` on the producer side and ``ExchangeClient`` /
+``ExchangeOperator`` on the consumer side, plus the exchange *types*
+REPARTITION / REPLICATE / GATHER (SURVEY.md §2.1 "Exchange", §2.5,
+§3.4).
+
+TPU-first redesign (SURVEY.md §7 step 6): there is no data plane. Inside
+a slice the shuffle *is* a collective inside the compiled program:
+
+- REPARTITION  -> bucket-scatter rows by destination + ``all_to_all``
+- REPLICATE    -> ``all_gather`` of the page + local compaction
+- GATHER       -> the fragment boundary: stacked per-shard output is
+  compacted on the consumer (see ``compact_flat``)
+
+All shapes are static: each worker sends exactly ``bucket_cap`` rows to
+every peer; per-destination counts ride along, and a count exceeding
+``bucket_cap`` raises the engine-wide overflow flag (host re-runs with a
+larger balance factor — the capacity-bucket protocol of SURVEY.md §7
+"Hard parts: dynamic shapes/skew").
+
+Rows are hashed with a splitmix64-style mixer over the *orderable int64*
+image of each key column (nulls encoded as a distinguished value), so
+equal keys — including NULL group keys — always land on the same worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.ops.common import orderable_i64
+from presto_tpu.page import Block, Page
+
+_NULL_SENTINEL = 0xA5A5_A5A5_DEAD_BEEF
+
+
+def _mix64(h: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer (public-domain constant schedule)."""
+    h = h ^ (h >> jnp.uint64(30))
+    h = h * jnp.uint64(0xBF58476D1CE4E5B9)
+    h = h ^ (h >> jnp.uint64(27))
+    h = h * jnp.uint64(0x94D049BB133111EB)
+    return h ^ (h >> jnp.uint64(31))
+
+
+def partition_hash(page: Page, key_cols: Sequence[str]) -> jnp.ndarray:
+    """uint64 hash per row over the key columns.
+
+    Grouping-consistent: a function of the normalized key values only
+    (NULLs normalized to a sentinel), so equal keys hash equally on every
+    worker and both sides of a join.
+    """
+    h = jnp.full((page.capacity,), 0x9E3779B97F4A7C15, dtype=jnp.uint64)
+    for c in key_cols:
+        blk = page.block(c)
+        x = orderable_i64(blk.data, blk.dtype).astype(jnp.uint64)
+        if blk.valid is not None:
+            x = jnp.where(blk.valid, x, jnp.uint64(_NULL_SENTINEL))
+        h = _mix64(h ^ x)
+    return h
+
+
+def compact_flat(
+    page: Page, live: jnp.ndarray, num_valid: jnp.ndarray
+) -> Page:
+    """Compact rows where ``live`` to the front (static-shape nonzero)."""
+    (sel,) = jnp.nonzero(live, size=page.capacity, fill_value=0)
+    blocks = []
+    for blk in page.blocks:
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[sel],
+                valid=None if blk.valid is None else blk.valid[sel],
+            )
+        )
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=num_valid.astype(jnp.int32),
+        names=page.names,
+    )
+
+
+def segmented_live_mask(counts: jnp.ndarray, seg_cap: int) -> jnp.ndarray:
+    """Flat live mask over ``len(counts)`` segments of ``seg_cap`` rows:
+    row j of segment i is live iff j < counts[i]."""
+    n = counts.shape[0]
+    j = jnp.arange(seg_cap, dtype=jnp.int32)[None, :]
+    return (j < counts[:, None].astype(jnp.int32)).reshape(n * seg_cap)
+
+
+def partition_exchange(
+    page: Page,
+    dest: jnp.ndarray,
+    n: int,
+    axis: str,
+    bucket_cap: int,
+) -> Tuple[Page, jnp.ndarray]:
+    """REPARTITION: route each live row to worker ``dest[row]``.
+
+    Returns (page', overflow): page' has capacity ``n * bucket_cap`` and
+    holds every row routed *to* this worker; overflow is True when any
+    outgoing bucket exceeded ``bucket_cap`` (surplus rows dropped — the
+    host must re-run with a larger balance factor).
+    """
+    cap = page.capacity
+    live = page.row_mask()
+    d = jnp.where(live, dest.astype(jnp.int32), n)  # dead rows -> trash
+    order = jnp.argsort(d, stable=True)  # rows grouped by destination
+    d_s = d[order]
+    # offset of each sorted row within its destination's bucket
+    offset = jnp.arange(cap, dtype=jnp.int32) - jnp.searchsorted(
+        d_s, d_s, side="left"
+    ).astype(jnp.int32)
+    counts = jax.ops.segment_sum(
+        jnp.ones((cap,), jnp.int32), d, num_segments=n + 1
+    )[:n]
+    overflow = jnp.any(counts > bucket_cap)
+    slot = d_s.astype(jnp.int64) * bucket_cap + offset
+    sendable = (d_s < n) & (offset < bucket_cap)
+    slot = jnp.where(sendable, slot, n * bucket_cap)  # OOB -> dropped
+
+    out_counts = jax.lax.all_to_all(
+        jnp.minimum(counts, bucket_cap), axis, 0, 0
+    )
+    num_valid = jnp.sum(out_counts)
+    live_recv = segmented_live_mask(out_counts, bucket_cap)
+
+    blocks: List[Block] = []
+    for blk in page.blocks:
+        data_s = blk.data[order]
+        sent = (
+            jnp.zeros((n * bucket_cap,), blk.data.dtype)
+            .at[slot]
+            .set(data_s, mode="drop")
+        )
+        recv = jax.lax.all_to_all(
+            sent.reshape(n, bucket_cap), axis, 0, 0
+        ).reshape(n * bucket_cap)
+        if blk.valid is None:
+            valid = None
+        else:
+            v_s = blk.valid[order]
+            v_sent = (
+                jnp.zeros((n * bucket_cap,), jnp.bool_)
+                .at[slot]
+                .set(v_s, mode="drop")
+            )
+            valid = jax.lax.all_to_all(
+                v_sent.reshape(n, bucket_cap), axis, 0, 0
+            ).reshape(n * bucket_cap)
+        blocks.append(dataclasses.replace(blk, data=recv, valid=valid))
+
+    routed = Page(
+        blocks=tuple(blocks),
+        num_valid=num_valid.astype(jnp.int32),
+        names=page.names,
+    )
+    # compact received segments so downstream kernels see a dense prefix
+    return compact_flat(routed, live_recv, num_valid), overflow
+
+
+def replicate(page: Page, n: int, axis: str) -> Page:
+    """REPLICATE: all_gather every worker's live rows; each worker ends
+    with the identical concatenation (capacity n * page.capacity)."""
+    cap = page.capacity
+    counts = jax.lax.all_gather(page.num_valid, axis)  # (n,)
+    blocks: List[Block] = []
+    for blk in page.blocks:
+        data = jax.lax.all_gather(blk.data, axis).reshape(n * cap)
+        valid = (
+            None
+            if blk.valid is None
+            else jax.lax.all_gather(blk.valid, axis).reshape(n * cap)
+        )
+        blocks.append(dataclasses.replace(blk, data=data, valid=valid))
+    gathered = Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.sum(counts).astype(jnp.int32),
+        names=page.names,
+    )
+    live = segmented_live_mask(counts, cap)
+    return compact_flat(gathered, live, gathered.num_valid)
+
+
+def gather_stacked(
+    page_flat: Page, counts: jnp.ndarray, shard_cap: int, replicated: bool
+) -> Page:
+    """GATHER (the fragment boundary, consumer side): turn a stacked
+    fragment output — flat leaves of shape (n * shard_cap,) plus per-shard
+    counts (n,) — into one dense page.
+
+    replicated fragments contribute shard 0 only; partitioned fragments
+    concatenate every shard's live prefix.
+    """
+    n = counts.shape[0]
+    if replicated:
+        blocks = [
+            dataclasses.replace(
+                blk,
+                data=blk.data[:shard_cap],
+                valid=None if blk.valid is None else blk.valid[:shard_cap],
+            )
+            for blk in page_flat.blocks
+        ]
+        return Page(
+            blocks=tuple(blocks),
+            num_valid=counts[0].astype(jnp.int32),
+            names=page_flat.names,
+        )
+    live = segmented_live_mask(counts, shard_cap)
+    return compact_flat(page_flat, live, jnp.sum(counts))

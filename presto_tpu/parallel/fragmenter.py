@@ -1,0 +1,54 @@
+"""Plan fragmenter: cut the plan at the gather boundary.
+
+Reference parity: ``PlanFragmenter`` cutting the optimized plan at
+ExchangeNodes into a ``SubPlan`` tree of fragments, with the root stage
+single-partition (GATHER) streaming results coordinator-ward
+(SURVEY.md §2.1 "Fragmenter", §3.1).
+
+TPU-first shape: only ONE cut matters in-slice — between the
+data-parallel fragment (compiled once, shard_map-ed over the mesh, with
+all exchanges *inside* the program as collectives) and the root
+fragment (final sort/limit/window/output over the gathered result,
+single device). Each maximal distributable subtree becomes a
+``RemoteSourceNode``; everything above runs in the root fragment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from presto_tpu.plan import nodes as N
+
+#: node types executable inside the shard_map fragment. Sort/Limit/
+#: Window/Output/Values run in the root fragment (the reference's
+#: single-partition root stage does its final ordering the same way).
+_DISTRIBUTABLE = (
+    N.TableScanNode,
+    N.FilterNode,
+    N.ProjectNode,
+    N.AggregationNode,
+    N.DistinctNode,
+    N.JoinNode,
+    N.CrossJoinNode,
+)
+
+
+def is_distributable(node: N.PlanNode) -> bool:
+    """True when the whole subtree can run inside one sharded fragment."""
+    if not isinstance(node, _DISTRIBUTABLE):
+        return False
+    return all(is_distributable(c) for c in node.children())
+
+
+def insert_gathers(node: N.PlanNode) -> N.PlanNode:
+    """Replace each maximal distributable subtree with RemoteSourceNode."""
+    if is_distributable(node):
+        return N.RemoteSourceNode(fragment_root=node)
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            nv = insert_gathers(v)
+            if nv is not v:
+                changes[f.name] = nv
+    return dataclasses.replace(node, **changes) if changes else node

@@ -189,6 +189,20 @@ class WindowNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class RemoteSourceNode(PlanNode):
+    """Fragment boundary: reads the gathered output of a distributed
+    fragment (reference: RemoteSourceNode reading an upstream stage
+    through the exchange, SURVEY.md §3.4). ``children()`` is empty on
+    purpose — the fragment executes separately; walking the consuming
+    fragment must not descend into it."""
+
+    fragment_root: PlanNode
+
+    def output_schema(self):
+        return self.fragment_root.output_schema()
+
+
+@dataclasses.dataclass(frozen=True)
 class OutputNode(PlanNode):
     """Final column selection + user-visible names (reference: OutputNode)."""
 

@@ -1,0 +1,174 @@
+"""Distributed execution suite: the full TPC-H corpus on an 8-virtual-
+device CPU mesh, verified against the sqlite oracle — the reference's
+DistributedQueryRunner pattern (SURVEY.md §4.3): multi-node correctness
+without a cluster, exercising real shard_map fragments and real
+all_to_all / all_gather exchanges.
+
+Also unit-covers the exchange collectives directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.page import Page
+from presto_tpu.parallel import DistributedQueryRunner
+from presto_tpu.verifier import SqliteOracle, verify_query
+
+from tpch_queries import QUERIES
+
+NOT_YET = {
+    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
+}
+
+
+@pytest.fixture(scope="module")
+def runner():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    # low thresholds so tiny-SF queries actually take the partitioned
+    # exchange paths instead of degenerating to broadcast everywhere
+    return DistributedQueryRunner(
+        broadcast_threshold=1 << 11, repl_threshold=1 << 10
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle("tiny")
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_query_distributed(qnum, runner, oracle):
+    if qnum in NOT_YET:
+        pytest.xfail(NOT_YET[qnum])
+    diff = verify_query(runner, oracle, QUERIES[qnum], rel_tol=1e-6)
+    assert diff is None, f"Q{qnum} distributed mismatch: {diff}"
+
+
+def test_partitioned_agg_path(runner, oracle):
+    """High max_groups forces the all_to_all partial/final agg path."""
+    sql = (
+        "select l_orderkey, count(*) as c, sum(l_quantity) as s "
+        "from tpch.tiny.lineitem group by l_orderkey"
+    )
+    diff = verify_query(runner, oracle, sql)
+    assert diff is None, diff
+
+
+def test_partition_exchange_roundtrip():
+    """Every live row lands on exactly the worker its key hashes to."""
+    from presto_tpu.parallel.exchange import (
+        partition_exchange,
+        partition_hash,
+    )
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = 8
+    cap = 64
+    devices = jax.devices()[:n]
+    mesh = Mesh(np.array(devices), ("workers",))
+    rng = np.random.RandomState(7)
+    keys = rng.randint(0, 1000, size=(n * cap,)).astype(np.int64)
+    counts = rng.randint(0, cap + 1, size=(n,)).astype(np.int32)
+
+    from presto_tpu.page import Block
+
+    flat = Page(
+        blocks=(
+            Block(data=jnp.asarray(keys), valid=None, dtype=T.BIGINT),
+        ),
+        num_valid=jnp.asarray(counts),
+        names=("k",),
+    )
+
+    def prog(page):
+        import dataclasses
+
+        local = dataclasses.replace(page, num_valid=page.num_valid[0])
+        h = partition_hash(local, ["k"])
+        dest = (h % jnp.uint64(n)).astype(jnp.int32)
+        out, ovf = partition_exchange(local, dest, n, "workers", cap)
+        return (
+            dataclasses.replace(out, num_valid=out.num_valid.reshape(1)),
+            ovf.reshape(1),
+        )
+
+    from jax import shard_map
+
+    fn = jax.jit(
+        shard_map(
+            prog, mesh=mesh, in_specs=(P("workers"),), out_specs=P("workers")
+        )
+    )
+    out, ovf = fn(jax.device_put(flat, NamedSharding(mesh, P("workers"))))
+    assert not np.any(np.asarray(ovf))
+
+    # reconstruct: rows received per worker must match the hash routing
+    out_cap = out.capacity // n
+    got = []
+    data = np.asarray(out.blocks[0].data).reshape(n, out_cap)
+    nv = np.asarray(out.num_valid)
+    for w in range(n):
+        got.append(sorted(data[w][: nv[w]].tolist()))
+
+    # expected routing computed host-side with the same mixer
+    def mix(h):
+        h = np.uint64(h)
+        h ^= h >> np.uint64(30)
+        h = np.uint64(h * np.uint64(0xBF58476D1CE4E5B9))
+        h ^= h >> np.uint64(27)
+        h = np.uint64(h * np.uint64(0x94D049BB133111EB))
+        return h ^ (h >> np.uint64(31))
+
+    expected = [[] for _ in range(n)]
+    with np.errstate(over="ignore"):
+        for w in range(n):
+            for j in range(counts[w]):
+                k = keys[w * cap + j]
+                h = mix(np.uint64(0x9E3779B97F4A7C15) ^ np.uint64(k))
+                expected[int(h % np.uint64(n))].append(int(k))
+    assert got == [sorted(e) for e in expected]
+    total = sum(counts)
+    assert sum(len(e) for e in got) == total
+
+
+def test_replicate_matches_concat():
+    from presto_tpu.parallel.exchange import replicate
+    from presto_tpu.page import Block
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+    import dataclasses
+
+    n, cap = 8, 16
+    mesh = Mesh(np.array(jax.devices()[:n]), ("workers",))
+    rng = np.random.RandomState(3)
+    vals = rng.randint(0, 100, size=(n * cap,)).astype(np.int64)
+    counts = rng.randint(0, cap + 1, size=(n,)).astype(np.int32)
+    flat = Page(
+        blocks=(Block(data=jnp.asarray(vals), valid=None, dtype=T.BIGINT),),
+        num_valid=jnp.asarray(counts),
+        names=("v",),
+    )
+
+    def prog(page):
+        local = dataclasses.replace(page, num_valid=page.num_valid[0])
+        out = replicate(local, n, "workers")
+        return dataclasses.replace(out, num_valid=out.num_valid.reshape(1))
+
+    fn = jax.jit(
+        shard_map(
+            prog, mesh=mesh, in_specs=(P("workers"),), out_specs=P("workers")
+        )
+    )
+    out = fn(jax.device_put(flat, NamedSharding(mesh, P("workers"))))
+    total = int(sum(counts))
+    expected = sorted(
+        int(vals[w * cap + j]) for w in range(n) for j in range(counts[w])
+    )
+    data = np.asarray(out.blocks[0].data).reshape(n, n * cap)
+    nv = np.asarray(out.num_valid)
+    for w in range(n):
+        assert nv[w] == total
+        assert sorted(data[w][:total].tolist()) == expected
