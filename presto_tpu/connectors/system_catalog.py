@@ -1,0 +1,168 @@
+"""Built-in ``system`` catalog.
+
+Reference parity: ``presto-system``'s runtime tables
+(``system.runtime.queries``, ``system.runtime.tasks``,
+``system.runtime.nodes``) and the jmx-connector pattern of making
+engine metrics SQL-able (SURVEY.md §5.5). Backed live by the runner's
+QueryHistory and the process metrics registry — zero stored bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import (
+    Connector,
+    ConnectorMetadata,
+    ConnectorSplit,
+    SplitSource,
+    TableHandle,
+)
+
+_SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
+    "runtime": {
+        "queries": {
+            "query_id": T.VARCHAR,
+            "state": T.VARCHAR,
+            "query": T.VARCHAR,
+            "elapsed_ms": T.DOUBLE,
+            "planning_ms": T.DOUBLE,
+            "staging_ms": T.DOUBLE,
+            "execution_ms": T.DOUBLE,
+            "compile_cache_hit": T.BOOLEAN,
+            "retries": T.BIGINT,
+            "input_rows": T.BIGINT,
+            "input_bytes": T.BIGINT,
+            "output_rows": T.BIGINT,
+            "error": T.VARCHAR,
+        },
+        "nodes": {
+            "node_id": T.VARCHAR,
+            "http_uri": T.VARCHAR,
+            "node_version": T.VARCHAR,
+            "coordinator": T.BOOLEAN,
+            "state": T.VARCHAR,
+        },
+        "metrics": {
+            "name": T.VARCHAR,
+            "kind": T.VARCHAR,
+            "value": T.DOUBLE,
+        },
+    },
+    "metadata": {
+        "catalogs": {"catalog_name": T.VARCHAR, "connector_id": T.VARCHAR},
+    },
+}
+
+
+class _SystemMetadata(ConnectorMetadata):
+    def list_schemas(self):
+        return sorted(_SCHEMAS)
+
+    def list_tables(self, schema):
+        return sorted(_SCHEMAS.get(schema, {}))
+
+    def get_table_schema(self, handle: TableHandle):
+        try:
+            return dict(_SCHEMAS[handle.schema][handle.table])
+        except KeyError:
+            raise KeyError(
+                f"table not found: system.{handle.schema}.{handle.table}"
+            )
+
+
+class SystemConnector(Connector):
+    """Catalog ``system``: live engine introspection tables."""
+
+    def __init__(self, runner=None, **config):
+        self._runner = runner
+        self._metadata = _SystemMetadata()
+
+    def metadata(self):
+        return self._metadata
+
+    def cacheable(self):
+        return False  # live data: never reuse staged pages
+
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+        return SplitSource([ConnectorSplit(handle, 0, 0)])
+
+    def create_page_source(self, split: ConnectorSplit, columns: Sequence[str]):
+        rows = self._rows(split.table)
+        return {
+            c: np.array([r[c] for r in rows], dtype=object) for c in columns
+        }
+
+    # ------------------------------------------------------------- tables
+
+    def _rows(self, handle: TableHandle):
+        key = (handle.schema, handle.table)
+        if key == ("runtime", "queries"):
+            hist = self._runner.history.snapshot() if self._runner else []
+            return [
+                {
+                    "query_id": q.query_id,
+                    "state": q.state,
+                    "query": q.sql.strip(),
+                    "elapsed_ms": q.elapsed_ms,
+                    "planning_ms": q.planning_ms,
+                    "staging_ms": q.staging_ms,
+                    "execution_ms": q.execution_ms,
+                    "compile_cache_hit": q.compile_cache_hit,
+                    "retries": q.retries,
+                    "input_rows": q.input_rows,
+                    "input_bytes": q.input_bytes,
+                    "output_rows": q.output_rows,
+                    "error": q.error,
+                }
+                for q in hist
+            ]
+        if key == ("runtime", "nodes"):
+            return self._node_rows()
+        if key == ("runtime", "metrics"):
+            from presto_tpu.utils.metrics import REGISTRY
+
+            return [
+                {"name": n, "kind": k, "value": v}
+                for n, k, v in REGISTRY.snapshot()
+            ]
+        if key == ("metadata", "catalogs"):
+            names = self._runner.catalogs.names() if self._runner else []
+            return [
+                {
+                    "catalog_name": n,
+                    "connector_id": type(
+                        self._runner.catalogs.get(n)
+                    ).__name__,
+                }
+                for n in names
+            ]
+        raise KeyError(f"system table {handle.schema}.{handle.table}")
+
+    def _node_rows(self):
+        cluster = getattr(self._runner, "cluster", None)
+        if cluster is not None:
+            return [
+                {
+                    "node_id": w.node_id,
+                    "http_uri": w.uri,
+                    "node_version": w.version,
+                    "coordinator": w.coordinator,
+                    "state": w.state,
+                }
+                for w in cluster.nodes()
+            ]
+        import jax
+
+        return [
+            {
+                "node_id": "local",
+                "http_uri": "local://",
+                "node_version": "presto-tpu-0.1",
+                "coordinator": True,
+                "state": f"ACTIVE ({len(jax.devices())} devices)",
+            }
+        ]

@@ -53,24 +53,39 @@ def _describe(node: N.PlanNode) -> str:
     return type(node).__name__
 
 
-def render_plan(node: N.PlanNode, indent: int = 0) -> str:
-    lines = ["    " * indent + "- " + _describe(node)]
+def render_plan(node: N.PlanNode, indent: int = 0, annot=None) -> str:
+    desc = _describe(node)
+    if annot is not None and id(node) in annot:
+        rows, cap = annot[id(node)]
+        desc += f"  [rows: {rows}, capacity: {cap}]"
+    lines = ["    " * indent + "- " + desc]
     for c in node.children():
-        lines.append(render_plan(c, indent + 1))
+        lines.append(render_plan(c, indent + 1, annot))
     return "\n".join(lines)
 
 
 def explain_text(runner, stmt: ast.Explain) -> str:
     plan = plan_statement(stmt.statement, runner.catalogs, runner.session)
     root = prune_columns(plan.root)
-    text = render_plan(root)
-    if stmt.analyze:
-        t0 = time.perf_counter()
-        result = runner.execute_plan(plan)
-        elapsed = time.perf_counter() - t0
-        n = len(result.rows())
-        text += (
-            f"\n\nEXPLAIN ANALYZE: {n} rows in {elapsed * 1000:.1f} ms "
-            f"(wall, includes staging + compile on first run)"
-        )
+    if not stmt.analyze:
+        return render_plan(root)
+    # EXPLAIN ANALYZE: re-run with per-node row counters traced as extra
+    # program outputs (stats.py); render rows inline like the reference.
+    t0 = time.perf_counter()
+    result, node_stats = runner.execute_plan_analyzed(plan)
+    elapsed = time.perf_counter() - t0
+    # node ids were assigned on the (possibly capacity-scaled) executed
+    # root; match to our tree by walk order, which scaling preserves
+    executed_order = {s.node_id: s for s in node_stats}
+    annot = {}
+    for i, n in enumerate(N.walk(root)):
+        s = executed_order.get(i)
+        if s is not None:
+            annot[id(n)] = (s.output_rows, s.output_capacity)
+    text = render_plan(root, annot=annot)
+    n_rows = len(result.rows())
+    text += (
+        f"\n\nEXPLAIN ANALYZE: {n_rows} rows in {elapsed * 1000:.1f} ms "
+        f"(wall, single-device instrumented run)"
+    )
     return text

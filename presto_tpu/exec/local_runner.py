@@ -22,6 +22,7 @@ plan-time placeholder, never a runtime value.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu import expr as E
 from presto_tpu.connectors import create_connector
+from presto_tpu.connectors.spi import payload_len
 from presto_tpu.exec.staging import CatalogManager, bucket_capacity, stage_page
 from presto_tpu.ops import (
     filter_project,
@@ -78,13 +80,21 @@ class LocalQueryRunner:
         catalogs: Optional[CatalogManager] = None,
         session: Optional[Session] = None,
     ):
+        from presto_tpu.exec.stats import QueryHistory
+
         if catalogs is None:
             catalogs = CatalogManager()
             catalogs.register("tpch", create_connector("tpch"))
         self.catalogs = catalogs
         self.session = session or Session()
+        self.history = QueryHistory()
+        if not catalogs.has("system"):
+            from presto_tpu.connectors.system_catalog import SystemConnector
+
+            catalogs.register("system", SystemConnector(runner=self))
         self._compiled: Dict[object, object] = {}
         self._table_cache: Dict[Tuple, Page] = {}
+        self._active_qs = None  # QueryStats while a query is in flight
 
     # ------------------------------------------------------------- public
 
@@ -129,14 +139,57 @@ class LocalQueryRunner:
                     "Table",
                 ),
             )
-        plan = plan_statement(stmt, self.catalogs, self.session)
-        return self.execute_plan(plan)
+        from presto_tpu.utils.metrics import REGISTRY
 
-    def execute_plan(self, plan: Plan) -> QueryResult:
+        qs = self.history.begin(sql)
+        REGISTRY.counter("queries.submitted").update()
+        t0 = time.perf_counter()
+        try:
+            with REGISTRY.timer("query.wall_time").time():
+                plan = plan_statement(stmt, self.catalogs, self.session)
+                qs.planning_ms = (time.perf_counter() - t0) * 1000.0
+                qs.state = "RUNNING"
+                result = self.execute_plan(plan, qs=qs)
+        except Exception as e:
+            REGISTRY.counter("queries.failed").update()
+            self.history.finish(qs, error=f"{type(e).__name__}: {e}")
+            raise
+        self.history.finish(qs)
+        REGISTRY.counter("queries.finished").update()
+        REGISTRY.distribution("query.output_rows").add(qs.output_rows)
+        return result
+
+    def execute_plan(self, plan: Plan, qs=None) -> QueryResult:
+        prev, self._active_qs = self._active_qs, qs
+        try:
+            root = self._bind_params(plan)
+            root = prune_columns(root)
+            t0 = time.perf_counter()
+            page = self._run(root)
+            if qs is not None:
+                qs.execution_ms += (time.perf_counter() - t0) * 1000.0
+                qs.output_rows = int(page.num_valid)
+        finally:
+            self._active_qs = prev
+        return QueryResult(plan.output_names, page)
+
+    def execute_plan_analyzed(self, plan: Plan):
+        """EXPLAIN ANALYZE support: run the plan with per-node row
+        counters traced as extra program outputs; returns
+        (QueryResult, List[PlanNodeStats]). Single-device trace path —
+        counts are identical under distribution."""
+        from presto_tpu.exec.stats import collect_node_stats
+
         root = self._bind_params(plan)
         root = prune_columns(root)
-        page = self._run(root)
-        return QueryResult(plan.output_names, page)
+        scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
+        pages = [self._load_table(s) for s in scans]
+        stats_cell: List = []
+        page = LocalQueryRunner._run_with_pages(
+            self, root, scans, pages, stats_out=stats_cell
+        )
+        stats = collect_node_stats(*stats_cell)
+        return QueryResult(plan.output_names, page), stats
 
     # ------------------------------------------------- params (subqueries)
 
@@ -162,32 +215,70 @@ class LocalQueryRunner:
         return self._run_with_pages(root, scans, pages)
 
     def _run_with_pages(
-        self, root: N.PlanNode, scans: List[N.PlanNode], pages: List[Page]
+        self,
+        root: N.PlanNode,
+        scans: List[N.PlanNode],
+        pages: List[Page],
+        stats_out: Optional[List] = None,
     ) -> Page:
+        """Run the compiled whole-plan program, retrying on capacity
+        overflow. With ``stats_out``, per-node row counters are traced as
+        extra outputs (EXPLAIN ANALYZE); stats_out receives
+        (executed_root, [(node, rows, capacity), ...])."""
         scan_ids = {id(s): i for i, s in enumerate(scans)}
+        analyzed = stats_out is not None
 
         tries = 0
         while True:
-            entry = self._compiled.get(root)
+            entry = self._compiled.get((root, analyzed))
             if entry is None:
+                if self._active_qs is not None:
+                    self._active_qs.compile_cache_hit = False
                 msgs_cell: List[str] = []
+                nodes_cell: List = []
 
-                def trace(pages_in, _root=root, _ids=scan_ids, _m=msgs_cell):
+                def trace(
+                    pages_in,
+                    _root=root,
+                    _ids=scan_ids,
+                    _m=msgs_cell,
+                    _n=nodes_cell,
+                ):
                     flags: List = []
                     errors: List = []
-                    out = _execute_node(_root, pages_in, _ids, flags, errors)
+                    counters: Optional[List] = [] if analyzed else None
+                    out = _execute_node(
+                        _root, pages_in, _ids, flags, errors, counters
+                    )
                     _m.clear()
                     _m.extend(m for m, _ in errors)
-                    return out, flags, [e for _, e in errors]
+                    _n.clear()
+                    if counters is not None:
+                        _n.extend((node, cap) for node, _, cap in counters)
+                        cnts = [c for _, c, _ in counters]
+                    else:
+                        cnts = []
+                    return out, flags, [e for _, e in errors], cnts
 
-                entry = (jax.jit(trace), msgs_cell)
-                self._compiled[root] = entry
-            fn, msgs_cell = entry
-            page, flags, error_flags = fn(pages)
+                entry = (jax.jit(trace), msgs_cell, nodes_cell)
+                self._compiled[(root, analyzed)] = entry
+            fn, msgs_cell, nodes_cell = entry
+            page, flags, error_flags, cnts = fn(pages)
             for msg, flag in zip(msgs_cell, error_flags):
                 if bool(flag):
                     raise ExecutionError(msg)
             if not any(bool(f) for f in flags):
+                if analyzed:
+                    stats_out.clear()
+                    stats_out.extend(
+                        (
+                            root,
+                            [
+                                (node, int(c), cap)
+                                for (node, cap), c in zip(nodes_cell, cnts)
+                            ],
+                        )
+                    )
                 return page
             tries += 1
             if tries >= self.MAX_RETRIES:
@@ -195,15 +286,28 @@ class LocalQueryRunner:
                     "capacity overflow persisted after retries "
                     "(join fan-out or group count beyond buckets)"
                 )
+            if self._active_qs is not None:
+                self._active_qs.retries += 1
             root = _scale_capacities(root, 4)
 
     def _load_table(self, scan: N.TableScanNode) -> Page:
         key = (scan.handle, scan.columns)
-        if key in self._table_cache:
-            return self._table_cache[key]
-        merged = self._load_merged_payload(scan)
-        page = stage_page(merged, dict(scan.schema))
-        self._table_cache[key] = page
+        page = self._table_cache.get(key)
+        if page is None:
+            t0 = time.perf_counter()
+            merged = self._load_merged_payload(scan)
+            page = stage_page(merged, dict(scan.schema))
+            if self.catalogs.get(scan.handle.catalog).cacheable():
+                self._table_cache[key] = page
+            if self._active_qs is not None:
+                self._active_qs.staging_ms += (
+                    time.perf_counter() - t0
+                ) * 1000.0
+        if self._active_qs is not None:
+            self._active_qs.input_rows += int(page.num_valid)
+            self._active_qs.input_bytes += sum(
+                int(b.data.nbytes) for b in page.blocks
+            )
         return page
 
     def _load_merged_payload(self, scan: N.TableScanNode) -> Dict:
@@ -222,9 +326,23 @@ class LocalQueryRunner:
 # ---------------------------------------------------------- trace helpers
 
 
-def _execute_node(node, pages, scan_ids, flags, errors) -> Page:
+def _execute_node(
+    node, pages, scan_ids, flags, errors, counters=None
+) -> Page:
+    """Execute one plan node at trace time. ``counters``, when given,
+    accumulates (node, traced num_valid, capacity) per node — the
+    EXPLAIN ANALYZE row-count instrumentation (stats.py)."""
+    out = _execute_node_inner(node, pages, scan_ids, flags, errors, counters)
+    if counters is not None:
+        counters.append((node, out.num_valid, out.capacity))
+    return out
+
+
+def _execute_node_inner(
+    node, pages, scan_ids, flags, errors, counters=None
+) -> Page:
     run = lambda n: _execute_node(  # noqa: E731
-        n, pages, scan_ids, flags, errors
+        n, pages, scan_ids, flags, errors, counters
     )
 
     if isinstance(node, (N.TableScanNode, N.RemoteSourceNode)):

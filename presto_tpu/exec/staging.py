@@ -95,5 +95,8 @@ class CatalogManager:
             raise KeyError(f"catalog not found: {name}")
         return self._catalogs[name]
 
+    def has(self, name: str) -> bool:
+        return name in self._catalogs
+
     def names(self):
         return sorted(self._catalogs)

@@ -1,0 +1,120 @@
+"""Query/operator statistics tree.
+
+Reference parity: the OperatorStats -> PipelineStats -> TaskStats ->
+StageStats -> QueryStats rollup that presto builds into every runtime
+object and exposes at ``GET /v1/query/{id}`` and in EXPLAIN ANALYZE
+(SURVEY.md §5.1).
+
+TPU-first redesign: a whole plan (or plan fragment) compiles to ONE XLA
+program, so there is no per-operator wall-clock to sample — XLA fuses
+across operator boundaries on purpose. What the device program *can*
+report exactly is per-plan-node output row counts (``num_valid`` of
+every intermediate page), traced as extra program outputs. Host-side
+phase timings (plan / stage / compile+execute / gather) plus those
+per-node row counts form the stats tree; whole-program device time is
+attributed to the fragment, as ``jax.profiler`` traces attribute it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class PlanNodeStats:
+    """Per-plan-node runtime stats (reference: OperatorStats)."""
+
+    node_id: int
+    label: str
+    output_rows: int = -1  # -1: not yet measured
+    output_capacity: int = -1  # static bucket the rows sat in
+
+
+@dataclasses.dataclass
+class QueryStats:
+    """One query's stats rollup (reference: QueryStats / QueryInfo)."""
+
+    query_id: str
+    sql: str
+    state: str = "QUEUED"  # QUEUED|PLANNING|RUNNING|FINISHED|FAILED
+    error: Optional[str] = None
+    create_time: float = 0.0
+    end_time: float = 0.0
+    planning_ms: float = 0.0
+    staging_ms: float = 0.0  # host->HBM page staging
+    execution_ms: float = 0.0  # device program (incl. compile on miss)
+    compile_cache_hit: bool = True
+    retries: int = 0  # capacity-overflow re-runs
+    input_rows: int = 0
+    input_bytes: int = 0
+    output_rows: int = 0
+    node_stats: List[PlanNodeStats] = dataclasses.field(default_factory=list)
+
+    @property
+    def elapsed_ms(self) -> float:
+        end = self.end_time or time.time()
+        return (end - self.create_time) * 1000.0
+
+
+class QueryHistory:
+    """Process-wide registry of running + finished queries; backs the
+    ``system.runtime.queries`` catalog table (reference:
+    presto-system's runtime.queries, SURVEY.md §5.5)."""
+
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._queries: Dict[str, QueryStats] = {}
+        self._ids = itertools.count(1)
+
+    def begin(self, sql: str) -> QueryStats:
+        with self._lock:
+            qid = f"q_{next(self._ids)}"
+            qs = QueryStats(
+                query_id=qid, sql=sql, state="PLANNING",
+                create_time=time.time(),
+            )
+            self._queries[qid] = qs
+            while len(self._queries) > self._capacity:
+                self._queries.pop(next(iter(self._queries)))
+            return qs
+
+    def finish(self, qs: QueryStats, error: Optional[str] = None) -> None:
+        qs.end_time = time.time()
+        qs.state = "FAILED" if error else "FINISHED"
+        qs.error = error
+
+    def snapshot(self) -> List[QueryStats]:
+        with self._lock:
+            return list(self._queries.values())
+
+
+def node_label(node) -> str:
+    from presto_tpu.exec.explain import _describe
+
+    return _describe(node)
+
+
+def collect_node_stats(
+    root, counts: List[Tuple[object, int, int]]
+) -> List[PlanNodeStats]:
+    """Pair trace-time (node, rows, capacity) records with walk ids."""
+    from presto_tpu.plan import nodes as N
+
+    ids = {id(n): i for i, n in enumerate(N.walk(root))}
+    out = []
+    for node, rows, cap in counts:
+        out.append(
+            PlanNodeStats(
+                node_id=ids.get(id(node), -1),
+                label=node_label(node),
+                output_rows=rows,
+                output_capacity=cap,
+            )
+        )
+    out.sort(key=lambda s: s.node_id)
+    return out

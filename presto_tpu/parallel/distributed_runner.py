@@ -205,22 +205,32 @@ class DistributedQueryRunner(LocalQueryRunner):
     # -------------------------------------------------- sharded staging
 
     def _load_table_sharded(self, scan: N.TableScanNode) -> Page:
+        from presto_tpu.connectors.spi import payload_len
+
         key = (scan.handle, scan.columns, self.n)
-        if key in self._shard_cache:
-            return self._shard_cache[key]
-        merged = self._load_merged_payload(scan)
-        first = next(iter(merged.values()))
-        total = len(first.ids) if hasattr(first, "ids") else len(first)
-        chunk = max(_ceil_div(total, self.n), 1)
-        shard_cap = bucket_capacity(chunk)
-        schema = dict(scan.schema)
-        shard_pages = []
-        for i in range(self.n):
-            lo, hi = min(i * chunk, total), min((i + 1) * chunk, total)
-            payload = {c: _slice_col(v, lo, hi) for c, v in merged.items()}
-            shard_pages.append(stage_page(payload, schema, shard_cap))
-        table = _stack_shards(shard_pages)
-        self._shard_cache[key] = table
+        table = self._shard_cache.get(key)
+        total = None
+        if table is None:
+            merged = self._load_merged_payload(scan)
+            total = payload_len(next(iter(merged.values())))
+            chunk = max(_ceil_div(total, self.n), 1)
+            shard_cap = bucket_capacity(chunk)
+            schema = dict(scan.schema)
+            shard_pages = []
+            for i in range(self.n):
+                lo, hi = min(i * chunk, total), min((i + 1) * chunk, total)
+                payload = {
+                    c: _slice_col(v, lo, hi) for c, v in merged.items()
+                }
+                shard_pages.append(stage_page(payload, schema, shard_cap))
+            table = _stack_shards(shard_pages)
+            if self.catalogs.get(scan.handle.catalog).cacheable():
+                self._shard_cache[key] = table
+        if self._active_qs is not None:
+            self._active_qs.input_rows += int(np.sum(np.asarray(table.num_valid)))
+            self._active_qs.input_bytes += sum(
+                int(b.data.nbytes) for b in table.blocks
+            )
         return table
 
     # -------------------------------------- distribution-aware execution

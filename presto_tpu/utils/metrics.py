@@ -1,0 +1,122 @@
+"""Process metrics registry.
+
+Reference parity: airlift's ``@Managed`` JMX stats beans — CounterStat,
+TimeStat, DistributionStat — exported everywhere in presto and made
+SQL-able by the jmx connector (SURVEY.md §5.5). TPU equivalent: a plain
+registry exported as Prometheus text and as ``system.runtime.metrics``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Tuple
+
+
+class CounterStat:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def update(self, n: int = 1) -> None:
+        with self._lock:
+            self.total += n
+
+    def values(self) -> Dict[str, float]:
+        return {"total": float(self.total)}
+
+
+class DistributionStat:
+    """Streaming count/sum/min/max/mean (reference keeps decaying
+    histograms; a round-1 simplification documented here)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+
+    def values(self) -> Dict[str, float]:
+        mean = self.sum / self.count if self.count else 0.0
+        return {
+            "count": float(self.count),
+            "sum": self.sum,
+            "min": self.min if self.count else 0.0,
+            "max": self.max if self.count else 0.0,
+            "mean": mean,
+        }
+
+
+class TimeStat(DistributionStat):
+    """Durations in seconds; ``time()`` is a context manager."""
+
+    def time(self):
+        stat = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                stat.add(time.perf_counter() - self._t0)
+                return False
+
+        return _Timer()
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str) -> CounterStat:
+        return self._get(name, CounterStat)
+
+    def timer(self, name: str) -> TimeStat:
+        return self._get(name, TimeStat)
+
+    def distribution(self, name: str) -> DistributionStat:
+        return self._get(name, DistributionStat)
+
+    def _get(self, name, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} is {type(m).__name__}")
+            return m
+
+    def snapshot(self) -> List[Tuple[str, str, float]]:
+        """(name.field, kind, value) rows for system.runtime.metrics."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = []
+        for name, m in items:
+            kind = type(m).__name__
+            for field, v in m.values().items():
+                out.append((f"{name}.{field}", kind, v))
+        return sorted(out)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of every metric."""
+        lines = []
+        for name, _kind, v in self.snapshot():
+            metric = name.replace(".", "_").replace("-", "_")
+            lines.append(f"presto_tpu_{metric} {v}")
+        return "\n".join(lines) + "\n"
+
+
+#: process-wide default registry (reference: the JMX MBean server)
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,136 @@
+"""Stats tree / EXPLAIN ANALYZE / system catalog / metrics registry.
+
+Reference parity: QueryStats rollup + EXPLAIN ANALYZE inline stats
+(SURVEY.md §5.1), system.runtime tables + jmx-style metrics (§5.5).
+"""
+
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.utils.metrics import (
+    CounterStat,
+    DistributionStat,
+    MetricsRegistry,
+    REGISTRY,
+    TimeStat,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def test_query_history_records_stats(runner):
+    res = runner.execute(
+        "select count(*) as c from tpch.tiny.region"
+    )
+    assert res.rows() == [(5,)]
+    hist = runner.history.snapshot()
+    q = [h for h in hist if "region" in h.sql][-1]
+    assert q.state == "FINISHED"
+    assert q.output_rows == 1
+    assert q.input_rows == 5
+    assert q.planning_ms > 0
+    assert q.execution_ms > 0
+    assert q.error is None
+
+
+def test_query_history_records_failure(runner):
+    with pytest.raises(Exception):
+        runner.execute("select * from tpch.tiny.nonexistent_table")
+    q = runner.history.snapshot()[-1]
+    assert q.state == "FAILED"
+    assert q.error
+
+
+def test_explain_analyze_row_counts(runner):
+    res = runner.execute(
+        "explain analyze select l_returnflag, count(*) c "
+        "from tpch.tiny.lineitem group by l_returnflag"
+    )
+    text = "\n".join(r[0] for r in res.rows())
+    assert "Aggregate" in text
+    assert "[rows: 3" in text  # 3 distinct return flags
+    assert "TableScan" in text
+    assert "EXPLAIN ANALYZE:" in text
+
+
+def test_system_runtime_queries(runner):
+    runner.execute("select count(*) as c from tpch.tiny.nation")
+    res = runner.execute(
+        "select query_id, state, output_rows from system.runtime.queries "
+        "where state = 'FINISHED'"
+    )
+    rows = res.rows()
+    assert len(rows) >= 1
+    assert all(r[1] == "FINISHED" for r in rows)
+
+
+def test_system_tables_are_live_not_cached(runner):
+    n1 = runner.execute(
+        "select count(*) as c from system.runtime.queries"
+    ).rows()[0][0]
+    runner.execute("select count(*) as c from tpch.tiny.nation")
+    n2 = runner.execute(
+        "select count(*) as c from system.runtime.queries"
+    ).rows()[0][0]
+    assert n2 > n1  # new queries visible: pages must not be cached
+
+
+def test_repeat_query_still_reports_input_rows(runner):
+    runner.execute("select count(*) as c from tpch.tiny.region")
+    runner.execute("select count(*) as c from tpch.tiny.region")
+    q = [h for h in runner.history.snapshot() if "region" in h.sql][-1]
+    assert q.input_rows == 5  # cache hit must still attribute input
+
+
+def test_system_runtime_nodes(runner):
+    rows = runner.execute(
+        "select node_id, coordinator from system.runtime.nodes"
+    ).rows()
+    assert len(rows) == 1
+    assert rows[0][1] is True
+
+
+def test_system_metadata_catalogs(runner):
+    rows = runner.execute(
+        "select catalog_name from system.metadata.catalogs"
+    ).rows()
+    names = {r[0] for r in rows}
+    assert {"tpch", "system"} <= names
+
+
+def test_system_runtime_metrics_sqlable(runner):
+    runner.execute("select count(*) as c from tpch.tiny.region")
+    rows = runner.execute(
+        "select name, value from system.runtime.metrics "
+        "where name = 'queries.finished.total'"
+    ).rows()
+    assert len(rows) == 1
+    assert rows[0][1] >= 1.0
+
+
+def test_metrics_registry_primitives():
+    reg = MetricsRegistry()
+    reg.counter("c").update(3)
+    reg.counter("c").update()
+    assert reg.counter("c").total == 4
+    d = reg.distribution("d")
+    for v in (1.0, 2.0, 3.0):
+        d.add(v)
+    assert d.values()["mean"] == 2.0
+    with reg.timer("t").time():
+        pass
+    assert reg.timer("t").count == 1
+    text = reg.render_prometheus()
+    assert "presto_tpu_c_total 4.0" in text
+    with pytest.raises(TypeError):
+        reg.timer("c")
+
+
+def test_registry_is_process_wide():
+    REGISTRY.counter("test.probe").update()
+    assert any(
+        n.startswith("test.probe") for n, _, _ in REGISTRY.snapshot()
+    )
