@@ -57,7 +57,10 @@ def render_plan(node: N.PlanNode, indent: int = 0, annot=None) -> str:
     desc = _describe(node)
     if annot is not None and id(node) in annot:
         rows, cap = annot[id(node)]
-        desc += f"  [rows: {rows}, capacity: {cap}]"
+        if cap is None:
+            desc += f"  [rows: {rows}, host root stage]"
+        else:
+            desc += f"  [rows: {rows}, capacity: {cap}]"
     lines = ["    " * indent + "- " + desc]
     for c in node.children():
         lines.append(render_plan(c, indent + 1, annot))
@@ -65,6 +68,8 @@ def render_plan(node: N.PlanNode, indent: int = 0, annot=None) -> str:
 
 
 def explain_text(runner, stmt: ast.Explain) -> str:
+    from presto_tpu.exec.host_ops import peel_host_ops
+
     plan = plan_statement(stmt.statement, runner.catalogs, runner.session)
     root = prune_columns(plan.root)
     if not stmt.analyze:
@@ -72,16 +77,22 @@ def explain_text(runner, stmt: ast.Explain) -> str:
     # EXPLAIN ANALYZE: re-run with per-node row counters traced as extra
     # program outputs (stats.py); render rows inline like the reference.
     t0 = time.perf_counter()
-    result, node_stats = runner.execute_plan_analyzed(plan)
+    result, node_stats, host_rows = runner.execute_plan_analyzed(plan)
     elapsed = time.perf_counter() - t0
-    # node ids were assigned on the (possibly capacity-scaled) executed
-    # root; match to our tree by walk order, which scaling preserves
+    # mirror the runner's host-root-stage peel so walk indices of the
+    # device subtree line up; peeled nodes get host-side row counts
+    droot = root
+    host_ops = []
+    if runner.session.get("host_root_stage"):
+        droot, host_ops = peel_host_ops(root)
     executed_order = {s.node_id: s for s in node_stats}
     annot = {}
-    for i, n in enumerate(N.walk(root)):
+    for i, n in enumerate(N.walk(droot)):
         s = executed_order.get(i)
         if s is not None:
             annot[id(n)] = (s.output_rows, s.output_capacity)
+    for node, rows in zip(reversed(host_ops), host_rows):
+        annot[id(node)] = (rows, None)
     text = render_plan(root, annot=annot)
     n_rows = len(result.rows())
     text += (

@@ -32,7 +32,6 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu import expr as E
 from presto_tpu.connectors import create_connector
-from presto_tpu.connectors.spi import payload_len
 from presto_tpu.exec.staging import CatalogManager, bucket_capacity, stage_page
 from presto_tpu.ops import (
     filter_project,
@@ -160,12 +159,19 @@ class LocalQueryRunner:
         return result
 
     def execute_plan(self, plan: Plan, qs=None) -> QueryResult:
+        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
+
         prev, self._active_qs = self._active_qs, qs
         try:
             root = self._bind_params(plan)
             root = prune_columns(root)
+            host_ops: List[N.PlanNode] = []
+            if self.session.get("host_root_stage"):
+                root, host_ops = peel_host_ops(root)
             t0 = time.perf_counter()
             page = self._run(root)
+            if host_ops:
+                page = apply_host_ops(page, host_ops)
             if qs is not None:
                 qs.execution_ms += (time.perf_counter() - t0) * 1000.0
                 qs.output_rows = int(page.num_valid)
@@ -174,22 +180,32 @@ class LocalQueryRunner:
         return QueryResult(plan.output_names, page)
 
     def execute_plan_analyzed(self, plan: Plan):
-        """EXPLAIN ANALYZE support: run the plan with per-node row
-        counters traced as extra program outputs; returns
-        (QueryResult, List[PlanNodeStats]). Single-device trace path —
-        counts are identical under distribution."""
+        """EXPLAIN ANALYZE support: run the plan exactly as execute_plan
+        does (including the host root stage peel) with per-node row
+        counters traced as extra program outputs. Returns
+        (QueryResult, List[PlanNodeStats] for the device tree,
+        List[int] rows-after-each-host-op innermost-first).
+        Single-device trace path — counts are identical under
+        distribution."""
+        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
         from presto_tpu.exec.stats import collect_node_stats
 
         root = self._bind_params(plan)
         root = prune_columns(root)
+        host_ops: List[N.PlanNode] = []
+        if self.session.get("host_root_stage"):
+            root, host_ops = peel_host_ops(root)
         scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
         pages = [self._load_table(s) for s in scans]
         stats_cell: List = []
         page = LocalQueryRunner._run_with_pages(
             self, root, scans, pages, stats_out=stats_cell
         )
-        stats = collect_node_stats(*stats_cell)
-        return QueryResult(plan.output_names, page), stats
+        host_rows: List[int] = []
+        if host_ops:
+            page = apply_host_ops(page, host_ops, rows_out=host_rows)
+        stats = collect_node_stats(stats_cell)
+        return QueryResult(plan.output_names, page), stats, host_rows
 
     # ------------------------------------------------- params (subqueries)
 
@@ -224,13 +240,16 @@ class LocalQueryRunner:
         """Run the compiled whole-plan program, retrying on capacity
         overflow. With ``stats_out``, per-node row counters are traced as
         extra outputs (EXPLAIN ANALYZE); stats_out receives
-        (executed_root, [(node, rows, capacity), ...])."""
+        (walk_id, label, rows, capacity) records."""
         scan_ids = {id(s): i for i, s in enumerate(scans)}
         analyzed = stats_out is not None
 
         tries = 0
         while True:
-            entry = self._compiled.get((root, analyzed))
+            # key by structural fingerprint, not object identity: every
+            # execute_plan rebuilds the tree (prune/bind), and a retrace
+            # per call would redo XLA cache lookups costing seconds
+            entry = self._compiled.get((root.fingerprint(), analyzed))
             if entry is None:
                 if self._active_qs is not None:
                     self._active_qs.compile_cache_hit = False
@@ -254,29 +273,45 @@ class LocalQueryRunner:
                     _m.extend(m for m, _ in errors)
                     _n.clear()
                     if counters is not None:
-                        _n.extend((node, cap) for node, _, cap in counters)
+                        from presto_tpu.exec.stats import node_label
+
+                        walk_ids = {
+                            id(n): i for i, n in enumerate(N.walk(_root))
+                        }
+                        _n.extend(
+                            (walk_ids.get(id(node), -1), node_label(node), cap)
+                            for node, _, cap in counters
+                        )
                         cnts = [c for _, c, _ in counters]
                     else:
                         cnts = []
-                    return out, flags, [e for _, e in errors], cnts
+                    # stack control outputs: ONE device->host fetch per
+                    # run (each separate scalar fetch costs a full relay
+                    # round trip, ~100ms on tunneled TPU)
+                    return (
+                        out,
+                        _stack_bools(flags),
+                        _stack_bools([e for _, e in errors]),
+                        _stack_i32(cnts),
+                    )
 
                 entry = (jax.jit(trace), msgs_cell, nodes_cell)
-                self._compiled[(root, analyzed)] = entry
+                self._compiled[(root.fingerprint(), analyzed)] = entry
             fn, msgs_cell, nodes_cell = entry
-            page, flags, error_flags, cnts = fn(pages)
-            for msg, flag in zip(msgs_cell, error_flags):
+            page, flags_arr, err_arr, cnt_arr = fn(pages)
+            flags_np, err_np, cnt_np = jax.device_get(
+                [flags_arr, err_arr, cnt_arr]
+            )
+            for msg, flag in zip(msgs_cell, err_np):
                 if bool(flag):
                     raise ExecutionError(msg)
-            if not any(bool(f) for f in flags):
+            if not flags_np.any():
                 if analyzed:
                     stats_out.clear()
                     stats_out.extend(
-                        (
-                            root,
-                            [
-                                (node, int(c), cap)
-                                for (node, cap), c in zip(nodes_cell, cnts)
-                            ],
+                        (walk_id, label, int(c), cap)
+                        for (walk_id, label, cap), c in zip(
+                            nodes_cell, cnt_np
                         )
                     )
                 return page
@@ -324,6 +359,18 @@ class LocalQueryRunner:
 
 
 # ---------------------------------------------------------- trace helpers
+
+
+def _stack_bools(xs: List) -> jnp.ndarray:
+    if not xs:
+        return jnp.zeros((0,), jnp.bool_)
+    return jnp.stack([jnp.asarray(x, jnp.bool_).reshape(()) for x in xs])
+
+
+def _stack_i32(xs: List) -> jnp.ndarray:
+    if not xs:
+        return jnp.zeros((0,), jnp.int32)
+    return jnp.stack([jnp.asarray(x, jnp.int32).reshape(()) for x in xs])
 
 
 def _execute_node(

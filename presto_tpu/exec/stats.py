@@ -100,21 +100,18 @@ def node_label(node) -> str:
 
 
 def collect_node_stats(
-    root, counts: List[Tuple[object, int, int]]
+    records: List[Tuple[int, str, int, int]]
 ) -> List[PlanNodeStats]:
-    """Pair trace-time (node, rows, capacity) records with walk ids."""
-    from presto_tpu.plan import nodes as N
+    """Build PlanNodeStats from (walk_id, label, rows, capacity) records.
 
-    ids = {id(n): i for i, n in enumerate(N.walk(root))}
-    out = []
-    for node, rows, cap in counts:
-        out.append(
-            PlanNodeStats(
-                node_id=ids.get(id(node), -1),
-                label=node_label(node),
-                output_rows=rows,
-                output_capacity=cap,
-            )
+    walk ids (not node identities) key the records: the compiled-program
+    cache outlives any one plan tree's objects, so identity matching
+    would break on every cache hit."""
+    out = [
+        PlanNodeStats(
+            node_id=w, label=label, output_rows=rows, output_capacity=cap
         )
+        for w, label, rows, cap in records
+    ]
     out.sort(key=lambda s: s.node_id)
     return out

@@ -4,15 +4,25 @@ Reference parity: ``HashAggregationOperator`` + ``GroupByHash`` +
 ``InMemoryHashAggregationBuilder`` and the annotation-generated
 accumulators (SURVEY.md §2.1 "Operators", "Function registry").
 
-TPU-first redesign (SURVEY.md §7 step 3): instead of an open-addressing
-hash table mutated row-at-a-time, grouping is *sort-based* — a stable
-multi-key sort brings equal keys together, group boundaries fall out of a
-vectorized neighbour-compare, and every accumulator is a segmented
-reduction (``jax.ops.segment_*``), which XLA lowers to fast batched
-scatter-reduces. Shapes stay static: the planner supplies ``max_groups``
-(the output capacity bucket); kernels report overflow instead of
-reallocating, and the host re-runs at a bigger bucket on overflow
-(SURVEY.md §7 "Hard parts: dynamic shapes").
+TPU-first redesign (SURVEY.md §7 step 3), informed by v5e microbenchmarks
+(scatter-adds — XLA's lowering of ``jax.ops.segment_*`` — run ~0.6s per
+call over 8M rows regardless of segment count; sorts are fast at runtime
+but cost minutes of compile; one-hot reduction and cumsum are ~10ms):
+
+- **one-hot path**: when every group key has a statically *provable*
+  small domain (dict-encoded strings, booleans) and the composite domain
+  is tiny, each accumulator is a masked broadcast-reduce against the
+  one-hot key matrix — XLA fuses it into a single pass, no sort, no
+  scatter. TPU analogue of the reference's array-based
+  ``BigintGroupByHash`` fast path.
+- **sorted path**: general keys — one stable multi-key sort brings equal
+  keys together; every accumulator is then a *scan*, not a scatter:
+  sums/counts are inclusive-cumsum differences at group boundaries,
+  min/max are segmented associative scans read at group ends.
+- Shapes stay static: the planner supplies ``max_groups`` (the output
+  capacity bucket); kernels report overflow instead of reallocating, and
+  the host re-runs at a bigger bucket on overflow (SURVEY.md §7 "Hard
+  parts: dynamic shapes").
 
 Aggregate functions: count(*), count(x), sum, min, max, avg. Null
 semantics match SQL: aggregates skip nulls; count(*) counts rows;
@@ -24,6 +34,13 @@ Result types: sum(int)->bigint, sum(decimal(p,s))->decimal(18,s) exact on
 int64, sum(double)->double, count->bigint, avg->double (deviation: the
 reference returns decimal for decimal inputs; exact decimal avg lands
 with int128), min/max preserve the input type.
+
+Exactness note: decimal/bigint sums on the sorted path are inclusive
+int64 cumsums differenced at boundaries — exact unless the *running
+total over the whole page* exceeds int64, a stricter-than-SQL bound
+(documented deviation; the reference overflows per-group). Float sums
+use per-segment scans (not the cumsum trick) so no cross-group
+cancellation is introduced.
 """
 
 from __future__ import annotations
@@ -33,6 +50,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from presto_tpu import types as T
 from presto_tpu.expr import Expr, ExprLowerer
@@ -65,6 +83,32 @@ class AggCall:
         raise NotImplementedError(f"aggregate {self.func}")
 
 
+#: one-hot path ceiling: cost is O(rows * domain) fused on the VPU;
+#: 256 keeps that under ~2G lane-ops for 8M-row pages
+_ONEHOT_MAX_SEGMENTS = 256
+
+
+def _static_domain(e: Expr, lowerer: ExprLowerer) -> Optional[int]:
+    """Provable key-domain size, or None when unbounded.
+
+    Only *proofs* qualify (collisions would be wrong answers): dictionary
+    ids are bounded by the static dictionary length; booleans by 2.
+    Range-bounded ints via connector stats are estimates, not proofs, so
+    they do NOT qualify.
+    """
+    if e.dtype.is_string:
+        try:
+            dic = lowerer.dictionary_of(e)
+        except NotImplementedError:
+            return None
+        if dic is None:
+            return None
+        return len(dic.values)
+    if e.dtype.name == "boolean":
+        return 2
+    return None
+
+
 def hash_aggregate(
     page: Page,
     group_keys: Sequence[Tuple[str, Expr]],
@@ -77,7 +121,7 @@ def hash_aggregate(
     when the data had more than ``max_groups`` groups (host must re-run
     with a larger bucket; surplus groups were dropped).
 
-    Global aggregation (no keys) is the ``max_groups=1`` degenerate case.
+    Global aggregation (no keys) is the plain-reduction degenerate case.
     """
     live = page.row_mask()
     lowerer = ExprLowerer(page)
@@ -86,54 +130,100 @@ def hash_aggregate(
         return _global_aggregate(page, aggs, live, lowerer)
 
     keys = [(name, *lowerer.eval(e), e) for name, e in group_keys]
-    order = sort_order(
-        [(d, v, e.dtype) for _, d, v, e in keys], live
-    )
-    live_s = live[order]
-    keys_s = [
-        (name, d[order], None if v is None else v[order], e)
-        for name, d, v, e in keys
-    ]
-    bnd = boundaries([(d, v) for _, d, v, _ in keys_s], live_s)
-    # group id per sorted row; dead rows -> max_groups (dropped by the
-    # out-of-range scatter semantics of segment_*)
-    gid = jnp.cumsum(bnd.astype(jnp.int32)) - 1
-    gid = jnp.where(live_s, gid, max_groups)
-    gid = jnp.where(gid >= max_groups, max_groups, gid)
-    num_groups = jnp.sum(bnd).astype(jnp.int32)
+
+    domains = [_static_domain(e, lowerer) for _, _, _, e in keys]
+    if all(d is not None for d in domains):
+        slots = [
+            d + (1 if v is not None else 0)
+            for d, (_, _, v, _) in zip(domains, keys)
+        ]
+        nseg = 1
+        for s in slots:
+            nseg *= max(s, 1)
+        if 0 < nseg <= _ONEHOT_MAX_SEGMENTS:
+            return _onehot_aggregate(
+                page, keys, domains, slots, nseg, aggs, max_groups,
+                live, lowerer,
+            )
+
+    return _sorted_aggregate(page, keys, aggs, max_groups, live, lowerer)
+
+
+# --------------------------------------------------------- one-hot path
+
+
+def _onehot_aggregate(
+    page: Page,
+    keys,
+    domains: List[int],
+    slots: List[int],
+    nseg: int,
+    aggs: Sequence[AggCall],
+    max_groups: int,
+    live: jnp.ndarray,
+    lowerer: ExprLowerer,
+) -> Tuple[Page, jnp.ndarray]:
+    """Sort-free, scatter-free aggregation over a tiny provable domain.
+
+    Strides assign the first key the most significant position, so
+    ascending segment order is lexicographic in the keys (dict ids are
+    order-preserving); a key's NULL slot is its largest id (nulls group
+    last, matching the sorted path's NULLS LAST grouping order).
+    """
+    cap = page.capacity
+
+    strides = []
+    s = 1
+    for sl in reversed(slots):
+        strides.append(s)
+        s *= sl
+    strides = list(reversed(strides))
+
+    gid = jnp.zeros((cap,), jnp.int32)
+    for (name, d, v, e), dom, stride in zip(keys, domains, strides):
+        comp = d.astype(jnp.int32)
+        if v is not None:
+            comp = jnp.where(v, comp, dom)  # null slot = largest id
+        gid = gid + comp * jnp.int32(stride)
+    gid = jnp.where(live, gid, nseg)  # dead rows match no one-hot column
+
+    oh = gid[:, None] == jnp.arange(nseg, dtype=jnp.int32)[None, :]
+
+    counts = jnp.sum(oh, axis=0)  # (nseg,) live rows per group
+    occupied = counts > 0
+    num_groups = jnp.sum(occupied).astype(jnp.int32)
     overflow = num_groups > max_groups
 
-    cap = page.capacity
-    positions = jnp.arange(cap, dtype=jnp.int32)
-    first_pos = jax.ops.segment_min(
-        positions, gid, num_segments=max_groups + 1
-    )[:max_groups]
-    first_pos = jnp.where(
-        jnp.arange(max_groups) < jnp.minimum(num_groups, max_groups),
-        first_pos,
-        0,
-    )
+    # occupied segments compacted to the front, ascending (lexicographic)
+    (sel,) = jnp.nonzero(occupied, size=max_groups, fill_value=nseg)
+    safe_sel = jnp.minimum(sel, nseg - 1).astype(jnp.int32)
 
     names: List[str] = []
     blocks: List[Block] = []
-    for name, d, v, e in keys_s:
-        names.append(name)
+    for (name, d, v, e), dom, stride, sl in zip(
+        keys, domains, strides, slots
+    ):
+        comp = (safe_sel // jnp.int32(stride)) % jnp.int32(sl)
+        valid = None if v is None else (comp != dom)
+        data = comp.astype(d.dtype)
         dictionary = None
         if e.dtype.is_string:
             dictionary = lowerer.dictionary_of(e)
+        names.append(name)
         blocks.append(
-            Block(
-                data=d[first_pos],
-                valid=None if v is None else v[first_pos],
-                dtype=e.dtype,
-                dictionary=dictionary,
-            )
+            Block(data=data, valid=valid, dtype=e.dtype, dictionary=dictionary)
         )
 
     for agg in aggs:
-        blk = _segment_agg(agg, page, order, live_s, gid, max_groups, lowerer)
+        full = _onehot_one_agg(agg, page, oh, live, counts, lowerer)
+        blocks.append(
+            dataclasses.replace(
+                full,
+                data=full.data[safe_sel],
+                valid=None if full.valid is None else full.valid[safe_sel],
+            )
+        )
         names.append(agg.out_name)
-        blocks.append(blk)
 
     out = Page(
         blocks=tuple(blocks),
@@ -143,73 +233,63 @@ def hash_aggregate(
     return out, overflow
 
 
-def _segment_agg(
+def _onehot_one_agg(
     agg: AggCall,
     page: Page,
-    order: jnp.ndarray,
-    live_s: jnp.ndarray,
-    gid: jnp.ndarray,
-    max_groups: int,
+    oh: jnp.ndarray,  # (cap, nseg) bool; dead rows all-False
+    live: jnp.ndarray,
+    counts: jnp.ndarray,  # (nseg,) live rows per group
     lowerer: ExprLowerer,
 ) -> Block:
-    nseg = max_groups + 1  # +1 absorbs dead rows routed to max_groups
-    rt = agg.result_type()
-
+    """One aggregate as full (nseg,) arrays via masked broadcast-reduce
+    (fuses into one pass; no scatter)."""
     if agg.func == "count_star":
-        data = jax.ops.segment_sum(
-            live_s.astype(jnp.int64), gid, num_segments=nseg
-        )[:max_groups]
-        return Block(data=data, valid=None, dtype=T.BIGINT)
+        return Block(
+            data=counts.astype(jnp.int64), valid=None, dtype=T.BIGINT
+        )
 
     d, v = lowerer.eval(agg.arg)
-    d = jnp.broadcast_to(d, (page.capacity,))[order]
-    valid_s = live_s if v is None else (
-        live_s & jnp.broadcast_to(v, (page.capacity,))[order]
-    )
+    d = jnp.broadcast_to(d, (page.capacity,))
+    valid = live if v is None else (live & jnp.broadcast_to(v, live.shape))
+
+    ohv = oh & valid[:, None]
+    cnt = jnp.sum(ohv, axis=0)
 
     if agg.func == "count":
-        data = jax.ops.segment_sum(
-            valid_s.astype(jnp.int64), gid, num_segments=nseg
-        )[:max_groups]
-        return Block(data=data, valid=None, dtype=T.BIGINT)
+        return Block(data=cnt.astype(jnp.int64), valid=None, dtype=T.BIGINT)
 
-    cnt = jax.ops.segment_sum(
-        valid_s.astype(jnp.int64), gid, num_segments=nseg
-    )[:max_groups]
     group_has_value = cnt > 0
+    at = agg.arg.dtype
 
     if agg.func in ("sum", "avg"):
-        at = agg.arg.dtype
         if at.name in ("double", "real") or agg.func == "avg":
             x = d.astype(jnp.float64)
             if at.is_decimal:
                 x = x / (10 ** at.scale)
-            x = jnp.where(valid_s, x, 0.0)
-            s = jax.ops.segment_sum(x, gid, num_segments=nseg)[:max_groups]
+            s = jnp.sum(jnp.where(ohv, x[:, None], 0.0), axis=0)
             if agg.func == "avg":
-                data = s / jnp.maximum(cnt, 1)
                 return Block(
-                    data=data, valid=group_has_value, dtype=T.DOUBLE
+                    data=s / jnp.maximum(cnt, 1),
+                    valid=group_has_value,
+                    dtype=T.DOUBLE,
                 )
             return Block(data=s, valid=group_has_value, dtype=T.DOUBLE)
-        x = jnp.where(valid_s, d.astype(jnp.int64), 0)
-        s = jax.ops.segment_sum(x, gid, num_segments=nseg)[:max_groups]
-        return Block(data=s, valid=group_has_value, dtype=rt)
+        x = d.astype(jnp.int64)
+        s = jnp.sum(jnp.where(ohv, x[:, None], 0), axis=0)
+        return Block(data=s, valid=group_has_value, dtype=agg.result_type())
 
     if agg.func in ("min", "max"):
-        at = agg.arg.dtype
+        reduce = jnp.min if agg.func == "min" else jnp.max
         if at.name in ("double", "real"):
             fill = jnp.inf if agg.func == "min" else -jnp.inf
-            x = jnp.where(valid_s, d.astype(jnp.float64), fill)
-            op = jax.ops.segment_min if agg.func == "min" else jax.ops.segment_max
-            data = op(x, gid, num_segments=nseg)[:max_groups]
+            x = d.astype(jnp.float64)
+            data = reduce(jnp.where(ohv, x[:, None], fill), axis=0)
             data = data.astype(at.jnp_dtype)
         else:
             info = jnp.iinfo(jnp.int64)
             fill = info.max if agg.func == "min" else info.min
-            x = jnp.where(valid_s, d.astype(jnp.int64), fill)
-            op = jax.ops.segment_min if agg.func == "min" else jax.ops.segment_max
-            data = op(x, gid, num_segments=nseg)[:max_groups]
+            x = d.astype(jnp.int64)
+            data = reduce(jnp.where(ohv, x[:, None], fill), axis=0)
             data = data.astype(at.jnp_dtype)
         dictionary = None
         if at.is_string:
@@ -221,23 +301,196 @@ def _segment_agg(
     raise NotImplementedError(f"aggregate {agg.func}")
 
 
+# ---------------------------------------------------------- sorted path
+
+
+def _segmented_scan_reduce(
+    x: jnp.ndarray, bnd: jnp.ndarray, op
+) -> jnp.ndarray:
+    """Inclusive segmented reduction scan: position p holds op-reduction
+    of its segment's values up to p; segments restart where ``bnd``.
+    Read at segment END positions for per-segment totals."""
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+
+    vals, _ = lax.associative_scan(combine, (x, bnd))
+    return vals
+
+
+def _group_spans(
+    bnd: jnp.ndarray, max_groups: int, cap: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(starts, ends) sorted-space positions per group (gather-safe).
+
+    ``ends[i] = starts[i+1] - 1`` with cap-1 for the final/fill groups —
+    safe because rows past the live prefix carry neutral values for every
+    accumulator (0 for cumsum deltas, +-inf fills for min/max scans).
+    """
+    (starts,) = jnp.nonzero(bnd, size=max_groups, fill_value=cap)
+    nxt = jnp.concatenate(
+        [starts[1:], jnp.full((1,), cap, starts.dtype)]
+    )
+    ends = jnp.clip(nxt - 1, 0, cap - 1)
+    safe_starts = jnp.minimum(starts, cap - 1).astype(jnp.int32)
+    return safe_starts, ends.astype(jnp.int32)
+
+
+def _sorted_aggregate(
+    page: Page,
+    keys,
+    aggs: Sequence[AggCall],
+    max_groups: int,
+    live: jnp.ndarray,
+    lowerer: ExprLowerer,
+) -> Tuple[Page, jnp.ndarray]:
+    cap = page.capacity
+    order = sort_order(
+        [(d, v, e.dtype) for _, d, v, e in keys], live
+    )
+    live_s = live[order]
+    keys_s = [
+        (name, d[order], None if v is None else v[order], e)
+        for name, d, v, e in keys
+    ]
+    bnd = boundaries([(d, v) for _, d, v, _ in keys_s], live_s)
+    num_groups = jnp.sum(bnd).astype(jnp.int32)
+    overflow = num_groups > max_groups
+
+    starts, ends = _group_spans(bnd, max_groups, cap)
+
+    names: List[str] = []
+    blocks: List[Block] = []
+    for name, d, v, e in keys_s:
+        names.append(name)
+        dictionary = None
+        if e.dtype.is_string:
+            dictionary = lowerer.dictionary_of(e)
+        blocks.append(
+            Block(
+                data=d[starts],
+                valid=None if v is None else v[starts],
+                dtype=e.dtype,
+                dictionary=dictionary,
+            )
+        )
+
+    for agg in aggs:
+        blk = _sorted_one_agg(
+            agg, page, order, live_s, bnd, starts, ends, lowerer
+        )
+        names.append(agg.out_name)
+        blocks.append(blk)
+
+    out = Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.minimum(num_groups, max_groups).astype(jnp.int32),
+        names=tuple(names),
+    )
+    return out, overflow
+
+
+def _cumsum_span(
+    w: jnp.ndarray, starts: jnp.ndarray, ends: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-group totals of ``w`` via inclusive cumsum differenced over
+    [start, end] spans (no scatter)."""
+    c = jnp.cumsum(w)
+    return c[ends] - c[starts] + w[starts]
+
+
+def _sorted_one_agg(
+    agg: AggCall,
+    page: Page,
+    order: jnp.ndarray,
+    live_s: jnp.ndarray,
+    bnd: jnp.ndarray,
+    starts: jnp.ndarray,
+    ends: jnp.ndarray,
+    lowerer: ExprLowerer,
+) -> Block:
+    rt = agg.result_type()
+
+    if agg.func == "count_star":
+        data = _cumsum_span(live_s.astype(jnp.int64), starts, ends)
+        return Block(data=data, valid=None, dtype=T.BIGINT)
+
+    d, v = lowerer.eval(agg.arg)
+    d = jnp.broadcast_to(d, (page.capacity,))[order]
+    valid_s = live_s if v is None else (
+        live_s & jnp.broadcast_to(v, (page.capacity,))[order]
+    )
+
+    if agg.func == "count":
+        data = _cumsum_span(valid_s.astype(jnp.int64), starts, ends)
+        return Block(data=data, valid=None, dtype=T.BIGINT)
+
+    cnt = _cumsum_span(valid_s.astype(jnp.int64), starts, ends)
+    group_has_value = cnt > 0
+
+    if agg.func in ("sum", "avg"):
+        at = agg.arg.dtype
+        if at.name in ("double", "real") or agg.func == "avg":
+            # decimal avg and double sums: SEGMENTED scan, not a global
+            # cumsum — differencing a whole-page running float total
+            # would cancel catastrophically for small late groups
+            x = d.astype(jnp.float64)
+            if at.is_decimal:
+                x = x / (10 ** at.scale)
+            x = jnp.where(valid_s, x, 0.0)
+            s = _segmented_scan_reduce(x, bnd, jnp.add)[ends]
+            if agg.func == "avg":
+                data = s / jnp.maximum(cnt, 1)
+                return Block(
+                    data=data, valid=group_has_value, dtype=T.DOUBLE
+                )
+            return Block(data=s, valid=group_has_value, dtype=T.DOUBLE)
+        x = jnp.where(valid_s, d.astype(jnp.int64), 0)
+        s = _cumsum_span(x, starts, ends)
+        return Block(data=s, valid=group_has_value, dtype=rt)
+
+    if agg.func in ("min", "max"):
+        at = agg.arg.dtype
+        op = jnp.minimum if agg.func == "min" else jnp.maximum
+        if at.name in ("double", "real"):
+            fill = jnp.inf if agg.func == "min" else -jnp.inf
+            x = jnp.where(valid_s, d.astype(jnp.float64), fill)
+            scan = _segmented_scan_reduce(x, bnd, op)
+            data = scan[ends].astype(at.jnp_dtype)
+        else:
+            info = jnp.iinfo(jnp.int64)
+            fill = info.max if agg.func == "min" else info.min
+            x = jnp.where(valid_s, d.astype(jnp.int64), fill)
+            scan = _segmented_scan_reduce(x, bnd, op)
+            data = scan[ends].astype(at.jnp_dtype)
+        dictionary = None
+        if at.is_string:
+            dictionary = lowerer.dictionary_of(agg.arg)
+        return Block(
+            data=data, valid=group_has_value, dtype=at, dictionary=dictionary
+        )
+
+    raise NotImplementedError(f"aggregate {agg.func}")
+
+
+# ---------------------------------------------------------- global path
+
+
 def _global_aggregate(
     page: Page,
     aggs: Sequence[AggCall],
     live: jnp.ndarray,
     lowerer: ExprLowerer,
 ) -> Tuple[Page, jnp.ndarray]:
-    """No GROUP BY: the max_groups=1 degenerate case of the segmented
-    path — all live rows route to segment 0. One output row always (SQL:
-    global aggregates over zero rows emit one row; sum -> NULL via the
-    empty-group validity rule, count -> 0)."""
-    gid = jnp.where(live, 0, 1)
-    order = jnp.arange(page.capacity, dtype=jnp.int32)  # identity
+    """No GROUP BY: plain masked whole-array reductions (no segments, no
+    sort, no scatter). One output row always (SQL: global aggregates over
+    zero rows emit one row; sum -> NULL via the empty-group validity
+    rule, count -> 0)."""
     names, blocks = [], []
     for agg in aggs:
-        blocks.append(
-            _segment_agg(agg, page, order, live, gid, 1, lowerer)
-        )
+        blocks.append(_global_one_agg(agg, page, live, lowerer))
         names.append(agg.out_name)
     out = Page(
         blocks=tuple(blocks),
@@ -245,3 +498,66 @@ def _global_aggregate(
         names=tuple(names),
     )
     return out, jnp.asarray(False)
+
+
+def _global_one_agg(
+    agg: AggCall, page: Page, live: jnp.ndarray, lowerer: ExprLowerer
+) -> Block:
+    def one(x):
+        return x.reshape(1)
+
+    if agg.func == "count_star":
+        return Block(
+            data=one(jnp.sum(live).astype(jnp.int64)),
+            valid=None,
+            dtype=T.BIGINT,
+        )
+
+    d, v = lowerer.eval(agg.arg)
+    d = jnp.broadcast_to(d, (page.capacity,))
+    valid = live if v is None else (live & jnp.broadcast_to(v, live.shape))
+    cnt = jnp.sum(valid).astype(jnp.int64)
+
+    if agg.func == "count":
+        return Block(data=one(cnt), valid=None, dtype=T.BIGINT)
+
+    has = one(cnt > 0)
+    at = agg.arg.dtype
+
+    if agg.func in ("sum", "avg"):
+        if at.name in ("double", "real") or agg.func == "avg":
+            x = d.astype(jnp.float64)
+            if at.is_decimal:
+                x = x / (10 ** at.scale)
+            s = jnp.sum(jnp.where(valid, x, 0.0))
+            if agg.func == "avg":
+                return Block(
+                    data=one(s / jnp.maximum(cnt, 1)),
+                    valid=has,
+                    dtype=T.DOUBLE,
+                )
+            return Block(data=one(s), valid=has, dtype=T.DOUBLE)
+        s = jnp.sum(jnp.where(valid, d.astype(jnp.int64), 0))
+        return Block(data=one(s), valid=has, dtype=agg.result_type())
+
+    if agg.func in ("min", "max"):
+        reduce = jnp.min if agg.func == "min" else jnp.max
+        if at.name in ("double", "real"):
+            fill = jnp.inf if agg.func == "min" else -jnp.inf
+            data = one(
+                reduce(jnp.where(valid, d.astype(jnp.float64), fill))
+            ).astype(at.jnp_dtype)
+        else:
+            info = jnp.iinfo(jnp.int64)
+            fill = info.max if agg.func == "min" else info.min
+            data = one(
+                reduce(jnp.where(valid, d.astype(jnp.int64), fill))
+            ).astype(at.jnp_dtype)
+        dictionary = None
+        if at.is_string:
+            dictionary = lowerer.dictionary_of(agg.arg)
+        return Block(
+            data=data, valid=has, dtype=at, dictionary=dictionary
+        )
+
+    raise NotImplementedError(f"aggregate {agg.func}")

@@ -157,7 +157,7 @@ class DistributedQueryRunner(LocalQueryRunner):
             balance *= 2
 
     def _execute_fragment(self, root, scans, tables, balance):
-        key = (root, balance, self.n)
+        key = (root.fingerprint(), balance, self.n)
         entry = self._frag_compiled.get(key)
         if entry is None:
             scan_ids = {id(s): i for i, s in enumerate(scans)}

@@ -91,6 +91,14 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             0,
         ),
         PropertyMetadata(
+            "host_root_stage",
+            "Run the final Output/Sort/Limit root stage host-side over "
+            "the gathered result (the reference's single-partition root "
+            "stage; avoids per-query XLA sort compiles)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "spill_enabled",
             "Allow spilling oversized build/group state to host RAM",
             bool,

@@ -56,6 +56,30 @@ def test_explain_analyze_row_counts(runner):
     assert "EXPLAIN ANALYZE:" in text
 
 
+def test_explain_analyze_repeat_keeps_annotations(runner):
+    """Second run hits the compiled-program cache; row annotations must
+    survive (regression: node-identity keyed stats went stale)."""
+    sql = (
+        "explain analyze select l_linestatus, count(*) c "
+        "from tpch.tiny.lineitem group by l_linestatus"
+    )
+    runner.execute(sql)
+    text = "\n".join(r[0] for r in runner.execute(sql).rows())
+    assert "[rows: 2" in text
+
+
+def test_explain_analyze_host_root_stage_annotated(runner):
+    text = "\n".join(
+        r[0]
+        for r in runner.execute(
+            "explain analyze select n_name from tpch.tiny.nation "
+            "order by n_name limit 3"
+        ).rows()
+    )
+    assert "host root stage" in text
+    assert "[rows: 3, host root stage]" in text
+
+
 def test_system_runtime_queries(runner):
     runner.execute("select count(*) as c from tpch.tiny.nation")
     res = runner.execute(
