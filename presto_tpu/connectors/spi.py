@@ -99,8 +99,13 @@ class ConnectorMetadata:
 
 
 def payload_len(col) -> int:
-    """Row count of one SPI column payload (ndarray or DictColumn)."""
-    return len(col.ids) if hasattr(col, "ids") else len(col)
+    """Row count of one SPI column payload (ndarray, DictColumn, or
+    MaskedColumn)."""
+    if hasattr(col, "ids"):
+        return len(col.ids)
+    if hasattr(col, "data"):
+        return len(col.data)
+    return len(col)
 
 
 class Connector:

@@ -17,6 +17,7 @@ every split of similar size reuses the same compiled fragment
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, Optional, Sequence
 
 import jax.numpy as jnp
@@ -27,6 +28,18 @@ from presto_tpu.connectors.tpch import DictColumn
 from presto_tpu.page import Block, Dictionary, Page
 
 MIN_BUCKET = 1 << 10
+
+
+@dataclasses.dataclass
+class MaskedColumn:
+    """Native-representation column + validity mask (+ optional
+    dictionary values): the exchange-wire staging form — keeps decimals
+    scaled/exact where an object array would round-trip through Python
+    values (pages_wire.deserialize_page produces these)."""
+
+    data: np.ndarray
+    valid: np.ndarray
+    values: Optional[tuple] = None  # dictionary values when string-typed
 
 
 def bucket_capacity(n: int) -> int:
@@ -43,17 +56,35 @@ def stage_page(
     capacity: Optional[int] = None,
 ) -> Page:
     """Build a device Page from SPI column payloads."""
+    from presto_tpu.connectors.spi import payload_len
+
     names = tuple(schema.keys())
     n = 0
     for v in data.values():
-        n = len(v.ids) if isinstance(v, DictColumn) else len(v)
+        n = payload_len(v)
         break
     cap = capacity if capacity is not None else bucket_capacity(n)
     blocks = []
     for name in names:
         t = schema[name]
         v = data[name]
-        if isinstance(v, DictColumn):
+        if isinstance(v, MaskedColumn):
+            arr = v.data.astype(t.np_dtype, copy=False)
+            padded = np.zeros(cap, dtype=t.np_dtype)
+            padded[: len(arr)] = arr
+            vpad = np.zeros(cap, dtype=bool)
+            vpad[: len(arr)] = v.valid
+            blocks.append(
+                Block(
+                    data=jnp.asarray(padded),
+                    valid=jnp.asarray(vpad),
+                    dtype=t,
+                    dictionary=(
+                        Dictionary(v.values) if v.values is not None else None
+                    ),
+                )
+            )
+        elif isinstance(v, DictColumn):
             ids = np.asarray(v.ids, dtype=np.int32)
             pad = np.zeros(cap - len(ids), dtype=np.int32)
             blocks.append(

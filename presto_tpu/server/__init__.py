@@ -1,0 +1,18 @@
+"""Multi-host runtime: coordinator / worker processes over HTTP.
+
+Reference parity: the coordinator<->worker split of SURVEY.md §1
+(L0/L3/L4) and the §2.5 communication backend — REST control plane
+(task create/update/status) + pull-based, token-acked paged data plane.
+
+TPU-first shape: one *worker process per host*; each worker executes
+plan fragments over its own local device mesh (shard_map + ICI
+collectives inside, exactly the in-slice engine), and only host-level
+traffic — fragment specs, split assignments, result pages — crosses
+processes (the DCN tier). The coordinator runs planning, split
+scheduling, partial/final aggregation splitting, the exchange client,
+and the host root stage.
+"""
+
+from presto_tpu.server.client import PrestoTpuClient  # noqa: F401
+from presto_tpu.server.coordinator import CoordinatorServer  # noqa: F401
+from presto_tpu.server.worker import WorkerServer  # noqa: F401

@@ -1,0 +1,411 @@
+"""Coordinator process: SQL frontend, discovery, stage scheduling,
+exchange client, paged client protocol.
+
+Reference parity: the coordinator half of SURVEY.md §1/§3 —
+``POST /v1/statement`` with paged ``nextUri`` results (L0),
+parse/plan/fragment (L1-L2), stage scheduling to workers over the task
+protocol (L3), the consumer side of the paged exchange
+(``ExchangeClient``), embedded discovery with TTL-expiring worker
+announcements and failure detection (SURVEY.md §5.3).
+
+Round-1 multihost shape documented in server.scheduler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import threading
+import time
+import traceback
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.exec.staging import stage_page
+from presto_tpu.plan import nodes as N
+from presto_tpu.server import pages_wire
+from presto_tpu.server.protocol import FragmentSpec
+from presto_tpu.server.scheduler import assign_ranges, plan_stage
+from presto_tpu.utils.metrics import REGISTRY
+
+#: announcement TTL: a worker silent this long is dropped (reference:
+#: discovery TTL expiry removing dead nodes from scheduling)
+NODE_TTL_S = 10.0
+RESULT_PAGE_ROWS = 4096
+
+
+@dataclasses.dataclass
+class _WorkerNode:
+    node_id: str
+    uri: str
+    last_seen: float
+    version: str = "presto-tpu-0.1"
+    coordinator: bool = False
+    state: str = "ACTIVE"
+
+
+class _Query:
+    def __init__(self, qid: str, sql: str):
+        self.qid = qid
+        self.sql = sql
+        self.state = "QUEUED"
+        self.error: Optional[str] = None
+        self.columns: List[dict] = []
+        self.rows: List[list] = []
+        self.done = threading.Event()
+
+
+class CoordinatorServer:
+    """Coordinator: embedded discovery + dispatcher + exchange client."""
+
+    def __init__(self, port: int = 0, catalogs=None, session=None):
+        from presto_tpu.exec.local_runner import LocalQueryRunner
+
+        self.local = LocalQueryRunner(catalogs=catalogs, session=session)
+        self.local.cluster = self  # system.runtime.nodes source
+        self.workers: Dict[str, _WorkerNode] = {}
+        self.queries: Dict[str, _Query] = {}
+        self._lock = threading.Lock()
+        self._qid = itertools.count(1)
+        self._shutting_down = False
+
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> "CoordinatorServer":
+        self._serve_thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._shutting_down = True
+        self.httpd.shutdown()
+
+    # ---------------------------------------------------------- discovery
+
+    def announce(self, node_id: str, uri: str) -> None:
+        with self._lock:
+            w = self.workers.get(node_id)
+            if w is None:
+                self.workers[node_id] = _WorkerNode(
+                    node_id=node_id, uri=uri, last_seen=time.time()
+                )
+            else:
+                w.last_seen = time.time()
+                w.uri = uri
+
+    def active_workers(self) -> List[_WorkerNode]:
+        now = time.time()
+        with self._lock:
+            return [
+                w
+                for w in self.workers.values()
+                if now - w.last_seen <= NODE_TTL_S
+            ]
+
+    def nodes(self) -> List[_WorkerNode]:
+        """All nodes incl. self, for system.runtime.nodes."""
+        me = _WorkerNode(
+            node_id="coordinator",
+            uri=self.uri,
+            last_seen=time.time(),
+            coordinator=True,
+        )
+        now = time.time()
+        with self._lock:
+            others = [
+                dataclasses.replace(
+                    w,
+                    state=(
+                        "ACTIVE"
+                        if now - w.last_seen <= NODE_TTL_S
+                        else "GONE"
+                    ),
+                )
+                for w in self.workers.values()
+            ]
+        return [me] + others
+
+    # ------------------------------------------------------------ queries
+
+    def submit(self, sql: str) -> _Query:
+        q = _Query(f"q_{next(self._qid)}", sql)
+        with self._lock:
+            self.queries[q.qid] = q
+        threading.Thread(
+            target=self._execute_query, args=(q,), daemon=True
+        ).start()
+        return q
+
+    def _execute_query(self, q: _Query) -> None:
+        q.state = "RUNNING"
+        try:
+            with REGISTRY.timer("coordinator.query_time").time():
+                self._run_sql(q)
+            q.state = "FINISHED"
+        except Exception as e:
+            q.state = "FAILED"
+            q.error = (
+                f"{type(e).__name__}: {e}\n{traceback.format_exc()[-1000:]}"
+            )
+            REGISTRY.counter("coordinator.queries_failed").update()
+        finally:
+            q.done.set()
+
+    def _run_sql(self, q: _Query) -> None:
+        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
+        from presto_tpu.parallel.fragmenter import insert_gathers
+        from presto_tpu.plan.optimizer import prune_columns
+        from presto_tpu.plan.planner import plan_statement
+        from presto_tpu.sql import ast, parse_statement
+
+        stmt = parse_statement(q.sql)
+        workers = self.active_workers()
+        if not isinstance(stmt, ast.Select) or not workers:
+            # non-SELECT (SET SESSION / SHOW / EXPLAIN) or empty cluster:
+            # run on the coordinator's local engine
+            res = self.local.execute(q.sql)
+            self._store_result(q, res)
+            return
+
+        plan = plan_statement(stmt, self.local.catalogs, self.local.session)
+        root = prune_columns(self.local._bind_params(plan))
+        host_ops: List[N.PlanNode] = []
+        if self.local.session.get("host_root_stage"):
+            root, host_ops = peel_host_ops(root)
+        froot = insert_gathers(root)
+        remotes = [
+            n for n in N.walk(froot) if isinstance(n, N.RemoteSourceNode)
+        ]
+        if not remotes:
+            res = self.local.execute_plan(plan)
+            self._store_result(q, res)
+            return
+        pages = [
+            self._run_stage(r.fragment_root, workers, q) for r in remotes
+        ]
+        page = self.local._run_with_pages(froot, remotes, pages)
+        if host_ops:
+            page = apply_host_ops(page, host_ops)
+        from presto_tpu.exec.local_runner import QueryResult
+
+        self._store_result(q, QueryResult(plan.output_names, page))
+
+    # ------------------------------------------------------- stage runner
+
+    def _run_stage(self, fragment_root, workers, q: _Query):
+        """Schedule one fragment across workers; gather + finalize."""
+        stage = plan_stage(fragment_root, self.local.catalogs)
+        ranges = assign_ranges(stage.partition_rows, len(workers))
+        specs = []
+        for w, (lo, hi) in zip(workers, ranges):
+            specs.append(
+                (
+                    w,
+                    FragmentSpec(
+                        task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                        query_id=q.qid,
+                        fragment=stage.worker_fragment,
+                        partition_scan=stage.partition_scan,
+                        split_start=lo,
+                        split_end=hi,
+                    ),
+                )
+            )
+        for w, spec in specs:
+            self._http_json(
+                "POST", w.uri + "/v1/task", spec.to_json()
+            )
+        payloads = []
+        for w, spec in specs:
+            payloads.extend(self._pull_task(w, spec))
+        # delete tasks (ack) regardless of outcome
+        for w, spec in specs:
+            try:
+                self._http_json(
+                    "DELETE", f"{w.uri}/v1/task/{spec.task_id}", None
+                )
+            except Exception:
+                pass
+
+        remote = [
+            n
+            for n in N.walk(stage.final_root)
+            if isinstance(n, N.RemoteSourceNode)
+        ]
+        schema = dict(stage.worker_fragment.output_schema())
+        merged = pages_wire.merge_payloads(payloads, schema)
+        page = stage_page(merged, schema)
+        return self.local._run_with_pages(
+            stage.final_root, remote, [page]
+        )
+
+    def _pull_task(self, w, spec) -> List[tuple]:
+        """Token-acked page pulls until X-Complete (exchange client)."""
+        token = 0
+        out = []
+        deadline = time.time() + float(
+            self.local.session.get("query_max_run_time_s")
+        )
+        while True:
+            if time.time() > deadline:
+                raise TimeoutError(f"task {spec.task_id} timed out")
+            url = f"{w.uri}/v1/task/{spec.task_id}/results/0/{token}"
+            req = urllib.request.Request(url)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                complete = resp.headers.get("X-Complete") == "true"
+                nxt = int(resp.headers.get("X-Next-Token", token))
+                if resp.status == 200:
+                    out.append(pages_wire.deserialize_page(resp.read()))
+                if complete and nxt == token + (
+                    1 if resp.status == 200 else 0
+                ):
+                    return out
+                if nxt == token and resp.status != 200:
+                    # no page yet: check for failure, then poll again
+                    st = self._http_json(
+                        "GET",
+                        f"{w.uri}/v1/task/{spec.task_id}/status",
+                        None,
+                    )
+                    if st.get("state") == "FAILED":
+                        raise RuntimeError(
+                            f"task on {w.node_id} failed: {st.get('error')}"
+                        )
+                    time.sleep(0.05)
+                token = nxt
+
+    # ------------------------------------------------------------ helpers
+
+    def _http_json(self, method: str, url: str, body) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            raw = resp.read()
+        return json.loads(raw) if raw else {}
+
+    def _store_result(self, q: _Query, res) -> None:
+        q.columns = [
+            {"name": c} for c in res.columns
+        ]
+        q.rows = [list(r) for r in res.rows()]
+
+
+def _make_handler(coord: CoordinatorServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(n)
+
+        def do_POST(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "statement"]:
+                sql = self._read_body().decode()
+                q = coord.submit(sql)
+                return self._json(
+                    200,
+                    {
+                        "id": q.qid,
+                        "nextUri": f"{coord.uri}/v1/statement/{q.qid}/0",
+                    },
+                )
+            self._json(404, {"error": f"no route {self.path}"})
+
+        def do_PUT(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "announcement"]:
+                d = json.loads(self._read_body().decode())
+                coord.announce(d["node_id"], d["uri"])
+                return self._json(200, {"ok": True})
+            self._json(404, {"error": f"no route {self.path}"})
+
+        def do_GET(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "cluster"]:
+                return self._json(
+                    200,
+                    {
+                        "workers": [
+                            {"node_id": w.node_id, "uri": w.uri}
+                            for w in coord.active_workers()
+                        ]
+                    },
+                )
+            if parts == ["v1", "metrics"]:
+                body = REGISTRY.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
+                qid, token = parts[2], int(parts[3])
+                q = coord.queries.get(qid)
+                if q is None:
+                    return self._json(404, {"error": "no such query"})
+                # long-poll up to 1s for progress (reference: long-poll)
+                q.done.wait(timeout=1.0)
+                if q.state == "FAILED":
+                    return self._json(
+                        200,
+                        {
+                            "id": qid,
+                            "error": q.error,
+                            "stats": {"state": "FAILED"},
+                        },
+                    )
+                if not q.done.is_set():
+                    return self._json(
+                        200,
+                        {
+                            "id": qid,
+                            "stats": {"state": q.state},
+                            "nextUri": (
+                                f"{coord.uri}/v1/statement/{qid}/{token}"
+                            ),
+                        },
+                    )
+                lo = token * RESULT_PAGE_ROWS
+                hi = min(lo + RESULT_PAGE_ROWS, len(q.rows))
+                out = {
+                    "id": qid,
+                    "columns": q.columns,
+                    "data": q.rows[lo:hi],
+                    "stats": {"state": "FINISHED"},
+                }
+                if hi < len(q.rows):
+                    out["nextUri"] = (
+                        f"{coord.uri}/v1/statement/{qid}/{token + 1}"
+                    )
+                return self._json(200, out)
+            self._json(404, {"error": f"no route {self.path}"})
+
+    return Handler

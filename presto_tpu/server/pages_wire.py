@@ -1,0 +1,159 @@
+"""DCN page serialization: typed columnar buffers + compression + checksum.
+
+Reference parity: ``PagesSerde`` — per-block typed encodings with LZ4
+compression and an xxhash checksum on the exchange wire (SURVEY.md §2.5
+"Serialization"). Here: raw little-endian typed buffers per column,
+zlib-compressed (stdlib; the C++ host-agent codec in ``native/`` is the
+hot-path replacement and uses the same frame layout), crc32-checksummed
+per buffer, with a JSON header.
+
+Frame layout::
+
+    b"PTP1" | u32 header_len | header_json | buffer_0 | buffer_1 | ...
+
+The header lists per-column metadata (name, type, validity, dictionary
+values, buffer sizes and crc32s). Dictionary columns ship ids (int32)
+plus their value list in the header — dictionaries are tiny relative to
+id vectors, and shipping values keeps the wire self-contained across
+processes that never shared a dictionary.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.tpch import DictColumn
+from presto_tpu.exec.staging import MaskedColumn
+from presto_tpu.server.protocol import decode as _decode_type
+from presto_tpu.server.protocol import encode as _encode_type
+
+_MAGIC = b"PTP1"
+
+
+def _compress(raw: bytes) -> Tuple[bytes, int]:
+    comp = zlib.compress(raw, level=1)
+    return comp, zlib.crc32(raw)
+
+
+def serialize_page(
+    columns: List[Tuple[str, np.ndarray, Optional[np.ndarray], T.DataType,
+                        Optional[tuple]]],
+    nrows: int,
+) -> bytes:
+    """columns: (name, data[:n], valid[:n]|None, dtype, dict_values|None).
+
+    Numeric data must already be in native representation (scaled ints
+    for decimals, epoch days for dates, int32 ids for dictionary cols).
+    """
+    header: Dict = {"nrows": nrows, "columns": []}
+    buffers: List[bytes] = []
+    for name, data, valid, dtype, dict_values in columns:
+        data = np.ascontiguousarray(data)
+        raw = data.tobytes()
+        comp, crc = _compress(raw)
+        col: Dict = {
+            "name": name,
+            "type": _encode_type(dtype),
+            "np_dtype": data.dtype.str,
+            "comp_size": len(comp),
+            "raw_size": len(raw),
+            "crc32": crc,
+        }
+        buffers.append(comp)
+        if valid is not None:
+            vraw = np.packbits(np.asarray(valid, dtype=bool)).tobytes()
+            vcomp, vcrc = _compress(vraw)
+            col["valid_comp_size"] = len(vcomp)
+            col["valid_raw_size"] = len(vraw)
+            col["valid_crc32"] = vcrc
+            buffers.append(vcomp)
+        if dict_values is not None:
+            col["dictionary"] = list(dict_values)
+        header["columns"].append(col)
+    hj = json.dumps(header).encode()
+    return b"".join(
+        [_MAGIC, struct.pack("<I", len(hj)), hj] + buffers
+    )
+
+
+def deserialize_page(buf: bytes):
+    """-> (payload {name: ndarray | DictColumn}, schema {name: DataType},
+    nrows) — feeds exec.staging.stage_page directly."""
+    if buf[:4] != _MAGIC:
+        raise ValueError("bad page frame magic")
+    (hlen,) = struct.unpack_from("<I", buf, 4)
+    header = json.loads(buf[8 : 8 + hlen].decode())
+    off = 8 + hlen
+    payload: Dict = {}
+    schema: Dict[str, T.DataType] = {}
+    nrows = header["nrows"]
+    for col in header["columns"]:
+        comp = buf[off : off + col["comp_size"]]
+        off += col["comp_size"]
+        raw = zlib.decompress(comp)
+        if len(raw) != col["raw_size"] or zlib.crc32(raw) != col["crc32"]:
+            raise ValueError(f"page checksum mismatch on {col['name']}")
+        data = np.frombuffer(raw, dtype=np.dtype(col["np_dtype"])).copy()
+        valid = None
+        if "valid_comp_size" in col:
+            vcomp = buf[off : off + col["valid_comp_size"]]
+            off += col["valid_comp_size"]
+            vraw = zlib.decompress(vcomp)
+            if zlib.crc32(vraw) != col["valid_crc32"]:
+                raise ValueError(
+                    f"validity checksum mismatch on {col['name']}"
+                )
+            valid = np.unpackbits(
+                np.frombuffer(vraw, dtype=np.uint8), count=nrows
+            ).astype(bool)
+        dtype = _decode_type(col["type"])
+        schema[col["name"]] = dtype
+        dict_values = (
+            tuple(col["dictionary"]) if "dictionary" in col else None
+        )
+        if valid is not None:
+            # native repr + mask: exact (no Python-value round trip)
+            payload[col["name"]] = MaskedColumn(
+                data=data, valid=valid, values=dict_values
+            )
+        elif dict_values is not None:
+            payload[col["name"]] = DictColumn(
+                ids=data.astype(np.int32), values=dict_values
+            )
+        else:
+            payload[col["name"]] = data
+    return payload, schema, nrows
+
+
+def page_to_wire_columns(page, fetched_n: Optional[int] = None):
+    """Device Page -> serialize_page input, with ONE batched device->host
+    fetch (two-phase; see exec.host_ops for the relay rationale)."""
+    import jax
+
+    n = fetched_n if fetched_n is not None else int(page.num_valid)
+    leaves = []
+    for blk in page.blocks:
+        leaves.append(blk.data[:n])
+        if blk.valid is not None:
+            leaves.append(blk.valid[:n])
+    fetched = jax.device_get(leaves)
+    cols = []
+    i = 0
+    for name, blk in zip(page.names, page.blocks):
+        data = fetched[i]
+        i += 1
+        valid = None
+        if blk.valid is not None:
+            valid = fetched[i]
+            i += 1
+        dict_values = (
+            tuple(blk.dictionary.values) if blk.dictionary is not None else None
+        )
+        cols.append((name, data, valid, blk.dtype, dict_values))
+    return cols, n

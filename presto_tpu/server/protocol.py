@@ -1,0 +1,142 @@
+"""Wire protocol: plan/expression trees and task specs as JSON.
+
+Reference parity: the coordinator->worker task protocol — a
+``PlanFragment`` serialized as JSON plus split batches, exactly the
+boundary where the reference swaps execution backends (SURVEY.md
+preamble, §2.3 "presto_protocol" codegen'd structs, §3.2).
+
+Implementation: a generic tagged codec over the engine's frozen
+dataclasses (plan nodes, expressions, types, agg/sort/window calls,
+table handles, splits). Every object encodes as
+``{"@": "ClassName", ...fields}``; tuples encode as lists and are
+restored per-field from dataclass annotations at decode time — the
+registry below is the single source of which classes may appear on the
+wire (arbitrary class instantiation from JSON is not possible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, List, Optional
+
+from presto_tpu import expr as E
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import ConnectorSplit, TableHandle
+from presto_tpu.ops.aggregation import AggCall
+from presto_tpu.ops.sort import SortKey
+from presto_tpu.ops.window import WindowCall
+from presto_tpu.plan import nodes as N
+
+
+def _registry() -> Dict[str, type]:
+    classes: List[type] = [TableHandle, ConnectorSplit, AggCall, SortKey,
+                           WindowCall]
+    for mod in (E, T, N):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+                classes.append(obj)
+    return {c.__name__: c for c in classes}
+
+
+_REGISTRY = _registry()
+
+#: singleton DataType instances by type name (decimal carries params)
+_TYPE_SINGLETONS = {
+    t.name: t
+    for t in [
+        T.BIGINT, T.INTEGER, T.DOUBLE, T.REAL, T.BOOLEAN, T.VARCHAR,
+        T.DATE, T.TIMESTAMP,
+    ]
+}
+
+
+def encode(obj: Any) -> Any:
+    """Engine object -> JSON-able structure."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, T.DataType):
+        if obj.is_decimal:
+            return {"@": "decimal", "p": obj.precision, "s": obj.scale}
+        return {"@": "type", "name": obj.name}
+    if isinstance(obj, (tuple, list)):
+        return [encode(x) for x in obj]
+    if dataclasses.is_dataclass(obj):
+        cls = type(obj)
+        if cls.__name__ not in _REGISTRY:
+            raise TypeError(f"{cls.__name__} is not wire-registered")
+        out = {"@": cls.__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = encode(getattr(obj, f.name))
+        return out
+    raise TypeError(f"cannot encode {type(obj).__name__}")
+
+
+def decode(data: Any) -> Any:
+    """JSON structure -> engine object."""
+    if data is None or isinstance(data, (bool, int, float, str)):
+        return data
+    if isinstance(data, list):
+        return tuple(decode(x) for x in data)
+    tag = data.get("@")
+    if tag == "decimal":
+        return T.decimal(data["p"], data["s"])
+    if tag == "type":
+        return _TYPE_SINGLETONS[data["name"]]
+    cls = _REGISTRY.get(tag)
+    if cls is None:
+        raise TypeError(f"unknown wire tag {tag!r}")
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _coerce(decode(data[f.name]), f.type, cls)
+    return cls(**kwargs)
+
+
+def _coerce(value: Any, annot: Any, cls: type) -> Any:
+    """Tuples come back as tuples already; lists in annotations stay
+    tuples (engine convention: all plan/expr collections are tuples)."""
+    return value
+
+
+# ------------------------------------------------------------ task spec
+
+
+@dataclasses.dataclass(frozen=True)
+class FragmentSpec:
+    """One task: a plan fragment + the splits this worker owns.
+
+    ``partition_scan`` names the scan (by walk index) whose splits are
+    sharded across workers; every other scan is replicated (scanned in
+    full by each worker) — the reference's source-partitioned stage vs
+    replicated build sides (SURVEY.md §2.4).
+    """
+
+    task_id: str
+    query_id: str
+    fragment: N.PlanNode
+    partition_scan: int  # walk index of the partitioned TableScanNode
+    split_start: int  # row range of the partitioned scan owned here
+    split_end: int
+
+    def to_json(self) -> dict:
+        return {
+            "task_id": self.task_id,
+            "query_id": self.query_id,
+            "fragment": encode(self.fragment),
+            "partition_scan": self.partition_scan,
+            "split_start": self.split_start,
+            "split_end": self.split_end,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "FragmentSpec":
+        return FragmentSpec(
+            task_id=d["task_id"],
+            query_id=d["query_id"],
+            fragment=decode(d["fragment"]),
+            partition_scan=d["partition_scan"],
+            split_start=d["split_start"],
+            split_end=d["split_end"],
+        )

@@ -1,0 +1,108 @@
+"""Cross-host query scheduling: fragment -> per-worker tasks.
+
+Reference parity: ``SqlQueryScheduler`` / ``SqlStageExecution`` — a leaf
+stage is N tasks over dynamically assigned splits of the partitioned
+source, intermediate data flows through exchanges, the root stage
+gathers (SURVEY.md §2.1 "Query scheduler", §3.2).
+
+TPU-first shape (round-1 multihost):
+- ONE source-partitioned stage per distributable fragment: the scan
+  with the largest stats row count is split by row ranges across
+  workers; every other scan is replicated (each worker scans it fully —
+  the reference's REPLICATED build-side choice, SURVEY.md §2.4).
+- Fragments whose root is an aggregation/distinct split into PARTIAL
+  (worker) / FINAL (coordinator merge) steps via the same
+  ``split_aggregation`` rewrite the in-slice engine uses.
+- The coordinator pulls every task's pages (GATHER), concatenates, and
+  finishes the plan locally (final agg + any non-distributable top +
+  the host root stage).
+
+Worker-to-worker hash repartition (the REPARTITION exchange crossing
+hosts) is intentionally absent this round: inside each worker the
+slice-level all_to_all already repartitions across its local mesh, and
+the cross-host cut is gather-shaped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu import expr as E
+from presto_tpu.parallel.agg_split import split_aggregation
+from presto_tpu.plan import nodes as N
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """One distributable fragment scheduled across workers."""
+
+    worker_fragment: N.PlanNode  # runs on every worker over its splits
+    final_root: N.PlanNode  # coordinator plan over the RemoteSourceNode
+    partition_scan: int  # walk index (in worker_fragment) of split scan
+    partition_rows: int  # total row count of the partitioned table
+
+
+def plan_stage(fragment_root: N.PlanNode, catalogs) -> StagePlan:
+    """Decompose one distributable fragment into worker/final steps."""
+    worker_root = fragment_root
+    remote = N.RemoteSourceNode(fragment_root=fragment_root)
+
+    if isinstance(fragment_root, N.AggregationNode) and fragment_root.aggs:
+        partial_aggs, fkeys, faggs, post = split_aggregation(
+            fragment_root.group_keys, fragment_root.aggs
+        )
+        worker_root = dataclasses.replace(fragment_root, aggs=partial_aggs)
+        remote = N.RemoteSourceNode(fragment_root=worker_root)
+        final: N.PlanNode = N.AggregationNode(
+            source=remote,
+            group_keys=fkeys,
+            aggs=faggs,
+            max_groups=fragment_root.max_groups,
+        )
+        if post:
+            final = N.ProjectNode(source=final, projections=post)
+    elif isinstance(fragment_root, N.DistinctNode):
+        # distinct-of-distinct: worker dedups its shard, final dedups
+        final = N.DistinctNode(
+            source=remote, max_groups=fragment_root.max_groups
+        )
+        worker_root = fragment_root
+    else:
+        final = remote
+
+    scan_idx, rows = _pick_partition_scan(worker_root, catalogs)
+    return StagePlan(
+        worker_fragment=worker_root,
+        final_root=final,
+        partition_scan=scan_idx,
+        partition_rows=rows,
+    )
+
+
+def _pick_partition_scan(root: N.PlanNode, catalogs) -> Tuple[int, int]:
+    """Walk index + row count of the scan to shard across workers (the
+    largest table by connector stats — the probe side in practice)."""
+    best_idx, best_rows = -1, -1
+    for i, node in enumerate(N.walk(root)):
+        if not isinstance(node, N.TableScanNode):
+            continue
+        conn = catalogs.get(node.handle.catalog)
+        stats = conn.metadata().get_table_stats(node.handle)
+        rows = int(stats.row_count or 0)
+        if rows > best_rows:
+            best_idx, best_rows = i, rows
+    if best_idx < 0:
+        raise ValueError("fragment has no table scan to partition")
+    return best_idx, best_rows
+
+
+def assign_ranges(total_rows: int, n_workers: int) -> List[Tuple[int, int]]:
+    """Contiguous row ranges of the partitioned scan, one per worker."""
+    chunk = -(-total_rows // max(n_workers, 1))
+    out = []
+    for i in range(n_workers):
+        lo = min(i * chunk, total_rows)
+        hi = min((i + 1) * chunk, total_rows)
+        out.append((lo, hi))
+    return out

@@ -1,0 +1,315 @@
+"""Worker process: executes plan fragments over its local device mesh.
+
+Reference parity: the worker task runtime — ``TaskResource``
+(``POST /v1/task/{id}``), ``SqlTaskManager``, task status long-poll, the
+producer side of the paged exchange (``OutputBuffer`` +
+``GET /v1/task/{id}/results/{buffer}/{token}``), graceful shutdown
+(SURVEY.md §2.1 "Task runtime", §2.5, §5.3). The C++ native worker
+("Prestissimo") implements exactly this HTTP surface; here the device
+runtime is JAX over the worker's local chips, and the HTTP host agent
+is this module.
+
+Execution: a task = FragmentSpec (plan fragment + owned row range of the
+partitioned scan). Replicated scans load in full; the partitioned scan
+loads only the owned range. The whole fragment compiles to one XLA
+program over the local mesh (the in-slice engine); result pages are
+serialized into the task's output buffer, pulled token-acked by the
+coordinator, and freed on DELETE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import traceback
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from presto_tpu.connectors.spi import ConnectorSplit
+from presto_tpu.exec.staging import stage_page
+from presto_tpu.plan import nodes as N
+from presto_tpu.server import pages_wire
+from presto_tpu.server.protocol import FragmentSpec
+from presto_tpu.utils.metrics import REGISTRY
+
+#: rows per exchange page (the reference pages its exchange similarly)
+PAGE_ROWS = 1 << 16
+
+
+class _Task:
+    def __init__(self, spec: FragmentSpec):
+        self.spec = spec
+        self.state = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|ABORTED
+        self.error: Optional[str] = None
+        self.pages: List[bytes] = []
+        self.created = time.time()
+
+
+class WorkerServer:
+    """One worker process: HTTP host agent + local device execution."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        node_id: Optional[str] = None,
+        catalogs=None,
+        coordinator_uri: Optional[str] = None,
+    ):
+        from presto_tpu.exec.local_runner import LocalQueryRunner
+
+        self.node_id = node_id or f"worker-{uuid.uuid4().hex[:8]}"
+        self.runner = LocalQueryRunner(catalogs=catalogs)
+        self.tasks: Dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        self._shutting_down = False
+        self.coordinator_uri = coordinator_uri
+        self._announcer: Optional[threading.Thread] = None
+
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "WorkerServer":
+        self._serve_thread.start()
+        if self.coordinator_uri:
+            self._announcer = threading.Thread(
+                target=self._announce_loop, daemon=True
+            )
+            self._announcer.start()
+        return self
+
+    def shutdown(self, graceful: bool = True) -> None:
+        """Graceful: stop accepting work, finish running tasks, stop
+        (reference: SHUTTING_DOWN protocol, SURVEY.md §5.3)."""
+        self._shutting_down = True
+        if graceful:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with self._lock:
+                    busy = any(
+                        t.state in ("QUEUED", "RUNNING")
+                        for t in self.tasks.values()
+                    )
+                if not busy:
+                    break
+                time.sleep(0.05)
+        self.httpd.shutdown()
+
+    def _announce_loop(self):
+        import urllib.request
+
+        while not self._shutting_down:
+            try:
+                body = json.dumps(
+                    {"node_id": self.node_id, "uri": self.uri}
+                ).encode()
+                req = urllib.request.Request(
+                    self.coordinator_uri + "/v1/announcement",
+                    data=body,
+                    method="PUT",
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass  # coordinator down: keep retrying (discovery TTL)
+            time.sleep(1.0)
+
+    # ---------------------------------------------------------- task exec
+
+    def create_task(self, spec: FragmentSpec) -> str:
+        if self._shutting_down:
+            raise RuntimeError("worker is shutting down")
+        task = _Task(spec)
+        with self._lock:
+            self.tasks[spec.task_id] = task
+        threading.Thread(
+            target=self._run_task, args=(task,), daemon=True
+        ).start()
+        REGISTRY.counter("worker.tasks_created").update()
+        return spec.task_id
+
+    def _run_task(self, task: _Task) -> None:
+        task.state = "RUNNING"
+        try:
+            with REGISTRY.timer("worker.task_time").time():
+                self._execute(task)
+            task.state = "FINISHED"
+        except Exception as e:  # report to coordinator via status
+            task.state = "FAILED"
+            task.error = (
+                f"{type(e).__name__}: {e}\n{traceback.format_exc()[-1000:]}"
+            )
+            REGISTRY.counter("worker.tasks_failed").update()
+
+    def _execute(self, task: _Task) -> None:
+        spec = task.spec
+        root = spec.fragment
+        scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
+        walk_ids = {
+            id(n): i for i, n in enumerate(N.walk(root))
+        }
+        pages = []
+        for s in scans:
+            if walk_ids[id(s)] == spec.partition_scan:
+                payload = self._load_range(s, spec.split_start, spec.split_end)
+                page = stage_page(payload, dict(s.schema))
+            else:
+                page = self.runner._load_table(s)  # replicated: cacheable
+            pages.append(page)
+        out = self.runner._run_with_pages(root, scans, pages)
+        cols, n = pages_wire.page_to_wire_columns(out)
+        for lo in range(0, max(n, 1), PAGE_ROWS):
+            hi = min(lo + PAGE_ROWS, n)
+            chunk = [
+                (name, data[lo:hi], None if v is None else v[lo:hi], t, dv)
+                for name, data, v, t, dv in cols
+            ]
+            task.pages.append(pages_wire.serialize_page(chunk, hi - lo))
+
+    def _load_range(self, scan: N.TableScanNode, lo: int, hi: int):
+        conn = self.runner.catalogs.get(scan.handle.catalog)
+        split = ConnectorSplit(scan.handle, lo, hi)
+        return conn.create_page_source(split, list(scan.columns))
+
+    # ------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "node_id": self.node_id,
+                "state": "SHUTTING_DOWN" if self._shutting_down else "ACTIVE",
+                "uri": self.uri,
+                "tasks": {
+                    tid: t.state for tid, t in self.tasks.items()
+                },
+            }
+
+
+def _make_handler(worker: WorkerServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(n)
+
+        def do_GET(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "status"]:
+                return self._json(200, worker.status())
+            if parts == ["v1", "metrics"]:
+                body = REGISTRY.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if len(parts) == 4 and parts[:2] == ["v1", "task"] and parts[3] == "status":
+                t = worker.tasks.get(parts[2])
+                if t is None:
+                    return self._json(404, {"error": "no such task"})
+                return self._json(
+                    200,
+                    {
+                        "task_id": parts[2],
+                        "state": t.state,
+                        "error": t.error,
+                        "num_pages": len(t.pages),
+                    },
+                )
+            if (
+                len(parts) == 6
+                and parts[:2] == ["v1", "task"]
+                and parts[3] == "results"
+            ):
+                # /v1/task/{id}/results/{buffer}/{token}
+                t = worker.tasks.get(parts[2])
+                if t is None:
+                    return self._json(404, {"error": "no such task"})
+                token = int(parts[5])
+                if t.state == "FAILED":
+                    return self._json(500, {"error": t.error})
+                if token < len(t.pages):
+                    body = t.pages[token]
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-presto-tpu-page"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("X-Next-Token", str(token + 1))
+                    self.send_header(
+                        "X-Complete",
+                        "true"
+                        if t.state == "FINISHED"
+                        and token + 1 >= len(t.pages)
+                        else "false",
+                    )
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                # no page at this token yet
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.send_header("X-Next-Token", str(token))
+                self.send_header(
+                    "X-Complete",
+                    "true" if t.state == "FINISHED" else "false",
+                )
+                self.end_headers()
+                return
+            self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "task"]:
+                try:
+                    spec = FragmentSpec.from_json(
+                        json.loads(self._read_body().decode())
+                    )
+                    tid = worker.create_task(spec)
+                    return self._json(200, {"task_id": tid})
+                except Exception as e:
+                    return self._json(400, {"error": str(e)})
+            self._json(404, {"error": f"no route {self.path}"})
+
+        def do_DELETE(self):
+            parts = [p for p in self.path.split("/") if p]
+            if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                with worker._lock:
+                    t = worker.tasks.pop(parts[2], None)
+                if t is not None and t.state in ("QUEUED", "RUNNING"):
+                    t.state = "ABORTED"
+                return self._json(200, {"ok": True})
+            self._json(404, {"error": f"no route {self.path}"})
+
+        def do_PUT(self):
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "state", "shutdown"]:
+                threading.Thread(
+                    target=worker.shutdown, daemon=True
+                ).start()
+                return self._json(200, {"ok": True})
+            self._json(404, {"error": f"no route {self.path}"})
+
+    return Handler
