@@ -68,23 +68,19 @@ def render_plan(node: N.PlanNode, indent: int = 0, annot=None) -> str:
 
 
 def explain_text(runner, stmt: ast.Explain) -> str:
-    from presto_tpu.exec.host_ops import peel_host_ops
-
     plan = plan_statement(stmt.statement, runner.catalogs, runner.session)
-    root = prune_columns(plan.root)
     if not stmt.analyze:
-        return render_plan(root)
+        return render_plan(prune_columns(plan.root))
     # EXPLAIN ANALYZE: re-run with per-node row counters traced as extra
     # program outputs (stats.py); render rows inline like the reference.
+    # The runner returns the exact trees it executed (param binding may
+    # rewrite the plan, so re-deriving them here could annotate the
+    # wrong nodes).
     t0 = time.perf_counter()
-    result, node_stats, host_rows = runner.execute_plan_analyzed(plan)
+    result, node_stats, host_rows, root, droot, host_ops = (
+        runner.execute_plan_analyzed(plan)
+    )
     elapsed = time.perf_counter() - t0
-    # mirror the runner's host-root-stage peel so walk indices of the
-    # device subtree line up; peeled nodes get host-side row counts
-    droot = root
-    host_ops = []
-    if runner.session.get("host_root_stage"):
-        droot, host_ops = peel_host_ops(root)
     executed_order = {s.node_id: s for s in node_stats}
     annot = {}
     for i, n in enumerate(N.walk(droot)):

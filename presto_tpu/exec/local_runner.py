@@ -95,6 +95,39 @@ class LocalQueryRunner:
         self._table_cache: Dict[Tuple, Page] = {}
         self._active_qs = None  # QueryStats while a query is in flight
 
+    # ------------------------------------------------------------ backend
+
+    def _exec_device(self):
+        """Execution device for the ``tpu_offload`` session gate
+        (BASELINE.json tier-3 property; SURVEY.md preamble dual-backend
+        seam): None = the platform default (TPU when present); the first
+        CPU device when offload is disabled — same plans, same compiled
+        programs, different executor, mirroring the reference's
+        Java-worker / native-worker swap at the protocol boundary."""
+        import jax
+
+        if self.session.get("tpu_offload"):
+            return None
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError as e:
+            raise ExecutionError(
+                "tpu_offload=false requires a CPU backend; none is "
+                f"registered in this process ({e})"
+            )
+
+    def _device_scope(self):
+        import contextlib
+
+        import jax
+
+        dev = self._exec_device()
+        return (
+            jax.default_device(dev)
+            if dev is not None
+            else contextlib.nullcontext()
+        )
+
     # ------------------------------------------------------------- public
 
     def execute(self, sql: str) -> QueryResult:
@@ -184,14 +217,19 @@ class LocalQueryRunner:
         does (including the host root stage peel) with per-node row
         counters traced as extra program outputs. Returns
         (QueryResult, List[PlanNodeStats] for the device tree,
-        List[int] rows-after-each-host-op innermost-first).
+        List[int] rows-after-each-host-op innermost-first,
+        bound pre-peel root, device root executed, host ops peeled) —
+        the trees are returned so EXPLAIN ANALYZE annotates the exact
+        nodes that ran (param binding may rewrite the plan, so
+        re-deriving them can diverge; peel preserves node identity, so
+        the bound root renders the full tree with matching ids).
         Single-device trace path — counts are identical under
         distribution."""
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
         from presto_tpu.exec.stats import collect_node_stats
 
-        root = self._bind_params(plan)
-        root = prune_columns(root)
+        bound_root = prune_columns(self._bind_params(plan))
+        root = bound_root
         host_ops: List[N.PlanNode] = []
         if self.session.get("host_root_stage"):
             root, host_ops = peel_host_ops(root)
@@ -205,7 +243,14 @@ class LocalQueryRunner:
         if host_ops:
             page = apply_host_ops(page, host_ops, rows_out=host_rows)
         stats = collect_node_stats(stats_cell)
-        return QueryResult(plan.output_names, page), stats, host_rows
+        return (
+            QueryResult(plan.output_names, page),
+            stats,
+            host_rows,
+            bound_root,
+            root,
+            host_ops,
+        )
 
     # ------------------------------------------------- params (subqueries)
 
@@ -249,7 +294,10 @@ class LocalQueryRunner:
             # key by structural fingerprint, not object identity: every
             # execute_plan rebuilds the tree (prune/bind), and a retrace
             # per call would redo XLA cache lookups costing seconds
-            entry = self._compiled.get((root.fingerprint(), analyzed))
+            offload = self.session.get("tpu_offload")
+            entry = self._compiled.get(
+                (root.fingerprint(), analyzed, offload)
+            )
             if entry is None:
                 if self._active_qs is not None:
                     self._active_qs.compile_cache_hit = False
@@ -296,9 +344,12 @@ class LocalQueryRunner:
                     )
 
                 entry = (jax.jit(trace), msgs_cell, nodes_cell)
-                self._compiled[(root.fingerprint(), analyzed)] = entry
+                self._compiled[
+                    (root.fingerprint(), analyzed, offload)
+                ] = entry
             fn, msgs_cell, nodes_cell = entry
-            page, flags_arr, err_arr, cnt_arr = fn(pages)
+            with self._device_scope():
+                page, flags_arr, err_arr, cnt_arr = fn(pages)
             flags_np, err_np, cnt_np = jax.device_get(
                 [flags_arr, err_arr, cnt_arr]
             )
@@ -326,12 +377,13 @@ class LocalQueryRunner:
             root = _scale_capacities(root, 4)
 
     def _load_table(self, scan: N.TableScanNode) -> Page:
-        key = (scan.handle, scan.columns)
+        key = (scan.handle, scan.columns, self.session.get("tpu_offload"))
         page = self._table_cache.get(key)
         if page is None:
             t0 = time.perf_counter()
             merged = self._load_merged_payload(scan)
-            page = stage_page(merged, dict(scan.schema))
+            with self._device_scope():
+                page = stage_page(merged, dict(scan.schema))
             if self.catalogs.get(scan.handle.catalog).cacheable():
                 self._table_cache[key] = page
             if self._active_qs is not None:
@@ -417,6 +469,7 @@ def _execute_node_inner(
             node.group_keys,
             node.aggs,
             node.max_groups,
+            errors_out=errors,
         )
         flags.append(overflow)
         return out

@@ -337,6 +337,28 @@ class DictTransform(Expr):
         return T.VARCHAR
 
 
+def dict_transform_fn(fn_key: str):
+    """Rebuild a DictTransform host function from its key.
+
+    The key is the canonical (wire-safe) identity of the transform —
+    the coordinator->worker protocol ships only ``fn_key`` and rebuilds
+    the callable here, so every producer of DictTransform nodes must
+    construct ``fn`` through this factory.
+    """
+    if fn_key == "lower":
+        return str.lower
+    if fn_key == "upper":
+        return str.upper
+    if fn_key.startswith("substring:"):
+        _, st, ln = fn_key.split(":")
+        start = int(st)
+        length = None if ln == "None" else int(ln)
+        if length is None:
+            return lambda s: s[start - 1:]
+        return lambda s: s[start - 1: start - 1 + length]
+    raise TypeError(f"unknown DictTransform key {fn_key!r}")
+
+
 @dataclasses.dataclass(frozen=True)
 class DictPredicate(Expr):
     """Boolean predicate over a dictionary column evaluated *host-side*

@@ -38,7 +38,10 @@ with int128), min/max preserve the input type.
 Exactness note: decimal/bigint sums on the sorted path are inclusive
 int64 cumsums differenced at boundaries — exact unless the *running
 total over the whole page* exceeds int64, a stricter-than-SQL bound
-(documented deviation; the reference overflows per-group). Float sums
+(the reference overflows per-group). A traced overflow trap (float64
+shadow cumsum compared against the int64 cumsum; a wrap displaces the
+value by ~2^64, far beyond float accumulation error) raises through the
+error-flag channel instead of returning silently wrong sums. Float sums
 use per-segment scans (not the cumsum trick) so no cross-group
 cancellation is introduced.
 """
@@ -114,12 +117,19 @@ def hash_aggregate(
     group_keys: Sequence[Tuple[str, Expr]],
     aggs: Sequence[AggCall],
     max_groups: int,
+    errors_out: Optional[List] = None,
 ) -> Tuple[Page, jnp.ndarray]:
     """Group ``page`` by key expressions, compute aggregates.
 
     Returns (result_page, overflow) where overflow is a traced bool: True
     when the data had more than ``max_groups`` groups (host must re-run
     with a larger bucket; surplus groups were dropped).
+
+    ``errors_out``, when given, collects ``(message, traced_bool)`` hard
+    errors — currently the bigint-sum overflow trap of the sorted path
+    (the reference raises on per-group bigint overflow; the sorted path's
+    page-wide running total would otherwise wrap *silently* even when
+    individual group sums are in range — see _sorted_one_agg).
 
     Global aggregation (no keys) is the plain-reduction degenerate case.
     """
@@ -146,7 +156,9 @@ def hash_aggregate(
                 live, lowerer,
             )
 
-    return _sorted_aggregate(page, keys, aggs, max_groups, live, lowerer)
+    return _sorted_aggregate(
+        page, keys, aggs, max_groups, live, lowerer, errors_out
+    )
 
 
 # --------------------------------------------------------- one-hot path
@@ -345,6 +357,7 @@ def _sorted_aggregate(
     max_groups: int,
     live: jnp.ndarray,
     lowerer: ExprLowerer,
+    errors_out: Optional[List] = None,
 ) -> Tuple[Page, jnp.ndarray]:
     cap = page.capacity
     order = sort_order(
@@ -379,7 +392,8 @@ def _sorted_aggregate(
 
     for agg in aggs:
         blk = _sorted_one_agg(
-            agg, page, order, live_s, bnd, starts, ends, lowerer
+            agg, page, order, live_s, bnd, starts, ends, lowerer,
+            errors_out,
         )
         names.append(agg.out_name)
         blocks.append(blk)
@@ -410,6 +424,7 @@ def _sorted_one_agg(
     starts: jnp.ndarray,
     ends: jnp.ndarray,
     lowerer: ExprLowerer,
+    errors_out: Optional[List] = None,
 ) -> Block:
     rt = agg.result_type()
 
@@ -449,6 +464,21 @@ def _sorted_one_agg(
             return Block(data=s, valid=group_has_value, dtype=T.DOUBLE)
         x = jnp.where(valid_s, d.astype(jnp.int64), 0)
         s = _cumsum_span(x, starts, ends)
+        if errors_out is not None:
+            # per-group overflow trap: the differenced int64 sums are
+            # exact under modular arithmetic whenever the TRUE group sum
+            # fits int64 (even if the page-wide running total wraps), so
+            # the check must be per group — a float64 shadow of the same
+            # span difference. A real per-group overflow displaces the
+            # int result ~2^64 from the shadow; float cancellation error
+            # stays many orders below the 2^62 threshold.
+            sf = _cumsum_span(x.astype(jnp.float64), starts, ends)
+            wrapped = jnp.any(
+                jnp.abs(s.astype(jnp.float64) - sf) > 2.0**62
+            )
+            errors_out.append(
+                (f"bigint sum overflow in {agg.out_name}", wrapped)
+            )
         return Block(data=s, valid=group_has_value, dtype=rt)
 
     if agg.func in ("min", "max"):

@@ -96,6 +96,12 @@ class DistributedQueryRunner(LocalQueryRunner):
         repl_threshold: int = 1 << 13,
     ):
         super().__init__(catalogs=catalogs, session=session)
+        if n_devices is None:
+            # hash_partition_count session property (reference: the
+            # fixed hash-distribution width; 0 = use every device)
+            hpc = int(self.session.get("hash_partition_count"))
+            if hpc > 0:
+                n_devices = hpc
         if devices is None:
             devices = jax.devices()
             if n_devices is not None:
@@ -378,7 +384,17 @@ class DistributedQueryRunner(LocalQueryRunner):
 
         if db == "repl":
             return local_join(probe, build), dp
-        if dp == "repl" or build.capacity <= self.broadcast_threshold:
+        # join_distribution_type session property (reference:
+        # AddExchanges' cost-based choice, overridable per session):
+        # AUTOMATIC = capacity threshold, BROADCAST = always replicate
+        # the build side, PARTITIONED = always hash-repartition both
+        jdt = str(self.session.get("join_distribution_type")).upper()
+        broadcast = (
+            build.capacity <= self.broadcast_threshold
+            if jdt == "AUTOMATIC"
+            else jdt == "BROADCAST"
+        )
+        if dp == "repl" or broadcast:
             # REPLICATED join: all_gather the build side (AddExchanges'
             # broadcast choice for small builds)
             return local_join(probe, replicate(build, nw, _AXIS)), dp

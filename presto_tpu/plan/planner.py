@@ -765,11 +765,23 @@ class _Planner:
 
     def _apply_exists(self, node, scope, a: ast.Exists, negate):
         q = a.query
-        corr_pairs, residual_where = self._extract_correlation(q, scope)
+        corr_pairs, neq_pairs, residual_where = self._extract_correlation(
+            q, scope, collect_neq=True
+        )
         if not corr_pairs:
             raise PlanningError(
                 "uncorrelated or non-equality-correlated EXISTS is not "
                 "supported yet"
+            )
+        if neq_pairs:
+            if len(neq_pairs) > 1:
+                raise PlanningError(
+                    "EXISTS with multiple inequality-correlated "
+                    "conjuncts is not supported"
+                )
+            return self._apply_exists_neq(
+                node, scope, q, corr_pairs, neq_pairs[0],
+                residual_where, negate,
             )
         inner_cols = tuple(p[0] for p in corr_pairs)
         inner_sel = ast.Select(
@@ -790,6 +802,103 @@ class _Planner:
             right_keys=sub_names,
             payload=(),
         )
+        return node, scope
+
+    def _apply_exists_neq(
+        self, node, scope, q, corr_pairs, neq_pair, residual_where, negate
+    ):
+        """Decorrelate ``EXISTS(inner.k = outer.k AND inner.c <> outer.c
+        [AND pure-inner residual])`` by counting (the classic Q21
+        rewrite; reference: ApplyNode correlated-EXISTS transformations):
+
+            cnt_all(k)    = rows of inner per equality key with c NOT NULL
+            cnt_self(k,c) = rows of inner per (key, c)
+            EXISTS     <=> outer.c IS NOT NULL
+                           AND coalesce(cnt_all,0)-coalesce(cnt_self,0) > 0
+            NOT EXISTS <=> outer.c IS NULL
+                           OR coalesce(cnt_all,0)-coalesce(cnt_self,0) = 0
+
+        NULL semantics: an inner row with c NULL makes ``c <> outer.c``
+        UNKNOWN (never satisfies EXISTS), so cnt_all counts ``count(c)``,
+        not ``count(*)``; an outer row with c NULL makes every comparison
+        UNKNOWN, so EXISTS is forced false (NOT EXISTS true) regardless
+        of counts. Both lookups are left joins against grouped (hence
+        unique-keyed) builds — TPU-friendly: two hash joins + a filter,
+        no per-row subquery."""
+        inner_eq = [p[0] for p in corr_pairs]
+        outer_eq = [p[1] for p in corr_pairs]
+        neq_inner, neq_outer = neq_pair
+
+        def grouped_count(group_cols, count_col):
+            aliases = [self._fresh("ckey") for _ in group_cols]
+            cnt = self._fresh("cnt")
+            count_args = (
+                (ast.Ident((count_col,)),) if count_col is not None else ()
+            )
+            sel = ast.Select(
+                items=tuple(
+                    ast.SelectItem(ast.Ident((c,)), alias)
+                    for c, alias in zip(group_cols, aliases)
+                )
+                + (
+                    ast.SelectItem(
+                        ast.FuncCall("count", count_args), cnt.lstrip("$")
+                    ),
+                ),
+                from_=q.from_,
+                where=residual_where,
+                group_by=tuple(ast.Ident((c,)) for c in group_cols),
+                ctes=q.ctes,
+            )
+            sub_node, _, sub_names = self.plan_select(sel, outer=None)
+            return sub_node, sub_names[:-1], sub_names[-1]
+
+        all_node, all_keys, cnt_all = grouped_count(inner_eq, neq_inner)
+        self_node, self_keys, cnt_self = grouped_count(
+            inner_eq + [neq_inner], None
+        )
+
+        node = N.JoinNode(
+            left=node,
+            right=all_node,
+            join_type="left",
+            left_keys=tuple(outer_eq),
+            right_keys=tuple(all_keys),
+            payload=(cnt_all,),
+            build_unique=True,  # grouped by the join keys
+        )
+        node = N.JoinNode(
+            left=node,
+            right=self_node,
+            join_type="left",
+            left_keys=tuple(outer_eq) + (neq_outer,),
+            right_keys=tuple(self_keys),
+            payload=(cnt_self,),
+            build_unique=True,
+        )
+        sch = node.output_schema()
+        zero = E.Literal(0, T.BIGINT)
+        diff = E.arith(
+            "-",
+            E.Coalesce((E.ColumnRef(cnt_all, sch[cnt_all]), zero), T.BIGINT),
+            E.Coalesce(
+                (E.ColumnRef(cnt_self, sch[cnt_self]), zero), T.BIGINT
+            ),
+        )
+        outer_c = E.ColumnRef(neq_outer, sch[neq_outer])
+        if negate:  # NOT EXISTS
+            pred: E.Expr = E.Or(
+                (E.IsNull(outer_c), E.Compare("=", diff, zero))
+            )
+        else:  # EXISTS
+            pred = E.And(
+                (
+                    E.IsNull(outer_c, negate=True),
+                    E.Compare(">", diff, zero),
+                )
+            )
+        node = N.FilterNode(node, pred)
+        # the helper count columns are internal: restore the outer scope
         return node, scope
 
     def _apply_correlated_scalar(self, node, scope, cmp: ast.BinaryOp):
@@ -843,20 +952,33 @@ class _Planner:
             pred = E.Compare(cmp.op, other, val_ref)
         return N.FilterNode(node, pred), scope
 
-    def _extract_correlation(self, q: ast.Select, outer_scope: Scope):
+    def _extract_correlation(
+        self,
+        q: ast.Select,
+        outer_scope: Scope,
+        collect_neq: bool = False,
+    ):
         """Split the inner WHERE into (inner_col = outer_col) correlation
-        pairs and the residual. Returns ([(inner_col, outer_col)], where)."""
+        pairs and the residual. Returns ([(inner_col, outer_col)], where)
+        — or, with ``collect_neq``, a 3-tuple whose middle element lists
+        (inner_col <> outer_col) pairs (Q21's correlation shape)."""
         inner_node_probe, inner_scope = self._plan_from(q.from_, None)
         pairs: List[Tuple[str, str]] = []
+        neq_pairs: List[Tuple[str, str]] = []
         rest: List[ast.Node] = []
         for c in _split_conjuncts(q.where) if q.where is not None else []:
             pair = None
+            is_eq = True
             if (
                 isinstance(c, ast.BinaryOp)
-                and c.op == "="
+                and (
+                    c.op == "="
+                    or (collect_neq and c.op in ("<>", "!="))
+                )
                 and isinstance(c.left, ast.Ident)
                 and isinstance(c.right, ast.Ident)
             ):
+                is_eq = c.op == "="
                 for inner_ast, outer_ast in (
                     (c.left, c.right),
                     (c.right, c.left),
@@ -878,8 +1000,10 @@ class _Planner:
                         continue
                     pair = (ic, oc)
                     break
-            if pair:
+            if pair and is_eq:
                 pairs.append(pair)
+            elif pair:
+                neq_pairs.append(pair)
             else:
                 rest.append(c)
         where = None
@@ -887,6 +1011,8 @@ class _Planner:
             where = rest[0]
             for c in rest[1:]:
                 where = ast.BinaryOp("and", where, c)
+        if collect_neq:
+            return pairs, neq_pairs, where
         return pairs, where
 
     # --------------------------------------------------------- aggregation
@@ -1196,15 +1322,12 @@ class _Planner:
                         raise PlanningError("substring length must be literal")
                     length = int(length_l.value)
                 key = f"substring:{start}:{length}"
-                if length is None:
-                    fn = lambda s, st=start: s[st - 1 :]  # noqa: E731
-                else:
-                    fn = lambda s, st=start, ln=length: s[st - 1 : st - 1 + ln]  # noqa: E731
-                return E.DictTransform(arg, key, fn)
+                return E.DictTransform(arg, key, E.dict_transform_fn(key))
             if e.name in ("lower", "upper"):
                 arg = lower(e.args[0])
-                fn = str.lower if e.name == "lower" else str.upper
-                return E.DictTransform(arg, e.name, fn)
+                return E.DictTransform(
+                    arg, e.name, E.dict_transform_fn(e.name)
+                )
             if e.name == "coalesce":
                 args = tuple(lower(a) for a in e.args)
                 rt = args[0].dtype

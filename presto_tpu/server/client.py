@@ -1,0 +1,76 @@
+"""Client: drives the paged ``/v1/statement`` protocol.
+
+Reference parity: the ``StatementClient`` inside ``presto-client/``
+(SURVEY.md §1 L0) — submit SQL with one POST, then follow ``nextUri``
+pages until the response carries no continuation, accumulating data
+rows; surface server-side failures as exceptions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+
+class QueryFailed(RuntimeError):
+    """The server reported the query FAILED."""
+
+
+@dataclasses.dataclass
+class ClientResult:
+    """Materialized result of one statement."""
+
+    query_id: str
+    columns: List[str]
+    data: List[list]
+
+    def rows(self) -> List[tuple]:
+        return [tuple(r) for r in self.data]
+
+
+class PrestoTpuClient:
+    """Minimal blocking client for one coordinator."""
+
+    def __init__(self, coordinator_uri: str, timeout_s: float = 120.0):
+        self.uri = coordinator_uri.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def execute(self, sql: str) -> ClientResult:
+        first = self._post_json(
+            self.uri + "/v1/statement", sql.encode()
+        )
+        qid = first["id"]
+        columns: List[str] = []
+        data: List[list] = []
+        cur = first
+        deadline = time.time() + self.timeout_s
+        while True:
+            if "error" in cur:
+                raise QueryFailed(cur["error"])
+            if cur.get("columns"):
+                columns = [c["name"] for c in cur["columns"]]
+            data.extend(cur.get("data") or [])
+            nxt = cur.get("nextUri")
+            if not nxt:
+                return ClientResult(query_id=qid, columns=columns, data=data)
+            if time.time() > deadline:
+                raise TimeoutError(f"query {qid} did not finish in time")
+            cur = self._get_json(nxt)
+
+    # ------------------------------------------------------------ http
+
+    def _post_json(self, url: str, body: bytes) -> dict:
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "text/plain"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def _get_json(self, url: str) -> dict:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return json.loads(resp.read())

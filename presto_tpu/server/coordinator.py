@@ -205,6 +205,10 @@ class CoordinatorServer:
     def _run_stage(self, fragment_root, workers, q: _Query):
         """Schedule one fragment across workers; gather + finalize."""
         stage = plan_stage(fragment_root, self.local.catalogs)
+        if stage is None:
+            # no scan admits a semantics-preserving partitioning:
+            # single-task fallback on the coordinator's local engine
+            return self.local._run(fragment_root)
         ranges = assign_ranges(stage.partition_rows, len(workers))
         specs = []
         for w, (lo, hi) in zip(workers, ranges):
@@ -218,6 +222,12 @@ class CoordinatorServer:
                         partition_scan=stage.partition_scan,
                         split_start=lo,
                         split_end=hi,
+                        split_batch_rows=int(
+                            self.local.session.get("page_capacity")
+                        ),
+                        task_concurrency=int(
+                            self.local.session.get("task_concurrency")
+                        ),
                     ),
                 )
             )
@@ -245,9 +255,17 @@ class CoordinatorServer:
         schema = dict(stage.worker_fragment.output_schema())
         merged = pages_wire.merge_payloads(payloads, schema)
         page = stage_page(merged, schema)
-        return self.local._run_with_pages(
-            stage.final_root, remote, [page]
-        )
+        # the final plan may contain real scans above the cut (e.g. a
+        # join against another table after the final aggregation) —
+        # load those locally alongside the gathered remote page
+        local_scans = [
+            n
+            for n in N.walk(stage.final_root)
+            if isinstance(n, N.TableScanNode)
+        ]
+        leaves = remote + local_scans
+        pages = [page] + [self.local._load_table(s) for s in local_scans]
+        return self.local._run_with_pages(stage.final_root, leaves, pages)
 
     def _pull_task(self, w, spec) -> List[tuple]:
         """Token-acked page pulls until X-Complete (exchange client)."""
@@ -313,7 +331,9 @@ def _make_handler(coord: CoordinatorServer):
             pass
 
         def _json(self, code: int, obj) -> None:
-            body = json.dumps(obj).encode()
+            # default=str: result rows may carry dates/decimals; the
+            # oracle-compatible wire form is their string rendering
+            body = json.dumps(obj, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -131,6 +131,80 @@ def deserialize_page(buf: bytes):
     return payload, schema, nrows
 
 
+def merge_payloads(
+    payloads: List[tuple], schema: Dict[str, T.DataType]
+) -> Dict[str, object]:
+    """Merge deserialized wire pages ``(payload, schema, nrows)`` from
+    many workers into ONE staging payload for ``stage_page``.
+
+    Dictionary-encoded columns need id remapping: each worker built its
+    dictionary from the values *it* saw, so id spaces differ across
+    payloads. Dictionaries are sorted-unique by construction (order-
+    preserving, see connectors.tpch.DictColumn), so the union dictionary
+    is the sorted union of values and remapping is a searchsorted.
+    """
+    out: Dict[str, object] = {}
+    for name in schema:
+        parts = []  # (data, valid|None, dict_values|None) per payload
+        for payload, _schema, nrows in payloads:
+            col = payload[name]
+            if isinstance(col, MaskedColumn):
+                parts.append((col.data, col.valid, col.values))
+            elif isinstance(col, DictColumn):
+                parts.append((np.asarray(col.ids, np.int32), None,
+                              tuple(col.values)))
+            else:
+                parts.append((np.asarray(col), None, None))
+        has_dict = any(v is not None for _, _, v in parts)
+        has_valid = any(v is not None for _, v, _ in parts)
+        if has_dict:
+            union = sorted(set().union(*[
+                v if v is not None else () for _, _, v in parts
+            ]))
+            uarr = np.asarray(union, dtype=object)
+            datas, valids = [], []
+            for data, valid, values in parts:
+                ids = np.asarray(data, np.int64)
+                if values:
+                    vals = np.asarray(values, dtype=object)
+                    remap = np.searchsorted(uarr, vals).astype(np.int64)
+                    # clip: padded/NULL slots may carry out-of-range ids
+                    ids = remap[np.clip(ids, 0, len(vals) - 1)]
+                datas.append(ids.astype(np.int32))
+                valids.append(
+                    valid
+                    if valid is not None
+                    else np.ones(len(ids), dtype=bool)
+                )
+            data = np.concatenate(datas) if datas else np.empty(0, np.int32)
+            if has_valid:
+                out[name] = MaskedColumn(
+                    data=data,
+                    valid=np.concatenate(valids),
+                    values=tuple(union),
+                )
+            else:
+                out[name] = DictColumn(ids=data, values=np.asarray(union))
+        else:
+            datas = [np.asarray(d) for d, _, _ in parts]
+            data = (
+                np.concatenate(datas)
+                if datas
+                else np.empty(0, schema[name].np_dtype)
+            )
+            if has_valid:
+                valids = [
+                    v if v is not None else np.ones(len(d), dtype=bool)
+                    for d, v, _ in parts
+                ]
+                out[name] = MaskedColumn(
+                    data=data, valid=np.concatenate(valids)
+                )
+            else:
+                out[name] = data
+    return out
+
+
 def page_to_wire_columns(page, fetched_n: Optional[int] = None):
     """Device Page -> serialize_page input, with ONE batched device->host
     fetch (two-phase; see exec.host_ops for the relay rationale)."""

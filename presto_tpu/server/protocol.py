@@ -59,6 +59,9 @@ def encode(obj: Any) -> Any:
     if isinstance(obj, T.DataType):
         if obj.is_decimal:
             return {"@": "decimal", "p": obj.precision, "s": obj.scale}
+        if isinstance(obj, T.VarcharType) and obj.length is not None:
+            # parameterized varchar(n)/char(n): name not in singletons
+            return {"@": "varchar", "len": obj.length}
         return {"@": "type", "name": obj.name}
     if isinstance(obj, (tuple, list)):
         return [encode(x) for x in obj]
@@ -68,6 +71,12 @@ def encode(obj: Any) -> Any:
             raise TypeError(f"{cls.__name__} is not wire-registered")
         out = {"@": cls.__name__}
         for f in dataclasses.fields(obj):
+            if f.name == "fn" and isinstance(
+                obj, (E.DictTransform, E.DictPredicate)
+            ):
+                # host callables don't cross the wire: fn_key is the
+                # canonical identity, rebuilt at decode time
+                continue
             out[f.name] = encode(getattr(obj, f.name))
         return out
     raise TypeError(f"cannot encode {type(obj).__name__}")
@@ -82,6 +91,8 @@ def decode(data: Any) -> Any:
     tag = data.get("@")
     if tag == "decimal":
         return T.decimal(data["p"], data["s"])
+    if tag == "varchar":
+        return T.varchar(data["len"])
     if tag == "type":
         return _TYPE_SINGLETONS[data["name"]]
     cls = _REGISTRY.get(tag)
@@ -91,6 +102,8 @@ def decode(data: Any) -> Any:
     for f in dataclasses.fields(cls):
         if f.name in data:
             kwargs[f.name] = _coerce(decode(data[f.name]), f.type, cls)
+    if cls in (E.DictTransform, E.DictPredicate) and "fn" not in kwargs:
+        kwargs["fn"] = E.dict_transform_fn(kwargs["fn_key"])
     return cls(**kwargs)
 
 
@@ -119,6 +132,14 @@ class FragmentSpec:
     partition_scan: int  # walk index of the partitioned TableScanNode
     split_start: int  # row range of the partitioned scan owned here
     split_end: int
+    #: rows per split batch streamed through the compiled fragment
+    #: (session ``page_capacity``; 0 = the whole range in one batch).
+    #: Safe because the coordinator's FINAL step merges partial states,
+    #: so per-batch partials concatenate like per-worker partials.
+    split_batch_rows: int = 0
+    #: concurrent split-batch drivers per task (session
+    #: ``task_concurrency``; reference: task.concurrency driver count)
+    task_concurrency: int = 1
 
     def to_json(self) -> dict:
         return {
@@ -128,6 +149,8 @@ class FragmentSpec:
             "partition_scan": self.partition_scan,
             "split_start": self.split_start,
             "split_end": self.split_end,
+            "split_batch_rows": self.split_batch_rows,
+            "task_concurrency": self.task_concurrency,
         }
 
     @staticmethod
@@ -139,4 +162,6 @@ class FragmentSpec:
             partition_scan=d["partition_scan"],
             split_start=d["split_start"],
             split_end=d["split_end"],
+            split_batch_rows=d.get("split_batch_rows", 0),
+            task_concurrency=d.get("task_concurrency", 1),
         )

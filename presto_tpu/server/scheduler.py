@@ -1,34 +1,36 @@
 """Cross-host query scheduling: fragment -> per-worker tasks.
 
 Reference parity: ``SqlQueryScheduler`` / ``SqlStageExecution`` — a leaf
-stage is N tasks over dynamically assigned splits of the partitioned
-source, intermediate data flows through exchanges, the root stage
-gathers (SURVEY.md §2.1 "Query scheduler", §3.2).
+stage is N tasks over assigned splits of the partitioned source,
+intermediate data flows through exchanges, the root stage gathers
+(SURVEY.md §2.1 "Query scheduler", §3.2).
 
-TPU-first shape (round-1 multihost):
-- ONE source-partitioned stage per distributable fragment: the scan
-  with the largest stats row count is split by row ranges across
-  workers; every other scan is replicated (each worker scans it fully —
-  the reference's REPLICATED build-side choice, SURVEY.md §2.4).
-- Fragments whose root is an aggregation/distinct split into PARTIAL
-  (worker) / FINAL (coordinator merge) steps via the same
-  ``split_aggregation`` rewrite the in-slice engine uses.
-- The coordinator pulls every task's pages (GATHER), concatenates, and
-  finishes the plan locally (final agg + any non-distributable top +
-  the host root stage).
-
-Worker-to-worker hash repartition (the REPARTITION exchange crossing
-hosts) is intentionally absent this round: inside each worker the
-slice-level all_to_all already repartitions across its local mesh, and
-the cross-host cut is gather-shaped.
+TPU-first shape:
+- ONE source-partitioned stage per distributable fragment: a scan is
+  split by row ranges across workers; every other scan is replicated
+  (each worker scans it fully — the reference's REPLICATED build-side
+  choice, SURVEY.md §2.4).
+- The partitioned scan must reach the stage cut through row-distributive
+  edges only: filters, projections, and the *streamed/probe* side of
+  joins (the preserved side of outer joins). Concatenating per-worker
+  results is only correct when each input row's contribution is
+  independent of the partition — the reference encodes the same rule by
+  hash-partitioning the probe side and broadcasting the build side.
+- The stage is CUT at the lowest aggregation/distinct above the
+  partitioned scan: workers run the PARTIAL step, the coordinator runs
+  the FINAL merge (via the same ``split_aggregation`` rewrite the
+  in-slice engine uses) and then everything above the cut (which may
+  include further joins/aggregations over full gathered data).
+- If no scan admits a valid partitioning, ``plan_stage`` returns None
+  and the coordinator executes the fragment locally (correctness first;
+  the reference similarly falls back to single-task stages).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
-from presto_tpu import expr as E
 from presto_tpu.parallel.agg_split import split_aggregation
 from presto_tpu.plan import nodes as N
 
@@ -43,58 +45,140 @@ class StagePlan:
     partition_rows: int  # total row count of the partitioned table
 
 
-def plan_stage(fragment_root: N.PlanNode, catalogs) -> StagePlan:
-    """Decompose one distributable fragment into worker/final steps."""
-    worker_root = fragment_root
-    remote = N.RemoteSourceNode(fragment_root=fragment_root)
+def plan_stage(
+    fragment_root: N.PlanNode, catalogs
+) -> Optional[StagePlan]:
+    """Decompose one distributable fragment into worker/final steps.
 
-    if isinstance(fragment_root, N.AggregationNode) and fragment_root.aggs:
-        partial_aggs, fkeys, faggs, post = split_aggregation(
-            fragment_root.group_keys, fragment_root.aggs
-        )
-        worker_root = dataclasses.replace(fragment_root, aggs=partial_aggs)
-        remote = N.RemoteSourceNode(fragment_root=worker_root)
-        final: N.PlanNode = N.AggregationNode(
-            source=remote,
-            group_keys=fkeys,
-            aggs=faggs,
-            max_groups=fragment_root.max_groups,
-        )
-        if post:
-            final = N.ProjectNode(source=final, projections=post)
-    elif isinstance(fragment_root, N.DistinctNode):
-        # distinct-of-distinct: worker dedups its shard, final dedups
-        final = N.DistinctNode(
-            source=remote, max_groups=fragment_root.max_groups
-        )
+    Tries candidate partition scans largest-first; returns None when no
+    scan can be partitioned without changing semantics (the coordinator
+    then runs the fragment locally).
+    """
+    scans = [
+        n for n in N.walk(fragment_root) if isinstance(n, N.TableScanNode)
+    ]
+    sized: List[Tuple[int, N.TableScanNode]] = []
+    for s in scans:
+        conn = catalogs.get(s.handle.catalog)
+        stats = conn.metadata().get_table_stats(s.handle)
+        sized.append((int(stats.row_count or 0), s))
+    sized.sort(key=lambda t: -t[0])
+
+    for rows, scan in sized:
+        stage = _try_cut(fragment_root, scan, rows)
+        if stage is not None:
+            return stage
+    return None
+
+
+def _path_to(root: N.PlanNode, target: N.PlanNode) -> Optional[list]:
+    """Node path root->...->target by identity, or None."""
+    if root is target:
+        return [root]
+    for c in root.children():
+        sub = _path_to(c, target)
+        if sub is not None:
+            return [root] + sub
+    return None
+
+
+def _edge_distributive(parent: N.PlanNode, child: N.PlanNode) -> bool:
+    """True when partitioning ``child``'s rows and concatenating
+    ``parent``'s per-partition outputs equals running ``parent`` whole.
+    """
+    if isinstance(parent, (N.FilterNode, N.ProjectNode)):
+        return True
+    if isinstance(parent, N.JoinNode):
+        if parent.join_type == "inner":
+            return True  # inner join distributes over either side
+        # semi/anti/left preserve the LEFT (probe) side only
+        return child is parent.left
+    if isinstance(parent, N.CrossJoinNode):
+        # right side is a broadcast scalar; only the left streams
+        return child is parent.left
+    return False
+
+
+def _try_cut(
+    fragment_root: N.PlanNode, scan: N.TableScanNode, rows: int
+) -> Optional[StagePlan]:
+    path = _path_to(fragment_root, scan)
+    if path is None:
+        return None
+
+    # lowest aggregation/distinct above the scan = the stage cut
+    cut_i = None
+    for i in range(len(path) - 2, -1, -1):
+        if isinstance(path[i], (N.AggregationNode, N.DistinctNode)):
+            cut_i = i
+            break
+    # every edge from the scan up to (but not including) the cut must be
+    # row-distributive; with no cut, every edge up to the root
+    lowest_parent = cut_i + 1 if cut_i is not None else 0
+    for i in range(len(path) - 1, lowest_parent, -1):
+        if not _edge_distributive(path[i - 1], path[i]):
+            return None
+
+    if cut_i is None:
         worker_root = fragment_root
+        final_root: N.PlanNode = N.RemoteSourceNode(
+            fragment_root=worker_root
+        )
     else:
-        final = remote
+        cut = path[cut_i]
+        if isinstance(cut, N.AggregationNode):
+            partial_aggs, fkeys, faggs, post = split_aggregation(
+                cut.group_keys, cut.aggs
+            )
+            worker_root = dataclasses.replace(cut, aggs=partial_aggs)
+            remote = N.RemoteSourceNode(fragment_root=worker_root)
+            final_sub: N.PlanNode = N.AggregationNode(
+                source=remote,
+                group_keys=fkeys,
+                aggs=faggs,
+                max_groups=cut.max_groups,
+            )
+            if post:
+                final_sub = N.ProjectNode(
+                    source=final_sub, projections=post
+                )
+        else:  # DistinctNode: dedup-of-dedups
+            worker_root = cut
+            remote = N.RemoteSourceNode(fragment_root=worker_root)
+            final_sub = N.DistinctNode(
+                source=remote, max_groups=cut.max_groups
+            )
+        final_root = _replace_on_path(path[:cut_i], cut, final_sub)
 
-    scan_idx, rows = _pick_partition_scan(worker_root, catalogs)
+    scan_idx = None
+    for i, node in enumerate(N.walk(worker_root)):
+        if node is scan:
+            scan_idx = i
+            break
+    if scan_idx is None:  # scan above the cut: nothing to partition
+        return None
     return StagePlan(
         worker_fragment=worker_root,
-        final_root=final,
+        final_root=final_root,
         partition_scan=scan_idx,
         partition_rows=rows,
     )
 
 
-def _pick_partition_scan(root: N.PlanNode, catalogs) -> Tuple[int, int]:
-    """Walk index + row count of the scan to shard across workers (the
-    largest table by connector stats — the probe side in practice)."""
-    best_idx, best_rows = -1, -1
-    for i, node in enumerate(N.walk(root)):
-        if not isinstance(node, N.TableScanNode):
-            continue
-        conn = catalogs.get(node.handle.catalog)
-        stats = conn.metadata().get_table_stats(node.handle)
-        rows = int(stats.row_count or 0)
-        if rows > best_rows:
-            best_idx, best_rows = i, rows
-    if best_idx < 0:
-        raise ValueError("fragment has no table scan to partition")
-    return best_idx, best_rows
+def _replace_on_path(
+    ancestors: list, old: N.PlanNode, new: N.PlanNode
+) -> N.PlanNode:
+    """Rebuild the ancestor chain with ``old`` (a direct child of the
+    last ancestor) swapped for ``new``."""
+    for parent in reversed(ancestors):
+        changes = {}
+        for f in dataclasses.fields(parent):
+            if getattr(parent, f.name) is old:
+                changes[f.name] = new
+        assert changes, "path ancestor does not reference its child"
+        new = dataclasses.replace(parent, **changes)
+        old = parent
+    return new
 
 
 def assign_ranges(total_rows: int, n_workers: int) -> List[Tuple[int, int]]:

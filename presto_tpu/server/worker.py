@@ -38,14 +38,49 @@ from presto_tpu.utils.metrics import REGISTRY
 #: rows per exchange page (the reference pages its exchange similarly)
 PAGE_ROWS = 1 << 16
 
+#: max unacked pages buffered per task before the producer blocks
+#: (reference: bounded OutputBuffer / sink.max-buffer-size blocking the
+#: producer driver, SURVEY.md §2.5 "Backpressure")
+MAX_BUFFERED_PAGES = 64
+
 
 class _Task:
     def __init__(self, spec: FragmentSpec):
         self.spec = spec
         self.state = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|ABORTED
         self.error: Optional[str] = None
-        self.pages: List[bytes] = []
+        self.pages: List[Optional[bytes]] = []  # None = acked + freed
+        self.acked = 0  # pages below this token are freed
+        self.cond = threading.Condition()
         self.created = time.time()
+
+    def offer_page(self, page: bytes) -> None:
+        """Producer side: blocks while the buffer is full (backpressure);
+        raises if the task was aborted while blocked."""
+        with self.cond:
+            while (
+                len(self.pages) - self.acked >= MAX_BUFFERED_PAGES
+                and self.state == "RUNNING"
+            ):
+                self.cond.wait(timeout=0.1)
+            if self.state == "ABORTED":
+                raise RuntimeError("task aborted")
+            self.pages.append(page)
+
+    def ack_below(self, token: int) -> None:
+        """Consumer side: pulling token N acks (frees) pages < N."""
+        with self.cond:
+            for i in range(self.acked, min(token, len(self.pages))):
+                self.pages[i] = None
+            if token > self.acked:
+                self.acked = token
+            self.cond.notify_all()
+
+    def abort(self) -> None:
+        with self.cond:
+            if self.state in ("QUEUED", "RUNNING"):
+                self.state = "ABORTED"
+            self.cond.notify_all()
 
 
 class WorkerServer:
@@ -151,29 +186,77 @@ class WorkerServer:
             REGISTRY.counter("worker.tasks_failed").update()
 
     def _execute(self, task: _Task) -> None:
+        """Stream split batches of the partitioned scan through the
+        compiled fragment (reference: split parallelism — drivers pull
+        split batches through the pipeline, SURVEY.md §2.4). Per-batch
+        outputs are partial states the coordinator's FINAL step merges,
+        so batching is semantics-preserving; it also bounds device
+        residency to one batch (the grouped-execution memory shape).
+        ``task_concurrency`` drivers overlap host staging with device
+        execution."""
         spec = task.spec
         root = spec.fragment
         scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
         walk_ids = {
             id(n): i for i, n in enumerate(N.walk(root))
         }
-        pages = []
+        part_scan = None
+        repl_pages = {}
         for s in scans:
             if walk_ids[id(s)] == spec.partition_scan:
-                payload = self._load_range(s, spec.split_start, spec.split_end)
-                page = stage_page(payload, dict(s.schema))
+                part_scan = s
             else:
-                page = self.runner._load_table(s)  # replicated: cacheable
-            pages.append(page)
-        out = self.runner._run_with_pages(root, scans, pages)
-        cols, n = pages_wire.page_to_wire_columns(out)
-        for lo in range(0, max(n, 1), PAGE_ROWS):
-            hi = min(lo + PAGE_ROWS, n)
-            chunk = [
-                (name, data[lo:hi], None if v is None else v[lo:hi], t, dv)
-                for name, data, v, t, dv in cols
-            ]
-            task.pages.append(pages_wire.serialize_page(chunk, hi - lo))
+                repl_pages[id(s)] = self.runner._load_table(s)
+
+        total = spec.split_end - spec.split_start
+        batch = spec.split_batch_rows or max(total, 1)
+        ranges = [
+            (lo, min(lo + batch, spec.split_end))
+            for lo in range(spec.split_start, spec.split_end, batch)
+        ] or [(spec.split_start, spec.split_end)]
+
+        def run_batch(lo: int, hi: int):
+            pages = []
+            for s in scans:
+                if s is part_scan:
+                    payload = self._load_range(s, lo, hi)
+                    # fixed capacity bucket: every full batch reuses one
+                    # compiled program
+                    pages.append(
+                        stage_page(payload, dict(s.schema))
+                    )
+                else:
+                    pages.append(repl_pages[id(s)])
+            return self.runner._run_with_pages(root, scans, pages)
+
+        def emit(out) -> None:
+            cols, n = pages_wire.page_to_wire_columns(out)
+            for lo in range(0, max(n, 1), PAGE_ROWS):
+                hi = min(lo + PAGE_ROWS, n)
+                chunk = [
+                    (
+                        name,
+                        data[lo:hi],
+                        None if v is None else v[lo:hi],
+                        t,
+                        dv,
+                    )
+                    for name, data, v, t, dv in cols
+                ]
+                task.offer_page(
+                    pages_wire.serialize_page(chunk, hi - lo)
+                )
+
+        if spec.task_concurrency <= 1 or len(ranges) <= 1:
+            for lo, hi in ranges:
+                emit(run_batch(lo, hi))
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(spec.task_concurrency) as pool:
+            futs = [pool.submit(run_batch, lo, hi) for lo, hi in ranges]
+            for f in futs:
+                emit(f.result())
 
     def _load_range(self, scan: N.TableScanNode, lo: int, hi: int):
         conn = self.runner.catalogs.get(scan.handle.catalog)
@@ -250,7 +333,10 @@ def _make_handler(worker: WorkerServer):
                 token = int(parts[5])
                 if t.state == "FAILED":
                     return self._json(500, {"error": t.error})
-                if token < len(t.pages):
+                # pulling token N acks pages < N (frees buffer slots and
+                # unblocks the producer — the reference's token-advance ack)
+                t.ack_below(token)
+                if token < len(t.pages) and t.pages[token] is not None:
                     body = t.pages[token]
                     self.send_response(200)
                     self.send_header(
@@ -298,8 +384,8 @@ def _make_handler(worker: WorkerServer):
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                 with worker._lock:
                     t = worker.tasks.pop(parts[2], None)
-                if t is not None and t.state in ("QUEUED", "RUNNING"):
-                    t.state = "ABORTED"
+                if t is not None:
+                    t.abort()
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
 

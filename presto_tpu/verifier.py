@@ -364,3 +364,27 @@ def verify_query(
     stmt = parse_statement(sql)
     ordered = bool(stmt.order_by)
     return diff_results(ours, theirs, ordered, rel_tol)
+
+
+def verify_offload(sql: str, rel_tol: float = 1e-6) -> Optional[str]:
+    """Cross-backend verification: run the SAME SQL on this engine with
+    ``tpu_offload`` on and off and diff the results — the reference's
+    presto-verifier control-vs-test replay (SURVEY.md §4.7), with the
+    backend swap happening at the session gate instead of across
+    clusters. On a CPU-only host both runs share a platform (the diff
+    still exercises two separately compiled executables); on a TPU host
+    this is the TPU-vs-CPU semantic sanitizer."""
+    from presto_tpu.exec.local_runner import LocalQueryRunner
+    from presto_tpu.session import Session
+
+    on = LocalQueryRunner(
+        session=Session(properties={"tpu_offload": True})
+    )
+    off = LocalQueryRunner(
+        session=Session(properties={"tpu_offload": False})
+    )
+    ours = on.execute(sql).rows()
+    theirs = off.execute(sql).rows()
+    stmt = parse_statement(sql)
+    ordered = bool(stmt.order_by)
+    return diff_results(ours, theirs, ordered, rel_tol)

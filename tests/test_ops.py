@@ -356,3 +356,65 @@ def test_window_running_min_null_frame():
         [WindowCall("min", col(p, "v"), "m")],
     )
     assert [r["m"] for r in out.to_pylist()] == [None, 5]
+
+
+def test_sorted_sum_overflow_trap():
+    """A group whose TRUE sum exceeds int64 must raise through the error
+    channel; groups whose sums fit must stay exact and silent even when
+    the page-wide running cumsum wraps (modular arithmetic makes the
+    span difference exact in that case)."""
+    big = (1 << 62) + 7
+    # group 1 sums to 2^63+14 -> real per-group overflow -> trap
+    p = make_page(
+        capacity=8,
+        k=([1, 1, 2], T.BIGINT),
+        x=([big, big, 10], T.BIGINT),
+    )
+    errors = []
+    hash_aggregate(
+        p,
+        [("k", col(p, "k"))],
+        [AggCall("sum", col(p, "x"), "s")],
+        8,
+        errors_out=errors,
+    )
+    assert errors, "sum must register an overflow trap"
+    assert any(bool(flag) for _, flag in errors)
+
+    # page-wide cumsum wraps (4 * (2^62+7) > 2^64) but every per-group
+    # sum is representable: exact results, NO trap (the reference only
+    # overflows per group)
+    p2 = make_page(
+        capacity=8,
+        k=([1, 2, 3, 4], T.BIGINT),
+        x=([big, big, big, big], T.BIGINT),
+    )
+    errors2 = []
+    out2, _ = hash_aggregate(
+        p2,
+        [("k", col(p2, "k"))],
+        [AggCall("sum", col(p2, "x"), "s")],
+        8,
+        errors_out=errors2,
+    )
+    assert not any(bool(flag) for _, flag in errors2)
+    rows = {r["k"]: r["s"] for r in out2.to_pylist()}
+    assert rows == {1: big, 2: big, 3: big, 4: big}
+
+    # and a benign page must NOT trip the trap
+    p3 = make_page(
+        capacity=8,
+        k=([1, 2, 1, 2], T.BIGINT),
+        x=([10, 20, 30, 40], T.BIGINT),
+    )
+    errors3 = []
+    out3, _ = hash_aggregate(
+        p3,
+        [("k", col(p3, "k"))],
+        [AggCall("sum", col(p3, "x"), "s")],
+        8,
+        errors_out=errors3,
+    )
+    assert not any(bool(flag) for _, flag in errors3)
+    rows = {r["k"]: r["s"] for r in out3.to_pylist()}
+    assert rows == {1: 40, 2: 60}

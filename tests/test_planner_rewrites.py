@@ -1,0 +1,95 @@
+"""Planner decorrelation rewrites — targeted semantics tests over the
+memory connector (the reference's ApplyNode-transformation unit-test
+style, SURVEY.md §4.2 plan-correctness harness)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import create_connector
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager
+from presto_tpu.session import Session
+
+
+@pytest.fixture()
+def mem_runner():
+    conn = create_connector("memory")
+    outer = TableHandle("mem", "default", "outer_t")
+    conn.create_table(outer, {"k": T.INTEGER, "c": T.INTEGER})
+    conn.append_rows(
+        outer,
+        {
+            "k": np.asarray([1, 1, 2, 3, 4]),
+            "c": np.asarray([5, 6, 7, None, 9], dtype=object),
+        },
+    )
+    inner = TableHandle("mem", "default", "inner_t")
+    conn.create_table(inner, {"k": T.INTEGER, "c": T.INTEGER})
+    conn.append_rows(
+        inner,
+        {
+            # k=1: rows c=5, NULL      k=2: row c=7     k=4: rows 9, 10
+            "k": np.asarray([1, 1, 2, 4, 4]),
+            "c": np.asarray([5, None, 7, 9, 10], dtype=object),
+        },
+    )
+    cats = CatalogManager()
+    cats.register("mem", conn)
+    return LocalQueryRunner(
+        catalogs=cats, session=Session(catalog="mem", schema="default")
+    )
+
+
+EXISTS_SQL = (
+    "select k, c from mem.default.outer_t o where exists ("
+    "  select * from mem.default.inner_t i"
+    "  where i.k = o.k and i.c <> o.c) order by k, c"
+)
+
+NOT_EXISTS_SQL = (
+    "select k, c from mem.default.outer_t o where not exists ("
+    "  select * from mem.default.inner_t i"
+    "  where i.k = o.k and i.c <> o.c) order by k, c"
+)
+
+
+def test_exists_inequality_null_semantics(mem_runner):
+    """Inner NULLs never satisfy <>; outer NULL c forces EXISTS false.
+
+    outer (1,5): inner k=1 non-null c = {5} -> no c<>5 -> false
+    outer (1,6): inner k=1 non-null c = {5} -> 5<>6    -> true
+    outer (2,7): inner k=2 c={7}            -> false
+    outer (3,NULL): no inner k=3            -> false
+    outer (4,9): inner k=4 c={9,10} -> 10<>9 -> true
+    """
+    rows = mem_runner.execute(EXISTS_SQL).rows()
+    assert rows == [(1, 6), (4, 9)]
+
+
+def test_not_exists_inequality_null_semantics(mem_runner):
+    """NOT EXISTS is the complement, including UNKNOWN->false rows."""
+    rows = mem_runner.execute(NOT_EXISTS_SQL).rows()
+    assert rows == [(1, 5), (2, 7), (3, None)]
+
+
+def test_not_exists_outer_null_c(mem_runner):
+    """An outer row with c NULL: every comparison UNKNOWN -> EXISTS
+    false -> NOT EXISTS true, even when inner rows share the key."""
+    conn = mem_runner.catalogs.get("mem")
+    h = TableHandle("mem", "default", "outer2")
+    conn.create_table(h, {"k": T.INTEGER, "c": T.INTEGER})
+    conn.append_rows(
+        h,
+        {
+            "k": np.asarray([4]),
+            "c": np.asarray([None], dtype=object),
+        },
+    )
+    sql = (
+        "select k from mem.default.outer2 o where not exists ("
+        "  select * from mem.default.inner_t i"
+        "  where i.k = o.k and i.c <> o.c)"
+    )
+    assert mem_runner.execute(sql).rows() == [(4,)]

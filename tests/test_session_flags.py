@@ -1,0 +1,101 @@
+"""Session-property wiring tests: every property must be observable in
+engine behavior (VERDICT round-1: no decorative flags). Reference:
+SystemSessionProperties, SURVEY.md §5.6."""
+
+import time
+
+import jax
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.parallel import DistributedQueryRunner
+from presto_tpu.session import Session
+from presto_tpu.verifier import SqliteOracle, verify_offload, verify_query
+
+Q_AGG = (
+    "select l_returnflag, count(*) as c, sum(l_quantity) as s "
+    "from tpch.tiny.lineitem group by l_returnflag order by l_returnflag"
+)
+
+Q_JOIN = (
+    "select o_orderpriority, count(*) as c from tpch.tiny.orders, "
+    "tpch.tiny.customer where o_custkey = c_custkey "
+    "group by o_orderpriority order by o_orderpriority"
+)
+
+
+def test_tpu_offload_changes_execution_device():
+    """tpu_offload=false pins staging + execution to the first CPU
+    device (the BASELINE.json dual-backend session gate)."""
+    cpu0 = jax.devices("cpu")[0]
+    off = LocalQueryRunner(
+        session=Session(properties={"tpu_offload": False})
+    )
+    res = off.execute(Q_AGG)
+    page = res.page
+    assert all(
+        b.data.devices() == {cpu0} for b in page.blocks
+    ), "offload-off result must live on the first CPU device"
+    # flag flip mid-session recompiles rather than reusing the cache
+    on = LocalQueryRunner(session=Session(properties={"tpu_offload": True}))
+    res2 = on.execute(Q_AGG)
+    assert [tuple(r) for r in res.rows()] == [
+        tuple(r) for r in res2.rows()
+    ]
+
+
+def test_verify_offload_mode():
+    assert verify_offload(Q_AGG) is None
+    assert verify_offload(Q_JOIN) is None
+
+
+def test_join_distribution_type_forced_modes(oracle_mod):
+    """PARTITIONED and BROADCAST forced modes both produce oracle-exact
+    results (AUTOMATIC is covered by the main distributed suite)."""
+    for mode in ("PARTITIONED", "BROADCAST"):
+        r = DistributedQueryRunner(
+            session=Session(properties={"join_distribution_type": mode}),
+            broadcast_threshold=1 << 11,
+            repl_threshold=1 << 10,
+        )
+        diff = verify_query(r, oracle_mod, Q_JOIN)
+        assert diff is None, f"{mode}: {diff}"
+
+
+def test_hash_partition_count_sets_mesh_width():
+    r = DistributedQueryRunner(
+        session=Session(properties={"hash_partition_count": 4})
+    )
+    assert r.n == 4
+    r8 = DistributedQueryRunner()
+    assert r8.n == len(jax.devices())
+
+
+def test_task_concurrency_and_split_batches_over_http(oracle_mod):
+    """Small split batches + concurrent drivers stream many partial
+    pages per task; results stay oracle-exact."""
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+
+    coord = CoordinatorServer().start()
+    coord.local.session.set("page_capacity", 1 << 12)  # 4096-row batches
+    coord.local.session.set("task_concurrency", 2)
+    w = WorkerServer(coordinator_uri=coord.uri).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not coord.active_workers():
+            time.sleep(0.05)
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        diff = verify_query(client, oracle_mod, Q_AGG)
+        assert diff is None, diff
+    finally:
+        w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+@pytest.fixture(scope="module")
+def oracle_mod():
+    return SqliteOracle("tiny")
