@@ -78,6 +78,7 @@ class LocalQueryRunner:
         self,
         catalogs: Optional[CatalogManager] = None,
         session: Optional[Session] = None,
+        memory_pool=None,
     ):
         from presto_tpu.exec.stats import QueryHistory
 
@@ -87,6 +88,9 @@ class LocalQueryRunner:
         self.catalogs = catalogs
         self.session = session or Session()
         self.history = QueryHistory()
+        #: optional utils.memory.MemoryPool; staged pages reserve
+        #: against it (reference: QueryContext -> MemoryPool accounting)
+        self.memory_pool = memory_pool
         if not catalogs.has("system"):
             from presto_tpu.connectors.system_catalog import SystemConnector
 
@@ -185,7 +189,11 @@ class LocalQueryRunner:
         except Exception as e:
             REGISTRY.counter("queries.failed").update()
             self.history.finish(qs, error=f"{type(e).__name__}: {e}")
+            if self.memory_pool is not None:
+                self.memory_pool.release(qs.query_id)
             raise
+        if self.memory_pool is not None:
+            self.memory_pool.release(qs.query_id)
         self.history.finish(qs)
         REGISTRY.counter("queries.finished").update()
         REGISTRY.distribution("query.output_rows").add(qs.output_rows)
@@ -269,6 +277,12 @@ class LocalQueryRunner:
     # ---------------------------------------------------------- execution
 
     def _run(self, root: N.PlanNode) -> Page:
+        from presto_tpu.exec import streaming
+
+        if streaming.needs_streaming(root, self.catalogs, self.session):
+            # larger-than-HBM input: split-streamed partial aggregation
+            # with hash-bucketed host spill (exec.streaming)
+            return streaming.run_streamed(self, root)
         scans = [
             n for n in N.walk(root) if isinstance(n, N.TableScanNode)
         ]
@@ -384,6 +398,25 @@ class LocalQueryRunner:
             merged = self._load_merged_payload(scan)
             with self._device_scope():
                 page = stage_page(merged, dict(scan.schema))
+            if self.memory_pool is not None:
+                nbytes = sum(
+                    int(b.data.nbytes)
+                    + (int(b.valid.nbytes) if b.valid is not None else 0)
+                    for b in page.blocks
+                )
+                cacheable = self.catalogs.get(
+                    scan.handle.catalog
+                ).cacheable()
+                owner = (
+                    "table-cache"
+                    if cacheable
+                    else (
+                        self._active_qs.query_id
+                        if self._active_qs is not None
+                        else "adhoc"
+                    )
+                )
+                self.memory_pool.reserve(owner, nbytes)
             if self.catalogs.get(scan.handle.catalog).cacheable():
                 self._table_cache[key] = page
             if self._active_qs is not None:

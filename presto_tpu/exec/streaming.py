@@ -1,0 +1,406 @@
+"""Larger-than-HBM execution: split-streamed partial aggregation with
+hash-bucketed host-RAM spill.
+
+Reference parity: the three mechanisms of SURVEY.md §5.7 in one design —
+(a) split parallelism streaming batches through the operator pipeline
+(§2.4), (b) partitioned spill: partial states hash-partitioned to
+host-RAM buckets during the single input pass (§2.1 "Spilling"), and
+(c) grouped execution: each bucket's final merge runs alone on the
+device, bounding live HBM state to one bucket (§2.4 "Grouped / bucketed
+execution").
+
+TPU-first shape: the *same* stage-cut rewrite the multi-host scheduler
+uses (server.scheduler.plan_stage — partial agg below the cut, final
+merge above) is applied locally; the compiled partial fragment is ONE
+XLA program reused for every batch (fixed capacity bucket), so the
+stream costs zero recompiles after the first batch. Host RAM is the
+spill tier (SURVEY.md §5.7 "host-RAM as the spill tier").
+
+Recursion handles multi-big-scan plans (e.g. TPC-H Q18, where both the
+semi-join subquery and the outer pipeline scan SF100 lineitem):
+``plan_stage(replicated_limit=...)`` refuses a cut that would replicate
+an oversized scan, so the inner fragment streams first and its
+materialized (small) result feeds the outer recursion as a leaf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors.spi import ConnectorSplit
+from presto_tpu.connectors.tpch import DictColumn
+from presto_tpu.exec.staging import MaskedColumn, stage_page
+from presto_tpu.plan import nodes as N
+from presto_tpu.parallel.fragmenter import insert_gathers
+from presto_tpu.server import pages_wire
+from presto_tpu.server.scheduler import (
+    _path_to,
+    _replace_on_path,
+    plan_stage,
+)
+
+
+class StreamingError(RuntimeError):
+    pass
+
+
+def _scan_rows(catalogs, scan: N.TableScanNode) -> int:
+    conn = catalogs.get(scan.handle.catalog)
+    stats = conn.metadata().get_table_stats(scan.handle)
+    return int(stats.row_count or 0)
+
+
+def needs_streaming(root: N.PlanNode, catalogs, session) -> bool:
+    """True when some scan exceeds the device residency budget."""
+    max_rows = int(session.get("max_device_rows"))
+    return any(
+        isinstance(n, N.TableScanNode)
+        and _scan_rows(catalogs, n) > max_rows
+        for n in N.walk(root)
+    )
+
+
+def run_streamed(runner, droot: N.PlanNode):
+    """Execute a device plan whose inputs exceed ``max_device_rows``.
+
+    Mirrors the distributed runner's shape: fragment the plan at the
+    gather boundary, stream each oversized fragment, run the root
+    fragment over the gathered pages.
+    """
+    if not runner.session.get("spill_enabled"):
+        raise StreamingError(
+            "input exceeds max_device_rows and spill_enabled=false "
+            "(reference behavior: the query fails on memory rather "
+            "than spilling)"
+        )
+    froot = insert_gathers(droot)
+    leaves = [
+        n
+        for n in N.walk(froot)
+        if isinstance(n, (N.TableScanNode, N.RemoteSourceNode))
+    ]
+    pages = []
+    for leaf in leaves:
+        if isinstance(leaf, N.RemoteSourceNode):
+            pages.append(_run_fragment(runner, leaf.fragment_root, {}))
+        else:
+            pages.append(runner._load_table(leaf))
+    return runner._run_with_pages(froot, leaves, pages)
+
+
+# ------------------------------------------------------------- fragment
+
+
+def _run_fragment(runner, frag_root: N.PlanNode, materialized: Dict):
+    """Run one distributable fragment, streaming if it holds an
+    oversized scan. ``materialized`` maps id(RemoteSourceNode) -> Page
+    produced by an earlier recursion step."""
+    max_rows = int(runner.session.get("max_device_rows"))
+    big = [
+        s
+        for s in N.walk(frag_root)
+        if isinstance(s, N.TableScanNode)
+        and _scan_rows(runner.catalogs, s) > max_rows
+    ]
+    if not big:
+        leaves = [
+            n
+            for n in N.walk(frag_root)
+            if isinstance(n, (N.TableScanNode, N.RemoteSourceNode))
+        ]
+        pages = [
+            materialized[id(n)]
+            if isinstance(n, N.RemoteSourceNode)
+            else runner._load_table(n)
+            for n in leaves
+        ]
+        return runner._run_with_pages(frag_root, leaves, pages)
+
+    stage = plan_stage(
+        frag_root, runner.catalogs, replicated_limit=max_rows
+    )
+    if stage is None:
+        raise StreamingError(
+            "fragment exceeds max_device_rows and admits no "
+            "semantics-preserving streaming cut"
+        )
+
+    bucket_root, rest_root, frag_remote = _split_final(stage.final_root)
+
+    # --- the single input pass: batch -> partial -> bucket spill
+    from presto_tpu.exec.staging import bucket_capacity
+
+    worker_root = stage.worker_fragment
+    batch = min(
+        int(runner.session.get("page_capacity")), max_rows
+    )
+    batch_cap = bucket_capacity(batch)
+    worker_root = _cap_cut_groups(worker_root, batch_cap)
+    part_scan = list(N.walk(worker_root))[stage.partition_scan]
+    n_buckets = max(1, -(-stage.partition_rows // max_rows) * 4)
+    key_names = _bucket_key_names(worker_root)
+    schema = dict(worker_root.output_schema())
+
+    leaves = [
+        n
+        for n in N.walk(worker_root)
+        if isinstance(n, (N.TableScanNode, N.RemoteSourceNode))
+    ]
+    base_pages = {}
+    for n in leaves:
+        if isinstance(n, N.RemoteSourceNode):
+            base_pages[id(n)] = materialized[id(n)]
+        elif n is not part_scan:
+            base_pages[id(n)] = runner._load_table(n)
+
+    conn = runner.catalogs.get(part_scan.handle.catalog)
+    spill: List[List[tuple]] = [[] for _ in range(n_buckets)]
+    for lo in range(0, stage.partition_rows, batch):
+        hi = min(lo + batch, stage.partition_rows)
+        payload = conn.create_page_source(
+            ConnectorSplit(part_scan.handle, lo, hi),
+            list(part_scan.columns),
+        )
+        # fixed capacity: every batch (incl. the tail) reuses ONE
+        # compiled partial-fragment program
+        batch_page = stage_page(
+            payload, dict(part_scan.schema), capacity=batch_cap
+        )
+        pages = [
+            batch_page if n is part_scan else base_pages[id(n)]
+            for n in leaves
+        ]
+        out = runner._run_with_pages(worker_root, leaves, pages)
+        part_payload, _, nrows = _page_to_payload(out)
+        if nrows == 0:
+            continue
+        _spill_partial(
+            spill, part_payload, schema, key_names, nrows, n_buckets
+        )
+
+    # --- per-bucket final merge on device
+    outs: List[tuple] = []
+    out_schema = dict(
+        (bucket_root or frag_remote).output_schema()
+    )
+    for b in range(n_buckets):
+        if not spill[b]:
+            continue
+        merged = pages_wire.merge_payloads(spill[b], schema)
+        page = stage_page(merged, schema)
+        spill[b] = []  # free the spilled partials as we go
+        if bucket_root is None:
+            outs.append(_page_to_payload(page))
+            continue
+        broot = _cap_cut_groups(bucket_root, page.capacity)
+        out = runner._run_with_pages(broot, [frag_remote], [page])
+        pl = _page_to_payload(out)
+        if pl[2]:
+            outs.append(pl)
+
+    if outs:
+        merged = pages_wire.merge_payloads(outs, out_schema)
+    else:
+        merged = {
+            name: np.empty(0, t.np_dtype)
+            for name, t in out_schema.items()
+        }
+    result = stage_page(merged, out_schema)
+
+    if rest_root is None:
+        return result
+    # the rest of the fragment may hold further oversized scans: recurse
+    rest_remote = next(
+        n
+        for n in N.walk(rest_root)
+        if isinstance(n, N.RemoteSourceNode)
+    )
+    return _run_fragment(
+        runner, rest_root, {**materialized, id(rest_remote): result}
+    )
+
+
+def _split_final(final_root: N.PlanNode):
+    """Split the coordinator-side plan into the bucket-safe chain (the
+    final agg/distinct merge plus row-wise filters/projections directly
+    above it — safe because groups are complete within one bucket) and
+    the rest. Returns (bucket_root|None, rest_root|None, remote)."""
+    remote = next(
+        n
+        for n in N.walk(final_root)
+        if isinstance(n, N.RemoteSourceNode)
+    )
+    path = _path_to(final_root, remote)
+    j = len(path) - 2
+    if j >= 0 and isinstance(
+        path[j], (N.AggregationNode, N.DistinctNode)
+    ):
+        j -= 1
+        while j >= 0 and isinstance(
+            path[j], (N.FilterNode, N.ProjectNode)
+        ):
+            j -= 1
+    bucket_root = path[j + 1]
+    if bucket_root is remote:
+        return None, (
+            None if final_root is remote else final_root
+        ), remote
+    if bucket_root is final_root:
+        return bucket_root, None, remote
+    rest_remote = N.RemoteSourceNode(fragment_root=bucket_root)
+    rest_root = _replace_on_path(
+        path[: j + 1], bucket_root, rest_remote
+    )
+    return bucket_root, rest_root, remote
+
+
+def _cap_cut_groups(root: N.PlanNode, cap: int) -> N.PlanNode:
+    """Rebind the cut agg/distinct's max_groups to the batch/bucket
+    capacity: distinct groups in a batch can never exceed its rows, so
+    this is always sufficient (no overflow retries on the stream)."""
+    if isinstance(root, (N.AggregationNode, N.DistinctNode)):
+        return dataclasses.replace(root, max_groups=cap)
+    target = next(
+        (
+            n
+            for n in N.walk(root)
+            if isinstance(n, (N.AggregationNode, N.DistinctNode))
+            and isinstance(n.source, N.RemoteSourceNode)
+        ),
+        None,
+    )
+    if target is None:
+        return root
+    path = _path_to(root, target)
+    return _replace_on_path(
+        path[:-1], target, dataclasses.replace(target, max_groups=cap)
+    )
+
+
+def _bucket_key_names(worker_root: N.PlanNode) -> List[str]:
+    """Group-key output columns of the cut node = the spill partition
+    key (DistinctNode dedups whole rows: every column is key)."""
+    if isinstance(worker_root, N.AggregationNode):
+        return [n for n, _ in worker_root.group_keys]
+    if isinstance(worker_root, N.DistinctNode):
+        return list(worker_root.output_schema())
+    return []  # no cut: pure distributive fragment, single bucket
+
+
+# ------------------------------------------------------- host-side spill
+
+
+def _page_to_payload(page) -> Tuple[Dict, Dict, int]:
+    """Device page -> (staging payload, schema, nrows) on host numpy —
+    the same shape pages_wire.deserialize_page produces, so bucket
+    merges reuse pages_wire.merge_payloads (incl. dictionary remap)."""
+    cols, n = pages_wire.page_to_wire_columns(page)
+    payload: Dict = {}
+    schema: Dict = {}
+    for name, data, valid, dtype, dict_values in cols:
+        schema[name] = dtype
+        if valid is not None:
+            payload[name] = MaskedColumn(
+                data=np.asarray(data),
+                valid=np.asarray(valid),
+                values=dict_values,
+            )
+        elif dict_values is not None:
+            payload[name] = DictColumn(
+                ids=np.asarray(data, np.int32),
+                values=np.asarray(dict_values, object),
+            )
+        else:
+            payload[name] = np.asarray(data)
+    return payload, schema, n
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def _col_hash_input(col, nrows: int) -> np.ndarray:
+    """uint64 image of a column for bucket hashing. Dictionary ids are
+    mapped through a per-VALUE crc so the hash is stable across batches
+    whose dictionaries differ; NULLs hash to 0 (one bucket)."""
+    if isinstance(col, MaskedColumn):
+        base = _col_hash_input(
+            DictColumn(ids=np.asarray(col.data, np.int64), values=col.values)
+            if col.values is not None
+            else col.data,
+            nrows,
+        )
+        return np.where(col.valid[:nrows], base, np.uint64(0))
+    if isinstance(col, DictColumn):
+        vals = np.asarray(col.values, object)
+        crc = np.asarray(
+            [zlib.crc32(str(v).encode()) for v in vals], np.uint64
+        )
+        ids = np.clip(np.asarray(col.ids, np.int64), 0, max(len(vals) - 1, 0))
+        if len(vals) == 0:
+            return np.zeros(nrows, np.uint64)
+        return crc[ids[:nrows]]
+    data = np.asarray(col)[:nrows]
+    if data.dtype.kind == "f":
+        d = data.astype(np.float64, copy=True)
+        d[d == 0] = 0.0  # -0.0 hashes like +0.0
+        return d.view(np.uint64)
+    return data.astype(np.int64).view(np.uint64)
+
+
+def _bucket_of(payload, key_names, nrows, n_buckets) -> np.ndarray:
+    h = np.full(nrows, 0x9E3779B97F4A7C15, np.uint64)
+    for name in key_names:
+        h ^= _mix64(_col_hash_input(payload[name], nrows))
+        h = _mix64(h)
+    return (h % np.uint64(n_buckets)).astype(np.int64)
+
+
+def _slice_payload(payload, schema, mask) -> Dict:
+    out = {}
+    for name in schema:
+        col = payload[name]
+        if isinstance(col, MaskedColumn):
+            out[name] = MaskedColumn(
+                data=np.asarray(col.data)[: len(mask)][mask],
+                valid=np.asarray(col.valid)[: len(mask)][mask],
+                values=col.values,
+            )
+        elif isinstance(col, DictColumn):
+            out[name] = DictColumn(
+                ids=np.asarray(col.ids)[: len(mask)][mask],
+                values=col.values,
+            )
+        else:
+            out[name] = np.asarray(col)[: len(mask)][mask]
+    return out
+
+
+def _spill_partial(
+    spill, payload, schema, key_names, nrows, n_buckets
+) -> None:
+    if n_buckets == 1 or not key_names:
+        spill[0].append((_truncate_payload(payload, schema, nrows),
+                         schema, nrows))
+        return
+    buckets = _bucket_of(payload, key_names, nrows, n_buckets)
+    for b in np.unique(buckets):
+        mask = buckets == b
+        sliced = _slice_payload(payload, schema, mask)
+        spill[int(b)].append((sliced, schema, int(mask.sum())))
+
+
+def _truncate_payload(payload, schema, nrows) -> Dict:
+    mask = np.ones(nrows, dtype=bool)
+    return _slice_payload(payload, schema, mask)

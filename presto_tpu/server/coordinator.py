@@ -61,9 +61,22 @@ class _Query:
 
 
 class CoordinatorServer:
-    """Coordinator: embedded discovery + dispatcher + exchange client."""
+    """Coordinator: embedded discovery + dispatcher + exchange client.
 
-    def __init__(self, port: int = 0, catalogs=None, session=None):
+    Admission control (reference: DispatchManager + resource-group
+    queueing, SURVEY.md §2.1 "Dispatch/queue"): at most
+    ``max_concurrent_queries`` run at once; up to ``max_queued_queries``
+    wait; beyond that submissions are REJECTED immediately instead of
+    accumulating unbounded threads."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        catalogs=None,
+        session=None,
+        max_concurrent_queries: int = 4,
+        max_queued_queries: int = 100,
+    ):
         from presto_tpu.exec.local_runner import LocalQueryRunner
 
         self.local = LocalQueryRunner(catalogs=catalogs, session=session)
@@ -73,6 +86,9 @@ class CoordinatorServer:
         self._lock = threading.Lock()
         self._qid = itertools.count(1)
         self._shutting_down = False
+        self._admit = threading.Semaphore(max_concurrent_queries)
+        self._max_queued = max_queued_queries
+        self._pending = 0  # queued + running, admission-gated
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -141,25 +157,39 @@ class CoordinatorServer:
         q = _Query(f"q_{next(self._qid)}", sql)
         with self._lock:
             self.queries[q.qid] = q
+            if self._pending >= self._max_queued:
+                q.state = "FAILED"
+                q.error = (
+                    "Query rejected: too many queued queries "
+                    f"(max {self._max_queued})"
+                )
+                REGISTRY.counter("coordinator.queries_rejected").update()
+                q.done.set()
+                return q
+            self._pending += 1
         threading.Thread(
             target=self._execute_query, args=(q,), daemon=True
         ).start()
         return q
 
     def _execute_query(self, q: _Query) -> None:
-        q.state = "RUNNING"
-        try:
-            with REGISTRY.timer("coordinator.query_time").time():
-                self._run_sql(q)
-            q.state = "FINISHED"
-        except Exception as e:
-            q.state = "FAILED"
-            q.error = (
-                f"{type(e).__name__}: {e}\n{traceback.format_exc()[-1000:]}"
-            )
-            REGISTRY.counter("coordinator.queries_failed").update()
-        finally:
-            q.done.set()
+        with self._admit:  # admission gate: bounded concurrency
+            q.state = "RUNNING"
+            try:
+                with REGISTRY.timer("coordinator.query_time").time():
+                    self._run_sql(q)
+                q.state = "FINISHED"
+            except Exception as e:
+                q.state = "FAILED"
+                q.error = (
+                    f"{type(e).__name__}: {e}\n"
+                    f"{traceback.format_exc()[-1000:]}"
+                )
+                REGISTRY.counter("coordinator.queries_failed").update()
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                q.done.set()
 
     def _run_sql(self, q: _Query) -> None:
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops

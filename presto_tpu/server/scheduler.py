@@ -46,13 +46,22 @@ class StagePlan:
 
 
 def plan_stage(
-    fragment_root: N.PlanNode, catalogs
+    fragment_root: N.PlanNode,
+    catalogs,
+    replicated_limit: Optional[int] = None,
 ) -> Optional[StagePlan]:
     """Decompose one distributable fragment into worker/final steps.
 
     Tries candidate partition scans largest-first; returns None when no
     scan can be partitioned without changing semantics (the coordinator
     then runs the fragment locally).
+
+    ``replicated_limit`` (streaming use): reject a candidate whose
+    worker fragment would replicate another scan bigger than this —
+    the streamed batch runner stages replicated scans whole, so an
+    oversized one must instead be the partition scan of an *earlier*
+    recursion step (exec.streaming resolves big-probe-over-big-build
+    plans inner-fragment-first this way).
     """
     scans = [
         n for n in N.walk(fragment_root) if isinstance(n, N.TableScanNode)
@@ -66,8 +75,20 @@ def plan_stage(
 
     for rows, scan in sized:
         stage = _try_cut(fragment_root, scan, rows)
-        if stage is not None:
-            return stage
+        if stage is None:
+            continue
+        if replicated_limit is not None:
+            others = [
+                r
+                for r, s in sized
+                if s is not scan
+                and any(
+                    n is s for n in N.walk(stage.worker_fragment)
+                )
+            ]
+            if any(r > replicated_limit for r in others):
+                continue
+        return stage
     return None
 
 

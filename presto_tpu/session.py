@@ -100,9 +100,21 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
         ),
         PropertyMetadata(
             "spill_enabled",
-            "Allow spilling oversized build/group state to host RAM",
+            "Allow larger-than-HBM execution: stream split batches "
+            "through the compiled fragment and spill hash-bucketed "
+            "partial states to host RAM (reference: spilling + grouped "
+            "execution)",
             bool,
-            False,
+            True,
+        ),
+        PropertyMetadata(
+            "max_device_rows",
+            "Largest table staged whole into device memory; bigger "
+            "scans use split-streamed execution (requires "
+            "spill_enabled)",
+            int,
+            1 << 24,
+            _positive("max_device_rows"),
         ),
         PropertyMetadata(
             "query_max_run_time_s",

@@ -1,0 +1,73 @@
+"""Memory manager: hierarchical pools with per-query accounting.
+
+Reference parity: ``MemoryPool`` + ``QueryContext`` local memory
+contexts + ``ClusterMemoryManager``'s kill-largest policy (SURVEY.md
+§2.1 "Memory manager"). TPU-first shape: what needs accounting here is
+*host-visible* residency — staged device pages (HBM) and host-RAM spill
+buffers — reserved against a per-node pool before staging; the
+blocking/queueing tier lives in the coordinator's admission control.
+
+No reserved-pool legacy; policy = fail the reserving query when the
+pool is exhausted and no larger query can be killed (the reference
+kills the largest query cluster-wide; locally we surface the same
+`Query exceeded memory limit` error shape).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class MemoryLimitExceeded(RuntimeError):
+    pass
+
+
+class MemoryPool:
+    """One node-level pool; queries reserve/release against it."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit = int(limit_bytes)
+        self._used: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def reserve(self, query_id: str, nbytes: int) -> None:
+        with self._lock:
+            total = sum(self._used.values())
+            if total + nbytes > self.limit:
+                largest = max(
+                    self._used, key=self._used.get, default=None
+                )
+                raise MemoryLimitExceeded(
+                    f"reserving {nbytes}B for {query_id} exceeds pool "
+                    f"limit {self.limit}B (in use {total}B, largest "
+                    f"holder {largest})"
+                )
+            self._used[query_id] = self._used.get(query_id, 0) + nbytes
+
+    def release(self, query_id: str) -> None:
+        with self._lock:
+            self._used.pop(query_id, None)
+
+    def used_bytes(self, query_id: Optional[str] = None) -> int:
+        with self._lock:
+            if query_id is not None:
+                return self._used.get(query_id, 0)
+            return sum(self._used.values())
+
+
+class QueryMemoryContext:
+    """Per-query handle: accumulates reservations, released on finish
+    (reference: QueryContext -> MemoryPool accounting)."""
+
+    def __init__(self, pool: Optional[MemoryPool], query_id: str):
+        self.pool = pool
+        self.query_id = query_id
+
+    def reserve(self, nbytes: int) -> None:
+        if self.pool is not None:
+            self.pool.reserve(self.query_id, nbytes)
+
+    def release_all(self) -> None:
+        if self.pool is not None:
+            self.pool.release(self.query_id)

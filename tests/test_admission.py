@@ -1,0 +1,89 @@
+"""Admission control + memory guardrails (VERDICT #10; reference:
+DispatchManager/resource groups + MemoryPool/ClusterMemoryManager,
+SURVEY.md §2.1)."""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.server import CoordinatorServer
+from presto_tpu.session import Session
+from presto_tpu.utils.memory import (
+    MemoryLimitExceeded,
+    MemoryPool,
+    QueryMemoryContext,
+)
+
+
+def test_memory_pool_reserve_release():
+    pool = MemoryPool(1000)
+    pool.reserve("q1", 600)
+    pool.reserve("q2", 300)
+    with pytest.raises(MemoryLimitExceeded):
+        pool.reserve("q3", 200)
+    assert pool.used_bytes() == 900
+    pool.release("q1")
+    pool.reserve("q3", 600)
+    assert pool.used_bytes("q3") == 600
+
+
+def test_query_context_noop_without_pool():
+    ctx = QueryMemoryContext(None, "q")
+    ctx.reserve(1 << 40)  # no pool: accounting disabled
+    ctx.release_all()
+
+
+def test_runner_accounts_staged_pages():
+    pool = MemoryPool(1 << 30)
+    r = LocalQueryRunner(memory_pool=pool)
+    r.execute("select count(*) as c from tpch.tiny.region")
+    # tpch is cacheable: staged bytes land under the shared cache owner
+    assert pool.used_bytes("table-cache") > 0
+
+
+def test_runner_memory_limit_fails_query():
+    pool = MemoryPool(1024)  # far below any staged table
+    r = LocalQueryRunner(memory_pool=pool)
+    with pytest.raises(MemoryLimitExceeded):
+        r.execute("select count(*) as c from tpch.tiny.region")
+
+
+def test_coordinator_sheds_load_beyond_queue():
+    """Submissions beyond max_queued are REJECTED, not accumulated."""
+    coord = CoordinatorServer(
+        max_concurrent_queries=1, max_queued_queries=2
+    )
+    # no .start(): exercise submit() directly.  Block the single
+    # execution slot so later submissions must queue.
+    release = threading.Event()
+    orig = coord._run_sql
+
+    def slow(q):
+        release.wait(timeout=30)
+        return orig(q)
+
+    coord._run_sql = slow
+    try:
+        qs = [
+            coord.submit("select count(*) as c from tpch.tiny.region")
+            for _ in range(4)
+        ]
+        time.sleep(0.3)
+        states = [q.state for q in qs]
+        assert states.count("FAILED") == 2, states  # shed, not queued
+        assert all(
+            "rejected" in (q.error or "").lower()
+            for q in qs
+            if q.state == "FAILED"
+        )
+        release.set()
+        for q in qs:
+            if q.state != "FAILED":
+                q.done.wait(timeout=60)
+        done_states = [q.state for q in qs]
+        assert done_states.count("FINISHED") == 2, done_states
+    finally:
+        release.set()
+        coord.shutdown()

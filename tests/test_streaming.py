@@ -1,0 +1,127 @@
+"""Larger-than-HBM streaming execution: the TPC-H corpus with the
+device-residency budget forced far below lineitem's size, so every
+lineitem query takes the split-stream + bucket-spill path — verified
+against the sqlite oracle (reference: spilling/grouped-execution tests;
+SURVEY.md §5.7)."""
+
+import jax
+import numpy as np
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.session import Session
+from presto_tpu.verifier import SqliteOracle, verify_query
+
+from tpch_queries import QUERIES
+
+NOT_YET = {
+    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
+}
+
+#: tiny-SF lineitem is ~60k rows; 16384 forces it (and only it) to
+#: stream in ~8 batches of 4096 with >= 16 spill buckets
+MAX_DEVICE_ROWS = 16_384
+BATCH_ROWS = 4_096
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(
+        session=Session(
+            properties={
+                "max_device_rows": MAX_DEVICE_ROWS,
+                "page_capacity": BATCH_ROWS,
+                "spill_enabled": True,
+            }
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle("tiny")
+
+
+#: queries that scan lineitem (stream) — the others stay resident
+LINEITEM_QUERIES = [
+    q
+    for q in sorted(QUERIES)
+    if "lineitem" in QUERIES[q]
+]
+
+
+@pytest.mark.parametrize("qnum", LINEITEM_QUERIES)
+def test_tpch_streamed(qnum, runner, oracle):
+    if qnum in NOT_YET:
+        pytest.xfail(NOT_YET[qnum])
+    diff = verify_query(runner, oracle, QUERIES[qnum], rel_tol=1e-6)
+    assert diff is None, f"Q{qnum} streamed mismatch: {diff}"
+
+
+def test_streaming_actually_engaged(runner):
+    """The path must really stream: count partial-fragment executions
+    by spying on the spill function."""
+    from presto_tpu.exec import streaming
+
+    calls = []
+    orig = streaming._spill_partial
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    streaming._spill_partial = spy
+    try:
+        fresh = LocalQueryRunner(
+            session=Session(
+                properties={
+                    "max_device_rows": MAX_DEVICE_ROWS,
+                    "page_capacity": BATCH_ROWS,
+                }
+            )
+        )
+        fresh.execute(
+            "select l_returnflag, sum(l_quantity) as s "
+            "from tpch.tiny.lineitem group by l_returnflag"
+        )
+    finally:
+        streaming._spill_partial = orig
+    assert len(calls) >= 10, f"expected >=10 streamed batches, {len(calls)}"
+
+
+def test_spill_disabled_fails_cleanly():
+    from presto_tpu.exec.streaming import StreamingError
+
+    r = LocalQueryRunner(
+        session=Session(
+            properties={
+                "max_device_rows": MAX_DEVICE_ROWS,
+                "spill_enabled": False,
+            }
+        )
+    )
+    with pytest.raises(StreamingError):
+        r.execute("select count(*) as c from tpch.tiny.lineitem")
+
+
+def test_bucket_hash_stable_across_dictionaries():
+    """The same value must land in the same bucket even when two
+    batches encode it with different dictionary ids."""
+    from presto_tpu.connectors.tpch import DictColumn
+    from presto_tpu.exec.streaming import _bucket_of
+
+    p1 = {
+        "k": DictColumn(
+            ids=np.array([0, 1], np.int32),
+            values=np.array(["apple", "banana"], object),
+        )
+    }
+    p2 = {
+        "k": DictColumn(
+            ids=np.array([1, 0], np.int32),
+            values=np.array(["aardvark", "apple"], object),
+        )
+    }
+    b1 = _bucket_of(p1, ["k"], 2, 64)
+    b2 = _bucket_of(p2, ["k"], 2, 64)
+    assert b1[0] == b2[0]  # "apple" agrees across id spaces
