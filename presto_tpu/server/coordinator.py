@@ -104,7 +104,12 @@ class CoordinatorServer:
 
     def shutdown(self) -> None:
         self._shutting_down = True
-        self.httpd.shutdown()
+        # httpd.shutdown() handshakes with the serve_forever loop and
+        # blocks forever if that loop never ran (server constructed but
+        # not .start()ed, e.g. in-process submit()-only tests).
+        if self._serve_thread.is_alive():
+            self.httpd.shutdown()
+        self.httpd.server_close()
 
     # ---------------------------------------------------------- discovery
 

@@ -137,7 +137,11 @@ class WorkerServer:
                 if not busy:
                     break
                 time.sleep(0.05)
-        self.httpd.shutdown()
+        # Only handshake with serve_forever if it actually ran (see
+        # CoordinatorServer.shutdown).
+        if self._serve_thread.is_alive():
+            self.httpd.shutdown()
+        self.httpd.server_close()
 
     def _announce_loop(self):
         import urllib.request

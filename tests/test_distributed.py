@@ -19,11 +19,6 @@ from presto_tpu.verifier import SqliteOracle, verify_query
 
 from tpch_queries import QUERIES
 
-NOT_YET = {
-    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
-}
-
-
 @pytest.fixture(scope="module")
 def runner():
     assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
@@ -41,8 +36,6 @@ def oracle():
 
 @pytest.mark.parametrize("qnum", sorted(QUERIES))
 def test_tpch_query_distributed(qnum, runner, oracle):
-    if qnum in NOT_YET:
-        pytest.xfail(NOT_YET[qnum])
     diff = verify_query(runner, oracle, QUERIES[qnum], rel_tol=1e-6)
     assert diff is None, f"Q{qnum} distributed mismatch: {diff}"
 

@@ -17,11 +17,6 @@ from presto_tpu.verifier import SqliteOracle, verify_query
 
 from tpch_queries import QUERIES
 
-NOT_YET = {
-    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
-}
-
-
 def _wait_workers(coord, n, timeout=10.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -59,8 +54,6 @@ def oracle():
 
 @pytest.mark.parametrize("qnum", sorted(QUERIES))
 def test_tpch_over_http(qnum, client, oracle):
-    if qnum in NOT_YET:
-        pytest.xfail(NOT_YET[qnum])
     diff = verify_query(client, oracle, QUERIES[qnum], rel_tol=1e-6)
     assert diff is None, f"Q{qnum} over HTTP mismatch: {diff}"
 

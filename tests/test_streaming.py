@@ -14,10 +14,6 @@ from presto_tpu.verifier import SqliteOracle, verify_query
 
 from tpch_queries import QUERIES
 
-NOT_YET = {
-    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
-}
-
 #: tiny-SF lineitem is ~60k rows; 16384 forces it (and only it) to
 #: stream in ~8 batches of 4096 with >= 16 spill buckets
 MAX_DEVICE_ROWS = 16_384
@@ -52,8 +48,6 @@ LINEITEM_QUERIES = [
 
 @pytest.mark.parametrize("qnum", LINEITEM_QUERIES)
 def test_tpch_streamed(qnum, runner, oracle):
-    if qnum in NOT_YET:
-        pytest.xfail(NOT_YET[qnum])
     diff = verify_query(runner, oracle, QUERIES[qnum], rel_tol=1e-6)
     assert diff is None, f"Q{qnum} streamed mismatch: {diff}"
 

@@ -9,12 +9,6 @@ from presto_tpu.verifier import SqliteOracle, verify_query
 
 from tpch_queries import QUERIES
 
-# queries whose decorrelation pattern is not implemented yet
-NOT_YET = {
-    21: "inequality-correlated EXISTS (l2.l_suppkey <> l1.l_suppkey)",
-}
-
-
 @pytest.fixture(scope="module")
 def runner():
     return LocalQueryRunner()
@@ -27,7 +21,5 @@ def oracle():
 
 @pytest.mark.parametrize("qnum", sorted(QUERIES))
 def test_tpch_query(qnum, runner, oracle):
-    if qnum in NOT_YET:
-        pytest.xfail(NOT_YET[qnum])
     diff = verify_query(runner, oracle, QUERIES[qnum], rel_tol=1e-6)
     assert diff is None, f"Q{qnum} mismatch: {diff}"

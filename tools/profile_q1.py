@@ -1,0 +1,131 @@
+"""Per-phase profile of the benchmark path (TPC-H Q1 @ SF1).
+
+Breaks one steady-state `bench.py` iteration into its host/device
+components so BASELINE.md can carry a real device-vs-host time split
+(SURVEY.md §5.1; VERDICT r2 item 1):
+
+  bind+prune    host Python: _bind_params + prune_columns per call
+  fingerprint   host Python: compiled-program cache key
+  dispatch      jax dispatch of the jitted program (async, no sync)
+  device        block_until_ready on the outputs (true device time +
+                transfer, measured after dispatch returned)
+  ctl_fetch     device_get of the control outputs (flags/errors)
+  host_ops      host root stage (numpy sort over gathered rows)
+  e2e           full runner.execute_plan, for cross-checking
+
+Optionally writes a jax.profiler trace (--trace DIR) for XProf.
+
+Usage:  python tools/profile_q1.py [--sf sf1] [--iters 5] [--trace DIR]
+"""
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", default="sf1")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--trace", default=None)
+    ap.add_argument("--platform", default=None,
+                    help="force jax platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import __graft_entry__ as G
+    from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
+    from presto_tpu.exec.local_runner import LocalQueryRunner
+    from presto_tpu.plan.optimizer import prune_columns
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.plan.planner import plan_statement
+    from presto_tpu.sql import parse_statement
+
+    print("devices:", jax.devices())
+    runner = LocalQueryRunner()
+    sql = G._Q1.replace("tiny", args.sf)
+    stmt = parse_statement(sql)
+    plan = plan_statement(stmt, runner.catalogs, runner.session)
+
+    # warm: stage tables + compile
+    t0 = time.perf_counter()
+    runner.execute_plan(plan)
+    print(f"cold run (stage+compile): {time.perf_counter() - t0:.3f}s")
+    t0 = time.perf_counter()
+    runner.execute_plan(plan)
+    print(f"warm run: {time.perf_counter() - t0:.3f}s")
+
+    # per-phase breakdown of what execute_plan does
+    phases = {k: [] for k in (
+        "bind_prune", "fingerprint", "dispatch", "device", "ctl_fetch",
+        "host_ops", "e2e")}
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        root = runner._bind_params(plan)
+        root = prune_columns(root)
+        host_ops = []
+        if runner.session.get("host_root_stage"):
+            root, host_ops = peel_host_ops(root)
+        phases["bind_prune"].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        fp = root.fingerprint()
+        phases["fingerprint"].append(time.perf_counter() - t0)
+
+        scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
+        pages = [runner._load_table(s) for s in scans]
+        offload = runner.session.get("tpu_offload")
+        entry = runner._compiled.get((fp, False, offload))
+        if entry is None:
+            sys.exit(
+                "no compiled whole-plan program for this root (the plan "
+                "took the streamed path, which this per-phase breakdown "
+                "does not cover) — use a resident scale factor"
+            )
+        fn, msgs_cell, _ = entry
+
+        t0 = time.perf_counter()
+        with runner._device_scope():
+            page, flags_arr, err_arr, cnt_arr = fn(pages)
+        phases["dispatch"].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        jax.block_until_ready((page, flags_arr, err_arr))
+        phases["device"].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        jax.device_get([flags_arr, err_arr, cnt_arr])
+        phases["ctl_fetch"].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        if host_ops:
+            apply_host_ops(page, host_ops)
+        phases["host_ops"].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        runner.execute_plan(plan)
+        phases["e2e"].append(time.perf_counter() - t0)
+
+    print(f"\n{'phase':<12} {'best':>9} {'median':>9}")
+    for k, v in phases.items():
+        print(f"{k:<12} {min(v) * 1e3:>8.1f}ms {statistics.median(v) * 1e3:>8.1f}ms")
+    summed = sum(min(phases[k]) for k in phases if k != "e2e")
+    print(f"{'sum(parts)':<12} {summed * 1e3:>8.1f}ms")
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            for _ in range(3):
+                runner.execute_plan(plan)
+        print("trace written to", args.trace)
+
+
+if __name__ == "__main__":
+    main()
