@@ -45,11 +45,15 @@ def _table_rows(runner, schema: str, table: str) -> int:
     """Driving-table cardinality from connector stats (the closed-form
     generator's counts differ slightly from upstream dbgen's, so rows/s
     must use the rows this engine actually scans)."""
+    return _table_rows_cat(runner, "tpch", schema, table)
+
+
+def _table_rows_cat(runner, catalog: str, schema: str, table: str) -> int:
     from presto_tpu.connectors.spi import TableHandle
 
-    conn = runner.catalogs.get("tpch")
+    conn = runner.catalogs.get(catalog)
     st = conn.metadata().get_table_stats(
-        TableHandle("tpch", schema, table)
+        TableHandle(catalog, schema, table)
     )
     return int(st.row_count)
 
@@ -83,6 +87,19 @@ select o_orderkey, o_custkey,
   row_number() over (partition by o_custkey order by o_orderdate) as rn,
   rank() over (partition by o_orderpriority order by o_totalprice) as rk
 from tpch.SCHEMA.orders
+"""
+
+_Q18 = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+  sum(l_quantity) as total_qty
+from tpch.SCHEMA.customer, tpch.SCHEMA.orders, tpch.SCHEMA.lineitem
+where o_orderkey in (
+    select l_orderkey from tpch.SCHEMA.lineitem
+    group by l_orderkey having sum(l_quantity) > 300)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100
 """
 
 
@@ -138,9 +155,13 @@ def main() -> None:
     if not run_all:
         return
 
+    from presto_tpu import queries_tpcds
+
     extra = [
         ("tpch_q3_sf10_rows_per_sec", _Q3, "sf10", "lineitem", 10),
         ("tpch_q5_sf10_rows_per_sec", _Q5, "sf10", "lineitem", 5),
+        ("tpch_q18_sf1_rows_per_sec", _Q18, "sf1", "lineitem", 100),
+        ("tpch_q18_sf10_rows_per_sec", _Q18, "sf10", "lineitem", 100),
         (
             "tpch_window_orders_sf1_rows_per_sec",
             _WINDOW,
@@ -148,13 +169,34 @@ def main() -> None:
             "orders",
             None,
         ),
+        (
+            "tpcds_q95_tiny_rows_per_sec",
+            queries_tpcds.Q95,
+            None,
+            ("tpcds", "tiny", "web_sales"),
+            None,
+        ),
+        (
+            "tpcds_q64_tiny_rows_per_sec",
+            queries_tpcds.Q64,
+            None,
+            ("tpcds", "tiny", "store_sales"),
+            None,
+        ),
     ]
     for metric, sql, schema, driving, expect in extra:
         try:
+            if isinstance(driving, tuple):
+                cat, sch, tbl = driving
+                nrows = _table_rows_cat(runner, cat, sch, tbl)
+                q = sql
+            else:
+                nrows = _table_rows(runner, schema, driving)
+                q = sql.replace("SCHEMA", schema)
             rps, best = _bench_query(
                 runner,
-                sql.replace("SCHEMA", schema),
-                _table_rows(runner, schema, driving),
+                q,
+                nrows,
                 expect_rows=expect,
             )
             print(

@@ -24,12 +24,14 @@ from presto_tpu.connectors.spi import (  # noqa: F401
     TableHandle,
 )
 from presto_tpu.connectors.tpch import TpchConnector  # noqa: F401
+from presto_tpu.connectors.tpcds import TpcdsConnector  # noqa: F401
 from presto_tpu.connectors.memory import MemoryConnector  # noqa: F401
 from presto_tpu.connectors.blackhole import BlackholeConnector  # noqa: F401
 
 
 CONNECTOR_FACTORIES = {
     "tpch": TpchConnector,
+    "tpcds": TpcdsConnector,
     "memory": MemoryConnector,
     "blackhole": BlackholeConnector,
 }

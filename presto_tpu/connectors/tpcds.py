@@ -1,0 +1,816 @@
+"""TPC-DS connector: deterministic star-schema data generated on the fly.
+
+Reference parity: ``presto-tpcds`` — like ``presto-tpch``, data derived
+from the scale factor at scan time with zero stored bytes (SURVEY.md
+§2.2), so the TPC-DS benchmark configs of BASELINE.json (Q64/Q95) run
+against exactly reproducible fixtures and the sqlite oracle can assert
+exact results over the SAME data.
+
+TPU-first: reuses the closed-form generator machinery of
+``connectors.tpch`` — splitmix64 streams keyed by (column, row index),
+arithmetic bijections for multi-line orders (ticket/order cycles), and
+dictionary-id varchar columns (strings never materialize per row).
+Returns tables are derived row-maps of their sales tables (return j
+references sale row j*K), which keeps the (item, order) FK pairs exact
+in O(1) per row — the property official dsdgen gets from sequential
+generation.
+
+Coverage: the 17 tables Q64/Q95 touch (store/catalog/web sales +
+returns, date_dim, item, customer, customer_address,
+customer_demographics, household_demographics, income_band, store,
+promotion, warehouse, web_site), with the columns those queries and the
+general test corpus exercise. Distributions are TPC-DS-shaped, not
+bit-identical to dsdgen (BASELINE.md provenance: no published reference
+numbers exist; correctness is oracle-diffed).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import (
+    ColumnStats,
+    Connector,
+    ConnectorMetadata,
+    ConnectorSplit,
+    SplitSource,
+    TableHandle,
+    TableStats,
+)
+from presto_tpu.connectors.tpch import (
+    COLORS,
+    DictColumn,
+    _fixed,
+    _LazyCombo,
+    _numbered,
+    _stream,
+    _uniform,
+)
+
+SCHEMAS = {"tiny": 0.01, "sf1": 1.0, "sf10": 10.0, "sf100": 100.0}
+
+_EPOCH = datetime.date(1970, 1, 1)
+_D_START = (datetime.date(1990, 1, 1) - _EPOCH).days
+_D_END = (datetime.date(2002, 12, 31) - _EPOCH).days
+N_DATES = _D_END - _D_START + 1  # 4748 days
+_DATE_SK0 = 2415022  # official dsdgen julian-ish base for d_date_sk
+
+#: sales dates concentrated where the benchmark queries look (Q64 self-
+#: joins syear 1999 x 2000; Q95 windows inside 1999) — official dsdgen
+#: also clusters sales in the 1998-2002 band
+_SOLD_LO = (datetime.date(1998, 1, 1) - _EPOCH).days
+_SOLD_HI = (datetime.date(2000, 12, 31) - _EPOCH).days
+
+MARITAL = ["D", "M", "S", "U", "W"]
+GENDER = ["F", "M"]
+EDUCATION = [
+    "2 yr Degree", "4 yr Degree", "Advanced Degree", "College",
+    "Primary", "Secondary", "Unknown",
+]
+CREDIT = ["Good", "High Risk", "Low Risk", "Unknown"]
+BUY_POTENTIAL = ["0-500", "1001-5000", "501-1000", ">10000", "5001-10000",
+                 "Unknown"]
+STATES = ["CA", "GA", "IL", "MI", "NY", "OH", "PA", "TN", "TX", "WA"]
+CITIES = [
+    "Antioch", "Bridgeport", "Centerville", "Clifton", "Fairview",
+    "Five Points", "Glendale", "Greenfield", "Liberty", "Lincoln",
+    "Marion", "Midway", "Mount Olive", "Mount Zion", "Oak Grove",
+    "Oak Hill", "Oakland", "Pleasant Grove", "Pleasant Hill", "Riverside",
+    "Salem", "Shady Grove", "Springdale", "Spring Hill", "Sulphur Springs",
+    "Union", "Unionville", "Walnut Grove", "White Oak", "Woodville",
+]
+STREET_W1 = [
+    "1st", "2nd", "3rd", "4th", "5th", "6th", "7th", "8th", "9th", "10th",
+    "Adams", "Birch", "Cedar", "Chestnut", "Church", "College", "Davis",
+    "Dogwood", "East", "Elm",
+]
+STREET_W2 = [
+    "Ave", "Blvd", "Circle", "Court", "Dr", "Lane", "Parkway", "Pkwy",
+    "RD", "ST", "Street", "Way", "Wy", "Boulevard", "Cir", "Ct", "Drive",
+    "Ln", "Pl", "Road",
+]
+STORE_NAMES = ["able", "anti", "ation", "bar", "cally", "eing", "ese",
+               "n st", "ought", "pri"]
+COMPANIES = ["pri", "able", "ese", "anti", "cally", "ation"]
+CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+              "Men", "Music", "Shoes", "Sports", "Women"]
+PROMO_CHANNELS = ["N", "Y"]
+
+_STREET_NAME = _LazyCombo(STREET_W1, STREET_W2)
+_I_NAME = _LazyCombo(COLORS, COLORS)
+_ZIPS = [f"{z:05d}" for z in range(10000, 10000 + 100 * 97, 97)]
+_STREET_NUMS = [str(n) for n in range(1, 1001)]
+
+D7_2 = T.decimal(7, 2)
+
+
+# -------------------------------------------------- multi-line order maps
+
+#: tickets/orders carry 1..4 line items cycling; closed form mirrors
+#: tpch's lineitem cycle (connectors.tpch._lineitem_order)
+_CYC = np.array([0, 1, 3, 6, 10], dtype=np.int64)  # prefix sums of 1..4
+_ROWS_PER_CYC = 10
+_ORDERS_PER_CYC = 4
+
+
+def _order_of_row(rows: np.ndarray):
+    """sales row -> (order index 0-based, line number 1-based)."""
+    cyc, rr = np.divmod(rows, _ROWS_PER_CYC)
+    j = np.searchsorted(_CYC, rr, side="right") - 1
+    return cyc * _ORDERS_PER_CYC + j, rr - _CYC[j] + 1
+
+
+# ------------------------------------------------------------- row counts
+
+
+def _counts(sf: float) -> Dict[str, int]:
+    root = max(sf, 0.01) ** 0.5
+    ss = max(int(2_880_000 * sf), 100)
+    cs = max(int(1_440_000 * sf), 100)
+    ws = max(int(720_000 * sf), 90)
+    return {
+        "date_dim": N_DATES,
+        "income_band": 20,
+        "customer_demographics": 5600,  # 2*5*7*20*4 mixed radix
+        "household_demographics": 1200,  # 20*6*10 mixed radix
+        "warehouse": 5,
+        "web_site": 6,
+        "store": max(int(12 * root), 2),
+        "promotion": max(int(300 * sf), 3),
+        "item": max(int(18_000 * sf), 100),
+        "customer": max(int(100_000 * sf), 500),
+        "customer_address": max(int(50_000 * sf), 250),
+        "store_sales": ss,
+        "store_returns": ss // 2,
+        "catalog_sales": cs,
+        "catalog_returns": cs // 2,
+        "web_sales": ws,
+        "web_returns": ws // 3,
+    }
+
+
+# --------------------------------------------------------------- schemas
+
+TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
+    "date_dim": {
+        "d_date_sk": T.INTEGER,
+        "d_date": T.DATE,
+        "d_year": T.INTEGER,
+        "d_moy": T.INTEGER,
+        "d_dom": T.INTEGER,
+        "d_qoy": T.INTEGER,
+        "d_day_name": T.VARCHAR,
+    },
+    "income_band": {
+        "ib_income_band_sk": T.INTEGER,
+        "ib_lower_bound": T.INTEGER,
+        "ib_upper_bound": T.INTEGER,
+    },
+    "customer_demographics": {
+        "cd_demo_sk": T.INTEGER,
+        "cd_gender": T.VARCHAR,
+        "cd_marital_status": T.VARCHAR,
+        "cd_education_status": T.VARCHAR,
+        "cd_purchase_estimate": T.INTEGER,
+        "cd_credit_rating": T.VARCHAR,
+    },
+    "household_demographics": {
+        "hd_demo_sk": T.INTEGER,
+        "hd_income_band_sk": T.INTEGER,
+        "hd_buy_potential": T.VARCHAR,
+        "hd_dep_count": T.INTEGER,
+    },
+    "warehouse": {
+        "w_warehouse_sk": T.INTEGER,
+        "w_warehouse_name": T.VARCHAR,
+        "w_state": T.VARCHAR,
+    },
+    "web_site": {
+        "web_site_sk": T.INTEGER,
+        "web_site_id": T.VARCHAR,
+        "web_name": T.VARCHAR,
+        "web_company_name": T.VARCHAR,
+    },
+    "store": {
+        "s_store_sk": T.INTEGER,
+        "s_store_id": T.VARCHAR,
+        "s_store_name": T.VARCHAR,
+        "s_state": T.VARCHAR,
+        "s_zip": T.VARCHAR,
+    },
+    "promotion": {
+        "p_promo_sk": T.INTEGER,
+        "p_promo_id": T.VARCHAR,
+        "p_channel_email": T.VARCHAR,
+    },
+    "item": {
+        "i_item_sk": T.INTEGER,
+        "i_item_id": T.VARCHAR,
+        "i_product_name": T.VARCHAR,
+        "i_color": T.VARCHAR,
+        "i_current_price": D7_2,
+        "i_category": T.VARCHAR,
+        "i_manufact_id": T.INTEGER,
+    },
+    "customer": {
+        "c_customer_sk": T.INTEGER,
+        "c_customer_id": T.VARCHAR,
+        "c_current_cdemo_sk": T.INTEGER,
+        "c_current_hdemo_sk": T.INTEGER,
+        "c_current_addr_sk": T.INTEGER,
+        "c_first_sales_date_sk": T.INTEGER,
+        "c_first_shipto_date_sk": T.INTEGER,
+        "c_birth_year": T.INTEGER,
+    },
+    "customer_address": {
+        "ca_address_sk": T.INTEGER,
+        "ca_street_number": T.VARCHAR,
+        "ca_street_name": T.VARCHAR,
+        "ca_city": T.VARCHAR,
+        "ca_state": T.VARCHAR,
+        "ca_zip": T.VARCHAR,
+    },
+    "store_sales": {
+        "ss_sold_date_sk": T.INTEGER,
+        "ss_item_sk": T.INTEGER,
+        "ss_customer_sk": T.INTEGER,
+        "ss_cdemo_sk": T.INTEGER,
+        "ss_hdemo_sk": T.INTEGER,
+        "ss_addr_sk": T.INTEGER,
+        "ss_store_sk": T.INTEGER,
+        "ss_promo_sk": T.INTEGER,
+        "ss_ticket_number": T.INTEGER,
+        "ss_quantity": T.INTEGER,
+        "ss_wholesale_cost": D7_2,
+        "ss_list_price": D7_2,
+        "ss_coupon_amt": D7_2,
+    },
+    "store_returns": {
+        "sr_returned_date_sk": T.INTEGER,
+        "sr_item_sk": T.INTEGER,
+        "sr_ticket_number": T.INTEGER,
+        "sr_return_amt": D7_2,
+    },
+    "catalog_sales": {
+        "cs_sold_date_sk": T.INTEGER,
+        "cs_bill_customer_sk": T.INTEGER,
+        "cs_item_sk": T.INTEGER,
+        "cs_order_number": T.INTEGER,
+        "cs_quantity": T.INTEGER,
+        "cs_ext_list_price": D7_2,
+    },
+    "catalog_returns": {
+        "cr_returned_date_sk": T.INTEGER,
+        "cr_item_sk": T.INTEGER,
+        "cr_order_number": T.INTEGER,
+        "cr_refunded_cash": D7_2,
+        "cr_reversed_charge": D7_2,
+        "cr_store_credit": D7_2,
+    },
+    "web_sales": {
+        "ws_sold_date_sk": T.INTEGER,
+        "ws_ship_date_sk": T.INTEGER,
+        "ws_item_sk": T.INTEGER,
+        "ws_ship_addr_sk": T.INTEGER,
+        "ws_web_site_sk": T.INTEGER,
+        "ws_warehouse_sk": T.INTEGER,
+        "ws_order_number": T.INTEGER,
+        "ws_ext_ship_cost": D7_2,
+        "ws_net_profit": D7_2,
+    },
+    "web_returns": {
+        "wr_returned_date_sk": T.INTEGER,
+        "wr_item_sk": T.INTEGER,
+        "wr_order_number": T.INTEGER,
+        "wr_return_amt": D7_2,
+    },
+}
+
+
+# ------------------------------------------------------------ generators
+
+
+class TpcdsGenerator:
+    def __init__(self, sf: float):
+        self.sf = sf
+        self.counts = _counts(sf)
+
+    def generate(
+        self, table: str, lo: int, hi: int, columns: Sequence[str]
+    ) -> Dict[str, object]:
+        rows = np.arange(lo, hi, dtype=np.int64)
+        return getattr(self, f"_gen_{table}")(rows, list(columns))
+
+    # -- dimensions ---------------------------------------------------
+
+    def _gen_date_dim(self, rows, columns):
+        days = _D_START + rows
+        dates = [_EPOCH + datetime.timedelta(days=int(d)) for d in days]
+        out = {}
+        for c in columns:
+            if c == "d_date_sk":
+                out[c] = _DATE_SK0 + rows
+            elif c == "d_date":
+                out[c] = days
+            elif c == "d_year":
+                out[c] = np.asarray([d.year for d in dates], np.int64)
+            elif c == "d_moy":
+                out[c] = np.asarray([d.month for d in dates], np.int64)
+            elif c == "d_dom":
+                out[c] = np.asarray([d.day for d in dates], np.int64)
+            elif c == "d_qoy":
+                out[c] = np.asarray(
+                    [(d.month - 1) // 3 + 1 for d in dates], np.int64
+                )
+            elif c == "d_day_name":
+                out[c] = _fixed(
+                    ["Sunday", "Monday", "Tuesday", "Wednesday",
+                     "Thursday", "Friday", "Saturday"],
+                    (days + 4) % 7,  # 1970-01-01 was a Thursday
+                )
+        return out
+
+    def _date_sk_for(self, days: np.ndarray) -> np.ndarray:
+        """epoch-days -> d_date_sk (clipped into the dimension)."""
+        return _DATE_SK0 + np.clip(days - _D_START, 0, N_DATES - 1)
+
+    def _gen_income_band(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "ib_income_band_sk":
+                out[c] = rows + 1
+            elif c == "ib_lower_bound":
+                out[c] = rows * 10000
+            elif c == "ib_upper_bound":
+                out[c] = rows * 10000 + 9999
+        return out
+
+    def _gen_customer_demographics(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "cd_demo_sk":
+                out[c] = rows + 1
+            elif c == "cd_gender":
+                out[c] = _fixed(GENDER, rows % 2)
+            elif c == "cd_marital_status":
+                out[c] = _fixed(MARITAL, (rows // 2) % 5)
+            elif c == "cd_education_status":
+                out[c] = _fixed(EDUCATION, (rows // 10) % 7)
+            elif c == "cd_purchase_estimate":
+                out[c] = 500 * (1 + (rows // 70) % 20)
+            elif c == "cd_credit_rating":
+                out[c] = _fixed(CREDIT, (rows // 1400) % 4)
+        return out
+
+    def _gen_household_demographics(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "hd_demo_sk":
+                out[c] = rows + 1
+            elif c == "hd_income_band_sk":
+                out[c] = rows % 20 + 1
+            elif c == "hd_buy_potential":
+                out[c] = _fixed(BUY_POTENTIAL, (rows // 20) % 6)
+            elif c == "hd_dep_count":
+                out[c] = (rows // 120) % 10
+        return out
+
+    def _gen_warehouse(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "w_warehouse_sk":
+                out[c] = rows + 1
+            elif c == "w_warehouse_name":
+                out[c] = _fixed(
+                    ["Bad cards must make.",
+                     "Conventional childr",
+                     "Doors canno",
+                     "Important issues liv",
+                     "Rooms cook ",
+                     ][: max(int(self.counts["warehouse"]), 1)],
+                    rows % self.counts["warehouse"],
+                )
+            elif c == "w_state":
+                out[c] = _fixed(STATES, rows % len(STATES))
+        return out
+
+    def _gen_web_site(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "web_site_sk":
+                out[c] = rows + 1
+            elif c == "web_site_id":
+                out[c] = _numbered("site", self.counts["web_site"], rows + 1)
+            elif c == "web_name":
+                out[c] = _numbered("web", self.counts["web_site"], rows + 1)
+            elif c == "web_company_name":
+                # 2 of 6 sites belong to 'pri' (Q95's company filter must
+                # select a meaningful slice at every scale)
+                out[c] = _fixed(COMPANIES, rows % 3)
+        return out
+
+    def _gen_store(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "s_store_sk":
+                out[c] = rows + 1
+            elif c == "s_store_id":
+                out[c] = _numbered("Store", self.counts["store"], rows + 1)
+            elif c == "s_store_name":
+                out[c] = _fixed(STORE_NAMES, rows % len(STORE_NAMES))
+            elif c == "s_state":
+                out[c] = _fixed(STATES, rows % len(STATES))
+            elif c == "s_zip":
+                out[c] = _fixed(_ZIPS, rows % len(_ZIPS))
+        return out
+
+    def _gen_promotion(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "p_promo_sk":
+                out[c] = rows + 1
+            elif c == "p_promo_id":
+                out[c] = _numbered(
+                    "Promo", self.counts["promotion"], rows + 1
+                )
+            elif c == "p_channel_email":
+                out[c] = _fixed(PROMO_CHANNELS, rows % 2)
+        return out
+
+    def _gen_item(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "i_item_sk":
+                out[c] = rows + 1
+            elif c == "i_item_id":
+                out[c] = _numbered("Item", self.counts["item"], rows + 1)
+            elif c == "i_product_name":
+                out[c] = _I_NAME.column(1401, rows)
+            elif c == "i_color":
+                out[c] = _fixed(
+                    COLORS,
+                    (_stream(1402, rows) % np.uint64(len(COLORS))).astype(
+                        np.int64
+                    ),
+                )
+            elif c == "i_current_price":
+                # 50.00..90.00: Q64's price-window parameters select a
+                # real slice of items at every scale factor
+                out[c] = _uniform(1403, rows, 5000, 9000)
+            elif c == "i_category":
+                out[c] = _fixed(CATEGORIES, _uniform(1404, rows, 0, 9))
+            elif c == "i_manufact_id":
+                out[c] = _uniform(1405, rows, 1, 1000)
+        return out
+
+    def _gen_customer(self, rows, columns):
+        cn = self.counts
+        out = {}
+        for c in columns:
+            if c == "c_customer_sk":
+                out[c] = rows + 1
+            elif c == "c_customer_id":
+                out[c] = _numbered("Customer", cn["customer"], rows + 1)
+            elif c == "c_current_cdemo_sk":
+                out[c] = _uniform(
+                    1501, rows, 1, cn["customer_demographics"]
+                )
+            elif c == "c_current_hdemo_sk":
+                out[c] = _uniform(
+                    1502, rows, 1, cn["household_demographics"]
+                )
+            elif c == "c_current_addr_sk":
+                out[c] = _uniform(1503, rows, 1, cn["customer_address"])
+            elif c == "c_first_sales_date_sk":
+                out[c] = self._date_sk_for(
+                    _uniform(1504, rows, _D_START, _SOLD_HI)
+                )
+            elif c == "c_first_shipto_date_sk":
+                out[c] = self._date_sk_for(
+                    _uniform(1505, rows, _D_START, _SOLD_HI)
+                )
+            elif c == "c_birth_year":
+                out[c] = _uniform(1506, rows, 1930, 1990)
+        return out
+
+    def _gen_customer_address(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "ca_address_sk":
+                out[c] = rows + 1
+            elif c == "ca_street_number":
+                out[c] = _fixed(
+                    _STREET_NUMS,
+                    _uniform(1601, rows, 0, len(_STREET_NUMS) - 1),
+                )
+            elif c == "ca_street_name":
+                out[c] = _STREET_NAME.column(1602, rows)
+            elif c == "ca_city":
+                out[c] = _fixed(
+                    CITIES, _uniform(1603, rows, 0, len(CITIES) - 1)
+                )
+            elif c == "ca_state":
+                out[c] = _fixed(
+                    STATES, _uniform(1604, rows, 0, len(STATES) - 1)
+                )
+            elif c == "ca_zip":
+                out[c] = _fixed(
+                    _ZIPS, _uniform(1605, rows, 0, len(_ZIPS) - 1)
+                )
+        return out
+
+    # -- fact tables --------------------------------------------------
+
+    def _ss_fields(self, rows):
+        """Shared store_sales row fields (store_returns derives from the
+        same closed forms via its row map, keeping FK pairs exact)."""
+        cn = self.counts
+        ticket, _line = _order_of_row(rows)
+        return {
+            "ticket": ticket + 1,
+            "item": _uniform(1701, rows, 1, cn["item"]),
+            "sold": _uniform(1702, rows, _SOLD_LO, _SOLD_HI),
+        }
+
+    def _gen_store_sales(self, rows, columns):
+        cn = self.counts
+        f = self._ss_fields(rows)
+        wholesale = _uniform(1703, rows, 100, 10000)
+        out = {}
+        for c in columns:
+            if c == "ss_sold_date_sk":
+                out[c] = self._date_sk_for(f["sold"])
+            elif c == "ss_item_sk":
+                out[c] = f["item"]
+            elif c == "ss_customer_sk":
+                out[c] = _uniform(1704, rows, 1, cn["customer"])
+            elif c == "ss_cdemo_sk":
+                out[c] = _uniform(
+                    1705, rows, 1, cn["customer_demographics"]
+                )
+            elif c == "ss_hdemo_sk":
+                out[c] = _uniform(
+                    1706, rows, 1, cn["household_demographics"]
+                )
+            elif c == "ss_addr_sk":
+                out[c] = _uniform(1707, rows, 1, cn["customer_address"])
+            elif c == "ss_store_sk":
+                out[c] = _uniform(1708, rows, 1, cn["store"])
+            elif c == "ss_promo_sk":
+                out[c] = _uniform(1709, rows, 1, cn["promotion"])
+            elif c == "ss_ticket_number":
+                out[c] = f["ticket"]
+            elif c == "ss_quantity":
+                out[c] = _uniform(1710, rows, 1, 100)
+            elif c == "ss_wholesale_cost":
+                out[c] = wholesale
+            elif c == "ss_list_price":
+                out[c] = wholesale + _uniform(1711, rows, 0, 5000)
+            elif c == "ss_coupon_amt":
+                r = _uniform(1712, rows, 0, 9)
+                out[c] = np.where(
+                    r < 8, 0, _uniform(1713, rows, 100, 2000)
+                )
+        return out
+
+    def _gen_store_returns(self, rows, columns):
+        src = rows * 2  # return j <-> store_sales row 2j
+        f = self._ss_fields(src)
+        out = {}
+        for c in columns:
+            if c == "sr_returned_date_sk":
+                out[c] = self._date_sk_for(
+                    f["sold"] + _uniform(1801, rows, 1, 90)
+                )
+            elif c == "sr_item_sk":
+                out[c] = f["item"]
+            elif c == "sr_ticket_number":
+                out[c] = f["ticket"]
+            elif c == "sr_return_amt":
+                out[c] = _uniform(1802, rows, 100, 10000)
+        return out
+
+    def _cs_fields(self, rows):
+        cn = self.counts
+        order, _line = _order_of_row(rows)
+        return {
+            "order": order + 1,
+            "item": _uniform(1901, rows, 1, cn["item"]),
+            "sold": _uniform(1902, rows, _SOLD_LO, _SOLD_HI),
+        }
+
+    def _gen_catalog_sales(self, rows, columns):
+        cn = self.counts
+        f = self._cs_fields(rows)
+        out = {}
+        for c in columns:
+            if c == "cs_sold_date_sk":
+                out[c] = self._date_sk_for(f["sold"])
+            elif c == "cs_bill_customer_sk":
+                out[c] = _uniform(1903, rows, 1, cn["customer"])
+            elif c == "cs_item_sk":
+                out[c] = f["item"]
+            elif c == "cs_order_number":
+                out[c] = f["order"]
+            elif c == "cs_quantity":
+                out[c] = _uniform(1904, rows, 1, 100)
+            elif c == "cs_ext_list_price":
+                out[c] = _uniform(1905, rows, 10000, 100000)
+        return out
+
+    def _gen_catalog_returns(self, rows, columns):
+        src = rows * 2  # return j <-> catalog_sales row 2j
+        f = self._cs_fields(src)
+        out = {}
+        for c in columns:
+            if c == "cr_returned_date_sk":
+                out[c] = self._date_sk_for(
+                    f["sold"] + _uniform(2001, rows, 1, 90)
+                )
+            elif c == "cr_item_sk":
+                out[c] = f["item"]
+            elif c == "cr_order_number":
+                out[c] = f["order"]
+            elif c == "cr_refunded_cash":
+                # bounded well below cs_ext_list_price so Q64's cs_ui
+                # HAVING (sale > 2*refund) keeps a healthy item fraction
+                out[c] = _uniform(2002, rows, 0, 15000)
+            elif c == "cr_reversed_charge":
+                out[c] = _uniform(2003, rows, 0, 5000)
+            elif c == "cr_store_credit":
+                out[c] = _uniform(2004, rows, 0, 5000)
+        return out
+
+    def _ws_fields(self, rows):
+        cn = self.counts
+        order, _line = _order_of_row(rows)
+        sold = _uniform(2101, rows, _SOLD_LO, _SOLD_HI)
+        return {
+            "order": order + 1,
+            "item": _uniform(2102, rows, 1, cn["item"]),
+            "sold": sold,
+        }
+
+    def _gen_web_sales(self, rows, columns):
+        cn = self.counts
+        f = self._ws_fields(rows)
+        out = {}
+        for c in columns:
+            if c == "ws_sold_date_sk":
+                out[c] = self._date_sk_for(f["sold"])
+            elif c == "ws_ship_date_sk":
+                out[c] = self._date_sk_for(
+                    f["sold"] + _uniform(2103, rows, 1, 30)
+                )
+            elif c == "ws_item_sk":
+                out[c] = f["item"]
+            elif c == "ws_ship_addr_sk":
+                out[c] = _uniform(2104, rows, 1, cn["customer_address"])
+            elif c == "ws_web_site_sk":
+                out[c] = _uniform(2105, rows, 1, cn["web_site"])
+            elif c == "ws_warehouse_sk":
+                # 3 warehouses in rotation: multi-line orders usually mix
+                # warehouses, so Q95's ws_wh self-join inequality selects
+                # a real slice
+                out[c] = _uniform(2106, rows, 1, 3)
+            elif c == "ws_order_number":
+                out[c] = f["order"]
+            elif c == "ws_ext_ship_cost":
+                out[c] = _uniform(2107, rows, 100, 10000)
+            elif c == "ws_net_profit":
+                out[c] = _uniform(2108, rows, -5000, 20000)
+        return out
+
+    def _gen_web_returns(self, rows, columns):
+        src = rows * 3  # return j <-> web_sales row 3j
+        f = self._ws_fields(src)
+        out = {}
+        for c in columns:
+            if c == "wr_returned_date_sk":
+                out[c] = self._date_sk_for(
+                    f["sold"] + _uniform(2201, rows, 1, 90)
+                )
+            elif c == "wr_item_sk":
+                out[c] = f["item"]
+            elif c == "wr_order_number":
+                out[c] = f["order"]
+            elif c == "wr_return_amt":
+                out[c] = _uniform(2202, rows, 100, 10000)
+        return out
+
+
+# -------------------------------------------------------------- connector
+
+
+class _TpcdsMetadata(ConnectorMetadata):
+    PRIMARY_KEYS = {
+        "date_dim": ("d_date_sk",),
+        "income_band": ("ib_income_band_sk",),
+        "customer_demographics": ("cd_demo_sk",),
+        "household_demographics": ("hd_demo_sk",),
+        "warehouse": ("w_warehouse_sk",),
+        "web_site": ("web_site_sk",),
+        "store": ("s_store_sk",),
+        "promotion": ("p_promo_sk",),
+        "item": ("i_item_sk",),
+        "customer": ("c_customer_sk",),
+        "customer_address": ("ca_address_sk",),
+        # fact tables: NO primary key declared — the closed-form
+        # generators draw items independently per line, so (item, order)
+        # pairs can repeat; declaring a PK would license build-unique
+        # join plans that those duplicates would silently break
+    }
+
+    FOREIGN_KEYS = {
+        "ss_item_sk": "item", "ss_customer_sk": "customer",
+        "ss_cdemo_sk": "customer_demographics",
+        "ss_hdemo_sk": "household_demographics",
+        "ss_addr_sk": "customer_address", "ss_store_sk": "store",
+        "ss_promo_sk": "promotion",
+        "sr_item_sk": "item",
+        "cs_item_sk": "item", "cs_bill_customer_sk": "customer",
+        "cr_item_sk": "item",
+        "ws_item_sk": "item", "ws_ship_addr_sk": "customer_address",
+        "ws_web_site_sk": "web_site", "ws_warehouse_sk": "warehouse",
+        "wr_item_sk": "item",
+        "c_current_cdemo_sk": "customer_demographics",
+        "c_current_hdemo_sk": "household_demographics",
+        "c_current_addr_sk": "customer_address",
+        "hd_income_band_sk": "income_band",
+    }
+
+    DATE_FKS = (
+        "ss_sold_date_sk", "sr_returned_date_sk", "cs_sold_date_sk",
+        "cr_returned_date_sk", "ws_sold_date_sk", "ws_ship_date_sk",
+        "wr_returned_date_sk", "c_first_sales_date_sk",
+        "c_first_shipto_date_sk",
+    )
+
+    def list_schemas(self):
+        return list(SCHEMAS)
+
+    def list_tables(self, schema):
+        return list(TABLE_SCHEMAS)
+
+    def get_table_schema(self, handle: TableHandle):
+        if handle.schema not in SCHEMAS:
+            raise KeyError(f"unknown tpcds schema: {handle.schema}")
+        if handle.table not in TABLE_SCHEMAS:
+            raise KeyError(f"unknown tpcds table: {handle.table}")
+        return dict(TABLE_SCHEMAS[handle.table])
+
+    def get_table_stats(self, handle: TableHandle):
+        counts = _counts(SCHEMAS[handle.schema])
+        n = counts[handle.table]
+        pk = self.PRIMARY_KEYS.get(handle.table)
+        cols: Dict[str, ColumnStats] = {}
+        for name in TABLE_SCHEMAS[handle.table]:
+            if pk and len(pk) == 1 and name == pk[0]:
+                cols[name] = ColumnStats(
+                    distinct_count=n, min_value=1, max_value=n
+                )
+            elif name in self.DATE_FKS:
+                cols[name] = ColumnStats(
+                    distinct_count=min(N_DATES, n),
+                    min_value=_DATE_SK0,
+                    max_value=_DATE_SK0 + N_DATES - 1,
+                )
+            elif name in self.FOREIGN_KEYS:
+                ref = counts[self.FOREIGN_KEYS[name]]
+                cols[name] = ColumnStats(
+                    distinct_count=min(ref, n), min_value=1, max_value=ref
+                )
+        return TableStats(row_count=float(n), columns=cols, primary_key=pk)
+
+
+class TpcdsConnector(Connector):
+    """Catalog 'tpcds': schemas tiny/sf1/sf10/sf100, zero stored bytes."""
+
+    def __init__(self, **config):
+        self._metadata = _TpcdsMetadata()
+        self._gens: Dict[str, TpcdsGenerator] = {}
+
+    def metadata(self):
+        return self._metadata
+
+    def _gen(self, schema: str) -> TpcdsGenerator:
+        if schema not in self._gens:
+            self._gens[schema] = TpcdsGenerator(SCHEMAS[schema])
+        return self._gens[schema]
+
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+        n = self._gen(handle.schema).counts[handle.table]
+        splits = [
+            ConnectorSplit(handle, lo, min(lo + target_split_rows, n))
+            for lo in range(0, n, target_split_rows)
+        ] or [ConnectorSplit(handle, 0, 0)]
+        return SplitSource(splits)
+
+    def create_page_source(self, split: ConnectorSplit, columns):
+        return self._gen(split.table.schema).generate(
+            split.table.table, split.row_start, split.row_end, columns
+        )

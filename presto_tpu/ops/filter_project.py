@@ -4,11 +4,14 @@ Reference parity: ``ScanFilterAndProjectOperator`` / ``FilterAndProject-
 Operator`` driven by the bytecode-compiled ``PageProcessor`` (selected
 positions + projected blocks) — SURVEY.md §2.1, §3.3.
 
-TPU-first shape: the predicate lowers to a boolean mask, survivors are
-*compacted to the front* with a static-size ``jnp.nonzero`` so the output
-page has the same capacity (XLA static shapes) and a traced ``num_valid``.
-Projections are evaluated over the full page and gathered through the
-selection — XLA fuses mask, select and projection into one kernel, which
+TPU-first shape: the predicate lowers to a boolean mask. By default the
+filter is LAZY — survivors stay in place and the output page carries the
+selection mask (``Page.live``), because on TPU the nonzero+gather
+compaction costs orders of magnitude more than the masked reads
+downstream kernels (agg/join/sort/window all take ``row_mask()``) do
+anyway. ``lazy=False`` forces the eager compact-to-front form for
+consumers that need a dense prefix. Projections are evaluated over the
+full page — XLA fuses mask, select and projection into one kernel, which
 is exactly what the reference's JIT'd PageProcessor does on CPU.
 """
 
@@ -49,7 +52,10 @@ def project(
         )
         names.append(name)
     return Page(
-        blocks=tuple(blocks), num_valid=page.num_valid, names=tuple(names)
+        blocks=tuple(blocks),
+        num_valid=page.num_valid,
+        names=tuple(names),
+        live=page.live,
     )
 
 
@@ -58,23 +64,33 @@ def filter_project(
     predicate: Optional[Expr],
     projections: Sequence[Tuple[str, Expr]],
     out_capacity: Optional[int] = None,
+    lazy: bool = True,
 ) -> Page:
     """Filter by ``predicate`` (None = keep all live rows), then project.
 
-    Output capacity defaults to input capacity; pass a smaller
-    ``out_capacity`` when the planner knows a tighter bound (static shape
-    step-down without a host round-trip)."""
+    ``lazy=True`` (default) returns the masked form (rows in place,
+    ``Page.live`` selection mask) — no gather. ``lazy=False`` compacts
+    survivors to the front. Output capacity defaults to input capacity;
+    pass a smaller ``out_capacity`` when the planner knows a tighter
+    bound (static shape step-down without a host round-trip; implies
+    eager compaction)."""
     if predicate is None:
         out = project(page, projections)
         if out_capacity is not None and out_capacity != page.capacity:
-            from presto_tpu.page import pad_capacity
+            from presto_tpu.page import compact_page
 
-            out = pad_capacity(out, out_capacity)
+            out = compact_page(out, out_capacity)
         return out
 
-    cap = out_capacity if out_capacity is not None else page.capacity
+    # eval_predicate already ANDs row_mask(), which honors Page.live
     mask = eval_predicate(predicate, page)
     count = jnp.sum(mask).astype(jnp.int32)
+
+    if lazy and out_capacity is None:
+        out = project(page, projections)
+        return dataclasses.replace(out, num_valid=count, live=mask)
+
+    cap = out_capacity if out_capacity is not None else page.capacity
     (sel,) = jnp.nonzero(mask, size=cap, fill_value=0)
 
     lowerer = ExprLowerer(page)

@@ -71,29 +71,13 @@ def _key_of(page: Page, key_cols: Sequence[str]) -> Tuple[jnp.ndarray, jnp.ndarr
     return key, ok
 
 
-def _gather_page(page: Page, idx: jnp.ndarray, num_valid, names=None) -> Page:
-    blocks = []
-    use_names = names if names is not None else page.names
-    for name in use_names:
-        blk = page.block(name)
-        blocks.append(
-            dataclasses.replace(
-                blk,
-                data=blk.data[idx],
-                valid=None if blk.valid is None else blk.valid[idx],
-            )
-        )
-    return Page(
-        blocks=tuple(blocks),
-        num_valid=jnp.asarray(num_valid, jnp.int32),
-        names=tuple(use_names),
+def _mask_out(page: Page, keep: jnp.ndarray) -> Page:
+    """Select rows of ``page`` lazily: keep them in place under a live
+    mask (Page masked form) instead of nonzero+gather compaction — the
+    downstream kernels all consume row_mask() (see ops.filter_project)."""
+    return dataclasses.replace(
+        page, live=keep, num_valid=jnp.sum(keep).astype(jnp.int32)
     )
-
-
-def _compact(page: Page, keep: jnp.ndarray) -> Page:
-    count = jnp.sum(keep).astype(jnp.int32)
-    (sel,) = jnp.nonzero(keep, size=page.capacity, fill_value=0)
-    return _gather_page(page, sel, count)
 
 
 def hash_join(
@@ -144,10 +128,10 @@ def hash_join(
     m = jnp.where(p_ok, hi - lo, 0)  # matches per probe row
 
     if join_type == "semi":
-        return _compact(probe, m > 0), jnp.asarray(False)
+        return _mask_out(probe, m > 0), jnp.asarray(False)
     if join_type == "anti":
         keep = (m == 0) & probe.row_mask()
-        return _compact(probe, keep), jnp.asarray(False)
+        return _mask_out(probe, keep), jnp.asarray(False)
 
     if build_unique:
         # PK side: m in {0,1}; output row i <-> probe row i (static!)
@@ -165,8 +149,10 @@ def hash_join(
         )
         if join_type == "inner":
             keep = matched & probe.row_mask()
-            return _compact(out, keep), jnp.asarray(False)
-        return out, jnp.asarray(False)
+            return _mask_out(out, keep), jnp.asarray(False)
+        # left outer keeps every probe row: positional layout, so the
+        # probe's own liveness (mask or prefix) carries over unchanged
+        return dataclasses.replace(out, live=probe.live), jnp.asarray(False)
 
     # general duplicate-capable expansion
     if out_capacity is None:

@@ -68,7 +68,16 @@ def order_by(
 
 
 def limit(page: Page, n: int) -> Page:
-    """LIMIT n: clamp the live-row count (no data movement)."""
+    """LIMIT n: clamp the live-row count (no data movement for
+    prefix-form pages). Masked form compacts first — but only into an
+    n-sized bucket: LIMIT without ORDER BY may return ANY n rows, so
+    gathering just the first n live rows (not the full capacity) keeps
+    the compaction cost O(n) per column instead of O(capacity)."""
+    from presto_tpu.exec.staging import bucket_capacity
+    from presto_tpu.page import compact_page
+
+    if page.live is not None:
+        page = compact_page(page, bucket_capacity(n))
     return dataclasses.replace(
         page, num_valid=jnp.minimum(page.num_valid, n).astype(jnp.int32)
     )

@@ -200,7 +200,7 @@ class Block:
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["blocks", "num_valid"],
+    data_fields=["blocks", "num_valid", "live"],
     meta_fields=["names"],
 )
 @dataclasses.dataclass
@@ -208,13 +208,24 @@ class Page:
     """An ordered set of equal-capacity Blocks + live-row count.
 
     ``names`` is static (tuple of column names); ``blocks`` is the matching
-    tuple of Blocks. The first ``num_valid`` rows are live; padding rows
-    carry unspecified data and must be masked via ``row_mask()``.
+    tuple of Blocks. Two liveness representations (SURVEY.md §7 "Design
+    stance": selection is a mask/selected-indices pair):
+
+    - **prefix form** (``live is None``): the first ``num_valid`` rows are
+      live, the rest is padding. Required at program outputs, exchanges,
+      and host materialization.
+    - **masked form** (``live`` is a bool (capacity,) array): live rows
+      are scattered in place; ``num_valid == sum(live)`` is the live
+      COUNT, not a prefix length. Filters produce this form lazily — on
+      TPU the nonzero+gather compaction costs far more than the masked
+      reads downstream kernels do anyway, so rows stay put until an op
+      genuinely needs prefix order (``compact_page``).
     """
 
     blocks: tuple
-    num_valid: jnp.ndarray  # scalar int32
+    num_valid: jnp.ndarray  # scalar int32: prefix length / live count
     names: tuple
+    live: Optional[jnp.ndarray] = None  # bool (capacity,): masked form
 
     @property
     def capacity(self) -> int:
@@ -232,6 +243,8 @@ class Page:
 
     def row_mask(self) -> jnp.ndarray:
         """Boolean mask over capacity: True for live rows."""
+        if self.live is not None:
+            return self.live
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_valid
 
     def with_blocks(self, names: Sequence[str], blocks: Sequence[Block]) -> "Page":
@@ -277,10 +290,17 @@ class Page:
         exact via int/10**s, dates -> datetime.date)."""
         import datetime
 
-        n = int(self.num_valid)
+        if self.live is not None:
+            # masked form: select host-side (numpy boolean index is cheap
+            # once the arrays are fetched; no device compaction needed)
+            idx = np.nonzero(np.asarray(self.live))[0]
+        else:
+            idx = np.arange(int(self.num_valid))
+        n = len(idx)
         out_cols = {}
         for name, blk in zip(self.names, self.blocks):
-            data, valid = blk.to_numpy(n)
+            data, valid = blk.to_numpy(None)
+            data, valid = data[idx], valid[idx]
             col = []
             for i in range(n):
                 if not valid[i]:
@@ -312,12 +332,43 @@ class Page:
         return {n: b.dtype for n, b in zip(self.names, self.blocks)}
 
 
+def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
+    """Masked form -> prefix form: gather live rows to the front
+    (static-shape ``jnp.nonzero``). Identity for prefix-form pages.
+
+    This is the one place the selection-mask design pays the gather; ops
+    that can consume masks never call it (SURVEY.md §7 "Design stance")."""
+    if page.live is None:
+        if out_capacity is not None and out_capacity != page.capacity:
+            return pad_capacity(page, out_capacity)
+        return page
+    cap = out_capacity if out_capacity is not None else page.capacity
+    (sel,) = jnp.nonzero(page.live, size=cap, fill_value=0)
+    blocks = []
+    for blk in page.blocks:
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[sel],
+                valid=None if blk.valid is None else blk.valid[sel],
+            )
+        )
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.minimum(page.num_valid, cap).astype(jnp.int32),
+        names=page.names,
+    )
+
+
 def pad_capacity(page: Page, capacity: int) -> Page:
     """Re-bucket a page to a new (>= live rows) capacity host-side.
 
     This is the fragment-boundary shape-step: selective filters hand a
     large-capacity page to a smaller compiled bucket. Runs on host between
-    fragments (device->device realloc via XLA pad/slice)."""
+    fragments (device->device realloc via XLA pad/slice). Prefix form
+    only (masked pages go through compact_page)."""
+    if page.live is not None:
+        return compact_page(page, capacity)
     blocks = []
     for blk in page.blocks:
         cap = blk.capacity

@@ -59,7 +59,7 @@ from presto_tpu.ops import (
     hash_join,
     project,
 )
-from presto_tpu.page import Block, Page
+from presto_tpu.page import Block, Page, compact_page
 from presto_tpu.parallel.agg_split import split_aggregation
 from presto_tpu.parallel.exchange import (
     gather_stacked,
@@ -181,6 +181,9 @@ class DistributedQueryRunner(LocalQueryRunner):
                 )
                 meta["dist"] = dist
                 meta["errors"] = [m for m, _ in errors]
+                # fragment boundary: gather_stacked treats num_valid as a
+                # per-shard prefix count, so lazy masks stop here
+                out = compact_page(out)
                 out = dataclasses.replace(
                     out, num_valid=out.num_valid.reshape(1)
                 )

@@ -167,7 +167,10 @@ def partition_exchange(
 
 def replicate(page: Page, n: int, axis: str) -> Page:
     """REPLICATE: all_gather every worker's live rows; each worker ends
-    with the identical concatenation (capacity n * page.capacity)."""
+    with the identical concatenation (capacity n * page.capacity).
+
+    Mask-aware: a masked-form input (lazy filter upstream) gathers its
+    selection mask alongside the data instead of assuming prefix order."""
     cap = page.capacity
     counts = jax.lax.all_gather(page.num_valid, axis)  # (n,)
     blocks: List[Block] = []
@@ -184,7 +187,10 @@ def replicate(page: Page, n: int, axis: str) -> Page:
         num_valid=jnp.sum(counts).astype(jnp.int32),
         names=page.names,
     )
-    live = segmented_live_mask(counts, cap)
+    if page.live is not None:
+        live = jax.lax.all_gather(page.live, axis).reshape(n * cap)
+    else:
+        live = segmented_live_mask(counts, cap)
     return compact_flat(gathered, live, gathered.num_valid)
 
 

@@ -641,6 +641,28 @@ class _Planner:
             nxt = min(cand, key=rank)
             pairs = cand[nxt]
             build = rels[nxt]
+            extra_pairs: List[Tuple[str, str]] = []
+            if len(pairs) > 2:
+                # the join kernel packs at most 2 key columns into its
+                # int64 composite (ops.join.pack_keys); keep the subset
+                # that proves build uniqueness when one exists and apply
+                # the remaining equalities as a post-join residual
+                import itertools
+
+                best = None
+                for combo in itertools.combinations(range(len(pairs)), 2):
+                    keys = tuple(pairs[k][1] for k in combo)
+                    if optimizer.is_build_unique(
+                        build, keys, self.catalogs
+                    ):
+                        best = combo
+                        break
+                if best is None:
+                    best = (0, 1)
+                extra_pairs = [
+                    p for k, p in enumerate(pairs) if k not in best
+                ]
+                pairs = [pairs[k] for k in best]
             lkeys = tuple(p[0] for p in pairs)
             rkeys = tuple(p[1] for p in pairs)
             unique = optimizer.is_build_unique(build, rkeys, self.catalogs)
@@ -659,6 +681,27 @@ class _Planner:
                 out_cap = bucket_capacity(
                     int(max(probe_est, build_est) * 4) + 1024
                 )
+            join_residual = None
+            if extra_pairs:
+                tree_schema = dict(tree.output_schema())
+                build_schema = dict(build.output_schema())
+                eqs = []
+                for ci, cj in extra_pairs:
+                    if cj not in payload:
+                        raise PlanningError(
+                            f"demoted join key {cj} not carried in the "
+                            "join payload (name clash)"
+                        )
+                    eqs.append(
+                        E.Compare(
+                            "=",
+                            E.ColumnRef(ci, tree_schema[ci]),
+                            E.ColumnRef(cj, build_schema[cj]),
+                        )
+                    )
+                join_residual = (
+                    eqs[0] if len(eqs) == 1 else E.And(tuple(eqs))
+                )
             tree = N.JoinNode(
                 left=tree,
                 right=build,
@@ -668,6 +711,7 @@ class _Planner:
                 payload=payload,
                 build_unique=unique,
                 out_capacity=out_cap,
+                residual=join_residual,
             )
             joined.add(nxt)
             remaining.discard(nxt)
@@ -1057,16 +1101,30 @@ class _Planner:
             else:
                 group_keys.append((self._fresh("key"), e))
 
-        aggs: List[AggCall] = []
         agg_map: Dict[ast.Node, str] = {}
         distinct_aggs = [a for a in agg_calls if a.distinct]
+        plain_aggs = [a for a in agg_calls if not a.distinct]
         if distinct_aggs:
-            if len(agg_calls) != 1 or agg_calls[0].name != "count":
+            if len(distinct_aggs) != 1 or distinct_aggs[0].name != "count":
                 raise PlanningError(
-                    "DISTINCT aggregates only supported as a lone "
-                    "count(DISTINCT x)"
+                    "only a single count(DISTINCT x) aggregate is "
+                    "supported (reference: MarkDistinct breadth later)"
                 )
-            a = agg_calls[0]
+            if plain_aggs and len(group_keys) > 2:
+                raise PlanningError(
+                    "count(DISTINCT x) mixed with plain aggregates "
+                    "supports at most 2 group keys (join-key width)"
+                )
+            if plain_aggs and len(group_keys) == 2 and any(
+                e.dtype.np_dtype.itemsize > 4 for _, e in group_keys
+            ):
+                # the stitch join packs both keys into one int64
+                # (ops.join.pack_keys) — fail at plan time, not runtime
+                raise PlanningError(
+                    "count(DISTINCT x) mixed with plain aggregates "
+                    "requires 32-bit group keys when there are two"
+                )
+            a = distinct_aggs[0]
             arg = self._lower(a.args[0], scope)
             dcol = self._fresh("dist")
             pre = N.AggregationNode(
@@ -1087,11 +1145,53 @@ class _Planner:
                 max_groups=self._agg_bucket(node),
             )
             agg_map[a] = out_name
-            out_scope = Scope(
-                dict(post.output_schema()), {}, scope.parent
+            if not plain_aggs:
+                out_scope = self._post_agg_scope(post, scope)
+                result: N.PlanNode = post
+                if sel.having is not None:
+                    pred = self._lower(
+                        sel.having, out_scope, agg_map=agg_map
+                    )
+                    result = N.FilterNode(result, pred)
+                return result, out_scope, agg_map
+            # mixed distinct + plain (reference: MarkDistinct feeding one
+            # HashAggregation): plain aggregates run beside the two-level
+            # distinct tree, stitched per group — a unique-build join on
+            # the group keys, or a single-row broadcast when global
+            plain_node, agg_map2 = self._plain_agg_node(
+                node, group_keys, plain_aggs, scope
             )
-            return post, out_scope, agg_map
+            agg_map.update(agg_map2)
+            if group_keys:
+                stitched: N.PlanNode = N.JoinNode(
+                    left=plain_node,
+                    right=post,
+                    join_type="inner",
+                    left_keys=tuple(n for n, _ in group_keys),
+                    right_keys=tuple(n for n, _ in group_keys),
+                    payload=(out_name,),
+                    build_unique=True,
+                )
+            else:
+                stitched = N.CrossJoinNode(left=plain_node, right=post)
+            out_scope = self._post_agg_scope(stitched, scope)
+            if sel.having is not None:
+                pred = self._lower(sel.having, out_scope, agg_map=agg_map)
+                stitched = N.FilterNode(stitched, pred)
+            return stitched, out_scope, agg_map
 
+        agg_node, agg_map = self._plain_agg_node(
+            node, group_keys, agg_calls, scope
+        )
+        out_scope = self._post_agg_scope(agg_node, scope)
+        if sel.having is not None:
+            pred = self._lower(sel.having, out_scope, agg_map=agg_map)
+            agg_node = N.FilterNode(agg_node, pred)
+        return agg_node, out_scope, agg_map
+
+    def _plain_agg_node(self, node, group_keys, agg_calls, scope):
+        aggs: List[AggCall] = []
+        agg_map: Dict[ast.Node, str] = {}
         for a in agg_calls:
             out_name = self._fresh("agg")
             if a.name == "count" and not a.args:
@@ -1100,18 +1200,25 @@ class _Planner:
                 arg = self._lower(a.args[0], scope)
                 aggs.append(AggCall(a.name, arg, out_name))
             agg_map[a] = out_name
-
         agg_node = N.AggregationNode(
             source=node,
             group_keys=tuple(group_keys),
             aggs=tuple(aggs),
             max_groups=self._agg_bucket(node) if group_keys else 1,
         )
-        out_scope = Scope(dict(agg_node.output_schema()), {}, scope.parent)
-        if sel.having is not None:
-            pred = self._lower(sel.having, out_scope, agg_map=agg_map)
-            agg_node = N.FilterNode(agg_node, pred)
-        return agg_node, out_scope, agg_map
+        return agg_node, agg_map
+
+    def _post_agg_scope(self, agg_node, scope) -> Scope:
+        """Scope after aggregation: only grouped/aggregated columns
+        survive, but alias qualifiers must keep resolving for the ones
+        that do (SELECT ad1.ca_city ... GROUP BY ad1.ca_city)."""
+        out_cols = dict(agg_node.output_schema())
+        quals = {
+            q: {vis: i for vis, i in m.items() if i in out_cols}
+            for q, m in scope.qualifiers.items()
+        }
+        quals = {q: m for q, m in quals.items() if m}
+        return Scope(out_cols, quals, scope.parent)
 
     def _agg_bucket(self, node) -> int:
         est = optimizer.estimate_rows(node, self.catalogs)

@@ -3,9 +3,8 @@
 Reference parity: ``PagesSerde`` — per-block typed encodings with LZ4
 compression and an xxhash checksum on the exchange wire (SURVEY.md §2.5
 "Serialization"). Here: raw little-endian typed buffers per column,
-zlib-compressed (stdlib; the C++ host-agent codec in ``native/`` is the
-hot-path replacement and uses the same frame layout), crc32-checksummed
-per buffer, with a JSON header.
+zlib-compressed (stdlib zlib — numpy buffers in, C deflate underneath),
+crc32-checksummed per buffer, with a JSON header.
 
 Frame layout::
 

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from presto_tpu.connectors.spi import TableHandle
-from presto_tpu.connectors.tpch import DictColumn, TABLE_SCHEMAS, TpchConnector
+from presto_tpu.connectors.tpch import DictColumn, TpchConnector
 from presto_tpu.sql import ast, parse_statement
 
 _EPOCH_OFFSET = 719163  # days from 0001-01-01 to 1970-01-01 per date.toordinal
@@ -35,20 +35,34 @@ def _days_to_iso(days: np.ndarray) -> List[str]:
 
 
 class SqliteOracle:
-    """sqlite mirror of a tpch schema (decimals as REAL, dates as ISO
-    TEXT) plus the dialect renderer."""
+    """sqlite mirror of a generated-catalog schema (decimals as REAL,
+    dates as ISO TEXT) plus the dialect renderer. ``catalog`` selects
+    the fixture connector: "tpch" (default) or "tpcds"."""
 
-    def __init__(self, schema: str = "tiny"):
+    def __init__(self, schema: str = "tiny", catalog: str = "tpch"):
         self.conn = sqlite3.connect(":memory:")
         self.schema = schema
-        self._connector = TpchConnector()
+        self.catalog = catalog
+        if catalog == "tpch":
+            self._connector = TpchConnector()
+            from presto_tpu.connectors.tpch import TABLE_SCHEMAS as ts
+        elif catalog == "tpcds":
+            from presto_tpu.connectors.tpcds import (
+                TABLE_SCHEMAS as ts,
+                TpcdsConnector,
+            )
+
+            self._connector = TpcdsConnector()
+        else:
+            raise KeyError(f"no oracle fixture for catalog {catalog}")
+        self._table_schemas = ts
         self._loaded: set = set()
 
     def load_table(self, table: str) -> None:
         if table in self._loaded:
             return
-        tschema = TABLE_SCHEMAS[table]
-        handle = TableHandle("tpch", self.schema, table)
+        tschema = self._table_schemas[table]
+        handle = TableHandle(self.catalog, self.schema, table)
         cols = list(tschema)
         defs = []
         for c in cols:
@@ -100,7 +114,7 @@ class SqliteOracle:
         stmt = parse_statement(sql)
         assert isinstance(stmt, ast.Select)
         for t in _tables_of(stmt):
-            if t in TABLE_SCHEMAS:
+            if t in self._table_schemas:
                 self.load_table(t)
         rendered = render_sqlite(stmt)
         cur = self.conn.execute(rendered)

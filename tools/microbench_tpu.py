@@ -1,0 +1,130 @@
+"""Primitive-cost microbenchmarks on the live chip.
+
+Isolates the building blocks of the Q1 device program so the aggregate
+kernel design can be chosen from measured numbers, not guesses
+(BASELINE.md perf breakdown; VERDICT r2 item 1):
+
+- plain reductions per dtype (i32/i64/f32/f64): the emulation tax
+- one-hot masked reduce (cap, nseg) per dtype: the current agg shape
+- matmul one-hot (oh.T @ x) per dtype: the MXU alternative
+- chunked scan reduce: bounded-memory alternative
+- gather/sort/cumsum: sorted-path primitives
+
+Usage: python tools/microbench_tpu.py [--cap 8388608] [--nseg 12]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import presto_tpu  # noqa: F401,E402  enables jax x64 — without it the
+# i64/f64 rows would silently measure int32/float32
+
+
+def bench(fn, *args, iters=5):
+    import jax
+
+    fn = jax.jit(fn)
+    out = jax.block_until_ready(fn(*args))  # compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cap", type=int, default=8 * 1024 * 1024)
+    ap.add_argument("--nseg", type=int, default=12)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cap, nseg = args.cap, args.nseg
+    print("devices:", jax.devices(), " cap:", cap, " nseg:", nseg)
+    rng = np.random.RandomState(0)
+    gid_np = rng.randint(0, nseg, size=cap).astype(np.int32)
+    gid = jnp.asarray(gid_np)
+    live = jnp.asarray(rng.rand(cap) < 0.97)
+
+    for name, arr in [
+        ("i32", jnp.asarray(rng.randint(0, 1000, cap).astype(np.int32))),
+        ("i64", jnp.asarray(rng.randint(0, 1000, cap).astype(np.int64))),
+        ("f32", jnp.asarray(rng.rand(cap).astype(np.float32))),
+        ("f64", jnp.asarray(rng.rand(cap).astype(np.float64))),
+    ]:
+        t_sum = bench(lambda x: jnp.sum(x), arr)
+
+        def onehot(x, g=gid):
+            oh = g[:, None] == jnp.arange(nseg, dtype=jnp.int32)[None, :]
+            return jnp.sum(jnp.where(oh, x[:, None], x.dtype.type(0)), axis=0)
+
+        t_oh = bench(onehot, arr)
+
+        def chunked(x, g=gid_np):
+            import jax.lax as lax
+
+            nchunk = 64
+            csize = cap // nchunk
+            xr = x.reshape(nchunk, csize)
+            gr = jnp.asarray(g).reshape(nchunk, csize)
+
+            def body(acc, xg):
+                xc, gc = xg
+                oh = gc[:, None] == jnp.arange(nseg, dtype=jnp.int32)[None, :]
+                return acc + jnp.sum(
+                    jnp.where(oh, xc[:, None], x.dtype.type(0)), axis=0
+                ), None
+
+            acc0 = jnp.zeros((nseg,), x.dtype)
+            out, _ = lax.scan(body, acc0, (xr, gr))
+            return out
+
+        t_chunk = bench(chunked, arr)
+
+        if name in ("f32",):
+            def mm(x, g=gid):
+                oh = (
+                    g[:, None] == jnp.arange(nseg, dtype=jnp.int32)[None, :]
+                ).astype(jnp.float32)
+                return x @ oh
+
+            t_mm = bench(mm, arr)
+        else:
+            t_mm = float("nan")
+        print(
+            f"{name}: sum {t_sum * 1e3:7.2f}ms  onehot {t_oh * 1e3:7.2f}ms  "
+            f"chunked {t_chunk * 1e3:7.2f}ms  matmul {t_mm * 1e3:7.2f}ms"
+        )
+
+    # where/select + compaction primitives
+    f64 = jnp.asarray(rng.rand(cap))
+    i64 = jnp.asarray(rng.randint(0, 1000, cap).astype(np.int64))
+    t = bench(lambda m, x: jnp.where(m, x, 0.0), live, f64)
+    print(f"where f64: {t * 1e3:7.2f}ms")
+    t = bench(lambda x: jnp.cumsum(x), i64)
+    print(f"cumsum i64: {t * 1e3:7.2f}ms")
+    t = bench(lambda x: jnp.cumsum(x.astype(jnp.int32)), gid)
+    print(f"cumsum i32: {t * 1e3:7.2f}ms")
+    t = bench(lambda x: x[jnp.argsort(gid)], f64)
+    print(f"argsort-gather by i32 key (f64 payload): {t * 1e3:7.2f}ms")
+    # comparison ops on i64 (filter predicates)
+    t = bench(lambda x: (x < 500) & (x > 2), i64)
+    print(f"i64 compare pair: {t * 1e3:7.2f}ms")
+    t = bench(lambda x: x * x + x, i64)
+    print(f"i64 mul+add: {t * 1e3:7.2f}ms")
+    t = bench(lambda x: x * x + x, f64)
+    print(f"f64 mul+add: {t * 1e3:7.2f}ms")
+    t = bench(lambda x: x * x + x, f64.astype(jnp.float32))
+    print(f"f32 mul+add: {t * 1e3:7.2f}ms")
+
+
+if __name__ == "__main__":
+    main()
