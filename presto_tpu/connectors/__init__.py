@@ -29,11 +29,18 @@ from presto_tpu.connectors.memory import MemoryConnector  # noqa: F401
 from presto_tpu.connectors.blackhole import BlackholeConnector  # noqa: F401
 
 
+def _parquet_factory(**config):
+    from presto_tpu.connectors.parquet import ParquetConnector
+
+    return ParquetConnector(**config)
+
+
 CONNECTOR_FACTORIES = {
     "tpch": TpchConnector,
     "tpcds": TpcdsConnector,
     "memory": MemoryConnector,
     "blackhole": BlackholeConnector,
+    "parquet": _parquet_factory,  # lazy: pyarrow imports on first use
 }
 
 

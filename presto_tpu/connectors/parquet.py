@@ -1,0 +1,267 @@
+"""Parquet connector: columnar files on disk as queryable tables.
+
+Reference parity: ``presto-parquet`` + the hive-style file connector
+surface (SURVEY.md §2.2 L9 "file-format readers") — columnar reads with
+column pruning, row-group splits, and statistics from file metadata
+(row counts + per-column min/max feed the cost-based optimizer exactly
+like the reference's TupleDomain pruning inputs).
+
+TPU-first shape: the reader produces the engine's staging payloads
+directly — numeric numpy arrays in native representation (decimals as
+scaled int64, dates as epoch days) and strings pre-encoded as
+dictionary ids (strings never touch the device; SURVEY.md §7 "Strings
+on TPU"). Arrow's columnar layout makes this a zero-copy handoff for
+the numeric columns.
+
+Layout: ``root/<schema>/<table>.parquet``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import (
+    ColumnStats,
+    Connector,
+    ConnectorMetadata,
+    ConnectorSplit,
+    SplitSource,
+    TableHandle,
+    TableStats,
+)
+from presto_tpu.connectors.tpch import DictColumn
+from presto_tpu.exec.staging import MaskedColumn
+
+
+def _arrow_to_engine_type(at) -> T.DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_integer(at):
+        return T.BIGINT if at.bit_width > 32 else T.INTEGER
+    if pa.types.is_floating(at):
+        return T.DOUBLE
+    if pa.types.is_decimal(at):
+        if at.precision > 18:
+            raise NotImplementedError(
+                f"decimal({at.precision},{at.scale}) exceeds int64-backed "
+                "decimal(18) (int128 long decimal: future round)"
+            )
+        return T.decimal(at.precision, at.scale)
+    if pa.types.is_date(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.VARCHAR
+    raise NotImplementedError(f"no engine mapping for arrow type {at}")
+
+
+class _ParquetMetadata(ConnectorMetadata):
+    def __init__(self, conn: "ParquetConnector"):
+        self._conn = conn
+
+    def list_schemas(self) -> List[str]:
+        root = self._conn.root
+        return sorted(
+            d
+            for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+
+    def list_tables(self, schema: str) -> List[str]:
+        d = os.path.join(self._conn.root, schema)
+        return sorted(
+            fn[: -len(".parquet")]
+            for fn in os.listdir(d)
+            if fn.endswith(".parquet")
+        )
+
+    def get_table_schema(self, handle: TableHandle) -> Dict[str, T.DataType]:
+        pf = self._conn._file(handle)
+        return {
+            f.name: _arrow_to_engine_type(f.type)
+            for f in pf.schema_arrow
+        }
+
+    def get_table_stats(self, handle: TableHandle) -> TableStats:
+        """Row count + per-column min/max straight from the parquet
+        footer (zero data reads) — the optimizer's range-selectivity
+        and join-sizing inputs."""
+        pf = self._conn._file(handle)
+        md = pf.metadata
+        cols: Dict[str, ColumnStats] = {}
+        schema = self.get_table_schema(handle)
+        mins: Dict[str, object] = {}
+        maxs: Dict[str, object] = {}
+        ndv: Dict[str, float] = {}
+        for rg in range(md.num_row_groups):
+            g = md.row_group(rg)
+            for ci in range(g.num_columns):
+                c = g.column(ci)
+                st = c.statistics
+                name = c.path_in_schema
+                if st is None or not st.has_min_max:
+                    continue
+                if not isinstance(st.min, (int, float)):
+                    continue  # numeric ranges only
+                mins[name] = (
+                    st.min if name not in mins else min(mins[name], st.min)
+                )
+                maxs[name] = (
+                    st.max if name not in maxs else max(maxs[name], st.max)
+                )
+                if st.distinct_count:
+                    ndv[name] = ndv.get(name, 0.0) + st.distinct_count
+        for name in schema:
+            if name in mins:
+                cols[name] = ColumnStats(
+                    distinct_count=ndv.get(name),
+                    min_value=float(mins[name]),
+                    max_value=float(maxs[name]),
+                )
+        return TableStats(row_count=float(md.num_rows), columns=cols)
+
+
+class ParquetConnector(Connector):
+    """Catalog over ``root/<schema>/<table>.parquet`` files."""
+
+    def __init__(self, root: str = ".", **config):
+        self.root = root
+        self._metadata = _ParquetMetadata(self)
+        self._files: Dict[TableHandle, object] = {}
+
+    def metadata(self):
+        return self._metadata
+
+    def _path(self, handle: TableHandle) -> str:
+        return os.path.join(
+            self.root, handle.schema, handle.table + ".parquet"
+        )
+
+    def _file(self, handle: TableHandle):
+        import pyarrow.parquet as pq
+
+        pf = self._files.get(handle)
+        if pf is None:
+            path = self._path(handle)
+            if not os.path.exists(path):
+                raise KeyError(f"no parquet table at {path}")
+            pf = pq.ParquetFile(path)
+            self._files[handle] = pf
+        return pf
+
+    def get_splits(
+        self, handle: TableHandle, target_split_rows: int = 1 << 20
+    ) -> SplitSource:
+        """Row-group-aligned splits (the reference's parquet split
+        boundary); expressed as row ranges so the engine's split
+        protocol stays format-agnostic."""
+        pf = self._file(handle)
+        md = pf.metadata
+        splits: List[ConnectorSplit] = []
+        lo = 0
+        acc = 0
+        start = 0
+        for rg in range(md.num_row_groups):
+            acc += md.row_group(rg).num_rows
+            if acc - start >= target_split_rows:
+                splits.append(ConnectorSplit(handle, start, acc))
+                start = acc
+        if acc > start or not splits:
+            splits.append(ConnectorSplit(handle, start, acc))
+        return SplitSource(splits)
+
+    def create_page_source(
+        self, split: ConnectorSplit, columns: Sequence[str]
+    ) -> Dict[str, object]:
+        import pyarrow.parquet as pq
+
+        pf = self._file(split.table)
+        schema = self._metadata.get_table_schema(split.table)
+        # map the row range back onto row groups, then TRIM the read to
+        # exactly [row_start, row_end) — the split contract is a row
+        # range, and the worker batches scans at arbitrary boundaries
+        md = pf.metadata
+        groups: List[int] = []
+        lo = 0
+        first_lo = 0
+        for rg in range(md.num_row_groups):
+            n = md.row_group(rg).num_rows
+            if lo < split.row_end and lo + n > split.row_start:
+                if not groups:
+                    first_lo = lo
+                groups.append(rg)
+            lo += n
+        table = pf.read_row_groups(groups, columns=list(columns))
+        a = split.row_start - first_lo
+        b = split.row_end - first_lo
+        table = table.slice(a, b - a)
+        out: Dict[str, object] = {}
+        for name in columns:
+            arr = table.column(name)
+            out[name] = _arrow_column_to_payload(arr, schema[name])
+        return out
+
+
+def _arrow_column_to_payload(arr, t: T.DataType):
+    """Arrow chunked array -> engine staging payload."""
+    import pyarrow as pa
+
+    combined = arr.combine_chunks()
+    nulls = combined.null_count > 0
+    if t.is_string:
+        ids, valid, dictionary = _encode_arrow_strings(combined)
+        if nulls:
+            return MaskedColumn(
+                data=ids, valid=valid, values=tuple(dictionary)
+            )
+        return DictColumn(
+            ids=ids, values=np.asarray(dictionary, dtype=object)
+        )
+    if t.is_decimal:
+        # arrow decimal128 -> unscaled int64 (precision <= 18 checked
+        # at schema mapping)
+        data = np.asarray(
+            [
+                0 if v is None else int(v.as_py().scaleb(t.scale))
+                for v in combined
+            ],
+            dtype=np.int64,
+        )
+    elif t.name == "date":
+        data = np.asarray(
+            combined.cast(pa.int32()).fill_null(0), dtype=np.int64
+        )
+    elif t.name == "timestamp":
+        data = np.asarray(
+            combined.cast(pa.int64()).fill_null(0), dtype=np.int64
+        )
+    else:
+        data = np.asarray(
+            combined.fill_null(0), dtype=t.np_dtype
+        )
+    if not nulls:
+        return data
+    valid = np.asarray(combined.is_valid(), dtype=bool)
+    return MaskedColumn(data=data, valid=valid)
+
+
+def _encode_arrow_strings(combined):
+    """Arrow string column -> (int32 ids, valid, sorted dictionary)."""
+    valid = np.asarray(combined.is_valid(), dtype=bool)
+    values = combined.fill_null("").to_numpy(zero_copy_only=False)
+    values = values.astype(object)
+    present = values[valid].astype(str)
+    uniq = np.unique(present) if len(present) else np.empty(0, object)
+    ids = np.zeros(len(values), dtype=np.int32)
+    if len(present):
+        ids[valid] = np.searchsorted(
+            uniq.astype(str), present
+        ).astype(np.int32)
+    return ids, valid, uniq.astype(object)

@@ -22,6 +22,7 @@ plan-time placeholder, never a runtime value.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -92,6 +93,10 @@ class LocalQueryRunner:
         #: optional utils.memory.MemoryPool; staged pages reserve
         #: against it (reference: QueryContext -> MemoryPool accounting)
         self.memory_pool = memory_pool
+        #: per-thread pool-owner override: a server embedding this
+        #: runner sets it to ITS query id so pool holders, kill-policy
+        #: victims, and client-visible queries share one id space
+        self._owner_override = threading.local()
         if not catalogs.has("system"):
             from presto_tpu.connectors.system_catalog import SystemConnector
 
@@ -155,6 +160,8 @@ class LocalQueryRunner:
             return QueryResult(
                 ("Session",), _lines_page("\n".join(lines), "Session")
             )
+        if isinstance(stmt, (ast.Insert, ast.CreateTableAs)):
+            return self._execute_write(stmt)
         if isinstance(stmt, ast.ShowSchemas):
             conn = self.catalogs.get(stmt.catalog or self.session.catalog)
             return QueryResult(
@@ -199,6 +206,74 @@ class LocalQueryRunner:
         REGISTRY.counter("queries.finished").update()
         REGISTRY.distribution("query.output_rows").add(qs.output_rows)
         return result
+
+    def _execute_write(self, stmt) -> QueryResult:
+        """Table writer (reference: TableWriterOperator + the SPI's
+        ConnectorPageSink): INSERT INTO ... SELECT | VALUES, and
+        CREATE TABLE AS, against any connector with supports_writes()."""
+        from presto_tpu.connectors.spi import TableHandle
+
+        parts = stmt.target
+        catalog, schema_name = self.session.catalog, self.session.schema
+        if len(parts) == 3:
+            catalog, schema_name, table = parts
+        elif len(parts) == 2:
+            schema_name, table = parts
+        else:
+            (table,) = parts
+        handle = TableHandle(catalog, schema_name, table)
+        conn = self.catalogs.get(catalog)
+        if not conn.supports_writes():
+            raise ExecutionError(f"catalog {catalog} is read-only")
+
+        if isinstance(stmt, ast.CreateTableAs):
+            res = self.execute_plan(
+                plan_statement(stmt.query, self.catalogs, self.session)
+            )
+            tschema = {
+                name: blk.dtype
+                for name, blk in zip(res.page.names, res.page.blocks)
+            }
+            conn.create_table(handle, tschema)
+            cols = _result_columns(res)
+            conn.append_rows(handle, cols)
+            n = int(res.page.num_valid)
+        elif stmt.query is not None:
+            tschema = conn.metadata().get_table_schema(handle)
+            res = self.execute_plan(
+                plan_statement(stmt.query, self.catalogs, self.session)
+            )
+            if len(res.columns) != len(tschema):
+                raise ExecutionError(
+                    f"INSERT column count mismatch: query has "
+                    f"{len(res.columns)}, table has {len(tschema)}"
+                )
+            src = _result_columns(res)
+            cols = {
+                tcol: src[qcol]
+                for tcol, qcol in zip(tschema, res.columns)
+            }
+            conn.append_rows(handle, cols)
+            n = int(res.page.num_valid)
+        else:
+            tschema = conn.metadata().get_table_schema(handle)
+            names = list(tschema)
+            rows = []
+            for row in stmt.values:
+                if len(row) != len(names):
+                    raise ExecutionError(
+                        f"INSERT VALUES arity {len(row)} != table "
+                        f"columns {len(names)}"
+                    )
+                rows.append([_literal_value(e) for e in row])
+            cols = {
+                name: np.asarray([r[i] for r in rows], dtype=object)
+                for i, name in enumerate(names)
+            }
+            conn.append_rows(handle, cols)
+            n = len(rows)
+        page = Page.from_pydict({"rows": [n]}, {"rows": T.BIGINT})
+        return QueryResult(("rows",), page)
 
     def execute_plan(self, plan: Plan, qs=None) -> QueryResult:
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
@@ -416,10 +491,12 @@ class LocalQueryRunner:
                 cacheable = self.catalogs.get(
                     scan.handle.catalog
                 ).cacheable()
+                override = getattr(self._owner_override, "value", None)
                 owner = (
                     "table-cache"
                     if cacheable
-                    else (
+                    else override
+                    or (
                         self._active_qs.query_id
                         if self._active_qs is not None
                         else "adhoc"
@@ -785,6 +862,41 @@ def _merge_split_payloads(datas: List[Dict], columns: List[str]) -> Dict:
         else:
             out[c] = np.concatenate([d[c] for d in datas])
     return out
+
+
+def _result_columns(res: QueryResult) -> Dict[str, np.ndarray]:
+    """QueryResult -> {column: object ndarray of python values} (the
+    write-SPI row format; None = NULL)."""
+    dicts = res.page.to_pylist()
+    return {
+        c: np.asarray([r[c] for r in dicts], dtype=object)
+        for c in res.columns
+    }
+
+
+def _literal_value(e):
+    """INSERT VALUES literal -> python value (numbers, strings, bools,
+    NULL; unary minus)."""
+    from presto_tpu.sql import ast as A
+
+    if isinstance(e, A.NumberLit):
+        t = e.text.lower()
+        if "." in t or "e" in t:  # 1.5, 1e3: float
+            return float(t)
+        return int(t)
+    if isinstance(e, A.StringLit):
+        return e.value
+    if isinstance(e, A.NullLit):
+        return None
+    if isinstance(e, A.BoolLit):
+        return e.value
+    if isinstance(e, A.UnaryOp) and e.op == "-":
+        v = _literal_value(e.arg)
+        return -v
+    raise ExecutionError(
+        "INSERT VALUES supports literal values only "
+        f"(got {type(e).__name__})"
+    )
 
 
 def _message_page(msg: str) -> Page:

@@ -230,6 +230,29 @@ class Cast(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class MathFunc(Expr):
+    """Scalar math over one numeric argument: sqrt | abs | ln | exp |
+    floor | ceil (reference: the scalar function registry's math
+    builtins). All except abs/floor/ceil return DOUBLE; sqrt/ln of
+    out-of-domain values return NULL (SQL-adjacent; the reference
+    raises — documented deviation, keeps the kernel branch-free)."""
+
+    func: str
+    arg: Expr
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        if self.func in ("abs",):
+            return self.arg.dtype
+        if self.func in ("floor", "ceil"):
+            return T.BIGINT
+        return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
 class Between(Expr):
     arg: Expr
     low: Expr
@@ -857,6 +880,30 @@ class ExprLowerer:
             return jnp.zeros((self.page.capacity,), jnp.int32), valid
         mapped = jnp.asarray(lut)[jnp.clip(data, 0, len(lut) - 1)]
         return mapped, valid
+
+    def _eval_mathfunc(self, e: MathFunc):
+        d, v = self.eval(e.arg)
+        at = e.arg.dtype
+        if e.func == "abs":
+            return jnp.abs(d), v
+        x = d.astype(jnp.float64)
+        if at.is_decimal:
+            x = x / (10 ** at.scale)
+        if e.func == "sqrt":
+            out = jnp.sqrt(jnp.maximum(x, 0.0))
+            v = _and_valid(v, x >= 0)
+            return out, v
+        if e.func == "ln":
+            out = jnp.log(jnp.maximum(x, jnp.finfo(jnp.float64).tiny))
+            v = _and_valid(v, x > 0)
+            return out, v
+        if e.func == "exp":
+            return jnp.exp(x), v
+        if e.func == "floor":
+            return jnp.floor(x).astype(jnp.int64), v
+        if e.func == "ceil":
+            return jnp.ceil(x).astype(jnp.int64), v
+        raise NotImplementedError(f"math function {e.func}")
 
     def _eval_extract(self, e: Extract):
         d, v = self.eval(e.arg)

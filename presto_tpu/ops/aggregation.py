@@ -63,7 +63,9 @@ from presto_tpu.page import Block, Page
 
 @dataclasses.dataclass(frozen=True)
 class AggCall:
-    """One aggregate: func in {count, count_star, sum, min, max, avg}."""
+    """One aggregate: func in {count, count_star, sum, min, max, avg,
+    stddev_samp, stddev_pop, var_samp, var_pop} (the planner folds the
+    stddev/variance aliases onto the _samp forms)."""
 
     func: str
     arg: Optional[Expr]  # None only for count_star
@@ -72,6 +74,8 @@ class AggCall:
     def result_type(self) -> T.DataType:
         if self.func in ("count", "count_star"):
             return T.BIGINT
+        if self.func in _VARIANCE_FUNCS:
+            return T.DOUBLE
         t = self.arg.dtype
         if self.func == "sum":
             if t.is_decimal:
@@ -84,6 +88,29 @@ class AggCall:
         if self.func in ("min", "max"):
             return t
         raise NotImplementedError(f"aggregate {self.func}")
+
+
+_VARIANCE_FUNCS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
+
+
+def _variance_block(
+    s1: jnp.ndarray, s2: jnp.ndarray, cnt: jnp.ndarray, func: str
+) -> Block:
+    """Variance family from (Σx, Σx², n) in float64.
+
+    var_pop = Σx²/n − (Σx/n)²; var_samp scales by n/(n−1). NULL when
+    n == 0 (pop) or n < 2 (samp), like the reference."""
+    n = jnp.maximum(cnt, 1).astype(jnp.float64)
+    mean = s1 / n
+    var_pop = jnp.maximum(s2 / n - mean * mean, 0.0)
+    if func.endswith("_samp"):
+        var = var_pop * (n / jnp.maximum(n - 1.0, 1.0))
+        has = cnt > 1
+    else:
+        var = var_pop
+        has = cnt > 0
+    data = jnp.sqrt(var) if func.startswith("stddev") else var
+    return Block(data=data, valid=has, dtype=T.DOUBLE)
 
 
 #: one-hot path ceiling: cost is O(rows * domain) fused on the VPU;
@@ -273,6 +300,15 @@ def _onehot_one_agg(
     group_has_value = cnt > 0
     at = agg.arg.dtype
 
+    if agg.func in _VARIANCE_FUNCS:
+        x = d.astype(jnp.float64)
+        if at.is_decimal:
+            x = x / (10 ** at.scale)
+        xm = jnp.where(ohv, x[:, None], 0.0)
+        s1 = jnp.sum(xm, axis=0)
+        s2 = jnp.sum(jnp.where(ohv, (x * x)[:, None], 0.0), axis=0)
+        return _variance_block(s1, s2, cnt, agg.func)
+
     if agg.func in ("sum", "avg"):
         if at.name in ("double", "real") or agg.func == "avg":
             x = d.astype(jnp.float64)
@@ -445,6 +481,16 @@ def _sorted_one_agg(
     cnt = _cumsum_span(valid_s.astype(jnp.int64), starts, ends)
     group_has_value = cnt > 0
 
+    if agg.func in _VARIANCE_FUNCS:
+        at = agg.arg.dtype
+        x = d.astype(jnp.float64)
+        if at.is_decimal:
+            x = x / (10 ** at.scale)
+        x = jnp.where(valid_s, x, 0.0)
+        s1 = _segmented_scan_reduce(x, bnd, jnp.add)[ends]
+        s2 = _segmented_scan_reduce(x * x, bnd, jnp.add)[ends]
+        return _variance_block(s1, s2, cnt, agg.func)
+
     if agg.func in ("sum", "avg"):
         at = agg.arg.dtype
         if at.name in ("double", "real") or agg.func == "avg":
@@ -553,6 +599,16 @@ def _global_one_agg(
 
     has = one(cnt > 0)
     at = agg.arg.dtype
+
+    if agg.func in _VARIANCE_FUNCS:
+        x = d.astype(jnp.float64)
+        if at.is_decimal:
+            x = x / (10 ** at.scale)
+        x = jnp.where(valid, x, 0.0)
+        blk = _variance_block(
+            one(jnp.sum(x)), one(jnp.sum(x * x)), one(cnt), agg.func
+        )
+        return blk
 
     if agg.func in ("sum", "avg"):
         if at.name in ("double", "real") or agg.func == "avg":

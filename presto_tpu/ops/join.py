@@ -1,4 +1,5 @@
-"""Equi-join kernels: inner / left outer / semi / anti.
+"""Equi-join kernels: inner / left outer / full outer / semi / anti
+(right outer is planned as left outer with the sides swapped).
 
 Reference parity: ``HashBuilderOperator`` -> ``PagesIndex`` ->
 ``LookupSourceFactory`` bridged to ``LookupJoinOperator`` (+``JoinProbe``)
@@ -95,7 +96,12 @@ def hash_join(
 
     Returns (result, overflow). Result columns = all probe columns plus
     ``build_payload`` columns (optionally renamed via ``payload_rename``).
-    join_type: inner | left | semi | anti.
+    join_type: inner | left | full | semi | anti.
+
+    FULL OUTER executes as left outer plus an appended section of
+    unmatched build rows (probe columns NULL) — the appended section
+    rides the Page live-mask (masked form), so no compaction gather is
+    paid for it.
     """
     build_payload = list(build_payload or [])
     payload_rename = payload_rename or {}
@@ -145,19 +151,25 @@ def hash_join(
             matched,
             build_payload,
             payload_rename,
-            left_outer=(join_type == "left"),
+            left_outer=(join_type in ("left", "full")),
         )
         if join_type == "inner":
             keep = matched & probe.row_mask()
             return _mask_out(out, keep), jnp.asarray(False)
-        # left outer keeps every probe row: positional layout, so the
-        # probe's own liveness (mask or prefix) carries over unchanged
-        return dataclasses.replace(out, live=probe.live), jnp.asarray(False)
+        # left/full outer keep every probe row: positional layout, so
+        # the probe's own liveness (mask or prefix) carries over
+        out = dataclasses.replace(out, live=probe.live)
+        if join_type == "full":
+            out = _append_unmatched_build(
+                out, probe, build, pk_eff, p_ok, bk, b_ok,
+                build_payload, payload_rename,
+            )
+        return out, jnp.asarray(False)
 
     # general duplicate-capable expansion
     if out_capacity is None:
         raise ValueError("non-unique inner/left join requires out_capacity")
-    m_eff = jnp.maximum(m, 1) if join_type == "left" else m
+    m_eff = jnp.maximum(m, 1) if join_type in ("left", "full") else m
     m_eff = jnp.where(probe.row_mask(), m_eff, 0)
     total = jnp.cumsum(m_eff)
     out_count = total[-1] if probe.capacity else jnp.asarray(0, jnp.int64)
@@ -180,12 +192,85 @@ def hash_join(
         matched,
         build_payload,
         payload_rename,
-        left_outer=(join_type == "left"),
+        left_outer=(join_type in ("left", "full")),
     )
     out = dataclasses.replace(
         out, num_valid=jnp.minimum(out_count, out_capacity).astype(jnp.int32)
     )
+    if join_type == "full":
+        out = _append_unmatched_build(
+            out, probe, build, pk_eff, p_ok, bk, b_ok,
+            build_payload, payload_rename,
+        )
     return out, overflow
+
+
+def _append_unmatched_build(
+    out: Page,
+    probe: Page,
+    build: Page,
+    pk_eff: jnp.ndarray,
+    p_ok: jnp.ndarray,
+    bk: jnp.ndarray,
+    b_ok: jnp.ndarray,
+    build_payload: Sequence[str],
+    payload_rename: dict,
+) -> Page:
+    """FULL OUTER's second section: build rows no probe key matched,
+    appended after the left-outer section with NULL probe columns. The
+    result is a masked-form Page (section 1's liveness concatenated
+    with the unmatched-build mask) — zero gathers."""
+    # membership of each build key among the live probe keys, by binary
+    # search in the sorted probe keys; matches beyond the live count are
+    # sentinel slots, not real keys — clip like the main probe path does
+    pk_sorted = jnp.sort(jnp.where(p_ok, pk_eff, _I64_MAX))
+    n_live = jnp.sum(p_ok)
+    lo = jnp.minimum(jnp.searchsorted(pk_sorted, bk, side="left"), n_live)
+    hi = jnp.minimum(jnp.searchsorted(pk_sorted, bk, side="right"), n_live)
+    matched_b = b_ok & (hi > lo)
+    keep_b = build.row_mask() & ~matched_b
+
+    rename = payload_rename or {}
+    payload_names = {rename.get(c, c) for c in build_payload}
+    cap_b = build.capacity
+    blocks = []
+    for name, blk in zip(out.names, out.blocks):
+        if name in payload_names:
+            src_name = next(
+                c for c in build_payload if rename.get(c, c) == name
+            )
+            b_blk = build.block(src_name)
+            tail_data = b_blk.data
+            tail_valid = (
+                jnp.ones((cap_b,), jnp.bool_)
+                if b_blk.valid is None
+                else b_blk.valid
+            )
+        else:
+            # probe column: NULL in the appended section
+            tail_data = jnp.zeros((cap_b,), blk.data.dtype)
+            tail_valid = jnp.zeros((cap_b,), jnp.bool_)
+        head_valid = (
+            jnp.ones((out.capacity,), jnp.bool_)
+            if blk.valid is None
+            else blk.valid
+        )
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=jnp.concatenate([blk.data, tail_data]),
+                valid=jnp.concatenate([head_valid, tail_valid]),
+            )
+        )
+    live = jnp.concatenate([out.row_mask(), keep_b])
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=(
+            out.num_valid + jnp.sum(keep_b).astype(jnp.int32)
+        ),
+        names=out.names,
+        live=live,
+    )
 
 
 def _join_output(

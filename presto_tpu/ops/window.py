@@ -36,16 +36,27 @@ from presto_tpu.page import Block, Page
 
 @dataclasses.dataclass(frozen=True)
 class WindowCall:
-    """func in {row_number, rank, dense_rank, sum, count, avg, min, max}."""
+    """func in {row_number, rank, dense_rank, ntile, lag, lead,
+    first_value, last_value, sum, count, avg, min, max}.
+
+    ``offset`` is lag/lead's constant distance (ntile reuses it as the
+    bucket count); ``default`` is lag/lead's constant fill for
+    out-of-partition positions as a Literal/Cast Expr (None = SQL
+    NULL)."""
 
     func: str
     arg: Optional[Expr]  # None for row_number/rank/dense_rank/count(*)
     out_name: str
+    offset: int = 1
+    default: Optional[Expr] = None
 
     def result_type(self) -> T.DataType:
-        if self.func in ("row_number", "rank", "dense_rank", "count"):
+        if self.func in ("row_number", "rank", "dense_rank", "count",
+                         "ntile"):
             return T.BIGINT
         t = self.arg.dtype
+        if self.func in ("lag", "lead", "first_value", "last_value"):
+            return t
         if self.func == "sum":
             if t.is_decimal:
                 return T.decimal(18, t.scale)
@@ -121,11 +132,41 @@ def window(
         for blk in page.blocks
     ]
 
+    # last live row position of each partition (lead bound, ntile size)
+    part_end = jax.ops.segment_max(
+        jnp.where(live_s, pos, -1), pid, num_segments=nseg
+    )
+    part_cnt = jax.ops.segment_sum(
+        live_s.astype(jnp.int64), pid, num_segments=nseg
+    )
+
     for call in calls:
         rt = call.result_type()
         if call.func == "row_number":
             data = pos - part_start[safe_pid] + 1
             blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
+        elif call.func == "ntile":
+            # SQL ntile: sizes differ by at most 1 and the FIRST
+            # (m mod n) buckets take the extra row
+            n_tiles = jnp.int64(max(int(call.offset), 1))
+            rn0 = pos - part_start[safe_pid]
+            m = jnp.maximum(part_cnt[safe_pid], 1)
+            q = m // n_tiles
+            r = m % n_tiles
+            big = r * (q + 1)  # rows covered by the (q+1)-sized buckets
+            data = jnp.where(
+                rn0 < big,
+                rn0 // jnp.maximum(q + 1, 1),
+                r + (rn0 - big) // jnp.maximum(q, 1),
+            ) + 1
+            blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
+        elif call.func in ("lag", "lead", "first_value", "last_value"):
+            blocks.append(
+                _window_nav(
+                    call, page, perm, live_s, safe_pid, part_start,
+                    part_end, peer_end, safe_peer, pos, lowerer,
+                )
+            )
         elif call.func == "rank":
             data = peer_start[safe_peer] - part_start[safe_pid] + 1
             blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
@@ -162,6 +203,63 @@ def window(
 
     return Page(
         blocks=tuple(blocks), num_valid=page.num_valid, names=tuple(names)
+    )
+
+
+def _window_nav(
+    call: WindowCall,
+    page: Page,
+    perm,
+    live_s,
+    safe_pid,
+    part_start,
+    part_end,
+    peer_end,
+    safe_peer,
+    pos,
+    lowerer: ExprLowerer,
+) -> Block:
+    """Navigation functions over the sorted layout: lag/lead by row
+    offset within the partition; first_value at the partition start;
+    last_value at the current frame end (default RANGE frame: the last
+    peer row)."""
+    cap = page.capacity
+    at = call.arg.dtype
+    d, v = lowerer.eval(call.arg)
+    d = jnp.broadcast_to(d, (cap,))[perm]
+    v_s = None if v is None else jnp.broadcast_to(v, (cap,))[perm]
+
+    if call.func == "lag":
+        src = pos - jnp.int64(call.offset)
+        in_part = src >= part_start[safe_pid]
+    elif call.func == "lead":
+        src = pos + jnp.int64(call.offset)
+        in_part = src <= part_end[safe_pid]
+    elif call.func == "first_value":
+        src = part_start[safe_pid].astype(jnp.int64)
+        in_part = jnp.ones((cap,), jnp.bool_)
+    else:  # last_value: frame ends at the last peer row
+        src = peer_end[safe_peer].astype(jnp.int64)
+        in_part = jnp.ones((cap,), jnp.bool_)
+
+    src_c = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
+    data = d[src_c]
+    src_valid = in_part if v_s is None else (in_part & v_s[src_c])
+    if call.default is not None and call.func in ("lag", "lead"):
+        fd, _ = lowerer.eval(call.default)
+        data = jnp.where(in_part, data, jnp.broadcast_to(fd, data.shape))
+        src_valid = (
+            jnp.ones((cap,), jnp.bool_)
+            if v_s is None
+            else jnp.where(in_part, src_valid, True)
+        )
+    valid = live_s & src_valid
+    dictionary = None
+    if at.is_string:
+        dictionary = lowerer.dictionary_of(call.arg)
+    return Block(
+        data=data.astype(at.jnp_dtype), valid=valid, dtype=at,
+        dictionary=dictionary,
     )
 
 

@@ -92,6 +92,83 @@ def split_aggregation(
             needs_post = True
             continue
 
+        if a.func in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+            # mergeable parts: (Σx, Σx², n) in DOUBLE; the post
+            # projection reassembles the variance exactly as the
+            # single-node kernel does (ops.aggregation._variance_block)
+            s1n, s2n, cn = f"$p{i}_s1", f"$p{i}_s2", f"$p{i}_cnt"
+            xd = E.Cast(a.arg, T.DOUBLE)
+            partial_aggs += [
+                AggCall("sum", xd, s1n),
+                AggCall("sum", E.Arithmetic("*", xd, xd, T.DOUBLE), s2n),
+                AggCall("count", a.arg, cn),
+            ]
+            final_aggs += [
+                AggCall("sum", E.ColumnRef(s1n, T.DOUBLE), s1n),
+                AggCall("sum", E.ColumnRef(s2n, T.DOUBLE), s2n),
+                AggCall("sum", E.ColumnRef(cn, T.BIGINT), cn),
+            ]
+            s1 = E.ColumnRef(s1n, T.DOUBLE)
+            s2 = E.ColumnRef(s2n, T.DOUBLE)
+            cnt_ref = E.ColumnRef(cn, T.BIGINT)
+            nf = E.Cast(cnt_ref, T.DOUBLE)
+            mean = E.Arithmetic("/", s1, nf, T.DOUBLE)
+            var_pop = E.Arithmetic(
+                "-",
+                E.Arithmetic("/", s2, nf, T.DOUBLE),
+                E.Arithmetic("*", mean, mean, T.DOUBLE),
+                T.DOUBLE,
+            )
+            if a.func.endswith("_samp"):
+                nm1 = E.Arithmetic(
+                    "-", nf, E.Literal(1.0, T.DOUBLE), T.DOUBLE
+                )
+                var = E.Arithmetic(
+                    "/",
+                    E.Arithmetic("*", var_pop, nf, T.DOUBLE),
+                    nm1,
+                    T.DOUBLE,
+                )
+                min_n = 2
+            else:
+                var = var_pop
+                min_n = 1
+            # clamp fp cancellation residue: a tiny negative variance
+            # must read as 0, not as a NULLed sqrt domain error
+            var = E.Case(
+                whens=(
+                    (
+                        E.Compare("<", var, E.Literal(0.0, T.DOUBLE)),
+                        E.Literal(0.0, T.DOUBLE),
+                    ),
+                ),
+                default=var,
+                _dtype=T.DOUBLE,
+            )
+            if a.func.startswith("stddev"):
+                var = E.MathFunc("sqrt", var)
+            post.append(
+                (
+                    a.out_name,
+                    E.Case(
+                        whens=(
+                            (
+                                E.Compare(
+                                    "<",
+                                    cnt_ref,
+                                    E.Literal(min_n, T.BIGINT),
+                                ),
+                                E.Literal(None, T.DOUBLE),
+                            ),
+                        ),
+                        default=var,
+                        _dtype=T.DOUBLE,
+                    ),
+                )
+            )
+            needs_post = True
+            continue
+
         rt = a.result_type()
         if a.func in ("count", "count_star"):
             partial_aggs.append(a)

@@ -37,6 +37,11 @@ def is_distributable(node: N.PlanNode) -> bool:
     """True when the whole subtree can run inside one sharded fragment."""
     if not isinstance(node, _DISTRIBUTABLE):
         return False
+    if isinstance(node, N.JoinNode) and node.join_type == "full":
+        # a broadcast-build FULL join would emit unmatched build rows
+        # once per worker; until the runner forces partitioned-both-
+        # sides for it, full joins run in the root fragment
+        return False
     return all(is_distributable(c) for c in node.children())
 
 

@@ -97,7 +97,7 @@ class AggregationNode(PlanNode):
 class JoinNode(PlanNode):
     left: PlanNode  # probe
     right: PlanNode  # build
-    join_type: str  # inner | left | semi | anti
+    join_type: str  # inner | left | full | semi | anti
     left_keys: Tuple[str, ...]
     right_keys: Tuple[str, ...]
     payload: Tuple[str, ...]  # build columns carried to output
@@ -109,7 +109,7 @@ class JoinNode(PlanNode):
     def output_schema(self):
         out = dict(self.left.output_schema())
         rename = dict(self.payload_rename)
-        if self.join_type in ("inner", "left"):
+        if self.join_type in ("inner", "left", "full"):
             rs = self.right.output_schema()
             for c in self.payload:
                 out[rename.get(c, c)] = rs[c]

@@ -22,7 +22,105 @@ from typing import Dict, FrozenSet, List, Optional, Set
 from presto_tpu import expr as E
 from presto_tpu.plan import nodes as N
 
+#: fallback when a predicate's shape/stats give no better signal
 FILTER_SELECTIVITY = 0.33
+
+
+def _column_stats(node: N.PlanNode, col: str, catalogs):
+    """ColumnStats for ``col`` seen through filters/projections down to
+    the scan (identity renames only), or None."""
+    if isinstance(node, N.TableScanNode):
+        stats = (
+            catalogs.get(node.handle.catalog)
+            .metadata()
+            .get_table_stats(node.handle)
+        )
+        return (stats.columns or {}).get(col)
+    if isinstance(node, N.FilterNode):
+        return _column_stats(node.source, col, catalogs)
+    if isinstance(node, N.ProjectNode):
+        for out_name, e in node.projections:
+            if out_name == col and isinstance(e, E.ColumnRef):
+                return _column_stats(node.source, e.name, catalogs)
+        return None
+    return None
+
+
+def _conjuncts_of(e: E.Expr) -> List[E.Expr]:
+    if isinstance(e, E.And):
+        out: List[E.Expr] = []
+        for c in e.terms:
+            out.extend(_conjuncts_of(c))
+        return out
+    return [e]
+
+
+def _one_selectivity(e: E.Expr, source: N.PlanNode, catalogs) -> float:
+    """Selectivity of a single conjunct (reference: StatsCalculator's
+    filter estimation — equality via 1/NDV, ranges via the value span,
+    IN via |list|/NDV; shape defaults otherwise)."""
+    if isinstance(e, E.Compare) and isinstance(e.left, E.ColumnRef):
+        cs = _column_stats(source, e.left.name, catalogs)
+        if isinstance(e.right, E.Literal) and e.right.value is not None:
+            if e.op == "=" and cs and cs.distinct_count:
+                return 1.0 / max(cs.distinct_count, 1.0)
+            if e.op in ("<", "<=", ">", ">=") and cs and (
+                cs.min_value is not None
+                and cs.max_value is not None
+                and cs.max_value > cs.min_value
+                and isinstance(e.right.value, (int, float))
+            ):
+                span = cs.max_value - cs.min_value
+                v = float(e.right.value)
+                frac = (v - cs.min_value) / span
+                if e.op in (">", ">="):
+                    frac = 1.0 - frac
+                return min(max(frac, 0.0), 1.0)
+            if e.op == "<>":
+                return 0.9
+        return 0.33 if e.op != "=" else 0.1
+    if isinstance(e, E.Between) and isinstance(e.arg, E.ColumnRef):
+        cs = _column_stats(source, e.arg.name, catalogs)
+        if (
+            cs
+            and cs.min_value is not None
+            and cs.max_value is not None
+            and cs.max_value > cs.min_value
+            and isinstance(getattr(e.low, "value", None), (int, float))
+            and isinstance(getattr(e.high, "value", None), (int, float))
+        ):
+            span = cs.max_value - cs.min_value
+            frac = (float(e.high.value) - float(e.low.value)) / span
+            frac = min(max(frac, 0.0), 1.0)
+            return (1.0 - frac) if e.negate else frac
+        return 0.25
+    if isinstance(e, E.InList):
+        cs = (
+            _column_stats(source, e.arg.name, catalogs)
+            if isinstance(e.arg, E.ColumnRef)
+            else None
+        )
+        if cs and cs.distinct_count:
+            frac = min(len(e.values) / max(cs.distinct_count, 1.0), 1.0)
+            return (1.0 - frac) if e.negate else frac
+        return 0.2
+    if isinstance(e, E.Or):
+        s = 0.0
+        for t in e.terms:
+            s += _one_selectivity(t, source, catalogs)
+        return min(s, 1.0)
+    if isinstance(e, E.Not):
+        return 1.0 - _one_selectivity(e.arg, source, catalogs)
+    return FILTER_SELECTIVITY
+
+
+def predicate_selectivity(
+    pred: E.Expr, source: N.PlanNode, catalogs
+) -> float:
+    s = 1.0
+    for c in _conjuncts_of(pred):
+        s *= _one_selectivity(c, source, catalogs)
+    return max(s, 1e-6)
 
 
 def estimate_rows(node: N.PlanNode, catalogs) -> float:
@@ -34,14 +132,28 @@ def estimate_rows(node: N.PlanNode, catalogs) -> float:
     if isinstance(node, N.ValuesNode):
         return 1.0
     if isinstance(node, N.FilterNode):
-        return max(estimate_rows(node.source, catalogs) * FILTER_SELECTIVITY, 1.0)
+        sel = predicate_selectivity(node.predicate, node.source, catalogs)
+        return max(estimate_rows(node.source, catalogs) * sel, 1.0)
     if isinstance(node, (N.ProjectNode, N.WindowNode, N.OutputNode)):
         return estimate_rows(node.source, catalogs)
     if isinstance(node, N.AggregationNode):
         src = estimate_rows(node.source, catalogs)
         if not node.group_keys:
             return 1.0
-        return max(min(src * 0.1, float(node.max_groups)), 1.0)
+        # groups = product of key NDVs when stats know them (capped by
+        # the input rows), else the classic 10% guess
+        ndv = 1.0
+        known = True
+        for _, e in node.group_keys:
+            if isinstance(e, E.ColumnRef):
+                cs = _column_stats(node.source, e.name, catalogs)
+                if cs and cs.distinct_count:
+                    ndv *= cs.distinct_count
+                    continue
+            known = False
+            break
+        groups = ndv if known else src * 0.1
+        return max(min(groups, src, float(node.max_groups)), 1.0)
     if isinstance(node, N.DistinctNode):
         return max(estimate_rows(node.source, catalogs) * 0.5, 1.0)
     if isinstance(node, N.SortNode):

@@ -127,8 +127,15 @@ class Scope:
         raise PlanningError(f"column not found: {'.'.join(parts)}")
 
 
-AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
-WINDOW_FUNCS = {"row_number", "rank", "dense_rank"} | AGG_FUNCS
+AGG_FUNCS = {
+    "sum", "count", "avg", "min", "max",
+    "stddev", "stddev_samp", "stddev_pop",
+    "variance", "var_samp", "var_pop",
+}
+NAV_WINDOW_FUNCS = {"lag", "lead", "first_value", "last_value", "ntile"}
+WINDOW_FUNCS = (
+    {"row_number", "rank", "dense_rank"} | NAV_WINDOW_FUNCS | AGG_FUNCS
+)
 
 
 def plan_statement(
@@ -261,6 +268,14 @@ class _Planner:
             {o: node.output_schema()[o] for o, _ in uniq_out}, {}
         )
         return node, out_scope, tuple(o for o, _ in uniq_out)
+
+    def _const_int(self, e: ast.Node, what: str) -> int:
+        lowered = self._lower(e, Scope({}, {}))
+        if not isinstance(lowered, E.Literal) or not isinstance(
+            lowered.value, int
+        ):
+            raise PlanningError(f"{what} must be an integer constant")
+        return int(lowered.value)
 
     def _item_name(self, e: ast.Node, i: int) -> str:
         if isinstance(e, ast.Ident):
@@ -410,7 +425,7 @@ class _Planner:
             left_node, right_node = right_node, left_node
             left_scope, right_scope = right_scope, left_scope
             jt = "left"
-        if jt != "left":
+        if jt not in ("left", "full"):
             raise PlanningError(f"unsupported join type: {rel.join_type}")
         (left_node, left_scope), (right_node, right_scope) = (
             self._rename_clashes(
@@ -444,6 +459,14 @@ class _Planner:
             )
         if not lkeys:
             raise PlanningError("outer join requires at least one equi key")
+        if build_filters and jt == "full":
+            # pushing an ON filter into the build side is only sound when
+            # the build's unmatched rows are dropped (left) — a FULL join
+            # preserves them, so the rewrite would change results
+            raise PlanningError(
+                "FULL JOIN ON conditions beyond equi keys are not "
+                "supported yet"
+            )
         if build_filters:
             right_node = N.FilterNode(
                 right_node,
@@ -465,7 +488,7 @@ class _Planner:
         node = N.JoinNode(
             left=left_node,
             right=right_node,
-            join_type="left",
+            join_type=jt,
             left_keys=tuple(lkeys),
             right_keys=tuple(rkeys),
             payload=payload,
@@ -1192,13 +1215,16 @@ class _Planner:
     def _plain_agg_node(self, node, group_keys, agg_calls, scope):
         aggs: List[AggCall] = []
         agg_map: Dict[ast.Node, str] = {}
+        alias = {"stddev": "stddev_samp", "variance": "var_samp"}
         for a in agg_calls:
             out_name = self._fresh("agg")
             if a.name == "count" and not a.args:
                 aggs.append(AggCall("count_star", None, out_name))
             else:
                 arg = self._lower(a.args[0], scope)
-                aggs.append(AggCall(a.name, arg, out_name))
+                aggs.append(
+                    AggCall(alias.get(a.name, a.name), arg, out_name)
+                )
             agg_map[a] = out_name
         agg_node = N.AggregationNode(
             source=node,
@@ -1260,7 +1286,54 @@ class _Planner:
                     wcalls.append(WindowCall(f.name, None, out_name))
                 elif f.name == "count" and not f.args:
                     wcalls.append(WindowCall("count", None, out_name))
+                elif f.name == "ntile":
+                    n = self._const_int(f.args[0], "ntile bucket count")
+                    wcalls.append(
+                        WindowCall("ntile", None, out_name, offset=n)
+                    )
+                elif f.name in ("lag", "lead"):
+                    arg = self._lower(f.args[0], scope)
+                    off = (
+                        self._const_int(f.args[1], f"{f.name} offset")
+                        if len(f.args) > 1
+                        else 1
+                    )
+                    default = None
+                    if len(f.args) > 2:
+                        de = self._lower(f.args[2], scope)
+                        if not isinstance(de, E.Literal):
+                            raise PlanningError(
+                                f"{f.name} default must be a constant"
+                            )
+                        if de.dtype.is_string or arg.dtype.is_string:
+                            # a string default needs dictionary
+                            # resolution against the arg column
+                            raise PlanningError(
+                                f"{f.name} string defaults are not "
+                                "supported yet"
+                            )
+                        # carry the literal as an Expr (cast to the arg
+                        # type) so unit/scale handling stays in expr
+                        default = (
+                            de
+                            if de.dtype == arg.dtype
+                            else E.Cast(de, arg.dtype)
+                        )
+                    wcalls.append(
+                        WindowCall(
+                            f.name, arg, out_name,
+                            offset=off, default=default,
+                        )
+                    )
                 else:
+                    if f.name not in (
+                        "sum", "count", "avg", "min", "max",
+                        "first_value", "last_value",
+                    ):
+                        raise PlanningError(
+                            f"{f.name}() is not supported as a window "
+                            "function"
+                        )
                     arg = self._lower(f.args[0], scope)
                     wcalls.append(WindowCall(f.name, arg, out_name))
                 win_map[f] = out_name
@@ -1443,6 +1516,11 @@ class _Planner:
                 return E.Coalesce(args, rt)
             if e.name == "year":
                 return E.Extract("year", lower(e.args[0]))
+            if e.name in (
+                "sqrt", "abs", "ln", "exp", "floor", "ceil", "ceiling"
+            ):
+                fname = "ceil" if e.name == "ceiling" else e.name
+                return E.MathFunc(fname, lower(e.args[0]))
             raise PlanningError(f"unknown function: {e.name}")
         raise PlanningError(f"cannot lower {type(e).__name__}")
 

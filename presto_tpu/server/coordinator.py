@@ -19,6 +19,7 @@ import json
 import threading
 import time
 import traceback
+import urllib.error
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -76,10 +77,25 @@ class CoordinatorServer:
         session=None,
         max_concurrent_queries: int = 4,
         max_queued_queries: int = 100,
+        config=None,
     ):
         from presto_tpu.exec.local_runner import LocalQueryRunner
+        from presto_tpu.utils.memory import MemoryPool, parse_bytes
 
-        self.local = LocalQueryRunner(catalogs=catalogs, session=session)
+        # memory accounting ALWAYS on (reference: MemoryPool +
+        # ClusterMemoryManager kill-largest policy; limit from tier-1
+        # config query.max-memory-per-node)
+        limit = parse_bytes(
+            (config.get("query.max-memory-per-node") if config else None)
+            or "8GB"
+        )
+        self.memory_pool = MemoryPool(
+            limit, kill_largest=self._kill_largest_query
+        )
+        self.local = LocalQueryRunner(
+            catalogs=catalogs, session=session,
+            memory_pool=self.memory_pool,
+        )
         self.local.cluster = self  # system.runtime.nodes source
         self.workers: Dict[str, _WorkerNode] = {}
         self.queries: Dict[str, _Query] = {}
@@ -110,6 +126,34 @@ class CoordinatorServer:
         if self._serve_thread.is_alive():
             self.httpd.shutdown()
         self.httpd.server_close()
+
+    def _kill_largest_query(self, holders, requester):
+        """ClusterMemoryManager policy: on pool exhaustion, abort the
+        largest memory holder that is a *running query* (never the
+        shared table cache, never the requester) and free its
+        reservation so the requester can proceed."""
+        candidates = {
+            qid: b
+            for qid, b in holders.items()
+            if qid != requester
+            and qid in self.queries
+            and not self.queries[qid].done.is_set()
+        }
+        if not candidates:
+            return None
+        victim = max(candidates, key=candidates.get)
+        vq = self.queries[victim]
+        vq.state = "FAILED"
+        vq.error = (
+            "Query killed by the cluster memory manager: largest "
+            f"holder ({candidates[victim]}B) when the pool was exhausted"
+        )
+        vq.done.set()
+        # cooperative cancel: the victim's thread fails at its next
+        # reservation instead of silently recomputing to completion
+        self.memory_pool.mark_dead(victim)
+        REGISTRY.counter("coordinator.queries_killed_oom").update()
+        return victim
 
     # ---------------------------------------------------------- discovery
 
@@ -179,19 +223,30 @@ class CoordinatorServer:
 
     def _execute_query(self, q: _Query) -> None:
         with self._admit:  # admission gate: bounded concurrency
+            if q.done.is_set():  # killed while queued (memory manager)
+                with self._lock:
+                    self._pending -= 1
+                return
             q.state = "RUNNING"
+            # pool reservations this thread makes are owned by THIS
+            # query id (one id space for holders, kills, and clients)
+            self.local._owner_override.value = q.qid
             try:
                 with REGISTRY.timer("coordinator.query_time").time():
                     self._run_sql(q)
-                q.state = "FINISHED"
+                if not q.done.is_set():  # a killed query stays FAILED
+                    q.state = "FINISHED"
             except Exception as e:
-                q.state = "FAILED"
-                q.error = (
-                    f"{type(e).__name__}: {e}\n"
-                    f"{traceback.format_exc()[-1000:]}"
-                )
+                if not q.done.is_set():
+                    q.state = "FAILED"
+                    q.error = (
+                        f"{type(e).__name__}: {e}\n"
+                        f"{traceback.format_exc()[-1000:]}"
+                    )
                 REGISTRY.counter("coordinator.queries_failed").update()
             finally:
+                self.local._owner_override.value = None
+                self.memory_pool.release(q.qid)
                 with self._lock:
                     self._pending -= 1
                 q.done.set()
@@ -225,9 +280,50 @@ class CoordinatorServer:
             res = self.local.execute_plan(plan)
             self._store_result(q, res)
             return
-        pages = [
-            self._run_stage(r.fragment_root, workers, q) for r in remotes
-        ]
+        # ordered MERGE exchange (reference: MergeOperator): when the
+        # peeled root sort sits directly over a single no-cut fragment,
+        # push the sort into the worker fragment (per-batch sorted runs)
+        # and k-way merge the runs at the gather instead of re-sorting
+        merge_sort = None
+        merge_stage = None
+        if len(remotes) == 1 and isinstance(froot, N.RemoteSourceNode):
+            sorts = [op for op in host_ops if isinstance(op, N.SortNode)]
+            if len(sorts) == 1:
+                merge_stage = plan_stage(
+                    remotes[0].fragment_root, self.local.catalogs
+                )
+                # merge requires raw worker rows: a stage with an
+                # aggregation cut emits PARTIAL states whose sorted
+                # runs would be meaningless
+                if merge_stage is not None and isinstance(
+                    merge_stage.final_root, N.RemoteSourceNode
+                ):
+                    merge_sort = sorts[0]
+        if merge_sort is not None:
+            page = self._run_stage(
+                remotes[0].fragment_root, workers, q,
+                order_by=merge_sort, stage=merge_stage,
+            )
+            host_ops = [op for op in host_ops if op is not merge_sort]
+            if host_ops:
+                page = apply_host_ops(page, host_ops)
+            from presto_tpu.exec.local_runner import QueryResult
+
+            self._store_result(q, QueryResult(plan.output_names, page))
+            return
+        if len(remotes) == 1:
+            pages = [self._run_stage(remotes[0].fragment_root, workers, q)]
+        else:
+            # overlap independent fragments (reference: all stages of a
+            # query run concurrently — inter-stage pipelining)
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(len(remotes)) as pool:
+                futs = [
+                    pool.submit(self._run_stage, r.fragment_root, workers, q)
+                    for r in remotes
+                ]
+                pages = [f.result() for f in futs]
         page = self.local._run_with_pages(froot, remotes, pages)
         if host_ops:
             page = apply_host_ops(page, host_ops)
@@ -237,57 +333,98 @@ class CoordinatorServer:
 
     # ------------------------------------------------------- stage runner
 
-    def _run_stage(self, fragment_root, workers, q: _Query):
-        """Schedule one fragment across workers; gather + finalize."""
-        stage = plan_stage(fragment_root, self.local.catalogs)
+    def _run_stage(
+        self, fragment_root, workers, q: _Query, order_by=None, stage=None
+    ):
+        """Schedule one fragment across workers; gather + finalize.
+
+        ``order_by`` (ordered MERGE exchange): wrap the worker fragment
+        in the given root SortNode so workers emit sorted runs, and
+        k-way merge the runs at the gather instead of re-sorting. The
+        caller guarantees the stage has no aggregation cut."""
+        if stage is None:
+            stage = plan_stage(fragment_root, self.local.catalogs)
         if stage is None:
             # no scan admits a semantics-preserving partitioning:
             # single-task fallback on the coordinator's local engine
             return self.local._run(fragment_root)
+        worker_fragment = stage.worker_fragment
+        if order_by is not None:
+            worker_fragment = dataclasses.replace(
+                order_by, source=worker_fragment
+            )
         ranges = assign_ranges(stage.partition_rows, len(workers))
-        specs = []
-        for w, (lo, hi) in zip(workers, ranges):
-            specs.append(
-                (
-                    w,
-                    FragmentSpec(
-                        task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
-                        query_id=q.qid,
-                        fragment=stage.worker_fragment,
-                        partition_scan=stage.partition_scan,
-                        split_start=lo,
-                        split_end=hi,
-                        split_batch_rows=int(
-                            self.local.session.get("page_capacity")
-                        ),
-                        task_concurrency=int(
-                            self.local.session.get("task_concurrency")
-                        ),
-                    ),
+
+        def make_spec(lo: int, hi: int) -> FragmentSpec:
+            return FragmentSpec(
+                task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                query_id=q.qid,
+                fragment=worker_fragment,
+                partition_scan=stage.partition_scan,
+                split_start=lo,
+                split_end=hi,
+                split_batch_rows=int(
+                    self.local.session.get("page_capacity")
+                ),
+                task_concurrency=int(
+                    self.local.session.get("task_concurrency")
+                ),
+            )
+
+        # pull every worker concurrently (reference: the ExchangeClient
+        # keeps all upstream tasks in flight; serial draining would
+        # block worker 2's bounded buffer on worker 1's drain) and
+        # retry a DEAD worker's range on a live one (recoverable
+        # execution: reassign, don't fail the query)
+        from concurrent.futures import ThreadPoolExecutor
+
+        def run_range(w, lo, hi, retried=False):
+            spec = make_spec(lo, hi)
+            try:
+                self._http_json(
+                    "POST", w.uri + "/v1/task", spec.to_json()
                 )
-            )
-        for w, spec in specs:
-            self._http_json(
-                "POST", w.uri + "/v1/task", spec.to_json()
-            )
-        payloads = []
-        for w, spec in specs:
-            payloads.extend(self._pull_task(w, spec))
-        # delete tasks (ack) regardless of outcome
-        for w, spec in specs:
+                out = self._pull_task(w, spec)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                # worker unreachable: reassign the range once to a
+                # live worker (task retry); execution errors inside a
+                # healthy worker (_pull_task raises RuntimeError) are
+                # NOT retried — they would fail anywhere
+                if retried:
+                    raise
+                alive = [
+                    a
+                    for a in self.active_workers()
+                    if a.node_id != w.node_id
+                ]
+                if not alive:
+                    raise
+                REGISTRY.counter("coordinator.tasks_retried").update()
+                return run_range(alive[0], lo, hi, retried=True)
             try:
                 self._http_json(
                     "DELETE", f"{w.uri}/v1/task/{spec.task_id}", None
                 )
             except Exception:
                 pass
+            return out
 
+        with ThreadPoolExecutor(max(len(ranges), 1)) as pool:
+            futs = [
+                pool.submit(run_range, w, lo, hi)
+                for w, (lo, hi) in zip(workers, ranges)
+            ]
+            payloads = [p for f in futs for p in f.result()]
+
+        schema = dict(stage.worker_fragment.output_schema())
+        if order_by is not None:
+            merged = _merge_sorted_runs(payloads, schema, order_by)
+            return stage_page(merged, schema)
         remote = [
             n
             for n in N.walk(stage.final_root)
             if isinstance(n, N.RemoteSourceNode)
         ]
-        schema = dict(stage.worker_fragment.output_schema())
         merged = pages_wire.merge_payloads(payloads, schema)
         page = stage_page(merged, schema)
         # the final plan may contain real scans above the cut (e.g. a
@@ -464,3 +601,77 @@ def _make_handler(coord: CoordinatorServer):
             self._json(404, {"error": f"no route {self.path}"})
 
     return Handler
+
+
+# ------------------------------------------------- ordered MERGE exchange
+
+
+def _merge_sorted_runs(payloads, schema, sort_node):
+    """K-way merge of per-page sorted runs into one globally ordered
+    staging payload (reference: MergeOperator consuming an ordered
+    exchange — SURVEY.md §2.4 "ordered MERGE").
+
+    Each wire page is a sorted run (workers apply the pushed-down root
+    sort per batch — for TopN that truncates each run to ``limit`` rows
+    BEFORE it crosses the wire, which is where the exchange saves its
+    bandwidth). Dictionary columns are first remapped into one id space
+    (merge_payloads), whose union dictionary is sorted — ids stay
+    order-preserving, so key comparison is pure int64. The run-merge is
+    expressed as a stable vectorized np.lexsort over the concatenated
+    runs rather than an interpreter-level k-way heap: numpy's O(n log n)
+    beats a per-row Python heap by orders of magnitude at gather sizes,
+    and stability keeps ties in (run, position) order like the
+    reference's MergeOperator. ``sort_node.limit`` truncates the
+    output."""
+    from presto_tpu.connectors.tpch import DictColumn
+    from presto_tpu.exec.host_ops import orderable_np
+    from presto_tpu.exec.staging import MaskedColumn
+
+    merged = pages_wire.merge_payloads(payloads, schema)
+    run_lens = [n for _, _, n in payloads]
+    total = sum(run_lens)
+
+    # least-significant-first key list for np.lexsort (mirrors
+    # exec.host_ops._host_sort_perm)
+    lex = []
+    for k in reversed(list(sort_node.keys)):
+        name = k.expr.name
+        col = merged[name]
+        if isinstance(col, MaskedColumn):
+            data, valid = col.data, col.valid
+        elif isinstance(col, DictColumn):
+            data, valid = col.ids, None
+        else:
+            data, valid = col, None
+        t = schema[name]
+        img = orderable_np(np.asarray(data), t)
+        if k.descending:
+            img = ~img
+        nf = (
+            k.nulls_first if k.nulls_first is not None else k.descending
+        )
+        if valid is None:
+            null_rank = np.zeros(total, np.int64)
+        else:
+            null_rank = np.where(valid, 0, -1 if nf else 1).astype(
+                np.int64
+            )
+        lex.append(img)
+        lex.append(null_rank)
+    perm = np.lexsort(lex) if lex else np.arange(total)
+    if sort_node.limit is not None:
+        perm = perm[: sort_node.limit]
+
+    out = {}
+    for name, col in merged.items():
+        if isinstance(col, MaskedColumn):
+            out[name] = MaskedColumn(
+                data=col.data[perm],
+                valid=col.valid[perm],
+                values=col.values,
+            )
+        elif isinstance(col, DictColumn):
+            out[name] = DictColumn(ids=col.ids[perm], values=col.values)
+        else:
+            out[name] = col[perm]
+    return out

@@ -92,11 +92,22 @@ class WorkerServer:
         node_id: Optional[str] = None,
         catalogs=None,
         coordinator_uri: Optional[str] = None,
+        config=None,
     ):
         from presto_tpu.exec.local_runner import LocalQueryRunner
+        from presto_tpu.utils.memory import MemoryPool, parse_bytes
 
         self.node_id = node_id or f"worker-{uuid.uuid4().hex[:8]}"
-        self.runner = LocalQueryRunner(catalogs=catalogs)
+        # memory accounting is ALWAYS on (reference: MemoryPool wired
+        # unconditionally in the worker; limit from tier-1 config)
+        limit = parse_bytes(
+            (config.get("query.max-memory-per-node") if config else None)
+            or "8GB"
+        )
+        self.memory_pool = MemoryPool(limit)
+        self.runner = LocalQueryRunner(
+            catalogs=catalogs, memory_pool=self.memory_pool
+        )
         self.tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._shutting_down = False
@@ -188,6 +199,9 @@ class WorkerServer:
                 f"{type(e).__name__}: {e}\n{traceback.format_exc()[-1000:]}"
             )
             REGISTRY.counter("worker.tasks_failed").update()
+        finally:
+            # free this query's batch-staging reservations
+            self.memory_pool.release(task.spec.query_id)
 
     def _execute(self, task: _Task) -> None:
         """Stream split batches of the partitioned scan through the
@@ -200,6 +214,14 @@ class WorkerServer:
         execution."""
         spec = task.spec
         root = spec.fragment
+        # a pushed-down root sort (ordered MERGE exchange: coordinator
+        # wraps the fragment in a SortNode so every emitted batch is a
+        # sorted run) executes host-side per batch — the same
+        # host-root-stage discipline that keeps XLA sort compiles out of
+        # the per-query budget (exec.host_ops)
+        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
+
+        root, pushed_ops = peel_host_ops(root)
         scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
         walk_ids = {
             id(n): i for i, n in enumerate(N.walk(root))
@@ -221,17 +243,28 @@ class WorkerServer:
 
         def run_batch(lo: int, hi: int):
             pages = []
+            staged_bytes = 0
             for s in scans:
                 if s is part_scan:
                     payload = self._load_range(s, lo, hi)
                     # fixed capacity bucket: every full batch reuses one
                     # compiled program
-                    pages.append(
-                        stage_page(payload, dict(s.schema))
+                    page = stage_page(payload, dict(s.schema))
+                    # account the staged batch's live residency
+                    staged_bytes = sum(
+                        int(b.data.nbytes) for b in page.blocks
                     )
+                    self.memory_pool.reserve(spec.query_id, staged_bytes)
+                    pages.append(page)
                 else:
                     pages.append(repl_pages[id(s)])
-            return self.runner._run_with_pages(root, scans, pages)
+            try:
+                out = self.runner._run_with_pages(root, scans, pages)
+                if pushed_ops:
+                    out = apply_host_ops(out, pushed_ops)
+                return out
+            finally:
+                self.memory_pool.release(spec.query_id, staged_bytes)
 
         def emit(out) -> None:
             cols, n = pages_wire.page_to_wire_columns(out)

@@ -233,3 +233,19 @@ class ShowSchemas(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowSession(Node):
     pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert(Node):
+    """INSERT INTO target (SELECT ... | VALUES (...), ...). ``values``
+    rows hold literal expression nodes."""
+
+    target: Tuple[str, ...]
+    query: Optional[Node] = None
+    values: Optional[Tuple[Tuple[Node, ...], ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAs(Node):
+    target: Tuple[str, ...]
+    query: Node = None

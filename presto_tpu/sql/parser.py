@@ -123,9 +123,42 @@ class _Parser:
                 self._finish()
                 return ast.ShowSession()
             raise ParseError(f"unsupported SHOW at {self.cur.pos}")
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            target = self._qualified_name()
+            if self.accept_kw("values"):
+                rows = [self._values_row()]
+                while self.accept_op(","):
+                    rows.append(self._values_row())
+                self._finish()
+                return ast.Insert(target, values=tuple(rows))
+            sel = self.parse_select()
+            self._finish()
+            return ast.Insert(target, query=sel)
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            target = self._qualified_name()
+            self.expect_kw("as")
+            sel = self.parse_select()
+            self._finish()
+            return ast.CreateTableAs(target, sel)
         sel = self.parse_select()
         self._finish()
         return sel
+
+    def _qualified_name(self):
+        parts = [self.expect_ident()]
+        while self.accept_op("."):
+            parts.append(self.expect_ident())
+        return tuple(parts)
+
+    def _values_row(self):
+        self.expect_op("(")
+        exprs = [self.parse_expr()]
+        while self.accept_op(","):
+            exprs.append(self.parse_expr())
+        self.expect_op(")")
+        return tuple(exprs)
 
     def _finish(self):
         self.accept_op(";")

@@ -24,6 +24,7 @@ KEYWORDS = {
     "with", "over", "partition", "rows", "range", "set", "session",
     "explain", "analyze", "show", "tables", "schemas", "substring",
     "substr", "for", "any", "some", "escape", "values",
+    "insert", "into", "create", "table",
 }
 
 _TOKEN_RE = re.compile(

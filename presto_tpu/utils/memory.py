@@ -23,31 +23,84 @@ class MemoryLimitExceeded(RuntimeError):
     pass
 
 
-class MemoryPool:
-    """One node-level pool; queries reserve/release against it."""
+def parse_bytes(s) -> int:
+    """'8GB' / '512MB' / '64kB' / plain ints -> bytes (config tier-1
+    size strings, reference: airlift DataSize)."""
+    if isinstance(s, (int, float)):
+        return int(s)
+    t = str(s).strip()
+    units = {"TB": 1 << 40, "GB": 1 << 30, "MB": 1 << 20, "KB": 1 << 10,
+             "B": 1}
+    for u in ("TB", "GB", "MB", "KB", "B"):
+        if t.upper().endswith(u):
+            return int(float(t[: -len(u)]) * units[u])
+    return int(float(t))
 
-    def __init__(self, limit_bytes: int):
+
+class MemoryPool:
+    """One node-level pool; queries reserve/release against it.
+
+    ``kill_largest`` (reference: ClusterMemoryManager's pluggable
+    kill policy): when a reservation would exceed the limit, the
+    callback may evict the largest other holder (aborting that query
+    and releasing its reservation); the reserve then retries once.
+    The callback receives ({owner: bytes}, requesting_owner) and
+    returns the evicted owner or None."""
+
+    def __init__(self, limit_bytes: int, kill_largest=None):
         self.limit = int(limit_bytes)
         self._used: Dict[str, int] = {}
         self._lock = threading.Lock()
+        self.kill_largest = kill_largest
+        self._dead: set = set()
+
+    def mark_dead(self, query_id: str) -> None:
+        """A killed query's next reservation fails immediately — the
+        cooperative cancellation point for the kill-largest policy (its
+        thread cannot be interrupted mid-kernel, but it cannot grow)."""
+        with self._lock:
+            self._dead.add(query_id)
 
     def reserve(self, query_id: str, nbytes: int) -> None:
-        with self._lock:
-            total = sum(self._used.values())
-            if total + nbytes > self.limit:
+        for attempt in (0, 1):
+            with self._lock:
+                if query_id in self._dead:
+                    raise MemoryLimitExceeded(
+                        f"query {query_id} was killed by the memory "
+                        "manager"
+                    )
+                total = sum(self._used.values())
+                if total + nbytes <= self.limit:
+                    self._used[query_id] = (
+                        self._used.get(query_id, 0) + nbytes
+                    )
+                    return
                 largest = max(
                     self._used, key=self._used.get, default=None
                 )
-                raise MemoryLimitExceeded(
-                    f"reserving {nbytes}B for {query_id} exceeds pool "
-                    f"limit {self.limit}B (in use {total}B, largest "
-                    f"holder {largest})"
-                )
-            self._used[query_id] = self._used.get(query_id, 0) + nbytes
+                holders = dict(self._used)
+            if attempt == 0 and self.kill_largest is not None:
+                victim = self.kill_largest(holders, query_id)
+                if victim is not None:
+                    self.release(victim)
+                    continue
+            raise MemoryLimitExceeded(
+                f"reserving {nbytes}B for {query_id} exceeds pool "
+                f"limit {self.limit}B (in use {total}B, largest "
+                f"holder {largest})"
+            )
 
-    def release(self, query_id: str) -> None:
+    def release(self, query_id: str, nbytes: Optional[int] = None) -> None:
+        """Release ``nbytes`` of a holder's reservation (None = all)."""
         with self._lock:
-            self._used.pop(query_id, None)
+            if nbytes is None:
+                self._used.pop(query_id, None)
+                return
+            left = self._used.get(query_id, 0) - int(nbytes)
+            if left > 0:
+                self._used[query_id] = left
+            else:
+                self._used.pop(query_id, None)
 
     def used_bytes(self, query_id: Optional[str] = None) -> int:
         with self._lock:

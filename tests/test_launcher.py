@@ -1,0 +1,74 @@
+"""Config-file bootstrap (SURVEY.md §5.6 tiers 1+2 + PrestoServer
+launcher): etc/ directories boot real coordinator/worker nodes."""
+
+import time
+
+import pytest
+
+from presto_tpu.server.launcher import launch, load_etc, parse_properties
+from presto_tpu.server import PrestoTpuClient
+
+
+def _write_etc(tmp_path, name, config_lines, catalogs=None):
+    etc = tmp_path / name
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text("\n".join(config_lines) + "\n")
+    for cat, lines in (catalogs or {}).items():
+        (etc / "catalog" / f"{cat}.properties").write_text(
+            "\n".join(lines) + "\n"
+        )
+    return str(etc)
+
+
+def test_parse_properties(tmp_path):
+    p = tmp_path / "x.properties"
+    p.write_text("# comment\n\na=1\nb = two words \n")
+    assert parse_properties(str(p)) == {"a": "1", "b": "two words"}
+
+
+def test_unknown_config_key_fails_fast(tmp_path):
+    etc = _write_etc(tmp_path, "bad", ["coordinator=true", "no.such.key=1"])
+    with pytest.raises(KeyError, match="no.such.key"):
+        load_etc(etc)
+
+
+def test_catalog_requires_connector_name(tmp_path):
+    etc = _write_etc(
+        tmp_path, "badcat", ["coordinator=true"], {"broken": ["foo=1"]}
+    )
+    with pytest.raises(ValueError, match="connector.name"):
+        load_etc(etc)
+
+
+def test_launch_cluster_from_etc(tmp_path):
+    coord_etc = _write_etc(
+        tmp_path,
+        "coord",
+        ["coordinator=true", "query.max-memory-per-node=2GB"],
+        {"tpch": ["connector.name=tpch"], "mem": ["connector.name=memory"]},
+    )
+    coord = launch(coord_etc)
+    try:
+        assert coord.memory_pool.limit == 2 << 30
+        assert coord.local.catalogs.has("mem")
+        worker_etc = _write_etc(
+            tmp_path,
+            "worker",
+            ["coordinator=false", f"discovery.uri={coord.uri}"],
+            {"tpch": ["connector.name=tpch"]},
+        )
+        worker = launch(worker_etc)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not coord.active_workers():
+                time.sleep(0.05)
+            assert coord.active_workers(), "worker not discovered"
+            client = PrestoTpuClient(coord.uri, timeout_s=120)
+            res = client.execute(
+                "select count(*) as c from tpch.tiny.region"
+            )
+            assert res.rows() == [(5,)]
+        finally:
+            worker.shutdown(graceful=False)
+    finally:
+        coord.shutdown()

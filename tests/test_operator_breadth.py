@@ -1,0 +1,221 @@
+"""Operator/function breadth (VERDICT r2 item 5): right/full outer
+joins, navigation window functions (lag/lead/first_value/last_value/
+ntile), stddev/variance aggregates, scalar math functions.
+
+Joins and navigation windows verify against the sqlite oracle (sqlite
+3.39+ has FULL JOIN and the full window set); stddev/variance verify
+against numpy (sqlite has no stdev) plus the tpu_offload cross-backend
+diff (SURVEY.md §4.7)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.verifier import SqliteOracle, verify_query, verify_offload
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle("tiny")
+
+
+# ------------------------------------------------------------- outer joins
+
+#: two subqueries with partial key overlap: [1,10] vs [5,15] customers
+_FULL_JOIN = """
+select c.ck, o.oc, o.n
+from (select c_custkey as ck from tpch.tiny.customer where c_custkey <= 10) c
+full join (select o_custkey as oc, count(*) as n from tpch.tiny.orders
+           where o_custkey between 5 and 15 group by o_custkey) o
+  on c.ck = o.oc
+order by c.ck nulls last, o.oc nulls last
+"""
+
+_RIGHT_JOIN = """
+select c.ck, o.oc, o.n
+from (select c_custkey as ck from tpch.tiny.customer where c_custkey <= 10) c
+right join (select o_custkey as oc, count(*) as n from tpch.tiny.orders
+            where o_custkey between 5 and 15 group by o_custkey) o
+  on c.ck = o.oc
+order by o.oc
+"""
+
+
+def test_full_outer_join(runner, oracle):
+    diff = verify_query(runner, oracle, _FULL_JOIN)
+    assert diff is None, diff
+    rows = runner.execute(_FULL_JOIN).rows()
+    # both preserved sides must actually appear
+    assert any(r[0] is not None and r[1] is None for r in rows), rows
+    assert any(r[0] is None and r[1] is not None for r in rows), rows
+    assert any(r[0] is not None and r[1] is not None for r in rows), rows
+
+
+def test_right_outer_join(runner, oracle):
+    diff = verify_query(runner, oracle, _RIGHT_JOIN)
+    assert diff is None, diff
+
+
+def test_full_join_duplicate_build_keys(runner, oracle):
+    # non-unique build side exercises the expansion + append path
+    sql = """
+    select a.k, b.v
+    from (select n_regionkey as k from tpch.tiny.nation
+          where n_nationkey < 5) a
+    full join (select r_regionkey as v from tpch.tiny.region) b
+      on a.k = b.v
+    order by a.k nulls last, b.v nulls last
+    """
+    diff = verify_query(runner, oracle, sql)
+    assert diff is None, diff
+
+
+# ------------------------------------------------- navigation window funcs
+
+_NAV_WINDOW = """
+select o_orderkey,
+  lag(o_totalprice) over (partition by o_custkey order by o_orderdate,
+                          o_orderkey) as prev_price,
+  lead(o_totalprice, 2) over (partition by o_custkey order by o_orderdate,
+                              o_orderkey) as next2,
+  first_value(o_orderkey) over (partition by o_custkey order by
+                                o_orderdate, o_orderkey) as first_ok,
+  ntile(4) over (partition by o_orderpriority order by o_totalprice,
+                 o_orderkey) as quartile
+from tpch.tiny.orders
+where o_custkey <= 100
+order by o_orderkey
+"""
+
+
+def test_navigation_windows(runner, oracle):
+    diff = verify_query(runner, oracle, _NAV_WINDOW)
+    assert diff is None, diff
+
+
+def test_lag_default(runner, oracle):
+    sql = """
+    select o_orderkey,
+      lag(o_shippriority, 1, -1) over (partition by o_custkey
+        order by o_orderdate, o_orderkey) as p
+    from tpch.tiny.orders where o_custkey <= 50
+    order by o_orderkey
+    """
+    diff = verify_query(runner, oracle, sql)
+    assert diff is None, diff
+    rows = runner.execute(sql).rows()
+    assert any(r[1] == -1 for r in rows)  # default engaged
+
+
+def test_last_value_frame(runner, oracle):
+    # default RANGE frame: last_value = value at the last PEER row
+    sql = """
+    select o_orderkey,
+      last_value(o_orderkey) over (partition by o_custkey
+        order by o_orderdate) as lv
+    from tpch.tiny.orders where o_custkey <= 50
+    order by o_orderkey
+    """
+    diff = verify_query(runner, oracle, sql)
+    assert diff is None, diff
+
+
+# --------------------------------------------------- stddev / variance
+
+def test_stddev_variance_global(runner):
+    sql = """
+    select stddev(o_totalprice) as sd, stddev_pop(o_totalprice) as sdp,
+           variance(o_totalprice) as v, var_pop(o_totalprice) as vp
+    from tpch.tiny.orders
+    """
+    (sd, sdp, v, vp), = runner.execute(sql).rows()
+    x = np.array(
+        [r[0] for r in runner.execute(
+            "select o_totalprice from tpch.tiny.orders"
+        ).rows()]
+    )
+    assert math.isclose(v, x.var(ddof=1), rel_tol=1e-9)
+    assert math.isclose(vp, x.var(ddof=0), rel_tol=1e-9)
+    assert math.isclose(sd, x.std(ddof=1), rel_tol=1e-9)
+    assert math.isclose(sdp, x.std(ddof=0), rel_tol=1e-9)
+
+
+def test_stddev_grouped(runner):
+    sql = """
+    select o_orderpriority as p, var_samp(o_totalprice) as v, count(*) as n
+    from tpch.tiny.orders group by o_orderpriority order by p
+    """
+    rows = runner.execute(sql).rows()
+    base = runner.execute(
+        "select o_orderpriority, o_totalprice from tpch.tiny.orders"
+    ).rows()
+    for p, v, n in rows:
+        x = np.array([tp for pp, tp in base if pp == p])
+        assert len(x) == n
+        assert math.isclose(v, x.var(ddof=1), rel_tol=1e-9), p
+
+
+def test_stddev_offload_diff():
+    assert verify_offload(
+        "select o_orderpriority as p, stddev(o_totalprice) as sd "
+        "from tpch.tiny.orders group by o_orderpriority order by p"
+    ) is None
+
+
+def test_stddev_distributed():
+    import jax
+
+    from presto_tpu.parallel import DistributedQueryRunner
+
+    assert len(jax.devices()) == 8
+    d = DistributedQueryRunner(
+        broadcast_threshold=1 << 11, repl_threshold=1 << 10
+    )
+    local = LocalQueryRunner()
+    sql = (
+        "select o_orderpriority as p, stddev(o_totalprice) as sd, "
+        "var_pop(o_totalprice) as vp from tpch.tiny.orders "
+        "group by o_orderpriority order by p"
+    )
+    a = d.execute(sql).rows()
+    b = local.execute(sql).rows()
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra[0] == rb[0]
+        assert math.isclose(ra[1], rb[1], rel_tol=1e-6)
+        assert math.isclose(ra[2], rb[2], rel_tol=1e-6)
+
+
+# ----------------------------------------------------- scalar math funcs
+
+def test_math_functions(runner):
+    rows = runner.execute(
+        "select sqrt(o_totalprice) as s, abs(0 - o_shippriority) as a, "
+        "ln(o_totalprice) as l, floor(o_totalprice) as f, "
+        "ceiling(o_totalprice) as c "
+        "from tpch.tiny.orders where o_orderkey = 1"
+    ).rows()
+    base = runner.execute(
+        "select o_totalprice from tpch.tiny.orders where o_orderkey = 1"
+    ).rows()
+    tp = base[0][0]
+    s, a, l, f, c = rows[0]
+    assert math.isclose(s, math.sqrt(tp), rel_tol=1e-9)
+    assert a == 0
+    assert math.isclose(l, math.log(tp), rel_tol=1e-9)
+    assert f == math.floor(tp) and c == math.ceil(tp)
+
+
+def test_sqrt_negative_is_null(runner):
+    rows = runner.execute(
+        "select sqrt(0 - o_totalprice) as s from tpch.tiny.orders "
+        "where o_orderkey = 1"
+    ).rows()
+    assert rows[0][0] is None

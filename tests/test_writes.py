@@ -1,0 +1,75 @@
+"""Table writer (reference: TableWriterOperator + ConnectorPageSink):
+INSERT INTO ... VALUES / SELECT and CREATE TABLE AS over the writable
+memory connector; read-only catalogs reject writes."""
+
+import pytest
+
+from presto_tpu.connectors import create_connector
+from presto_tpu.exec.local_runner import ExecutionError, LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager
+from presto_tpu import types as T
+
+
+@pytest.fixture()
+def runner():
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    mem = create_connector("memory")
+    from presto_tpu.connectors.spi import TableHandle
+
+    mem.create_table(
+        TableHandle("mem", "default", "kv"),
+        {"k": T.INTEGER, "v": T.VARCHAR},
+    )
+    catalogs.register("mem", mem)
+    return LocalQueryRunner(catalogs=catalogs)
+
+
+def test_insert_values_and_read_back(runner):
+    res = runner.execute(
+        "insert into mem.default.kv values (1, 'one'), (2, 'two'), "
+        "(3, null)"
+    )
+    assert res.rows() == [(3,)]
+    rows = runner.execute(
+        "select k, v from mem.default.kv order by k"
+    ).rows()
+    assert rows == [(1, "one"), (2, "two"), (3, None)]
+
+
+def test_insert_select(runner):
+    res = runner.execute(
+        "insert into mem.default.kv "
+        "select r_regionkey, r_name from tpch.tiny.region"
+    )
+    assert res.rows() == [(5,)]
+    rows = runner.execute(
+        "select count(*) as n from mem.default.kv"
+    ).rows()
+    assert rows == [(5,)]
+
+
+def test_create_table_as(runner):
+    res = runner.execute(
+        "create table mem.default.big_orders as "
+        "select o_orderkey, o_totalprice from tpch.tiny.orders "
+        "where o_totalprice > 500000"
+    )
+    n = res.rows()[0][0]
+    assert n > 0
+    rows = runner.execute(
+        "select count(*) as n, min(o_totalprice) as m "
+        "from mem.default.big_orders"
+    ).rows()
+    assert rows[0][0] == n
+    assert rows[0][1] > 500000
+
+
+def test_insert_into_readonly_catalog_fails(runner):
+    with pytest.raises(ExecutionError, match="read-only"):
+        runner.execute("insert into tpch.tiny.region values (9, 'X', 'c')")
+
+
+def test_insert_arity_mismatch(runner):
+    with pytest.raises(ExecutionError, match="arity"):
+        runner.execute("insert into mem.default.kv values (1, 'a', 2)")
