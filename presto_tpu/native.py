@@ -1,0 +1,112 @@
+"""ctypes loader for the C++ host-agent codec (native/dict_codec.cpp).
+
+Reference parity: the native-worker split (SURVEY.md §2.3) — hot host
+paths in C++, everything else Python. Build is lazy (g++ on first use,
+cached under native/build/) with a clean numpy fallback when the
+toolchain or compiler is unavailable, so the engine never hard-depends
+on native code.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "dict_codec.cpp")
+_SO = os.path.join(_ROOT, "native", "build", "dict_codec.so")
+
+_lock = threading.Lock()
+_lib: Optional[object] = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(
+                _SO
+            ) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                # build to a temp name, then atomic-rename: concurrent
+                # processes must never CDLL a half-linked .so
+                tmp = f"{_SO}.{os.getpid()}.tmp"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                        _SRC, "-o", tmp,
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.dict_encode.restype = ctypes.c_int64
+            lib.dict_encode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None  # toolchain absent / build failed: numpy path
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def encode_strings_native(
+    values,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Object array of str/None -> (int32 ids, valid mask, sorted
+    unique values), or None when the native library is unavailable.
+    Semantics identical to page.encode_strings' numpy path."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(values)
+    encs = []
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    valid = np.ones(n, dtype=np.uint8)
+    pos = 0
+    for i, v in enumerate(values):
+        if v is None:
+            valid[i] = 0
+            offsets[i + 1] = pos
+            continue
+        b = str(v).encode("utf-8")
+        encs.append(b)
+        pos += len(b)
+        offsets[i + 1] = pos
+    blob = b"".join(encs)
+    ids = np.empty(n, dtype=np.int32)
+    repr_rows = np.empty(max(n, 1), dtype=np.int64)
+    rc = lib.dict_encode(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        valid.ctypes.data_as(ctypes.c_char_p),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        repr_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc < 0:
+        return None
+    uniq = np.asarray(
+        [str(values[int(r)]) for r in repr_rows[:rc]], dtype=object
+    )
+    return ids, valid.astype(bool), uniq

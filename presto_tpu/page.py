@@ -102,12 +102,26 @@ class Dictionary:
         return np.asarray([bool(fn(v)) for v in self.values], dtype=bool)
 
 
-def encode_strings(values: Sequence) -> tuple[np.ndarray, np.ndarray, Dictionary]:
+_NATIVE_ENCODE_MIN_ROWS = 4096
+
+
+def encode_strings(
+    values: Sequence, force_numpy: bool = False
+) -> tuple[np.ndarray, np.ndarray, Dictionary]:
     """Encode strings -> (int32 ids, valid mask, order-preserving dict).
 
-    None values get id -1 and valid=False.
-    """
+    None values get id -1 and valid=False. Large columns route through
+    the C++ host-agent codec when it is available (native/dict_codec.cpp
+    — ~2x over the np.unique path, measured table in BASELINE.md);
+    identical semantics either way."""
     arr = np.asarray(values, dtype=object)
+    if len(arr) >= _NATIVE_ENCODE_MIN_ROWS and not force_numpy:
+        from presto_tpu import native
+
+        out = native.encode_strings_native(arr)
+        if out is not None:
+            ids, valid, uniq = out  # codec writes -1 for NULL rows
+            return ids, valid, Dictionary(uniq)
     isnull = np.array([v is None for v in arr], dtype=bool)
     present = arr[~isnull].astype(str) if (~isnull).any() else np.array([], str)
     dictionary = Dictionary(np.unique(present))

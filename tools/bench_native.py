@@ -1,0 +1,61 @@
+"""Native-vs-numpy head-to-head for varlen->dictionary-id encoding.
+
+The measured go/no-go for the C++ host-agent codec (SURVEY.md §2.3
+disposition) — same discipline as tools/pallas_groupby.py: keep
+whichever implementation wins, record the numbers.
+
+Usage: python tools/bench_native.py [--rows 1000000] [--card 50000]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--card", type=int, default=50_000)
+    ap.add_argument("--null-frac", type=float, default=0.02)
+    args = ap.parse_args()
+
+    from presto_tpu import native
+    from presto_tpu.page import encode_strings
+
+    rng = np.random.RandomState(0)
+    pool = np.asarray(
+        [f"value-{i:08d}-{rng.randint(1e9)}" for i in range(args.card)],
+        dtype=object,
+    )
+    vals = pool[rng.randint(0, args.card, args.rows)].copy()
+    nulls = rng.rand(args.rows) < args.null_frac
+    vals[nulls] = None
+
+    assert native.available(), "native build failed (g++ missing?)"
+
+    t0 = time.perf_counter()
+    ids_n, valid_n, uniq_n = native.encode_strings_native(vals)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ids_p, valid_p, dic_p = encode_strings(vals, force_numpy=True)
+    t_numpy = time.perf_counter() - t0
+
+    assert (valid_n == valid_p).all()
+    assert (ids_n[valid_n] == ids_p[valid_p]).all(), "id mismatch"
+    assert list(uniq_n) == list(dic_p.values), "dictionary mismatch"
+    print(
+        f"rows={args.rows} card={args.card}  "
+        f"numpy {t_numpy * 1e3:8.1f} ms   "
+        f"native {t_native * 1e3:8.1f} ms   "
+        f"speedup {t_numpy / t_native:5.2f}x"
+    )
+
+
+if __name__ == "__main__":
+    main()
