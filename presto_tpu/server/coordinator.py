@@ -425,6 +425,23 @@ class CoordinatorServer:
             for n in N.walk(stage.final_root)
             if isinstance(n, N.RemoteSourceNode)
         ]
+        # bucketed gather (reference: grouped execution at the merge):
+        # partial states beyond the device budget hash-bucket by group
+        # key and merge one bucket at a time instead of funnelling
+        # everything into one staged page (exec.streaming owns the
+        # policy, shared with the local streamed path)
+        from presto_tpu.exec import streaming as S
+
+        bucketed = S.grouped_final_merge(
+            self.local,
+            payloads,
+            schema,
+            stage.final_root,
+            stage.worker_fragment,
+            int(self.local.session.get("max_device_rows")),
+        )
+        if bucketed is not None:
+            return bucketed
         merged = pages_wire.merge_payloads(payloads, schema)
         page = stage_page(merged, schema)
         # the final plan may contain real scans above the cut (e.g. a

@@ -195,6 +195,43 @@ def test_ordered_merge_topn(merge_cluster, oracle, monkeypatch):
     assert calls == [25]
 
 
+def test_bucketed_gather_merge(oracle, monkeypatch):
+    """Partial states beyond the device budget hash-bucket at the
+    gather and merge one bucket at a time (grouped execution at the
+    coordinator; VERDICT r2 weak 5) — oracle-exact."""
+    from presto_tpu.exec import streaming as S
+    from presto_tpu.session import Session
+
+    coord = CoordinatorServer(
+        session=Session(properties={"max_device_rows": 4096})
+    ).start()
+    workers = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    calls = []
+    orig = S.bucketize_payloads
+
+    def spy(payloads, schema, keys, n_buckets):
+        calls.append(n_buckets)
+        return orig(payloads, schema, keys, n_buckets)
+
+    monkeypatch.setattr(S, "bucketize_payloads", spy)
+    try:
+        _wait_workers(coord, 2)
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        sql = (
+            "select l_orderkey, count(*) as c, sum(l_quantity) as s "
+            "from tpch.tiny.lineitem group by l_orderkey"
+        )
+        diff = verify_query(client, oracle, sql)
+        assert diff is None, diff
+        assert calls and calls[0] > 1, "bucketed gather did not engage"
+    finally:
+        for w in workers:
+            w.shutdown(graceful=False)
+        coord.shutdown()
+
+
 def test_agg_query_skips_merge_path(merge_cluster, monkeypatch):
     """A stage with an aggregation cut must NOT take the merge path
     (sorted runs of partial states would be wrong)."""
