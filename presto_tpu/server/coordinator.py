@@ -353,7 +353,16 @@ class CoordinatorServer:
             worker_fragment = dataclasses.replace(
                 order_by, source=worker_fragment
             )
-        ranges = assign_ranges(stage.partition_rows, len(workers))
+        # dynamic split placement (reference: SourcePartitionedScheduler
+        # handing split batches to whichever task has capacity): cut the
+        # scan into more ranges than workers and let each worker thread
+        # pull the next unclaimed range when it finishes — a straggler
+        # naturally processes fewer ranges (work stealing by queue)
+        over = max(1, int(self.local.session.get("split_queue_factor")))
+        ranges = assign_ranges(
+            stage.partition_rows, max(len(workers) * over, 1)
+        )
+        ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
 
         def make_spec(lo: int, hi: int) -> FragmentSpec:
             return FragmentSpec(
@@ -409,11 +418,23 @@ class CoordinatorServer:
                 pass
             return out
 
-        with ThreadPoolExecutor(max(len(ranges), 1)) as pool:
-            futs = [
-                pool.submit(run_range, w, lo, hi)
-                for w, (lo, hi) in zip(workers, ranges)
-            ]
+        import queue as _queue
+
+        range_q: "_queue.Queue" = _queue.Queue()
+        for r in ranges:
+            range_q.put(r)
+
+        def drain_worker(w):
+            out = []
+            while True:
+                try:
+                    lo, hi = range_q.get_nowait()
+                except _queue.Empty:
+                    return out
+                out.extend(run_range(w, lo, hi))
+
+        with ThreadPoolExecutor(max(len(workers), 1)) as pool:
+            futs = [pool.submit(drain_worker, w) for w in workers]
             payloads = [p for f in futs for p in f.result()]
 
         schema = dict(stage.worker_fragment.output_schema())

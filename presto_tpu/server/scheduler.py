@@ -202,11 +202,13 @@ def _replace_on_path(
     return new
 
 
-def assign_ranges(total_rows: int, n_workers: int) -> List[Tuple[int, int]]:
-    """Contiguous row ranges of the partitioned scan, one per worker."""
-    chunk = -(-total_rows // max(n_workers, 1))
+def assign_ranges(total_rows: int, n_ranges: int) -> List[Tuple[int, int]]:
+    """Contiguous row ranges of the partitioned scan. The coordinator
+    over-partitions (n_ranges = workers x split_queue_factor) and lets
+    workers drain a shared queue — dynamic split placement."""
+    chunk = -(-total_rows // max(n_ranges, 1))
     out = []
-    for i in range(n_workers):
+    for i in range(n_ranges):
         lo = min(i * chunk, total_rows)
         hi = min((i + 1) * chunk, total_rows)
         out.append((lo, hi))

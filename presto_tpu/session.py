@@ -70,6 +70,15 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _positive("task_concurrency"),
         ),
         PropertyMetadata(
+            "split_queue_factor",
+            "Scan ranges queued per worker for dynamic split placement "
+            "(1 = static assignment; reference: SourcePartitionedScheduler "
+            "split batching)",
+            int,
+            4,
+            _positive("split_queue_factor"),
+        ),
+        PropertyMetadata(
             "join_distribution_type",
             "AUTOMATIC | PARTITIONED | BROADCAST (reference: AddExchanges "
             "join distribution choice)",
