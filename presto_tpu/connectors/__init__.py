@@ -35,12 +35,19 @@ def _parquet_factory(**config):
     return ParquetConnector(**config)
 
 
+def _orc_factory(**config):
+    from presto_tpu.connectors.orc import OrcConnector
+
+    return OrcConnector(**config)
+
+
 CONNECTOR_FACTORIES = {
     "tpch": TpchConnector,
     "tpcds": TpcdsConnector,
     "memory": MemoryConnector,
     "blackhole": BlackholeConnector,
     "parquet": _parquet_factory,  # lazy: pyarrow imports on first use
+    "orc": _orc_factory,
 }
 
 

@@ -1,0 +1,97 @@
+"""Shared Arrow-to-engine conversion for file-format connectors.
+
+Both columnar file formats the engine reads (parquet, ORC) arrive
+through pyarrow, and both hand the engine the same staging payloads:
+numeric numpy arrays in the engine's native representation (decimals as
+scaled int64, dates as epoch days, timestamps as epoch micros) and
+strings pre-encoded as dictionary ids — strings never touch the device
+(SURVEY.md §7 "Strings on TPU"). Reference parity: the format readers
+under ``presto-parquet`` / ``presto-orc`` share the column-reader
+contract the same way (SURVEY.md §2.2 L9).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.tpch import DictColumn
+from presto_tpu.exec.staging import MaskedColumn
+
+
+def arrow_to_engine_type(at) -> T.DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_integer(at):
+        return T.BIGINT if at.bit_width > 32 else T.INTEGER
+    if pa.types.is_floating(at):
+        return T.DOUBLE
+    if pa.types.is_decimal(at):
+        return T.decimal(at.precision, at.scale)
+    if pa.types.is_date(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.VARCHAR
+    raise NotImplementedError(f"no engine mapping for arrow type {at}")
+
+
+def arrow_column_to_payload(arr, t: T.DataType):
+    """Arrow chunked array -> engine staging payload."""
+    import pyarrow as pa
+
+    combined = arr.combine_chunks()
+    nulls = combined.null_count > 0
+    if t.is_string:
+        ids, valid, dictionary = _encode_arrow_strings(combined)
+        if nulls:
+            return MaskedColumn(
+                data=ids, valid=valid, values=tuple(dictionary)
+            )
+        return DictColumn(
+            ids=ids, values=np.asarray(dictionary, dtype=object)
+        )
+    if t.is_decimal:
+        # arrow decimal128 -> unscaled int64 (precision bound checked
+        # at schema-mapping time by T.decimal)
+        data = np.asarray(
+            [
+                0 if v is None else int(v.as_py().scaleb(t.scale))
+                for v in combined
+            ],
+            dtype=np.int64,
+        )
+    elif t.name == "date":
+        data = np.asarray(
+            combined.cast(pa.int32()).fill_null(0), dtype=np.int64
+        )
+    elif t.name == "timestamp":
+        data = np.asarray(
+            combined.cast(pa.int64()).fill_null(0), dtype=np.int64
+        )
+    else:
+        data = np.asarray(
+            combined.fill_null(0), dtype=t.np_dtype
+        )
+    if not nulls:
+        return data
+    valid = np.asarray(combined.is_valid(), dtype=bool)
+    return MaskedColumn(data=data, valid=valid)
+
+
+def _encode_arrow_strings(combined):
+    """Arrow string column -> (int32 ids, valid, sorted dictionary)."""
+    valid = np.asarray(combined.is_valid(), dtype=bool)
+    values = combined.fill_null("").to_numpy(zero_copy_only=False)
+    values = values.astype(object)
+    present = values[valid].astype(str)
+    uniq = np.unique(present) if len(present) else np.empty(0, object)
+    ids = np.zeros(len(values), dtype=np.int32)
+    if len(present):
+        ids[valid] = np.searchsorted(
+            uniq.astype(str), present
+        ).astype(np.int32)
+    return ids, valid, uniq.astype(object)

@@ -1,0 +1,204 @@
+"""ORC connector: stripe-organized columnar files as queryable tables.
+
+Reference parity: ``presto-orc`` (SURVEY.md §2.2 L9 "file-format
+readers") — column-pruned reads with stripe-aligned splits, the second
+of the two columnar formats the reference treats as first-class. The
+engine-facing contract is identical to the parquet connector's: splits
+are row ranges, payloads are device-ready numpy columns
+(``connectors/_arrow.py``), so everything above the SPI is
+format-agnostic.
+
+TPU-first shape: like parquet, strings leave the reader already
+dictionary-encoded and numerics in native representation; the device
+only ever sees fixed-width arrays.
+
+Layout: ``root/<schema>/<table>.orc``.
+
+Implementation notes: pyarrow's ORC reader exposes stripe count but not
+per-stripe row counts, so stripe row offsets are probed once per file
+by reading the narrowest column of each stripe (cheap: one column,
+decoded once, then cached). File-footer column statistics are not
+exposed by pyarrow's ORC bindings at all, so ``get_table_stats``
+returns the row count only — the optimizer falls back to its default
+selectivities, exactly as it does for any stats-less connector.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Sequence
+
+from presto_tpu import types as T
+from presto_tpu.connectors._arrow import (
+    arrow_column_to_payload,
+    arrow_to_engine_type,
+)
+from presto_tpu.connectors.spi import (
+    Connector,
+    ConnectorMetadata,
+    ConnectorSplit,
+    SplitSource,
+    TableHandle,
+    TableStats,
+)
+
+
+class _OrcMetadata(ConnectorMetadata):
+    def __init__(self, conn: "OrcConnector"):
+        self._conn = conn
+
+    def list_schemas(self) -> List[str]:
+        root = self._conn.root
+        return sorted(
+            d
+            for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+
+    def list_tables(self, schema: str) -> List[str]:
+        d = os.path.join(self._conn.root, schema)
+        return sorted(
+            fn[: -len(".orc")]
+            for fn in os.listdir(d)
+            if fn.endswith(".orc")
+        )
+
+    def get_table_schema(self, handle: TableHandle) -> Dict[str, T.DataType]:
+        f = self._conn._file(handle)
+        schema = f.schema
+        return {
+            schema.field(i).name: arrow_to_engine_type(schema.field(i).type)
+            for i in range(len(schema.names))
+        }
+
+    def get_table_stats(self, handle: TableHandle) -> TableStats:
+        # row count from the ORC footer; pyarrow exposes no per-column
+        # min/max for ORC (see module docstring)
+        f = self._conn._file(handle)
+        return TableStats(row_count=float(f.nrows), columns={})
+
+
+class OrcConnector(Connector):
+    """Catalog over ``root/<schema>/<table>.orc`` files."""
+
+    def __init__(self, root: str = ".", **config):
+        self.root = root
+        self._metadata = _OrcMetadata(self)
+        self._files: Dict[TableHandle, object] = {}
+        self._offsets: Dict[TableHandle, List[int]] = {}
+
+    def metadata(self):
+        return self._metadata
+
+    def _path(self, handle: TableHandle) -> str:
+        return os.path.join(
+            self.root, handle.schema, handle.table + ".orc"
+        )
+
+    def _file(self, handle: TableHandle):
+        from pyarrow import orc
+
+        f = self._files.get(handle)
+        if f is None:
+            path = self._path(handle)
+            if not os.path.exists(path):
+                raise KeyError(f"no ORC table at {path}")
+            f = orc.ORCFile(path)
+            self._files[handle] = f
+        return f
+
+    def _stripe_offsets(self, handle: TableHandle) -> List[int]:
+        """Cumulative stripe row offsets ``[0, n0, n0+n1, ...]``, probed
+        once by reading each stripe's narrowest column (pyarrow has no
+        stripe-row metadata accessor; ``columns=[]`` reads zero rows)."""
+        offs = self._offsets.get(handle)
+        if offs is None:
+            f = self._file(handle)
+            probe = _narrowest_column(f.schema)
+            offs = [0]
+            for i in range(f.nstripes):
+                offs.append(
+                    offs[-1]
+                    + f.read_stripe(i, columns=[probe]).num_rows
+                )
+            if offs[-1] != f.nrows:  # pragma: no cover - corrupt file
+                raise IOError(
+                    f"ORC stripe rows {offs[-1]} != footer rows {f.nrows}"
+                )
+            self._offsets[handle] = offs
+        return offs
+
+    def get_splits(
+        self, handle: TableHandle, target_split_rows: int = 1 << 20
+    ) -> SplitSource:
+        """Stripe-aligned splits (the reference's ORC split boundary),
+        expressed as row ranges so the split protocol stays
+        format-agnostic."""
+        offs = self._stripe_offsets(handle)
+        total = offs[-1]
+        splits: List[ConnectorSplit] = []
+        start = 0
+        for end in offs[1:]:
+            if end - start >= target_split_rows:
+                splits.append(ConnectorSplit(handle, start, end))
+                start = end
+        if total > start or not splits:
+            splits.append(ConnectorSplit(handle, start, total))
+        return SplitSource(splits)
+
+    def create_page_source(
+        self, split: ConnectorSplit, columns: Sequence[str]
+    ) -> Dict[str, object]:
+        import pyarrow as pa
+
+        f = self._file(split.table)
+        schema = self._metadata.get_table_schema(split.table)
+        offs = self._stripe_offsets(split.table)
+        # map the row range onto stripes, then TRIM to exactly
+        # [row_start, row_end) — workers batch scans at arbitrary
+        # boundaries, not just stripe edges
+        batches = []
+        first_lo = None
+        for i in range(len(offs) - 1):
+            lo, hi = offs[i], offs[i + 1]
+            if lo < split.row_end and hi > split.row_start:
+                if first_lo is None:
+                    first_lo = lo
+                batches.append(f.read_stripe(i, columns=list(columns)))
+        if not batches:
+            # empty table (0 stripes) or empty range: typed empty arrays
+            # (null-typed ones poison arrow_column_to_payload's fill_null)
+            arrow_types = {
+                f.schema.field(i).name: f.schema.field(i).type
+                for i in range(len(f.schema.names))
+            }
+            table = pa.table(
+                {c: pa.array([], type=arrow_types[c]) for c in columns}
+            )
+            first_lo = split.row_start
+        else:
+            table = pa.Table.from_batches(batches)
+        a = split.row_start - first_lo
+        b = split.row_end - first_lo
+        table = table.slice(a, b - a)
+        out: Dict[str, object] = {}
+        for name in columns:
+            arr = table.column(name)
+            out[name] = arrow_column_to_payload(arr, schema[name])
+        return out
+
+
+_WIDTHS = {
+    "bool": 1, "int8": 1, "int16": 2, "int32": 4, "float": 4,
+    "date32[day]": 4, "int64": 8, "double": 8,
+}
+
+
+def _narrowest_column(schema) -> str:
+    """Cheapest column to decode when probing stripe row counts."""
+    best, best_w = schema.names[0], 1 << 30
+    for i, name in enumerate(schema.names):
+        w = _WIDTHS.get(str(schema.field(i).type), 16)
+        if w < best_w:
+            best, best_w = name, w
+    return best

@@ -19,11 +19,13 @@ Layout: ``root/<schema>/<table>.parquet``.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence
-
-import numpy as np
+from typing import Dict, List, Sequence
 
 from presto_tpu import types as T
+from presto_tpu.connectors._arrow import (
+    arrow_column_to_payload as _arrow_column_to_payload,
+    arrow_to_engine_type as _arrow_to_engine_type,
+)
 from presto_tpu.connectors.spi import (
     ColumnStats,
     Connector,
@@ -33,33 +35,6 @@ from presto_tpu.connectors.spi import (
     TableHandle,
     TableStats,
 )
-from presto_tpu.connectors.tpch import DictColumn
-from presto_tpu.exec.staging import MaskedColumn
-
-
-def _arrow_to_engine_type(at) -> T.DataType:
-    import pyarrow as pa
-
-    if pa.types.is_boolean(at):
-        return T.BOOLEAN
-    if pa.types.is_integer(at):
-        return T.BIGINT if at.bit_width > 32 else T.INTEGER
-    if pa.types.is_floating(at):
-        return T.DOUBLE
-    if pa.types.is_decimal(at):
-        if at.precision > 18:
-            raise NotImplementedError(
-                f"decimal({at.precision},{at.scale}) exceeds int64-backed "
-                "decimal(18) (int128 long decimal: future round)"
-            )
-        return T.decimal(at.precision, at.scale)
-    if pa.types.is_date(at):
-        return T.DATE
-    if pa.types.is_timestamp(at):
-        return T.TIMESTAMP
-    if pa.types.is_string(at) or pa.types.is_large_string(at):
-        return T.VARCHAR
-    raise NotImplementedError(f"no engine mapping for arrow type {at}")
 
 
 class _ParquetMetadata(ConnectorMetadata):
@@ -207,61 +182,3 @@ class ParquetConnector(Connector):
             arr = table.column(name)
             out[name] = _arrow_column_to_payload(arr, schema[name])
         return out
-
-
-def _arrow_column_to_payload(arr, t: T.DataType):
-    """Arrow chunked array -> engine staging payload."""
-    import pyarrow as pa
-
-    combined = arr.combine_chunks()
-    nulls = combined.null_count > 0
-    if t.is_string:
-        ids, valid, dictionary = _encode_arrow_strings(combined)
-        if nulls:
-            return MaskedColumn(
-                data=ids, valid=valid, values=tuple(dictionary)
-            )
-        return DictColumn(
-            ids=ids, values=np.asarray(dictionary, dtype=object)
-        )
-    if t.is_decimal:
-        # arrow decimal128 -> unscaled int64 (precision <= 18 checked
-        # at schema mapping)
-        data = np.asarray(
-            [
-                0 if v is None else int(v.as_py().scaleb(t.scale))
-                for v in combined
-            ],
-            dtype=np.int64,
-        )
-    elif t.name == "date":
-        data = np.asarray(
-            combined.cast(pa.int32()).fill_null(0), dtype=np.int64
-        )
-    elif t.name == "timestamp":
-        data = np.asarray(
-            combined.cast(pa.int64()).fill_null(0), dtype=np.int64
-        )
-    else:
-        data = np.asarray(
-            combined.fill_null(0), dtype=t.np_dtype
-        )
-    if not nulls:
-        return data
-    valid = np.asarray(combined.is_valid(), dtype=bool)
-    return MaskedColumn(data=data, valid=valid)
-
-
-def _encode_arrow_strings(combined):
-    """Arrow string column -> (int32 ids, valid, sorted dictionary)."""
-    valid = np.asarray(combined.is_valid(), dtype=bool)
-    values = combined.fill_null("").to_numpy(zero_copy_only=False)
-    values = values.astype(object)
-    present = values[valid].astype(str)
-    uniq = np.unique(present) if len(present) else np.empty(0, object)
-    ids = np.zeros(len(values), dtype=np.int32)
-    if len(present):
-        ids[valid] = np.searchsorted(
-            uniq.astype(str), present
-        ).astype(np.int32)
-    return ids, valid, uniq.astype(object)

@@ -1,0 +1,189 @@
+"""ORC connector (SURVEY.md §2.2 L9 file-format readers): read
+pyarrow-written ORC files through the SPI, with column pruning,
+stripe-aligned splits, nulls, decimals, dates, and strings — the same
+engine-facing contract as the parquet connector, different physical
+format."""
+
+import datetime
+import decimal
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+orc = pytest.importorskip("pyarrow.orc")
+
+from presto_tpu.connectors import create_connector  # noqa: E402
+from presto_tpu.connectors.spi import TableHandle  # noqa: E402
+from presto_tpu.exec.local_runner import LocalQueryRunner  # noqa: E402
+from presto_tpu.exec.staging import CatalogManager  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def lake(tmp_path_factory):
+    root = tmp_path_factory.mktemp("orclake")
+    (root / "sales").mkdir()
+    n = 10_000
+    rng = np.random.RandomState(11)
+    region = rng.choice(["east", "west", "north", None], n, p=[.4, .3, .2, .1])
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "qty": pa.array(rng.randint(1, 100, n).astype(np.int32)),
+            "price": pa.array(
+                [
+                    decimal.Decimal(int(v)) / 100
+                    for v in rng.randint(100, 100000, n)
+                ],
+                type=pa.decimal128(12, 2),
+            ),
+            "day": pa.array(
+                [
+                    datetime.date(2024, 1, 1) + datetime.timedelta(days=int(d))
+                    for d in rng.randint(0, 365, n)
+                ]
+            ),
+            "region": pa.array(region.tolist()),
+            "score": pa.array(rng.rand(n)),
+        }
+    )
+    # small stripes so split tests exercise multi-stripe mapping
+    orc.write_table(table, root / "sales" / "orders.orc", stripe_size=65536)
+    return root, table
+
+
+@pytest.fixture(scope="module")
+def runner(lake):
+    root, _ = lake
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    catalogs.register("lake", create_connector("orc", root=str(root)))
+    return LocalQueryRunner(catalogs=catalogs)
+
+
+def test_metadata_and_stats(lake):
+    root, _ = lake
+    conn = create_connector("orc", root=str(root))
+    md = conn.metadata()
+    assert md.list_schemas() == ["sales"]
+    assert md.list_tables("sales") == ["orders"]
+    h = TableHandle("lake", "sales", "orders")
+    schema = md.get_table_schema(h)
+    assert schema["id"].name == "bigint"
+    assert schema["price"].is_decimal and schema["price"].scale == 2
+    assert schema["region"].is_string
+    st = md.get_table_stats(h)
+    assert st.row_count == 10_000
+
+
+def test_stripe_splits_cover_exactly(lake):
+    root, _ = lake
+    conn = create_connector("orc", root=str(root))
+    h = TableHandle("lake", "sales", "orders")
+    src = conn.get_splits(h, target_split_rows=1024)
+    splits = []
+    while not src.exhausted:
+        splits.extend(src.next_batch(64))
+    assert splits[0].row_start == 0
+    assert splits[-1].row_end == 10_000
+    for a, b in zip(splits, splits[1:]):
+        assert a.row_end == b.row_start
+    assert len(splits) >= 2
+
+
+def test_arbitrary_range_read_matches_source(lake):
+    """Page source must honor exact row ranges, including ranges that
+    straddle stripe boundaries at unaligned offsets."""
+    root, table = lake
+    conn = create_connector("orc", root=str(root))
+    h = TableHandle("lake", "sales", "orders")
+    offs = conn._stripe_offsets(h)
+    assert offs[-1] == 10_000
+    mid = offs[1] if len(offs) > 2 else 5000
+    from presto_tpu.connectors.spi import ConnectorSplit
+
+    lo, hi = mid - 7, mid + 13
+    page = conn.create_page_source(ConnectorSplit(h, lo, hi), ["id", "qty"])
+    np.testing.assert_array_equal(
+        np.asarray(page["id"]), np.arange(lo, hi, dtype=np.int64)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(page["qty"]),
+        table.column("qty").to_numpy()[lo:hi].astype(np.int32),
+    )
+
+
+def test_full_scan_agg(runner, lake):
+    _, table = lake
+    rows = runner.execute(
+        "select count(*) as n, sum(qty) as q from lake.sales.orders"
+    ).rows()
+    assert rows == [(10_000, int(np.sum(table.column("qty").to_numpy())))]
+
+
+def test_strings_nulls_and_groupby(runner, lake):
+    _, table = lake
+    rows = runner.execute(
+        "select region, count(*) as n from lake.sales.orders "
+        "group by region order by region nulls last"
+    ).rows()
+    import collections
+
+    expect = collections.Counter(table.column("region").to_pylist())
+    got = {r: n for r, n in rows}
+    assert got == dict(expect)
+
+
+def test_decimal_exactness(runner, lake):
+    _, table = lake
+    rows = runner.execute(
+        "select sum(price) as s from lake.sales.orders where qty < 10"
+    ).rows()
+    qty = np.asarray(table.column("qty").to_numpy())
+    price = [decimal.Decimal(str(v)) for v in table.column("price").to_pylist()]
+    expect = sum(p for p, q in zip(price, qty) if q < 10)
+    assert rows[0][0] == pytest.approx(float(expect), rel=1e-12)
+
+
+def test_date_filter(runner, lake):
+    _, table = lake
+    rows = runner.execute(
+        "select count(*) as n from lake.sales.orders "
+        "where day >= date '2024-07-01'"
+    ).rows()
+    days = table.column("day").to_pylist()
+    expect = sum(1 for d in days if d >= datetime.date(2024, 7, 1))
+    assert rows == [(expect,)]
+
+
+def test_empty_orc_table(tmp_path):
+    """A 0-row ORC file (0 stripes) must scan as an empty result, not
+    crash on null-typed arrays."""
+    (tmp_path / "s").mkdir()
+    empty = pa.table(
+        {
+            "a": pa.array([], type=pa.int64()),
+            "b": pa.array([], type=pa.string()),
+        }
+    )
+    orc.write_table(empty, tmp_path / "s" / "t.orc")
+    from presto_tpu.exec.staging import CatalogManager
+
+    catalogs = CatalogManager()
+    catalogs.register("lake", create_connector("orc", root=str(tmp_path)))
+    r = LocalQueryRunner(catalogs=catalogs)
+    assert r.execute("select count(*) as n from lake.s.t").rows() == [(0,)]
+    assert r.execute("select a, b from lake.s.t").rows() == []
+
+
+def test_join_orc_with_tpch(runner, lake):
+    _, table = lake
+    rows = runner.execute(
+        "select r_name, count(*) as n "
+        "from lake.sales.orders, tpch.tiny.region "
+        "where qty = r_regionkey group by r_name order by r_name"
+    ).rows()
+    qty = table.column("qty").to_numpy()
+    expect = sum(1 for q in qty if 0 <= q <= 4)
+    assert sum(n for _, n in rows) == expect
+    assert 0 < len(rows) <= 5
