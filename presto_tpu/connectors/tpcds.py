@@ -98,6 +98,24 @@ STORE_NAMES = ["able", "anti", "ation", "bar", "cally", "eing", "ese",
 COMPANIES = ["pri", "able", "ese", "anti", "cally", "ation"]
 CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
               "Men", "Music", "Shoes", "Sports", "Women"]
+CLASSES = [
+    "accent", "arts", "athletic", "bedding", "bridal", "blinds/shades",
+    "bracelets", "classical", "computers", "consignment", "country",
+    "curtains/drapes", "decor", "dresses", "fiction", "history",
+]
+FIRST_NAMES = [
+    "Aaron", "Alice", "Amy", "Anna", "Brian", "Carol", "Chad", "Daniel",
+    "David", "Diane", "Earl", "Edna", "Frank", "Grace", "Helen", "Irene",
+    "Jack", "James", "Karen", "Larry", "Linda", "Maria", "Nancy", "Oscar",
+    "Paul", "Rachel", "Sarah", "Thomas", "Velma", "Walter",
+]
+LAST_NAMES = [
+    "Adams", "Baker", "Brown", "Clark", "Davis", "Evans", "Garcia",
+    "Harris", "Hill", "Johnson", "Jones", "King", "Lewis", "Lopez",
+    "Martin", "Miller", "Moore", "Nelson", "Parker", "Roberts",
+    "Robinson", "Scott", "Smith", "Taylor", "Thompson", "Turner",
+    "Walker", "White", "Williams", "Young",
+]
 PROMO_CHANNELS = ["N", "Y"]
 
 _STREET_NAME = _LazyCombo(STREET_W1, STREET_W2)
@@ -162,6 +180,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "d_year": T.INTEGER,
         "d_moy": T.INTEGER,
         "d_dom": T.INTEGER,
+        "d_dow": T.INTEGER,
         "d_qoy": T.INTEGER,
         "d_day_name": T.VARCHAR,
     },
@@ -183,6 +202,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "hd_income_band_sk": T.INTEGER,
         "hd_buy_potential": T.VARCHAR,
         "hd_dep_count": T.INTEGER,
+        "hd_vehicle_count": T.INTEGER,
     },
     "warehouse": {
         "w_warehouse_sk": T.INTEGER,
@@ -199,13 +219,17 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "s_store_sk": T.INTEGER,
         "s_store_id": T.VARCHAR,
         "s_store_name": T.VARCHAR,
+        "s_city": T.VARCHAR,
         "s_state": T.VARCHAR,
         "s_zip": T.VARCHAR,
+        "s_number_employees": T.INTEGER,
     },
     "promotion": {
         "p_promo_sk": T.INTEGER,
         "p_promo_id": T.VARCHAR,
         "p_channel_email": T.VARCHAR,
+        "p_channel_event": T.VARCHAR,
+        "p_channel_dmail": T.VARCHAR,
     },
     "item": {
         "i_item_sk": T.INTEGER,
@@ -214,11 +238,20 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "i_color": T.VARCHAR,
         "i_current_price": D7_2,
         "i_category": T.VARCHAR,
+        "i_category_id": T.INTEGER,
+        "i_class": T.VARCHAR,
+        "i_class_id": T.INTEGER,
+        "i_brand": T.VARCHAR,
+        "i_brand_id": T.INTEGER,
         "i_manufact_id": T.INTEGER,
+        "i_manufact": T.VARCHAR,
+        "i_manager_id": T.INTEGER,
     },
     "customer": {
         "c_customer_sk": T.INTEGER,
         "c_customer_id": T.VARCHAR,
+        "c_first_name": T.VARCHAR,
+        "c_last_name": T.VARCHAR,
         "c_current_cdemo_sk": T.INTEGER,
         "c_current_hdemo_sk": T.INTEGER,
         "c_current_addr_sk": T.INTEGER,
@@ -247,7 +280,12 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ss_quantity": T.INTEGER,
         "ss_wholesale_cost": D7_2,
         "ss_list_price": D7_2,
+        "ss_sales_price": D7_2,
+        "ss_ext_sales_price": D7_2,
+        "ss_ext_list_price": D7_2,
+        "ss_ext_tax": D7_2,
         "ss_coupon_amt": D7_2,
+        "ss_net_profit": D7_2,
     },
     "store_returns": {
         "sr_returned_date_sk": T.INTEGER,
@@ -322,6 +360,8 @@ class TpcdsGenerator:
                 out[c] = np.asarray([d.month for d in dates], np.int64)
             elif c == "d_dom":
                 out[c] = np.asarray([d.day for d in dates], np.int64)
+            elif c == "d_dow":
+                out[c] = (days + 4) % 7  # 0=Sunday, matching d_day_name
             elif c == "d_qoy":
                 out[c] = np.asarray(
                     [(d.month - 1) // 3 + 1 for d in dates], np.int64
@@ -377,6 +417,8 @@ class TpcdsGenerator:
                 out[c] = _fixed(BUY_POTENTIAL, (rows // 20) % 6)
             elif c == "hd_dep_count":
                 out[c] = (rows // 120) % 10
+            elif c == "hd_vehicle_count":
+                out[c] = (rows // 7) % 6 - 1  # official domain -1..4
         return out
 
     def _gen_warehouse(self, rows, columns):
@@ -422,6 +464,10 @@ class TpcdsGenerator:
                 out[c] = _numbered("Store", self.counts["store"], rows + 1)
             elif c == "s_store_name":
                 out[c] = _fixed(STORE_NAMES, rows % len(STORE_NAMES))
+            elif c == "s_city":
+                out[c] = _fixed(CITIES, rows % len(CITIES))
+            elif c == "s_number_employees":
+                out[c] = _uniform(1210, rows, 200, 300)
             elif c == "s_state":
                 out[c] = _fixed(STATES, rows % len(STATES))
             elif c == "s_zip":
@@ -439,9 +485,20 @@ class TpcdsGenerator:
                 )
             elif c == "p_channel_email":
                 out[c] = _fixed(PROMO_CHANNELS, rows % 2)
+            elif c == "p_channel_event":
+                # phase-shifted vs email so OR filters select a real mix
+                out[c] = _fixed(PROMO_CHANNELS, (rows // 2) % 2)
+            elif c == "p_channel_dmail":
+                out[c] = _fixed(PROMO_CHANNELS, (rows // 4) % 2)
         return out
 
     def _gen_item(self, rows, columns):
+        # hoisted picks: each id/name pair (category, class, brand,
+        # manufact) is functionally dependent through a single draw
+        cat = _uniform(1404, rows, 0, 9)
+        cls = _uniform(1406, rows, 0, len(CLASSES) - 1)
+        brand = _uniform(1407, rows, 1, 500)
+        manufact = _uniform(1405, rows, 1, 1000)
         out = {}
         for c in columns:
             if c == "i_item_sk":
@@ -462,9 +519,25 @@ class TpcdsGenerator:
                 # real slice of items at every scale factor
                 out[c] = _uniform(1403, rows, 5000, 9000)
             elif c == "i_category":
-                out[c] = _fixed(CATEGORIES, _uniform(1404, rows, 0, 9))
+                out[c] = _fixed(CATEGORIES, cat)
+            elif c == "i_category_id":
+                out[c] = cat + 1
+            elif c == "i_class":
+                out[c] = _fixed(CLASSES, cls)
+            elif c == "i_class_id":
+                out[c] = cls + 1
+            elif c == "i_brand":
+                # brand name derived from the same draw as i_brand_id
+                # (functional dependence, like dsdgen's brand hierarchy)
+                out[c] = _numbered("brand", 500, brand)
+            elif c == "i_brand_id":
+                out[c] = brand
             elif c == "i_manufact_id":
-                out[c] = _uniform(1405, rows, 1, 1000)
+                out[c] = manufact
+            elif c == "i_manufact":
+                out[c] = _numbered("manufact", 1000, manufact)
+            elif c == "i_manager_id":
+                out[c] = _uniform(1408, rows, 1, 100)
         return out
 
     def _gen_customer(self, rows, columns):
@@ -475,6 +548,16 @@ class TpcdsGenerator:
                 out[c] = rows + 1
             elif c == "c_customer_id":
                 out[c] = _numbered("Customer", cn["customer"], rows + 1)
+            elif c == "c_first_name":
+                out[c] = _fixed(
+                    FIRST_NAMES,
+                    _uniform(1507, rows, 0, len(FIRST_NAMES) - 1),
+                )
+            elif c == "c_last_name":
+                out[c] = _fixed(
+                    LAST_NAMES,
+                    _uniform(1508, rows, 0, len(LAST_NAMES) - 1),
+                )
             elif c == "c_current_cdemo_sk":
                 out[c] = _uniform(
                     1501, rows, 1, cn["customer_demographics"]
@@ -539,7 +622,14 @@ class TpcdsGenerator:
     def _gen_store_sales(self, rows, columns):
         cn = self.counts
         f = self._ss_fields(rows)
+        # hoisted shared draws: per-unit and extended columns must stay
+        # row-wise consistent, so each quantity/price stream is drawn
+        # exactly once here (the consistency invariant lives in these
+        # bindings, not in matching magic tags across branches)
         wholesale = _uniform(1703, rows, 100, 10000)
+        quantity = _uniform(1710, rows, 1, 100)
+        list_price = wholesale + _uniform(1711, rows, 0, 5000)
+        sales_price = _uniform(1714, rows, 50, 9900)
         out = {}
         for c in columns:
             if c == "ss_sold_date_sk":
@@ -565,16 +655,29 @@ class TpcdsGenerator:
             elif c == "ss_ticket_number":
                 out[c] = f["ticket"]
             elif c == "ss_quantity":
-                out[c] = _uniform(1710, rows, 1, 100)
+                out[c] = quantity
             elif c == "ss_wholesale_cost":
                 out[c] = wholesale
             elif c == "ss_list_price":
-                out[c] = wholesale + _uniform(1711, rows, 0, 5000)
+                out[c] = list_price
+            elif c == "ss_sales_price":
+                out[c] = sales_price
+            elif c == "ss_ext_sales_price":
+                # sales_price * quantity, in cents; max 99.00 * 100 =
+                # 9900.00, inside decimal(7,2)
+                out[c] = sales_price * quantity
+            elif c == "ss_ext_list_price":
+                # list_price * quantity <= 150.00 * 100, inside d(7,2)
+                out[c] = list_price * quantity
+            elif c == "ss_ext_tax":
+                out[c] = _uniform(1715, rows, 0, 90000)
             elif c == "ss_coupon_amt":
                 r = _uniform(1712, rows, 0, 9)
                 out[c] = np.where(
                     r < 8, 0, _uniform(1713, rows, 100, 2000)
                 )
+            elif c == "ss_net_profit":
+                out[c] = _uniform(1716, rows, -500000, 1000000)
         return out
 
     def _gen_store_returns(self, rows, columns):

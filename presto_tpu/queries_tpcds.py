@@ -23,6 +23,12 @@ def queries_for(schema: str):
         {k: v.replace(S, target) for k, v in BREADTH.items()},
     )
 
+
+def official_for(schema: str):
+    """The OFFICIAL corpus rebound to ``tpcds.<schema>``."""
+    target = f"tpcds.{schema}"
+    return {k: v.replace(S, target) for k, v in OFFICIAL.items()}
+
 # Q95: ws_wh self-join inequality CTE (the Q21 pattern), two IN
 # subqueries, count(distinct), date-window scan
 Q95 = f"""
@@ -176,4 +182,158 @@ BREADTH = {
         group by cs_item_sk
         order by sale desc
         limit 10""",
+}
+
+#: official TPC-DS query templates beyond the two BASELINE configs,
+#: rendered in this engine's dialect with substitution parameters chosen
+#: (by probing the deterministic generator) so every query selects a
+#: non-empty slice at tiny scale and above
+OFFICIAL = {
+    # Q3: brand revenue by year for one manufacturer in November
+    "q3": f"""
+        select d_year, i_brand_id as brand_id, i_brand as brand,
+               sum(ss_ext_sales_price) as sum_agg
+        from {S}.date_dim, {S}.store_sales, {S}.item
+        where d_date_sk = ss_sold_date_sk
+          and ss_item_sk = i_item_sk
+          and i_manufact_id = 156
+          and d_moy = 11
+        group by d_year, i_brand_id, i_brand
+        order by d_year, sum_agg desc, brand_id
+        limit 100""",
+    # Q7: average item economics for a demographic + promo channel slice
+    "q7": f"""
+        select i_item_id,
+               avg(ss_quantity) as agg1,
+               avg(ss_list_price) as agg2,
+               avg(ss_coupon_amt) as agg3,
+               avg(ss_sales_price) as agg4
+        from {S}.store_sales, {S}.customer_demographics, {S}.date_dim,
+             {S}.item, {S}.promotion
+        where ss_sold_date_sk = d_date_sk
+          and ss_item_sk = i_item_sk
+          and ss_cdemo_sk = cd_demo_sk
+          and ss_promo_sk = p_promo_sk
+          and cd_gender = 'M'
+          and cd_marital_status = 'S'
+          and cd_education_status = 'College'
+          and (p_channel_email = 'N' or p_channel_event = 'N')
+          and d_year = 1999
+        group by i_item_id
+        order by i_item_id
+        limit 100""",
+    # Q19: brand revenue where the customer's zip differs from the
+    # store's zip (the cross-shopping filter)
+    "q19": f"""
+        select i_brand_id as brand_id, i_brand as brand,
+               i_manufact_id as man_id, i_manufact as man,
+               sum(ss_ext_sales_price) as ext_price
+        from {S}.date_dim, {S}.store_sales, {S}.item, {S}.customer,
+             {S}.customer_address, {S}.store
+        where d_date_sk = ss_sold_date_sk
+          and ss_item_sk = i_item_sk
+          and i_manager_id = 64
+          and d_moy = 11
+          and d_year = 1999
+          and ss_customer_sk = c_customer_sk
+          and c_current_addr_sk = ca_address_sk
+          and substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
+          and ss_store_sk = s_store_sk
+        group by i_brand_id, i_brand, i_manufact_id, i_manufact
+        order by ext_price desc, brand_id, man_id
+        limit 100""",
+    # Q42: category revenue for one month
+    "q42": f"""
+        select d_year, i_category_id, i_category,
+               sum(ss_ext_sales_price) as revenue
+        from {S}.date_dim, {S}.store_sales, {S}.item
+        where d_date_sk = ss_sold_date_sk
+          and ss_item_sk = i_item_sk
+          and d_moy = 11
+          and d_year = 1999
+        group by d_year, i_category_id, i_category
+        order by revenue desc, d_year, i_category_id, i_category
+        limit 100""",
+    # Q52: brand revenue for one month
+    "q52": f"""
+        select d_year, i_brand_id as brand_id, i_brand as brand,
+               sum(ss_ext_sales_price) as ext_price
+        from {S}.date_dim, {S}.store_sales, {S}.item
+        where d_date_sk = ss_sold_date_sk
+          and ss_item_sk = i_item_sk
+          and d_moy = 11
+          and d_year = 1999
+        group by d_year, i_brand_id, i_brand
+        order by d_year, ext_price desc, brand_id
+        limit 100""",
+    # Q55: brand revenue for one manager's items
+    "q55": f"""
+        select i_brand_id as brand_id, i_brand as brand,
+               sum(ss_ext_sales_price) as ext_price
+        from {S}.date_dim, {S}.store_sales, {S}.item
+        where d_date_sk = ss_sold_date_sk
+          and ss_item_sk = i_item_sk
+          and i_manager_id = 64
+          and d_moy = 11
+          and d_year = 1999
+        group by i_brand_id, i_brand
+        order by ext_price desc, brand_id
+        limit 100""",
+    # Q68: per-ticket shopping carts where the bought-in city differs
+    # from the customer's current city (subquery-in-FROM + two address
+    # instances)
+    "q68": f"""
+        select c_last_name, c_first_name, ca_city, bought_city,
+               ss_ticket_number, extended_price, extended_tax,
+               list_price
+        from (select ss_ticket_number, ss_customer_sk,
+                     ca_city as bought_city,
+                     sum(ss_ext_sales_price) as extended_price,
+                     sum(ss_ext_list_price) as list_price,
+                     sum(ss_ext_tax) as extended_tax
+              from {S}.store_sales, {S}.date_dim, {S}.store,
+                   {S}.household_demographics, {S}.customer_address
+              where ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and ss_addr_sk = ca_address_sk
+                and d_dom between 1 and 2
+                and (hd_dep_count = 4 or hd_vehicle_count = 3)
+                and d_year in (1998, 1999, 2000)
+                and s_city in ('Antioch', 'Bridgeport')
+              group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                       ca_city) dn,
+             {S}.customer, {S}.customer_address current_addr
+        where ss_customer_sk = c_customer_sk
+          and c_current_addr_sk = current_addr.ca_address_sk
+          and current_addr.ca_city <> bought_city
+        order by c_last_name, ss_ticket_number,
+                 c_first_name, ca_city, bought_city, extended_price,
+                 extended_tax, list_price
+        limit 100""",
+    # Q79: per-ticket coupon/profit for Monday shoppers at mid-size
+    # stores
+    "q79": f"""
+        select c_last_name, c_first_name,
+               substring(s_city, 1, 30) as city_part, ss_ticket_number,
+               amt, profit
+        from (select ss_ticket_number, ss_customer_sk, s_city,
+                     sum(ss_coupon_amt) as amt,
+                     sum(ss_net_profit) as profit
+              from {S}.store_sales, {S}.date_dim, {S}.store,
+                   {S}.household_demographics
+              where ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and (hd_dep_count = 6 or hd_vehicle_count > 2)
+                and d_dow = 1
+                and d_year in (1998, 1999, 2000)
+                and s_number_employees between 200 and 295
+              group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                       s_city) ms,
+             {S}.customer
+        where ss_customer_sk = c_customer_sk
+        order by c_last_name, c_first_name, city_part, profit,
+                 ss_ticket_number, amt
+        limit 100""",
 }
