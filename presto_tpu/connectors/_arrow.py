@@ -29,6 +29,7 @@ def arrow_to_engine_type(at) -> T.DataType:
     if pa.types.is_floating(at):
         return T.DOUBLE
     if pa.types.is_decimal(at):
+        # T.decimal routes p>18 to the int128-limbed LongDecimalType
         return T.decimal(at.precision, at.scale)
     if pa.types.is_date(at):
         return T.DATE
@@ -55,15 +56,16 @@ def arrow_column_to_payload(arr, t: T.DataType):
             ids=ids, values=np.asarray(dictionary, dtype=object)
         )
     if t.is_decimal:
-        # arrow decimal128 -> unscaled int64 (precision bound checked
-        # at schema-mapping time by T.decimal)
-        data = np.asarray(
-            [
-                0 if v is None else int(v.as_py().scaleb(t.scale))
-                for v in combined
-            ],
-            dtype=np.int64,
-        )
+        # arrow decimal128 -> unscaled int64 (short) or (n, 2) int128
+        # limb pairs (long)
+        unscaled = [
+            0 if v is None else int(v.as_py().scaleb(t.scale))
+            for v in combined
+        ]
+        if t.is_long_decimal:
+            data = T.int128_limbs(unscaled)
+        else:
+            data = np.asarray(unscaled, dtype=np.int64)
     elif t.name == "date":
         data = np.asarray(
             combined.cast(pa.int32()).fill_null(0), dtype=np.int64

@@ -50,6 +50,15 @@ def _describe(node: N.PlanNode) -> str:
         return f"Output[{[o for o, _ in node.columns]}]"
     if isinstance(node, N.ValuesNode):
         return "Values[1 row]"
+    if isinstance(node, N.UnnestNode):
+        ords = (
+            f", ordinality={node.ordinality_name}"
+            if node.ordinality_name
+            else ""
+        )
+        return (
+            f"Unnest[{node.out_name} x{len(node.elements)}{ords}]"
+        )
     return type(node).__name__
 
 

@@ -41,6 +41,7 @@ from presto_tpu.ops import (
     limit as limit_op,
     order_by as order_by_op,
     project,
+    unnest as unnest_op,
     window as window_op,
 )
 from presto_tpu.page import Block, Page, compact_page
@@ -551,7 +552,8 @@ def materialize_page(page: Page, n: int) -> Page:
     cap = bucket_capacity(n)
     blocks = []
     for blk in page.blocks:
-        data = np.zeros((cap,), page_np_dtype(blk))
+        # long decimals carry (capacity, 2) limb pairs; pad on axis 0
+        data = np.zeros((cap,) + blk.data.shape[1:], page_np_dtype(blk))
         data[:n] = next(fetched)
         if blk.valid is not None:
             valid = np.zeros((cap,), bool)
@@ -675,6 +677,14 @@ def _execute_node_inner(
     if isinstance(node, N.WindowNode):
         return window_op(
             run(node.source), node.partition_by, node.order_by, node.calls
+        )
+    if isinstance(node, N.UnnestNode):
+        return unnest_op(
+            run(node.source),
+            node.elements,
+            node.out_name,
+            node.out_type,
+            node.ordinality_name,
         )
     if isinstance(node, N.OutputNode):
         src = run(node.source)

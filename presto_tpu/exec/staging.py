@@ -70,7 +70,8 @@ def stage_page(
         v = data[name]
         if isinstance(v, MaskedColumn):
             arr = v.data.astype(t.np_dtype, copy=False)
-            padded = np.zeros(cap, dtype=t.np_dtype)
+            # long decimals carry (n, 2) limb pairs; pad on axis 0
+            padded = np.zeros((cap,) + arr.shape[1:], dtype=t.np_dtype)
             padded[: len(arr)] = arr
             vpad = np.zeros(cap, dtype=bool)
             vpad[: len(arr)] = v.valid
@@ -97,7 +98,7 @@ def stage_page(
             )
         elif isinstance(v, np.ndarray) and v.dtype != object:
             arr = v.astype(t.np_dtype, copy=False)
-            padded = np.zeros(cap, dtype=t.np_dtype)
+            padded = np.zeros((cap,) + arr.shape[1:], dtype=t.np_dtype)
             padded[: len(arr)] = arr
             blocks.append(
                 Block(data=jnp.asarray(padded), valid=None, dtype=t)

@@ -415,15 +415,16 @@ def arith(op: str, left: Expr, right: Expr) -> Arithmetic:
     elif lt.is_decimal or rt.is_decimal:
         a = lt if lt.is_decimal else T.decimal(18, 0)
         b = rt if rt.is_decimal else T.decimal(18, 0)
+        long = a.is_long_decimal or b.is_long_decimal
         if op == "*":
             scale = a.scale + b.scale
             if scale > 18:
                 raise NotImplementedError(
                     f"decimal multiply scale {scale} > 18"
                 )
-            out = T.decimal(18, scale)
+            out = T.decimal(38 if long else 18, scale)
         else:
-            out = T.decimal(18, max(a.scale, b.scale))
+            out = T.decimal(38 if long else 18, max(a.scale, b.scale))
     else:
         out = T.common_super_type(lt, rt)
     return Arithmetic(op, left, right, out)
@@ -571,12 +572,21 @@ class ExprLowerer:
 
     def _eval_literal(self, e: Literal):
         if e.value is None:
-            zero = jnp.zeros((self.page.capacity,), dtype=e.dtype.jnp_dtype)
+            shape = (
+                (self.page.capacity, 2)
+                if e.dtype.is_long_decimal
+                else (self.page.capacity,)
+            )
+            zero = jnp.zeros(shape, dtype=e.dtype.jnp_dtype)
             return zero, jnp.zeros((self.page.capacity,), dtype=jnp.bool_)
         if e.dtype.is_string:
             raise NotImplementedError(
                 "bare string literal outside comparison context"
             )
+        if e.dtype.is_long_decimal:
+            # (1, 2) limb row: broadcasts against both (cap, 2) columns
+            # (elementwise limb ops) and (cap, 2) projection shapes
+            return jnp.asarray(T.int128_limbs([e.value])), None
         v = e.value
         return jnp.asarray(v, dtype=e.dtype.jnp_dtype), None
 
@@ -587,6 +597,8 @@ class ExprLowerer:
         rd, rv = self.eval(e.right)
         valid = _and_valid(lv, rv)
         lt, rt = e.left.dtype, e.right.dtype
+        if lt.is_long_decimal or rt.is_long_decimal:
+            return self._long_decimal_arith(e, ld, rd, valid)
         if e.op == "/" and (lt.is_decimal or rt.is_decimal):
             ls = 10.0 ** -(lt.scale if lt.is_decimal else 0)
             rs = 10.0 ** -(rt.scale if rt.is_decimal else 0)
@@ -628,7 +640,98 @@ class ExprLowerer:
 
     def _eval_negate(self, e: Negate):
         d, v = self.eval(e.arg)
+        if e.arg.dtype.is_long_decimal:
+            from presto_tpu import int128
+
+            h, l = int128.neg(d[..., 0], d[..., 1])
+            return jnp.stack([h, l], axis=-1), v
         return -d, v
+
+    # -- long decimal (int128 limb pairs; presto_tpu.int128) ---------------
+
+    def _long_limbs(self, expr: Expr, data, to_scale: int):
+        """Any numeric operand -> (hi, lo) limbs at ``to_scale``."""
+        from presto_tpu import int128
+
+        t = expr.dtype
+        if t.is_long_decimal:
+            h, l = data[..., 0], data[..., 1]
+            from_scale = t.scale
+        else:
+            h, l = int128.from_i64(data.astype(jnp.int64))
+            from_scale = t.scale if t.is_decimal else 0
+        if to_scale < from_scale:  # pragma: no cover - planner upscales
+            raise NotImplementedError(
+                "long-decimal downscale requires int128 division"
+            )
+        return int128.mul_pow10(h, l, to_scale - from_scale)
+
+    def _long_decimal_arith(self, e: Arithmetic, ld, rd, valid):
+        from presto_tpu import int128
+
+        lt, rt = e.left.dtype, e.right.dtype
+        if e.dtype.name in ("double", "real"):
+            # long decimal op double -> double (arith() typed it so)
+            lf = self._long_f64(e.left, ld)
+            rf = self._long_f64(e.right, rd)
+            if e.op == "+":
+                return lf + rf, valid
+            if e.op == "-":
+                return lf - rf, valid
+            if e.op == "*":
+                return lf * rf, valid
+            if e.op == "/":
+                return lf / jnp.where(rf == 0, 1.0, rf), (
+                    valid
+                    if not _maybe_zero(e.right)
+                    else _and_valid(valid, rf != 0)
+                )
+        if e.op in ("+", "-"):
+            scale = e.dtype.scale
+            lh, ll = self._long_limbs(e.left, ld, scale)
+            rh, rl = self._long_limbs(e.right, rd, scale)
+            fn = int128.add if e.op == "+" else int128.sub
+            h, l = fn(lh, ll, rh, rl)
+            return jnp.stack([h, l], axis=-1), valid
+        if e.op == "*" and not (lt.is_long_decimal and rt.is_long_decimal):
+            # long * small integer literal: exact via limb multiply
+            lit = e.right if rt.is_integer else e.left
+            if (
+                isinstance(lit, Literal)
+                and lit.value is not None
+                and 0 <= int(lit.value) < (1 << 31)
+            ):
+                big, bt = (ld, lt) if lt.is_long_decimal else (rd, rt)
+                h, l = int128.mul_u32(
+                    big[..., 0], big[..., 1], int(lit.value)
+                )
+                return jnp.stack([h, l], axis=-1), valid
+        if e.op == "/":
+            # like short-decimal /: falls to DOUBLE (documented deviation)
+            lf = self._long_f64(e.left, ld)
+            rf = self._long_f64(e.right, rd)
+            return lf / jnp.where(rf == 0, 1.0, rf), (
+                valid
+                if not _maybe_zero(e.right)
+                else _and_valid(valid, rf != 0)
+            )
+        raise NotImplementedError(
+            f"long-decimal {e.op} between {lt} and {rt} (supported: "
+            "+, -, negate, compare, / (->double), * by a small integer "
+            "literal; full 128x128 multiply is a documented deviation)"
+        )
+
+    def _long_f64(self, expr: Expr, data):
+        from presto_tpu import int128
+
+        t = expr.dtype
+        if t.is_long_decimal:
+            return int128.to_f64(data[..., 0], data[..., 1]) * (
+                10.0 ** -t.scale
+            )
+        if t.is_decimal:
+            return data.astype(jnp.float64) * (10.0 ** -t.scale)
+        return data.astype(jnp.float64)
 
     # -- comparisons -------------------------------------------------------
 
@@ -695,6 +798,36 @@ class ExprLowerer:
                     "cross-dictionary string comparison requires re-encode"
                 )
             return self._cmp(e.op, ld, rd), _and_valid(lv, rv)
+        if lt.is_long_decimal or rt.is_long_decimal:
+            from presto_tpu import int128
+
+            if "double" in (lt.name, rt.name) or "real" in (
+                lt.name, rt.name
+            ):
+                l = self._long_f64(e.left, ld)
+                r = self._long_f64(e.right, rd)
+                return self._cmp(e.op, l, r), _and_valid(lv, rv)
+            scale = max(
+                lt.scale if lt.is_decimal else 0,
+                rt.scale if rt.is_decimal else 0,
+            )
+            lh, ll = self._long_limbs(e.left, ld, scale)
+            rh, rl = self._long_limbs(e.right, rd, scale)
+            if e.op == "=":
+                res = int128.eq(lh, ll, rh, rl)
+            elif e.op in ("<>", "!="):
+                res = ~int128.eq(lh, ll, rh, rl)
+            elif e.op == "<":
+                res = int128.lt(lh, ll, rh, rl)
+            elif e.op == "<=":
+                res = ~int128.lt(rh, rl, lh, ll)
+            elif e.op == ">":
+                res = int128.lt(rh, rl, lh, ll)
+            elif e.op == ">=":
+                res = ~int128.lt(lh, ll, rh, rl)
+            else:
+                raise ValueError(f"unknown comparison {e.op}")
+            return res, _and_valid(lv, rv)
         l, r, _ = _numeric_pair(e.left, e.right, ld, rd)
         return self._cmp(e.op, l, r), _and_valid(lv, rv)
 
@@ -759,27 +892,37 @@ class ExprLowerer:
             vd, vv = self.eval(v)
             conds.append(cd)
             vals.append((vd, vv))
+        long = e.dtype.is_long_decimal  # (cap, 2) limb branches
         if e.default is not None:
             dd, dv = self.eval(e.default)
+            dd = _coerce_to(dd, e.default.dtype, e.dtype)
         else:
-            dd = jnp.zeros((self.page.capacity,), dtype=e.dtype.jnp_dtype)
+            shape = (
+                (self.page.capacity, 2)
+                if long
+                else (self.page.capacity,)
+            )
+            dd = jnp.zeros(shape, dtype=e.dtype.jnp_dtype)
             dv = jnp.zeros((self.page.capacity,), dtype=jnp.bool_)
         out_d, out_v = dd, dv
         needs_valid = dv is not None or any(vv is not None for _, vv in vals)
         if needs_valid and out_v is None:
-            out_v = jnp.ones(jnp.shape(out_d), dtype=jnp.bool_)
+            out_v = jnp.ones((self.page.capacity,), dtype=jnp.bool_)
         branch_types = [v.dtype for _, v in e.whens]
         for cd, (vd, vv), bt in zip(
             reversed(conds), reversed(vals), reversed(branch_types)
         ):
             vd = _coerce_to(vd, bt, e.dtype)
-            out_d = jnp.where(cd, vd, out_d)
+            out_d = jnp.where(cd[..., None] if long else cd, vd, out_d)
             if needs_valid:
-                branch_v = vv if vv is not None else jnp.ones(jnp.shape(vd), jnp.bool_)
+                branch_v = vv if vv is not None else jnp.ones(
+                    jnp.shape(cd), jnp.bool_
+                )
                 out_v = jnp.where(cd, branch_v, out_v)
         return out_d, (out_v if needs_valid else None)
 
     def _eval_coalesce(self, e: Coalesce):
+        long = e.dtype.is_long_decimal
         out_d, out_v = self.eval(e.args[0])
         out_d = _coerce_to(out_d, e.args[0].dtype, e.dtype)
         for a in e.args[1:]:
@@ -787,7 +930,9 @@ class ExprLowerer:
                 return out_d, None
             d, v = self.eval(a)
             d = _coerce_to(d, a.dtype, e.dtype)
-            out_d = jnp.where(out_v, out_d, d)
+            out_d = jnp.where(
+                out_v[..., None] if long else out_v, out_d, d
+            )
             out_v = out_v | (v if v is not None else True)
         return out_d, out_v
 
@@ -796,6 +941,8 @@ class ExprLowerer:
         src, dst = e.arg.dtype, e.to
         if src == dst:
             return d, v
+        if src.is_long_decimal or dst.is_long_decimal:
+            return self._cast_long(d, v, src, dst)
         if dst.is_decimal:
             if src.is_decimal:
                 return _rescale(d, src.scale, dst.scale), v
@@ -815,6 +962,54 @@ class ExprLowerer:
             if dst.is_integer:
                 return _rescale(d, src.scale, 0).astype(dst.jnp_dtype), v
         return d.astype(dst.jnp_dtype), v
+
+    def _cast_long(self, d, v, src: T.DataType, dst: T.DataType):
+        """Casts in/out of the int128 limb representation."""
+        from presto_tpu import int128
+
+        if dst.is_long_decimal:
+            if src.is_long_decimal:
+                if dst.scale < src.scale:
+                    raise NotImplementedError(
+                        "long-decimal downscale requires int128 division"
+                    )
+                h, l = int128.mul_pow10(
+                    d[..., 0], d[..., 1], dst.scale - src.scale
+                )
+                return jnp.stack([h, l], axis=-1), v
+            if src.is_decimal or src.is_integer:
+                h, l = int128.from_i64(d.astype(jnp.int64))
+                from_scale = src.scale if src.is_decimal else 0
+                if dst.scale < from_scale:
+                    raise NotImplementedError(
+                        "long-decimal downscale requires int128 division"
+                    )
+                h, l = int128.mul_pow10(h, l, dst.scale - from_scale)
+                return jnp.stack([h, l], axis=-1), v
+            if src.name in ("double", "real"):
+                raise NotImplementedError(
+                    "double -> long decimal cast (use a decimal literal)"
+                )
+        # src is long decimal
+        if dst.name in ("double", "real"):
+            f = int128.to_f64(d[..., 0], d[..., 1]) * (10.0 ** -src.scale)
+            return f.astype(dst.jnp_dtype), v
+        if dst.is_decimal or dst.is_integer:
+            # in-range narrowing: take the low limb after descaling to
+            # the target scale; values beyond int64 wrap (the reference
+            # raises on overflow — documented deviation)
+            to_scale = dst.scale if dst.is_decimal else 0
+            if to_scale > src.scale:
+                h, l = int128.mul_pow10(
+                    d[..., 0], d[..., 1], to_scale - src.scale
+                )
+                return l, v
+            if to_scale < src.scale:
+                raise NotImplementedError(
+                    "long-decimal downscale requires int128 division"
+                )
+            return d[..., 1], v
+        raise NotImplementedError(f"cast {src} -> {dst}")
 
     # -- predicates --------------------------------------------------------
 
@@ -942,6 +1137,25 @@ def _tv_or_valid(ld, lv, rd, rv):
 def _coerce_to(data, from_t: T.DataType, to_t: T.DataType):
     if from_t == to_t:
         return data
+    if to_t.is_long_decimal:
+        from presto_tpu import int128
+
+        if from_t.is_long_decimal:
+            h, l = data[..., 0], data[..., 1]
+            from_scale = from_t.scale
+        else:
+            h, l = int128.from_i64(data.astype(jnp.int64))
+            from_scale = from_t.scale if from_t.is_decimal else 0
+        if to_t.scale < from_scale:
+            raise NotImplementedError(
+                "long-decimal downscale requires int128 division"
+            )
+        h, l = int128.mul_pow10(h, l, to_t.scale - from_scale)
+        return jnp.stack([h, l], axis=-1)
+    if from_t.is_long_decimal:
+        raise NotImplementedError(
+            f"implicit narrowing of {from_t} to {to_t}; cast explicitly"
+        )
     if to_t.is_decimal and from_t.is_decimal:
         return _rescale(data, from_t.scale, to_t.scale)
     if to_t.is_decimal and from_t.is_integer:

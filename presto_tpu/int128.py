@@ -1,0 +1,94 @@
+"""int128 limb arithmetic for long decimals, as jit-safe jnp ops.
+
+Reference parity: presto-common's ``Int128Math`` (the long-decimal
+accumulator/arithmetic kernel, used by DecimalType p>18). TPU-first
+shape: a value is an (..., 2) int64 array — [..., 0] the signed high
+limb, [..., 1] the low 64 bits (unsigned, stored as an int64 bit
+pattern). Everything here is elementwise int64/uint64 VPU work with
+static shapes; no loops, no host.
+
+Requires ``jax_enable_x64`` (the engine enables it at import for SQL
+bigint semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_U64 = jnp.uint64
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+def _u(x):
+    return x.astype(_U64)
+
+
+def from_i64(x):
+    """Sign-extend int64 -> (hi, lo) limbs."""
+    return jnp.where(x < 0, jnp.int64(-1), jnp.int64(0)), x
+
+
+def add(ah, al, bh, bl):
+    """(ah, al) + (bh, bl) with carry out of the low limb."""
+    lo = al + bl  # wraps (two's complement)
+    carry = (_u(lo) < _u(al)).astype(jnp.int64)
+    return ah + bh + carry, lo
+
+
+def neg(h, l):
+    """Two's-complement negate: ~x + 1 across limbs."""
+    lo = -l  # wraps
+    borrow = (l != 0).astype(jnp.int64)
+    return -h - borrow, lo
+
+
+def sub(ah, al, bh, bl):
+    nh, nl = neg(bh, bl)
+    return add(ah, al, nh, nl)
+
+
+def eq(ah, al, bh, bl):
+    return (ah == bh) & (al == bl)
+
+
+def lt(ah, al, bh, bl):
+    """Signed 128-bit less-than: high limb signed, low limb unsigned."""
+    return (ah < bh) | ((ah == bh) & (_u(al) < _u(bl)))
+
+
+def mul_u32(h, l, c: int):
+    """Multiply by a non-negative python int < 2**31 (schoolbook on
+    32-bit halves of the low limb; the high limb wraps like the
+    reference's overflow-unchecked fast path)."""
+    assert 0 <= c < (1 << 31), c
+    cu = np.uint64(c)
+    lu = _u(l)
+    lo32 = lu & _MASK32
+    hi32 = lu >> np.uint64(32)
+    p_lo = lo32 * cu  # < 2^63
+    p_hi = hi32 * cu  # < 2^63
+    low = p_lo + ((p_hi & _MASK32) << np.uint64(32))  # may wrap once
+    carry = (low < p_lo).astype(jnp.int64)
+    new_l = low.astype(jnp.int64)
+    new_h = h * jnp.int64(c) + (p_hi >> np.uint64(32)).astype(
+        jnp.int64
+    ) + carry
+    return new_h, new_l
+
+
+def mul_pow10(h, l, k: int):
+    """Multiply by 10**k (k >= 0) in <=2^31 steps — the decimal rescale
+    primitive."""
+    while k > 0:
+        step = min(k, 9)  # 10^9 < 2^31
+        h, l = mul_u32(h, l, 10 ** step)
+        k -= step
+    return h, l
+
+
+def to_f64(h, l):
+    """Approximate float64 value (for casts to DOUBLE)."""
+    return h.astype(jnp.float64) * jnp.float64(2.0 ** 64) + _u(l).astype(
+        jnp.float64
+    )

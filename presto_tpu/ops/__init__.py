@@ -8,7 +8,11 @@ adjacent operators; the fragment is the compilation unit (SURVEY.md §7
 "Design stance").
 """
 
-from presto_tpu.ops.filter_project import filter_project, project  # noqa: F401
+from presto_tpu.ops.filter_project import (  # noqa: F401
+    filter_project,
+    project,
+    unnest,
+)
 from presto_tpu.ops.aggregation import AggCall, hash_aggregate  # noqa: F401
 from presto_tpu.ops.join import hash_join, pack_keys  # noqa: F401
 from presto_tpu.ops.sort import SortKey, distinct, limit, order_by  # noqa: F401

@@ -24,6 +24,13 @@ def orderable_i64(data: jnp.ndarray, dtype: T.DataType) -> jnp.ndarray:
     - floats: sign-magnitude bit trick (IEEE754 totally ordered for
       non-NaN; NaN sorts last as in the reference's ORDER BY)
     """
+    if dtype.is_long_decimal:
+        # kernel-level backstop for the planner gates: (cap, 2) limb
+        # pairs do not fit a single orderable int64
+        raise NotImplementedError(
+            "long decimals (p>18) as sort/group/join/distinct keys are "
+            "a documented deviation — cast to decimal(18,s) or double"
+        )
     if dtype.name in ("double", "real"):
         f = jnp.asarray(data, jnp.float64)
         f = jnp.where(f == 0, 0.0, f)  # -0.0 and +0.0 are SQL-equal

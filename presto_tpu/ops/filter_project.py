@@ -35,7 +35,7 @@ def project(
     names, blocks = [], []
     for name, expr in projections:
         data, valid = lowerer.eval(expr)
-        data = jnp.broadcast_to(data, (page.capacity,))
+        data = jnp.broadcast_to(data, _col_shape(page, expr))
         if valid is not None:
             valid = jnp.broadcast_to(valid, (page.capacity,))
         blocks.append(
@@ -56,6 +56,153 @@ def project(
         num_valid=page.num_valid,
         names=tuple(names),
         live=page.live,
+    )
+
+
+def _col_shape(page: Page, expr: Expr):
+    """Column data shape: long decimals carry (capacity, 2) limb pairs."""
+    if expr.dtype.is_long_decimal:
+        return (page.capacity, 2)
+    return (page.capacity,)
+
+
+def unnest(
+    page: Page,
+    elements: Sequence[Expr],
+    out_name: str,
+    out_type,
+    ordinality_name: Optional[str] = None,
+) -> Page:
+    """CROSS JOIN UNNEST(ARRAY[e1..ek]) — static-width row expansion
+    (reference: UnnestOperator; see plan.nodes.UnnestNode).
+
+    Every input row yields exactly k = len(elements) output rows, so the
+    output capacity is a static ``capacity * k`` and the whole expansion
+    is repeat/stack/reshape — no dynamic shapes for XLA. Row i expands
+    to positions [i*k, (i+1)*k): parent columns repeat, the unnest
+    column interleaves the k element expressions, ordinality tiles
+    1..k. Liveness: an output row is live iff its parent row is
+    (Presto emits NULL elements as rows; arrays here are never NULL)."""
+    import numpy as np
+
+    from presto_tpu.page import Dictionary
+
+    from presto_tpu.expr import Literal
+
+    k = len(elements)
+    cap = page.capacity
+    lowerer = ExprLowerer(page)
+    datas, valids, dicts = [], [], []
+    for el in elements:
+        if out_type.is_string and isinstance(el, Literal):
+            # bare string literal: no dictionary context exists in the
+            # page, so synthesize a one-entry dictionary (or all-NULL)
+            if el.value is None:
+                datas.append(jnp.zeros((cap,), jnp.int32))
+                valids.append(jnp.zeros((cap,), bool))
+                dicts.append(None)
+            else:
+                datas.append(jnp.zeros((cap,), jnp.int32))
+                valids.append(None)
+                dicts.append(
+                    Dictionary(np.asarray([el.value], dtype=object))
+                )
+            continue
+        d, v = lowerer.eval(el)
+        datas.append(
+            jnp.broadcast_to(
+                d, (cap, 2) if out_type.is_long_decimal else (cap,)
+            )
+        )
+        valids.append(
+            None if v is None else jnp.broadcast_to(v, (cap,))
+        )
+        dicts.append(
+            lowerer.dictionary_of(el) if out_type.is_string else None
+        )
+
+    out_dict = None
+    if out_type.is_string:
+        # union the per-element dictionaries host-side (static pytree
+        # metadata) and remap each element's ids through a device LUT
+        values = np.unique(
+            np.concatenate(
+                [
+                    np.asarray(d.values, dtype=object)
+                    if d is not None and len(d.values)
+                    else np.empty(0, dtype=object)
+                    for d in dicts
+                ]
+            ).astype(str)
+        )
+        out_dict = Dictionary(values.astype(object))
+        remapped = []
+        for d, ids in zip(dicts, datas):
+            if d is None or len(d.values) == 0:
+                remapped.append(jnp.zeros((cap,), ids.dtype))
+                continue
+            lut = jnp.asarray(
+                np.searchsorted(
+                    values, np.asarray(d.values).astype(str)
+                ).astype(np.int32)
+            )
+            remapped.append(lut[jnp.clip(ids, 0, len(d.values) - 1)])
+        datas = remapped
+
+    def expand(x):
+        # axis=0: repeat ROWS (long-decimal blocks are (cap, 2) limb
+        # pairs; default axis=None would flatten and interleave limbs)
+        return jnp.repeat(x, k, axis=0, total_repeat_length=cap * k)
+
+    blocks = []
+    names = []
+    for name, blk in zip(page.names, page.blocks):
+        blocks.append(
+            Block(
+                data=expand(blk.data),
+                valid=None if blk.valid is None else expand(blk.valid),
+                dtype=blk.dtype,
+                dictionary=blk.dictionary,
+            )
+        )
+        names.append(name)
+    # interleave the k element columns: stack -> (cap, k, ...) ->
+    # (cap*k, ...) — trailing dims carry long-decimal limb pairs
+    tail = datas[0].shape[1:]
+    el_data = jnp.stack(datas, axis=1).reshape((cap * k,) + tail)
+    if any(v is not None for v in valids):
+        el_valid = jnp.stack(
+            [
+                jnp.ones((cap,), bool) if v is None else v
+                for v in valids
+            ],
+            axis=1,
+        ).reshape(cap * k)
+    else:
+        el_valid = None
+    blocks.append(
+        Block(
+            data=el_data, valid=el_valid, dtype=out_type,
+            dictionary=out_dict,
+        )
+    )
+    names.append(out_name)
+    if ordinality_name is not None:
+        blocks.append(
+            Block(
+                data=jnp.tile(
+                    jnp.arange(1, k + 1, dtype=jnp.int64), cap
+                ),
+                valid=None,
+                dtype=T.BIGINT,
+            )
+        )
+        names.append(ordinality_name)
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=(page.num_valid * k).astype(jnp.int32),
+        names=tuple(names),
+        live=expand(page.row_mask()),
     )
 
 
@@ -97,7 +244,7 @@ def filter_project(
     names, blocks = [], []
     for name, expr in projections:
         data, valid = lowerer.eval(expr)
-        data = jnp.broadcast_to(data, (page.capacity,))[sel]
+        data = jnp.broadcast_to(data, _col_shape(page, expr))[sel]
         if valid is not None:
             valid = jnp.broadcast_to(valid, (page.capacity,))[sel]
         blocks.append(

@@ -183,17 +183,24 @@ class Block:
             import decimal as _dec
 
             q = _dec.Decimal(1).scaleb(-dtype.scale)
-            filled = [
-                0
-                if v is None
-                else int(
-                    _dec.Decimal(str(v)).quantize(
-                        q, rounding=_dec.ROUND_HALF_UP
-                    ).scaleb(dtype.scale)
-                )
-                for v in values
-            ]
-            arr = np.asarray(filled, dtype=np.int64)
+            # default context precision (28) is too small for long
+            # decimals: quantize at int128 width
+            with _dec.localcontext() as ctx:
+                ctx.prec = 50
+                filled = [
+                    0
+                    if v is None
+                    else int(
+                        _dec.Decimal(str(v)).quantize(
+                            q, rounding=_dec.ROUND_HALF_UP
+                        ).scaleb(dtype.scale)
+                    )
+                    for v in values
+                ]
+            if dtype.is_long_decimal:
+                arr = T.int128_limbs(filled)  # (n, 2) limb pairs
+            else:
+                arr = np.asarray(filled, dtype=np.int64)
         else:
             filled = [0 if v is None else v for v in values]
             arr = np.asarray(filled).astype(dtype.np_dtype)
@@ -324,6 +331,17 @@ class Page:
                 t = blk.dtype
                 if t.is_string:
                     col.append(str(blk.dictionary.values[int(v)]))
+                elif t.is_long_decimal:
+                    # exact: int/10**s would lose precision past 2^53,
+                    # and the default context (prec 28) rounds scaleb
+                    import decimal as _dec
+
+                    unscaled = T.int128_value(int(v[0]), int(v[1]))
+                    with _dec.localcontext() as ctx:
+                        ctx.prec = 50
+                        col.append(
+                            _dec.Decimal(unscaled).scaleb(-t.scale)
+                        )
                 elif t.is_decimal:
                     col.append(int(v) / (10 ** t.scale))
                 elif t.name == "date":

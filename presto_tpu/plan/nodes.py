@@ -189,6 +189,32 @@ class WindowNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class UnnestNode(PlanNode):
+    """CROSS JOIN UNNEST(ARRAY[e1..ek]) AS a(col [, ord]) — reference:
+    UnnestNode (presto-main logical plan). The engine keeps arrays as
+    trace-time expression lists, so unnest is a static-width row
+    expansion: every input row yields exactly k output rows (capacity
+    x k, shapes static for XLA), with the unnest column interleaved
+    from the k element expressions."""
+
+    source: PlanNode
+    elements: Tuple[Expr, ...]  # all pre-coerced to out_type
+    out_name: str
+    out_type: T.DataType
+    ordinality_name: Optional[str] = None
+
+    def output_schema(self):
+        out = dict(self.source.output_schema())
+        out[self.out_name] = self.out_type
+        if self.ordinality_name is not None:
+            out[self.ordinality_name] = T.BIGINT
+        return out
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class RemoteSourceNode(PlanNode):
     """Fragment boundary: reads the gathered output of a distributed
     fragment (reference: RemoteSourceNode reading an upstream stage

@@ -161,6 +161,8 @@ def estimate_rows(node: N.PlanNode, catalogs) -> float:
         return min(src, node.limit) if node.limit else src
     if isinstance(node, N.LimitNode):
         return min(estimate_rows(node.source, catalogs), node.count)
+    if isinstance(node, N.UnnestNode):
+        return estimate_rows(node.source, catalogs) * len(node.elements)
     if isinstance(node, N.JoinNode):
         probe = estimate_rows(node.left, catalogs)
         if node.join_type in ("semi", "anti"):
@@ -360,6 +362,13 @@ def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
             if c.arg is not None:
                 _expr_columns(c.arg, need)
         # window preserves all source columns; required source cols only
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, need)
+        )
+    if isinstance(node, N.UnnestNode):
+        need = set(required) - {node.out_name, node.ordinality_name}
+        for e in node.elements:
+            _expr_columns(e, need)
         return dataclasses.replace(
             node, source=prune_columns(node.source, need)
         )

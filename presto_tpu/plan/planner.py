@@ -240,6 +240,12 @@ class _Planner:
                     nm = self._fresh("sort")
                     order_extra.append((nm, key_expr))
                     k = E.ColumnRef(nm, key_expr.dtype)
+                if k.dtype.is_long_decimal:
+                    raise PlanningError(
+                        "ORDER BY a long decimal is not supported "
+                        "(documented deviation; cast to decimal(18,s) "
+                        "or double to sort)"
+                    )
                 sort_keys.append(
                     SortKey(k, si.descending, si.nulls_first)
                 )
@@ -307,6 +313,8 @@ class _Planner:
 
         outer_joins: List[ast.JoinRel] = []
 
+        pending_unnests: List[ast.UnnestRef] = []
+
         def flatten2(rel):
             if isinstance(rel, ast.JoinRel) and rel.join_type in (
                 "cross",
@@ -322,11 +330,20 @@ class _Planner:
                 node, scope = self._plan_outer_join(rel, outer)
                 rels.append((node, scope))
                 return
+            if isinstance(rel, ast.UnnestRef):
+                # lateral: element exprs reference sibling relations, so
+                # unnests apply after the join graph is assembled
+                pending_unnests.append(rel)
+                return
             node, scope = self._plan_relation(rel, outer)
             rels.append((node, scope))
 
         self._pending_conjuncts: List[ast.Node] = []
         flatten2(from_)
+
+        if not rels:
+            # FROM unnest(...) with no other relation
+            rels = [(N.ValuesNode(), Scope({}, {}, outer))]
 
         rels = self._rename_clashes(rels)
         scope = rels[0][1]
@@ -338,12 +355,66 @@ class _Planner:
             node = rels[0][0]
         else:
             node = self._join_graph(rels, scope)
-        # ON conjuncts of flattened inner joins -> WHERE-style application
+        # ON conjuncts of flattened inner joins -> WHERE-style
+        # application. Applied BEFORE unnests: ON clauses cannot
+        # reference unnest columns (unnest joins are CROSS), and the
+        # join pool must see its edges before any unnest caps it.
         pending = self._pending_conjuncts
         self._pending_conjuncts = []
         for c in pending:
             node, scope = self._apply_where(node, scope, c)
+        for u in pending_unnests:
+            node, scope = self._apply_unnest(node, scope, u)
         return node, scope
+
+    def _apply_unnest(self, node, scope: Scope, u: ast.UnnestRef):
+        """CROSS JOIN UNNEST(ARRAY[...]) — static-width row expansion
+        (see N.UnnestNode). Arrays exist at trace time as expression
+        lists, so only the ARRAY[...] constructor form is supported
+        (documented deviation: no physical array columns)."""
+        if not isinstance(u.array, ast.ArrayLit):
+            raise PlanningError(
+                "UNNEST supports ARRAY[...] constructors only (arrays "
+                "are trace-time expression lists in this engine)"
+            )
+        if not u.array.items:
+            raise PlanningError("UNNEST of empty ARRAY[] is not supported")
+        if isinstance(node, _PendingJoin):
+            node = self._finalize_pool(node, scope)
+        els = [self._lower(it, scope) for it in u.array.items]
+        ct = els[0].dtype
+        for el in els[1:]:
+            ct = T.common_super_type(ct, el.dtype)
+        els = [
+            el if el.dtype == ct else E.Cast(el, ct) for el in els
+        ]
+        cols = dict(scope.columns)
+        out_internal = (
+            u.column if u.column not in cols else self._fresh(u.column)
+        )
+        cols[out_internal] = ct
+        qual = {u.column: out_internal}
+        ord_internal = None
+        if u.ordinality is not None:
+            ord_internal = (
+                u.ordinality
+                if u.ordinality not in cols
+                else self._fresh(u.ordinality)
+            )
+            cols[ord_internal] = T.BIGINT
+            qual[u.ordinality] = ord_internal
+        node = N.UnnestNode(
+            source=node,
+            elements=tuple(els),
+            out_name=out_internal,
+            out_type=ct,
+            ordinality_name=ord_internal,
+        )
+        quals = {
+            k: dict(v) for k, v in scope.qualifiers.items()
+        }
+        quals[u.alias] = qual
+        return node, Scope(cols, quals, scope.parent)
 
     def _rename_clashes(self, rels):
         """Self-joined relations expose the same internal column names;
@@ -1119,6 +1190,12 @@ class _Planner:
         group_keys: List[Tuple[str, E.Expr]] = []
         for g in sel.group_by:
             e = self._lower(g, scope)
+            if e.dtype.is_long_decimal:
+                raise PlanningError(
+                    "GROUP BY a long decimal is not supported "
+                    "(documented deviation; cast to decimal(18,s) "
+                    "or varchar to group)"
+                )
             if isinstance(e, E.ColumnRef):
                 group_keys.append((e.name, e))
             else:
@@ -1222,6 +1299,14 @@ class _Planner:
                 aggs.append(AggCall("count_star", None, out_name))
             else:
                 arg = self._lower(a.args[0], scope)
+                if arg.dtype.is_long_decimal and a.name != "count":
+                    raise PlanningError(
+                        f"{a.name}() over {arg.dtype} is not supported: "
+                        "long-decimal accumulators are a documented "
+                        "deviation (no benchmark config aggregates "
+                        ">18-digit decimals) — cast to decimal(18,s) "
+                        "or double to aggregate"
+                    )
                 aggs.append(
                     AggCall(alias.get(a.name, a.name), arg, out_name)
                 )
@@ -1521,8 +1606,77 @@ class _Planner:
             ):
                 fname = "ceil" if e.name == "ceiling" else e.name
                 return E.MathFunc(fname, lower(e.args[0]))
+            if e.name in ("cardinality", "element_at", "contains"):
+                return self._lower_array_func(e, lower)
             raise PlanningError(f"unknown function: {e.name}")
+        if isinstance(e, ast.ArrayLit):
+            raise PlanningError(
+                "ARRAY[...] is supported under UNNEST, cardinality, "
+                "element_at, contains, and the [] subscript (arrays are "
+                "trace-time expression lists; no physical array columns)"
+            )
         raise PlanningError(f"cannot lower {type(e).__name__}")
+
+    def _lower_array_func(self, e: ast.FuncCall, lower):
+        """Array functions over ARRAY[...] constructors. Arrays are
+        trace-time expression lists (see N.UnnestNode), so these fold
+        into ordinary scalar expressions:
+          cardinality(ARRAY[..k..])      -> literal k
+          element_at(arr, i) / arr[i]    -> the i-th element (literal i)
+                                            or a CASE chain (column i);
+                                            out-of-range -> NULL (Presto
+                                            element_at semantics)
+          contains(arr, x)               -> OR of equality comparisons
+                                            (3VL OR gives Presto's
+                                            true/NULL/false behavior)
+        """
+        if not e.args or not isinstance(e.args[0], ast.ArrayLit):
+            raise PlanningError(
+                f"{e.name}() requires an ARRAY[...] constructor argument"
+            )
+        items = e.args[0].items
+        if e.name == "cardinality":
+            if len(e.args) != 1:
+                raise PlanningError("cardinality() takes one argument")
+            return E.Literal(len(items), T.BIGINT)
+        if not items:
+            raise PlanningError(f"{e.name}() over empty ARRAY[]")
+        els = [lower(it) for it in items]
+        ct = els[0].dtype
+        for el in els[1:]:
+            ct = T.common_super_type(ct, el.dtype)
+        els = [el if el.dtype == ct else E.Cast(el, ct) for el in els]
+        if len(e.args) != 2:
+            raise PlanningError(f"{e.name}() takes two arguments")
+        arg = lower(e.args[1])
+        if e.name == "element_at":
+            k = len(els)
+            if isinstance(arg, E.Literal):
+                i = int(arg.value) if arg.value is not None else 0
+                if 1 <= i <= k:
+                    return els[i - 1]
+                if -k <= i <= -1:  # Presto: negative = from the end
+                    return els[k + i]
+                return E.Literal(None, ct)  # out of range -> NULL
+            whens = tuple(
+                (
+                    E.Compare("=", arg, E.Literal(i + 1, T.BIGINT)),
+                    el,
+                )
+                for i, el in enumerate(els)
+            ) + tuple(
+                (
+                    E.Compare("=", arg, E.Literal(i - k, T.BIGINT)),
+                    el,
+                )
+                for i, el in enumerate(els)
+            )
+            return E.Case(whens, E.Literal(None, ct), ct)
+        # contains(arr, x): 3VL OR over equality with each element
+        if not arg.dtype.is_string and arg.dtype != ct:
+            arg = E.Cast(arg, ct)
+        cmps = tuple(E.Compare("=", arg, el) for el in els)
+        return cmps[0] if len(cmps) == 1 else E.Or(cmps)
 
     def _date_interval(self, date_expr, iv: ast.IntervalLit, op, flip):
         if flip and op == "-":

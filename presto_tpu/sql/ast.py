@@ -179,6 +179,24 @@ class JoinRel(Node):
     on: Optional[Node] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayLit(Node):
+    """ARRAY[e1, ..., ek] constructor (plan-time list; the engine keeps
+    arrays as trace-time expression lists — see planner UNNEST rewrite)."""
+
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class UnnestRef(Node):
+    """UNNEST(arr) [WITH ORDINALITY] AS alias (col [, ord]) in FROM."""
+
+    array: Node
+    alias: str
+    column: str
+    ordinality: Optional[str] = None  # ordinality column name
+
+
 # ------------------------------------------------------------ statements
 
 

@@ -301,6 +301,44 @@ class _Parser:
             rel = ast.JoinRel(rel, right, jt, on)
 
     def _primary_relation(self) -> ast.Node:
+        # UNNEST(arr) [WITH ORDINALITY] AS alias (col [, ord])
+        t = self.cur
+        if (
+            t.kind == "ident"
+            and t.value.lower() == "unnest"
+            and self.tokens[self.pos + 1].kind == "op"
+            and self.tokens[self.pos + 1].value == "("
+        ):
+            self.advance()
+            self.advance()
+            arr = self.parse_expr()
+            self.expect_op(")")
+            ordinality = False
+            if self.peek_kw("with"):
+                self.advance()
+                w = self.expect_ident()
+                if w != "ordinality":
+                    raise ParseError(
+                        f"expected ORDINALITY after WITH, got {w!r}"
+                    )
+                ordinality = True
+            self.accept_kw("as")
+            alias = self.expect_ident()
+            self.expect_op("(")
+            col = self.expect_ident()
+            ordname = None
+            if self.accept_op(","):
+                ordname = self.expect_ident()
+            self.expect_op(")")
+            if ordinality and ordname is None:
+                raise ParseError(
+                    "WITH ORDINALITY requires two column aliases"
+                )
+            if not ordinality and ordname is not None:
+                raise ParseError(
+                    "second column alias requires WITH ORDINALITY"
+                )
+            return ast.UnnestRef(arr, alias, col, ordname)
         if self.accept_op("("):
             q = self.parse_select()
             self.expect_op(")")
@@ -408,12 +446,22 @@ class _Parser:
                 return left
             left = ast.BinaryOp(op, left, self._unary())
 
+    def _postfix(self) -> ast.Node:
+        """Primary expression plus subscript chains: ``arr[i]`` is
+        sugar for ``element_at(arr, i)`` (Presto's subscript operator)."""
+        e = self._primary()
+        while self.accept_op("["):
+            idx = self.parse_expr()
+            self.expect_op("]")
+            e = ast.FuncCall("element_at", (e, idx))
+        return e
+
     def _unary(self) -> ast.Node:
         if self.accept_op("-"):
             return ast.UnaryOp("-", self._unary())
         if self.accept_op("+"):
             return self._unary()
-        return self._primary()
+        return self._postfix()
 
     def _primary(self) -> ast.Node:
         t = self.cur
@@ -507,6 +555,23 @@ class _Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        # ARRAY[e1, ..., ek] constructor ("array" stays a soft keyword:
+        # only the bracket form is special)
+        if (
+            t.kind == "ident"
+            and t.value.lower() == "array"
+            and self.tokens[self.pos + 1].kind == "op"
+            and self.tokens[self.pos + 1].value == "["
+        ):
+            self.advance()
+            self.advance()
+            items: List[ast.Node] = []
+            if not self.peek_op("]"):
+                items.append(self.parse_expr())
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+            self.expect_op("]")
+            return ast.ArrayLit(tuple(items))
         # identifier / function call / qualified name
         if t.kind == "ident" or (
             t.kind == "kw"

@@ -33,7 +33,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+\.\d*(e[+-]?\d+)?|\.\d+(e[+-]?\d+)?|\d+(e[+-]?\d+)?)
   | (?P<ident>[a-zA-Z_][a-zA-Z0-9_]*|"[^"]*")
   | (?P<string>'(?:[^']|'')*')
-  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.;<>=])
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.;<>=\[\]])
     """,
     re.VERBOSE | re.IGNORECASE | re.DOTALL,
 )

@@ -65,6 +65,10 @@ class DataType:
     def is_decimal(self) -> bool:
         return False
 
+    @property
+    def is_long_decimal(self) -> bool:
+        return False
+
     def __str__(self) -> str:
         return self.name
 
@@ -182,9 +186,9 @@ class DecimalType(DataType):
             self, "name", f"decimal({self.precision},{self.scale})"
         )
         if self.precision > 18:
-            raise NotImplementedError(
-                "long decimal (p>18) lands with int128 emulation; "
-                "TPC-H needs p<=15"
+            raise ValueError(
+                "DecimalType is the short-decimal (p<=18) path; use "
+                "T.decimal(), which routes p>18 to LongDecimalType"
             )
 
     @property
@@ -197,6 +201,58 @@ class DecimalType(DataType):
 
     @property
     def is_decimal(self):
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class LongDecimalType(DataType):
+    """decimal(19..38, s): emulated int128 (reference parity:
+    presto-common long DecimalType, Int128-backed).
+
+    Physical layout: the block's data array has shape ``(capacity, 2)``
+    int64 — column 0 the signed high limb, column 1 the low 64 bits
+    (unsigned, stored in an int64 bit pattern); value = hi*2^64 + lo.
+    All limb arithmetic lives in ``presto_tpu.int128`` and runs inside
+    jit (static shapes, pure int64/uint64 ops — nothing the MXU/VPU
+    can't chew).
+
+    Supported surface this round: scans (parquet/ORC/memory/pylist),
+    comparisons, +/-/negate, casts (short<->long, ->double, ->bigint),
+    projection and exact host materialization (``decimal.Decimal``).
+    Documented deviation: long decimals as GROUP BY / join / sort keys
+    and as aggregate inputs raise PlanningError — cast to
+    decimal(18,s) or double to aggregate (no benchmark config needs a
+    >18-digit key; see COMPONENTS.md type-system row).
+    """
+
+    precision: int = 38
+    scale: int = 0
+    name: str = "decimal"
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "name", f"decimal({self.precision},{self.scale})"
+        )
+        if not (18 < self.precision <= 38):
+            raise ValueError(
+                f"LongDecimalType precision must be in 19..38, got "
+                f"{self.precision}"
+            )
+
+    @property
+    def jnp_dtype(self):
+        return jnp.int64
+
+    @property
+    def is_numeric(self):
+        return True
+
+    @property
+    def is_decimal(self):
+        return True
+
+    @property
+    def is_long_decimal(self):
         return True
 
 
@@ -237,8 +293,33 @@ TIMESTAMP = TimestampType()
 VARCHAR = VarcharType()
 
 
-def decimal(precision: int, scale: int) -> DecimalType:
+def decimal(precision: int, scale: int) -> DataType:
+    """decimal(p,s) — int64-backed for p<=18, int128 limb pair beyond."""
+    if precision > 18:
+        return LongDecimalType(precision=precision, scale=scale)
     return DecimalType(precision=precision, scale=scale)
+
+
+def long_decimal(precision: int, scale: int) -> LongDecimalType:
+    return LongDecimalType(precision=precision, scale=scale)
+
+
+def int128_limbs(unscaled) -> np.ndarray:
+    """Python ints -> (n, 2) int64 limb array [hi, lo] (lo = low 64
+    bits as an int64 bit pattern)."""
+    vals = [int(v) for v in unscaled]
+    lo = np.asarray(
+        [(v & 0xFFFFFFFFFFFFFFFF) - (1 << 64) if (v & (1 << 63)) else
+         (v & 0xFFFFFFFFFFFFFFFF) for v in vals],
+        dtype=np.int64,
+    )
+    hi = np.asarray([v >> 64 for v in vals], dtype=np.int64)
+    return np.stack([hi, lo], axis=1)
+
+
+def int128_value(hi: int, lo: int) -> int:
+    """Limb pair -> python int (lo re-read as unsigned)."""
+    return (int(hi) << 64) + (int(lo) & 0xFFFFFFFFFFFFFFFF)
 
 
 def varchar(length: Optional[int] = None) -> VarcharType:
@@ -287,18 +368,15 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
     if a.is_decimal and b.is_decimal:
         scale = max(a.scale, b.scale)
         intd = max(a.precision - a.scale, b.precision - b.scale)
-        if intd + scale > 18:
-            raise NotImplementedError(
-                f"decimal merge of {a} and {b} needs precision "
-                f"{intd + scale} > 18 (int128 emulation not yet built)"
-            )
-        return decimal(intd + scale, scale)
+        # p>18 routes to LongDecimalType via decimal(); cap at the
+        # int128 ceiling like the reference caps at 38
+        return decimal(min(intd + scale, 38), scale)
     if a.is_decimal and b.is_integer:
-        # widen integer digits to the int64 ceiling; precision is
-        # capacity-advisory (all short-decimal arithmetic runs on int64)
-        return decimal(18, a.scale)
+        # widen integer digits to the representation ceiling; precision
+        # is capacity-advisory (arithmetic runs on int64 / int128 limbs)
+        return decimal(38 if a.is_long_decimal else 18, a.scale)
     if b.is_decimal and a.is_integer:
-        return decimal(18, b.scale)
+        return decimal(38 if b.is_long_decimal else 18, b.scale)
     if a.is_decimal and b.name == "double":
         return DOUBLE
     if b.is_decimal and a.name == "double":

@@ -1,0 +1,263 @@
+"""Long decimal (p>18): int128 limb-pair representation
+(types.LongDecimalType, presto_tpu.int128). Exactness is asserted
+against Python's arbitrary-precision ints/Decimals — sqlite cannot hold
+int128, so the oracle here is the host language itself."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.page import Page
+from presto_tpu.plan.planner import PlanningError
+
+
+def test_decimal_factory_routes_long():
+    t = T.decimal(25, 2)
+    assert t.is_long_decimal and t.is_decimal and t.precision == 25
+    s = T.decimal(18, 2)
+    assert not s.is_long_decimal
+    assert T.parse_type("decimal(30,4)").is_long_decimal
+
+
+def test_int128_limbs_roundtrip():
+    vals = [
+        0, 1, -1, (1 << 64), -(1 << 64), (1 << 100) + 12345,
+        -(1 << 100) - 999, (1 << 126), -(1 << 126),
+        12345678901234567890123456789,
+    ]
+    limbs = T.int128_limbs(vals)
+    assert limbs.shape == (len(vals), 2)
+    back = [T.int128_value(h, l) for h, l in limbs]
+    assert back == vals
+
+
+def test_int128_device_ops_match_python():
+    from presto_tpu import int128
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    a = [int(x) for x in rng.randint(-(1 << 62), 1 << 62, 40)]
+    b = [int(x) for x in rng.randint(-(1 << 62), 1 << 62, 40)]
+    # spread across the 128-bit range
+    a = [x * ((1 << 50) + 7) for x in a]
+    b = [x * ((1 << 33) + 11) for x in b]
+    la, lb = T.int128_limbs(a), T.int128_limbs(b)
+    ah, al = jnp.asarray(la[:, 0]), jnp.asarray(la[:, 1])
+    bh, bl = jnp.asarray(lb[:, 0]), jnp.asarray(lb[:, 1])
+    sh, sl = int128.add(ah, al, bh, bl)
+    assert [
+        T.int128_value(int(h), int(l)) for h, l in zip(sh, sl)
+    ] == [x + y for x, y in zip(a, b)]
+    dh, dl = int128.sub(ah, al, bh, bl)
+    assert [
+        T.int128_value(int(h), int(l)) for h, l in zip(dh, dl)
+    ] == [x - y for x, y in zip(a, b)]
+    nh, nl = int128.neg(ah, al)
+    assert [
+        T.int128_value(int(h), int(l)) for h, l in zip(nh, nl)
+    ] == [-x for x in a]
+    assert list(map(bool, int128.lt(ah, al, bh, bl))) == [
+        x < y for x, y in zip(a, b)
+    ]
+    # a <= ~2^112; x4 decimal digits stays inside int128
+    mh, ml = int128.mul_pow10(ah, al, 4)
+    assert [
+        T.int128_value(int(h), int(l)) for h, l in zip(mh, ml)
+    ] == [x * 10 ** 4 for x in a]
+
+
+def test_page_roundtrip_exact():
+    t = T.decimal(30, 2)
+    vals = [
+        decimal.Decimal("123456789012345678901234567.89"),
+        decimal.Decimal("-99999999999999999999.99"),
+        None,
+        decimal.Decimal("0.01"),
+    ]
+    p = Page.from_pydict({"x": vals}, {"x": t}, capacity=8)
+    out = [r["x"] for r in p.to_pylist()]
+    assert out == vals
+
+
+@pytest.fixture(scope="module")
+def lake(tmp_path_factory):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    root = tmp_path_factory.mktemp("ldlake")
+    (root / "s").mkdir()
+    n = 3000
+    rng = np.random.RandomState(17)
+    # values straddling the int64 boundary: |v| up to ~10^27
+    base = rng.randint(-(1 << 62), 1 << 62, n)
+    # |unscaled| < 2^62 * 2^33 = 2^95 ~ 4e28, inside decimal(30)
+    mult = rng.choice([1, 1 << 20, (1 << 33) + 3], n)
+    unscaled = [int(x) * int(m) for x, m in zip(base, mult)]
+    vals = [decimal.Decimal(u).scaleb(-3) for u in unscaled]
+    table = pa.table(
+        {
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "amt": pa.array(vals, type=pa.decimal128(30, 3)),
+        }
+    )
+    pq.write_table(table, root / "s" / "t.parquet")
+    return root, vals
+
+
+@pytest.fixture(scope="module")
+def runner(lake):
+    from presto_tpu.connectors import create_connector
+    from presto_tpu.exec.staging import CatalogManager
+
+    root, _ = lake
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    catalogs.register("lake", create_connector("parquet", root=str(root)))
+    return LocalQueryRunner(catalogs=catalogs)
+
+
+def test_scan_count_and_filter(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select count(*) as n from lake.s.t where amt > 0"
+    ).rows()
+    assert rows == [(sum(1 for v in vals if v > 0),)]
+    # comparison against a >int64 decimal literal
+    big = decimal.Decimal(1 << 70).scaleb(-3)
+    rows = runner.execute(
+        "select count(*) as n from lake.s.t "
+        f"where amt > {big}"
+    ).rows()
+    assert rows == [(sum(1 for v in vals if v > big),)]
+
+
+def test_projection_exact_roundtrip(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select id, amt from lake.s.t where id < 50"
+    ).rows()
+    got = {i: a for i, a in rows}
+    for i in range(50):
+        assert got[i] == vals[i], i
+
+
+def test_arithmetic_exact(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select id, amt + amt as dbl, amt - amt as zero, -amt as neg "
+        "from lake.s.t where id < 20"
+    ).rows()
+    for i, dbl, zero, neg in rows:
+        assert dbl == vals[i] * 2
+        assert zero == 0
+        assert neg == -vals[i]
+
+
+def test_literal_arithmetic_exact(runner):
+    rows = runner.execute(
+        "select 12345678901234567890.12 + 98765432109876543210.88 as s"
+    ).rows()
+    assert rows[0][0] == decimal.Decimal("111111111011111111101.00")
+
+
+def test_cast_to_double_approx(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select id, cast(amt as double) as d from lake.s.t where id < 10"
+    ).rows()
+    for i, d in rows:
+        expect = float(vals[i])
+        assert d == pytest.approx(expect, rel=1e-12)
+
+
+def test_cast_short_to_long_and_back(runner):
+    rows = runner.execute(
+        "select cast(cast(12345.67 as decimal(30,4)) as double) as d"
+    ).rows()
+    assert rows[0][0] == pytest.approx(12345.67)
+
+
+def test_documented_gates(runner):
+    for sql, frag in [
+        ("select sum(amt) from lake.s.t", "long-decimal"),
+        ("select amt from lake.s.t group by amt", "GROUP BY a long"),
+        ("select amt from lake.s.t order by amt", "ORDER BY a long"),
+    ]:
+        with pytest.raises(Exception) as ei:
+            runner.execute(sql).rows()
+        assert "long" in str(ei.value).lower(), sql
+
+
+def test_long_plus_double_is_double(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select id, amt + 0.5e0 as s from lake.s.t where id < 5"
+    ).rows()
+    for i, s in rows:
+        assert s == pytest.approx(float(vals[i]) + 0.5, rel=1e-12)
+
+
+def test_case_over_long_decimal(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select id, case when id < 2 then amt else -amt end as v "
+        "from lake.s.t where id < 4"
+    ).rows()
+    for i, v in rows:
+        assert v == (vals[i] if i < 2 else -vals[i])
+
+
+def test_unnest_page_with_long_decimal_column(runner, lake):
+    """Row expansion must repeat (cap, 2) limb blocks row-wise."""
+    _, vals = lake
+    rows = runner.execute(
+        "select id, amt, m from lake.s.t "
+        "cross join unnest(array[1, 2]) as u(m) where id < 3"
+    ).rows()
+    assert len(rows) == 6
+    for i, a, m in rows:
+        assert a == vals[i], (i, m)
+
+
+def test_join_key_gate(runner):
+    with pytest.raises(Exception) as ei:
+        runner.execute(
+            "select count(*) from lake.s.t a, lake.s.t b "
+            "where a.amt = b.amt"
+        ).rows()
+    assert "long decimal" in str(ei.value).lower() or "long-decimal" in (
+        str(ei.value).lower()
+    )
+
+
+def test_element_at_negative_index(runner):
+    rows = runner.execute(
+        "select element_at(array[10, 20, 30], -1) as a, "
+        "array[10, 20, 30][-2] as b, "
+        "element_at(array[10, 20], -5) as c"
+    ).rows()
+    assert rows == [(30, 20, None)]
+
+
+def test_element_at_negative_column_index(runner):
+    rows = runner.execute(
+        "select r_regionkey, "
+        "element_at(array[100, 200], r_regionkey - 3) as e "
+        "from tpch.tiny.region order by r_regionkey"
+    ).rows()
+    # keys 0..4 -> indices -3,-2,-1,0,1 -> NULL,100,200,NULL,100
+    assert rows == [
+        (0, None), (1, 100), (2, 200), (3, None), (4, 100),
+    ]
+
+
+def test_aggregate_after_cast_down(runner, lake):
+    """The documented workaround: cast to double to aggregate."""
+    _, vals = lake
+    rows = runner.execute(
+        "select sum(cast(amt as double)) as s from lake.s.t"
+    ).rows()
+    assert rows[0][0] == pytest.approx(float(sum(vals)), rel=1e-9)

@@ -1,0 +1,171 @@
+"""ARRAY[...] expressions + UNNEST (SURVEY.md §2.1 "Operators":
+UnnestOperator parity). Arrays are trace-time expression lists, so
+UNNEST is a static-width row expansion and the array scalar functions
+fold into ordinary expressions — every shape stays static for XLA."""
+
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def test_unnest_constants_standalone(runner):
+    rows = runner.execute(
+        "select x from unnest(array[3, 1, 2]) as t(x) order by x"
+    ).rows()
+    assert rows == [(1,), (2,), (3,)]
+
+
+def test_unnest_with_ordinality(runner):
+    rows = runner.execute(
+        "select x, n from unnest(array[30, 10, 20]) "
+        "with ordinality as t(x, n) order by n"
+    ).rows()
+    assert rows == [(30, 1), (10, 2), (20, 3)]
+
+
+def test_unnest_lateral_columns(runner):
+    """Elements referencing the left relation's columns (lateral)."""
+    rows = runner.execute(
+        "select r_regionkey, v from tpch.tiny.region "
+        "cross join unnest(array[r_regionkey, r_regionkey * 10]) as u(v) "
+        "order by r_regionkey, v"
+    ).rows()
+    expect = []
+    for k in range(5):
+        expect += [(k, k), (k, k * 10)] if k else [(0, 0), (0, 0)]
+    assert rows == sorted(expect)
+
+
+def test_unnest_aggregation_over_expanded_rows(runner):
+    """The expansion multiplies row counts exactly (5 regions x 3)."""
+    rows = runner.execute(
+        "select count(*) as n, sum(v) as s from tpch.tiny.region "
+        "cross join unnest(array[1, 2, 3]) as u(v)"
+    ).rows()
+    assert rows == [(15, 5 * 6)]
+
+
+def test_unnest_filter_on_element(runner):
+    rows = runner.execute(
+        "select r_name, v from tpch.tiny.region "
+        "cross join unnest(array[r_regionkey, 7]) as u(v) "
+        "where v > 3 order by r_name, v"
+    ).rows()
+    names = [
+        r[0]
+        for r in runner.execute(
+            "select r_name from tpch.tiny.region order by r_name"
+        ).rows()
+    ]
+    expect = sorted(
+        [(n, 7) for n in names]
+        + [(n, 4) for n in names if n == "MIDDLE EAST"]
+    )
+    assert rows == expect
+
+
+def test_unnest_string_elements_mixed_dictionaries(runner):
+    """String elements from different dictionaries (a column and a
+    literal) must land in one coherent output dictionary."""
+    rows = runner.execute(
+        "select r_regionkey, s from tpch.tiny.region "
+        "cross join unnest(array[r_name, 'zzz']) as u(s) "
+        "order by r_regionkey, s"
+    ).rows()
+    names = dict(
+        runner.execute(
+            "select r_regionkey, r_name from tpch.tiny.region"
+        ).rows()
+    )
+    expect = sorted(
+        [(k, names[k]) for k in names] + [(k, "zzz") for k in names]
+    )
+    assert rows == expect
+
+
+def test_unnest_nulls_pass_through(runner):
+    rows = runner.execute(
+        "select v from unnest(array[1, null, 3]) as t(v) "
+        "order by v nulls last"
+    ).rows()
+    assert rows == [(1,), (3,), (None,)]
+
+
+def test_cardinality(runner):
+    rows = runner.execute(
+        "select cardinality(array[1, 2, 3]) as c"
+    ).rows()
+    assert rows == [(3,)]
+
+
+def test_element_at_literal_index(runner):
+    rows = runner.execute(
+        "select element_at(array[10, 20, 30], 2) as e"
+    ).rows()
+    assert rows == [(20,)]
+
+
+def test_element_at_out_of_range_is_null(runner):
+    rows = runner.execute(
+        "select element_at(array[10, 20], 5) as e"
+    ).rows()
+    assert rows == [(None,)]
+
+
+def test_subscript_sugar(runner):
+    rows = runner.execute(
+        "select array[10, 20, 30][2] as e"
+    ).rows()
+    assert rows == [(20,)]
+
+
+def test_element_at_column_index(runner):
+    """Non-literal index lowers to a CASE chain."""
+    rows = runner.execute(
+        "select r_regionkey, "
+        "element_at(array[100, 200], r_regionkey) as e "
+        "from tpch.tiny.region order by r_regionkey"
+    ).rows()
+    assert rows == [
+        (0, None), (1, 100), (2, 200), (3, None), (4, None),
+    ]
+
+
+def test_contains(runner):
+    rows = runner.execute(
+        "select r_name from tpch.tiny.region "
+        "where contains(array[0, 2], r_regionkey) "
+        "order by r_name"
+    ).rows()
+    names = dict(
+        runner.execute(
+            "select r_regionkey, r_name from tpch.tiny.region"
+        ).rows()
+    )
+    assert rows == sorted([(names[0],), (names[2],)])
+
+
+def test_unnest_explain_shows_node(runner):
+    txt = "\n".join(
+        r[0]
+        for r in runner.execute(
+            "explain select v from unnest(array[1, 2]) as t(v)"
+        ).rows()
+    )
+    assert "Unnest[v x2]" in txt
+
+
+def test_unnest_join_then_unnest(runner):
+    """Unnest composed with a real join (explicit JOIN ... ON)."""
+    rows = runner.execute(
+        "select n_name, v from tpch.tiny.nation "
+        "join tpch.tiny.region on n_regionkey = r_regionkey "
+        "cross join unnest(array[r_regionkey]) as u(v) "
+        "where n_name = 'CANADA'"
+    ).rows()
+    assert rows == [("CANADA", 1)]
