@@ -131,30 +131,38 @@ def main() -> None:
     import __graft_entry__ as G
 
     run_all = "--all" in sys.argv
+    # --only SUBSTR: run matching extra configs in isolation (one
+    # process per heavy config — a backend crash on one config must not
+    # poison the rest of the matrix)
+    only = None
+    if "--only" in sys.argv:
+        only = sys.argv[sys.argv.index("--only") + 1]
+        run_all = True
 
     runner = LocalQueryRunner()
-    rps, _ = _bench_query(
-        runner,
-        G._Q1.replace("tiny", "sf1"),
-        _table_rows(runner, "sf1", "lineitem"),
-        expect_rows=4,
-    )
-    vs = (
-        rps / CPU_BASELINE_ROWS_PER_SEC
-        if CPU_BASELINE_ROWS_PER_SEC
-        else 1.0
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "tpch_q1_sf1_rows_per_sec",
-                "value": round(rps),
-                "unit": "rows/s",
-                "vs_baseline": round(vs, 3),
-            }
-        ),
-        flush=True,
-    )
+    if only is None:
+        rps, _ = _bench_query(
+            runner,
+            G._Q1.replace("tiny", "sf1"),
+            _table_rows(runner, "sf1", "lineitem"),
+            expect_rows=4,
+        )
+        vs = (
+            rps / CPU_BASELINE_ROWS_PER_SEC
+            if CPU_BASELINE_ROWS_PER_SEC
+            else 1.0
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "tpch_q1_sf1_rows_per_sec",
+                    "value": round(rps),
+                    "unit": "rows/s",
+                    "vs_baseline": round(vs, 3),
+                }
+            ),
+            flush=True,
+        )
     if not run_all:
         return
 
@@ -178,8 +186,10 @@ def main() -> None:
          None, None),
         ("tpch_q18_sf10_rows_per_sec", _Q18, "sf10", "lineitem", 100,
          {"max_device_rows": str(1 << 27)}, 2),
+        # budget 2M: lineitem (6M) streams while orders (1.5M) still
+        # fits as the replicated build side of the semi-join
         ("tpch_q18_sf1_streamed_rows_per_sec", _Q18, "sf1", "lineitem",
-         100, {"max_device_rows": str(1 << 20)}, 2),
+         100, {"max_device_rows": str(1 << 21)}, 2),
         ("tpch_window_orders_sf1_rows_per_sec", _WINDOW, "sf1",
          "orders", None, None, None),
         ("tpcds_q95_tiny_rows_per_sec", queries_tpcds.Q95, None,
@@ -188,6 +198,8 @@ def main() -> None:
          ("tpcds", "tiny", "store_sales"), None, None, None),
     ]
     for metric, sql, schema, driving, expect, props, iters in extra:
+        if only is not None and only not in metric:
+            continue
         try:
             saved = {
                 k: str(runner.session.get(k)) for k in (props or {})

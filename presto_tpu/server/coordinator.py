@@ -353,6 +353,26 @@ class CoordinatorServer:
             worker_fragment = dataclasses.replace(
                 order_by, source=worker_fragment
             )
+        # worker<->worker shuffle (reference: intermediate stages read
+        # their hash partition straight from upstream tasks' partitioned
+        # output buffers; the coordinator only sees final-stage output).
+        # Applies when the stage cuts at a keyed agg/distinct and >1
+        # worker is up; single-worker / global-agg / merge-exchange
+        # stages keep the direct gather (nothing to repartition).
+        from presto_tpu.exec import streaming as S
+
+        key_names = S._bucket_key_names(stage.worker_fragment)
+        if (
+            order_by is None
+            and len(workers) > 1
+            and key_names
+            and bool(self.local.session.get("distributed_final"))
+        ):
+            bucket_root, rest_root, _ = S._split_final(stage.final_root)
+            if bucket_root is not None:
+                return self._run_stage_shuffled(
+                    stage, workers, q, key_names, bucket_root, rest_root
+                )
         # dynamic split placement (reference: SourcePartitionedScheduler
         # handing split batches to whichever task has capacity): cut the
         # scan into more ranges than workers and let each worker thread
@@ -385,31 +405,8 @@ class CoordinatorServer:
         # block worker 2's bounded buffer on worker 1's drain) and
         # retry a DEAD worker's range on a live one (recoverable
         # execution: reassign, don't fail the query)
-        from concurrent.futures import ThreadPoolExecutor
-
-        def run_range(w, lo, hi, retried=False):
-            spec = make_spec(lo, hi)
-            try:
-                self._http_json(
-                    "POST", w.uri + "/v1/task", spec.to_json()
-                )
-                out = self._pull_task(w, spec)
-            except (urllib.error.URLError, ConnectionError, OSError):
-                # worker unreachable: reassign the range once to a
-                # live worker (task retry); execution errors inside a
-                # healthy worker (_pull_task raises RuntimeError) are
-                # NOT retried — they would fail anywhere
-                if retried:
-                    raise
-                alive = [
-                    a
-                    for a in self.active_workers()
-                    if a.node_id != w.node_id
-                ]
-                if not alive:
-                    raise
-                REGISTRY.counter("coordinator.tasks_retried").update()
-                return run_range(alive[0], lo, hi, retried=True)
+        def pull_and_delete(w, spec):
+            out = self._pull_task(w, spec)
             try:
                 self._http_json(
                     "DELETE", f"{w.uri}/v1/task/{spec.task_id}", None
@@ -418,24 +415,10 @@ class CoordinatorServer:
                 pass
             return out
 
-        import queue as _queue
-
-        range_q: "_queue.Queue" = _queue.Queue()
-        for r in ranges:
-            range_q.put(r)
-
-        def drain_worker(w):
-            out = []
-            while True:
-                try:
-                    lo, hi = range_q.get_nowait()
-                except _queue.Empty:
-                    return out
-                out.extend(run_range(w, lo, hi))
-
-        with ThreadPoolExecutor(max(len(workers), 1)) as pool:
-            futs = [pool.submit(drain_worker, w) for w in workers]
-            payloads = [p for f in futs for p in f.result()]
+        results = self._ranged_tasks(
+            workers, ranges, make_spec, pull_and_delete
+        )
+        payloads = [p for out in results for p in out]
 
         schema = dict(stage.worker_fragment.output_schema())
         if order_by is not None:
@@ -476,6 +459,224 @@ class CoordinatorServer:
         leaves = remote + local_scans
         pages = [page] + [self.local._load_table(s) for s in local_scans]
         return self.local._run_with_pages(stage.final_root, leaves, pages)
+
+    def _run_stage_shuffled(
+        self, stage, workers, q: _Query, key_names, bucket_root, rest_root
+    ):
+        """Two-stage execution with a worker<->worker data plane.
+
+        Stage 1 (producers): the usual dynamic range queue, but each
+        task hash-partitions its PARTIAL output by the final agg's group
+        keys into ``len(workers)`` output buffers (value-stable hash —
+        exec.streaming's). Stage 2 (mergers): one task per worker pulls
+        its partition from EVERY producer and runs the FINAL merge; the
+        coordinator gathers only the merged (small) results and
+        concatenates — correct because the hash partitions the group
+        space. Sources attach when stage 1 completes (no pipelined
+        shuffle start yet — documented simplification vs the reference's
+        incremental addExchangeLocations)."""
+        REGISTRY.counter("coordinator.shuffled_stages").update()
+        over = max(1, int(self.local.session.get("split_queue_factor")))
+        ranges = assign_ranges(
+            stage.partition_rows, max(len(workers) * over, 1)
+        )
+        ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
+        nparts = len(workers)
+
+        def make_spec(lo: int, hi: int) -> FragmentSpec:
+            return FragmentSpec(
+                task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                query_id=q.qid,
+                fragment=stage.worker_fragment,
+                partition_scan=stage.partition_scan,
+                split_start=lo,
+                split_end=hi,
+                split_batch_rows=int(
+                    self.local.session.get("page_capacity")
+                ),
+                task_concurrency=int(
+                    self.local.session.get("task_concurrency")
+                ),
+                n_partitions=nparts,
+                partition_keys=tuple(key_names),
+            )
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        # every task POSTed (incl. attempts on workers that later died)
+        # is recorded so the finally below can DELETE it — buffered
+        # shuffle partitions must not outlive the query on any worker
+        created: List[tuple] = []
+        clock = threading.Lock()
+
+        def wait_producer(w, spec):
+            with clock:
+                created.append((w, spec.task_id))
+            self._wait_task(w, spec)
+            return (w, spec.task_id)
+
+        try:
+            producers = self._ranged_tasks(
+                workers, ranges, make_spec, wait_producer
+            )
+            sources = tuple((w.uri, tid) for w, tid in producers)
+
+            # merge tasks are placed on CURRENTLY-live workers (the
+            # stage-1 worker set may have shrunk) and retried once
+            # elsewhere on worker death. Limitation vs the reference's
+            # full recoverability: a producer dying AFTER stage 1 loses
+            # its buffered partitions and fails the query (classic
+            # non-recoverable exchange; the gather path's range retry
+            # remains the recoverable fallback).
+            def run_merge_on(i: int, w):
+                spec = FragmentSpec(
+                    task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
+                    query_id=q.qid,
+                    fragment=bucket_root,
+                    partition_scan=-1,
+                    split_start=0,
+                    split_end=0,
+                    sources=sources,
+                    partition=i,
+                )
+                try:
+                    self._http_json(
+                        "POST", w.uri + "/v1/task", spec.to_json()
+                    )
+                    return self._pull_task(w, spec)
+                finally:
+                    try:
+                        self._http_json(
+                            "DELETE",
+                            f"{w.uri}/v1/task/{spec.task_id}",
+                            None,
+                        )
+                    except Exception:
+                        pass
+
+            def run_merge(i: int):
+                live = self.active_workers() or list(workers)
+                w = live[i % len(live)]
+                try:
+                    return run_merge_on(i, w)
+                except (
+                    urllib.error.URLError, ConnectionError, OSError
+                ):
+                    others = [
+                        a
+                        for a in self.active_workers()
+                        if a.node_id != w.node_id
+                    ]
+                    if not others:
+                        raise
+                    REGISTRY.counter("coordinator.tasks_retried").update()
+                    return run_merge_on(i, others[i % len(others)])
+
+            with ThreadPoolExecutor(nparts) as pool:
+                futs = [
+                    pool.submit(run_merge, i) for i in range(nparts)
+                ]
+                payloads = [p for f in futs for p in f.result()]
+        finally:
+            for w, tid in created:
+                try:
+                    self._http_json(
+                        "DELETE", f"{w.uri}/v1/task/{tid}", None
+                    )
+                except Exception:
+                    pass
+
+        schema = dict(bucket_root.output_schema())
+        merged = pages_wire.merge_payloads(payloads, schema)
+        page = stage_page(merged, schema)
+        if rest_root is None:
+            return page
+        rest_remote = [
+            n
+            for n in N.walk(rest_root)
+            if isinstance(n, N.RemoteSourceNode)
+        ]
+        local_scans = [
+            n
+            for n in N.walk(rest_root)
+            if isinstance(n, N.TableScanNode)
+        ]
+        pages = [page] + [
+            self.local._load_table(s) for s in local_scans
+        ]
+        return self.local._run_with_pages(
+            rest_root, rest_remote + local_scans, pages
+        )
+
+    def _ranged_tasks(self, workers, ranges, make_spec, consume):
+        """Dynamic split placement shared by the gather and shuffle
+        paths: over-partitioned ranges in a queue, each worker's thread
+        pulls the next unclaimed range (work stealing by queue), a DEAD
+        worker's range is retried once on a live one. ``consume(w,
+        spec)`` runs after the task POST (pull pages, or await FINISH);
+        its results are collected in arbitrary order. Execution errors
+        inside a healthy worker are NOT retried — they would fail
+        anywhere."""
+        import queue as _queue
+        from concurrent.futures import ThreadPoolExecutor
+
+        def run_range(w, lo, hi, retried=False):
+            spec = make_spec(lo, hi)
+            try:
+                self._http_json(
+                    "POST", w.uri + "/v1/task", spec.to_json()
+                )
+                return consume(w, spec)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if retried:
+                    raise
+                alive = [
+                    a
+                    for a in self.active_workers()
+                    if a.node_id != w.node_id
+                ]
+                if not alive:
+                    raise
+                REGISTRY.counter("coordinator.tasks_retried").update()
+                return run_range(alive[0], lo, hi, retried=True)
+
+        range_q: "_queue.Queue" = _queue.Queue()
+        for r in ranges:
+            range_q.put(r)
+
+        def drain_worker(w):
+            out = []
+            while True:
+                try:
+                    lo, hi = range_q.get_nowait()
+                except _queue.Empty:
+                    return out
+                out.append(run_range(w, lo, hi))
+
+        with ThreadPoolExecutor(max(len(workers), 1)) as pool:
+            futs = [pool.submit(drain_worker, w) for w in workers]
+            return [r for f in futs for r in f.result()]
+
+    def _wait_task(self, w, spec) -> None:
+        """Poll a producer task to completion (its pages stay buffered
+        for the merge stage; nothing is pulled here)."""
+        deadline = time.time() + float(
+            self.local.session.get("query_max_run_time_s")
+        )
+        while True:
+            if time.time() > deadline:
+                raise TimeoutError(f"task {spec.task_id} timed out")
+            st = self._http_json(
+                "GET", f"{w.uri}/v1/task/{spec.task_id}/status", None
+            )
+            state = st.get("state")
+            if state == "FINISHED":
+                return
+            if state == "FAILED":
+                raise RuntimeError(
+                    f"task on {w.node_id} failed: {st.get('error')}"
+                )
+            time.sleep(0.03)
 
     def _pull_task(self, w, spec) -> List[tuple]:
         """Token-acked page pulls until X-Complete (exchange client)."""

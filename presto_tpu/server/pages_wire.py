@@ -230,3 +230,42 @@ def page_to_wire_columns(page, fetched_n: Optional[int] = None):
         )
         cols.append((name, data, valid, blk.dtype, dict_values))
     return cols, n
+
+
+def payload_to_wire_columns(payload, schema, nrows: int):
+    """Staging payload (deserialize_page / streaming._page_to_payload
+    form) -> serialize_page input. Used by the partitioned-output path:
+    producers bucket host-side payloads and re-serialize each
+    partition's slice without another device round trip."""
+    from presto_tpu.connectors.tpch import DictColumn
+    from presto_tpu.exec.staging import MaskedColumn
+
+    cols = []
+    for name, t in schema.items():
+        col = payload[name]
+        if isinstance(col, MaskedColumn):
+            values = (
+                tuple(col.values) if col.values is not None else None
+            )
+            cols.append(
+                (
+                    name,
+                    np.asarray(col.data)[:nrows],
+                    np.asarray(col.valid)[:nrows],
+                    t,
+                    values,
+                )
+            )
+        elif isinstance(col, DictColumn):
+            cols.append(
+                (
+                    name,
+                    np.asarray(col.ids, np.int32)[:nrows],
+                    None,
+                    t,
+                    tuple(col.values),
+                )
+            )
+        else:
+            cols.append((name, np.asarray(col)[:nrows], None, t, None))
+    return cols

@@ -140,6 +140,20 @@ class FragmentSpec:
     #: concurrent split-batch drivers per task (session
     #: ``task_concurrency``; reference: task.concurrency driver count)
     task_concurrency: int = 1
+    #: partitioned output (reference: PartitionedOutputOperator +
+    #: PartitionedOutputBuffer): producers hash-partition output rows by
+    #: ``partition_keys`` into ``n_partitions`` buffers; downstream
+    #: merge tasks pull only their buffer — worker<->worker shuffle,
+    #: pages never touch the coordinator
+    n_partitions: int = 1
+    partition_keys: tuple = ()
+    #: merge task (reference: an intermediate stage's ExchangeClient):
+    #: ``sources`` = [(uri, task_id), ...] of the producing stage;
+    #: ``partition`` = which output buffer this merge task owns. When
+    #: sources is non-empty the fragment's leaf is a RemoteSourceNode
+    #: fed by the pulled pages instead of a table scan.
+    sources: tuple = ()
+    partition: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -151,6 +165,10 @@ class FragmentSpec:
             "split_end": self.split_end,
             "split_batch_rows": self.split_batch_rows,
             "task_concurrency": self.task_concurrency,
+            "n_partitions": self.n_partitions,
+            "partition_keys": list(self.partition_keys),
+            "sources": [list(s) for s in self.sources],
+            "partition": self.partition,
         }
 
     @staticmethod
@@ -164,4 +182,10 @@ class FragmentSpec:
             split_end=d["split_end"],
             split_batch_rows=d.get("split_batch_rows", 0),
             task_concurrency=d.get("task_concurrency", 1),
+            n_partitions=d.get("n_partitions", 1),
+            partition_keys=tuple(d.get("partition_keys", ())),
+            sources=tuple(
+                tuple(s) for s in d.get("sources", ())
+            ),
+            partition=d.get("partition", 0),
         )

@@ -45,35 +45,74 @@ MAX_BUFFERED_PAGES = 64
 
 
 class _Task:
-    def __init__(self, spec: FragmentSpec):
+    def __init__(self, spec: FragmentSpec, pool=None):
         self.spec = spec
         self.state = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|ABORTED
         self.error: Optional[str] = None
-        self.pages: List[Optional[bytes]] = []  # None = acked + freed
-        self.acked = 0  # pages below this token are freed
+        # one output buffer per partition (reference:
+        # PartitionedOutputBuffer); unpartitioned tasks use buffer 0
+        nparts = max(spec.n_partitions, 1)
+        self.parts: List[List[Optional[bytes]]] = [
+            [] for _ in range(nparts)
+        ]
+        self.part_acked: List[int] = [0] * nparts
         self.cond = threading.Condition()
         self.created = time.time()
+        # buffered output bytes are accounted against the worker's
+        # MemoryPool under a task-scoped key: buffers outlive task
+        # FINISH (shuffle consumers attach later), so the query-id
+        # safety-net release at task end must not free them
+        self.pool = pool
+        self.buf_key = f"{spec.query_id}#buf#{spec.task_id}"
 
-    def offer_page(self, page: bytes) -> None:
+    def drop_buffers(self) -> None:
+        """Release every remaining buffered byte (DELETE/abort path)."""
+        if self.pool is not None:
+            self.pool.release(self.buf_key, None)
+
+    @property
+    def pages(self) -> List[Optional[bytes]]:
+        """Buffer 0 view (status reporting + unpartitioned pulls)."""
+        return self.parts[0]
+
+    def offer_page(self, page: bytes, part: int = 0) -> None:
         """Producer side: blocks while the buffer is full (backpressure);
-        raises if the task was aborted while blocked."""
+        raises if the task was aborted while blocked.
+
+        Partitioned (shuffle) buffers are stage-lifetime: the merge
+        stage attaches only after every producer FINISHES, so blocking
+        on a full buffer would deadlock the stage. They hold compressed
+        PARTIAL states (small by construction); the bounded-buffer
+        backpressure applies to the unpartitioned streaming path."""
         with self.cond:
             while (
-                len(self.pages) - self.acked >= MAX_BUFFERED_PAGES
+                len(self.parts) == 1
+                and len(self.parts[part]) - self.part_acked[part]
+                >= MAX_BUFFERED_PAGES
                 and self.state == "RUNNING"
             ):
                 self.cond.wait(timeout=0.1)
             if self.state == "ABORTED":
                 raise RuntimeError("task aborted")
-            self.pages.append(page)
+            if self.pool is not None:
+                # too-big shuffle output fails on ACCOUNTING
+                # (MemoryLimitExceeded -> task FAILED), not on OOM
+                self.pool.reserve(self.buf_key, len(page))
+            self.parts[part].append(page)
 
-    def ack_below(self, token: int) -> None:
+    def ack_below(self, token: int, part: int = 0) -> None:
         """Consumer side: pulling token N acks (frees) pages < N."""
         with self.cond:
-            for i in range(self.acked, min(token, len(self.pages))):
-                self.pages[i] = None
-            if token > self.acked:
-                self.acked = token
+            pages = self.parts[part]
+            freed = 0
+            for i in range(self.part_acked[part], min(token, len(pages))):
+                if pages[i] is not None:
+                    freed += len(pages[i])
+                pages[i] = None
+            if token > self.part_acked[part]:
+                self.part_acked[part] = token
+            if freed and self.pool is not None:
+                self.pool.release(self.buf_key, freed)
             self.cond.notify_all()
 
     def abort(self) -> None:
@@ -178,7 +217,7 @@ class WorkerServer:
     def create_task(self, spec: FragmentSpec) -> str:
         if self._shutting_down:
             raise RuntimeError("worker is shutting down")
-        task = _Task(spec)
+        task = _Task(spec, pool=self.memory_pool)
         with self._lock:
             self.tasks[spec.task_id] = task
         threading.Thread(
@@ -213,6 +252,8 @@ class WorkerServer:
         ``task_concurrency`` drivers overlap host staging with device
         execution."""
         spec = task.spec
+        if spec.sources:
+            return self._execute_merge(task)
         root = spec.fragment
         # a pushed-down root sort (ordered MERGE exchange: coordinator
         # wraps the fragment in a SortNode so every emitted batch is a
@@ -267,6 +308,8 @@ class WorkerServer:
                 self.memory_pool.release(spec.query_id, staged_bytes)
 
         def emit(out) -> None:
+            if spec.n_partitions > 1:
+                return _emit_partitioned(task, out)
             cols, n = pages_wire.page_to_wire_columns(out)
             for lo in range(0, max(n, 1), PAGE_ROWS):
                 hi = min(lo + PAGE_ROWS, n)
@@ -300,6 +343,69 @@ class WorkerServer:
         split = ConnectorSplit(scan.handle, lo, hi)
         return conn.create_page_source(split, list(scan.columns))
 
+    # ------------------------------------------- merge task (shuffle read)
+
+    def _execute_merge(self, task: "_Task") -> None:
+        """Intermediate-stage task: pull this task's output partition
+        from every producer task (worker<->worker data plane — the
+        reference's ExchangeClient feeding an intermediate stage), merge
+        the payloads (dictionary remap included), and run the fragment
+        with its RemoteSourceNode leaf bound to the merged page.
+
+        Correctness: producers hash-partition rows by the final
+        aggregation's group keys, so every group lands wholly in one
+        partition and per-partition FINAL results concatenate."""
+        REGISTRY.counter("worker.merge_tasks").update()
+        spec = task.spec
+        payloads = []
+        for uri, src_task in spec.sources:
+            payloads.extend(
+                _pull_partition(
+                    uri, src_task, spec.partition, self.runner.session
+                )
+            )
+        root = spec.fragment
+        remotes = [
+            n for n in N.walk(root) if isinstance(n, N.RemoteSourceNode)
+        ]
+        if len(remotes) != 1:
+            raise RuntimeError(
+                f"merge fragment must have one RemoteSource leaf, "
+                f"got {len(remotes)}"
+            )
+        schema = dict(remotes[0].fragment_root.output_schema())
+        # same grouped-execution discipline as the coordinator gather:
+        # a partition beyond max_device_rows sub-buckets and merges one
+        # bucket at a time (or fails under spill_enabled=false) instead
+        # of staging one oversized page
+        from presto_tpu.exec import streaming as S
+
+        out = S.grouped_final_merge(
+            self.runner,
+            payloads,
+            schema,
+            root,
+            remotes[0].fragment_root,
+            int(self.runner.session.get("max_device_rows")),
+        )
+        if out is None:
+            merged = pages_wire.merge_payloads(payloads, schema)
+            page = stage_page(merged, schema)
+            staged = sum(int(b.data.nbytes) for b in page.blocks)
+            self.memory_pool.reserve(spec.query_id, staged)
+            try:
+                out = self.runner._run_with_pages(root, remotes, [page])
+            finally:
+                self.memory_pool.release(spec.query_id, staged)
+        cols, n = pages_wire.page_to_wire_columns(out)
+        for lo in range(0, max(n, 1), PAGE_ROWS):
+            hi = min(lo + PAGE_ROWS, n)
+            chunk = [
+                (name, d[lo:hi], None if v is None else v[lo:hi], t, dv)
+                for name, d, v, t, dv in cols
+            ]
+            task.offer_page(pages_wire.serialize_page(chunk, hi - lo))
+
     # ------------------------------------------------------------- status
 
     def status(self) -> dict:
@@ -312,6 +418,62 @@ class WorkerServer:
                     tid: t.state for tid, t in self.tasks.items()
                 },
             }
+
+
+def _emit_partitioned(task: "_Task", out) -> None:
+    """Partitioned output (reference: PartitionedOutputOperator): hash
+    the batch's rows by the stage's partition keys — on VALUES, not
+    dictionary ids, so partitioning agrees across producers whose
+    dictionaries differ (exec.streaming owns the hash) — and offer each
+    partition's slice to its own output buffer."""
+    from presto_tpu.exec import streaming as S
+
+    spec = task.spec
+    payload, schema, nrows = S._page_to_payload(out)
+    if nrows == 0:
+        return
+    buckets = S._bucket_of(
+        payload, list(spec.partition_keys), nrows, spec.n_partitions
+    )
+    import numpy as _np
+
+    for b in _np.unique(buckets):
+        mask = buckets == b
+        sliced = S._slice_payload(payload, schema, mask)
+        n = int(mask.sum())
+        cols = pages_wire.payload_to_wire_columns(sliced, schema, n)
+        task.offer_page(
+            pages_wire.serialize_page(cols, n), part=int(b)
+        )
+
+
+def _pull_partition(uri: str, src_task: str, part: int, session):
+    """Token-acked pull of one output partition from a peer worker
+    (the exchange-client loop, worker side)."""
+    import urllib.request
+
+    token = 0
+    out = []
+    deadline = time.time() + float(session.get("query_max_run_time_s"))
+    while True:
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"shuffle pull of {src_task}[{part}] timed out"
+            )
+        url = f"{uri}/v1/task/{src_task}/results/{part}/{token}"
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            complete = resp.headers.get("X-Complete") == "true"
+            nxt = int(resp.headers.get("X-Next-Token", token))
+            if resp.status == 200:
+                out.append(pages_wire.deserialize_page(resp.read()))
+            if complete and nxt == token + (
+                1 if resp.status == 200 else 0
+            ):
+                return out
+            if nxt == token and resp.status != 200:
+                time.sleep(0.02)
+            token = nxt
 
 
 def _make_handler(worker: WorkerServer):
@@ -367,14 +529,20 @@ def _make_handler(worker: WorkerServer):
                 t = worker.tasks.get(parts[2])
                 if t is None:
                     return self._json(404, {"error": "no such task"})
+                part = int(parts[4])
                 token = int(parts[5])
                 if t.state == "FAILED":
                     return self._json(500, {"error": t.error})
+                if not (0 <= part < len(t.parts)):
+                    return self._json(
+                        400, {"error": f"no output buffer {part}"}
+                    )
                 # pulling token N acks pages < N (frees buffer slots and
                 # unblocks the producer — the reference's token-advance ack)
-                t.ack_below(token)
-                if token < len(t.pages) and t.pages[token] is not None:
-                    body = t.pages[token]
+                t.ack_below(token, part)
+                pages = t.parts[part]
+                if token < len(pages) and pages[token] is not None:
+                    body = pages[token]
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "application/x-presto-tpu-page"
@@ -385,7 +553,7 @@ def _make_handler(worker: WorkerServer):
                         "X-Complete",
                         "true"
                         if t.state == "FINISHED"
-                        and token + 1 >= len(t.pages)
+                        and token + 1 >= len(pages)
                         else "false",
                     )
                     self.end_headers()
@@ -423,6 +591,7 @@ def _make_handler(worker: WorkerServer):
                     t = worker.tasks.pop(parts[2], None)
                 if t is not None:
                     t.abort()
+                    t.drop_buffers()
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
 

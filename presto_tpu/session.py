@@ -70,6 +70,15 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _positive("task_concurrency"),
         ),
         PropertyMetadata(
+            "distributed_final",
+            "Run keyed FINAL merges as a second worker stage reading "
+            "hash partitions straight from producer workers "
+            "(worker<->worker shuffle); False gathers partials at the "
+            "coordinator",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "split_queue_factor",
             "Scan ranges queued per worker for dynamic split placement "
             "(1 = static assignment; reference: SourcePartitionedScheduler "

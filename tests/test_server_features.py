@@ -242,12 +242,22 @@ def test_ordered_merge_topn(merge_cluster, oracle, monkeypatch):
 def test_bucketed_gather_merge(oracle, monkeypatch):
     """Partial states beyond the device budget hash-bucket at the
     gather and merge one bucket at a time (grouped execution at the
-    coordinator; VERDICT r2 weak 5) — oracle-exact."""
+    coordinator; VERDICT r2 weak 5) — oracle-exact.
+
+    Pins ``distributed_final=false``: with the worker<->worker shuffle
+    on (the default), keyed FINAL merges run on workers and the
+    coordinator's bucketed gather is the fallback discipline under
+    test here."""
     from presto_tpu.exec import streaming as S
     from presto_tpu.session import Session
 
     coord = CoordinatorServer(
-        session=Session(properties={"max_device_rows": 4096})
+        session=Session(
+            properties={
+                "max_device_rows": 4096,
+                "distributed_final": "false",
+            }
+        )
     ).start()
     workers = [
         WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
