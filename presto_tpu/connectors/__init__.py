@@ -41,6 +41,12 @@ def _orc_factory(**config):
     return OrcConnector(**config)
 
 
+def _hive_factory(**config):
+    from presto_tpu.connectors.hive import HiveConnector
+
+    return HiveConnector(**config)
+
+
 CONNECTOR_FACTORIES = {
     "tpch": TpchConnector,
     "tpcds": TpcdsConnector,
@@ -48,6 +54,7 @@ CONNECTOR_FACTORIES = {
     "blackhole": BlackholeConnector,
     "parquet": _parquet_factory,  # lazy: pyarrow imports on first use
     "orc": _orc_factory,
+    "hive": _hive_factory,
 }
 
 

@@ -856,22 +856,18 @@ def _scalar_literal(page: Page, col: str) -> E.Literal:
 
 
 def _merge_split_payloads(datas: List[Dict], columns: List[str]) -> Dict:
-    from presto_tpu.connectors.tpch import DictColumn
+    """Merge per-split payloads; dictionary columns union + remap when
+    splits carry different dictionaries (file connectors) with a
+    same-dictionary fast path (closed-form generators), and masked
+    chunks merge mask-correctly (exec.staging.merge_column_chunks —
+    the round-3 fix for multi-split string/null scans)."""
+    from presto_tpu.exec.staging import merge_column_chunks
 
     if len(datas) == 1:
         return datas[0]
-    out = {}
-    for c in columns:
-        first = datas[0][c]
-        if isinstance(first, DictColumn):
-            # same closed-form dictionary across splits by construction
-            out[c] = DictColumn(
-                ids=np.concatenate([d[c].ids for d in datas]),
-                values=first.values,
-            )
-        else:
-            out[c] = np.concatenate([d[c] for d in datas])
-    return out
+    return {
+        c: merge_column_chunks([d[c] for d in datas]) for c in columns
+    }
 
 
 def _result_columns(res: QueryResult) -> Dict[str, np.ndarray]:

@@ -18,7 +18,7 @@ every split of similar size reuses the same compiled fragment
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -111,6 +111,23 @@ def stage_page(
         num_valid=jnp.asarray(n, jnp.int32),
         names=names,
     )
+
+
+def merge_column_chunks(parts: List[object], dtype=None):
+    """Concatenate one column's per-split payload chunks — a
+    single-column view over ``pages_wire.merge_payloads`` (ONE
+    implementation of the union-dictionary + id-remap + masked-mix
+    merge; this wrapper exists for split-payload callers that work
+    column-at-a-time). ``dtype`` only matters for the empty case."""
+    from presto_tpu.server.pages_wire import merge_payloads
+
+    if len(parts) == 1:
+        return parts[0]
+    merged = merge_payloads(
+        [({"c": p}, None, 0) for p in parts],
+        {"c": dtype or T.BIGINT},
+    )
+    return merged["c"]
 
 
 class CatalogManager:

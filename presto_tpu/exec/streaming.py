@@ -424,6 +424,13 @@ def _col_hash_input(col, nrows: int) -> np.ndarray:
             return np.zeros(nrows, np.uint64)
         return crc[ids[:nrows]]
     data = np.asarray(col)[:nrows]
+    if data.ndim != 1:
+        # int128 limb pairs etc. — the planner gates long decimals out
+        # of key positions; this backstop keeps the failure loud
+        raise NotImplementedError(
+            f"cannot bucket-hash a {data.ndim}-D column (long-decimal "
+            "keys are a documented deviation)"
+        )
     if data.dtype.kind == "f":
         d = data.astype(np.float64, copy=True)
         d[d == 0] = 0.0  # -0.0 hashes like +0.0

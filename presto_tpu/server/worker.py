@@ -101,18 +101,27 @@ class _Task:
             self.parts[part].append(page)
 
     def ack_below(self, token: int, part: int = 0) -> None:
-        """Consumer side: pulling token N acks (frees) pages < N."""
+        """Consumer side: pulling token N acks pages < N.
+
+        Unpartitioned (streaming) buffers FREE acked pages — that is
+        the backpressure contract. Partitioned (shuffle) buffers only
+        advance the cursor: pages stay until DELETE, so a merge task
+        retried on another worker can restart its pull at token 0
+        without finding acked holes (silent data loss)."""
         with self.cond:
             pages = self.parts[part]
-            freed = 0
-            for i in range(self.part_acked[part], min(token, len(pages))):
-                if pages[i] is not None:
-                    freed += len(pages[i])
-                pages[i] = None
+            if len(self.parts) == 1:
+                freed = 0
+                for i in range(
+                    self.part_acked[part], min(token, len(pages))
+                ):
+                    if pages[i] is not None:
+                        freed += len(pages[i])
+                    pages[i] = None
+                if freed and self.pool is not None:
+                    self.pool.release(self.buf_key, freed)
             if token > self.part_acked[part]:
                 self.part_acked[part] = token
-            if freed and self.pool is not None:
-                self.pool.release(self.buf_key, freed)
             self.cond.notify_all()
 
     def abort(self) -> None:
