@@ -234,6 +234,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
     "item": {
         "i_item_sk": T.INTEGER,
         "i_item_id": T.VARCHAR,
+        "i_item_desc": T.VARCHAR,
         "i_product_name": T.VARCHAR,
         "i_color": T.VARCHAR,
         "i_current_price": D7_2,
@@ -507,6 +508,8 @@ class TpcdsGenerator:
                 out[c] = _numbered("Item", self.counts["item"], rows + 1)
             elif c == "i_product_name":
                 out[c] = _I_NAME.column(1401, rows)
+            elif c == "i_item_desc":
+                out[c] = _I_NAME.column(1409, rows)
             elif c == "i_color":
                 out[c] = _fixed(
                     COLORS,

@@ -199,7 +199,9 @@ class _Planner:
         # 4. window functions
         win_map: Dict[ast.Node, str] = {}
         if any(self._contains_window(it.expr) for it in sel.items):
-            node, scope, win_map = self._plan_windows(node, scope, sel)
+            node, scope, win_map = self._plan_windows(
+                node, scope, sel, agg_map
+            )
 
         # 5. select items -> output projection
         out_names: List[str] = []
@@ -1337,8 +1339,13 @@ class _Planner:
 
     # ------------------------------------------------------------- windows
 
-    def _plan_windows(self, node, scope, sel: ast.Select):
+    def _plan_windows(self, node, scope, sel: ast.Select, agg_map=None):
+        # runs AFTER aggregation: window args and partition/order keys
+        # may reference aggregate results (reference: Q98's
+        # sum(sum(x)) over (partition by ...) — a window over the
+        # grouped output), resolved through agg_map like select items
         node = self._finalize_pool(node, scope)
+        lower_w = lambda x: self._lower(x, scope, agg_map=agg_map)  # noqa: E731
         calls: List[ast.FuncCall] = []
 
         def collect(e):
@@ -1357,10 +1364,10 @@ class _Planner:
         for c in calls:
             by_spec.setdefault(c.window, []).append(c)
         for spec, fns in by_spec.items():
-            pby = tuple(self._lower(p, scope) for p in spec.partition_by)
+            pby = tuple(lower_w(p) for p in spec.partition_by)
             oby = tuple(
                 SortKey(
-                    self._lower(si.expr, scope), si.descending, si.nulls_first
+                    lower_w(si.expr), si.descending, si.nulls_first
                 )
                 for si in spec.order_by
             )
@@ -1377,7 +1384,7 @@ class _Planner:
                         WindowCall("ntile", None, out_name, offset=n)
                     )
                 elif f.name in ("lag", "lead"):
-                    arg = self._lower(f.args[0], scope)
+                    arg = lower_w(f.args[0])
                     off = (
                         self._const_int(f.args[1], f"{f.name} offset")
                         if len(f.args) > 1
@@ -1385,7 +1392,7 @@ class _Planner:
                     )
                     default = None
                     if len(f.args) > 2:
-                        de = self._lower(f.args[2], scope)
+                        de = lower_w(f.args[2])
                         if not isinstance(de, E.Literal):
                             raise PlanningError(
                                 f"{f.name} default must be a constant"
@@ -1419,7 +1426,7 @@ class _Planner:
                             f"{f.name}() is not supported as a window "
                             "function"
                         )
-                    arg = self._lower(f.args[0], scope)
+                    arg = lower_w(f.args[0])
                     wcalls.append(WindowCall(f.name, arg, out_name))
                 win_map[f] = out_name
             node = N.WindowNode(node, pby, oby, tuple(wcalls))

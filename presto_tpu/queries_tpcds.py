@@ -311,6 +311,25 @@ OFFICIAL = {
                  c_first_name, ca_city, bought_city, extended_price,
                  extended_tax, list_price
         limit 100""",
+    # Q98: per-item revenue share of its class — a window aggregate
+    # OVER the grouped output (sum(sum(x)) over (partition by i_class))
+    "q98": f"""
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(ss_ext_sales_price) as itemrevenue,
+               sum(ss_ext_sales_price) * 100 /
+                 sum(sum(ss_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from {S}.store_sales, {S}.item, {S}.date_dim
+        where ss_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and ss_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22'
+              and date '1999-02-22' + interval '30' day
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio""",
     # Q79: per-ticket coupon/profit for Monday shoppers at mid-size
     # stores
     "q79": f"""
