@@ -85,7 +85,15 @@ def _stream(tag: int, idx: np.ndarray) -> np.ndarray:
 
 
 def _uniform(tag: int, idx: np.ndarray, lo: int, hi: int) -> np.ndarray:
-    """Uniform integers in [lo, hi] (inclusive)."""
+    """Uniform integers in [lo, hi] (inclusive). Large affine index
+    ranges route through the native fused loop (native/genstream.cpp,
+    bit-exact, measured in tools/bench_native.py); everything else (and
+    any host without a toolchain) takes the vectorized numpy path."""
+    from presto_tpu import native
+
+    out = native.gen_uniform_native(tag, idx, lo, hi)
+    if out is not None:
+        return out
     span = (_stream(tag, idx) % np.uint64(hi - lo + 1)).astype(np.int64)
     return lo + span
 

@@ -26,43 +26,52 @@ _lib: Optional[object] = None
 _tried = False
 
 
+def _build_and_load(src: str, so: str, configure):
+    """Lazy g++ build (mtime-checked, pid-tmp atomic rename so
+    concurrent processes never CDLL a half-linked .so) + ctypes load;
+    None when the toolchain is absent or the build fails. ``configure``
+    sets restype/argtypes on the loaded library."""
+    try:
+        if not os.path.exists(so) or os.path.getmtime(
+            so
+        ) < os.path.getmtime(src):
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            tmp = f"{so}.{os.getpid()}.tmp"
+            subprocess.run(
+                [
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    src, "-o", tmp,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        configure(lib)
+        return lib
+    except Exception:
+        return None  # toolchain absent / build failed: numpy path
+
+
+def _configure_codec(lib):
+    lib.dict_encode.restype = ctypes.c_int64
+    lib.dict_encode.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+
+
 def _load():
     global _lib, _tried
     with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        try:
-            if not os.path.exists(_SO) or os.path.getmtime(
-                _SO
-            ) < os.path.getmtime(_SRC):
-                os.makedirs(os.path.dirname(_SO), exist_ok=True)
-                # build to a temp name, then atomic-rename: concurrent
-                # processes must never CDLL a half-linked .so
-                tmp = f"{_SO}.{os.getpid()}.tmp"
-                subprocess.run(
-                    [
-                        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                        _SRC, "-o", tmp,
-                    ],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-                os.replace(tmp, _SO)
-            lib = ctypes.CDLL(_SO)
-            lib.dict_encode.restype = ctypes.c_int64
-            lib.dict_encode.argtypes = [
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int64,
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int64),
-            ]
-            _lib = lib
-        except Exception:
-            _lib = None  # toolchain absent / build failed: numpy path
+        if not _tried:
+            _tried = True
+            _lib = _build_and_load(_SRC, _SO, _configure_codec)
         return _lib
 
 
@@ -110,3 +119,75 @@ def encode_strings_native(
         [str(values[int(r)]) for r in repr_rows[:rc]], dtype=object
     )
     return ids, valid.astype(bool), uniq
+
+
+# ------------------------------------------------- closed-form generator
+
+_GEN_SRC = os.path.join(_ROOT, "native", "genstream.cpp")
+_GEN_SO = os.path.join(_ROOT, "native", "build", "genstream.so")
+
+_gen_lock = threading.Lock()
+_gen_lib: Optional[object] = None
+_gen_tried = False
+
+#: below this, ctypes call overhead beats the fused-loop win
+_GEN_MIN_ROWS = 65_536
+
+
+def _configure_gen(lib):
+    # gen_stream stays C++-exported but unbound until a caller exists
+    lib.gen_uniform.restype = None
+    lib.gen_uniform.argtypes = [ctypes.c_int64] * 6 + [
+        ctypes.POINTER(ctypes.c_int64)
+    ]
+
+
+def _load_gen():
+    global _gen_lib, _gen_tried
+    with _gen_lock:
+        if not _gen_tried:
+            _gen_tried = True
+            _gen_lib = _build_and_load(_GEN_SRC, _GEN_SO, _configure_gen)
+        return _gen_lib
+
+
+def _affine_of(idx: np.ndarray) -> Optional[Tuple[int, int]]:
+    """(start, step) when idx is exactly start + step*arange(n)."""
+    n = len(idx)
+    if n == 0:
+        return None
+    start = int(idx[0])
+    if n == 1:
+        return start, 1
+    step = int(idx[1]) - start
+    if int(idx[-1]) != start + step * (n - 1):
+        return None
+    if not np.array_equal(
+        np.diff(idx), np.full(n - 1, step, dtype=idx.dtype)
+    ):
+        return None
+    return start, step
+
+
+def gen_uniform_native(
+    tag: int, idx: np.ndarray, lo: int, hi: int
+) -> Optional[np.ndarray]:
+    """Fused C++ stream+mod for affine index sequences; None when the
+    library is unavailable, the sequence is not affine, or the batch is
+    too small to pay the call overhead. Bit-exact vs the numpy path
+    (tests/test_native.py)."""
+    if len(idx) < _GEN_MIN_ROWS:
+        return None
+    lib = _load_gen()
+    if lib is None:
+        return None
+    aff = _affine_of(idx)
+    if aff is None:
+        return None
+    start, step = aff
+    out = np.empty(len(idx), dtype=np.int64)
+    lib.gen_uniform(
+        tag, start, step, len(idx), lo, hi,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out

@@ -150,6 +150,43 @@ def test_join_with_tpch(runner, warehouse):
     assert sum(n for _, n in got) == len(rows)
 
 
+def test_decimal_scale_evolution_across_files(tmp_path):
+    """Schema evolution: a later file storing the decimal at a finer
+    scale must normalize to the table schema (derived from the first
+    file) — the raw-buffer read keeps the as_py-era rescale."""
+    import decimal
+
+    d = tmp_path / "s" / "t"
+    d.mkdir(parents=True)
+    pq.write_table(
+        pa.table(
+            {
+                "v": pa.array(
+                    [decimal.Decimal("1.25")], type=pa.decimal128(12, 2)
+                )
+            }
+        ),
+        d / "a.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "v": pa.array(
+                    [decimal.Decimal("2.375")],
+                    type=pa.decimal128(12, 3),
+                )
+            }
+        ),
+        d / "b.parquet",
+    )
+    catalogs = CatalogManager()
+    catalogs.register("hive", create_connector("hive", root=str(tmp_path)))
+    r = LocalQueryRunner(catalogs=catalogs)
+    rows = r.execute("select sum(v) as s from hive.s.t").rows()
+    # 1.25 + round_half_up(2.375 -> 2.38) at scale 2
+    assert rows[0][0] == pytest.approx(3.63)
+
+
 def test_merge_column_chunks_unit():
     """Split payload merging: differing dictionaries union + remap,
     masked and unmasked chunks mix, same-dictionary fast path holds

@@ -56,6 +56,34 @@ def main():
         f"speedup {t_numpy / t_native:5.2f}x"
     )
 
+    # closed-form generator (native/genstream.cpp): fused stream loop
+    # vs numpy's 6-pass vectorized mix
+    from presto_tpu.connectors import tpch
+
+    assert native._load_gen() is not None, "genstream build failed"
+    n = args.rows * 10
+    idx = np.arange(n, dtype=np.int64)
+    tpch._uniform(1701, idx, 1, 200000)  # warm
+    t0 = time.perf_counter()
+    got_native = tpch._uniform(1701, idx, 1, 200000)
+    t_native = time.perf_counter() - t0
+    saved = native._gen_lib
+    native._gen_lib = None
+    try:
+        tpch._uniform(1701, idx, 1, 200000)  # warm
+        t0 = time.perf_counter()
+        got_numpy = tpch._uniform(1701, idx, 1, 200000)
+        t_numpy = time.perf_counter() - t0
+    finally:
+        native._gen_lib = saved
+    assert np.array_equal(got_native, got_numpy)
+    print(
+        f"gen_uniform rows={n}  "
+        f"numpy {t_numpy * 1e3:8.1f} ms ({n / t_numpy / 1e6:.0f}M/s)   "
+        f"native {t_native * 1e3:8.1f} ms ({n / t_native / 1e6:.0f}M/s)  "
+        f"speedup {t_numpy / t_native:5.2f}x"
+    )
+
 
 if __name__ == "__main__":
     main()
