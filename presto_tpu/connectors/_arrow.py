@@ -72,17 +72,26 @@ def arrow_column_to_payload(arr, t: T.DataType):
             data = np.stack([hi, lo], axis=1)
         else:
             data = lo
+        valid = (
+            np.asarray(combined.is_valid(), dtype=bool) if nulls else None
+        )
+        if valid is not None:
+            # zero null slots FIRST: they carry uninitialized bytes
+            # that would poison the rescale below (and pages must stay
+            # deterministic; masked rows are never observed)
+            data[~valid] = 0
         # schema evolution: a file may store the column at a different
         # scale than the table schema (hive derives the schema from its
-        # first file) — normalize like the as_py().scaleb path did
+        # first file). Rounding on downscale is HALF-UP, matching
+        # Block.from_pylist ingest (the replaced as_py path truncated
+        # toward zero — a deliberate change, codified in
+        # tests/test_hive.py::test_decimal_scale_evolution_across_files)
         file_scale = combined.type.scale
         if file_scale != t.scale:
             data = _rescale_unscaled(data, file_scale, t.scale, t)
-        if nulls:
-            # null slots carry uninitialized bytes: zero them so pages
-            # stay deterministic (masked rows are never observed)
-            invalid = ~np.asarray(combined.is_valid(), dtype=bool)
-            data[invalid] = 0
+        if valid is not None:  # reuse the mask computed above
+            return MaskedColumn(data=data, valid=valid)
+        return data
     elif t.name == "date":
         data = np.asarray(
             combined.cast(pa.int32()).fill_null(0), dtype=np.int64
@@ -118,7 +127,16 @@ def _rescale_unscaled(data, from_scale: int, to_scale: int, t):
             ]
         return T.int128_limbs(vals)
     if to_scale > from_scale:
-        return data * np.int64(10 ** (to_scale - from_scale))
+        factor = 10 ** (to_scale - from_scale)
+        if len(data) and int(
+            np.abs(data).max()
+        ) > (2 ** 63 - 1) // factor:
+            # loud like the old python-int path, not a silent wrap
+            raise OverflowError(
+                f"decimal rescale x{factor} overflows int64 "
+                f"(declared {t}, file scale {from_scale})"
+            )
+        return data * np.int64(factor)
     f = np.int64(10 ** (from_scale - to_scale))
     q = (np.abs(data) + f // 2) // f
     return np.sign(data) * q

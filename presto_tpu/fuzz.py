@@ -1,0 +1,222 @@
+"""Random-query fuzzer: generated SELECTs diffed against the sqlite
+oracle over the same data.
+
+Reference parity: SURVEY.md §5.2 (race detection / sanitizers) — the
+reference leans on differential testing (Java vs native worker, query
+shadowing); this engine's analogue is a seeded generator whose every
+query runs on the XLA engine AND sqlite, diffing ordered rows. The
+generator stays inside the engine's supported SQL surface on purpose:
+its job is to catch WRONG ANSWERS (planner rewrites, null semantics,
+dictionary handling, distributed merges), not to probe parser errors.
+
+Determinism: a seed fully determines the query text, so failures
+reproduce by seed — `python -m presto_tpu.fuzz --seed N` replays one
+query; the test suite pins a seed range.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Tuple
+
+#: column pools per table (type-aware; tiny-scale tpch)
+_NUMERIC = {
+    "lineitem": ["l_quantity", "l_extendedprice", "l_discount", "l_tax"],
+    "orders": ["o_totalprice", "o_shippriority"],
+    "customer": ["c_acctbal"],
+    "part": ["p_retailprice", "p_size"],
+    "supplier": ["s_acctbal"],
+}
+_STRINGS = {
+    "lineitem": ["l_returnflag", "l_linestatus", "l_shipmode",
+                 "l_shipinstruct"],
+    "orders": ["o_orderstatus", "o_orderpriority"],
+    "customer": ["c_mktsegment"],
+    "part": ["p_brand", "p_container"],
+    "supplier": ["s_name"],
+}
+_DATES = {
+    "lineitem": ["l_shipdate", "l_commitdate", "l_receiptdate"],
+    "orders": ["o_orderdate"],
+}
+_KEYS = {
+    "lineitem": ["l_orderkey", "l_partkey", "l_suppkey", "l_linenumber"],
+    "orders": ["o_orderkey", "o_custkey"],
+    "customer": ["c_custkey", "c_nationkey"],
+    "part": ["p_partkey"],
+    "supplier": ["s_suppkey", "s_nationkey"],
+}
+#: joinable FK = (left table, left col, right table, right col)
+_JOINS = [
+    ("lineitem", "l_orderkey", "orders", "o_orderkey"),
+    ("lineitem", "l_partkey", "part", "p_partkey"),
+    ("lineitem", "l_suppkey", "supplier", "s_suppkey"),
+    ("orders", "o_custkey", "customer", "c_custkey"),
+]
+_STR_LITS = {
+    "l_returnflag": ["A", "N", "R"],
+    "l_linestatus": ["F", "O"],
+    "l_shipmode": ["AIR", "MAIL", "RAIL", "SHIP", "TRUCK"],
+    "l_shipinstruct": ["COLLECT COD", "DELIVER IN PERSON"],
+    "o_orderstatus": ["F", "O", "P"],
+    "o_orderpriority": ["1-URGENT", "2-HIGH", "3-MEDIUM"],
+    "c_mktsegment": ["AUTOMOBILE", "BUILDING", "FURNITURE"],
+    "p_brand": ["Brand#11", "Brand#23", "Brand#45"],
+    "p_container": ["JUMBO BOX", "LG CASE", "SM PKG"],
+    "s_name": ["Supplier#000000001"],
+}
+_AGGS = ["count", "sum", "min", "max", "avg"]
+
+
+def _pick(rng: random.Random, xs):
+    return xs[rng.randrange(len(xs))]
+
+
+def _numeric_expr(rng, table) -> str:
+    c = _pick(rng, _NUMERIC[table] + _KEYS[table])
+    r = rng.random()
+    if r < 0.5:
+        return c
+    if r < 0.7:
+        return f"{c} + {rng.randrange(1, 100)}"
+    if r < 0.85:
+        return f"{c} * {rng.randrange(2, 9)}"
+    c2 = _pick(rng, _NUMERIC[table] + _KEYS[table])
+    return f"{c} + {c2}"
+
+
+def _predicate(rng, table, qual: str = "") -> str:
+    kind = rng.random()
+    p = qual
+    if kind < 0.35:
+        c = _pick(rng, _NUMERIC[table] + _KEYS[table])
+        op = _pick(rng, ["<", "<=", ">", ">=", "=", "<>"])
+        return f"{p}{c} {op} {rng.randrange(0, 50000)}"
+    if kind < 0.6 and _STRINGS.get(table):
+        c = _pick(rng, _STRINGS[table])
+        lits = _STR_LITS[c]
+        if rng.random() < 0.5:
+            return f"{p}{c} = '{_pick(rng, lits)}'"
+        ins = ", ".join(f"'{v}'" for v in lits[:2])
+        return f"{p}{c} in ({ins})"
+    if kind < 0.8 and _DATES.get(table):
+        c = _pick(rng, _DATES[table])
+        y = rng.randrange(1992, 1999)
+        return f"{p}{c} >= date '{y}-01-01'"
+    if kind < 0.9:
+        c = _pick(rng, _NUMERIC[table])
+        lo = rng.randrange(0, 1000)
+        return f"{p}{c} between {lo} and {lo + rng.randrange(1, 5000)}"
+    c = _pick(rng, _KEYS[table])
+    return f"{p}{c} % {rng.randrange(2, 7)} = 0"
+
+
+def generate_query(seed: int) -> str:
+    """One deterministic SELECT inside the supported surface."""
+    rng = random.Random(seed)
+    do_join = rng.random() < 0.35
+    if do_join:
+        lt, lc, rt, rc = _pick(rng, _JOINS)
+        from_clause = (
+            f"tpch.tiny.{lt}, tpch.tiny.{rt} "
+        )
+        join_cond = f"{lc} = {rc}"
+        tables = [lt, rt]
+    else:
+        lt = _pick(rng, list(_NUMERIC))
+        from_clause = f"tpch.tiny.{lt}"
+        join_cond = None
+        tables = [lt]
+
+    group_cols: List[str] = []
+    if rng.random() < 0.6:
+        t = _pick(rng, tables)
+        pool = _STRINGS.get(t, []) + _KEYS[t]
+        for _ in range(rng.randrange(1, 3)):
+            c = _pick(rng, pool)
+            if c not in group_cols:
+                group_cols.append(c)
+
+    items: List[str] = list(group_cols)
+    if group_cols or rng.random() < 0.7:
+        for i in range(rng.randrange(1, 4)):
+            agg = _pick(rng, _AGGS)
+            t = _pick(rng, tables)
+            if agg == "count" and rng.random() < 0.4:
+                items.append(f"count(*) as a{i}")
+            else:
+                items.append(f"{agg}({_numeric_expr(rng, t)}) as a{i}")
+        aggregated = True
+    else:
+        t = tables[0]
+        for i, c in enumerate(
+            (_KEYS[t] + _NUMERIC[t])[: rng.randrange(2, 5)]
+        ):
+            items.append(f"{c} as c{i}")
+        aggregated = False
+
+    preds = []
+    if join_cond:
+        preds.append(join_cond)
+    for _ in range(rng.randrange(0, 3)):
+        preds.append(_predicate(rng, _pick(rng, tables)))
+
+    sql = f"select {', '.join(items)} from {from_clause}"
+    if preds:
+        sql += " where " + " and ".join(preds)
+    if group_cols:
+        sql += " group by " + ", ".join(group_cols)
+        if rng.random() < 0.3:
+            sql += " having count(*) > 1"
+    # total order => the ordered oracle diff is deterministic
+    if aggregated and group_cols:
+        sql += " order by " + ", ".join(group_cols)
+    elif not aggregated:
+        keys = [i.split(" as ")[0] for i in items]
+        sql += " order by " + ", ".join(keys)
+        sql += f" limit {rng.randrange(10, 200)}"
+    return sql
+
+
+def run_fuzz(
+    seeds, runner=None, oracle=None, rel_tol: float = 1e-6
+) -> List[Tuple[int, str, Optional[str]]]:
+    """Run seeds; return [(seed, sql, diff|None)] for failures only."""
+    from presto_tpu.exec.local_runner import LocalQueryRunner
+    from presto_tpu.verifier import SqliteOracle, verify_query
+
+    runner = runner or LocalQueryRunner()
+    oracle = oracle or SqliteOracle("tiny")
+    failures = []
+    for seed in seeds:
+        sql = generate_query(seed)
+        try:
+            diff = verify_query(runner, oracle, sql, rel_tol=rel_tol)
+        except Exception as e:  # engine error = a finding too
+            diff = f"{type(e).__name__}: {e}"
+        if diff is not None:
+            failures.append((seed, sql, diff))
+    return failures
+
+
+def main() -> None:  # pragma: no cover - CLI
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--count", type=int, default=100)
+    args = ap.parse_args()
+    seeds = (
+        [args.seed]
+        if args.seed is not None
+        else range(args.start, args.start + args.count)
+    )
+    fails = run_fuzz(seeds)
+    for seed, sql, diff in fails:
+        print(f"seed {seed}: {sql}\n  -> {diff}\n")
+    print(f"{len(fails)} failures / {len(list(seeds))} queries")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
