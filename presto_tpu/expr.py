@@ -970,21 +970,23 @@ class ExprLowerer:
         if dst.is_long_decimal:
             if src.is_long_decimal:
                 if dst.scale < src.scale:
-                    raise NotImplementedError(
-                        "long-decimal downscale requires int128 division"
+                    h, l = int128.div_pow10_half_up(
+                        d[..., 0], d[..., 1], src.scale - dst.scale
                     )
-                h, l = int128.mul_pow10(
-                    d[..., 0], d[..., 1], dst.scale - src.scale
-                )
+                else:
+                    h, l = int128.mul_pow10(
+                        d[..., 0], d[..., 1], dst.scale - src.scale
+                    )
                 return jnp.stack([h, l], axis=-1), v
             if src.is_decimal or src.is_integer:
                 h, l = int128.from_i64(d.astype(jnp.int64))
                 from_scale = src.scale if src.is_decimal else 0
                 if dst.scale < from_scale:
-                    raise NotImplementedError(
-                        "long-decimal downscale requires int128 division"
+                    h, l = int128.div_pow10_half_up(
+                        h, l, from_scale - dst.scale
                     )
-                h, l = int128.mul_pow10(h, l, dst.scale - from_scale)
+                else:
+                    h, l = int128.mul_pow10(h, l, dst.scale - from_scale)
                 return jnp.stack([h, l], axis=-1), v
             if src.name in ("double", "real"):
                 raise NotImplementedError(
@@ -995,20 +997,20 @@ class ExprLowerer:
             f = int128.to_f64(d[..., 0], d[..., 1]) * (10.0 ** -src.scale)
             return f.astype(dst.jnp_dtype), v
         if dst.is_decimal or dst.is_integer:
-            # in-range narrowing: take the low limb after descaling to
-            # the target scale; values beyond int64 wrap (the reference
-            # raises on overflow — documented deviation)
+            # narrowing: rescale in int128 (half-up on downscale, like
+            # the reference's rescale-with-round), then take the low
+            # limb; values beyond int64 wrap (the reference raises on
+            # overflow — documented deviation)
             to_scale = dst.scale if dst.is_decimal else 0
+            h, l = d[..., 0], d[..., 1]
             if to_scale > src.scale:
-                h, l = int128.mul_pow10(
-                    d[..., 0], d[..., 1], to_scale - src.scale
+                h, l = int128.mul_pow10(h, l, to_scale - src.scale)
+            elif to_scale < src.scale:
+                h, l = int128.div_pow10_half_up(
+                    h, l, src.scale - to_scale
                 )
-                return l, v
-            if to_scale < src.scale:
-                raise NotImplementedError(
-                    "long-decimal downscale requires int128 division"
-                )
-            return d[..., 1], v
+            # dtype-faithful narrowing, like the short-decimal path
+            return l.astype(dst.jnp_dtype), v
         raise NotImplementedError(f"cast {src} -> {dst}")
 
     # -- predicates --------------------------------------------------------

@@ -92,3 +92,62 @@ def to_f64(h, l):
     return h.astype(jnp.float64) * jnp.float64(2.0 ** 64) + _u(l).astype(
         jnp.float64
     )
+
+
+def _divmod_u128_small(uh, ul, d: int):
+    """Unsigned (uh, ul as uint64) // d for python 0 < d < 2**31.
+    Schoolbook long division in 32-bit chunks: every partial dividend
+    (rem << 32 | chunk) < 2^63, so plain uint64 ops suffice."""
+    du = np.uint64(d)
+    q3 = uh >> np.uint64(32)
+    r = q3 % du
+    q3 = q3 // du
+    t = (r << np.uint64(32)) | (uh & _MASK32)
+    q2 = t // du
+    r = t % du
+    t = (r << np.uint64(32)) | (ul >> np.uint64(32))
+    q1 = t // du
+    r = t % du
+    t = (r << np.uint64(32)) | (ul & _MASK32)
+    q0 = t // du
+    r = t % du
+    out_h = (q3 << np.uint64(32)) | q2
+    out_l = (q1 << np.uint64(32)) | q0
+    return out_h, out_l, r
+
+
+def div_pow10_half_up(h, l, k: int):
+    """(h, l) / 10**k with SQL half-up rounding away from zero — the
+    long-decimal downscale primitive (reference: Int128Math
+    rescaleTruncate/round pair). k <= 18 (two 10^9 chunks; larger
+    downscales do not occur in decimal(38) practice)."""
+    if k == 0:
+        return h, l
+    if k > 18:
+        raise NotImplementedError(
+            f"long-decimal downscale by 10^{k} (>18 digits)"
+        )
+    is_neg = h < 0
+    nh, nl = neg(h, l)
+    uh = jnp.where(is_neg, nh, h).astype(_U64)
+    ul = jnp.where(is_neg, nl, l).astype(_U64)
+    d1 = 10 ** min(k, 9)
+    qh, ql, r1 = _divmod_u128_small(uh, ul, d1)
+    rem = r1
+    dd = np.uint64(d1)
+    if k > 9:
+        d2 = 10 ** (k - 9)
+        qh, ql, r2 = _divmod_u128_small(qh, ql, d2)
+        # total remainder = r2*d1 + r1 < 10^18, fits uint64
+        rem = r2 * np.uint64(d1) + r1
+        dd = np.uint64(d1) * np.uint64(d2)
+    half = dd // np.uint64(2) + dd % np.uint64(2)  # ceil(d/2): half-up
+    carry = (rem >= half).astype(jnp.int64)
+    qh = qh.astype(jnp.int64)
+    ql = ql.astype(jnp.int64)
+    qh, ql = add(qh, ql, jnp.zeros_like(qh), carry)
+    back_h, back_l = neg(qh, ql)
+    return (
+        jnp.where(is_neg, back_h, qh),
+        jnp.where(is_neg, back_l, ql),
+    )

@@ -217,8 +217,9 @@ class LongDecimalType(DataType):
     can't chew).
 
     Supported surface this round: scans (parquet/ORC/memory/pylist),
-    comparisons, +/-/negate, casts (short<->long, ->double, ->bigint),
-    projection and exact host materialization (``decimal.Decimal``).
+    comparisons, +/-/negate, casts (short<->long incl. half-up
+    downscale via int128 division, ->double, ->bigint), projection and
+    exact host materialization (``decimal.Decimal``).
     Documented deviation: long decimals as GROUP BY / join / sort keys
     and as aggregate inputs raise PlanningError — cast to
     decimal(18,s) or double to aggregate (no benchmark config needs a

@@ -69,6 +69,37 @@ def test_int128_device_ops_match_python():
     ] == [x * 10 ** 4 for x in a]
 
 
+def test_int128_div_pow10_half_up():
+    from presto_tpu import int128
+    import jax.numpy as jnp
+
+    vals = [
+        0, 1, 5, -5, 12345, -12345, (1 << 100) + 987654321,
+        -(1 << 100) - 987654321, 10 ** 30 + 5 * 10 ** 11,
+        -(10 ** 30) - 5 * 10 ** 11, 15, 25, -15, -25, 449, 450, -450,
+    ]
+    limbs = T.int128_limbs(vals)
+    h, l = jnp.asarray(limbs[:, 0]), jnp.asarray(limbs[:, 1])
+    for k in (1, 2, 9, 12, 18):
+        qh, ql = int128.div_pow10_half_up(h, l, k)
+        got = [T.int128_value(int(a), int(b)) for a, b in zip(qh, ql)]
+        f = 10 ** k
+        expect = [
+            (abs(v) + f // 2) // f * (1 if v >= 0 else -1) for v in vals
+        ]
+        assert got == expect, (k, got, expect)
+
+
+def test_cast_downscale_and_to_bigint(runner):
+    rows = runner.execute(
+        "select cast(cast(123.456 as decimal(30,6)) as decimal(10,2)) "
+        "as a, cast(cast(987654321.987 as decimal(25,3)) as bigint) as b, "
+        "cast(cast(-2.5 as decimal(20,1)) as bigint) as c"
+    ).rows()
+    # half-up away from zero, matching the engine's ingest rounding
+    assert rows == [(123.46, 987654322, -3)]
+
+
 def test_page_roundtrip_exact():
     t = T.decimal(30, 2)
     vals = [
