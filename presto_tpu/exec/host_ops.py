@@ -86,13 +86,15 @@ def apply_host_ops(
     # latency AND low D2H bandwidth): 1 scalar fetch for the live count,
     # device-side slices down to n rows, then ONE batched device_get of
     # the small slices (async dispatches pipeline; transfers batch).
+    # A page that is ALREADY host-side (the speculative single-round-
+    # trip materialization) skips the fetch entirely.
     n = int(page.num_valid)
     leaves = []
     for blk in page.blocks:
         leaves.append(blk.data[:n])
         if blk.valid is not None:
             leaves.append(blk.valid[:n])
-    fetched = jax.device_get(leaves)
+    fetched = leaves if page.is_host else jax.device_get(leaves)
     cols = {}  # name -> (np_data, np_valid, dtype, dictionary)
     i = 0
     for name, blk in zip(page.names, page.blocks):

@@ -268,6 +268,14 @@ class Page:
             return self.live
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_valid
 
+    @property
+    def is_host(self) -> bool:
+        """True when block data already lives host-side as numpy (a
+        materialized page) — fetches/materialization are no-ops then."""
+        return bool(self.blocks) and isinstance(
+            self.blocks[0].data, np.ndarray
+        )
+
     def with_blocks(self, names: Sequence[str], blocks: Sequence[Block]) -> "Page":
         return Page(
             blocks=tuple(blocks),

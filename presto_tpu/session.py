@@ -51,6 +51,14 @@ def _positive(name):
     return check
 
 
+def _non_negative(name):
+    def check(v):
+        if v < 0:
+            raise ValueError(f"{name} must be >= 0, got {v}")
+
+    return check
+
+
 #: Engine-wide session properties (reference: SystemSessionProperties).
 SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
     p.name: p
@@ -68,6 +76,16 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int,
             1,
             _positive("task_concurrency"),
+        ),
+        PropertyMetadata(
+            "speculative_result_rows",
+            "Result-prefix rows piggybacked on the control fetch: "
+            "results this small materialize in ONE device round trip "
+            "(0 disables; the tunnel RTT is ~65ms, the speculative "
+            "bytes ~1ms/MB)",
+            int,
+            1024,
+            _non_negative("speculative_result_rows"),
         ),
         PropertyMetadata(
             "distributed_final",

@@ -99,3 +99,43 @@ def test_task_concurrency_and_split_batches_over_http(oracle_mod):
 @pytest.fixture(scope="module")
 def oracle_mod():
     return SqliteOracle("tiny")
+
+
+def test_speculative_result_rows_single_round_trip(oracle_mod):
+    """speculative_result_rows pins the one-round-trip materialization:
+    a small aggregate result must need exactly ONE device_get; with the
+    property 0, the control+materialize pair (two fetches) returns."""
+    import jax
+
+    from presto_tpu.exec import local_runner as LR
+
+    r = LR.LocalQueryRunner()
+    sql = (
+        "select l_returnflag, count(*) as n from tpch.tiny.lineitem "
+        "group by l_returnflag order by l_returnflag"
+    )
+    r.execute(sql).rows()  # warm: staging + compile out of the count
+
+    calls = []
+    orig = jax.device_get
+
+    def spy(x):
+        calls.append(1)
+        return orig(x)
+
+    jax.device_get, LR.jax.device_get = spy, spy
+    try:
+        rows1 = r.execute(sql).rows()
+        one = len(calls)
+        calls.clear()
+        r.session.set("speculative_result_rows", 0)
+        rows2 = r.execute(sql).rows()
+        two = len(calls)
+    finally:
+        jax.device_get = LR.jax.device_get = orig
+        r.session.set("speculative_result_rows", 1024)
+    assert rows1 == rows2
+    diff = verify_query(r, oracle_mod, sql)
+    assert diff is None, diff
+    assert one == 1, f"speculative path used {one} fetches"
+    assert two == 2, f"fallback path used {two} fetches"
