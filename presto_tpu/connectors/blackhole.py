@@ -64,7 +64,7 @@ class BlackholeConnector(Connector):
     def append_rows(self, handle, data):
         pass  # the sink half: swallow everything
 
-    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20, constraint=()):
         n = self._tables[(handle.schema, handle.table)]["rows"]
         splits = [
             ConnectorSplit(handle, lo, min(lo + target_split_rows, n))

@@ -213,13 +213,30 @@ class HiveConnector(Connector):
         return f.pf
 
     def get_splits(
-        self, handle: TableHandle, target_split_rows: int = 1 << 20
+        self,
+        handle: TableHandle,
+        target_split_rows: int = 1 << 20,
+        constraint=(),
     ) -> SplitSource:
         """File-aligned splits over the global row space (big files
-        split further at row-group-sized boundaries)."""
-        files, _ = self._layout(handle)
+        split further at row-group-sized boundaries). PARTITION
+        PRUNING: files whose path key values fall outside the pushed
+        constraint produce no splits at all — zero bytes read for
+        excluded partitions (reference: TupleDomain reaching the hive
+        split manager)."""
+        files, part_types = self._layout(handle)
+        domains = {
+            col: set(vals)
+            for col, vals in constraint
+            if col in part_types
+        }
         splits: List[ConnectorSplit] = []
         for f in files:
+            if not all(
+                _key_matches(f.keys[col], part_types[col], vals)
+                for col, vals in domains.items()
+            ):
+                continue
             lo = f.row_start
             while lo < f.row_end:
                 hi = min(lo + target_split_rows, f.row_end)
@@ -290,6 +307,24 @@ class HiveConnector(Connector):
                 )
 
     # hive partition values come from the PATH: one constant per file
+
+
+def _key_matches(raw: str, t: T.DataType, allowed: set) -> bool:
+    """Does a path key value satisfy a pushed value-set constraint?
+    BIGINT keys compare numerically — including string-carried integer
+    literals (the planner's IN-list coercion keeps '2024' as str);
+    anything unparseable keeps the file (over-retain, never
+    over-prune: the filter still applies)."""
+    if t.name == "bigint":
+        out = False
+        for v in allowed:
+            try:
+                if int(str(v)) == int(raw):
+                    return True
+            except (TypeError, ValueError):
+                return True  # can't interpret: don't prune on it
+        return out
+    return str(raw) in {str(v) for v in allowed}
 
 
 def _const_column(value: str, t: T.DataType, n: int):

@@ -71,7 +71,7 @@ class MemoryConnector(Connector):
             )
         self._store.tables[key] = (schema, merged)
 
-    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20, constraint=()):
         schema, data = self._store.tables[(handle.schema, handle.table)]
         n = len(next(iter(data.values()))) if data else 0
         splits = [

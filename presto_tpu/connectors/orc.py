@@ -129,7 +129,8 @@ class OrcConnector(Connector):
         return offs
 
     def get_splits(
-        self, handle: TableHandle, target_split_rows: int = 1 << 20
+        self, handle: TableHandle, target_split_rows: int = 1 << 20,
+        constraint=(),
     ) -> SplitSource:
         """Stripe-aligned splits (the reference's ORC split boundary),
         expressed as row ranges so the split protocol stays

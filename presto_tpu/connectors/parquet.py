@@ -132,7 +132,8 @@ class ParquetConnector(Connector):
         return pf
 
     def get_splits(
-        self, handle: TableHandle, target_split_rows: int = 1 << 20
+        self, handle: TableHandle, target_split_rows: int = 1 << 20,
+        constraint=(),
     ) -> SplitSource:
         """Row-group-aligned splits (the reference's parquet split
         boundary); expressed as row ranges so the engine's split

@@ -120,8 +120,16 @@ class Connector:
         raise NotImplementedError
 
     def get_splits(
-        self, handle: TableHandle, target_split_rows: int = 1 << 20
+        self,
+        handle: TableHandle,
+        target_split_rows: int = 1 << 20,
+        constraint: Sequence = (),
     ) -> SplitSource:
+        """Enumerate splits. ``constraint`` is TupleDomain-lite advice
+        from the planner — (column, allowed-values) pairs a connector
+        MAY use to skip splits (hive partition pruning); the engine
+        still applies the originating filter, so ignoring it is always
+        correct (and the default implementations do)."""
         raise NotImplementedError
 
     def create_page_source(

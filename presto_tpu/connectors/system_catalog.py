@@ -87,7 +87,7 @@ class SystemConnector(Connector):
     def cacheable(self):
         return False  # live data: never reuse staged pages
 
-    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20, constraint=()):
         return SplitSource([ConnectorSplit(handle, 0, 0)])
 
     def create_page_source(self, split: ConnectorSplit, columns: Sequence[str]):

@@ -908,7 +908,7 @@ class TpcdsConnector(Connector):
             self._gens[schema] = TpcdsGenerator(SCHEMAS[schema])
         return self._gens[schema]
 
-    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20):
+    def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20, constraint=()):
         n = self._gen(handle.schema).counts[handle.table]
         splits = [
             ConnectorSplit(handle, lo, min(lo + target_split_rows, n))

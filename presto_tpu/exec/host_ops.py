@@ -89,11 +89,7 @@ def apply_host_ops(
     # A page that is ALREADY host-side (the speculative single-round-
     # trip materialization) skips the fetch entirely.
     n = int(page.num_valid)
-    leaves = []
-    for blk in page.blocks:
-        leaves.append(blk.data[:n])
-        if blk.valid is not None:
-            leaves.append(blk.valid[:n])
+    leaves = page.prefix_leaves(n)
     fetched = leaves if page.is_host else jax.device_get(leaves)
     cols = {}  # name -> (np_data, np_valid, dtype, dictionary)
     i = 0

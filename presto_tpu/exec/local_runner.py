@@ -46,7 +46,10 @@ from presto_tpu.ops import (
 )
 from presto_tpu.page import Block, Page, compact_page
 from presto_tpu.plan import nodes as N
-from presto_tpu.plan.optimizer import prune_columns
+from presto_tpu.plan.optimizer import (
+    prune_columns,
+    push_scan_constraints,
+)
 from presto_tpu.plan.planner import Plan, plan_statement
 from presto_tpu.session import Session
 from presto_tpu.sql import parse_statement
@@ -282,7 +285,7 @@ class LocalQueryRunner:
         prev, self._active_qs = self._active_qs, qs
         try:
             root = self._bind_params(plan)
-            root = prune_columns(root)
+            root = push_scan_constraints(prune_columns(root))
             host_ops: List[N.PlanNode] = []
             if self.session.get("host_root_stage"):
                 root, host_ops = peel_host_ops(root)
@@ -313,7 +316,9 @@ class LocalQueryRunner:
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
         from presto_tpu.exec.stats import collect_node_stats
 
-        bound_root = prune_columns(self._bind_params(plan))
+        bound_root = push_scan_constraints(
+            prune_columns(self._bind_params(plan))
+        )
         root = bound_root
         host_ops: List[N.PlanNode] = []
         if self.session.get("host_root_stage"):
@@ -343,7 +348,7 @@ class LocalQueryRunner:
         bindings: Dict[int, E.Literal] = {}
         for pid, sub in plan.params:
             sub_root = self._bind_params(sub)
-            sub_root = prune_columns(sub_root)
+            sub_root = push_scan_constraints(prune_columns(sub_root))
             page = self._run(sub_root)
             col = sub.output_names[0]
             bindings[pid] = _scalar_literal(page, col)
@@ -458,10 +463,7 @@ class LocalQueryRunner:
             )
             leaves: List = [flags_arr, err_arr, cnt_arr, page.num_valid]
             if spec > 0:
-                for blk in page.blocks:
-                    leaves.append(blk.data[:spec])
-                    if blk.valid is not None:
-                        leaves.append(blk.valid[:spec])
+                leaves.extend(page.prefix_leaves(spec))
             fetched = jax.device_get(leaves)
             flags_np, err_np, cnt_np, n_out = fetched[:4]
             for msg, flag in zip(msgs_cell, err_np):
@@ -491,7 +493,15 @@ class LocalQueryRunner:
             root = _scale_capacities(root, 4)
 
     def _load_table(self, scan: N.TableScanNode) -> Page:
-        key = (scan.handle, scan.columns, self.session.get("tpu_offload"))
+        # constraint is part of the identity: a partition-pruned page
+        # must never serve an unconstrained (or differently-constrained)
+        # scan of the same table
+        key = (
+            scan.handle,
+            scan.columns,
+            scan.constraint,
+            self.session.get("tpu_offload"),
+        )
         page = self._table_cache.get(key)
         if page is None:
             t0 = time.perf_counter()
@@ -533,9 +543,15 @@ class LocalQueryRunner:
         return page
 
     def _load_merged_payload(self, scan: N.TableScanNode) -> Dict:
-        """Fetch all splits of a scan and merge their column payloads."""
+        """Fetch all splits of a scan and merge their column payloads.
+        The scan's pushed constraint reaches the connector here (hive
+        partition pruning; other connectors ignore it)."""
         conn = self.catalogs.get(scan.handle.catalog)
-        src = conn.get_splits(scan.handle, target_split_rows=1 << 22)
+        src = conn.get_splits(
+            scan.handle,
+            target_split_rows=1 << 22,
+            constraint=scan.constraint,
+        )
         datas = []
         while not src.exhausted:
             for split in src.next_batch(64):
@@ -584,12 +600,9 @@ def materialize_page(page: Page, n: int) -> Page:
     still hits the per-bucket compile cache."""
     if not page.blocks or page.is_host:
         return page
-    leaves = []
-    for blk in page.blocks:
-        leaves.append(blk.data[:n])
-        if blk.valid is not None:
-            leaves.append(blk.valid[:n])
-    return _page_from_prefix(page, jax.device_get(leaves), n)
+    return _page_from_prefix(
+        page, jax.device_get(page.prefix_leaves(n)), n
+    )
 
 
 def page_np_dtype(blk: Block):

@@ -276,6 +276,17 @@ class Page:
             self.blocks[0].data, np.ndarray
         )
 
+    def prefix_leaves(self, k) -> list:
+        """Flat [data[:k], valid[:k]?, ...] leaf list for a batched
+        device->host fetch of the first ``k`` rows — the ONE shape every
+        materialization path fetches (round-trip discipline)."""
+        leaves = []
+        for blk in self.blocks:
+            leaves.append(blk.data[:k])
+            if blk.valid is not None:
+                leaves.append(blk.valid[:k])
+        return leaves
+
     def with_blocks(self, names: Sequence[str], blocks: Sequence[Block]) -> "Page":
         return Page(
             blocks=tuple(blocks),

@@ -216,7 +216,10 @@ class DistributedQueryRunner(LocalQueryRunner):
     def _load_table_sharded(self, scan: N.TableScanNode) -> Page:
         from presto_tpu.connectors.spi import payload_len
 
-        key = (scan.handle, scan.columns, self.n)
+        # constraint in the key: a partition-pruned page must never
+        # serve a differently-constrained scan (same hazard as the
+        # local _load_table cache)
+        key = (scan.handle, scan.columns, scan.constraint, self.n)
         table = self._shard_cache.get(key)
         total = None
         if table is None:

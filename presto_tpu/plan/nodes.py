@@ -37,6 +37,12 @@ class TableScanNode(PlanNode):
     handle: TableHandle
     columns: Tuple[str, ...]
     schema: Tuple[Tuple[str, T.DataType], ...]  # ordered (name, type)
+    #: TupleDomain-lite pushdown (reference: TupleDomain reaching
+    #: ConnectorSplitManager): (column, allowed literal values) pairs
+    #: derived from filters ABOVE the scan — advisory for split
+    #: enumeration (hive partition pruning); the filter itself still
+    #: applies, so ignoring the constraint is always correct.
+    constraint: Tuple[Tuple[str, Tuple], ...] = ()
 
     def output_schema(self):
         return dict(self.schema)

@@ -375,3 +375,104 @@ def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
     if isinstance(node, N.ValuesNode):
         return node
     return node
+
+
+def push_scan_constraints(node: N.PlanNode) -> N.PlanNode:
+    """TupleDomain-lite pushdown (reference: PickTableLayout pushing
+    TupleDomain into the split manager): collect ``col = literal`` and
+    ``col IN (literals)`` conjuncts from FilterNodes sitting directly
+    above a scan (through other filters) and annotate the scan's
+    ``constraint``. The filter stays in place — the constraint only
+    lets connectors skip splits (hive partition pruning); ignoring it
+    is always correct."""
+    if isinstance(node, N.FilterNode):
+        chain = [node]
+        src = node.source
+        while isinstance(src, N.FilterNode):
+            chain.append(src)
+            src = src.source
+        if isinstance(src, N.TableScanNode):
+            domains: Dict[str, tuple] = {}
+            for f in chain:
+                for c in _conjuncts_of(f.predicate):
+                    col_vals = _equality_domain(c)
+                    if col_vals is None:
+                        continue
+                    col, vals = col_vals
+                    if col in domains:
+                        vals = tuple(
+                            v for v in vals if v in set(domains[col])
+                        )
+                    domains[col] = vals
+            if domains:
+                scan = dataclasses.replace(
+                    src,
+                    constraint=tuple(sorted(domains.items())),
+                )
+                rebuilt: N.PlanNode = scan
+                for f in reversed(chain):
+                    rebuilt = dataclasses.replace(f, source=rebuilt)
+                return rebuilt
+        return dataclasses.replace(
+            node, source=push_scan_constraints(node.source)
+        )
+    kids = node.children()
+    if not kids:
+        return node
+    changed = False
+    updates = {}
+    for fname, val in (
+        (f.name, getattr(node, f.name))
+        for f in dataclasses.fields(node)
+        if dataclasses.is_dataclass(type(node))
+    ):
+        if isinstance(val, N.PlanNode):
+            new = push_scan_constraints(val)
+            if new is not val:
+                updates[fname] = new
+                changed = True
+    return dataclasses.replace(node, **updates) if changed else node
+
+
+def _equality_domain(e: E.Expr):
+    """ColumnRef = Literal  /  ColumnRef IN (literals)  ->
+    (column, values) or None. Only integer- and string-typed literals
+    become domains: a decimal literal's stored value is UNSCALED (2024.0
+    -> 20240), so passing it through would prune wrongly — those
+    predicates simply stay unpruned filters."""
+    if (
+        isinstance(e, E.Compare)
+        and e.op == "="
+        and isinstance(e.left, E.ColumnRef)
+        and _domain_value(e.right) is not None
+    ):
+        return e.left.name, (_domain_value(e.right),)
+    if (
+        isinstance(e, E.Compare)
+        and e.op == "="
+        and isinstance(e.right, E.ColumnRef)
+        and _domain_value(e.left) is not None
+    ):
+        return e.right.name, (_domain_value(e.left),)
+    if (
+        isinstance(e, E.InList)
+        and not e.negate
+        and isinstance(e.arg, E.ColumnRef)
+        and all(_domain_value(v) is not None for v in e.values)
+    ):
+        return e.arg.name, tuple(_domain_value(v) for v in e.values)
+    return None
+
+
+def _domain_value(lit: E.Expr):
+    """Literal -> the value a connector compares partition keys
+    against, or None when the literal cannot safely become a domain
+    (non-literal, NULL, or a scaled-decimal whose stored value is the
+    unscaled integer)."""
+    if not isinstance(lit, E.Literal) or lit.value is None:
+        return None
+    if lit.dtype.is_string:
+        return str(lit.value)
+    if lit.dtype.is_integer:
+        return lit.value
+    return None

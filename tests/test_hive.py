@@ -150,6 +150,63 @@ def test_join_with_tpch(runner, warehouse):
     assert sum(n for _, n in got) == len(rows)
 
 
+def test_partition_pruning_skips_files(runner, warehouse, monkeypatch):
+    """`where region = 'east' and year = 2024` must open only that
+    partition's files (TupleDomain-lite pushdown into get_splits) and
+    still be exact."""
+    from presto_tpu.connectors import hive as hive_mod
+
+    _, rows = warehouse
+    opened = []
+    orig = hive_mod.HiveConnector._append_file_range
+
+    def spy(self, f, lo, hi, columns, schema, part_types, out):
+        opened.append(f.keys.copy())
+        return orig(self, f, lo, hi, columns, schema, part_types, out)
+
+    monkeypatch.setattr(
+        hive_mod.HiveConnector, "_append_file_range", spy
+    )
+    got = runner.execute(
+        "select count(*) as n, sum(amount) as s from hive.sales.orders "
+        "where region = 'east' and year = 2024"
+    ).rows()
+    expect = [
+        (
+            sum(1 for r in rows if r[0] == "east" and r[1] == 2024),
+            sum(r[3] for r in rows if r[0] == "east" and r[1] == 2024),
+        )
+    ]
+    assert got == expect
+    assert opened, "no files read at all?"
+    assert all(
+        k == {"region": "east", "year": "2024"} for k in opened
+    ), f"pruning leaked partitions: {opened}"
+
+
+def test_pruned_page_not_cached_for_unconstrained_scan(runner, warehouse):
+    """The table cache must key on the constraint: a full scan after a
+    pruned scan sees ALL partitions."""
+    _, rows = warehouse
+    runner.execute(
+        "select count(*) as n from hive.sales.orders "
+        "where region = 'west' and year = 2023"
+    ).rows()
+    got = runner.execute(
+        "select count(*) as n from hive.sales.orders"
+    ).rows()
+    assert got == [(len(rows),)]
+
+
+def test_in_list_pruning(runner, warehouse):
+    _, rows = warehouse
+    got = runner.execute(
+        "select count(*) as n from hive.sales.orders "
+        "where region in ('west', 'north')"
+    ).rows()
+    assert got == [(sum(1 for r in rows if r[0] == "west"),)]
+
+
 def test_decimal_scale_evolution_across_files(tmp_path):
     """Schema evolution: a later file storing the decimal at a finer
     scale must normalize to the table schema (derived from the first
