@@ -509,26 +509,87 @@ class CoordinatorServer:
         created: List[tuple] = []
         clock = threading.Lock()
 
+        # PIPELINED shuffle start (reference: merge stages run
+        # concurrently with their producers; sources attach via
+        # addExchangeLocations): merge tasks are created FIRST with no
+        # sources, each producer is announced the moment its task is
+        # POSTed (pulls overlap production), and the set is sealed when
+        # every range completes. Limitation vs full recoverability: a
+        # producer dying after announcement fails the query (classic
+        # non-recoverable exchange; the gather path's range retry
+        # remains the recoverable fallback).
+        merge_specs: List[tuple] = []
+
+        def broadcast(source_list, done: bool):
+            # transient PUT drops are healed by the SEAL broadcast,
+            # which always carries the FULL deduped source list; a
+            # dead merge worker surfaces at the pull
+            body = {
+                "sources": [list(s) for s in source_list],
+                "done": done,
+            }
+            for w, spec in merge_specs:
+                try:
+                    self._http_json(
+                        "PUT",
+                        f"{w.uri}/v1/task/{spec.task_id}/sources",
+                        body,
+                    )
+                except Exception:
+                    pass
+
         def wait_producer(w, spec):
             with clock:
                 created.append((w, spec.task_id))
+            broadcast([(w.uri, spec.task_id)], False)
             self._wait_task(w, spec)
             return (w, spec.task_id)
 
         try:
+            # merge tasks first, placed on live workers (a worker that
+            # died since discovery is skipped, not fatal)
+            candidates = list(workers)
+            for i in range(nparts):
+                posted = False
+                for k in range(len(candidates)):
+                    w = candidates[(i + k) % len(candidates)]
+                    spec = FragmentSpec(
+                        task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
+                        query_id=q.qid,
+                        fragment=bucket_root,
+                        partition_scan=-1,
+                        split_start=0,
+                        split_end=0,
+                        partition=i,
+                    )
+                    try:
+                        self._http_json(
+                            "POST", w.uri + "/v1/task", spec.to_json()
+                        )
+                    except (
+                        urllib.error.URLError, ConnectionError, OSError
+                    ):
+                        continue
+                    merge_specs.append((w, spec))
+                    posted = True
+                    break
+                if not posted:
+                    raise RuntimeError(
+                        "no live worker accepts merge tasks"
+                    )
+
             producers = self._ranged_tasks(
-                workers, ranges, make_spec, wait_producer
+                workers, ranges, make_spec, wait_producer, retry=False
             )
             sources = tuple((w.uri, tid) for w, tid in producers)
+            # seal with the FULL list: add_sources dedups, so this
+            # also repairs any announcement a merge task missed
+            broadcast(sources, True)
 
-            # merge tasks are placed on CURRENTLY-live workers (the
-            # stage-1 worker set may have shrunk) and retried once
-            # elsewhere on worker death. Limitation vs the reference's
-            # full recoverability: a producer dying AFTER stage 1 loses
-            # its buffered partitions and fails the query (classic
-            # non-recoverable exchange; the gather path's range retry
-            # remains the recoverable fallback).
-            def run_merge_on(i: int, w):
+            def run_merge_fallback(i: int, w):
+                # merge-worker death: re-run that partition's FINAL as
+                # a barrier-mode merge task (full source list known by
+                # now) on a live worker
                 spec = FragmentSpec(
                     task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
                     query_id=q.qid,
@@ -555,10 +616,9 @@ class CoordinatorServer:
                         pass
 
             def run_merge(i: int):
-                live = self.active_workers() or list(workers)
-                w = live[i % len(live)]
+                w, spec = merge_specs[i]
                 try:
-                    return run_merge_on(i, w)
+                    return self._pull_task(w, spec)
                 except (
                     urllib.error.URLError, ConnectionError, OSError
                 ):
@@ -570,7 +630,7 @@ class CoordinatorServer:
                     if not others:
                         raise
                     REGISTRY.counter("coordinator.tasks_retried").update()
-                    return run_merge_on(i, others[i % len(others)])
+                    return run_merge_fallback(i, others[i % len(others)])
 
             with ThreadPoolExecutor(nparts) as pool:
                 futs = [
@@ -578,6 +638,13 @@ class CoordinatorServer:
                 ]
                 payloads = [p for f in futs for p in f.result()]
         finally:
+            for w, spec in merge_specs:
+                try:
+                    self._http_json(
+                        "DELETE", f"{w.uri}/v1/task/{spec.task_id}", None
+                    )
+                except Exception:
+                    pass
             for w, tid in created:
                 try:
                     self._http_json(
@@ -608,15 +675,17 @@ class CoordinatorServer:
             rest_root, rest_remote + local_scans, pages
         )
 
-    def _ranged_tasks(self, workers, ranges, make_spec, consume):
+    def _ranged_tasks(self, workers, ranges, make_spec, consume, retry=True):
         """Dynamic split placement shared by the gather and shuffle
         paths: over-partitioned ranges in a queue, each worker's thread
         pulls the next unclaimed range (work stealing by queue), a DEAD
-        worker's range is retried once on a live one. ``consume(w,
-        spec)`` runs after the task POST (pull pages, or await FINISH);
-        its results are collected in arbitrary order. Execution errors
-        inside a healthy worker are NOT retried — they would fail
-        anywhere."""
+        worker's range is retried once on a live one (``retry=False``
+        disables that — the pipelined shuffle must NOT re-produce a
+        range whose first task was already announced to merge tasks,
+        or its rows double-count). ``consume(w, spec)`` runs after the
+        task POST (pull pages, or await FINISH); its results are
+        collected in arbitrary order. Execution errors inside a healthy
+        worker are NOT retried — they would fail anywhere."""
         import queue as _queue
         from concurrent.futures import ThreadPoolExecutor
 
@@ -628,7 +697,7 @@ class CoordinatorServer:
                 )
                 return consume(w, spec)
             except (urllib.error.URLError, ConnectionError, OSError):
-                if retried:
+                if retried or not retry:
                     raise
                 alive = [
                     a

@@ -64,6 +64,22 @@ class _Task:
         # safety-net release at task end must not free them
         self.pool = pool
         self.buf_key = f"{spec.query_id}#buf#{spec.task_id}"
+        # merge tasks: dynamically-attached upstream sources
+        # (reference: addExchangeLocations + noMoreExchangeLocations)
+        self.sources: List[tuple] = [tuple(s) for s in spec.sources]
+        self.sources_done: bool = bool(spec.sources)
+
+    def add_sources(self, sources, done: bool) -> None:
+        with self.cond:
+            known = set(self.sources)
+            for s in sources:
+                s = tuple(s)
+                if s not in known:
+                    self.sources.append(s)
+                    known.add(s)
+            if done:
+                self.sources_done = True
+            self.cond.notify_all()
 
     def drop_buffers(self) -> None:
         """Release every remaining buffered byte (DELETE/abort path)."""
@@ -79,11 +95,15 @@ class _Task:
         """Producer side: blocks while the buffer is full (backpressure);
         raises if the task was aborted while blocked.
 
-        Partitioned (shuffle) buffers are stage-lifetime: the merge
-        stage attaches only after every producer FINISHES, so blocking
-        on a full buffer would deadlock the stage. They hold compressed
-        PARTIAL states (small by construction); the bounded-buffer
-        backpressure applies to the unpartitioned streaming path."""
+        Partitioned (shuffle) buffers are stage-lifetime and exempt
+        from the bounded-buffer wait: the merge stage attaches
+        asynchronously (pipelined start) with no guarantee of pulling
+        before this producer FINISHES, so blocking on a full buffer
+        could deadlock the stage. They hold compressed PARTIAL states
+        (small by construction) and every buffered byte is accounted
+        against the MemoryPool — a too-big shuffle fails on accounting,
+        not OOM. The bounded-buffer backpressure applies to the
+        unpartitioned streaming path."""
         with self.cond:
             while (
                 len(self.parts) == 1
@@ -261,7 +281,9 @@ class WorkerServer:
         ``task_concurrency`` drivers overlap host staging with device
         execution."""
         spec = task.spec
-        if spec.sources:
+        if spec.sources or spec.partition_scan < 0:
+            # merge task: static sources (barrier mode) or dynamically
+            # attached ones (pipelined shuffle; partition_scan=-1)
             return self._execute_merge(task)
         root = spec.fragment
         # a pushed-down root sort (ordered MERGE exchange: coordinator
@@ -366,13 +388,40 @@ class WorkerServer:
         partition and per-partition FINAL results concatenate."""
         REGISTRY.counter("worker.merge_tasks").update()
         spec = task.spec
+        # dynamic source loop (reference: ExchangeClient consuming
+        # addExchangeLocations until noMoreLocations): pull every known
+        # source's partition — pulls OVERLAP production, since the
+        # token loop polls until the producer reports complete — and
+        # wait for more until the coordinator marks the set done
         payloads = []
-        for uri, src_task in spec.sources:
-            payloads.extend(
-                _pull_partition(
-                    uri, src_task, spec.partition, self.runner.session
+        pulled = set()
+        deadline = time.time() + float(
+            self.runner.session.get("query_max_run_time_s")
+        )
+        while True:
+            with task.cond:
+                pending = [
+                    s for s in task.sources if tuple(s) not in pulled
+                ]
+                if not pending:
+                    if task.sources_done:
+                        break
+                    if task.state == "ABORTED":
+                        raise RuntimeError("merge task aborted")
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            "merge task timed out waiting for sources"
+                        )
+                    task.cond.wait(timeout=0.1)
+                    continue
+            for uri, src_task in pending:
+                payloads.extend(
+                    _pull_partition(
+                        uri, src_task, spec.partition,
+                        self.runner.session,
+                    )
                 )
-            )
+                pulled.add((uri, src_task))
         root = spec.fragment
         remotes = [
             n for n in N.walk(root) if isinstance(n, N.RemoteSourceNode)
@@ -610,6 +659,21 @@ def _make_handler(worker: WorkerServer):
                 threading.Thread(
                     target=worker.shutdown, daemon=True
                 ).start()
+                return self._json(200, {"ok": True})
+            if (
+                len(parts) == 4
+                and parts[:2] == ["v1", "task"]
+                and parts[3] == "sources"
+            ):
+                # pipelined shuffle: attach upstream sources to a merge
+                # task (reference: addExchangeLocations)
+                t = worker.tasks.get(parts[2])
+                if t is None:
+                    return self._json(404, {"error": "no such task"})
+                body = json.loads(self._read_body() or b"{}")
+                t.add_sources(
+                    body.get("sources", ()), bool(body.get("done"))
+                )
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
 

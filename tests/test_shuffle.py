@@ -108,6 +108,32 @@ def test_session_flag_disables_shuffle(client, oracle):
         client.execute("set session distributed_final = true")
 
 
+def test_pipelined_source_attachment(cluster3, client, oracle, monkeypatch):
+    """Merge tasks exist BEFORE stage 1 completes: producers are
+    announced one by one (addExchangeLocations parity) and the set is
+    sealed once — not attached as a single post-barrier batch."""
+    from presto_tpu.server import worker as worker_mod
+
+    events = []
+    orig = worker_mod._Task.add_sources
+
+    def spy(self, sources, done):
+        events.append((len(list(sources)), bool(done)))
+        return orig(self, sources, done)
+
+    monkeypatch.setattr(worker_mod._Task, "add_sources", spy)
+    sql = (
+        "select l_shipmode, count(*) as n from tpch.tiny.lineitem "
+        "group by l_shipmode order by l_shipmode"
+    )
+    diff = verify_query(client, oracle, sql, rel_tol=1e-6)
+    assert diff is None, diff
+    incremental = [e for e in events if not e[1] and e[0] > 0]
+    seals = [e for e in events if e[1]]
+    assert incremental, "no incremental source announcements"
+    assert seals, "source set never sealed"
+
+
 def test_global_agg_skips_shuffle(client, oracle):
     """No group keys -> nothing to partition; direct gather."""
     before = _shuffles()
