@@ -135,6 +135,13 @@ _ROWS_PER_CYC = 10
 _ORDERS_PER_CYC = 4
 
 
+def _sparse_amount(pick_tag: int, amt_tag: int, rows: np.ndarray):
+    """80%-zero coupon amounts, 1.00..20.00 otherwise (shared by the
+    store and catalog channels so the sparsity stays aligned)."""
+    r = _uniform(pick_tag, rows, 0, 9)
+    return np.where(r < 8, 0, _uniform(amt_tag, rows, 100, 2000))
+
+
 def _order_of_row(rows: np.ndarray):
     """sales row -> (order index 0-based, line number 1-based)."""
     cyc, rr = np.divmod(rows, _ROWS_PER_CYC)
@@ -297,9 +304,14 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
     "catalog_sales": {
         "cs_sold_date_sk": T.INTEGER,
         "cs_bill_customer_sk": T.INTEGER,
+        "cs_bill_cdemo_sk": T.INTEGER,
         "cs_item_sk": T.INTEGER,
+        "cs_promo_sk": T.INTEGER,
         "cs_order_number": T.INTEGER,
         "cs_quantity": T.INTEGER,
+        "cs_list_price": D7_2,
+        "cs_sales_price": D7_2,
+        "cs_coupon_amt": D7_2,
         "cs_ext_list_price": D7_2,
     },
     "catalog_returns": {
@@ -675,10 +687,7 @@ class TpcdsGenerator:
             elif c == "ss_ext_tax":
                 out[c] = _uniform(1715, rows, 0, 90000)
             elif c == "ss_coupon_amt":
-                r = _uniform(1712, rows, 0, 9)
-                out[c] = np.where(
-                    r < 8, 0, _uniform(1713, rows, 100, 2000)
-                )
+                out[c] = _sparse_amount(1712, 1713, rows)
             elif c == "ss_net_profit":
                 out[c] = _uniform(1716, rows, -500000, 1000000)
         return out
@@ -718,12 +727,28 @@ class TpcdsGenerator:
                 out[c] = self._date_sk_for(f["sold"])
             elif c == "cs_bill_customer_sk":
                 out[c] = _uniform(1903, rows, 1, cn["customer"])
+            elif c == "cs_bill_cdemo_sk":
+                out[c] = _uniform(
+                    1906, rows, 1, cn["customer_demographics"]
+                )
             elif c == "cs_item_sk":
                 out[c] = f["item"]
+            elif c == "cs_promo_sk":
+                out[c] = _uniform(1907, rows, 1, cn["promotion"])
             elif c == "cs_order_number":
                 out[c] = f["order"]
             elif c == "cs_quantity":
                 out[c] = _uniform(1904, rows, 1, 100)
+            elif c == "cs_list_price":
+                # sales <= list, like the store channel's
+                # wholesale-plus-delta invariant
+                out[c] = _uniform(1909, rows, 50, 9900) + _uniform(
+                    1908, rows, 0, 5100
+                )
+            elif c == "cs_sales_price":
+                out[c] = _uniform(1909, rows, 50, 9900)
+            elif c == "cs_coupon_amt":
+                out[c] = _sparse_amount(1910, 1911, rows)
             elif c == "cs_ext_list_price":
                 out[c] = _uniform(1905, rows, 10000, 100000)
         return out
@@ -839,6 +864,8 @@ class _TpcdsMetadata(ConnectorMetadata):
         "ss_promo_sk": "promotion",
         "sr_item_sk": "item",
         "cs_item_sk": "item", "cs_bill_customer_sk": "customer",
+        "cs_bill_cdemo_sk": "customer_demographics",
+        "cs_promo_sk": "promotion",
         "cr_item_sk": "item",
         "ws_item_sk": "item", "ws_ship_addr_sk": "customer_address",
         "ws_web_site_sk": "web_site", "ws_warehouse_sk": "warehouse",

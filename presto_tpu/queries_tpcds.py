@@ -311,6 +311,51 @@ OFFICIAL = {
                  c_first_name, ca_city, bought_city, extended_price,
                  extended_tax, list_price
         limit 100""",
+    # Q43: per-store weekday sales pivot (sum(case ...) columns)
+    "q43": f"""
+        select s_store_name, s_store_id,
+               sum(case when d_day_name = 'Sunday'
+                   then ss_sales_price else null end) as sun_sales,
+               sum(case when d_day_name = 'Monday'
+                   then ss_sales_price else null end) as mon_sales,
+               sum(case when d_day_name = 'Tuesday'
+                   then ss_sales_price else null end) as tue_sales,
+               sum(case when d_day_name = 'Wednesday'
+                   then ss_sales_price else null end) as wed_sales,
+               sum(case when d_day_name = 'Thursday'
+                   then ss_sales_price else null end) as thu_sales,
+               sum(case when d_day_name = 'Friday'
+                   then ss_sales_price else null end) as fri_sales,
+               sum(case when d_day_name = 'Saturday'
+                   then ss_sales_price else null end) as sat_sales
+        from {S}.date_dim, {S}.store_sales, {S}.store
+        where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+          and d_year = 1999
+        group by s_store_name, s_store_id
+        order by s_store_name, s_store_id, sun_sales, mon_sales,
+                 tue_sales, wed_sales, thu_sales, fri_sales, sat_sales
+        limit 100""",
+    # Q26: catalog-channel demographic averages (Q7's catalog twin)
+    "q26": f"""
+        select i_item_id,
+               avg(cs_quantity) as agg1,
+               avg(cs_list_price) as agg2,
+               avg(cs_coupon_amt) as agg3,
+               avg(cs_sales_price) as agg4
+        from {S}.catalog_sales, {S}.customer_demographics, {S}.date_dim,
+             {S}.item, {S}.promotion
+        where cs_sold_date_sk = d_date_sk
+          and cs_item_sk = i_item_sk
+          and cs_bill_cdemo_sk = cd_demo_sk
+          and cs_promo_sk = p_promo_sk
+          and cd_gender = 'F'
+          and cd_marital_status = 'W'
+          and cd_education_status = 'Primary'
+          and (p_channel_email = 'N' or p_channel_event = 'N')
+          and d_year = 1999
+        group by i_item_id
+        order by i_item_id
+        limit 100""",
     # Q98: per-item revenue share of its class — a window aggregate
     # OVER the grouped output (sum(sum(x)) over (partition by i_class))
     "q98": f"""
