@@ -21,7 +21,6 @@ the expression engine lives.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import List, Optional, Tuple
 
 import numpy as np

@@ -18,7 +18,7 @@ every split of similar size reuses the same compiled fragment
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np

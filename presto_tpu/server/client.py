@@ -13,7 +13,7 @@ import json
 import time
 import urllib.error
 import urllib.request
-from typing import List, Optional
+from typing import List
 
 
 class QueryFailed(RuntimeError):

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import typing
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from presto_tpu import expr as E
 from presto_tpu import types as T

@@ -19,7 +19,6 @@ coordinator, and freed on DELETE.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import threading
 import time

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import List, Optional
+from typing import List
 
 
 @dataclasses.dataclass(frozen=True)
