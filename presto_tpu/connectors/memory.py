@@ -58,7 +58,12 @@ class MemoryConnector(Connector):
         return True
 
     def create_table(self, handle: TableHandle, schema: Dict[str, T.DataType]):
-        self._store.tables[(handle.schema, handle.table)] = (dict(schema), {})
+        # empty columns from the start: a never-inserted table must
+        # still scan (zero rows), e.g. NOT IN (SELECT ... FROM empty)
+        self._store.tables[(handle.schema, handle.table)] = (
+            dict(schema),
+            {c: np.empty(0, dtype=object) for c in schema},
+        )
 
     def append_rows(self, handle: TableHandle, data: Dict[str, np.ndarray]):
         key = (handle.schema, handle.table)

@@ -365,11 +365,118 @@ class LocalQueryRunner:
             # larger-than-HBM input: split-streamed partial aggregation
             # with hash-bucketed host spill (exec.streaming)
             return streaming.run_streamed(self, root)
+        budget = int(self.session.get("max_fragment_weight"))
+        if budget > 0 and _plan_weight(root) > budget:
+            return self._run_fragmented(root, budget)
         scans = [
             n for n in N.walk(root) if isinstance(n, N.TableScanNode)
         ]
         pages = [self._load_table(s) for s in scans]
         return self._run_with_pages(root, scans, pages)
+
+    # ------------------------------------------- stage-at-a-time execution
+
+    def _run_fragmented(self, root: N.PlanNode, budget: int) -> Page:
+        """Execute a heavy plan stage-at-a-time: heavy subtrees compile
+        and run as their OWN bounded-size XLA programs, their outputs
+        stay device-resident, and the remaining tree consumes them as
+        leaves.
+
+        Reference parity: tasks execute plan *fragments*, never a whole
+        plan as one unit (SURVEY.md §3.3) — the whole-plan-as-one-program
+        model produces pathologically large XLA programs exactly when
+        plans get big (Q64's 17-table star join, Q18's semi-join + big
+        aggregation), which is what killed their compiles on the tunnel
+        (BASELINE.md "matrix walls"). Per-fragment cost is one extra
+        control round trip (~65 ms tunneled), paid only by plans heavy
+        enough to fragment.
+        """
+        pages_map: Dict[int, Page] = {}
+        reduced = self._reduce_fragment(root, budget, pages_map)
+        leaves, pages = self.leaf_pages(reduced, pages_map)
+        return self._run_with_pages(reduced, leaves, pages)
+
+    def leaf_pages(
+        self, root: N.PlanNode, pages_map: Optional[Dict[int, Page]] = None
+    ) -> Tuple[List[N.PlanNode], List[Page]]:
+        """Collect a fragment's leaves (scans + remote sources) and
+        their input pages: scans load (cached) tables, remote sources
+        resolve through ``pages_map`` (id(node) -> already-produced
+        page). The one leaf-resolution path for every fragment
+        executor."""
+        pages_map = pages_map or {}
+        leaves = [
+            n
+            for n in N.walk(root)
+            if isinstance(n, (N.TableScanNode, N.RemoteSourceNode))
+        ]
+        pages = [
+            pages_map[id(n)]
+            if isinstance(n, N.RemoteSourceNode)
+            else self._load_table(n)
+            for n in leaves
+        ]
+        return leaves, pages
+
+    def _reduce_fragment(
+        self, node: N.PlanNode, budget: int, pages_map: Dict[int, Page]
+    ) -> N.PlanNode:
+        """Bottom-up: shrink ``node``'s subtree to at most ``budget``
+        weight by executing its heaviest child subtrees as standalone
+        fragments (device-resident results become RemoteSourceNode
+        leaves). A node whose own weight exceeds the budget with only
+        leaf children runs as one program anyway — it cannot be cut
+        smaller."""
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, N.PlanNode):
+                changes[f.name] = self._reduce_fragment(
+                    v, budget, pages_map
+                )
+        if changes:
+            node = dataclasses.replace(node, **changes)
+        while _plan_weight(node) > budget:
+            cands = [
+                c
+                for c in node.children()
+                if not isinstance(
+                    c,
+                    (
+                        N.TableScanNode,
+                        N.RemoteSourceNode,
+                        N.ValuesNode,
+                    ),
+                )
+            ]
+            if not cands:
+                break
+            child = max(cands, key=_plan_weight)
+            leaf = self._execute_to_leaf(child, pages_map)
+            swaps = {
+                f.name: leaf
+                for f in dataclasses.fields(node)
+                if getattr(node, f.name) is child
+            }
+            node = dataclasses.replace(node, **swaps)
+        return node
+
+    def _execute_to_leaf(
+        self, subtree: N.PlanNode, pages_map: Dict[int, Page]
+    ) -> N.RemoteSourceNode:
+        """Run one fragment as its own program; the result stays on
+        device, re-bucketed to its live prefix so the consuming
+        fragment's program size tracks actual (not worst-case)
+        intermediate cardinality."""
+        leaves, pages = self.leaf_pages(subtree, pages_map)
+        page, _n = self._run_with_pages(
+            subtree, leaves, pages, fetch_result=False
+        )
+        if self._active_qs is not None:
+            self._active_qs.device_fragments += 1
+        remote = N.RemoteSourceNode(fragment_root=subtree)
+        pages_map[id(remote)] = page
+        return remote
 
     def _run_with_pages(
         self,
@@ -377,11 +484,17 @@ class LocalQueryRunner:
         scans: List[N.PlanNode],
         pages: List[Page],
         stats_out: Optional[List] = None,
+        fetch_result: bool = True,
     ) -> Page:
         """Run the compiled whole-plan program, retrying on capacity
         overflow. With ``stats_out``, per-node row counters are traced as
         extra outputs (EXPLAIN ANALYZE); stats_out receives
-        (walk_id, label, rows, capacity) records."""
+        (walk_id, label, rows, capacity) records.
+
+        ``fetch_result=False`` (stage-at-a-time execution): the result
+        stays ON DEVICE — only the control flags + live count are
+        fetched (one round trip) — and the return value is
+        ``(device_page_rebucketed, n)`` instead of a host page."""
         scan_ids = {id(s): i for i, s in enumerate(scans)}
         analyzed = stats_out is not None
 
@@ -461,6 +574,8 @@ class LocalQueryRunner:
                 int(self.session.get("speculative_result_rows")),
                 page.capacity,
             )
+            if not fetch_result:
+                spec = 0
             leaves: List = [flags_arr, err_arr, cnt_arr, page.num_valid]
             if spec > 0:
                 leaves.extend(page.prefix_leaves(spec))
@@ -479,6 +594,10 @@ class LocalQueryRunner:
                         )
                     )
                 n = int(n_out)
+                if not fetch_result:
+                    from presto_tpu.page import pad_capacity
+
+                    return pad_capacity(page, bucket_capacity(n)), n
                 if 0 < spec and n <= spec:
                     return _page_from_prefix(page, fetched[4:], n)
                 return materialize_page(page, n)
@@ -610,6 +729,29 @@ def page_np_dtype(blk: Block):
     return np.dtype(blk.data.dtype)
 
 
+#: compile-cost weight per plan node: joins/aggregations/sorts/windows
+#: each lower to a multi-kernel XLA subgraph (sorts dominate compile
+#: time on TPU), row-wise nodes fuse away. Weights are a compile-size
+#: proxy, not a runtime cost model.
+_HEAVY_NODES = (
+    N.JoinNode,
+    N.AggregationNode,
+    N.DistinctNode,
+    N.SortNode,
+    N.WindowNode,
+    N.UnnestNode,
+)
+
+
+def _plan_weight(root: N.PlanNode) -> int:
+    """Compile-size proxy for the stage-at-a-time cut decision. Does not
+    descend into already-executed fragments (RemoteSourceNode children()
+    is empty)."""
+    return sum(
+        6 if isinstance(n, _HEAVY_NODES) else 1 for n in N.walk(root)
+    )
+
+
 # ---------------------------------------------------------- trace helpers
 
 
@@ -702,6 +844,12 @@ def _execute_node_inner(
     if isinstance(node, N.CrossJoinNode):
         left = run(node.left)
         right = run(node.right)
+        if node.out_capacity is not None:
+            from presto_tpu.ops.join import cross_join
+
+            out, overflow = cross_join(left, right, node.out_capacity)
+            flags.append(overflow)
+            return out
         # single-row broadcast (scalar-aggregate shape); >1 row is a hard
         # error, not a capacity overflow — retries cannot fix it
         errors.append(("cross join build produced more than one row",
@@ -861,7 +1009,10 @@ def _scale_capacities(node: N.PlanNode, factor: int) -> N.PlanNode:
             changes[f.name] = _scale_capacities(v, factor)
     if isinstance(node, (N.AggregationNode, N.DistinctNode)):
         changes["max_groups"] = node.max_groups * factor
-    if isinstance(node, N.JoinNode) and node.out_capacity is not None:
+    if (
+        isinstance(node, (N.JoinNode, N.CrossJoinNode))
+        and node.out_capacity is not None
+    ):
         changes["out_capacity"] = node.out_capacity * factor
     return dataclasses.replace(node, **changes) if changes else node
 

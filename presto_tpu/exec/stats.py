@@ -49,6 +49,7 @@ class QueryStats:
     execution_ms: float = 0.0  # device program (incl. compile on miss)
     compile_cache_hit: bool = True
     retries: int = 0  # capacity-overflow re-runs
+    device_fragments: int = 0  # stage-at-a-time programs beyond the root
     input_rows: int = 0
     input_bytes: int = 0
     output_rows: int = 0

@@ -83,6 +83,9 @@ def run_streamed(runner, droot: N.PlanNode):
         for n in N.walk(froot)
         if isinstance(n, (N.TableScanNode, N.RemoteSourceNode))
     ]
+    # remote leaves RUN here (recursive fragment execution), so this
+    # site cannot use runner.leaf_pages (which only resolves
+    # already-produced pages)
     pages = []
     for leaf in leaves:
         if isinstance(leaf, N.RemoteSourceNode):
@@ -107,23 +110,18 @@ def _run_fragment(runner, frag_root: N.PlanNode, materialized: Dict):
         and _scan_rows(runner.catalogs, s) > max_rows
     ]
     if not big:
-        leaves = [
-            n
-            for n in N.walk(frag_root)
-            if isinstance(n, (N.TableScanNode, N.RemoteSourceNode))
-        ]
-        pages = [
-            materialized[id(n)]
-            if isinstance(n, N.RemoteSourceNode)
-            else runner._load_table(n)
-            for n in leaves
-        ]
+        leaves, pages = runner.leaf_pages(frag_root, materialized)
         return runner._run_with_pages(frag_root, leaves, pages)
 
     stage = plan_stage(
         frag_root, runner.catalogs, replicated_limit=max_rows
     )
     if stage is None:
+        out = _try_partitioned_join(
+            runner, frag_root, materialized, max_rows
+        )
+        if out is not None:
+            return out
         raise StreamingError(
             "fragment exceeds max_device_rows and admits no "
             "semantics-preserving streaming cut"
@@ -361,6 +359,197 @@ def _bucket_key_names(worker_root: N.PlanNode) -> List[str]:
     if isinstance(worker_root, N.DistinctNode):
         return list(worker_root.output_schema())
     return []  # no cut: pure distributive fragment, single bucket
+
+
+# ---------------------------------------------- partitioned join spill
+
+
+def _oversized_scans(runner, root: N.PlanNode, max_rows: int):
+    return [
+        s
+        for s in N.walk(root)
+        if isinstance(s, N.TableScanNode)
+        and _scan_rows(runner.catalogs, s) > max_rows
+    ]
+
+
+def _row_distributive_to_root(root: N.PlanNode, scan: N.PlanNode) -> bool:
+    """True when every edge scan->root is a Filter/Project (streaming
+    batches of the scan through the subtree and concatenating equals
+    running it whole)."""
+    path = _path_to(root, scan)
+    if path is None:
+        return False
+    return all(
+        isinstance(p, (N.FilterNode, N.ProjectNode)) for p in path[:-1]
+    )
+
+
+def _try_partitioned_join(
+    runner, frag_root: N.PlanNode, materialized: Dict, max_rows: int
+):
+    """Join build-side spill (reference: HashBuilderOperator partitioned
+    spill + LookupJoinOperator unspill — SURVEY.md §2.1 "Spilling").
+
+    When a join's BUILD side exceeds the device budget (so neither side
+    can be replicated and no agg cut applies), hash-partition BOTH
+    sides by the equi-join keys into host-RAM buckets — each side
+    streamed through its own compiled sub-fragment in split batches —
+    then join bucket-by-bucket on device and concatenate. Valid for
+    every equi-join type: a key lands in exactly one bucket on both
+    sides, so per-bucket joins partition the full join (probe-preserved
+    rows included). Returns the fragment's result page, or None when no
+    join admits this shape (caller falls back to the error)."""
+    for J in N.walk(frag_root):
+        if not isinstance(J, N.JoinNode):
+            continue
+        if not _oversized_scans(runner, J.right, max_rows):
+            continue  # build fits: not this join's problem
+        sides = []
+        for side_root, keys in (
+            (J.left, J.left_keys),
+            (J.right, J.right_keys),
+        ):
+            big = _oversized_scans(runner, side_root, max_rows)
+            if len(big) > 1 or (
+                big and not _row_distributive_to_root(side_root, big[0])
+            ):
+                sides = None
+                break
+            sides.append((side_root, list(keys), big[0] if big else None))
+        if sides is None:
+            continue
+        probe_rows = sum(
+            _scan_rows(runner.catalogs, s)
+            for s in N.walk(J.left)
+            if isinstance(s, N.TableScanNode)
+        )
+        build_rows = sum(
+            _scan_rows(runner.catalogs, s)
+            for s in N.walk(J.right)
+            if isinstance(s, N.TableScanNode)
+        )
+        n_buckets = _n_buckets_for(probe_rows + build_rows, max_rows)
+
+        spills = []
+        for side_root, keys, big_scan in sides:
+            spills.append(
+                _stream_side_to_buckets(
+                    runner, side_root, keys, big_scan, n_buckets,
+                    materialized, max_rows,
+                )
+            )
+        (p_spill, p_schema), (b_spill, b_schema) = spills
+
+        lremote = N.RemoteSourceNode(fragment_root=J.left)
+        rremote = N.RemoteSourceNode(fragment_root=J.right)
+        bucket_join = dataclasses.replace(J, left=lremote, right=rremote)
+        out_schema = dict(bucket_join.output_schema())
+        outs: List[tuple] = []
+        for b in range(n_buckets):
+            # probe-preserved types skip probe-empty buckets; FULL also
+            # preserves build rows, so build-only buckets must still run
+            if not p_spill[b] and (
+                J.join_type != "full" or not b_spill[b]
+            ):
+                p_spill[b], b_spill[b] = [], []
+                continue
+            p_page = stage_page(
+                pages_wire.merge_payloads(p_spill[b], p_schema)
+                if p_spill[b]
+                else {
+                    n: np.empty(0, t.np_dtype)
+                    for n, t in p_schema.items()
+                },
+                p_schema,
+            )
+            b_page = stage_page(
+                pages_wire.merge_payloads(b_spill[b], b_schema)
+                if b_spill[b]
+                else {
+                    n: np.empty(0, t.np_dtype)
+                    for n, t in b_schema.items()
+                },
+                b_schema,
+            )
+            p_spill[b], b_spill[b] = [], []  # free as we go
+            out = runner._run_with_pages(
+                bucket_join, [lremote, rremote], [p_page, b_page]
+            )
+            pl = _page_to_payload(out)
+            if pl[2]:
+                outs.append(pl)
+
+        if outs:
+            merged = pages_wire.merge_payloads(outs, out_schema)
+        else:
+            merged = {
+                n: np.empty(0, t.np_dtype)
+                for n, t in out_schema.items()
+            }
+        join_page = stage_page(merged, out_schema)
+        if J is frag_root:
+            return join_page
+        remote = N.RemoteSourceNode(fragment_root=J)
+        path = _path_to(frag_root, J)
+        rest_root = _replace_on_path(path[:-1], J, remote)
+        return _run_fragment(
+            runner, rest_root, {**materialized, id(remote): join_page}
+        )
+    return None
+
+
+def _stream_side_to_buckets(
+    runner,
+    side_root: N.PlanNode,
+    key_cols: List[str],
+    big_scan,
+    n_buckets: int,
+    materialized: Dict,
+    max_rows: int,
+):
+    """Run one join side, hash-bucketing its output rows by the join
+    keys into host-RAM spill buckets. A side with no oversized scan
+    runs whole; a side with one streams the scan in split batches
+    through ONE compiled sub-fragment program."""
+    from presto_tpu.exec.staging import bucket_capacity
+
+    schema = dict(side_root.output_schema())
+    spill: List[List[tuple]] = [[] for _ in range(n_buckets)]
+
+    def spill_page(page):
+        payload, pschema, nrows = _page_to_payload(page)
+        if nrows:
+            _spill_partial(
+                spill, payload, schema, key_cols, nrows, n_buckets
+            )
+
+    if big_scan is None:
+        leaves, pages = runner.leaf_pages(side_root, materialized)
+        spill_page(
+            runner._run_with_pages(side_root, leaves, pages)
+        )
+        return spill, schema
+
+    # _row_distributive_to_root admitted only Filter/Project edges, so
+    # the side is a linear chain and big_scan is its ONLY leaf
+    batch = min(int(runner.session.get("page_capacity")), max_rows)
+    batch_cap = bucket_capacity(batch)
+    total = _scan_rows(runner.catalogs, big_scan)
+    conn = runner.catalogs.get(big_scan.handle.catalog)
+    for lo in range(0, total, batch):
+        hi = min(lo + batch, total)
+        payload = conn.create_page_source(
+            ConnectorSplit(big_scan.handle, lo, hi),
+            list(big_scan.columns),
+        )
+        batch_page = stage_page(
+            payload, dict(big_scan.schema), capacity=batch_cap
+        )
+        spill_page(
+            runner._run_with_pages(side_root, [big_scan], [batch_page])
+        )
+    return spill, schema
 
 
 # ------------------------------------------------------- host-side spill

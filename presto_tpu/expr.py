@@ -231,9 +231,10 @@ class Cast(Expr):
 
 @dataclasses.dataclass(frozen=True)
 class MathFunc(Expr):
-    """Scalar math over one numeric argument: sqrt | abs | ln | exp |
-    floor | ceil (reference: the scalar function registry's math
-    builtins). All except abs/floor/ceil return DOUBLE; sqrt/ln of
+    """Scalar math over one numeric argument (reference: the scalar
+    function registry's math builtins — SURVEY.md §2.1 "Function
+    registry"). abs/sign/round/truncate preserve the argument type,
+    floor/ceil return BIGINT, the rest return DOUBLE; sqrt/ln of
     out-of-domain values return NULL (SQL-adjacent; the reference
     raises — documented deviation, keeps the kernel branch-free)."""
 
@@ -245,11 +246,53 @@ class MathFunc(Expr):
 
     @property
     def dtype(self):
-        if self.func in ("abs",):
+        if self.func == "sign" and self.arg.dtype.is_decimal:
+            # ±1/0 is an integer; keeping the decimal type would read
+            # the bare sign as an unscaled value (off by 10^-scale)
+            return T.BIGINT
+        if self.func in ("abs", "sign", "round", "truncate"):
             return self.arg.dtype
         if self.func in ("floor", "ceil"):
             return T.BIGINT
         return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class MathFunc2(Expr):
+    """Two-argument scalar math: power | atan2 | log(base, x) |
+    round(x, digits) | truncate(x, digits). round/truncate preserve the
+    first argument's type; the rest return DOUBLE."""
+
+    func: str
+    left: Expr
+    right: Expr
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def dtype(self):
+        if self.func in ("round", "truncate"):
+            return self.left.dtype
+        return T.DOUBLE
+
+
+@dataclasses.dataclass(frozen=True)
+class DateTrunc(Expr):
+    """date_trunc(unit, x) over date (epoch days) or timestamp (epoch
+    microseconds): unit in year|quarter|month|week|day (+ hour|minute|
+    second for timestamps). Branch-free civil-calendar integer math on
+    device (see _civil_from_days / _days_from_civil)."""
+
+    unit: str
+    arg: Expr
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return self.arg.dtype
 
 
 @dataclasses.dataclass(frozen=True)
@@ -361,17 +404,30 @@ class DictTransform(Expr):
 
 
 def dict_transform_fn(fn_key: str):
-    """Rebuild a DictTransform host function from its key.
+    """Rebuild a dictionary-function host callable from its key.
 
-    The key is the canonical (wire-safe) identity of the transform —
+    The key is the canonical (wire-safe) identity of the function —
     the coordinator->worker protocol ships only ``fn_key`` and rebuilds
-    the callable here, so every producer of DictTransform nodes must
-    construct ``fn`` through this factory.
-    """
+    the callable here, so every producer of DictTransform /
+    DictPredicate / DictIntFunc nodes must construct ``fn`` through
+    this factory. Parameterized keys carry their arguments
+    JSON-encoded after the first colon (colon-safe)."""
+    import json
+
     if fn_key == "lower":
         return str.lower
     if fn_key == "upper":
         return str.upper
+    if fn_key == "trim":
+        return str.strip
+    if fn_key == "ltrim":
+        return lambda s: s.lstrip()
+    if fn_key == "rtrim":
+        return lambda s: s.rstrip()
+    if fn_key == "reverse":
+        return lambda s: s[::-1]
+    if fn_key == "length":
+        return len
     if fn_key.startswith("substring:"):
         _, st, ln = fn_key.split(":")
         start = int(st)
@@ -379,7 +435,85 @@ def dict_transform_fn(fn_key: str):
         if length is None:
             return lambda s: s[start - 1:]
         return lambda s: s[start - 1: start - 1 + length]
-    raise TypeError(f"unknown DictTransform key {fn_key!r}")
+    kind, _, payload = fn_key.partition(":")
+    if kind == "replace":
+        old, new = json.loads(payload)
+        return lambda s: s.replace(old, new)
+    if kind == "concat":
+        prefix, suffix = json.loads(payload)
+        return lambda s: prefix + s + suffix
+    if kind == "lpad":
+        size, pad = json.loads(payload)
+        return lambda s: (
+            s[:size]
+            if len(s) >= size
+            else ((pad * size)[: size - len(s)] + s if pad else s)
+        )
+    if kind == "rpad":
+        size, pad = json.loads(payload)
+        return lambda s: (
+            s[:size]
+            if len(s) >= size
+            else (s + (pad * size)[: size - len(s)] if pad else s)
+        )
+    if kind == "split_part":
+        delim, index = json.loads(payload)
+        def _split_part(s, _d=delim, _i=index):
+            parts = s.split(_d) if _d else [s]
+            return parts[_i - 1] if 1 <= _i <= len(parts) else ""
+        return _split_part
+    if kind == "strpos":
+        (sub,) = json.loads(payload)
+        return lambda s: s.find(sub) + 1
+    if kind == "regexp_like":
+        (pat,) = json.loads(payload)
+        rx = re.compile(pat)
+        return lambda s: rx.search(s) is not None
+    if kind == "starts_with":
+        (prefix,) = json.loads(payload)
+        return lambda s: s.startswith(prefix)
+    if kind == "ends_with":
+        (suffix,) = json.loads(payload)
+        return lambda s: s.endswith(suffix)
+    raise TypeError(f"unknown dictionary-function key {fn_key!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DateAdd(Expr):
+    """date_add(unit, n, x): shift a date/timestamp by n units (unit in
+    day|week|month|year). Month/year shifts clamp the day-of-month to
+    the target month's length (SQL semantics), computed branch-free via
+    civil-calendar math on device."""
+
+    unit: str
+    n: Expr  # integer count (may be a column)
+    arg: Expr
+
+    def children(self):
+        return (self.n, self.arg)
+
+    @property
+    def dtype(self):
+        return self.arg.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class DictIntFunc(Expr):
+    """Integer-valued function of a dictionary column (length, strpos),
+    evaluated host-side per dictionary entry into an int64 LUT that the
+    device gathers (SURVEY.md §7 "Strings on TPU"). ``fn`` maps
+    str -> int and is rebuilt from ``fn_key`` via dict_transform_fn."""
+
+    arg: Expr  # string-typed
+    fn_key: str
+    fn: object = dataclasses.field(hash=False, compare=False)
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BIGINT
 
 
 @dataclasses.dataclass(frozen=True)
@@ -515,6 +649,19 @@ def _civil_from_days(z):
     return y, m, d
 
 
+def _days_from_civil(y, m, d):
+    """(year, month, day) -> epoch days; inverse of _civil_from_days
+    (Howard Hinnant's days_from_civil), branch-free on device."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400
+    doy = jnp.floor_divide(
+        153 * (m + jnp.where(m > 2, -3, 9)) + 2, 5
+    ) + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
 class ExprLowerer:
     """Lowers an Expr tree over one Page at trace time.
 
@@ -532,6 +679,11 @@ class ExprLowerer:
             return self.page.block(expr.name).dictionary
         if isinstance(expr, DictTransform):
             return self._transform(expr)[0]
+        if isinstance(expr, Literal):
+            from presto_tpu.page import Dictionary
+
+            vals = [] if expr.value is None else [str(expr.value)]
+            return Dictionary(np.asarray(vals, object))
         raise NotImplementedError(
             f"no dictionary for string expression {type(expr).__name__}"
         )
@@ -580,9 +732,8 @@ class ExprLowerer:
             zero = jnp.zeros(shape, dtype=e.dtype.jnp_dtype)
             return zero, jnp.zeros((self.page.capacity,), dtype=jnp.bool_)
         if e.dtype.is_string:
-            raise NotImplementedError(
-                "bare string literal outside comparison context"
-            )
+            # one-entry dictionary, all ids 0 (dictionary_of pairs it)
+            return jnp.zeros((self.page.capacity,), jnp.int32), None
         if e.dtype.is_long_decimal:
             # (1, 2) limb row: broadcasts against both (cap, 2) columns
             # (elementwise limb ops) and (cap, 2) projection shapes
@@ -1081,8 +1232,26 @@ class ExprLowerer:
     def _eval_mathfunc(self, e: MathFunc):
         d, v = self.eval(e.arg)
         at = e.arg.dtype
+        if at.is_long_decimal:
+            raise NotImplementedError(
+                "math functions over long decimals: cast to "
+                "decimal(18,s) or double first (documented deviation)"
+            )
         if e.func == "abs":
             return jnp.abs(d), v
+        if e.func == "sign":
+            return jnp.sign(d).astype(e.dtype.jnp_dtype), v
+        if e.func in ("round", "truncate") and (
+            at.is_integer or at.is_decimal
+        ):
+            if at.is_integer:
+                return d, v  # already integral
+            # decimal: round/truncate the unscaled value to 0 digits,
+            # result keeps the decimal type (rescaled back)
+            factor = 10 ** at.scale
+            half = factor // 2 if e.func == "round" else 0
+            q = (jnp.abs(d.astype(jnp.int64)) + half) // factor
+            return jnp.sign(d) * q * factor, v
         x = d.astype(jnp.float64)
         if at.is_decimal:
             x = x / (10 ** at.scale)
@@ -1094,16 +1263,170 @@ class ExprLowerer:
             out = jnp.log(jnp.maximum(x, jnp.finfo(jnp.float64).tiny))
             v = _and_valid(v, x > 0)
             return out, v
+        if e.func in ("log2", "log10"):
+            base = 2.0 if e.func == "log2" else 10.0
+            out = jnp.log(
+                jnp.maximum(x, jnp.finfo(jnp.float64).tiny)
+            ) / jnp.log(base)
+            v = _and_valid(v, x > 0)
+            return out, v
         if e.func == "exp":
             return jnp.exp(x), v
         if e.func == "floor":
             return jnp.floor(x).astype(jnp.int64), v
         if e.func == "ceil":
             return jnp.ceil(x).astype(jnp.int64), v
+        if e.func == "round":
+            # SQL half-away-from-zero (jnp.round is half-to-even)
+            return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5), v
+        if e.func == "truncate":
+            return jnp.sign(x) * jnp.floor(jnp.abs(x)), v
+        if e.func == "cbrt":
+            return jnp.cbrt(x), v
+        if e.func in ("sin", "cos", "tan", "asin", "acos", "atan"):
+            fn = {
+                "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+                "asin": jnp.arcsin, "acos": jnp.arccos,
+                "atan": jnp.arctan,
+            }[e.func]
+            if e.func in ("asin", "acos"):
+                v = _and_valid(v, jnp.abs(x) <= 1.0)
+                x = jnp.clip(x, -1.0, 1.0)
+            return fn(x), v
+        if e.func == "degrees":
+            return x * (180.0 / float(np.pi)), v
+        if e.func == "radians":
+            return x * (float(np.pi) / 180.0), v
         raise NotImplementedError(f"math function {e.func}")
+
+    def _eval_mathfunc2(self, e: MathFunc2):
+        if (
+            e.left.dtype.is_long_decimal
+            or e.right.dtype.is_long_decimal
+        ):
+            raise NotImplementedError(
+                "math functions over long decimals: cast to "
+                "decimal(18,s) or double first (documented deviation)"
+            )
+        ld, lv = self.eval(e.left)
+        rd, rv = self.eval(e.right)
+        valid = _and_valid(lv, rv)
+        lt = e.left.dtype
+        x = ld.astype(jnp.float64)
+        if lt.is_decimal:
+            x = x / (10 ** lt.scale)
+        y = rd.astype(jnp.float64)
+        if e.right.dtype.is_decimal:
+            y = y / (10 ** e.right.dtype.scale)
+        if e.func == "power":
+            return jnp.power(x, y), valid
+        if e.func == "atan2":
+            return jnp.arctan2(x, y), valid
+        if e.func == "log":
+            # Presto log(base, x)
+            ok = (x > 0) & (y > 0)
+            out = jnp.log(
+                jnp.maximum(y, jnp.finfo(jnp.float64).tiny)
+            ) / jnp.log(jnp.maximum(x, jnp.finfo(jnp.float64).tiny))
+            return out, _and_valid(valid, ok)
+        if e.func in ("round", "truncate"):
+            factor = jnp.power(10.0, y)
+            scaled = x * factor
+            half = 0.5 if e.func == "round" else 0.0
+            out = jnp.sign(scaled) * jnp.floor(
+                jnp.abs(scaled) + half
+            ) / factor
+            if lt.is_integer:
+                return out.astype(jnp.int64), valid
+            if lt.is_decimal:
+                return (
+                    jnp.sign(out)
+                    * jnp.floor(jnp.abs(out) * (10 ** lt.scale) + 0.5)
+                ).astype(jnp.int64), valid
+            return out, valid
+        raise NotImplementedError(f"math function {e.func}")
+
+    def _eval_datetrunc(self, e: DateTrunc):
+        d, v = self.eval(e.arg)
+        unit = e.unit
+        is_ts = e.arg.dtype.name == "timestamp"
+        if is_ts:
+            us_per_day = 86_400_000_000
+            days = jnp.floor_divide(d, us_per_day)
+            if unit == "hour":
+                q = 3_600_000_000
+                return jnp.floor_divide(d, q) * q, v
+            if unit == "minute":
+                q = 60_000_000
+                return jnp.floor_divide(d, q) * q, v
+            if unit == "second":
+                q = 1_000_000
+                return jnp.floor_divide(d, q) * q, v
+        else:
+            days = d
+        if unit == "day":
+            out_days = days
+        elif unit == "week":
+            # epoch day 0 = Thursday; Monday-start ISO weeks
+            out_days = days - (days + 3) % 7
+        else:
+            y, m, _day = _civil_from_days(days)
+            if unit == "month":
+                out_days = _days_from_civil(y, m, jnp.int64(1))
+            elif unit == "quarter":
+                qm = ((m - 1) // 3) * 3 + 1
+                out_days = _days_from_civil(y, qm, jnp.int64(1))
+            elif unit == "year":
+                out_days = _days_from_civil(
+                    y, jnp.int64(1), jnp.int64(1)
+                )
+            else:
+                raise NotImplementedError(f"date_trunc({unit})")
+        if is_ts:
+            return out_days * 86_400_000_000, v
+        return out_days.astype(e.arg.dtype.jnp_dtype), v
+
+    def _eval_dateadd(self, e: DateAdd):
+        nd, nv = self.eval(e.n)
+        d, v = self.eval(e.arg)
+        valid = _and_valid(nv, v)
+        n = nd.astype(jnp.int64)
+        is_ts = e.arg.dtype.name == "timestamp"
+        us_per_day = 86_400_000_000
+        days = jnp.floor_divide(d, us_per_day) if is_ts else d
+        tod = d - days * us_per_day if is_ts else None
+        if e.unit in ("day", "week"):
+            out_days = days + n * (7 if e.unit == "week" else 1)
+        else:
+            months = n * (12 if e.unit == "year" else 1)
+            y, m, day = _civil_from_days(days)
+            total = y * 12 + (m - 1) + months
+            y2 = jnp.floor_divide(total, 12)
+            m2 = total - y2 * 12 + 1
+            first = _days_from_civil(y2, m2, jnp.int64(1))
+            nxt = _days_from_civil(
+                y2 + (m2 == 12), jnp.where(m2 == 12, 1, m2 + 1),
+                jnp.int64(1),
+            )
+            out_days = first + jnp.minimum(day, nxt - first) - 1
+        if is_ts:
+            return out_days * us_per_day + tod, valid
+        return out_days.astype(e.arg.dtype.jnp_dtype), valid
+
+    def _eval_dictintfunc(self, e: DictIntFunc):
+        data, valid = self.eval(e.arg)
+        dic = self.dictionary_of(e.arg)
+        lut = np.asarray(
+            [int(e.fn(v)) for v in dic.values], dtype=np.int64
+        )
+        if len(lut) == 0:
+            return jnp.zeros((self.page.capacity,), jnp.int64), valid
+        return jnp.asarray(lut)[jnp.clip(data, 0, len(lut) - 1)], valid
 
     def _eval_extract(self, e: Extract):
         d, v = self.eval(e.arg)
+        if e.arg.dtype.name == "timestamp":
+            d = jnp.floor_divide(d, 86_400_000_000)
         y, m, day = _civil_from_days(d)
         f = e.field.lower()
         if f == "year":
@@ -1114,6 +1437,19 @@ class ExprLowerer:
             return day, v
         if f == "quarter":
             return (m + 2) // 3, v
+        if f in ("day_of_week", "dow"):
+            # ISO: 1 = Monday .. 7 = Sunday; epoch day 0 was a Thursday
+            return (d + 3) % 7 + 1, v
+        if f in ("day_of_year", "doy"):
+            return d - _days_from_civil(
+                y, jnp.int64(1), jnp.int64(1)
+            ) + 1, v
+        if f == "week":
+            # ISO week number of the ISO year containing the date
+            thursday = d - (d + 3) % 7 + 3
+            ty, _, _ = _civil_from_days(thursday)
+            jan1 = _days_from_civil(ty, jnp.int64(1), jnp.int64(1))
+            return (thursday - jan1) // 7 + 1, v
         raise NotImplementedError(f"extract({e.field})")
 
 

@@ -1,0 +1,558 @@
+"""Scalar function registry.
+
+Reference parity: ``FunctionAndTypeManager`` and the annotation-driven
+builtin registry (``@ScalarFunction`` over hundreds of builtins —
+SURVEY.md §2.1 "Function registry"). The reference registers a function
+once and every layer (analyzer, planner, interpreter, codegen) resolves
+it through the manager; here the analogous seam is a declarative table
+``name -> ScalarFunction`` whose ``build`` lowers a call directly to the
+engine's Expr IR (XLA is the codegen, so "registering" a function means
+providing its typed Expr construction — no interpreter entry needed).
+
+Adding a builtin touches ONLY this module: the planner resolves every
+non-aggregate, non-window FuncCall here (plan/planner.py FuncCall
+branch), and the fuzzer draws generatable functions from the same table
+(``fuzz`` argument classes).
+
+String functions follow the dictionary-LUT design (SURVEY.md §7
+"Strings on TPU"): host-side evaluation over the (small) dictionary,
+device-side int32/int64/bool LUT gathers — so string builtins require a
+dictionary-backed argument and literal parameters, enforced here at
+plan time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+
+from presto_tpu import expr as E
+from presto_tpu import types as T
+
+
+class FunctionError(ValueError):
+    """Raised for bad calls; the planner re-raises as PlanningError."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarFunction:
+    """One registered scalar builtin."""
+
+    name: str
+    min_args: int
+    max_args: int  # -1 = variadic
+    build: Callable[[List[E.Expr]], E.Expr]
+    description: str = ""
+    #: fuzzer argument classes, each in {"num", "str", "date", "any",
+    #: "bool"}; None = not fuzz-generatable (needs literal params etc.)
+    fuzz: Optional[Tuple[str, ...]] = None
+
+
+SCALAR: Dict[str, ScalarFunction] = {}
+
+
+def _register(
+    name: str,
+    min_args: int,
+    max_args: Optional[int] = None,
+    description: str = "",
+    fuzz: Optional[Tuple[str, ...]] = None,
+):
+    def deco(fn):
+        SCALAR[name] = ScalarFunction(
+            name=name,
+            min_args=min_args,
+            max_args=min_args if max_args is None else max_args,
+            build=fn,
+            description=description,
+            fuzz=fuzz,
+        )
+        return fn
+
+    return deco
+
+
+def lower_scalar(name: str, args: List[E.Expr]) -> E.Expr:
+    """Resolve + build a scalar call; FunctionError on unknown name or
+    arity/type mismatch. The planner's single entry point."""
+    fn = SCALAR.get(name)
+    if fn is None:
+        raise FunctionError(f"unknown function: {name}")
+    n = len(args)
+    if n < fn.min_args or (fn.max_args >= 0 and n > fn.max_args):
+        want = (
+            str(fn.min_args)
+            if fn.min_args == fn.max_args
+            else f"{fn.min_args}..{'*' if fn.max_args < 0 else fn.max_args}"
+        )
+        raise FunctionError(f"{name}() takes {want} arguments, got {n}")
+    return fn.build(args)
+
+
+# ------------------------------------------------------------- helpers
+
+
+def _lit_str(e: E.Expr, what: str) -> str:
+    if not isinstance(e, E.Literal) or not isinstance(e.value, str):
+        raise FunctionError(f"{what} must be a string literal")
+    return e.value
+
+
+def _lit_int(e: E.Expr, what: str) -> int:
+    if not isinstance(e, E.Literal) or e.value is None:
+        raise FunctionError(f"{what} must be an integer literal")
+    try:
+        return int(e.value)
+    except (TypeError, ValueError):
+        raise FunctionError(
+            f"{what} must be an integer literal, got {e.value!r}"
+        ) from None
+
+
+def _string_arg(e: E.Expr, fname: str) -> E.Expr:
+    if not e.dtype.is_string:
+        raise FunctionError(
+            f"{fname}() requires a varchar argument, got {e.dtype}"
+        )
+    return e
+
+
+def _numeric_arg(e: E.Expr, fname: str) -> E.Expr:
+    t = e.dtype
+    if not (t.is_integer or t.is_decimal or t.name in ("double", "real")):
+        raise FunctionError(
+            f"{fname}() requires a numeric argument, got {t}"
+        )
+    return e
+
+
+def _date_arg(e: E.Expr, fname: str) -> E.Expr:
+    if e.dtype.name not in ("date", "timestamp"):
+        raise FunctionError(
+            f"{fname}() requires a date/timestamp argument, got {e.dtype}"
+        )
+    return e
+
+
+def _common_type(args: List[E.Expr]) -> T.DataType:
+    ct = args[0].dtype
+    for a in args[1:]:
+        ct = T.common_super_type(ct, a.dtype)
+    return ct
+
+
+def _transform(arg: E.Expr, key: str) -> E.Expr:
+    fn = E.dict_transform_fn(key)
+    if isinstance(arg, E.Literal):  # constant fold
+        v = None if arg.value is None else str(fn(str(arg.value)))
+        return E.Literal(v, T.VARCHAR)
+    return E.DictTransform(arg, key, fn)
+
+
+def _int_func(arg: E.Expr, key: str) -> E.Expr:
+    fn = E.dict_transform_fn(key)
+    if isinstance(arg, E.Literal):
+        v = None if arg.value is None else int(fn(str(arg.value)))
+        return E.Literal(v, T.BIGINT)
+    return E.DictIntFunc(arg, key, fn)
+
+
+def _predicate(arg: E.Expr, key: str) -> E.Expr:
+    fn = E.dict_transform_fn(key)
+    if isinstance(arg, E.Literal):
+        v = None if arg.value is None else bool(fn(str(arg.value)))
+        return E.Literal(v, T.BOOLEAN)
+    return E.DictPredicate(arg, key, fn)
+
+
+def _math1(func: str):
+    def build(args, _f=func):
+        return E.MathFunc(_f, _numeric_arg(args[0], _f))
+
+    return build
+
+
+# ---------------------------------------------------------------- math
+
+for _f in (
+    "sqrt", "ln", "exp", "abs", "sign", "cbrt",
+    "log2", "log10", "sin", "cos", "tan", "asin", "acos", "atan",
+    "degrees", "radians",
+):
+    _register(_f, 1, description=f"{_f}(x)", fuzz=("num",))(_math1(_f))
+
+
+@_register("floor", 1, description="floor(x) -> bigint", fuzz=("num",))
+def _floor(args):
+    return E.MathFunc("floor", _numeric_arg(args[0], "floor"))
+
+
+@_register("ceil", 1, description="ceil(x) -> bigint", fuzz=("num",))
+@_register("ceiling", 1, description="alias of ceil")
+def _ceil(args):
+    return E.MathFunc("ceil", _numeric_arg(args[0], "ceil"))
+
+
+@_register("round", 1, 2, description="round(x[, digits])", fuzz=("num",))
+def _round(args):
+    x = _numeric_arg(args[0], "round")
+    if len(args) == 1:
+        return E.MathFunc("round", x)
+    return E.MathFunc2("round", x, _numeric_arg(args[1], "round"))
+
+
+@_register("truncate", 1, 2, description="truncate(x[, digits])",
+           fuzz=("num",))
+def _truncate(args):
+    x = _numeric_arg(args[0], "truncate")
+    if len(args) == 1:
+        return E.MathFunc("truncate", x)
+    return E.MathFunc2("truncate", x, _numeric_arg(args[1], "truncate"))
+
+
+@_register("power", 2, description="power(x, y)", fuzz=("num", "num"))
+@_register("pow", 2, description="alias of power")
+def _power(args):
+    return E.MathFunc2(
+        "power",
+        _numeric_arg(args[0], "power"),
+        _numeric_arg(args[1], "power"),
+    )
+
+
+@_register("atan2", 2, description="atan2(y, x)", fuzz=("num", "num"))
+def _atan2(args):
+    return E.MathFunc2(
+        "atan2",
+        _numeric_arg(args[0], "atan2"),
+        _numeric_arg(args[1], "atan2"),
+    )
+
+
+@_register("log", 2, description="log(base, x)")
+def _log(args):
+    return E.MathFunc2(
+        "log", _numeric_arg(args[0], "log"), _numeric_arg(args[1], "log")
+    )
+
+
+@_register("mod", 2, description="mod(x, y)", fuzz=("num", "num"))
+def _mod(args):
+    return E.arith(
+        "%", _numeric_arg(args[0], "mod"), _numeric_arg(args[1], "mod")
+    )
+
+
+@_register("pi", 0, description="pi()")
+def _pi(args):
+    import math
+
+    return E.Literal(math.pi, T.DOUBLE)
+
+
+@_register("e", 0, description="e()")
+def _e(args):
+    import math
+
+    return E.Literal(math.e, T.DOUBLE)
+
+
+def _bound(op: str, args: List[E.Expr], fname: str) -> E.Expr:
+    """greatest/least as a CASE fold; NULL if any argument is NULL
+    (Presto semantics)."""
+    ct = _common_type(args)
+    args = [a if a.dtype == ct else E.Cast(a, ct) for a in args]
+    out = args[0]
+    for a in args[1:]:
+        out = E.Case(
+            whens=(
+                (E.IsNull(out), E.Literal(None, ct)),
+                (E.IsNull(a), E.Literal(None, ct)),
+                (E.Compare(op, out, a), out),
+            ),
+            default=a,
+            _dtype=ct,
+        )
+    return out
+
+
+@_register("greatest", 1, -1, description="greatest(x, ...)",
+           fuzz=("num", "num"))
+def _greatest(args):
+    return _bound(">=", list(args), "greatest")
+
+
+@_register("least", 1, -1, description="least(x, ...)",
+           fuzz=("num", "num"))
+def _least(args):
+    return _bound("<=", list(args), "least")
+
+
+# --------------------------------------------------------- conditional
+
+
+@_register("coalesce", 1, -1, description="coalesce(x, ...)")
+def _coalesce(args):
+    ct = _common_type(list(args))
+    return E.Coalesce(tuple(args), ct)
+
+
+@_register("if", 2, 3, description="if(cond, then[, else])")
+def _if(args):
+    cond = args[0]
+    if cond.dtype.name != "boolean":
+        raise FunctionError("if() condition must be boolean")
+    then = args[1]
+    default = args[2] if len(args) > 2 else E.Literal(None, then.dtype)
+    ct = T.common_super_type(then.dtype, default.dtype)
+    return E.Case(whens=((cond, then),), default=default, _dtype=ct)
+
+
+@_register("nullif", 2, description="nullif(a, b)")
+def _nullif(args):
+    a, b = args
+    return E.Case(
+        whens=((E.Compare("=", a, b), E.Literal(None, a.dtype)),),
+        default=a,
+        _dtype=a.dtype,
+    )
+
+
+# -------------------------------------------------------------- string
+
+
+@_register("lower", 1, description="lower(s)", fuzz=("str",))
+def _lower_fn(args):
+    return _transform(_string_arg(args[0], "lower"), "lower")
+
+
+@_register("upper", 1, description="upper(s)", fuzz=("str",))
+def _upper_fn(args):
+    return _transform(_string_arg(args[0], "upper"), "upper")
+
+
+@_register("trim", 1, description="trim(s)", fuzz=("str",))
+def _trim(args):
+    return _transform(_string_arg(args[0], "trim"), "trim")
+
+
+@_register("ltrim", 1, description="ltrim(s)", fuzz=("str",))
+def _ltrim(args):
+    return _transform(_string_arg(args[0], "ltrim"), "ltrim")
+
+
+@_register("rtrim", 1, description="rtrim(s)", fuzz=("str",))
+def _rtrim(args):
+    return _transform(_string_arg(args[0], "rtrim"), "rtrim")
+
+
+@_register("reverse", 1, description="reverse(s)", fuzz=("str",))
+def _reverse(args):
+    return _transform(_string_arg(args[0], "reverse"), "reverse")
+
+
+@_register("length", 1, description="length(s) -> bigint", fuzz=("str",))
+def _length(args):
+    return _int_func(_string_arg(args[0], "length"), "length")
+
+
+@_register("substring", 2, 3, description="substring(s, start[, len])")
+@_register("substr", 2, 3, description="alias of substring")
+def _substring(args):
+    s = _string_arg(args[0], "substring")
+    start = _lit_int(args[1], "substring start")
+    length = _lit_int(args[2], "substring length") if len(args) > 2 else None
+    return _transform(s, f"substring:{start}:{length}")
+
+
+@_register("replace", 3, description="replace(s, search, repl)")
+def _replace(args):
+    s = _string_arg(args[0], "replace")
+    old = _lit_str(args[1], "replace search")
+    new = _lit_str(args[2], "replace replacement")
+    return _transform(s, f"replace:{json.dumps([old, new])}")
+
+
+@_register(
+    "concat", 1, -1,
+    description="concat(s, ...): at most one dictionary column, any "
+    "number of string literals (host-LUT design)",
+)
+def _concat(args):
+    cols = [a for a in args if not isinstance(a, E.Literal)]
+    if len(cols) > 1:
+        raise FunctionError(
+            "concat() supports one non-literal argument (dictionary "
+            "LUT design); concatenating two columns requires a "
+            "cross-dictionary rebuild"
+        )
+    if not cols:
+        return E.Literal(
+            "".join(_lit_str(a, "concat argument") for a in args),
+            T.VARCHAR,
+        )
+    col = cols[0]
+    _string_arg(col, "concat")
+    idx = next(i for i, a in enumerate(args) if a is col)
+    prefix = "".join(
+        _lit_str(a, "concat argument") for a in args[:idx]
+    )
+    suffix = "".join(
+        _lit_str(a, "concat argument") for a in args[idx + 1:]
+    )
+    return _transform(col, f"concat:{json.dumps([prefix, suffix])}")
+
+
+@_register("strpos", 2, description="strpos(s, sub) -> 1-based, 0=absent")
+def _strpos(args):
+    s = _string_arg(args[0], "strpos")
+    sub = _lit_str(args[1], "strpos substring")
+    return _int_func(s, f"strpos:{json.dumps([sub])}")
+
+
+@_register("position", 2, description="position(sub IN s)")
+def _position(args):
+    # the parser's position(x IN y) special form produces
+    # position(x, y): arg order is (substring, string) — flipped vs
+    # strpos
+    sub = _lit_str(args[0], "position substring")
+    s = _string_arg(args[1], "position")
+    return _int_func(s, f"strpos:{json.dumps([sub])}")
+
+
+@_register("lpad", 3, description="lpad(s, size, pad)")
+def _lpad(args):
+    s = _string_arg(args[0], "lpad")
+    size = _lit_int(args[1], "lpad size")
+    pad = _lit_str(args[2], "lpad padstring")
+    return _transform(s, f"lpad:{json.dumps([size, pad])}")
+
+
+@_register("rpad", 3, description="rpad(s, size, pad)")
+def _rpad(args):
+    s = _string_arg(args[0], "rpad")
+    size = _lit_int(args[1], "rpad size")
+    pad = _lit_str(args[2], "rpad padstring")
+    return _transform(s, f"rpad:{json.dumps([size, pad])}")
+
+
+@_register(
+    "split_part", 3,
+    description="split_part(s, delim, index); out-of-range -> '' "
+    "(deviation: the reference returns NULL)",
+)
+def _split_part(args):
+    s = _string_arg(args[0], "split_part")
+    delim = _lit_str(args[1], "split_part delimiter")
+    index = _lit_int(args[2], "split_part index")
+    if index < 1:
+        raise FunctionError("split_part index must be >= 1")
+    return _transform(s, f"split_part:{json.dumps([delim, index])}")
+
+
+@_register("regexp_like", 2, description="regexp_like(s, pattern)")
+def _regexp_like(args):
+    s = _string_arg(args[0], "regexp_like")
+    pat = _lit_str(args[1], "regexp_like pattern")
+    return _predicate(s, f"regexp_like:{json.dumps([pat])}")
+
+
+@_register("starts_with", 2, description="starts_with(s, prefix)")
+def _starts_with(args):
+    s = _string_arg(args[0], "starts_with")
+    prefix = _lit_str(args[1], "starts_with prefix")
+    return _predicate(s, f"starts_with:{json.dumps([prefix])}")
+
+
+@_register("ends_with", 2, description="ends_with(s, suffix)")
+def _ends_with(args):
+    s = _string_arg(args[0], "ends_with")
+    suffix = _lit_str(args[1], "ends_with suffix")
+    return _predicate(s, f"ends_with:{json.dumps([suffix])}")
+
+
+# ---------------------------------------------------------------- date
+
+_DATE_UNITS = ("year", "quarter", "month", "week", "day")
+_TIME_UNITS = ("hour", "minute", "second")
+
+
+@_register("date_trunc", 2, description="date_trunc(unit, x)",
+           fuzz=None)
+def _date_trunc(args):
+    unit = _lit_str(args[0], "date_trunc unit").lower()
+    x = _date_arg(args[1], "date_trunc")
+    if unit not in _DATE_UNITS + _TIME_UNITS:
+        raise FunctionError(f"date_trunc: unknown unit {unit!r}")
+    if unit in _TIME_UNITS and x.dtype.name != "timestamp":
+        raise FunctionError(
+            f"date_trunc({unit!r}) requires a timestamp argument"
+        )
+    return E.DateTrunc(unit, x)
+
+
+@_register("date_add", 3, description="date_add(unit, n, x)")
+def _date_add(args):
+    unit = _lit_str(args[0], "date_add unit").lower()
+    if unit not in _DATE_UNITS or unit == "quarter":
+        raise FunctionError(f"date_add: unsupported unit {unit!r}")
+    n = _numeric_arg(args[1], "date_add")
+    if not n.dtype.is_integer:
+        raise FunctionError("date_add count must be an integer")
+    x = _date_arg(args[2], "date_add")
+    return E.DateAdd(unit, n, x)
+
+
+@_register(
+    "date_diff", 3,
+    description="date_diff('day'|'week', a, b) -> b - a in units",
+)
+def _date_diff(args):
+    unit = _lit_str(args[0], "date_diff unit").lower()
+    a = _date_arg(args[1], "date_diff")
+    b = _date_arg(args[2], "date_diff")
+    if unit not in ("day", "week"):
+        raise FunctionError(
+            f"date_diff: unsupported unit {unit!r} (day/week only; "
+            "month/year boundaries need per-row civil division)"
+        )
+    if a.dtype.name == "timestamp" or b.dtype.name == "timestamp":
+        raise FunctionError("date_diff over timestamps: cast to date")
+    diff = E.Arithmetic("-", b, a, T.BIGINT)
+    if unit == "week":
+        return E.arith("/", diff, E.Literal(7, T.BIGINT))
+    return diff
+
+
+def _extract_fn(field: str):
+    def build(args, _f=field):
+        return E.Extract(_f, _date_arg(args[0], _f))
+
+    return build
+
+
+for _f in (
+    "year", "month", "day", "quarter", "week",
+    "day_of_week", "day_of_year",
+):
+    _register(_f, 1, description=f"{_f}(x)", fuzz=("date",))(
+        _extract_fn(_f)
+    )
+
+
+# ------------------------------------------------------- aggregate aliases
+
+#: aggregate-name aliases resolved in the planner's aggregation path
+#: (these are AGGREGATES, not scalars — listed here so the registry is
+#: the one catalog of builtin names): approx_distinct(x) plans as the
+#: exact count(DISTINCT x) two-level rewrite (error 0 <= any HLL
+#: standard error); arbitrary/any_value take min (any value is valid);
+#: bool_and/bool_or/every are min/max over booleans.
+AGGREGATE_ALIASES: Dict[str, str] = {
+    "arbitrary": "min",
+    "any_value": "min",
+    "bool_and": "min",
+    "every": "min",
+    "bool_or": "max",
+}

@@ -205,6 +205,62 @@ def hash_join(
     return out, overflow
 
 
+def cross_join(
+    left: Page, right: Page, out_capacity: int
+) -> Tuple[Page, jnp.ndarray]:
+    """General nested-loop cross product (reference:
+    NestedLoopJoinOperator — SURVEY.md §2.1 "Operators"). Static-shape:
+    the same prefix-sum + inverse-searchsorted expansion the
+    duplicate-key equi-join uses, with every live left row matching
+    every live right row. Returns (result, overflow) under the engine's
+    capacity-bucket protocol."""
+    from presto_tpu.page import compact_page
+
+    right_c = compact_page(right)  # offsets index the live prefix
+    nr = right_c.num_valid.astype(jnp.int64)
+    m_eff = jnp.where(left.row_mask(), nr, 0)
+    total = jnp.cumsum(m_eff)
+    out_count = total[-1] if left.capacity else jnp.asarray(0, jnp.int64)
+    overflow = out_count > out_capacity
+
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
+    p_idx = jnp.searchsorted(total, j, side="right")
+    p_idx = jnp.minimum(p_idx, left.capacity - 1)
+    prev = jnp.where(p_idx > 0, total[jnp.maximum(p_idx - 1, 0)], 0)
+    b_idx = jnp.clip(j - prev, 0, right_c.capacity - 1)
+
+    names: List[str] = []
+    blocks: List[Block] = []
+    for name, blk in zip(left.names, left.blocks):
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[p_idx],
+                valid=None if blk.valid is None else blk.valid[p_idx],
+            )
+        )
+        names.append(name)
+    for name, blk in zip(right_c.names, right_c.blocks):
+        blocks.append(
+            dataclasses.replace(
+                blk,
+                data=blk.data[b_idx],
+                valid=None if blk.valid is None else blk.valid[b_idx],
+            )
+        )
+        names.append(name)
+    return (
+        Page(
+            blocks=tuple(blocks),
+            num_valid=jnp.minimum(out_count, out_capacity).astype(
+                jnp.int32
+            ),
+            names=tuple(names),
+        ),
+        overflow,
+    )
+
+
 def _append_unmatched_build(
     out: Page,
     probe: Page,

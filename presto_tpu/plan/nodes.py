@@ -127,11 +127,15 @@ class JoinNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class CrossJoinNode(PlanNode):
-    """Cross product; round 1 supports only a single-row right side
-    (scalar-aggregate broadcast — the common SQL shape)."""
+    """Cross product. ``out_capacity=None``: single-row right side only
+    (scalar-aggregate broadcast — the common SQL shape, no expansion).
+    With ``out_capacity``: general nested-loop product (reference:
+    NestedLoopJoinOperator) under the capacity-bucket overflow
+    protocol."""
 
     left: PlanNode
     right: PlanNode
+    out_capacity: Optional[int] = None
 
     def output_schema(self):
         return {**self.left.output_schema(), **self.right.output_schema()}

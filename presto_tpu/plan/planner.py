@@ -6,9 +6,10 @@ type resolution, SURVEY.md §2.1 "Analyzer") fused with ``LogicalPlanner``
 planner"), including the subquery rewrites the reference does in its
 optimizer (ApplyNode decorrelation):
 
-- IN (subquery)      -> semi join        (NOT IN -> anti; NOT IN keeps
-                        NOT-EXISTS null semantics: a planner-documented
-                        deviation until null-aware anti join lands)
+- IN (subquery)      -> semi join        (NOT IN -> NULL-AWARE anti
+                        join: two bound count params + a probe-side
+                        pre-filter give exact three-valued NOT IN
+                        semantics — see _null_aware_prefilter)
 - EXISTS             -> semi/anti join on equality correlation conjuncts
 - scalar subquery    -> uncorrelated: Param bound by the executor;
                         correlated: GROUP BY correlation keys + join
@@ -131,6 +132,10 @@ AGG_FUNCS = {
     "sum", "count", "avg", "min", "max",
     "stddev", "stddev_samp", "stddev_pop",
     "variance", "var_samp", "var_pop",
+    # registry aliases (functions.AGGREGATE_ALIASES) + approx_distinct
+    # (plans as the exact count(DISTINCT x) rewrite, error 0)
+    "approx_distinct", "arbitrary", "any_value",
+    "bool_and", "bool_or", "every",
 }
 NAV_WINDOW_FUNCS = {"lag", "lead", "first_value", "last_value", "ntile"}
 WINDOW_FUNCS = (
@@ -714,14 +719,25 @@ class _Planner:
                 elif j in joined and i in remaining:
                     cand.setdefault(i, []).append((cj, ci))
             if not cand:
-                # cross join: only single-row builds supported in round 1
+                # no equi edge: cross join. Single-row builds broadcast
+                # (scalar-aggregate shape, no expansion); multi-row
+                # builds take the general nested-loop kernel with a
+                # stats-sized output bucket (reference:
+                # NestedLoopJoinOperator)
                 nxt = min(remaining, key=lambda i: est[i])
                 if est[nxt] > 1.5:
-                    raise PlanningError(
-                        "cross join between multi-row relations is not "
-                        "supported (no equi-join conjunct found)"
+                    tree_est = optimizer.estimate_rows(
+                        tree, self.catalogs
                     )
-                tree = N.CrossJoinNode(tree, rels[nxt])
+                    cap = bucket_capacity(
+                        int(max(tree_est, 1) * max(est[nxt], 1) * 1.2)
+                        + 1024
+                    )
+                    tree = N.CrossJoinNode(
+                        tree, rels[nxt], out_capacity=cap
+                    )
+                else:
+                    tree = N.CrossJoinNode(tree, rels[nxt])
                 remaining.discard(nxt)
                 joined.add(nxt)
                 continue
@@ -893,6 +909,8 @@ class _Planner:
         if len(sub_names) != 1:
             raise PlanningError("IN subquery must return one column")
         node, scope, key = self._probe_key(node, scope, a.arg)
+        if negate:
+            node = self._null_aware_prefilter(node, scope, a.query, key)
         node = N.JoinNode(
             left=node,
             right=sub_node,
@@ -902,6 +920,89 @@ class _Planner:
             payload=(),
         )
         return node, scope
+
+    def _not_in_state_param(self, query: ast.Select) -> E.Param:
+        """Plan ``query`` once under a fresh param namespace and reduce
+        it to ONE bound scalar classifying S for the null-aware NOT IN
+        rewrite: 0 = S empty, 1 = S non-empty and null-free,
+        2 = S contains a NULL (one subquery execution for both counts)."""
+        saved = self.params
+        self.params = []
+        try:
+            cnt_node, _, cnt_names = self.plan_select(query, outer=None)
+            col = cnt_names[0]
+            col_t = cnt_node.output_schema()[col]
+            total = E.ColumnRef("$na_total", T.BIGINT)
+            non_null = E.ColumnRef("$na_nonnull", T.BIGINT)
+            zero = E.Literal(0, T.BIGINT)
+            state = E.Case(
+                whens=(
+                    (E.Compare("=", total, zero), zero),
+                    (
+                        E.Compare("=", total, non_null),
+                        E.Literal(1, T.BIGINT),
+                    ),
+                ),
+                default=E.Literal(2, T.BIGINT),
+                _dtype=T.BIGINT,
+            )
+            sub = Plan(
+                root=N.ProjectNode(
+                    source=N.AggregationNode(
+                        source=cnt_node,
+                        group_keys=(),
+                        aggs=(
+                            AggCall("count_star", None, "$na_total"),
+                            AggCall(
+                                "count",
+                                E.ColumnRef(col, col_t),
+                                "$na_nonnull",
+                            ),
+                        ),
+                    ),
+                    projections=(("$na_state", state),),
+                ),
+                params=self.params,
+                output_names=("$na_state",),
+            )
+        finally:
+            self.params = saved
+        pid = self._param_counter[0]
+        self._param_counter[0] += 1
+        self.params.append((pid, sub))
+        return E.Param(pid, T.BIGINT)
+
+    def _null_aware_prefilter(self, node, scope, query: ast.Select, key):
+        """Null-aware anti join (reference: the null-aware rewrite of
+        NOT IN — SURVEY.md §2.1 "Logical planner" subquery rewrites; a
+        plain anti join has NOT-EXISTS semantics and returns wrong
+        answers on NULLs). SQL three-valued logic for ``x NOT IN (S)``:
+
+          - S empty                  -> TRUE for every x (even NULL)
+          - x NULL and S non-empty   -> UNKNOWN (row dropped)
+          - S contains NULL          -> never TRUE (match -> FALSE,
+                                        else UNKNOWN -> dropped)
+
+        One bound scalar param (0 = S empty, 1 = null-free, 2 = has a
+        NULL — computed from a single execution of S) turns this into a
+        probe-side pre-filter: keep a probe row iff ``state = 0 OR
+        (x IS NOT NULL AND state = 1)``; the anti join then decides
+        membership for the surviving (non-null x, null-free S) cases,
+        and an empty S makes the anti join keep everything."""
+        state = self._not_in_state_param(query)
+        probe_ref = E.ColumnRef(key, scope.columns[key])
+        pred = E.Or(
+            (
+                E.Compare("=", state, E.Literal(0, T.BIGINT)),
+                E.And(
+                    (
+                        E.IsNull(probe_ref, negate=True),
+                        E.Compare("=", state, E.Literal(1, T.BIGINT)),
+                    )
+                ),
+            )
+        )
+        return N.FilterNode(source=node, predicate=pred)
 
     def _apply_exists(self, node, scope, a: ast.Exists, negate):
         q = a.query
@@ -1189,8 +1290,26 @@ class _Planner:
         for si in sel.order_by:
             self._collect_aggs(si.expr, agg_calls)
 
+        agg_map: Dict[ast.Node, str] = {}
         group_keys: List[Tuple[str, E.Expr]] = []
         for g in sel.group_by:
+            if isinstance(g, ast.NumberLit):
+                # GROUP BY ordinal (reference: GROUP BY 1 resolves to
+                # the first select item)
+                try:
+                    idx = int(g.text) - 1
+                except ValueError:
+                    raise PlanningError(
+                        f"GROUP BY position must be an integer, got "
+                        f"{g.text}"
+                    ) from None
+                if not (0 <= idx < len(sel.items)) or isinstance(
+                    sel.items[idx].expr, ast.Star
+                ):
+                    raise PlanningError(
+                        f"GROUP BY position {g.text} out of range"
+                    )
+                g = sel.items[idx].expr
             e = self._lower(g, scope)
             if e.dtype.is_long_decimal:
                 raise PlanningError(
@@ -1201,13 +1320,22 @@ class _Planner:
             if isinstance(e, E.ColumnRef):
                 group_keys.append((e.name, e))
             else:
-                group_keys.append((self._fresh("key"), e))
-
-        agg_map: Dict[ast.Node, str] = {}
-        distinct_aggs = [a for a in agg_calls if a.distinct]
-        plain_aggs = [a for a in agg_calls if not a.distinct]
+                # expression key: select items / HAVING / ORDER BY
+                # re-lowering the same AST resolve to the key column
+                name = self._fresh("key")
+                group_keys.append((name, e))
+                agg_map[g] = name
+        distinct_aggs = [
+            a
+            for a in agg_calls
+            if a.distinct or a.name == "approx_distinct"
+        ]
+        plain_aggs = [a for a in agg_calls if a not in distinct_aggs]
         if distinct_aggs:
-            if len(distinct_aggs) != 1 or distinct_aggs[0].name != "count":
+            if len(distinct_aggs) != 1 or distinct_aggs[0].name not in (
+                "count",
+                "approx_distinct",
+            ):
                 raise PlanningError(
                     "only a single count(DISTINCT x) aggregate is "
                     "supported (reference: MarkDistinct breadth later)"
@@ -1282,9 +1410,10 @@ class _Planner:
                 stitched = N.FilterNode(stitched, pred)
             return stitched, out_scope, agg_map
 
-        agg_node, agg_map = self._plain_agg_node(
+        agg_node, agg_map2 = self._plain_agg_node(
             node, group_keys, agg_calls, scope
         )
+        agg_map.update(agg_map2)
         out_scope = self._post_agg_scope(agg_node, scope)
         if sel.having is not None:
             pred = self._lower(sel.having, out_scope, agg_map=agg_map)
@@ -1292,9 +1421,15 @@ class _Planner:
         return agg_node, out_scope, agg_map
 
     def _plain_agg_node(self, node, group_keys, agg_calls, scope):
+        from presto_tpu.functions import AGGREGATE_ALIASES
+
         aggs: List[AggCall] = []
         agg_map: Dict[ast.Node, str] = {}
-        alias = {"stddev": "stddev_samp", "variance": "var_samp"}
+        alias = {
+            "stddev": "stddev_samp",
+            "variance": "var_samp",
+            **AGGREGATE_ALIASES,
+        }
         for a in agg_calls:
             out_name = self._fresh("agg")
             if a.name == "count" and not a.args:
@@ -1581,41 +1716,18 @@ class _Planner:
                 raise PlanningError(
                     f"aggregate {e.name}() in an unsupported position"
                 )
-            if e.name == "substring":
-                arg = lower(e.args[0])
-                start_l = lower(e.args[1])
-                if not isinstance(start_l, E.Literal):
-                    raise PlanningError("substring start must be literal")
-                start = int(start_l.value)
-                length = None
-                if len(e.args) > 2:
-                    length_l = lower(e.args[2])
-                    if not isinstance(length_l, E.Literal):
-                        raise PlanningError("substring length must be literal")
-                    length = int(length_l.value)
-                key = f"substring:{start}:{length}"
-                return E.DictTransform(arg, key, E.dict_transform_fn(key))
-            if e.name in ("lower", "upper"):
-                arg = lower(e.args[0])
-                return E.DictTransform(
-                    arg, e.name, E.dict_transform_fn(e.name)
-                )
-            if e.name == "coalesce":
-                args = tuple(lower(a) for a in e.args)
-                rt = args[0].dtype
-                for a in args[1:]:
-                    rt = T.common_super_type(rt, a.dtype)
-                return E.Coalesce(args, rt)
-            if e.name == "year":
-                return E.Extract("year", lower(e.args[0]))
-            if e.name in (
-                "sqrt", "abs", "ln", "exp", "floor", "ceil", "ceiling"
-            ):
-                fname = "ceil" if e.name == "ceiling" else e.name
-                return E.MathFunc(fname, lower(e.args[0]))
             if e.name in ("cardinality", "element_at", "contains"):
+                # array functions take raw ArrayLit ASTs, not lowered
+                # exprs (arrays are trace-time expression lists)
                 return self._lower_array_func(e, lower)
-            raise PlanningError(f"unknown function: {e.name}")
+            from presto_tpu import functions as F
+
+            try:
+                return F.lower_scalar(
+                    e.name, [lower(a) for a in e.args]
+                )
+            except F.FunctionError as err:
+                raise PlanningError(str(err)) from None
         if isinstance(e, ast.ArrayLit):
             raise PlanningError(
                 "ARRAY[...] is supported under UNNEST, cardinality, "

@@ -35,9 +35,15 @@ class ClientResult:
 class PrestoTpuClient:
     """Minimal blocking client for one coordinator."""
 
-    def __init__(self, coordinator_uri: str, timeout_s: float = 120.0):
+    def __init__(
+        self,
+        coordinator_uri: str,
+        timeout_s: float = 120.0,
+        user: str = "presto_tpu",
+    ):
         self.uri = coordinator_uri.rstrip("/")
         self.timeout_s = timeout_s
+        self.user = user  # sent as X-Presto-User (resource-group routing)
 
     def execute(self, sql: str) -> ClientResult:
         first = self._post_json(
@@ -66,7 +72,10 @@ class PrestoTpuClient:
     def _post_json(self, url: str, body: bytes) -> dict:
         req = urllib.request.Request(
             url, data=body, method="POST",
-            headers={"Content-Type": "text/plain"},
+            headers={
+                "Content-Type": "text/plain",
+                "X-Presto-User": self.user,
+            },
         )
         with urllib.request.urlopen(req, timeout=30) as resp:
             return json.loads(resp.read())

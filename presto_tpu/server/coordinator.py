@@ -78,6 +78,7 @@ class CoordinatorServer:
         max_concurrent_queries: int = 4,
         max_queued_queries: int = 100,
         config=None,
+        resource_groups=None,
     ):
         from presto_tpu.exec.local_runner import LocalQueryRunner
         from presto_tpu.utils.memory import MemoryPool, parse_bytes
@@ -105,6 +106,22 @@ class CoordinatorServer:
         self._admit = threading.Semaphore(max_concurrent_queries)
         self._max_queued = max_queued_queries
         self._pending = 0  # queued + running, admission-gated
+        # weighted-fair resource groups (reference: resource-group
+        # managers; SURVEY.md §2.1 "Dispatch/queue"). dict spec or a
+        # path to an etc/resource-groups.json-style file; None = the
+        # flat admission gate only.
+        self.resource_groups = None
+        if resource_groups is not None:
+            from presto_tpu.server.resource_groups import (
+                ResourceGroupManager,
+            )
+
+            self.resource_groups = (
+                ResourceGroupManager.from_file(resource_groups)
+                if isinstance(resource_groups, str)
+                else ResourceGroupManager(resource_groups)
+            )
+            self.resource_groups.memory_usage_fn = self._group_memory
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -202,8 +219,24 @@ class CoordinatorServer:
 
     # ------------------------------------------------------------ queries
 
-    def submit(self, sql: str) -> _Query:
+    def _group_memory(self, group_name: str) -> int:
+        """Bytes reserved by running queries of one resource group (the
+        manager's softMemoryLimit eligibility hook)."""
+        with self._lock:
+            # live queries only: the history dict is unbounded, and
+            # finished queries hold no reservations anyway
+            qids = [
+                q.qid
+                for q in self.queries.values()
+                if not q.done.is_set()
+                and getattr(q, "resource_group", None) == group_name
+            ]
+        return sum(self.memory_pool.used_bytes(qid) for qid in qids)
+
+    def submit(self, sql: str, user: str = "presto_tpu") -> _Query:
         q = _Query(f"q_{next(self._qid)}", sql)
+        q.user = user
+        q.resource_group = None
         with self._lock:
             self.queries[q.qid] = q
             if self._pending >= self._max_queued:
@@ -216,9 +249,30 @@ class CoordinatorServer:
                 q.done.set()
                 return q
             self._pending += 1
-        threading.Thread(
-            target=self._execute_query, args=(q,), daemon=True
-        ).start()
+        if self.resource_groups is None:
+            threading.Thread(
+                target=self._execute_query, args=(q,), daemon=True
+            ).start()
+            return q
+
+        def start(_q=q):
+            threading.Thread(
+                target=self._execute_query, args=(_q,), daemon=True
+            ).start()
+
+        # group assignment is deterministic: record it before the
+        # thread can race to the finish hook
+        q.resource_group = self.resource_groups.group_of(user).name
+        state, info = self.resource_groups.submit(user, start)
+        if state == "rejected":
+            with self._lock:
+                self._pending -= 1
+            q.state = "FAILED"
+            q.error = info
+            REGISTRY.counter("coordinator.queries_rejected").update()
+            q.done.set()
+            return q
+        q.resource_group = info
         return q
 
     def _execute_query(self, q: _Query) -> None:
@@ -226,6 +280,11 @@ class CoordinatorServer:
             if q.done.is_set():  # killed while queued (memory manager)
                 with self._lock:
                     self._pending -= 1
+                if (
+                    self.resource_groups is not None
+                    and getattr(q, "resource_group", None) is not None
+                ):
+                    self.resource_groups.finish(q.resource_group)
                 return
             q.state = "RUNNING"
             # pool reservations this thread makes are owned by THIS
@@ -250,6 +309,13 @@ class CoordinatorServer:
                 with self._lock:
                     self._pending -= 1
                 q.done.set()
+                if (
+                    self.resource_groups is not None
+                    and getattr(q, "resource_group", None) is not None
+                ):
+                    # frees the group slot and admits the next queued
+                    # query by weighted fairness
+                    self.resource_groups.finish(q.resource_group)
 
     def _run_sql(self, q: _Query) -> None:
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
@@ -828,7 +894,8 @@ def _make_handler(coord: CoordinatorServer):
             parts = [p for p in self.path.split("/") if p]
             if parts == ["v1", "statement"]:
                 sql = self._read_body().decode()
-                q = coord.submit(sql)
+                user = self.headers.get("X-Presto-User", "presto_tpu")
+                q = coord.submit(sql, user=user)
                 return self._json(
                     200,
                     {

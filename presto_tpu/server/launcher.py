@@ -98,8 +98,14 @@ def launch(etc_dir: str):
     config, catalogs = load_etc(etc_dir)
     port = int(config.get("http-server.port", 0) or 0)
     if config.get("coordinator", False):
+        # optional weighted-fair resource groups (reference:
+        # etc/resource-groups.json file-configured manager)
+        rg_path = os.path.join(etc_dir, "resource-groups.json")
         server: object = CoordinatorServer(
-            port=port, catalogs=catalogs, config=config
+            port=port,
+            catalogs=catalogs,
+            config=config,
+            resource_groups=rg_path if os.path.exists(rg_path) else None,
         ).start()
     else:
         disc = config.get("discovery.uri")

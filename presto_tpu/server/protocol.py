@@ -72,7 +72,7 @@ def encode(obj: Any) -> Any:
         out = {"@": cls.__name__}
         for f in dataclasses.fields(obj):
             if f.name == "fn" and isinstance(
-                obj, (E.DictTransform, E.DictPredicate)
+                obj, (E.DictTransform, E.DictPredicate, E.DictIntFunc)
             ):
                 # host callables don't cross the wire: fn_key is the
                 # canonical identity, rebuilt at decode time
@@ -102,7 +102,10 @@ def decode(data: Any) -> Any:
     for f in dataclasses.fields(cls):
         if f.name in data:
             kwargs[f.name] = _coerce(decode(data[f.name]), f.type, cls)
-    if cls in (E.DictTransform, E.DictPredicate) and "fn" not in kwargs:
+    if (
+        cls in (E.DictTransform, E.DictPredicate, E.DictIntFunc)
+        and "fn" not in kwargs
+    ):
         kwargs["fn"] = E.dict_transform_fn(kwargs["fn_key"])
     return cls(**kwargs)
 

@@ -153,6 +153,17 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _positive("max_device_rows"),
         ),
         PropertyMetadata(
+            "max_fragment_weight",
+            "Largest plan weight compiled as ONE XLA program; heavier "
+            "plans execute stage-at-a-time with device-resident "
+            "intermediates (reference: tasks run fragments, never whole "
+            "plans — SURVEY.md §3.3; bounds compile size on Q64-class "
+            "many-join plans). 0 compiles whole plans",
+            int,
+            28,
+            _non_negative("max_fragment_weight"),
+        ),
+        PropertyMetadata(
             "query_max_run_time_s",
             "Per-query wall-clock limit (seconds)",
             float,

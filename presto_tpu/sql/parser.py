@@ -542,6 +542,23 @@ class _Parser:
             self.expect_op(")")
             args = (arg, start) + ((length,) if length is not None else ())
             return ast.FuncCall("substring", args)
+        if (
+            t.kind == "ident"
+            and t.value.lower() == "position"
+            and self.tokens[self.pos + 1].kind == "op"
+            and self.tokens[self.pos + 1].value == "("
+        ):
+            # position(sub IN s) — standard form; the first operand
+            # parses above predicate level so IN is the separator
+            # (comma form accepted too)
+            self.advance()
+            self.advance()
+            sub = self._additive()
+            if not self.accept_kw("in"):
+                self.expect_op(",")
+            s = self.parse_expr()
+            self.expect_op(")")
+            return ast.FuncCall("position", (sub, s))
         if self.accept_kw("exists"):
             self.expect_op("(")
             q = self.parse_select()

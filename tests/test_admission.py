@@ -87,3 +87,127 @@ def test_coordinator_sheds_load_beyond_queue():
     finally:
         release.set()
         coord.shutdown()
+
+
+# ------------------------------------------------- resource groups
+
+
+def test_resource_group_selection_and_limits():
+    from presto_tpu.server.resource_groups import ResourceGroupManager
+
+    mgr = ResourceGroupManager(
+        {
+            "rootGroups": [
+                {"name": "etl", "weight": 3, "hardConcurrencyLimit": 2,
+                 "maxQueued": 1},
+                {"name": "adhoc", "weight": 1, "hardConcurrencyLimit": 1},
+            ],
+            "selectors": [{"user": "etl-.*", "group": "etl"}],
+            "defaultGroup": "adhoc",
+        }
+    )
+    assert mgr.group_of("etl-nightly").name == "etl"
+    assert mgr.group_of("alice").name == "adhoc"
+
+    started = []
+    state, g = mgr.submit("etl-a", lambda: started.append("a"))
+    assert (state, g) == ("run", "etl") and started == ["a"]
+    state, _ = mgr.submit("etl-b", lambda: started.append("b"))
+    assert state == "run"
+    state, _ = mgr.submit("etl-c", lambda: started.append("c"))
+    assert state == "queued" and started == ["a", "b"]
+    state, msg = mgr.submit("etl-d", lambda: started.append("d"))
+    assert state == "rejected" and "queue is full" in msg
+    mgr.finish("etl")  # frees a slot -> queued c starts
+    assert started == ["a", "b", "c"]
+
+
+def test_resource_group_weighted_fairness():
+    """When both groups have queued work, freed slots go to the group
+    with the smallest running/weight ratio — the weight-3 group ends up
+    with ~3x the admissions of the weight-1 group."""
+    from presto_tpu.server.resource_groups import ResourceGroupManager
+
+    mgr = ResourceGroupManager(
+        {
+            "rootGroups": [
+                {"name": "heavy", "weight": 3, "hardConcurrencyLimit": 8},
+                {"name": "light", "weight": 1, "hardConcurrencyLimit": 8},
+            ],
+            "selectors": [{"user": "heavy", "group": "heavy"}],
+            "defaultGroup": "light",
+        }
+    )
+    # saturate both groups' slots artificially: fill 4 running in each
+    running = {"heavy": 0, "light": 0}
+    admitted = []
+
+    def starter(name):
+        def go():
+            admitted.append(name)
+        return go
+
+    # 4 running each (global cap pretend = 8), then queue 8 more per group
+    for g in ("heavy", "light"):
+        mgr.groups[g].running = 4
+        for _ in range(8):
+            mgr.groups[g].queue.append(starter(g))
+
+    # free 8 slots, alternating finishes: fairness picks by running/weight
+    for _ in range(4):
+        mgr.finish("heavy")
+        mgr.finish("light")
+    # heavy: ratio running/3 vs light: running/1 -> heavy admitted ~3x
+    h = admitted.count("heavy")
+    l = admitted.count("light")
+    assert h > l, admitted
+    assert h >= 2 * l, admitted
+
+
+def test_coordinator_routes_users_to_groups():
+    """Two users share a cluster per their groups' limits: the adhoc
+    group (limit 1) queues its second query while etl (limit 2) runs
+    both — per-group concurrency, not global FIFO."""
+    coord = CoordinatorServer(
+        max_concurrent_queries=8,
+        resource_groups={
+            "rootGroups": [
+                {"name": "etl", "weight": 3, "hardConcurrencyLimit": 2},
+                {"name": "adhoc", "weight": 1,
+                 "hardConcurrencyLimit": 1},
+            ],
+            "selectors": [{"user": "etl-.*", "group": "etl"}],
+            "defaultGroup": "adhoc",
+        },
+    )
+    release = threading.Event()
+    orig = coord._run_sql
+
+    def slow(q):
+        release.wait(timeout=30)
+        return orig(q)
+
+    coord._run_sql = slow
+    try:
+        sql = "select count(*) as c from tpch.tiny.region"
+        e1 = coord.submit(sql, user="etl-1")
+        e2 = coord.submit(sql, user="etl-2")
+        a1 = coord.submit(sql, user="alice")
+        a2 = coord.submit(sql, user="alice")
+        time.sleep(0.3)
+        assert e1.resource_group == "etl" and a1.resource_group == "adhoc"
+        snap = {
+            g["name"]: g for g in coord.resource_groups.snapshot()
+        }
+        assert snap["etl"]["running"] == 2, snap
+        assert snap["adhoc"]["running"] == 1, snap
+        assert snap["adhoc"]["queued"] == 1, snap
+        release.set()
+        for q in (e1, e2, a1, a2):
+            q.done.wait(timeout=60)
+            assert q.state == "FINISHED", (q.state, q.error)
+        snap = {g["name"]: g for g in coord.resource_groups.snapshot()}
+        assert snap["adhoc"]["running"] == 0 and snap["adhoc"]["queued"] == 0
+    finally:
+        release.set()
+        coord.shutdown()

@@ -219,3 +219,31 @@ def test_sqrt_negative_is_null(runner):
         "where o_orderkey = 1"
     ).rows()
     assert rows[0][0] is None
+
+
+# --------------------------------------------------- general cross join
+
+
+def test_general_cross_join(runner, oracle):
+    """Multi-row CROSS JOIN takes the nested-loop expansion kernel
+    (VERDICT r3 missing 10: was a single-row-build planner error)."""
+    q = (
+        "select n.n_name, r.r_name from tpch.tiny.nation n "
+        "cross join tpch.tiny.region r "
+        "order by n.n_name, r.r_name"
+    )
+    diff = verify_query(runner, oracle, q)
+    assert diff is None, diff
+    rows = runner.execute(q).rows()
+    assert len(rows) == 25 * 5
+
+
+def test_implicit_cross_join_with_filter(runner, oracle):
+    """Comma-join with a non-equi conjunct: cross join + residual
+    filter, oracle-exact."""
+    q = (
+        "select count(*) as c from tpch.tiny.nation a, "
+        "tpch.tiny.nation b where a.n_nationkey < b.n_nationkey"
+    )
+    diff = verify_query(runner, oracle, q)
+    assert diff is None, diff

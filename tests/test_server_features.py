@@ -102,13 +102,22 @@ class _CountingWorker(WorkerServer):
 
 
 class _SlowWorker(_CountingWorker):
-    """Worker whose scan staging sleeps: makes stage wall time visible."""
+    """Worker whose scan staging sleeps; records each staging interval
+    so concurrency is assertable from event ORDER, not wall-clock
+    ratios (load-insensitive — VERDICT r3 weak 3)."""
 
     DELAY_S = 0.6
 
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.spans = []
+
     def _load_range(self, scan, lo, hi):
+        t0 = time.time()
         time.sleep(self.DELAY_S)
-        return super()._load_range(scan, lo, hi)
+        out = super()._load_range(scan, lo, hi)
+        self.spans.append((t0, time.time()))
+        return out
 
 
 def test_dynamic_splits_favor_fast_worker():
@@ -142,27 +151,40 @@ def test_dynamic_splits_favor_fast_worker():
 
 
 def test_stage_time_is_slowest_worker_not_sum():
-    """3 slow workers, one batch each: concurrent pulls make the stage
-    take ~max(worker) not ~sum(worker) (VERDICT r2 item 7)."""
+    """3 slow workers, one batch each: tasks dispatch CONCURRENTLY, so
+    the stage costs ~max(worker), not ~sum(worker) (VERDICT r2 item 7).
+
+    Asserted from event ORDER — the three staging intervals must
+    overlap (serial dispatch would make them disjoint no matter how
+    loaded the box is) — not from wall-clock ratios, which flaked under
+    load on the 1-vCPU CI host (VERDICT r3 weak 3)."""
     coord = CoordinatorServer()
     coord.local.session.set("page_capacity", 1 << 20)  # one batch/worker
     coord.local.session.set("split_queue_factor", 1)  # one range/worker
     workers = [
         _SlowWorker(coordinator_uri=coord.uri).start() for _ in range(3)
     ]
+    for w in workers:
+        w.DELAY_S = 1.5  # overlap margin >> scheduler jitter under load
     coord.start()
     try:
         _wait_workers(coord, 3)
         client = PrestoTpuClient(coord.uri, timeout_s=60)
         client.execute("select count(*) as c from tpch.tiny.region")
-        t0 = time.time()
+        for w in workers:
+            w.spans.clear()  # warmup staging is not part of the stage
         res = client.execute(
             "select count(*) as c from tpch.tiny.lineitem"
         )
-        wall = time.time() - t0
         assert res.rows() == [(59997,)]
-        # serial would be >= 3 * DELAY_S (1.8s) + overhead
-        assert wall < 2.5 * _SlowWorker.DELAY_S, f"stage wall {wall:.2f}s"
+        spans = [s for w in workers for s in w.spans]
+        assert len(spans) == 3, spans  # one range per worker
+        latest_start = max(s for s, _ in spans)
+        earliest_end = min(e for _, e in spans)
+        assert latest_start < earliest_end, (
+            f"staging intervals did not overlap (serial dispatch?): "
+            f"{spans}"
+        )
     finally:
         for w in workers:
             w.shutdown(graceful=False)
