@@ -68,9 +68,11 @@ class MemoryConnector(Connector):
     def append_rows(self, handle: TableHandle, data: Dict[str, np.ndarray]):
         key = (handle.schema, handle.table)
         schema, existing = self._store.tables[key]
+        from presto_tpu.exec.staging import obj_array
+
         merged = {}
         for col in schema:
-            new = np.asarray(data[col], dtype=object)
+            new = obj_array(data[col])
             merged[col] = (
                 np.concatenate([existing[col], new]) if existing else new
             )

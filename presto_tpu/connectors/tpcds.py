@@ -65,6 +65,24 @@ _DATE_SK0 = 2415022  # official dsdgen julian-ish base for d_date_sk
 _SOLD_LO = (datetime.date(1998, 1, 1) - _EPOCH).days
 _SOLD_HI = (datetime.date(2000, 12, 31) - _EPOCH).days
 
+REASON_DESCS = [
+    "Did not fit", "Did not like the color", "Did not like the model",
+    "Did not like the warranty", "Does not work", "Duplicate purchase",
+    "Found a better extension", "Found a better price", "Gift exchange",
+    "Lost my job", "No service location in my area", "Not the product",
+    "Package was damaged", "Parts missing", "Stopped working",
+    "Unauthorized purchase", "Wrong size",
+]
+SHIP_TYPES = ["EXPRESS", "LIBRARY", "NEXT DAY", "OVERNIGHT", "REGULAR",
+              "TWO DAY"]
+SHIP_CODES = ["AIR", "SEA", "SURFACE"]
+CARRIERS = ["AIRBORNE", "ALLIANCE", "BARIAN", "BOXBUNDLES", "DHL",
+            "FEDEX", "GERMA", "GREAT EASTERN", "HARMSTORF", "LATVIAN",
+            "MSC", "ORIENTAL", "PRIVATECARRIER", "RUPEKSA", "TBS", "UPS",
+            "USPS", "ZHOU", "ZOUROS", "DIAMOND"]
+CC_NAMES = ["California", "Hawaii/Alaska", "Mid Atlantic", "Midwest",
+            "NY Metro", "North Midwest", "Northwest", "Pacific Northwest",
+            "South Atlantic", "Southwest"]
 MARITAL = ["D", "M", "S", "U", "W"]
 GENDER = ["F", "M"]
 EDUCATION = [
@@ -159,6 +177,15 @@ def _counts(sf: float) -> Dict[str, int]:
     ws = max(int(720_000 * sf), 90)
     return {
         "date_dim": N_DATES,
+        "time_dim": 86_400,
+        "reason": max(int(35 * root), 5),
+        "ship_mode": 20,
+        "call_center": max(int(6 * root), 2),
+        "web_page": max(int(60 * sf), 10),
+        "catalog_page": max(int(11_718 * root), 100),
+        # items x warehouses x weekly inventory dates (official dsdgen
+        # shape: one snapshot per week across the 1998-2002 band)
+        "inventory": max(int(18_000 * sf), 100) * 5 * 261,
         "income_band": 20,
         "customer_demographics": 5600,  # 2*5*7*20*4 mixed radix
         "household_demographics": 1200,  # 20*6*10 mixed radix
@@ -195,6 +222,56 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ib_income_band_sk": T.INTEGER,
         "ib_lower_bound": T.INTEGER,
         "ib_upper_bound": T.INTEGER,
+    },
+    "time_dim": {
+        "t_time_sk": T.INTEGER,
+        "t_time_id": T.VARCHAR,
+        "t_time": T.INTEGER,
+        "t_hour": T.INTEGER,
+        "t_minute": T.INTEGER,
+        "t_second": T.INTEGER,
+        "t_am_pm": T.VARCHAR,
+        "t_shift": T.VARCHAR,
+    },
+    "reason": {
+        "r_reason_sk": T.INTEGER,
+        "r_reason_id": T.VARCHAR,
+        "r_reason_desc": T.VARCHAR,
+    },
+    "ship_mode": {
+        "sm_ship_mode_sk": T.INTEGER,
+        "sm_ship_mode_id": T.VARCHAR,
+        "sm_type": T.VARCHAR,
+        "sm_code": T.VARCHAR,
+        "sm_carrier": T.VARCHAR,
+    },
+    "call_center": {
+        "cc_call_center_sk": T.INTEGER,
+        "cc_call_center_id": T.VARCHAR,
+        "cc_name": T.VARCHAR,
+        "cc_manager": T.VARCHAR,
+        "cc_county": T.VARCHAR,
+        "cc_state": T.VARCHAR,
+    },
+    "web_page": {
+        "wp_web_page_sk": T.INTEGER,
+        "wp_web_page_id": T.VARCHAR,
+        "wp_url": T.VARCHAR,
+        "wp_char_count": T.INTEGER,
+        "wp_link_count": T.INTEGER,
+    },
+    "catalog_page": {
+        "cp_catalog_page_sk": T.INTEGER,
+        "cp_catalog_page_id": T.VARCHAR,
+        "cp_catalog_number": T.INTEGER,
+        "cp_catalog_page_number": T.INTEGER,
+        "cp_department": T.VARCHAR,
+    },
+    "inventory": {
+        "inv_date_sk": T.INTEGER,
+        "inv_item_sk": T.INTEGER,
+        "inv_warehouse_sk": T.INTEGER,
+        "inv_quantity_on_hand": T.INTEGER,
     },
     "customer_demographics": {
         "cd_demo_sk": T.INTEGER,
@@ -303,10 +380,14 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
     },
     "catalog_sales": {
         "cs_sold_date_sk": T.INTEGER,
+        "cs_ship_date_sk": T.INTEGER,
         "cs_bill_customer_sk": T.INTEGER,
         "cs_bill_cdemo_sk": T.INTEGER,
         "cs_item_sk": T.INTEGER,
         "cs_promo_sk": T.INTEGER,
+        "cs_ship_mode_sk": T.INTEGER,
+        "cs_call_center_sk": T.INTEGER,
+        "cs_warehouse_sk": T.INTEGER,
         "cs_order_number": T.INTEGER,
         "cs_quantity": T.INTEGER,
         "cs_list_price": D7_2,
@@ -329,6 +410,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ws_ship_addr_sk": T.INTEGER,
         "ws_web_site_sk": T.INTEGER,
         "ws_warehouse_sk": T.INTEGER,
+        "ws_ship_mode_sk": T.INTEGER,
         "ws_order_number": T.INTEGER,
         "ws_ext_ship_cost": D7_2,
         "ws_net_profit": D7_2,
@@ -390,6 +472,137 @@ class TpcdsGenerator:
     def _date_sk_for(self, days: np.ndarray) -> np.ndarray:
         """epoch-days -> d_date_sk (clipped into the dimension)."""
         return _DATE_SK0 + np.clip(days - _D_START, 0, N_DATES - 1)
+
+    def _gen_time_dim(self, rows, columns):
+        out = {}
+        hour = rows // 3600
+        for c in columns:
+            if c == "t_time_sk":
+                out[c] = rows
+            elif c == "t_time_id":
+                out[c] = _numbered(
+                    "Time", self.counts["time_dim"], rows + 1
+                )
+            elif c == "t_time":
+                out[c] = rows
+            elif c == "t_hour":
+                out[c] = hour
+            elif c == "t_minute":
+                out[c] = (rows // 60) % 60
+            elif c == "t_second":
+                out[c] = rows % 60
+            elif c == "t_am_pm":
+                out[c] = _fixed(["AM", "PM"], (hour >= 12).astype(np.int64))
+            elif c == "t_shift":
+                out[c] = _fixed(
+                    ["first", "second", "third"],
+                    np.clip(hour // 8, 0, 2),
+                )
+        return out
+
+    def _gen_reason(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "r_reason_sk":
+                out[c] = rows + 1
+            elif c == "r_reason_id":
+                out[c] = _numbered(
+                    "Reason", self.counts["reason"], rows + 1
+                )
+            elif c == "r_reason_desc":
+                out[c] = _fixed(REASON_DESCS, rows % len(REASON_DESCS))
+        return out
+
+    def _gen_ship_mode(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "sm_ship_mode_sk":
+                out[c] = rows + 1
+            elif c == "sm_ship_mode_id":
+                out[c] = _numbered(
+                    "ShipMode", self.counts["ship_mode"], rows + 1
+                )
+            elif c == "sm_type":
+                out[c] = _fixed(SHIP_TYPES, rows % len(SHIP_TYPES))
+            elif c == "sm_code":
+                out[c] = _fixed(SHIP_CODES, (rows // 5) % len(SHIP_CODES))
+            elif c == "sm_carrier":
+                out[c] = _fixed(CARRIERS, rows % len(CARRIERS))
+        return out
+
+    def _gen_call_center(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "cc_call_center_sk":
+                out[c] = rows + 1
+            elif c == "cc_call_center_id":
+                out[c] = _numbered(
+                    "CallCenter", self.counts["call_center"], rows + 1
+                )
+            elif c == "cc_name":
+                out[c] = _fixed(CC_NAMES, rows % len(CC_NAMES))
+            elif c == "cc_manager":
+                out[c] = _numbered(
+                    "Manager", self.counts["call_center"], rows + 1
+                )
+            elif c == "cc_county":
+                out[c] = _fixed(CITIES, rows % len(CITIES))
+            elif c == "cc_state":
+                out[c] = _fixed(STATES, rows % len(STATES))
+        return out
+
+    def _gen_web_page(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "wp_web_page_sk":
+                out[c] = rows + 1
+            elif c == "wp_web_page_id":
+                out[c] = _numbered(
+                    "WebPage", self.counts["web_page"], rows + 1
+                )
+            elif c == "wp_url":
+                out[c] = _fixed(["http://www.foo.com"], rows * 0)
+            elif c == "wp_char_count":
+                out[c] = _uniform(3101, rows, 100, 8000)
+            elif c == "wp_link_count":
+                out[c] = _uniform(3102, rows, 2, 25)
+        return out
+
+    def _gen_catalog_page(self, rows, columns):
+        out = {}
+        for c in columns:
+            if c == "cp_catalog_page_sk":
+                out[c] = rows + 1
+            elif c == "cp_catalog_page_id":
+                out[c] = _numbered(
+                    "CatalogPage", self.counts["catalog_page"], rows + 1
+                )
+            elif c == "cp_catalog_number":
+                out[c] = rows // 108 + 1  # 108 pages per catalog
+            elif c == "cp_catalog_page_number":
+                out[c] = rows % 108 + 1
+            elif c == "cp_department":
+                out[c] = _fixed(["DEPARTMENT"], rows * 0)
+        return out
+
+    def _gen_inventory(self, rows, columns):
+        # row = ((week * n_items) + item) * 5 + warehouse: every
+        # (item, warehouse) pair snapshots once per week
+        n_items = self.counts["item"]
+        wh = rows % 5
+        item = (rows // 5) % n_items
+        week = rows // (5 * n_items)
+        out = {}
+        for c in columns:
+            if c == "inv_date_sk":
+                out[c] = self._date_sk_for(_SOLD_LO + week * 7)
+            elif c == "inv_item_sk":
+                out[c] = item + 1
+            elif c == "inv_warehouse_sk":
+                out[c] = wh + 1
+            elif c == "inv_quantity_on_hand":
+                out[c] = _uniform(3201, rows, 0, 1000)
+        return out
 
     def _gen_income_band(self, rows, columns):
         out = {}
@@ -725,6 +938,17 @@ class TpcdsGenerator:
         for c in columns:
             if c == "cs_sold_date_sk":
                 out[c] = self._date_sk_for(f["sold"])
+            elif c == "cs_ship_date_sk":
+                # 1..120-day ship lag: Q99's latency buckets all select
+                out[c] = self._date_sk_for(
+                    f["sold"] + _uniform(1912, rows, 1, 120)
+                )
+            elif c == "cs_ship_mode_sk":
+                out[c] = _uniform(1913, rows, 1, cn["ship_mode"])
+            elif c == "cs_call_center_sk":
+                out[c] = _uniform(1914, rows, 1, cn["call_center"])
+            elif c == "cs_warehouse_sk":
+                out[c] = _uniform(1915, rows, 1, cn["warehouse"])
             elif c == "cs_bill_customer_sk":
                 out[c] = _uniform(1903, rows, 1, cn["customer"])
             elif c == "cs_bill_cdemo_sk":
@@ -808,6 +1032,8 @@ class TpcdsGenerator:
                 # warehouses, so Q95's ws_wh self-join inequality selects
                 # a real slice
                 out[c] = _uniform(2106, rows, 1, 3)
+            elif c == "ws_ship_mode_sk":
+                out[c] = _uniform(2109, rows, 1, cn["ship_mode"])
             elif c == "ws_order_number":
                 out[c] = f["order"]
             elif c == "ws_ext_ship_cost":
@@ -850,6 +1076,12 @@ class _TpcdsMetadata(ConnectorMetadata):
         "item": ("i_item_sk",),
         "customer": ("c_customer_sk",),
         "customer_address": ("ca_address_sk",),
+        "time_dim": ("t_time_sk",),
+        "reason": ("r_reason_sk",),
+        "ship_mode": ("sm_ship_mode_sk",),
+        "call_center": ("cc_call_center_sk",),
+        "web_page": ("wp_web_page_sk",),
+        "catalog_page": ("cp_catalog_page_sk",),
         # fact tables: NO primary key declared — the closed-form
         # generators draw items independently per line, so (item, order)
         # pairs can repeat; declaring a PK would license build-unique
@@ -874,13 +1106,19 @@ class _TpcdsMetadata(ConnectorMetadata):
         "c_current_hdemo_sk": "household_demographics",
         "c_current_addr_sk": "customer_address",
         "hd_income_band_sk": "income_band",
+        "inv_item_sk": "item",
+        "inv_warehouse_sk": "warehouse",
+        "ws_ship_mode_sk": "ship_mode",
+        "cs_ship_mode_sk": "ship_mode",
+        "cs_call_center_sk": "call_center",
+        "cs_warehouse_sk": "warehouse",
     }
 
     DATE_FKS = (
         "ss_sold_date_sk", "sr_returned_date_sk", "cs_sold_date_sk",
         "cr_returned_date_sk", "ws_sold_date_sk", "ws_ship_date_sk",
         "wr_returned_date_sk", "c_first_sales_date_sk",
-        "c_first_shipto_date_sk",
+        "c_first_shipto_date_sk", "inv_date_sk", "cs_ship_date_sk",
     )
 
     def list_schemas(self):

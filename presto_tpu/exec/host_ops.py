@@ -93,8 +93,21 @@ def apply_host_ops(
     cols = {}  # name -> (np_data, np_valid, dtype, dictionary)
     i = 0
     for name, blk in zip(page.names, page.blocks):
-        data = fetched[i]
-        i += 1
+        if blk.offsets is not None:
+            # array block leaves: offsets[:n+1] + full flat values.
+            # Host form = object array of per-row value slices, so the
+            # sort/limit/output permutations below index it natively.
+            off = np.asarray(fetched[i])
+            i += 1
+            vals = np.asarray(fetched[i])
+            i += 1
+            rows = np.empty(n, dtype=object)
+            for r in range(n):
+                rows[r] = vals[off[r]: off[r + 1]]
+            data = rows
+        else:
+            data = fetched[i]
+            i += 1
         if blk.valid is not None:
             valid = fetched[i]
             i += 1
@@ -131,6 +144,31 @@ def apply_host_ops(
     blocks = []
     names = []
     for name, (d, v, t, dic) in cols.items():
+        if t.is_array:
+            # object array of per-row slices -> offsets + flat values
+            lengths = [len(d[r]) for r in range(n)]
+            offsets = np.zeros(cap + 1, np.int32)
+            np.cumsum(lengths, out=offsets[1: n + 1])
+            offsets[n + 1:] = offsets[n]
+            flat = (
+                np.concatenate([np.asarray(d[r]) for r in range(n)])
+                if n and offsets[n]
+                else np.zeros(0, t.element.np_dtype)
+            )
+            vpad = np.zeros(cap, bool)
+            vpad[:n] = v[:n]
+            valid = None if bool(np.all(v[:n])) else jnp.asarray(vpad)
+            blocks.append(
+                Block(
+                    data=jnp.asarray(flat),
+                    valid=valid,
+                    dtype=t,
+                    dictionary=dic,
+                    offsets=jnp.asarray(offsets),
+                )
+            )
+            names.append(name)
+            continue
         pad = cap - len(d)
         if pad:
             d = np.concatenate([d, np.zeros(pad, dtype=d.dtype)])

@@ -270,8 +270,10 @@ class LocalQueryRunner:
                         f"columns {len(names)}"
                     )
                 rows.append([_literal_value(e) for e in row])
+            from presto_tpu.exec.staging import obj_array
+
             cols = {
-                name: np.asarray([r[i] for r in rows], dtype=object)
+                name: obj_array([r[i] for r in rows])
                 for i, name in enumerate(names)
             }
             conn.append_rows(handle, cols)
@@ -451,7 +453,13 @@ class LocalQueryRunner:
             ]
             if not cands:
                 break
-            child = max(cands, key=_plan_weight)
+            # BUILD side first when reducing a join (reference pipeline
+            # order: HashBuilder before LookupJoin) — its executed page
+            # then feeds a dynamic filter into the probe side
+            if isinstance(node, N.JoinNode) and node.right in cands:
+                child = node.right
+            else:
+                child = max(cands, key=_plan_weight)
             leaf = self._execute_to_leaf(child, pages_map)
             swaps = {
                 f.name: leaf
@@ -459,7 +467,95 @@ class LocalQueryRunner:
                 if getattr(node, f.name) is child
             }
             node = dataclasses.replace(node, **swaps)
+            node = self._apply_dynamic_filter(node, leaf, pages_map)
         return node
+
+    def _apply_dynamic_filter(
+        self, node: N.PlanNode, leaf: N.RemoteSourceNode, pages_map
+    ) -> N.PlanNode:
+        """Dynamic filtering (reference: runtime dynamic filters flowing
+        from the join build side into probe-side scans — SURVEY.md
+        §3.2): when a stage-at-a-time JOIN's BUILD side has just
+        executed, fetch its join-key min/max (one round trip of two
+        scalars) and pre-filter the still-unexecuted probe side with
+        the resulting range — probe rows outside the build's key domain
+        cannot match, so inner/semi joins may drop them early (cuts
+        join out_capacity pressure and overflow retries on star
+        joins)."""
+        if not self.session.get("enable_dynamic_filtering"):
+            return node
+        if not (
+            isinstance(node, N.JoinNode)
+            and node.right is leaf
+            and node.join_type in ("inner", "semi")
+            and not isinstance(
+                node.left, (N.RemoteSourceNode, N.ValuesNode)
+            )
+        ):
+            return node
+        build = pages_map[id(leaf)]
+        left_schema = node.left.output_schema()
+        conjuncts: List[E.Expr] = []
+        fetch: List = []
+        specs: List[Tuple[str, object]] = []
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            blk = build.block(rk)
+            lt = left_schema.get(lk)
+            if (
+                lt is None
+                or lt != blk.dtype  # scales/id-spaces must agree
+                or lt.is_string
+                or lt.is_long_decimal
+                or blk.offsets is not None
+            ):
+                continue
+            mask = build.row_mask()
+            if blk.valid is not None:
+                mask = mask & blk.valid
+            is_float = lt.name in ("double", "real")
+            if is_float:
+                d = blk.data.astype(jnp.float64)
+                lo_fill, hi_fill = jnp.inf, -jnp.inf
+            else:
+                info = jnp.iinfo(jnp.int64)
+                d = blk.data.astype(jnp.int64)
+                lo_fill, hi_fill = info.max, info.min
+            fetch.append(jnp.min(jnp.where(mask, d, lo_fill)))
+            fetch.append(jnp.max(jnp.where(mask, d, hi_fill)))
+            specs.append((lk, lt, is_float))
+        if not specs:
+            return node
+        vals = jax.device_get(fetch)
+        for i, (lk, lt, is_float) in enumerate(specs):
+            if is_float:
+                # exact float bounds (int truncation would exclude
+                # matching fractional keys)
+                lo, hi = float(vals[2 * i]), float(vals[2 * i + 1])
+                if not (lo <= hi):  # empty build (inf fills) / NaN
+                    lo, hi = 0.0, -1.0
+            else:
+                lo, hi = int(vals[2 * i]), int(vals[2 * i + 1])
+                if lo > hi:  # empty build: no key can match
+                    lo, hi = 0, -1
+            ref = E.ColumnRef(lk, lt)
+            # compare in the key's native repr (decimals unscaled)
+            conjuncts.append(
+                E.Between(
+                    ref,
+                    E.Literal(lo, lt),
+                    E.Literal(hi, lt),
+                )
+            )
+        if self._active_qs is not None:
+            self._active_qs.dynamic_filters += len(conjuncts)
+        pred = (
+            conjuncts[0]
+            if len(conjuncts) == 1
+            else E.And(tuple(conjuncts))
+        )
+        return dataclasses.replace(
+            node, left=N.FilterNode(source=node.left, predicate=pred)
+        )
 
     def _execute_to_leaf(
         self, subtree: N.PlanNode, pages_map: Dict[int, Page]
@@ -689,6 +785,28 @@ def _page_from_prefix(page: Page, prefix_leaves, n: int) -> Page:
     cap = bucket_capacity(n)
     blocks = []
     for blk in page.blocks:
+        if blk.offsets is not None:
+            # array block leaves: offsets[:n+1] + the full values array
+            opref = next(fetched)
+            vals = next(fetched)
+            offsets = np.zeros((cap + 1,), np.int32)
+            offsets[: n + 1] = opref[: n + 1]
+            offsets[n + 1:] = offsets[n]  # padding rows read empty
+            if blk.valid is not None:
+                vpref = next(fetched)
+                valid = np.zeros((cap,), bool)
+                valid[:n] = vpref[:n]
+            else:
+                valid = None
+            blocks.append(
+                dataclasses.replace(
+                    blk,
+                    data=np.asarray(vals),
+                    valid=valid,
+                    offsets=offsets,
+                )
+            )
+            continue
         pref = next(fetched)
         data = np.zeros((cap,) + pref.shape[1:], page_np_dtype(blk))
         data[:n] = pref[:n]
@@ -864,6 +982,19 @@ def _execute_node_inner(
             run(node.source), node.partition_by, node.order_by, node.calls
         )
     if isinstance(node, N.UnnestNode):
+        if node.array_column is not None:
+            from presto_tpu.ops import unnest_column
+
+            out, overflow = unnest_column(
+                run(node.source),
+                node.array_column,
+                node.out_name,
+                node.out_type,
+                node.ordinality_name,
+                node.out_capacity,
+            )
+            flags.append(overflow)
+            return out
         return unnest_op(
             run(node.source),
             node.elements,
@@ -1010,7 +1141,7 @@ def _scale_capacities(node: N.PlanNode, factor: int) -> N.PlanNode:
     if isinstance(node, (N.AggregationNode, N.DistinctNode)):
         changes["max_groups"] = node.max_groups * factor
     if (
-        isinstance(node, (N.JoinNode, N.CrossJoinNode))
+        isinstance(node, (N.JoinNode, N.CrossJoinNode, N.UnnestNode))
         and node.out_capacity is not None
     ):
         changes["out_capacity"] = node.out_capacity * factor
@@ -1061,10 +1192,11 @@ def _merge_split_payloads(datas: List[Dict], columns: List[str]) -> Dict:
 def _result_columns(res: QueryResult) -> Dict[str, np.ndarray]:
     """QueryResult -> {column: object ndarray of python values} (the
     write-SPI row format; None = NULL)."""
+    from presto_tpu.exec.staging import obj_array
+
     dicts = res.page.to_pylist()
     return {
-        c: np.asarray([r[c] for r in dicts], dtype=object)
-        for c in res.columns
+        c: obj_array([r[c] for r in dicts]) for c in res.columns
     }
 
 
@@ -1082,6 +1214,8 @@ def _literal_value(e):
         return e.value
     if isinstance(e, A.NullLit):
         return None
+    if isinstance(e, A.ArrayLit):
+        return [_literal_value(x) for x in e.items]
     if isinstance(e, A.BoolLit):
         return e.value
     if isinstance(e, A.UnaryOp) and e.op == "-":

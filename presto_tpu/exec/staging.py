@@ -31,6 +31,33 @@ MIN_BUCKET = 1 << 10
 
 
 @dataclasses.dataclass
+class ArrayColumn:
+    """Array-column staging payload: int32 offsets (n+1) over flat
+    values (+ optional per-ROW validity and element dictionary values).
+    The wire/staging twin of Block.offsets (reference: ArrayBlock)."""
+
+    offsets: np.ndarray
+    values: np.ndarray
+    valid: Optional[np.ndarray] = None
+    dict_values: Optional[tuple] = None
+
+    def __getitem__(self, sl: slice) -> "ArrayColumn":
+        """Row-slice (wire chunking): offsets rebase to the slice."""
+        lo = sl.start or 0
+        n = len(self.offsets) - 1
+        hi = min(sl.stop if sl.stop is not None else n, n)
+        off = np.asarray(self.offsets[lo : hi + 1], np.int32)
+        base = int(off[0]) if len(off) else 0
+        end = int(off[-1]) if len(off) else base
+        return ArrayColumn(
+            offsets=off - base,
+            values=np.asarray(self.values)[base:end],
+            valid=None if self.valid is None else self.valid[lo:hi],
+            dict_values=self.dict_values,
+        )
+
+
+@dataclasses.dataclass
 class MaskedColumn:
     """Native-representation column + validity mask (+ optional
     dictionary values): the exchange-wire staging form — keeps decimals
@@ -40,6 +67,15 @@ class MaskedColumn:
     data: np.ndarray
     valid: np.ndarray
     values: Optional[tuple] = None  # dictionary values when string-typed
+
+
+def obj_array(values) -> np.ndarray:
+    """Element-wise object ndarray (np.asarray would collapse
+    equal-length list values — array columns — into a 2-D array)."""
+    arr = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        arr[i] = v
+    return arr
 
 
 def bucket_capacity(n: int) -> int:
@@ -68,6 +104,32 @@ def stage_page(
     for name in names:
         t = schema[name]
         v = data[name]
+        if isinstance(v, ArrayColumn):
+            off = np.asarray(v.offsets, np.int32)
+            offsets = np.full(cap + 1, off[-1] if len(off) else 0,
+                              np.int32)
+            offsets[: len(off)] = off
+            valid = None
+            if v.valid is not None:
+                vpad = np.zeros(cap, bool)
+                vpad[: len(v.valid)] = v.valid
+                valid = jnp.asarray(vpad)
+            blocks.append(
+                Block(
+                    data=jnp.asarray(
+                        np.asarray(v.values, t.element.np_dtype)
+                    ),
+                    valid=valid,
+                    dtype=t,
+                    dictionary=(
+                        Dictionary(np.asarray(v.dict_values, object))
+                        if v.dict_values is not None
+                        else None
+                    ),
+                    offsets=jnp.asarray(offsets),
+                )
+            )
+            continue
         if isinstance(v, MaskedColumn):
             arr = v.data.astype(t.np_dtype, copy=False)
             # long decimals carry (n, 2) limb pairs; pad on axis 0

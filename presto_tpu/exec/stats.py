@@ -50,6 +50,7 @@ class QueryStats:
     compile_cache_hit: bool = True
     retries: int = 0  # capacity-overflow re-runs
     device_fragments: int = 0  # stage-at-a-time programs beyond the root
+    dynamic_filters: int = 0  # build->probe runtime range filters applied
     input_rows: int = 0
     input_bytes: int = 0
     output_rows: int = 0

@@ -127,7 +127,9 @@ def _run_fragment(runner, frag_root: N.PlanNode, materialized: Dict):
             "semantics-preserving streaming cut"
         )
 
-    bucket_root, rest_root, frag_remote = _split_final(stage.final_root)
+    bucket_root, rest_root, frag_remote, rest_remote = _split_final(
+        stage.final_root, stage.worker_fragment
+    )
 
     # --- the single input pass: batch -> partial -> bucket spill
     from presto_tpu.exec.staging import bucket_capacity
@@ -188,11 +190,6 @@ def _run_fragment(runner, frag_root: N.PlanNode, materialized: Dict):
     if rest_root is None:
         return result
     # the rest of the fragment may hold further oversized scans: recurse
-    rest_remote = next(
-        n
-        for n in N.walk(rest_root)
-        if isinstance(n, N.RemoteSourceNode)
-    )
     return _run_fragment(
         runner, rest_root, {**materialized, id(rest_remote): result}
     )
@@ -226,7 +223,9 @@ def grouped_final_merge(
             "spill_enabled=false (reference behavior: fail on memory "
             "rather than spill)"
         )
-    bucket_root, rest_root, frag_remote = _split_final(final_root)
+    bucket_root, rest_root, frag_remote, rest_remote = _split_final(
+        final_root, worker_fragment
+    )
     n_buckets = _n_buckets_for(total_rows, max_rows)
     spill = bucketize_payloads(payloads, schema, key_names, n_buckets)
     page = merge_spilled_buckets(
@@ -234,9 +233,6 @@ def grouped_final_merge(
     )
     if rest_root is None:
         return page
-    rest_remote = next(
-        n for n in N.walk(rest_root) if isinstance(n, N.RemoteSourceNode)
-    )
     local_scans = [
         n for n in N.walk(rest_root) if isinstance(n, N.TableScanNode)
     ]
@@ -294,15 +290,29 @@ def bucketize_payloads(
     return spill
 
 
-def _split_final(final_root: N.PlanNode):
+def _split_final(
+    final_root: N.PlanNode, worker_fragment: N.PlanNode = None
+):
     """Split the coordinator-side plan into the bucket-safe chain (the
     final agg/distinct merge plus row-wise filters/projections directly
     above it — safe because groups are complete within one bucket) and
-    the rest. Returns (bucket_root|None, rest_root|None, remote)."""
+    the rest. Returns (bucket_root|None, rest_root|None, remote,
+    rest_remote|None) — ``rest_remote`` is the leaf in rest_root the
+    bucket-merged page binds to.
+
+    ``worker_fragment`` identifies THIS stage's remote when the final
+    plan holds several RemoteSourceNodes (recursive streaming leaves
+    earlier fragments' remotes in the tree — picking the first in walk
+    order built bucket chains around, and bound results to, the WRONG
+    exchange)."""
     remote = next(
         n
         for n in N.walk(final_root)
         if isinstance(n, N.RemoteSourceNode)
+        and (
+            worker_fragment is None
+            or n.fragment_root is worker_fragment
+        )
     )
     path = _path_to(final_root, remote)
     j = len(path) - 2
@@ -316,16 +326,18 @@ def _split_final(final_root: N.PlanNode):
             j -= 1
     bucket_root = path[j + 1]
     if bucket_root is remote:
+        # no bucket-safe chain: the merged page binds to the stage
+        # remote itself inside the (unchanged) rest plan
         return None, (
             None if final_root is remote else final_root
-        ), remote
+        ), remote, remote
     if bucket_root is final_root:
-        return bucket_root, None, remote
+        return bucket_root, None, remote, None
     rest_remote = N.RemoteSourceNode(fragment_root=bucket_root)
     rest_root = _replace_on_path(
         path[: j + 1], bucket_root, rest_remote
     )
-    return bucket_root, rest_root, remote
+    return bucket_root, rest_root, remote, rest_remote
 
 
 def _cap_cut_groups(root: N.PlanNode, cap: int) -> N.PlanNode:
@@ -559,12 +571,21 @@ def _page_to_payload(page) -> Tuple[Dict, Dict, int]:
     """Device page -> (staging payload, schema, nrows) on host numpy —
     the same shape pages_wire.deserialize_page produces, so bucket
     merges reuse pages_wire.merge_payloads (incl. dictionary remap)."""
+    from presto_tpu.exec.staging import ArrayColumn
+
     cols, n = pages_wire.page_to_wire_columns(page)
     payload: Dict = {}
     schema: Dict = {}
     for name, data, valid, dtype, dict_values in cols:
         schema[name] = dtype
-        if valid is not None:
+        if isinstance(data, ArrayColumn):
+            payload[name] = ArrayColumn(
+                offsets=data.offsets,
+                values=data.values,
+                valid=data.valid,
+                dict_values=dict_values,
+            )
+        elif valid is not None:
             payload[name] = MaskedColumn(
                 data=np.asarray(data),
                 valid=np.asarray(valid),
@@ -595,6 +616,12 @@ def _col_hash_input(col, nrows: int) -> np.ndarray:
     """uint64 image of a column for bucket hashing. Dictionary ids are
     mapped through a per-VALUE crc so the hash is stable across batches
     whose dictionaries differ; NULLs hash to 0 (one bucket)."""
+    from presto_tpu.exec.staging import ArrayColumn
+
+    if isinstance(col, ArrayColumn):
+        raise NotImplementedError(
+            "array columns cannot be bucket-hash keys"
+        )
     if isinstance(col, MaskedColumn):
         base = _col_hash_input(
             DictColumn(ids=np.asarray(col.data, np.int64), values=col.values)
@@ -636,9 +663,38 @@ def _bucket_of(payload, key_names, nrows, n_buckets) -> np.ndarray:
 
 
 def _slice_payload(payload, schema, mask) -> Dict:
+    from presto_tpu.exec.staging import ArrayColumn
+
     out = {}
     for name in schema:
         col = payload[name]
+        if isinstance(col, ArrayColumn):
+            off = np.asarray(col.offsets, np.int64)
+            idx = np.nonzero(mask)[0]
+            lens = off[1:] - off[:-1]
+            new_off = np.zeros(len(idx) + 1, np.int32)
+            np.cumsum(lens[idx], out=new_off[1:])
+            vals = (
+                np.concatenate(
+                    [
+                        np.asarray(col.values)[off[i]: off[i + 1]]
+                        for i in idx
+                    ]
+                )
+                if len(idx)
+                else np.asarray(col.values)[:0]
+            )
+            out[name] = ArrayColumn(
+                offsets=new_off,
+                values=vals,
+                valid=(
+                    None
+                    if col.valid is None
+                    else np.asarray(col.valid)[: len(mask)][mask]
+                ),
+                dict_values=col.dict_values,
+            )
+            continue
         if isinstance(col, MaskedColumn):
             out[name] = MaskedColumn(
                 data=np.asarray(col.data)[: len(mask)][mask],

@@ -479,6 +479,40 @@ def dict_transform_fn(fn_key: str):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayLength(Expr):
+    """cardinality(arr) over a physical array column -> BIGINT
+    (offsets difference; NULL rows stay NULL)."""
+
+    arg: Expr  # ColumnRef to an array column
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BIGINT
+
+
+@dataclasses.dataclass(frozen=True)
+class ArraySubscript(Expr):
+    """arr[i] / element_at(arr, i) over a physical array column: a
+    bounds-checked gather ``values[offsets[row] + i - 1]``;
+    out-of-range (or negative-from-the-end out-of-range) -> NULL
+    (Presto element_at semantics; the reference's subscript raises —
+    documented deviation keeps the kernel branch-free)."""
+
+    arg: Expr  # ColumnRef to an array column
+    index: Expr  # 1-based; negative = from the end
+
+    def children(self):
+        return (self.arg, self.index)
+
+    @property
+    def dtype(self):
+        return self.arg.dtype.element
+
+
+@dataclasses.dataclass(frozen=True)
 class DateAdd(Expr):
     """date_add(unit, n, x): shift a date/timestamp by n units (unit in
     day|week|month|year). Month/year shifts clamp the day-of-month to
@@ -684,6 +718,9 @@ class ExprLowerer:
 
             vals = [] if expr.value is None else [str(expr.value)]
             return Dictionary(np.asarray(vals, object))
+        if isinstance(expr, ArraySubscript):
+            # elements share the array block's dictionary
+            return self._array_block(expr.arg).dictionary
         raise NotImplementedError(
             f"no dictionary for string expression {type(expr).__name__}"
         )
@@ -1412,6 +1449,46 @@ class ExprLowerer:
         if is_ts:
             return out_days * us_per_day + tod, valid
         return out_days.astype(e.arg.dtype.jnp_dtype), valid
+
+    def _array_block(self, e: Expr):
+        if not isinstance(e, ColumnRef):
+            raise NotImplementedError(
+                "array operations require a physical array column"
+            )
+        blk = self.page.block(e.name)
+        if blk.offsets is None:
+            raise NotImplementedError(
+                f"{e.name} is not a physical array column"
+            )
+        return blk
+
+    def _eval_arraylength(self, e: ArrayLength):
+        blk = self._array_block(e.arg)
+        lengths = (blk.offsets[1:] - blk.offsets[:-1]).astype(jnp.int64)
+        return lengths, blk.valid
+
+    def _eval_arraysubscript(self, e: ArraySubscript):
+        blk = self._array_block(e.arg)
+        idx_d, idx_v = self.eval(e.index)
+        idx = jnp.broadcast_to(
+            idx_d.astype(jnp.int64), (blk.capacity,)
+        )
+        lengths = (blk.offsets[1:] - blk.offsets[:-1]).astype(jnp.int64)
+        # 1-based; negative counts from the end (Presto element_at)
+        pos = jnp.where(idx < 0, lengths + idx, idx - 1)
+        in_range = (pos >= 0) & (pos < lengths)
+        src = jnp.clip(
+            blk.offsets[:-1].astype(jnp.int64) + pos,
+            0,
+            max(blk.data.shape[0] - 1, 0),
+        )
+        data = blk.data[src]
+        valid = in_range
+        if blk.valid is not None:
+            valid = valid & blk.valid
+        if idx_v is not None:
+            valid = valid & jnp.broadcast_to(idx_v, (blk.capacity,))
+        return data, valid
 
     def _eval_dictintfunc(self, e: DictIntFunc):
         data, valid = self.eval(e.arg)

@@ -12,6 +12,7 @@ from presto_tpu.ops.filter_project import (  # noqa: F401
     filter_project,
     project,
     unnest,
+    unnest_column,
 )
 from presto_tpu.ops.aggregation import AggCall, hash_aggregate  # noqa: F401
 from presto_tpu.ops.join import hash_join, pack_keys  # noqa: F401

@@ -64,8 +64,8 @@ from presto_tpu.page import Block, Page
 @dataclasses.dataclass(frozen=True)
 class AggCall:
     """One aggregate: func in {count, count_star, sum, min, max, avg,
-    stddev_samp, stddev_pop, var_samp, var_pop} (the planner folds the
-    stddev/variance aliases onto the _samp forms)."""
+    stddev_samp, stddev_pop, var_samp, var_pop, array_agg} (the planner
+    folds the stddev/variance aliases onto the _samp forms)."""
 
     func: str
     arg: Optional[Expr]  # None only for count_star
@@ -76,6 +76,8 @@ class AggCall:
             return T.BIGINT
         if self.func in _VARIANCE_FUNCS:
             return T.DOUBLE
+        if self.func == "array_agg":
+            return T.array(self.arg.dtype)
         t = self.arg.dtype
         if self.func == "sum":
             if t.is_decimal:
@@ -169,6 +171,12 @@ def hash_aggregate(
     keys = [(name, *lowerer.eval(e), e) for name, e in group_keys]
 
     domains = [_static_domain(e, lowerer) for _, _, _, e in keys]
+    if any(a.func == "array_agg" for a in aggs):
+        # array_agg needs the sorted layout (group spans ARE the
+        # output arrays); skip the one-hot fast path
+        return _sorted_aggregate(
+            page, keys, aggs, max_groups, live, lowerer, errors_out
+        )
     if all(d is not None for d in domains):
         slots = [
             d + (1 if v is not None else 0)
@@ -468,6 +476,42 @@ def _sorted_one_agg(
         data = _cumsum_span(live_s.astype(jnp.int64), starts, ends)
         return Block(data=data, valid=None, dtype=T.BIGINT)
 
+    if agg.func == "array_agg":
+        # the sorted layout IS the concatenated per-group arrays
+        # (groups are contiguous spans); NULL inputs are SKIPPED, so
+        # valid values scatter to their rank among valid rows — stable,
+        # so groups stay contiguous — and group offsets are the valid
+        # counts at group starts. (Deviation: the reference's
+        # array_agg default INCLUDES nulls; arrays here carry no
+        # element validity.)
+        cap = page.capacity
+        d, v = lowerer.eval(agg.arg)
+        d_s = jnp.broadcast_to(d, (cap,))[order]
+        valid_s = live_s if v is None else (
+            live_s & jnp.broadcast_to(v, (cap,))[order]
+        )
+        cum = jnp.cumsum(valid_s.astype(jnp.int32))
+        total = cum[-1] if cap else jnp.int32(0)
+        pos = jnp.where(valid_s, cum - 1, cap)  # cap = dump slot
+        out_vals = jnp.zeros((cap + 1,), d_s.dtype).at[pos].set(d_s)
+        start_off = cum[starts] - valid_s[starts].astype(jnp.int32)
+        offsets = jnp.concatenate(
+            [
+                jnp.minimum(start_off, total).astype(jnp.int32),
+                total.reshape(1),
+            ]
+        )
+        dictionary = None
+        if agg.arg.dtype.is_string:
+            dictionary = lowerer.dictionary_of(agg.arg)
+        return Block(
+            data=out_vals[:cap],
+            valid=None,
+            dtype=rt,
+            dictionary=dictionary,
+            offsets=offsets,
+        )
+
     d, v = lowerer.eval(agg.arg)
     d = jnp.broadcast_to(d, (page.capacity,))[order]
     valid_s = live_s if v is None else (
@@ -587,6 +631,27 @@ def _global_one_agg(
             data=one(jnp.sum(live).astype(jnp.int64)),
             valid=None,
             dtype=T.BIGINT,
+        )
+
+    if agg.func == "array_agg":
+        d, v = lowerer.eval(agg.arg)
+        d = jnp.broadcast_to(d, (page.capacity,))
+        keep = live if v is None else (
+            live & jnp.broadcast_to(v, live.shape)
+        )
+        # stable-compact kept values to the front (single global array;
+        # NULL inputs skipped — documented deviation from include-nulls)
+        order = jnp.argsort(~keep, stable=True)
+        n = jnp.sum(keep).astype(jnp.int32)
+        dictionary = None
+        if agg.arg.dtype.is_string:
+            dictionary = lowerer.dictionary_of(agg.arg)
+        return Block(
+            data=d[order],
+            valid=None,
+            dtype=agg.result_type(),
+            dictionary=dictionary,
+            offsets=jnp.stack([jnp.int32(0), n]),
         )
 
     d, v = lowerer.eval(agg.arg)

@@ -23,7 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 
 from presto_tpu import types as T
-from presto_tpu.expr import Expr, ExprLowerer, eval_predicate
+from presto_tpu.expr import ColumnRef, Expr, ExprLowerer, eval_predicate
 from presto_tpu.page import Block, Page
 
 
@@ -34,6 +34,12 @@ def project(
     lowerer = ExprLowerer(page)
     names, blocks = [], []
     for name, expr in projections:
+        if isinstance(expr, ColumnRef) and expr.dtype.is_array:
+            # array columns pass through whole (offsets + flat values);
+            # non-identity array expressions have no lane form
+            blocks.append(page.block(expr.name))
+            names.append(name)
+            continue
         data, valid = lowerer.eval(expr)
         data = jnp.broadcast_to(data, _col_shape(page, expr))
         if valid is not None:
@@ -206,6 +212,85 @@ def unnest(
     )
 
 
+def unnest_column(
+    page: Page,
+    array_column: str,
+    out_name: str,
+    out_type,
+    ordinality_name: Optional[str],
+    out_capacity: int,
+):
+    """UNNEST of a physical array column (reference: UnnestOperator
+    over ArrayBlock): per-row length expansion via the engine's
+    prefix-sum + inverse-searchsorted trick, under the capacity-bucket
+    protocol. Returns (page, overflow). NULL / dead rows contribute 0
+    output rows (Presto: NULL arrays emit nothing)."""
+    blk = page.block(array_column)
+    off = blk.offsets
+    lengths = (off[1:] - off[:-1]).astype(jnp.int64)
+    live = page.row_mask()
+    if blk.valid is not None:
+        live = live & blk.valid
+    m = jnp.where(live, lengths, 0)
+    total = jnp.cumsum(m)
+    out_count = total[-1] if page.capacity else jnp.asarray(0, jnp.int64)
+    overflow = out_count > out_capacity
+
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
+    p_idx = jnp.searchsorted(total, j, side="right")
+    p_idx = jnp.minimum(p_idx, page.capacity - 1)
+    prev = jnp.where(p_idx > 0, total[jnp.maximum(p_idx - 1, 0)], 0)
+    offset = j - prev  # position within the parent row's array
+
+    vcap = max(blk.data.shape[0], 1)
+    src = jnp.clip(
+        off[p_idx].astype(jnp.int64) + offset, 0, vcap - 1
+    )
+
+    blocks, names = [], []
+    for name, b in zip(page.names, page.blocks):
+        if b.offsets is not None:
+            # array columns do not ride through the expansion (their
+            # repeated rows could exceed the flat value capacity);
+            # UnnestNode.output_schema drops them identically, so a
+            # post-unnest reference fails at PLAN time, not here
+            continue
+        blocks.append(
+            dataclasses.replace(
+                b,
+                data=b.data[p_idx],
+                valid=None if b.valid is None else b.valid[p_idx],
+            )
+        )
+        names.append(name)
+    blocks.append(
+        Block(
+            data=blk.data[src],
+            valid=None,
+            dtype=out_type,
+            dictionary=blk.dictionary,
+        )
+    )
+    names.append(out_name)
+    if ordinality_name is not None:
+        blocks.append(
+            Block(
+                data=offset + 1, valid=None, dtype=T.BIGINT
+            )
+        )
+        names.append(ordinality_name)
+    return (
+        Page(
+            blocks=tuple(blocks),
+            num_valid=jnp.minimum(out_count, out_capacity).astype(
+                jnp.int32
+            ),
+            names=tuple(names),
+        ),
+        overflow,
+    )
+
+
 def filter_project(
     page: Page,
     predicate: Optional[Expr],
@@ -243,6 +328,16 @@ def filter_project(
     lowerer = ExprLowerer(page)
     names, blocks = [], []
     for name, expr in projections:
+        if isinstance(expr, ColumnRef) and expr.dtype.is_array:
+            from presto_tpu.page import _gather_array_block
+
+            blocks.append(
+                _gather_array_block(
+                    page.block(expr.name), sel, count
+                )
+            )
+            names.append(name)
+            continue
         data, valid = lowerer.eval(expr)
         data = jnp.broadcast_to(data, _col_shape(page, expr))[sel]
         if valid is not None:

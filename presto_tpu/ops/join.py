@@ -339,6 +339,16 @@ def _join_output(
     payload_rename: dict,
     left_outer: bool,
 ) -> Page:
+    for name in list(probe.names) + list(build_payload):
+        src = probe if name in probe.names else build
+        if src.block(name).offsets is not None:
+            # a row-index gather of the FLAT values array with stale
+            # offsets would silently corrupt array columns
+            raise NotImplementedError(
+                f"array column {name} cannot ride through a join "
+                "output; select it before the join or join on its "
+                "parent rows and unnest after"
+            )
     names: List[str] = []
     blocks: List[Block] = []
     for name in probe.names:

@@ -54,6 +54,13 @@ def order_by(
         order = order[:limit]
     blocks = []
     for blk in page.blocks:
+        if blk.offsets is not None:
+            from presto_tpu.page import _gather_array_block
+
+            blocks.append(
+                _gather_array_block(blk, order, page.num_valid)
+            )
+            continue
         blocks.append(
             dataclasses.replace(
                 blk,

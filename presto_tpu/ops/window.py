@@ -123,6 +123,13 @@ def window(
     safe_peer = jnp.minimum(peer_gid, cap)
 
     names = list(page.names)
+    for name, blk in zip(names, page.blocks):
+        if blk.offsets is not None:
+            # flat-values gather with stale offsets would corrupt
+            raise NotImplementedError(
+                f"array column {name} cannot ride through a window "
+                "operator; select it separately"
+            )
     blocks = [
         dataclasses.replace(
             blk,

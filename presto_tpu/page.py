@@ -135,7 +135,7 @@ def encode_strings(
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["data", "valid"],
+    data_fields=["data", "valid", "offsets"],
     meta_fields=["dtype", "dictionary"],
 )
 @dataclasses.dataclass
@@ -145,15 +145,24 @@ class Block:
     ``valid`` is None when the column is known null-free (the common case
     for TPC-H) — that knowledge is static, so XLA never materialises or
     computes masks for non-null columns.
+
+    Array columns (``dtype.is_array``, reference: ArrayBlock): ``data``
+    is the flat VALUES array (its own padded capacity) and ``offsets``
+    is an int32 (row_capacity + 1,) array — row i's elements are
+    ``data[offsets[i]:offsets[i+1]]``; ``valid`` stays per-ROW. Scalar
+    columns carry offsets=None.
     """
 
     data: jnp.ndarray
     valid: Optional[jnp.ndarray]  # bool, True = non-null; None = all valid
     dtype: T.DataType
     dictionary: Optional[Dictionary] = None
+    offsets: Optional[jnp.ndarray] = None  # int32 (capacity+1,) arrays only
 
     @property
     def capacity(self) -> int:
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
         return self.data.shape[0]
 
     @classmethod
@@ -171,7 +180,34 @@ class Block:
     @classmethod
     def from_pylist(cls, values: Sequence, dtype: T.DataType) -> "Block":
         """Build from Python values (None = NULL). Handles dictionary
-        encoding for varchar and scaling for decimals."""
+        encoding for varchar, scaling for decimals, and offsets+flat
+        values for arrays (elements recurse through this builder)."""
+        if dtype.is_array:
+            lengths = [0 if v is None else len(v) for v in values]
+            offsets = np.zeros(len(values) + 1, np.int32)
+            np.cumsum(lengths, out=offsets[1:])
+            flat: list = []
+            for v in values:
+                if v is not None:
+                    flat.extend(v)
+            if any(x is None for x in flat):
+                raise NotImplementedError(
+                    "NULL array elements are not supported (documented "
+                    "deviation; NULL rows are)"
+                )
+            child = cls.from_pylist(flat, dtype.element)
+            isnull = np.array([v is None for v in values], bool)
+            return cls(
+                data=child.data,
+                valid=(
+                    None
+                    if not isnull.any()
+                    else jnp.asarray(~isnull)
+                ),
+                dtype=dtype,
+                dictionary=child.dictionary,
+                offsets=jnp.asarray(offsets),
+            )
         if dtype.is_string:
             ids, valid, dictionary = encode_strings(values)
             v = None if valid.all() else valid
@@ -279,10 +315,17 @@ class Page:
     def prefix_leaves(self, k) -> list:
         """Flat [data[:k], valid[:k]?, ...] leaf list for a batched
         device->host fetch of the first ``k`` rows — the ONE shape every
-        materialization path fetches (round-trip discipline)."""
+        materialization path fetches (round-trip discipline). Array
+        blocks fetch offsets[:k+1] plus the FULL flat values array
+        (their live extent is data-dependent; the padded fetch trades
+        bytes for the round trip)."""
         leaves = []
         for blk in self.blocks:
-            leaves.append(blk.data[:k])
+            if blk.offsets is not None:
+                leaves.append(blk.offsets[: k + 1])
+                leaves.append(blk.data)
+            else:
+                leaves.append(blk.data[:k])
             if blk.valid is not None:
                 leaves.append(blk.valid[:k])
         return leaves
@@ -339,6 +382,28 @@ class Page:
         n = len(idx)
         out_cols = {}
         for name, blk in zip(self.names, self.blocks):
+            if blk.dtype.is_array:
+                off = np.asarray(blk.offsets)
+                vals = np.asarray(blk.data)
+                rvalid = (
+                    np.ones(blk.capacity, bool)
+                    if blk.valid is None
+                    else np.asarray(blk.valid)
+                )
+                et = blk.dtype.element
+                col = []
+                for i in idx:
+                    if not rvalid[i]:
+                        col.append(None)
+                        continue
+                    col.append(
+                        [
+                            _decode_value(v, et, blk.dictionary)
+                            for v in vals[off[i]: off[i + 1]]
+                        ]
+                    )
+                out_cols[name] = col
+                continue
             data, valid = blk.to_numpy(None)
             data, valid = data[idx], valid[idx]
             col = []
@@ -346,34 +411,9 @@ class Page:
                 if not valid[i]:
                     col.append(None)
                     continue
-                v = data[i]
-                t = blk.dtype
-                if t.is_string:
-                    col.append(str(blk.dictionary.values[int(v)]))
-                elif t.is_long_decimal:
-                    # exact: int/10**s would lose precision past 2^53,
-                    # and the default context (prec 28) rounds scaleb
-                    import decimal as _dec
-
-                    unscaled = T.int128_value(int(v[0]), int(v[1]))
-                    with _dec.localcontext() as ctx:
-                        ctx.prec = 50
-                        col.append(
-                            _dec.Decimal(unscaled).scaleb(-t.scale)
-                        )
-                elif t.is_decimal:
-                    col.append(int(v) / (10 ** t.scale))
-                elif t.name == "date":
-                    col.append(
-                        datetime.date(1970, 1, 1)
-                        + datetime.timedelta(days=int(v))
-                    )
-                elif t.name == "boolean":
-                    col.append(bool(v))
-                elif t.is_integer or t.name == "timestamp":
-                    col.append(int(v))
-                else:
-                    col.append(float(v))
+                col.append(
+                    _decode_value(data[i], blk.dtype, blk.dictionary)
+                )
             out_cols[name] = col
         return [
             {name: out_cols[name][i] for name in self.names} for i in range(n)
@@ -381,6 +421,35 @@ class Page:
 
     def schema(self) -> Dict[str, T.DataType]:
         return {n: b.dtype for n, b in zip(self.names, self.blocks)}
+
+
+def _decode_value(v, t: T.DataType, dictionary: Optional[Dictionary]):
+    """One device value -> python value (shared by scalar columns and
+    array elements)."""
+    import datetime
+
+    if t.is_string:
+        return str(dictionary.values[int(v)])
+    if t.is_long_decimal:
+        # exact: int/10**s would lose precision past 2^53, and the
+        # default context (prec 28) rounds scaleb
+        import decimal as _dec
+
+        unscaled = T.int128_value(int(v[0]), int(v[1]))
+        with _dec.localcontext() as ctx:
+            ctx.prec = 50
+            return _dec.Decimal(unscaled).scaleb(-t.scale)
+    if t.is_decimal:
+        return int(v) / (10 ** t.scale)
+    if t.name == "date":
+        return datetime.date(1970, 1, 1) + datetime.timedelta(
+            days=int(v)
+        )
+    if t.name == "boolean":
+        return bool(v)
+    if t.is_integer or t.name == "timestamp":
+        return int(v)
+    return float(v)
 
 
 def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
@@ -397,6 +466,11 @@ def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
     (sel,) = jnp.nonzero(page.live, size=cap, fill_value=0)
     blocks = []
     for blk in page.blocks:
+        if blk.offsets is not None:
+            blocks.append(
+                _gather_array_block(blk, sel, page.num_valid)
+            )
+            continue
         blocks.append(
             dataclasses.replace(
                 blk,
@@ -408,6 +482,37 @@ def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
         blocks=tuple(blocks),
         num_valid=jnp.minimum(page.num_valid, cap).astype(jnp.int32),
         names=page.names,
+    )
+
+
+def _gather_array_block(
+    blk: Block, sel: jnp.ndarray, num_live
+) -> Block:
+    """Row-gather an array block: new offsets from the selected rows'
+    lengths, values re-laid-out by the prefix-sum + inverse-searchsorted
+    expansion (the engine's standard static-shape gather-of-segments).
+    ``sel`` fill entries (padding rows) contribute length 0 via the
+    ``num_live`` cutoff."""
+    cap = sel.shape[0]
+    off = blk.offsets
+    lengths = off[1:] - off[:-1]
+    sel_len = jnp.where(
+        jnp.arange(cap) < num_live, lengths[sel], 0
+    ).astype(jnp.int32)
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(sel_len).astype(jnp.int32)]
+    )
+    vcap = blk.data.shape[0]
+    j = jnp.arange(vcap, dtype=jnp.int32)
+    p = jnp.searchsorted(new_off[1:], j, side="right")
+    p = jnp.minimum(p, cap - 1)
+    src = off[sel[p]] + (j - new_off[p])
+    src = jnp.clip(src, 0, vcap - 1)
+    return dataclasses.replace(
+        blk,
+        data=blk.data[src],
+        valid=None if blk.valid is None else blk.valid[sel],
+        offsets=new_off,
     )
 
 
@@ -425,6 +530,29 @@ def pad_capacity(page: Page, capacity: int) -> Page:
         cap = blk.capacity
         if capacity == cap:
             blocks.append(blk)
+        elif blk.offsets is not None:
+            # array block: re-bucket the ROW axis (offsets); the flat
+            # values array keeps its own capacity. Shrink slices
+            # (monotonic prefix stays valid); grow edge-pads so padding
+            # rows read as empty
+            if capacity > cap:
+                offsets = jnp.pad(
+                    blk.offsets, [(0, capacity - cap)], mode="edge"
+                )
+            else:
+                offsets = blk.offsets[: capacity + 1]
+            valid = (
+                None
+                if blk.valid is None
+                else (
+                    jnp.pad(blk.valid, [(0, capacity - cap)])
+                    if capacity > cap
+                    else blk.valid[:capacity]
+                )
+            )
+            blocks.append(
+                dataclasses.replace(blk, offsets=offsets, valid=valid)
+            )
         elif capacity > cap:
             pad = [(0, capacity - cap)]
             data = jnp.pad(blk.data, pad)

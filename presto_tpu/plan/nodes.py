@@ -200,21 +200,31 @@ class WindowNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class UnnestNode(PlanNode):
-    """CROSS JOIN UNNEST(ARRAY[e1..ek]) AS a(col [, ord]) — reference:
-    UnnestNode (presto-main logical plan). The engine keeps arrays as
-    trace-time expression lists, so unnest is a static-width row
-    expansion: every input row yields exactly k output rows (capacity
-    x k, shapes static for XLA), with the unnest column interleaved
-    from the k element expressions."""
+    """CROSS JOIN UNNEST(...) AS a(col [, ord]) — reference: UnnestNode
+    (presto-main logical plan). Two forms:
+
+    - constructor form (``elements``): ARRAY[e1..ek] is a trace-time
+      expression list, so unnest is a static-width row expansion —
+      every input row yields exactly k output rows (capacity x k,
+      shapes static for XLA);
+    - column form (``array_column``): a physical array column expands
+      by per-row lengths under the engine's capacity-bucket protocol
+      (``out_capacity`` + overflow retry)."""
 
     source: PlanNode
     elements: Tuple[Expr, ...]  # all pre-coerced to out_type
     out_name: str
     out_type: T.DataType
     ordinality_name: Optional[str] = None
+    array_column: Optional[str] = None  # column form
+    out_capacity: Optional[int] = None  # column form output bucket
 
     def output_schema(self):
         out = dict(self.source.output_schema())
+        if self.array_column is not None:
+            # column form drops array columns (their repeated rows
+            # could exceed the flat value capacity; see ops.unnest_column)
+            out = {n: t for n, t in out.items() if not t.is_array}
         out[self.out_name] = self.out_type
         if self.ordinality_name is not None:
             out[self.ordinality_name] = T.BIGINT

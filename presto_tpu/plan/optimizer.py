@@ -162,6 +162,8 @@ def estimate_rows(node: N.PlanNode, catalogs) -> float:
     if isinstance(node, N.LimitNode):
         return min(estimate_rows(node.source, catalogs), node.count)
     if isinstance(node, N.UnnestNode):
+        if node.array_column is not None:
+            return estimate_rows(node.source, catalogs) * 4.0
         return estimate_rows(node.source, catalogs) * len(node.elements)
     if isinstance(node, N.JoinNode):
         probe = estimate_rows(node.left, catalogs)
@@ -260,10 +262,40 @@ def _expr_columns(e: E.Expr, out: Set[str]) -> None:
         _expr_columns(c, out)
 
 
+def normalize_interior_outputs(
+    node: N.PlanNode, is_root: bool = True
+) -> N.PlanNode:
+    """Rewrite non-root OutputNodes (subquery relations keep one from
+    plan_select) into plain projections: an interior Output is just a
+    column select/rename, and leaving it blocks the fragmenter's
+    distributable-subtree detection and the fragment-weight model."""
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            nv = normalize_interior_outputs(v, is_root=False)
+            if nv is not v:
+                changes[f.name] = nv
+    if changes:
+        node = dataclasses.replace(node, **changes)
+    if not is_root and isinstance(node, N.OutputNode):
+        src_schema = node.source.output_schema()
+        return N.ProjectNode(
+            source=node.source,
+            projections=tuple(
+                (out, E.ColumnRef(col, src_schema[col]))
+                for out, col in node.columns
+            ),
+        )
+    return node
+
+
 def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
     """Drop unused columns, pushing requirements down to scans
     (reference: PruneUnreferencedOutputs / pushdown of column sets into
     ConnectorPageSource — SURVEY.md §2.2 pushdown surface)."""
+    if required is None:
+        node = normalize_interior_outputs(node)
     if isinstance(node, N.OutputNode):
         need = {src for _, src in node.columns}
         return dataclasses.replace(
@@ -369,6 +401,8 @@ def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
         need = set(required) - {node.out_name, node.ordinality_name}
         for e in node.elements:
             _expr_columns(e, need)
+        if node.array_column is not None:
+            need.add(node.array_column)
         return dataclasses.replace(
             node, source=prune_columns(node.source, need)
         )

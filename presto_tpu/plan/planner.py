@@ -136,6 +136,7 @@ AGG_FUNCS = {
     # (plans as the exact count(DISTINCT x) rewrite, error 0)
     "approx_distinct", "arbitrary", "any_value",
     "bool_and", "bool_or", "every",
+    "array_agg",
 }
 NAV_WINDOW_FUNCS = {"lag", "lead", "first_value", "last_value", "ntile"}
 WINDOW_FUNCS = (
@@ -252,6 +253,10 @@ class _Planner:
                         "ORDER BY a long decimal is not supported "
                         "(documented deviation; cast to decimal(18,s) "
                         "or double to sort)"
+                    )
+                if k.dtype.is_array:
+                    raise PlanningError(
+                        "ORDER BY an array column is not supported"
                     )
                 sort_keys.append(
                     SortKey(k, si.descending, si.nulls_first)
@@ -377,24 +382,35 @@ class _Planner:
     def _apply_unnest(self, node, scope: Scope, u: ast.UnnestRef):
         """CROSS JOIN UNNEST(ARRAY[...]) — static-width row expansion
         (see N.UnnestNode). Arrays exist at trace time as expression
-        lists, so only the ARRAY[...] constructor form is supported
-        (documented deviation: no physical array columns)."""
-        if not isinstance(u.array, ast.ArrayLit):
-            raise PlanningError(
-                "UNNEST supports ARRAY[...] constructors only (arrays "
-                "are trace-time expression lists in this engine)"
-            )
-        if not u.array.items:
-            raise PlanningError("UNNEST of empty ARRAY[] is not supported")
+        lists; physical array COLUMNS take the column form (per-row
+        length expansion under the capacity-bucket protocol)."""
         if isinstance(node, _PendingJoin):
             node = self._finalize_pool(node, scope)
-        els = [self._lower(it, scope) for it in u.array.items]
-        ct = els[0].dtype
-        for el in els[1:]:
-            ct = T.common_super_type(ct, el.dtype)
-        els = [
-            el if el.dtype == ct else E.Cast(el, ct) for el in els
-        ]
+        array_column = None
+        els: List[E.Expr] = []
+        if isinstance(u.array, ast.ArrayLit):
+            if not u.array.items:
+                raise PlanningError(
+                    "UNNEST of empty ARRAY[] is not supported"
+                )
+            els = [self._lower(it, scope) for it in u.array.items]
+            ct = els[0].dtype
+            for el in els[1:]:
+                ct = T.common_super_type(ct, el.dtype)
+            els = [
+                el if el.dtype == ct else E.Cast(el, ct) for el in els
+            ]
+        else:
+            arr = self._lower(u.array, scope)
+            if not (
+                isinstance(arr, E.ColumnRef) and arr.dtype.is_array
+            ):
+                raise PlanningError(
+                    "UNNEST requires an ARRAY[...] constructor or a "
+                    "physical array column"
+                )
+            array_column = arr.name
+            ct = arr.dtype.element
         cols = dict(scope.columns)
         out_internal = (
             u.column if u.column not in cols else self._fresh(u.column)
@@ -410,12 +426,20 @@ class _Planner:
             )
             cols[ord_internal] = T.BIGINT
             qual[u.ordinality] = ord_internal
+        out_cap = None
+        if array_column is not None:
+            # output bucket: no array-length stats exist, so start at
+            # 4x the input estimate; overflow retries scale it
+            est = optimizer.estimate_rows(node, self.catalogs)
+            out_cap = bucket_capacity(int(est * 4) + 1024)
         node = N.UnnestNode(
             source=node,
             elements=tuple(els),
             out_name=out_internal,
             out_type=ct,
             ordinality_name=ord_internal,
+            array_column=array_column,
+            out_capacity=out_cap,
         )
         quals = {
             k: dict(v) for k, v in scope.qualifiers.items()
@@ -1317,6 +1341,11 @@ class _Planner:
                     "(documented deviation; cast to decimal(18,s) "
                     "or varchar to group)"
                 )
+            if e.dtype.is_array:
+                raise PlanningError(
+                    "GROUP BY an array column is not supported "
+                    "(unnest first)"
+                )
             if isinstance(e, E.ColumnRef):
                 group_keys.append((e.name, e))
             else:
@@ -1749,6 +1778,27 @@ class _Planner:
                                             (3VL OR gives Presto's
                                             true/NULL/false behavior)
         """
+        if e.args and not isinstance(e.args[0], ast.ArrayLit):
+            # physical array COLUMN (reference: ArrayType columns):
+            # cardinality/element_at lower to offsets-based kernels
+            arg0 = lower(e.args[0])
+            if arg0.dtype.is_array:
+                if e.name == "cardinality":
+                    if len(e.args) != 1:
+                        raise PlanningError(
+                            "cardinality() takes one argument"
+                        )
+                    return E.ArrayLength(arg0)
+                if e.name == "element_at":
+                    if len(e.args) != 2:
+                        raise PlanningError(
+                            "element_at() takes two arguments"
+                        )
+                    return E.ArraySubscript(arg0, lower(e.args[1]))
+                raise PlanningError(
+                    f"{e.name}() over physical array columns is not "
+                    "supported (cardinality/element_at/unnest are)"
+                )
         if not e.args or not isinstance(e.args[0], ast.ArrayLit):
             raise PlanningError(
                 f"{e.name}() requires an ARRAY[...] constructor argument"

@@ -400,4 +400,64 @@ OFFICIAL = {
         order by c_last_name, c_first_name, city_part, profit,
                  ss_ticket_number, amt
         limit 100""",
+    # Q62: web shipping latency buckets by warehouse/ship-mode/site
+    # (official parameterizes d_month_seq; this dialect has d_year)
+    "q62": f"""
+        select substring(w_warehouse_name, 1, 20) as wname, sm_type,
+               web_name,
+               sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30
+                        then 1 else 0 end) as d30,
+               sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+                         and ws_ship_date_sk - ws_sold_date_sk <= 60
+                        then 1 else 0 end) as d60,
+               sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+                        then 1 else 0 end) as dmore
+        from {S}.web_sales, {S}.warehouse, {S}.ship_mode,
+             {S}.web_site, {S}.date_dim
+        where ws_ship_date_sk = d_date_sk
+          and ws_warehouse_sk = w_warehouse_sk
+          and ws_ship_mode_sk = sm_ship_mode_sk
+          and ws_web_site_sk = web_site_sk
+          and d_year = 1999
+        group by substring(w_warehouse_name, 1, 20), sm_type, web_name
+        order by wname, sm_type, web_name
+        limit 100""",
+    # Q99: catalog shipping latency buckets by call center/ship mode
+    "q99": f"""
+        select substring(w_warehouse_name, 1, 20) as wname, sm_type,
+               cc_name,
+               sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30
+                        then 1 else 0 end) as d30,
+               sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+                         and cs_ship_date_sk - cs_sold_date_sk <= 60
+                        then 1 else 0 end) as d60,
+               sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+                         and cs_ship_date_sk - cs_sold_date_sk <= 90
+                        then 1 else 0 end) as d90,
+               sum(case when cs_ship_date_sk - cs_sold_date_sk > 90
+                        then 1 else 0 end) as dmore
+        from {S}.catalog_sales, {S}.warehouse, {S}.ship_mode,
+             {S}.call_center, {S}.date_dim
+        where cs_ship_date_sk = d_date_sk
+          and cs_warehouse_sk = w_warehouse_sk
+          and cs_ship_mode_sk = sm_ship_mode_sk
+          and cs_call_center_sk = cc_call_center_sk
+          and d_year = 1999
+        group by substring(w_warehouse_name, 1, 20), sm_type, cc_name
+        order by wname, sm_type, cc_name
+        limit 100""",
+    # Q82: items in an inventory quantity band that also sold in store
+    "q82": f"""
+        select i_item_id, i_item_desc, i_current_price
+        from {S}.item, {S}.inventory, {S}.date_dim, {S}.store_sales
+        where i_current_price between 30 and 60
+          and inv_item_sk = i_item_sk
+          and d_date_sk = inv_date_sk
+          and d_date between date '1998-03-01'
+                         and date '1998-03-01' + interval '60' day
+          and ss_item_sk = i_item_sk
+          and inv_quantity_on_hand between 100 and 500
+        group by i_item_id, i_item_desc, i_current_price
+        order by i_item_id
+        limit 100""",
 }

@@ -408,6 +408,17 @@ class CoordinatorServer:
         in the given root SortNode so workers emit sorted runs, and
         k-way merge the runs at the gather instead of re-sorting. The
         caller guarantees the stage has no aggregation cut."""
+        if (
+            order_by is None
+            and len(workers) > 1
+            and str(
+                self.local.session.get("join_distribution_type")
+            ).upper()
+            == "PARTITIONED"
+        ):
+            out = self._run_join_partitioned(fragment_root, workers, q)
+            if out is not None:
+                return out
         if stage is None:
             stage = plan_stage(fragment_root, self.local.catalogs)
         if stage is None:
@@ -434,7 +445,9 @@ class CoordinatorServer:
             and key_names
             and bool(self.local.session.get("distributed_final"))
         ):
-            bucket_root, rest_root, _ = S._split_final(stage.final_root)
+            bucket_root, rest_root, _, _ = S._split_final(
+                stage.final_root, stage.worker_fragment
+            )
             if bucket_root is not None:
                 return self._run_stage_shuffled(
                     stage, workers, q, key_names, bucket_root, rest_root
@@ -525,6 +538,164 @@ class CoordinatorServer:
         leaves = remote + local_scans
         pages = [page] + [self.local._load_table(s) for s in local_scans]
         return self.local._run_with_pages(stage.final_root, leaves, pages)
+
+    def _run_join_partitioned(self, fragment_root, workers, q: _Query):
+        """Hash-partitioned intermediate JOIN stage (reference:
+        FIXED_HASH_DISTRIBUTION intermediate stages — SURVEY.md §2.4
+        "Join distribution choice"): BOTH join inputs run as
+        partitioned producer stages that hash their output by the
+        equi-join keys into ``len(workers)`` buffers, and a join stage
+        (one task per partition) pulls matching partitions from every
+        producer of both sides — neither side is replicated. Valid for
+        every equi-join type: a key lands in the same partition on both
+        sides (value-stable hash), so per-partition joins partition the
+        full join.
+
+        Applies when the session forces
+        ``join_distribution_type=PARTITIONED`` and a join's two sides
+        each admit a cut-free source-partitioned stage; returns None
+        otherwise (caller falls through to the replicated-build path).
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        target = None
+        for J in N.walk(fragment_root):
+            if not isinstance(J, N.JoinNode):
+                continue
+            stages = []
+            for side in (J.left, J.right):
+                st = plan_stage(side, self.local.catalogs)
+                if st is None or not isinstance(
+                    st.final_root, N.RemoteSourceNode
+                ):
+                    stages = None
+                    break
+                stages.append(st)
+            if stages:
+                target = (J, stages)
+                break
+        if target is None:
+            return None
+        J, side_stages = target
+        REGISTRY.counter("coordinator.partitioned_join_stages").update()
+        nparts = len(workers)
+        over = max(1, int(self.local.session.get("split_queue_factor")))
+        created: List[tuple] = []
+        clock = threading.Lock()
+
+        def run_producers(stage, keys, group):
+            ranges = assign_ranges(
+                stage.partition_rows, max(len(workers) * over, 1)
+            )
+            ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
+
+            def make_spec(lo: int, hi: int) -> FragmentSpec:
+                return FragmentSpec(
+                    task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                    query_id=q.qid,
+                    fragment=stage.worker_fragment,
+                    partition_scan=stage.partition_scan,
+                    split_start=lo,
+                    split_end=hi,
+                    split_batch_rows=int(
+                        self.local.session.get("page_capacity")
+                    ),
+                    task_concurrency=int(
+                        self.local.session.get("task_concurrency")
+                    ),
+                    n_partitions=nparts,
+                    partition_keys=tuple(keys),
+                )
+
+            def wait_producer(w, spec):
+                with clock:
+                    created.append((w, spec.task_id))
+                self._wait_task(w, spec)
+                return (w.uri, spec.task_id, group)
+
+            # producer death fails the query: partitioned exchanges
+            # are non-recoverable (same semantics as the shuffled
+            # agg path; the replicated gather path keeps range retry)
+            return self._ranged_tasks(
+                workers, ranges, make_spec, wait_producer, retry=False
+            )
+
+        try:
+            # both producer stages are independent: run concurrently
+            # (sequential would cost sum, not max, of the side walls)
+            with ThreadPoolExecutor(2) as side_pool:
+                side_futs = [
+                    side_pool.submit(run_producers, stage, keys, group)
+                    for (stage, keys, group) in (
+                        (side_stages[0], J.left_keys, 0),
+                        (side_stages[1], J.right_keys, 1),
+                    )
+                ]
+                sources: List[tuple] = [
+                    s for f in side_futs for s in f.result()
+                ]
+
+            join_frag = dataclasses.replace(
+                J,
+                left=N.RemoteSourceNode(fragment_root=J.left),
+                right=N.RemoteSourceNode(fragment_root=J.right),
+            )
+
+            def run_join_task(i: int):
+                w = workers[i % len(workers)]
+                spec = FragmentSpec(
+                    task_id=f"{q.qid}.join.{uuid.uuid4().hex[:8]}",
+                    query_id=q.qid,
+                    fragment=join_frag,
+                    partition_scan=-1,
+                    split_start=0,
+                    split_end=0,
+                    sources=tuple(sources),
+                    partition=i,
+                )
+                with clock:
+                    created.append((w, spec.task_id))
+                self._http_json(
+                    "POST", w.uri + "/v1/task", spec.to_json()
+                )
+                return self._pull_task(w, spec)
+
+            with ThreadPoolExecutor(nparts) as pool:
+                futs = [
+                    pool.submit(run_join_task, i) for i in range(nparts)
+                ]
+                payloads = [p for f in futs for p in f.result()]
+        finally:
+            for w, tid in created:
+                try:
+                    self._http_json(
+                        "DELETE", f"{w.uri}/v1/task/{tid}", None
+                    )
+                except Exception:
+                    pass
+
+        schema = dict(join_frag.output_schema())
+        if payloads:
+            merged = pages_wire.merge_payloads(payloads, schema)
+        else:
+            merged = {
+                nm: np.empty(0, t.np_dtype) for nm, t in schema.items()
+            }
+        page = stage_page(merged, schema)
+        if J is fragment_root:
+            return page
+        remote = N.RemoteSourceNode(fragment_root=J)
+        from presto_tpu.server.scheduler import (
+            _path_to,
+            _replace_on_path,
+        )
+
+        path = _path_to(fragment_root, J)
+        rest_root = _replace_on_path(path[:-1], J, remote)
+        leaves, pages = self.local.leaf_pages(
+            rest_root, {id(remote): page}
+        )
+        return self.local._run_with_pages(rest_root, leaves, pages)
 
     def _run_stage_shuffled(
         self, stage, workers, q: _Query, key_names, bucket_root, rest_root

@@ -50,9 +50,49 @@ def serialize_page(
     Numeric data must already be in native representation (scaled ints
     for decimals, epoch days for dates, int32 ids for dictionary cols).
     """
+    from presto_tpu.exec.staging import ArrayColumn
+
     header: Dict = {"nrows": nrows, "columns": []}
     buffers: List[bytes] = []
     for name, data, valid, dtype, dict_values in columns:
+        if isinstance(data, ArrayColumn):
+            # array column: offsets buffer + flat values buffer
+            off = np.ascontiguousarray(
+                np.asarray(data.offsets, np.int32)
+            )
+            vals = np.ascontiguousarray(
+                np.asarray(data.values)[: int(off[-1]) if len(off) else 0]
+            )
+            oraw, vraw_ = off.tobytes(), vals.tobytes()
+            ocomp, ocrc = _compress(oraw)
+            vcomp_, vcrc_ = _compress(vraw_)
+            col = {
+                "name": name,
+                "type": _encode_type(dtype),
+                "array": True,
+                "off_comp_size": len(ocomp),
+                "off_raw_size": len(oraw),
+                "off_crc32": ocrc,
+                "np_dtype": vals.dtype.str,
+                "comp_size": len(vcomp_),
+                "raw_size": len(vraw_),
+                "crc32": vcrc_,
+            }
+            buffers.append(ocomp)
+            buffers.append(vcomp_)
+            if valid is not None:
+                vraw = np.packbits(
+                    np.asarray(valid, dtype=bool)
+                ).tobytes()
+                vc, vcr = _compress(vraw)
+                col["valid_comp_size"] = len(vc)
+                col["valid_raw_size"] = len(vraw)
+                col["valid_crc32"] = vcr
+                buffers.append(vc)
+            if dict_values is not None:
+                col["dictionary"] = list(dict_values)
+            header["columns"].append(col)
+            continue
         data = np.ascontiguousarray(data)
         raw = data.tobytes()
         comp, crc = _compress(raw)
@@ -93,6 +133,52 @@ def deserialize_page(buf: bytes):
     schema: Dict[str, T.DataType] = {}
     nrows = header["nrows"]
     for col in header["columns"]:
+        if col.get("array"):
+            from presto_tpu.exec.staging import ArrayColumn
+
+            ocomp = buf[off : off + col["off_comp_size"]]
+            off += col["off_comp_size"]
+            oraw = zlib.decompress(ocomp)
+            if zlib.crc32(oraw) != col["off_crc32"]:
+                raise ValueError(
+                    f"offsets checksum mismatch on {col['name']}"
+                )
+            offsets = np.frombuffer(oraw, np.int32).copy()
+            vcomp2 = buf[off : off + col["comp_size"]]
+            off += col["comp_size"]
+            vraw2 = zlib.decompress(vcomp2)
+            if zlib.crc32(vraw2) != col["crc32"]:
+                raise ValueError(
+                    f"values checksum mismatch on {col['name']}"
+                )
+            values = np.frombuffer(
+                vraw2, np.dtype(col["np_dtype"])
+            ).copy()
+            valid = None
+            if "valid_comp_size" in col:
+                vc = buf[off : off + col["valid_comp_size"]]
+                off += col["valid_comp_size"]
+                vr = zlib.decompress(vc)
+                if zlib.crc32(vr) != col["valid_crc32"]:
+                    raise ValueError(
+                        f"validity checksum mismatch on {col['name']}"
+                    )
+                valid = np.unpackbits(
+                    np.frombuffer(vr, np.uint8), count=nrows
+                ).astype(bool)
+            dtype = _decode_type(col["type"])
+            schema[col["name"]] = dtype
+            payload[col["name"]] = ArrayColumn(
+                offsets=offsets,
+                values=values,
+                valid=valid,
+                dict_values=(
+                    tuple(col["dictionary"])
+                    if "dictionary" in col
+                    else None
+                ),
+            )
+            continue
         comp = buf[off : off + col["comp_size"]]
         off += col["comp_size"]
         raw = zlib.decompress(comp)
@@ -142,8 +228,15 @@ def merge_payloads(
     preserving, see connectors.tpch.DictColumn), so the union dictionary
     is the sorted union of values and remapping is a searchsorted.
     """
+    from presto_tpu.exec.staging import ArrayColumn
+
     out: Dict[str, object] = {}
     for name in schema:
+        if schema[name].is_array:
+            out[name] = _merge_array_parts(
+                [p[name] for p, _s, _n in payloads]
+            )
+            continue
         parts = []  # (data, valid|None, dict_values|None) per payload
         for payload, _schema, nrows in payloads:
             col = payload[name]
@@ -204,21 +297,92 @@ def merge_payloads(
     return out
 
 
+def _merge_array_parts(parts: List) -> "object":
+    """Concatenate ArrayColumn payload chunks: values concat + offsets
+    rebase. String-element dictionaries must agree across chunks
+    (cross-dictionary array remap is a guarded gap)."""
+    from presto_tpu.exec.staging import ArrayColumn
+
+    dicts = {p.dict_values for p in parts if p.dict_values is not None}
+    if len(dicts) > 1:
+        raise NotImplementedError(
+            "merging array columns with differing element "
+            "dictionaries is not supported"
+        )
+    offsets = [np.zeros(1, np.int32)]
+    values = []
+    valids = []
+    base = 0
+    any_valid = any(p.valid is not None for p in parts)
+    for p in parts:
+        off = np.asarray(p.offsets, np.int32)
+        n = max(len(off) - 1, 0)
+        offsets.append(off[1:] + base)
+        base += int(off[-1]) if len(off) else 0
+        values.append(np.asarray(p.values)[: int(off[-1]) if len(off) else 0])
+        if any_valid:
+            valids.append(
+                np.asarray(p.valid, bool)
+                if p.valid is not None
+                else np.ones(n, bool)
+            )
+    return ArrayColumn(
+        offsets=np.concatenate(offsets),
+        values=(
+            np.concatenate(values) if values else np.zeros(0)
+        ),
+        valid=np.concatenate(valids) if any_valid else None,
+        dict_values=next(iter(dicts)) if dicts else None,
+    )
+
+
 def page_to_wire_columns(page, fetched_n: Optional[int] = None):
     """Device Page -> serialize_page input, with ONE batched device->host
     fetch (two-phase; see exec.host_ops for the relay rationale)."""
     import jax
 
+    from presto_tpu.exec.staging import ArrayColumn
+
     n = fetched_n if fetched_n is not None else int(page.num_valid)
     leaves = []
     for blk in page.blocks:
-        leaves.append(blk.data[:n])
+        if blk.offsets is not None:
+            # array block: offsets prefix + FULL flat values (live
+            # extent is data-dependent; serialize trims to offsets[-1])
+            leaves.append(blk.offsets[: n + 1])
+            leaves.append(blk.data)
+        else:
+            leaves.append(blk.data[:n])
         if blk.valid is not None:
             leaves.append(blk.valid[:n])
     fetched = jax.device_get(leaves)
     cols = []
     i = 0
     for name, blk in zip(page.names, page.blocks):
+        if blk.offsets is not None:
+            offsets = np.asarray(fetched[i])
+            i += 1
+            values = np.asarray(fetched[i])
+            i += 1
+            valid = None
+            if blk.valid is not None:
+                valid = fetched[i]
+                i += 1
+            cols.append(
+                (
+                    name,
+                    ArrayColumn(offsets=offsets, values=values,
+                                valid=valid),
+                    valid,
+                    blk.dtype,
+                    (
+                        tuple(blk.dictionary.values)
+                        if blk.dictionary is not None
+                        else None
+                    ),
+                )
+            )
+            continue
         data = fetched[i]
         i += 1
         valid = None

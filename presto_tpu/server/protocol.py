@@ -57,6 +57,8 @@ def encode(obj: Any) -> Any:
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
     if isinstance(obj, T.DataType):
+        if obj.is_array:
+            return {"@": "array", "element": encode(obj.element)}
         if obj.is_decimal:
             return {"@": "decimal", "p": obj.precision, "s": obj.scale}
         if isinstance(obj, T.VarcharType) and obj.length is not None:
@@ -89,6 +91,8 @@ def decode(data: Any) -> Any:
     if isinstance(data, list):
         return tuple(decode(x) for x in data)
     tag = data.get("@")
+    if tag == "array":
+        return T.array(decode(data["element"]))
     if tag == "decimal":
         return T.decimal(data["p"], data["s"])
     if tag == "varchar":

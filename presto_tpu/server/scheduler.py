@@ -148,9 +148,15 @@ def _try_cut(
     else:
         cut = path[cut_i]
         if isinstance(cut, N.AggregationNode):
-            partial_aggs, fkeys, faggs, post = split_aggregation(
-                cut.group_keys, cut.aggs
-            )
+            try:
+                partial_aggs, fkeys, faggs, post = split_aggregation(
+                    cut.group_keys, cut.aggs
+                )
+            except NotImplementedError:
+                # un-decomposable aggregate (e.g. array_agg): no
+                # distributed cut; the caller falls back to local
+                # execution
+                return None
             worker_root = dataclasses.replace(cut, aggs=partial_aggs)
             remote = N.RemoteSourceNode(fragment_root=worker_root)
             final_sub: N.PlanNode = N.AggregationNode(

@@ -391,8 +391,12 @@ class WorkerServer:
         # addExchangeLocations until noMoreLocations): pull every known
         # source's partition — pulls OVERLAP production, since the
         # token loop polls until the producer reports complete — and
-        # wait for more until the coordinator marks the set done
-        payloads = []
+        # wait for more until the coordinator marks the set done.
+        # A source is (uri, task_id[, group]): group tags map each
+        # producer stage to one RemoteSourceNode leaf (a partitioned
+        # JOIN stage has two producer stages — group 0 probe, group 1
+        # build); untagged sources are group 0.
+        by_group: Dict[int, list] = {}
         pulled = set()
         deadline = time.time() + float(
             self.runner.session.get("query_max_run_time_s")
@@ -413,23 +417,71 @@ class WorkerServer:
                         )
                     task.cond.wait(timeout=0.1)
                     continue
-            for uri, src_task in pending:
-                payloads.extend(
+            for src in pending:
+                uri, src_task = src[0], src[1]
+                group = int(src[2]) if len(src) > 2 else 0
+                by_group.setdefault(group, []).extend(
                     _pull_partition(
                         uri, src_task, spec.partition,
                         self.runner.session,
                     )
                 )
-                pulled.add((uri, src_task))
+                pulled.add(tuple(src))
         root = spec.fragment
         remotes = [
             n for n in N.walk(root) if isinstance(n, N.RemoteSourceNode)
         ]
+        if len(remotes) > 1:
+            # multi-source fragment (partitioned join stage): group i
+            # feeds the i-th RemoteSourceNode in walk order; each
+            # group's payloads merge + stage separately, then the
+            # fragment runs once over all leaves
+            import numpy as np
+
+            pages = []
+            for i, r in enumerate(remotes):
+                rschema = dict(r.fragment_root.output_schema())
+                if by_group.get(i):
+                    merged = pages_wire.merge_payloads(
+                        by_group[i], rschema
+                    )
+                else:  # no rows from this side in this partition
+                    merged = {
+                        nm: np.empty(0, t.np_dtype)
+                        for nm, t in rschema.items()
+                    }
+                pages.append(stage_page(merged, rschema))
+            # same accounting as the single-remote path: a too-big
+            # (skewed) join partition fails on MemoryPool accounting
+            # (kill-largest policy visible), not device OOM
+            staged = sum(
+                int(b.data.nbytes)
+                for pg in pages
+                for b in pg.blocks
+            )
+            self.memory_pool.reserve(spec.query_id, staged)
+            try:
+                out = self.runner._run_with_pages(root, remotes, pages)
+            finally:
+                self.memory_pool.release(spec.query_id, staged)
+            cols, n = pages_wire.page_to_wire_columns(out)
+            for lo in range(0, max(n, 1), PAGE_ROWS):
+                hi = min(lo + PAGE_ROWS, n)
+                chunk = [
+                    (nm, d[lo:hi], None if v is None else v[lo:hi], t,
+                     dv)
+                    for nm, d, v, t, dv in cols
+                ]
+                task.offer_page(
+                    pages_wire.serialize_page(chunk, hi - lo)
+                )
+            return
         if len(remotes) != 1:
             raise RuntimeError(
                 f"merge fragment must have one RemoteSource leaf, "
                 f"got {len(remotes)}"
             )
+        payloads = by_group.get(0, [])
         schema = dict(remotes[0].fragment_root.output_schema())
         # same grouped-execution discipline as the coordinator gather:
         # a partition beyond max_device_rows sub-buckets and merges one

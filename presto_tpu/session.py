@@ -157,11 +157,23 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "Largest plan weight compiled as ONE XLA program; heavier "
             "plans execute stage-at-a-time with device-resident "
             "intermediates (reference: tasks run fragments, never whole "
-            "plans — SURVEY.md §3.3; bounds compile size on Q64-class "
-            "many-join plans). 0 compiles whole plans",
+            "plans — SURVEY.md §3.3). 16 keeps single-heavy-op plans "
+            "(Q1-class) whole while every multi-join plan fragments — "
+            "measured: Q3@SF1's ~25-weight whole-plan program exceeded "
+            "20 min in the tunnel's remote_compile while its fragments "
+            "compile in seconds. 0 compiles whole plans",
             int,
-            28,
+            16,
             _non_negative("max_fragment_weight"),
+        ),
+        PropertyMetadata(
+            "enable_dynamic_filtering",
+            "Stage-at-a-time joins fetch the executed build side's "
+            "join-key min/max and pre-filter the probe side with the "
+            "range (reference: dynamic filters flowing build->probe "
+            "at runtime)",
+            bool,
+            True,
         ),
         PropertyMetadata(
             "query_max_run_time_s",

@@ -69,6 +69,10 @@ class DataType:
     def is_long_decimal(self) -> bool:
         return False
 
+    @property
+    def is_array(self) -> bool:
+        return False
+
     def __str__(self) -> str:
         return self.name
 
@@ -340,6 +344,35 @@ _BY_NAME = {
 }
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    """array(T) — physical array columns (reference: ArrayType).
+
+    Device representation (SURVEY.md §2.1 "Block/Page data model"): an
+    offsets int32 array (capacity+1) over a flat child values array
+    (``Block.offsets``/``Block.data``); per-row validity as usual.
+    """
+
+    element: DataType = None  # type: ignore[assignment]
+    name: str = "array"
+
+    @property
+    def jnp_dtype(self):
+        # the VALUES child array's dtype (offsets are always int32)
+        return self.element.jnp_dtype
+
+    @property
+    def is_array(self) -> bool:
+        return True
+
+    def __str__(self) -> str:
+        return f"array({self.element})"
+
+
+def array(element: DataType) -> ArrayType:
+    return ArrayType(element=element)
+
+
 def parse_type(text: str) -> DataType:
     """Parse a SQL type string, e.g. ``decimal(12,2)`` or ``varchar(25)``."""
     t = text.strip().lower()
@@ -354,6 +387,8 @@ def parse_type(text: str) -> DataType:
     if (t.startswith("varchar(") or t.startswith("char(")) and t.endswith(")"):
         inner = t[t.index("(") + 1 : -1]
         return varchar(int(inner))
+    if t.startswith("array(") and t.endswith(")"):
+        return array(parse_type(t[len("array(") : -1]))
     raise ValueError(f"unknown type: {text}")
 
 

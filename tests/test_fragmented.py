@@ -65,3 +65,30 @@ def test_weight_counts_heavy_nodes():
     )
     w = _plan_weight(plan.root)
     assert w > 28, w  # Q5 (6-table join) must exceed the default budget
+
+
+def test_dynamic_filtering_applies(runner, oracle):
+    """Build-first fragment execution feeds runtime build-key ranges
+    into probe-side filters (reference: dynamic filtering, SURVEY.md
+    §3.2) — filters fire AND the result stays oracle-exact."""
+    diff = verify_query(runner, oracle, QUERIES[10], rel_tol=1e-6)
+    assert diff is None, diff
+    qs = runner.history.snapshot()[-1]
+    assert qs.dynamic_filters > 0, qs
+
+
+def test_dynamic_filtering_can_disable(oracle):
+    from presto_tpu.session import Session
+
+    r = LocalQueryRunner(
+        session=Session(
+            properties={
+                "max_fragment_weight": 8,
+                "enable_dynamic_filtering": "false",
+            }
+        )
+    )
+    diff = verify_query(r, oracle, QUERIES[10], rel_tol=1e-6)
+    assert diff is None, diff
+    qs = r.history.snapshot()[-1]
+    assert qs.dynamic_filters == 0, qs
