@@ -672,6 +672,11 @@ class _TpchMetadata(ConnectorMetadata):
                     min_value=1,
                     max_value=key_max(ref_table),
                 )
+            elif name == "l_linenumber":
+                # closed form: 1..7 lines per order
+                cols[name] = ColumnStats(
+                    distinct_count=7, min_value=1, max_value=7
+                )
         return TableStats(row_count=float(n), columns=cols, primary_key=pk)
 
 

@@ -114,11 +114,15 @@ def stage_page(
                 vpad = np.zeros(cap, bool)
                 vpad[: len(v.valid)] = v.valid
                 valid = jnp.asarray(vpad)
+            vals = np.asarray(v.values, t.element.np_dtype)
+            # bucket the VALUE axis too: exact element counts would
+            # make every distinct total a fresh XLA input shape
+            vcap = bucket_capacity(len(vals))
+            vpadded = np.zeros(vcap, t.element.np_dtype)
+            vpadded[: len(vals)] = vals
             blocks.append(
                 Block(
-                    data=jnp.asarray(
-                        np.asarray(v.values, t.element.np_dtype)
-                    ),
+                    data=jnp.asarray(vpadded),
                     valid=valid,
                     dtype=t,
                     dictionary=(

@@ -494,7 +494,18 @@ def _sorted_one_agg(
         total = cum[-1] if cap else jnp.int32(0)
         pos = jnp.where(valid_s, cum - 1, cap)  # cap = dump slot
         out_vals = jnp.zeros((cap + 1,), d_s.dtype).at[pos].set(d_s)
-        start_off = cum[starts] - valid_s[starts].astype(jnp.int32)
+        # padding group slots must read offset == total; the CLAMPED
+        # starts (cap-1) would read total-1 on a completely full page
+        # and silently drop the last group's last element, so detect
+        # padding from the UNCLAMPED boundary positions
+        (raw_starts,) = jnp.nonzero(
+            bnd, size=starts.shape[0], fill_value=cap
+        )
+        start_off = jnp.where(
+            raw_starts >= cap,
+            total,
+            cum[starts] - valid_s[starts].astype(jnp.int32),
+        )
         offsets = jnp.concatenate(
             [
                 jnp.minimum(start_off, total).astype(jnp.int32),

@@ -150,7 +150,10 @@ def window(
     for call in calls:
         rt = call.result_type()
         if call.func == "row_number":
-            data = pos - part_start[safe_pid] + 1
+            # int32 lanes: ranks are bounded by the page capacity, so
+            # the BIGINT-typed block carries int32 data — half the HBM
+            # and half the result-transfer bytes on rank-heavy outputs
+            data = (pos - part_start[safe_pid] + 1).astype(jnp.int32)
             blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
         elif call.func == "ntile":
             # SQL ntile: sizes differ by at most 1 and the FIRST
@@ -175,7 +178,9 @@ def window(
                 )
             )
         elif call.func == "rank":
-            data = peer_start[safe_peer] - part_start[safe_pid] + 1
+            data = (
+                peer_start[safe_peer] - part_start[safe_pid] + 1
+            ).astype(jnp.int32)
             blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
         elif call.func == "dense_rank":
             first_peer_of_part = jax.ops.segment_min(
@@ -183,7 +188,7 @@ def window(
             )
             data = peer_gid - first_peer_of_part[safe_pid] + 1
             blocks.append(
-                Block(data=data.astype(jnp.int64), valid=None, dtype=T.BIGINT)
+                Block(data=data.astype(jnp.int32), valid=None, dtype=T.BIGINT)
             )
         elif call.func in ("sum", "count", "avg", "min", "max"):
             blocks.append(

@@ -196,6 +196,18 @@ class Block:
                     "deviation; NULL rows are)"
                 )
             child = cls.from_pylist(flat, dtype.element)
+            from presto_tpu.exec.staging import bucket_capacity
+
+            vcap = bucket_capacity(len(flat))
+            if child.data.shape[0] < vcap:
+                # bucket the VALUE axis (same discipline as rows):
+                # exact element counts would churn XLA input shapes
+                child = dataclasses.replace(
+                    child,
+                    data=jnp.pad(
+                        child.data, [(0, vcap - child.data.shape[0])]
+                    ),
+                )
             isnull = np.array([v is None for v in values], bool)
             return cls(
                 data=child.data,

@@ -778,30 +778,46 @@ class _Planner:
             pairs = cand[nxt]
             build = rels[nxt]
             extra_pairs: List[Tuple[str, str]] = []
+            forced_unique = None
             if len(pairs) > 2:
-                # the join kernel packs at most 2 key columns into its
-                # int64 composite (ops.join.pack_keys); keep the subset
-                # that proves build uniqueness when one exists and apply
-                # the remaining equalities as a post-join residual
-                import itertools
+                # widen past the kernel's 2x32-bit composite: when
+                # connector stats bound every key column's range, the
+                # whole composite packs BIJECTIVELY into one bigint
+                # via stats-allocated bit widths (no residual, no
+                # out_capacity blow-out on skew)
+                packed = self._pack_composite_keys(tree, build, pairs)
+                if packed is not None:
+                    tree, build, pairs, forced_unique = packed
+                else:
+                    # fallback: keep the subset that proves build
+                    # uniqueness and demote the rest to a residual
+                    import itertools
 
-                best = None
-                for combo in itertools.combinations(range(len(pairs)), 2):
-                    keys = tuple(pairs[k][1] for k in combo)
-                    if optimizer.is_build_unique(
-                        build, keys, self.catalogs
+                    best = None
+                    for combo in itertools.combinations(
+                        range(len(pairs)), 2
                     ):
-                        best = combo
-                        break
-                if best is None:
-                    best = (0, 1)
-                extra_pairs = [
-                    p for k, p in enumerate(pairs) if k not in best
-                ]
-                pairs = [pairs[k] for k in best]
+                        keys = tuple(pairs[k][1] for k in combo)
+                        if optimizer.is_build_unique(
+                            build, keys, self.catalogs
+                        ):
+                            best = combo
+                            break
+                    if best is None:
+                        best = (0, 1)
+                    extra_pairs = [
+                        p for k, p in enumerate(pairs) if k not in best
+                    ]
+                    pairs = [pairs[k] for k in best]
             lkeys = tuple(p[0] for p in pairs)
             rkeys = tuple(p[1] for p in pairs)
-            unique = optimizer.is_build_unique(build, rkeys, self.catalogs)
+            unique = (
+                forced_unique
+                if forced_unique is not None
+                else optimizer.is_build_unique(
+                    build, rkeys, self.catalogs
+                )
+            )
             payload = tuple(
                 c for c in build.output_schema() if c not in rkeys
             ) + tuple(c for c in rkeys if c not in tree.output_schema())
@@ -910,6 +926,94 @@ class _Planner:
         if kind == "scalar_cmp":
             return self._apply_correlated_scalar(node, scope, a)
         raise AssertionError(kind)
+
+    def _pack_composite_keys(self, tree, build, pairs):
+        """>2-column equi-join keys -> ONE synthetic bigint key on each
+        side, packed bijectively with stats-allocated bit widths
+        (reference: multi-channel GroupByHash/JoinProbe composite keys;
+        TPU-first: the sorted-probe kernel stays single-int64).
+
+        Requires every pair to be integer/date-typed with known
+        min/max on BOTH sides and a total packed width <= 62 bits;
+        returns (tree', build', [(lkey, rkey)], build_unique) with
+        projections appended, or None when stats can't prove the pack
+        is bijective (caller falls back to residual demotion)."""
+        tree_schema = dict(tree.output_schema())
+        build_schema = dict(build.output_schema())
+        ranges = []
+        for ci, cj in pairs:
+            lt, rt = tree_schema[ci], build_schema[cj]
+            if not (
+                (lt.is_integer or lt.name == "date")
+                and (rt.is_integer or rt.name == "date")
+            ):
+                return None
+            cs_l = optimizer._column_stats(tree, ci, self.catalogs)
+            cs_r = optimizer._column_stats(build, cj, self.catalogs)
+            if (
+                cs_l is None
+                or cs_r is None
+                or cs_l.min_value is None
+                or cs_l.max_value is None
+                or cs_r.min_value is None
+                or cs_r.max_value is None
+            ):
+                return None
+            lo = min(int(cs_l.min_value), int(cs_r.min_value))
+            hi = max(int(cs_l.max_value), int(cs_r.max_value))
+            ranges.append((lo, hi))
+        widths = [max(hi - lo + 1, 1).bit_length() for lo, hi in ranges]
+        if sum(widths) > 62:
+            return None
+        # build an equal value on equal composites: equal shifts/los on
+        # both sides; NULL components null the whole key (never match)
+        shifts = []
+        s = 0
+        for w in reversed(widths):
+            shifts.append(s)
+            s += w
+        shifts = list(reversed(shifts))
+
+        def packed_expr(schema, cols):
+            total = None
+            for (col, (lo, _hi), shift) in zip(cols, ranges, shifts):
+                ref = E.Cast(
+                    E.ColumnRef(col, schema[col]), T.BIGINT
+                )
+                term = E.Arithmetic(
+                    "*",
+                    E.Arithmetic(
+                        "-", ref, E.Literal(lo, T.BIGINT), T.BIGINT
+                    ),
+                    E.Literal(1 << shift, T.BIGINT),
+                    T.BIGINT,
+                )
+                total = (
+                    term
+                    if total is None
+                    else E.Arithmetic("+", total, term, T.BIGINT)
+                )
+            return total
+
+        unique = optimizer.is_build_unique(
+            build, tuple(cj for _, cj in pairs), self.catalogs
+        )
+        lname, rname = self._fresh("packl"), self._fresh("packr")
+        tree2 = N.ProjectNode(
+            source=tree,
+            projections=tuple(
+                (n, E.ColumnRef(n, t)) for n, t in tree_schema.items()
+            )
+            + ((lname, packed_expr(tree_schema, [ci for ci, _ in pairs])),),
+        )
+        build2 = N.ProjectNode(
+            source=build,
+            projections=tuple(
+                (n, E.ColumnRef(n, t)) for n, t in build_schema.items()
+            )
+            + ((rname, packed_expr(build_schema, [cj for _, cj in pairs])),),
+        )
+        return tree2, build2, [(lname, rname)], unique
 
     def _probe_key(self, node, scope, arg_ast):
         """Column name for a probe-side join key (project if not a bare

@@ -402,12 +402,17 @@ def payload_to_wire_columns(payload, schema, nrows: int):
     producers bucket host-side payloads and re-serialize each
     partition's slice without another device round trip."""
     from presto_tpu.connectors.tpch import DictColumn
-    from presto_tpu.exec.staging import MaskedColumn
+    from presto_tpu.exec.staging import ArrayColumn, MaskedColumn
 
     cols = []
     for name, t in schema.items():
         col = payload[name]
-        if isinstance(col, MaskedColumn):
+        if isinstance(col, ArrayColumn):
+            sliced = col[0:nrows]  # offsets rebase + values trim
+            cols.append(
+                (name, sliced, sliced.valid, t, sliced.dict_values)
+            )
+        elif isinstance(col, MaskedColumn):
             values = (
                 tuple(col.values) if col.values is not None else None
             )

@@ -43,6 +43,19 @@ PAGE_ROWS = 1 << 16
 MAX_BUFFERED_PAGES = 64
 
 
+def _offer_chunked(task: "_Task", cols, n: int) -> None:
+    """Serialize wire columns into PAGE_ROWS-sized pages on the task's
+    output buffer — the ONE chunk-and-offer loop every result-emitting
+    path shares (streaming emit, single- and multi-remote merges)."""
+    for lo in range(0, max(n, 1), PAGE_ROWS):
+        hi = min(lo + PAGE_ROWS, n)
+        chunk = [
+            (name, d[lo:hi], None if v is None else v[lo:hi], t, dv)
+            for name, d, v, t, dv in cols
+        ]
+        task.offer_page(pages_wire.serialize_page(chunk, hi - lo))
+
+
 class _Task:
     def __init__(self, spec: FragmentSpec, pool=None):
         self.spec = spec
@@ -341,21 +354,7 @@ class WorkerServer:
             if spec.n_partitions > 1:
                 return _emit_partitioned(task, out)
             cols, n = pages_wire.page_to_wire_columns(out)
-            for lo in range(0, max(n, 1), PAGE_ROWS):
-                hi = min(lo + PAGE_ROWS, n)
-                chunk = [
-                    (
-                        name,
-                        data[lo:hi],
-                        None if v is None else v[lo:hi],
-                        t,
-                        dv,
-                    )
-                    for name, data, v, t, dv in cols
-                ]
-                task.offer_page(
-                    pages_wire.serialize_page(chunk, hi - lo)
-                )
+            _offer_chunked(task, cols, n)
 
         if spec.task_concurrency <= 1 or len(ranges) <= 1:
             for lo, hi in ranges:
@@ -465,16 +464,7 @@ class WorkerServer:
             finally:
                 self.memory_pool.release(spec.query_id, staged)
             cols, n = pages_wire.page_to_wire_columns(out)
-            for lo in range(0, max(n, 1), PAGE_ROWS):
-                hi = min(lo + PAGE_ROWS, n)
-                chunk = [
-                    (nm, d[lo:hi], None if v is None else v[lo:hi], t,
-                     dv)
-                    for nm, d, v, t, dv in cols
-                ]
-                task.offer_page(
-                    pages_wire.serialize_page(chunk, hi - lo)
-                )
+            _offer_chunked(task, cols, n)
             return
         if len(remotes) != 1:
             raise RuntimeError(
@@ -507,13 +497,7 @@ class WorkerServer:
             finally:
                 self.memory_pool.release(spec.query_id, staged)
         cols, n = pages_wire.page_to_wire_columns(out)
-        for lo in range(0, max(n, 1), PAGE_ROWS):
-            hi = min(lo + PAGE_ROWS, n)
-            chunk = [
-                (name, d[lo:hi], None if v is None else v[lo:hi], t, dv)
-                for name, d, v, t, dv in cols
-            ]
-            task.offer_page(pages_wire.serialize_page(chunk, hi - lo))
+        _offer_chunked(task, cols, n)
 
     # ------------------------------------------------------------- status
 

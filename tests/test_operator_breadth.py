@@ -247,3 +247,36 @@ def test_implicit_cross_join_with_filter(runner, oracle):
     )
     diff = verify_query(runner, oracle, q)
     assert diff is None, diff
+
+
+# ------------------------------------------- composite-key packed joins
+
+
+def test_multi_key_join_packs_bijectively(runner, oracle):
+    """A 4-column equi-join packs into ONE synthetic bigint key when
+    stats bound every column's range (no residual demotion, no
+    out_capacity skew risk) — and stays oracle-exact."""
+    from presto_tpu.plan import nodes as PN
+    from presto_tpu.plan.planner import plan_statement
+    from presto_tpu.sql import parse_statement
+
+    q = (
+        "select count(*) as c from tpch.tiny.lineitem a, "
+        "tpch.tiny.lineitem b "
+        "where a.l_orderkey = b.l_orderkey "
+        "and a.l_partkey = b.l_partkey "
+        "and a.l_suppkey = b.l_suppkey "
+        "and a.l_linenumber = b.l_linenumber"
+    )
+    plan = plan_statement(
+        parse_statement(q), runner.catalogs, runner.session
+    )
+    joins = [
+        n for n in PN.walk(plan.root) if isinstance(n, PN.JoinNode)
+    ]
+    assert len(joins) == 1
+    assert len(joins[0].left_keys) == 1  # packed, not demoted
+    assert joins[0].left_keys[0].startswith("$pack")
+    assert joins[0].residual is None
+    diff = verify_query(runner, oracle, q)
+    assert diff is None, diff
