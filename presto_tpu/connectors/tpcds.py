@@ -394,6 +394,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cs_sales_price": D7_2,
         "cs_coupon_amt": D7_2,
         "cs_ext_list_price": D7_2,
+        "cs_ext_sales_price": D7_2,
     },
     "catalog_returns": {
         "cr_returned_date_sk": T.INTEGER,
@@ -413,6 +414,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ws_ship_mode_sk": T.INTEGER,
         "ws_order_number": T.INTEGER,
         "ws_ext_ship_cost": D7_2,
+        "ws_ext_sales_price": D7_2,
         "ws_net_profit": D7_2,
     },
     "web_returns": {
@@ -975,6 +977,8 @@ class TpcdsGenerator:
                 out[c] = _sparse_amount(1910, 1911, rows)
             elif c == "cs_ext_list_price":
                 out[c] = _uniform(1905, rows, 10000, 100000)
+            elif c == "cs_ext_sales_price":
+                out[c] = _uniform(1916, rows, 100, 30000)
         return out
 
     def _gen_catalog_returns(self, rows, columns):
@@ -1034,6 +1038,8 @@ class TpcdsGenerator:
                 out[c] = _uniform(2106, rows, 1, 3)
             elif c == "ws_ship_mode_sk":
                 out[c] = _uniform(2109, rows, 1, cn["ship_mode"])
+            elif c == "ws_ext_sales_price":
+                out[c] = _uniform(2110, rows, 100, 30000)
             elif c == "ws_order_number":
                 out[c] = f["order"]
             elif c == "ws_ext_ship_cost":

@@ -436,6 +436,15 @@ class LocalQueryRunner:
                 changes[f.name] = self._reduce_fragment(
                     v, budget, pages_map
                 )
+            elif (
+                isinstance(v, tuple)
+                and v
+                and isinstance(v[0], N.PlanNode)
+            ):
+                changes[f.name] = tuple(
+                    self._reduce_fragment(x, budget, pages_map)
+                    for x in v
+                )
         if changes:
             node = dataclasses.replace(node, **changes)
         while _plan_weight(node) > budget:
@@ -461,11 +470,17 @@ class LocalQueryRunner:
             else:
                 child = max(cands, key=_plan_weight)
             leaf = self._execute_to_leaf(child, pages_map)
-            swaps = {
-                f.name: leaf
-                for f in dataclasses.fields(node)
-                if getattr(node, f.name) is child
-            }
+            swaps = {}
+            for f in dataclasses.fields(node):
+                v = getattr(node, f.name)
+                if v is child:
+                    swaps[f.name] = leaf
+                elif isinstance(v, tuple) and any(
+                    x is child for x in v
+                ):
+                    swaps[f.name] = tuple(
+                        leaf if x is child else x for x in v
+                    )
             node = dataclasses.replace(node, **swaps)
             node = self._apply_dynamic_filter(node, leaf, pages_map)
         return node
@@ -1002,6 +1017,10 @@ def _execute_node_inner(
             node.out_type,
             node.ordinality_name,
         )
+    if isinstance(node, N.UnionAllNode):
+        from presto_tpu.ops import union_all
+
+        return union_all([run(s) for s in node.sources])
     if isinstance(node, N.OutputNode):
         src = run(node.source)
         blocks = []
@@ -1086,6 +1105,10 @@ def _substitute_params_node(node: N.PlanNode, bindings) -> N.PlanNode:
         v = getattr(node, f.name)
         if isinstance(v, N.PlanNode):
             changes[f.name] = _substitute_params_node(v, bindings)
+        elif isinstance(v, tuple) and v and isinstance(v[0], N.PlanNode):
+            changes[f.name] = tuple(
+                _substitute_params_node(x, bindings) for x in v
+            )
         elif isinstance(v, E.Expr):
             changes[f.name] = _substitute_params_expr(v, bindings)
         elif isinstance(v, tuple) and v and isinstance(v[0], tuple):
@@ -1138,6 +1161,10 @@ def _scale_capacities(node: N.PlanNode, factor: int) -> N.PlanNode:
         v = getattr(node, f.name)
         if isinstance(v, N.PlanNode):
             changes[f.name] = _scale_capacities(v, factor)
+        elif isinstance(v, tuple) and v and isinstance(v[0], N.PlanNode):
+            changes[f.name] = tuple(
+                _scale_capacities(x, factor) for x in v
+            )
     if isinstance(node, (N.AggregationNode, N.DistinctNode)):
         changes["max_groups"] = node.max_groups * factor
     if (

@@ -11,6 +11,7 @@ adjacent operators; the fragment is the compilation unit (SURVEY.md §7
 from presto_tpu.ops.filter_project import (  # noqa: F401
     filter_project,
     project,
+    union_all,
     unnest,
     unnest_column,
 )

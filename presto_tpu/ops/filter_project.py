@@ -291,6 +291,86 @@ def unnest_column(
     )
 
 
+def union_all(pages: Sequence[Page]) -> Page:
+    """UNION ALL: concatenate pages (reference: UnionNode). Inputs are
+    schema-aligned by the planner (same names/types per position);
+    liveness concatenates as masks (no compaction), capacities add.
+    String columns re-encode through a trace-time union dictionary
+    (per-input dictionaries are static metadata, so the remap LUTs are
+    constants)."""
+    import numpy as np
+
+    from presto_tpu.page import Dictionary
+
+    first = pages[0]
+    blocks: List[Block] = []
+    for ci, name in enumerate(first.names):
+        blks = [p.blocks[ci] for p in pages]
+        if any(b.offsets is not None for b in blks):
+            raise NotImplementedError(
+                f"array column {name} through UNION is not supported"
+            )
+        dictionary = None
+        if first.blocks[ci].dtype.is_string:
+            dicts = [b.dictionary for b in blks]
+            values = np.unique(
+                np.concatenate(
+                    [
+                        np.asarray(d.values, object)
+                        if d is not None and len(d.values)
+                        else np.empty(0, object)
+                        for d in dicts
+                    ]
+                ).astype(str)
+            )
+            dictionary = Dictionary(values.astype(object))
+            datas = []
+            for b, d in zip(blks, dicts):
+                if d is None or len(d.values) == 0:
+                    datas.append(jnp.zeros_like(b.data))
+                    continue
+                lut = jnp.asarray(
+                    np.searchsorted(
+                        values, np.asarray(d.values).astype(str)
+                    ).astype(np.int32)
+                )
+                datas.append(
+                    lut[jnp.clip(b.data, 0, len(d.values) - 1)]
+                )
+        else:
+            datas = [b.data for b in blks]
+        data = jnp.concatenate(datas, axis=0)
+        if any(b.valid is not None for b in blks):
+            valid = jnp.concatenate(
+                [
+                    b.valid
+                    if b.valid is not None
+                    else jnp.ones((b.capacity,), jnp.bool_)
+                    for b in blks
+                ]
+            )
+        else:
+            valid = None
+        blocks.append(
+            Block(
+                data=data,
+                valid=valid,
+                dtype=first.blocks[ci].dtype,
+                dictionary=dictionary,
+            )
+        )
+    live = jnp.concatenate([p.row_mask() for p in pages])
+    num = sum(
+        (p.num_valid for p in pages), jnp.asarray(0, jnp.int32)
+    ).astype(jnp.int32)
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=num,
+        names=first.names,
+        live=live,
+    )
+
+
 def filter_project(
     page: Page,
     predicate: Optional[Expr],

@@ -49,11 +49,4 @@ def insert_gathers(node: N.PlanNode) -> N.PlanNode:
     """Replace each maximal distributable subtree with RemoteSourceNode."""
     if is_distributable(node):
         return N.RemoteSourceNode(fragment_root=node)
-    changes = {}
-    for f in dataclasses.fields(node):
-        v = getattr(node, f.name)
-        if isinstance(v, N.PlanNode):
-            nv = insert_gathers(v)
-            if nv is not v:
-                changes[f.name] = nv
-    return dataclasses.replace(node, **changes) if changes else node
+    return N.map_children(node, insert_gathers)

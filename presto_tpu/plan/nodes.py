@@ -235,6 +235,24 @@ class UnnestNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class UnionAllNode(PlanNode):
+    """UNION ALL: page concatenation (reference: UnionNode/ExchangeNode
+    with multiple sources). The planner aligns every source to the same
+    column names/types via projections; UNION DISTINCT is this node
+    under a DistinctNode. TPU-first: concatenation of static-shape
+    pages (capacities add), with string columns re-encoded through a
+    trace-time union dictionary."""
+
+    sources: Tuple[PlanNode, ...]
+
+    def output_schema(self):
+        return self.sources[0].output_schema()
+
+    def children(self):
+        return self.sources
+
+
+@dataclasses.dataclass(frozen=True)
 class RemoteSourceNode(PlanNode):
     """Fragment boundary: reads the gathered output of a distributed
     fragment (reference: RemoteSourceNode reading an upstream stage
@@ -267,3 +285,22 @@ def walk(node: PlanNode):
     yield node
     for c in node.children():
         yield from walk(c)
+
+
+def map_children(node: PlanNode, fn) -> PlanNode:
+    """Rebuild ``node`` with ``fn`` applied to every direct child plan
+    node — including tuple-of-PlanNode fields (UnionAllNode.sources) —
+    returning ``node`` unchanged when nothing changed. The one
+    child-rewrite loop every generic plan traversal should use."""
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode):
+            nv = fn(v)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple) and v and isinstance(v[0], PlanNode):
+            nt = tuple(fn(x) for x in v)
+            if any(a is not b for a, b in zip(nt, v)):
+                changes[f.name] = nt
+    return dataclasses.replace(node, **changes) if changes else node

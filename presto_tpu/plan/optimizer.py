@@ -165,6 +165,8 @@ def estimate_rows(node: N.PlanNode, catalogs) -> float:
         if node.array_column is not None:
             return estimate_rows(node.source, catalogs) * 4.0
         return estimate_rows(node.source, catalogs) * len(node.elements)
+    if isinstance(node, N.UnionAllNode):
+        return sum(estimate_rows(s, catalogs) for s in node.sources)
     if isinstance(node, N.JoinNode):
         probe = estimate_rows(node.left, catalogs)
         if node.join_type in ("semi", "anti"):
@@ -269,15 +271,9 @@ def normalize_interior_outputs(
     plan_select) into plain projections: an interior Output is just a
     column select/rename, and leaving it blocks the fragmenter's
     distributable-subtree detection and the fragment-weight model."""
-    changes = {}
-    for f in dataclasses.fields(node):
-        v = getattr(node, f.name)
-        if isinstance(v, N.PlanNode):
-            nv = normalize_interior_outputs(v, is_root=False)
-            if nv is not v:
-                changes[f.name] = nv
-    if changes:
-        node = dataclasses.replace(node, **changes)
+    node = N.map_children(
+        node, lambda c: normalize_interior_outputs(c, is_root=False)
+    )
     if not is_root and isinstance(node, N.OutputNode):
         src_schema = node.source.output_schema()
         return N.ProjectNode(
@@ -318,9 +314,12 @@ def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
             node, source=prune_columns(node.source, need)
         )
     if isinstance(node, N.ProjectNode):
+        # keep at least one projection (same fallback as scans): a
+        # zero-column page has capacity 0 and loses its row count
+        # (count(*) over a fully-pruned union/subquery)
         projs = tuple(
             (n, e) for n, e in node.projections if n in required
-        )
+        ) or node.projections[:1]
         need: Set[str] = set()
         for _, e in projs:
             _expr_columns(e, need)
@@ -406,6 +405,14 @@ def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
         return dataclasses.replace(
             node, source=prune_columns(node.source, need)
         )
+    if isinstance(node, N.UnionAllNode):
+        # sources share the same output names by construction
+        return dataclasses.replace(
+            node,
+            sources=tuple(
+                prune_columns(s, set(required)) for s in node.sources
+            ),
+        )
     if isinstance(node, N.ValuesNode):
         return node
     return node
@@ -450,22 +457,9 @@ def push_scan_constraints(node: N.PlanNode) -> N.PlanNode:
         return dataclasses.replace(
             node, source=push_scan_constraints(node.source)
         )
-    kids = node.children()
-    if not kids:
+    if not node.children():
         return node
-    changed = False
-    updates = {}
-    for fname, val in (
-        (f.name, getattr(node, f.name))
-        for f in dataclasses.fields(node)
-        if dataclasses.is_dataclass(type(node))
-    ):
-        if isinstance(val, N.PlanNode):
-            new = push_scan_constraints(val)
-            if new is not val:
-                updates[fname] = new
-                changed = True
-    return dataclasses.replace(node, **updates) if changed else node
+    return N.map_children(node, push_scan_constraints)
 
 
 def _equality_domain(e: E.Expr):

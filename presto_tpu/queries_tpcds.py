@@ -446,6 +446,58 @@ OFFICIAL = {
         group by substring(w_warehouse_name, 1, 20), sm_type, cc_name
         order by wname, sm_type, cc_name
         limit 100""",
+    # Q12: Q98's web-channel twin — revenue ratio within class
+    "q12": f"""
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(ws_ext_sales_price) as itemrevenue,
+               sum(ws_ext_sales_price) * 100 /
+                 sum(sum(ws_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from {S}.web_sales, {S}.item, {S}.date_dim
+        where ws_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and ws_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22'
+              and date '1999-02-22' + interval '30' day
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio
+        limit 100""",
+    # Q20: Q98's catalog-channel twin
+    "q20": f"""
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(cs_ext_sales_price) as itemrevenue,
+               sum(cs_ext_sales_price) * 100 /
+                 sum(sum(cs_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from {S}.catalog_sales, {S}.item, {S}.date_dim
+        where cs_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and cs_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22'
+              and date '1999-02-22' + interval '30' day
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio
+        limit 100""",
+    # Q37: Q82's catalog-channel twin — inventory band + catalog sales
+    "q37": f"""
+        select i_item_id, i_item_desc, i_current_price
+        from {S}.item, {S}.inventory, {S}.date_dim, {S}.catalog_sales
+        where i_current_price between 10 and 80
+          and inv_item_sk = i_item_sk
+          and d_date_sk = inv_date_sk
+          and d_date between date '1999-01-01'
+                         and date '1999-01-01' + interval '60' day
+          and cs_item_sk = i_item_sk
+          and inv_quantity_on_hand between 50 and 700
+        group by i_item_id, i_item_desc, i_current_price
+        order by i_item_id
+        limit 100""",
     # Q82: items in an inventory quantity band that also sold in store
     "q82": f"""
         select i_item_id, i_item_desc, i_current_price

@@ -197,6 +197,18 @@ class UnnestRef(Node):
     ordinality: Optional[str] = None  # ordinality column name
 
 
+@dataclasses.dataclass(frozen=True)
+class UnionRel(Node):
+    """A set-operation chain as a relation: terms[0] (UNION [ALL]
+    terms[i+1])*, left-associative; ``alls[i]`` is the ALL flag of the
+    op between terms[i] and terms[i+1]. The parser wraps any union
+    chain as ``SELECT * FROM UnionRel`` so ORDER BY/LIMIT apply to the
+    whole statement."""
+
+    terms: Tuple["Select", ...]
+    alls: Tuple[bool, ...]
+
+
 # ------------------------------------------------------------ statements
 
 

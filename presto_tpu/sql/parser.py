@@ -8,6 +8,7 @@ unary < postfix/primary).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional, Tuple
 
 from presto_tpu.sql import ast
@@ -181,6 +182,64 @@ class _Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
+        first = self._union_term()
+        terms: List[ast.Select] = []
+        alls: List[bool] = []
+        while self.accept_kw("union"):
+            all_ = bool(self.accept_kw("all"))
+            if not all_:
+                self.accept_kw("distinct")
+            terms.append(self._union_term())
+            alls.append(all_)
+        order_by: List[ast.SortItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
+                order_by.append(self._sort_item())
+        limit = None
+        if self.accept_kw("limit"):
+            t = self.advance()
+            if t.kind != "number":
+                raise ParseError(f"LIMIT expects a number at {t.pos}")
+            limit = int(t.value)
+        if terms:
+            # a union chain wraps as SELECT * FROM <union-relation>
+            # so ORDER BY/LIMIT and CTEs stay on the whole statement
+            return ast.Select(
+                items=(ast.SelectItem(ast.Star(), None),),
+                from_=ast.UnionRel(
+                    terms=(first,) + tuple(terms), alls=tuple(alls)
+                ),
+                order_by=tuple(order_by),
+                limit=limit,
+                ctes=tuple(ctes),
+            )
+        # only override clauses actually parsed HERE: a parenthesized
+        # first term arrives with its own order_by/limit, which a
+        # blanket replace would silently wipe
+        changes = {"ctes": tuple(ctes) + first.ctes}
+        if order_by:
+            changes["order_by"] = tuple(order_by)
+        if limit is not None:
+            changes["limit"] = limit
+        return dataclasses.replace(first, **changes)
+
+    def _union_term(self) -> ast.Select:
+        """One branch of a (possible) set-operation chain: a bare
+        select core, or a parenthesized full select."""
+        if (
+            self.peek_op("(")
+            and self.tokens[self.pos + 1].kind == "kw"
+            and self.tokens[self.pos + 1].value in ("select", "with")
+        ):
+            self.advance()
+            q = self.parse_select()
+            self.expect_op(")")
+            return q
+        return self._select_core()
+
+    def _select_core(self) -> ast.Select:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         self.accept_kw("all")
@@ -198,28 +257,13 @@ class _Parser:
             while self.accept_op(","):
                 group_by.append(self.parse_expr())
         having = self.parse_expr() if self.accept_kw("having") else None
-        order_by: List[ast.SortItem] = []
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            order_by.append(self._sort_item())
-            while self.accept_op(","):
-                order_by.append(self._sort_item())
-        limit = None
-        if self.accept_kw("limit"):
-            t = self.advance()
-            if t.kind != "number":
-                raise ParseError(f"LIMIT expects a number at {t.pos}")
-            limit = int(t.value)
         return ast.Select(
             items=tuple(items),
             from_=from_,
             where=where,
             group_by=tuple(group_by),
             having=having,
-            order_by=tuple(order_by),
-            limit=limit,
             distinct=distinct,
-            ctes=tuple(ctes),
         )
 
     def _select_item(self) -> ast.SelectItem:

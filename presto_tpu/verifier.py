@@ -304,6 +304,12 @@ def _r(n: ast.Node) -> str:
         return f"CAST(strftime('{fmt}', {_r(n.arg)}) AS INTEGER)"
     if isinstance(n, ast.Star):
         return (n.qualifier + ".*") if n.qualifier else "*"
+    if isinstance(n, ast.UnionRel):
+        rendered = [_r(n.terms[0])]
+        for t, all_ in zip(n.terms[1:], n.alls):
+            rendered.append("UNION ALL" if all_ else "UNION")
+            rendered.append(_r(t))
+        return "(" + " ".join(rendered) + ")"
     if isinstance(n, ast.IntervalLit):
         raise ValueError("bare interval outside date arithmetic")
     raise ValueError(f"cannot render {type(n).__name__} for sqlite")
