@@ -107,7 +107,20 @@ class LocalQueryRunner:
             catalogs.register("system", SystemConnector(runner=self))
         self._compiled: Dict[object, object] = {}
         self._table_cache: Dict[Tuple, Page] = {}
-        self._active_qs = None  # QueryStats while a query is in flight
+        # QueryStats while a query is in flight — THREAD-local: a
+        # server embedding this runner executes admitted queries on
+        # concurrent threads, and a shared slot races (one thread's
+        # restore-to-None between another's is-not-None check and its
+        # attribute writes)
+        self._qs_local = threading.local()
+
+    @property
+    def _active_qs(self):
+        return getattr(self._qs_local, "value", None)
+
+    @_active_qs.setter
+    def _active_qs(self, qs) -> None:
+        self._qs_local.value = qs
 
     # ------------------------------------------------------------ backend
 

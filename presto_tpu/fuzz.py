@@ -317,6 +317,26 @@ def _gen_subquery(rng) -> str:
     return _order_and_limit(rng, sql, keys)
 
 
+def _gen_setop(rng) -> str:
+    """UNION [ALL] / INTERSECT / EXCEPT over single-table branches,
+    aligned to one output column."""
+    t1 = _pick(rng, list(_NUMERIC))
+    t2 = _pick(rng, list(_NUMERIC))
+    c1 = _pick(rng, _KEYS[t1])
+    c2 = _pick(rng, _KEYS[t2])
+    op = _pick(rng, ["union all", "union", "intersect", "except"])
+    sql = f"select {c1} as k from tpch.tiny.{t1}"
+    if rng.random() < 0.7:
+        sql += f" where {_predicate(rng, t1)}"
+    sql += f" {op} select {c2} from tpch.tiny.{t2}"
+    if rng.random() < 0.7:
+        sql += f" where {_predicate(rng, t2)}"
+    sql += " order by k"
+    if op == "union all":
+        sql += f" limit {rng.randrange(20, 300)}"
+    return sql
+
+
 def _gen_string_funcs(rng) -> str:
     """Registry string functions projected + grouped (LUT design)."""
     _, str_funcs = _registry_funcs()
@@ -345,12 +365,14 @@ def generate_query(seed: int) -> str:
     shape = rng.random()
     if shape < 0.12:
         return _gen_window(rng)
-    if shape < 0.22:
+    if shape < 0.2:
         return _gen_distinct(rng)
-    if shape < 0.36:
+    if shape < 0.34:
         return _gen_subquery(rng)
-    if shape < 0.44:
+    if shape < 0.42:
         return _gen_string_funcs(rng)
+    if shape < 0.5:
+        return _gen_setop(rng)
     return _gen_core(rng)
 
 

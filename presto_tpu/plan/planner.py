@@ -559,16 +559,86 @@ class _Planner:
                 projs.append((out, e))
             aligned.append(N.ProjectNode(node, tuple(projs)))
         cur = aligned[0]
-        for node, all_ in zip(aligned[1:], rel.alls):
-            cur = N.UnionAllNode(sources=(cur, node))
-            if not all_:
-                cur = N.DistinctNode(
-                    source=cur, max_groups=self._agg_bucket(cur)
+        for node, op in zip(aligned[1:], rel.ops):
+            if op in ("union_all", "union"):
+                cur = N.UnionAllNode(sources=(cur, node))
+                if op == "union":
+                    cur = N.DistinctNode(
+                        source=cur, max_groups=self._agg_bucket(cur)
+                    )
+            else:  # intersect | except (DISTINCT semantics)
+                cur = self._set_difference(
+                    cur, node, out_names, types, keep_both=(op == "intersect")
                 )
         scope = Scope(
             {n: t for n, t in zip(out_names, types)}, {}, outer
         )
         return cur, scope
+
+    def _set_difference(self, left, right, out_names, types, keep_both):
+        """INTERSECT / EXCEPT (DISTINCT semantics) without a dedicated
+        kernel: tag each side, UNION ALL (which re-encodes string
+        columns into ONE dictionary, making all-column grouping valid),
+        group by every output column tracking per-side presence, and
+        keep groups present on both sides (INTERSECT) or only the left
+        (EXCEPT) — the reference's SetOperationNode-to-aggregation
+        rewrite, TPU-first over the existing union + sorted-agg
+        kernels."""
+        tag = self._fresh("setop")
+        tagged = []
+        for node, tag_val in ((left, 1), (right, 2)):
+            schema = node.output_schema()
+            tagged.append(
+                N.ProjectNode(
+                    source=node,
+                    projections=tuple(
+                        (n, E.ColumnRef(n, schema[n])) for n in out_names
+                    )
+                    + ((tag, E.Literal(tag_val, T.INTEGER)),),
+                )
+            )
+        u = N.UnionAllNode(sources=tuple(tagged))
+        tag_ref = E.ColumnRef(tag, T.INTEGER)
+        lo, hi = self._fresh("tagmin"), self._fresh("tagmax")
+        agg = N.AggregationNode(
+            source=u,
+            group_keys=tuple(
+                (n, E.ColumnRef(n, t))
+                for n, t in zip(out_names, types)
+            ),
+            aggs=(
+                AggCall("min", tag_ref, lo),
+                AggCall("max", tag_ref, hi),
+            ),
+            max_groups=self._agg_bucket(u),
+        )
+        # tags are 1 (left) / 2 (right): INTERSECT keeps groups seen on
+        # both sides (min=1 AND max=2); EXCEPT keeps left-only (max=1)
+        if keep_both:
+            pred: E.Expr = E.And(
+                (
+                    E.Compare(
+                        "=", E.ColumnRef(lo, T.INTEGER),
+                        E.Literal(1, T.INTEGER),
+                    ),
+                    E.Compare(
+                        "=", E.ColumnRef(hi, T.INTEGER),
+                        E.Literal(2, T.INTEGER),
+                    ),
+                )
+            )
+        else:
+            pred = E.Compare(
+                "=", E.ColumnRef(hi, T.INTEGER), E.Literal(1, T.INTEGER)
+            )
+        filtered = N.FilterNode(source=agg, predicate=pred)
+        return N.ProjectNode(
+            source=filtered,
+            projections=tuple(
+                (n, E.ColumnRef(n, t))
+                for n, t in zip(out_names, types)
+            ),
+        )
 
     def _plan_outer_join(self, rel: ast.JoinRel, outer):
         jt = rel.join_type

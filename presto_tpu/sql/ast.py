@@ -199,14 +199,15 @@ class UnnestRef(Node):
 
 @dataclasses.dataclass(frozen=True)
 class UnionRel(Node):
-    """A set-operation chain as a relation: terms[0] (UNION [ALL]
-    terms[i+1])*, left-associative; ``alls[i]`` is the ALL flag of the
-    op between terms[i] and terms[i+1]. The parser wraps any union
-    chain as ``SELECT * FROM UnionRel`` so ORDER BY/LIMIT apply to the
-    whole statement."""
+    """A set-operation chain as a relation: terms[0] (op terms[i+1])*,
+    left-associative; ``ops[i]`` in {"union_all", "union",
+    "intersect", "except"} is the operator between terms[i] and
+    terms[i+1] (INTERSECT chains pre-bind tighter in the parser). The
+    parser wraps any chain as ``SELECT * FROM UnionRel`` so ORDER
+    BY/LIMIT apply to the whole statement."""
 
     terms: Tuple["Select", ...]
-    alls: Tuple[bool, ...]
+    ops: Tuple[str, ...]
 
 
 # ------------------------------------------------------------ statements

@@ -182,15 +182,26 @@ class _Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
-        first = self._union_term()
+        first = self._intersect_chain()
         terms: List[ast.Select] = []
-        alls: List[bool] = []
-        while self.accept_kw("union"):
-            all_ = bool(self.accept_kw("all"))
-            if not all_:
+        ops: List[str] = []
+        while True:
+            if self.accept_kw("union"):
+                all_ = bool(self.accept_kw("all"))
+                if not all_:
+                    self.accept_kw("distinct")
+                ops.append("union_all" if all_ else "union")
+            elif self.accept_kw("except"):
+                if self.peek_kw("all"):
+                    raise ParseError(
+                        "EXCEPT ALL is not supported (DISTINCT "
+                        "semantics only)"
+                    )
                 self.accept_kw("distinct")
-            terms.append(self._union_term())
-            alls.append(all_)
+                ops.append("except")
+            else:
+                break
+            terms.append(self._intersect_chain())
         order_by: List[ast.SortItem] = []
         if self.accept_kw("order"):
             self.expect_kw("by")
@@ -204,12 +215,12 @@ class _Parser:
                 raise ParseError(f"LIMIT expects a number at {t.pos}")
             limit = int(t.value)
         if terms:
-            # a union chain wraps as SELECT * FROM <union-relation>
+            # a set-op chain wraps as SELECT * FROM <union-relation>
             # so ORDER BY/LIMIT and CTEs stay on the whole statement
             return ast.Select(
                 items=(ast.SelectItem(ast.Star(), None),),
                 from_=ast.UnionRel(
-                    terms=(first,) + tuple(terms), alls=tuple(alls)
+                    terms=(first,) + tuple(terms), ops=tuple(ops)
                 ),
                 order_by=tuple(order_by),
                 limit=limit,
@@ -224,6 +235,30 @@ class _Parser:
         if limit is not None:
             changes["limit"] = limit
         return dataclasses.replace(first, **changes)
+
+    def _intersect_chain(self) -> ast.Select:
+        """INTERSECT binds tighter than UNION/EXCEPT (SQL precedence):
+        fold a chain of terms joined by INTERSECT into its own wrapped
+        union-relation before the outer loop sees it."""
+        first = self._union_term()
+        terms: List[ast.Select] = []
+        while self.accept_kw("intersect"):
+            if self.peek_kw("all"):
+                raise ParseError(
+                    "INTERSECT ALL is not supported (DISTINCT "
+                    "semantics only)"
+                )
+            self.accept_kw("distinct")
+            terms.append(self._union_term())
+        if not terms:
+            return first
+        return ast.Select(
+            items=(ast.SelectItem(ast.Star(), None),),
+            from_=ast.UnionRel(
+                terms=(first,) + tuple(terms),
+                ops=("intersect",) * len(terms),
+            ),
+        )
 
     def _union_term(self) -> ast.Select:
         """One branch of a (possible) set-operation chain: a bare

@@ -21,6 +21,7 @@ KEYWORDS = {
     "cast", "extract", "date", "interval", "year", "month", "day",
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "asc", "desc", "nulls", "first", "last", "distinct", "all", "union",
+    "intersect", "except",
     "with", "over", "partition", "rows", "range", "set", "session",
     "explain", "analyze", "show", "tables", "schemas", "substring",
     "substr", "for", "any", "some", "escape", "values",

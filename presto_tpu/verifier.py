@@ -305,9 +305,15 @@ def _r(n: ast.Node) -> str:
     if isinstance(n, ast.Star):
         return (n.qualifier + ".*") if n.qualifier else "*"
     if isinstance(n, ast.UnionRel):
+        kw = {
+            "union_all": "UNION ALL",
+            "union": "UNION",
+            "intersect": "INTERSECT",
+            "except": "EXCEPT",
+        }
         rendered = [_r(n.terms[0])]
-        for t, all_ in zip(n.terms[1:], n.alls):
-            rendered.append("UNION ALL" if all_ else "UNION")
+        for t, op in zip(n.terms[1:], n.ops):
+            rendered.append(kw[op])
             rendered.append(_r(t))
         return "(" + " ".join(rendered) + ")"
     if isinstance(n, ast.IntervalLit):

@@ -132,3 +132,45 @@ def test_uncorrelated_union_in_subquery_predicate(runner, oracle):
     )
     diff = verify_query(runner, oracle, q)
     assert diff is None, diff
+
+
+# --------------------------------------------------- INTERSECT / EXCEPT
+
+
+INTERSECT_QUERIES = {
+    "intersect_basic": (
+        "select n_regionkey as k from tpch.tiny.nation "
+        "intersect select r_regionkey from tpch.tiny.region "
+        "where r_regionkey < 3 order by k"
+    ),
+    "except_basic": (
+        "select n_nationkey as k from tpch.tiny.nation "
+        "where n_nationkey < 8 "
+        "except select r_regionkey from tpch.tiny.region order by k"
+    ),
+    "intersect_strings": (
+        "select n_name as x from tpch.tiny.nation "
+        "intersect select n_name from tpch.tiny.nation "
+        "where n_regionkey = 2 order by x"
+    ),
+    "precedence_intersect_binds_tighter": (
+        "select n_nationkey as k from tpch.tiny.nation "
+        "where n_nationkey < 3 "
+        "union select n_nationkey from tpch.tiny.nation "
+        "where n_nationkey between 3 and 6 "
+        "intersect select n_nationkey from tpch.tiny.nation "
+        "where n_nationkey between 5 and 9 order by k"
+    ),
+    "except_dedups": (
+        "select n_regionkey as k from tpch.tiny.nation "
+        "except select 99 order by k"
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(INTERSECT_QUERIES))
+def test_intersect_except(name, runner, oracle):
+    diff = verify_query(
+        runner, oracle, INTERSECT_QUERIES[name], rel_tol=1e-6
+    )
+    assert diff is None, f"{name}: {diff}"
