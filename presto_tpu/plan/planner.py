@@ -511,7 +511,83 @@ class _Planner:
             )
         if isinstance(rel, ast.UnionRel):
             return self._plan_union(rel, outer)
+        if isinstance(rel, ast.ValuesRel):
+            return self._plan_values(rel, outer)
         raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_values(self, rel: ast.ValuesRel, outer):
+        """(VALUES ...) AS t(c1, ...): an inline table as a UNION ALL
+        of single-row literal projections over the FROM-less relation
+        (reference: Values query body) — zero new executor surface."""
+        if not rel.rows:
+            raise PlanningError("VALUES requires at least one row")
+        arity = len(rel.rows[0])
+        for row in rel.rows:
+            if len(row) != arity:
+                raise PlanningError(
+                    "VALUES rows must have equal arity "
+                    f"({arity} vs {len(row)})"
+                )
+        if rel.column_names and len(rel.column_names) != arity:
+            raise PlanningError(
+                f"VALUES alias declares {len(rel.column_names)} "
+                f"columns for {arity}-column rows"
+            )
+        empty = Scope({}, {}, None)
+        lowered = [
+            [self._lower(e, empty) for e in row] for row in rel.rows
+        ]
+        types = []
+        for i in range(arity):
+            ct = lowered[0][i].dtype
+            for row in lowered[1:]:
+                # typed NULLs coerce toward the non-null type
+                if isinstance(row[i], E.Literal) and row[i].value is None:
+                    continue
+                if isinstance(lowered[0][i], E.Literal) and (
+                    lowered[0][i].value is None
+                ):
+                    ct = row[i].dtype
+                    continue
+                ct = T.common_super_type(ct, row[i].dtype)
+            if ct.is_long_decimal:
+                # the bigint/decimal lattice widens mixed integer +
+                # decimal literals past p=18; VALUES literals always
+                # fit the short form
+                ct = T.decimal(18, ct.scale)
+            types.append(ct)
+        visible = tuple(rel.column_names) or tuple(
+            f"_col{i}" for i in range(arity)
+        )
+        internal = tuple(self._fresh(v.lstrip("$")) for v in visible)
+        row_nodes = []
+        for row in lowered:
+            projs = []
+            for i, e in enumerate(row):
+                if isinstance(e, E.Literal) and e.value is None:
+                    e = E.Literal(None, types[i])
+                elif e.dtype != types[i]:
+                    e = (
+                        _coerce_literal(e, types[i])
+                        if isinstance(e, E.Literal)
+                        and not types[i].is_string
+                        else E.Cast(e, types[i])
+                    )
+                projs.append((internal[i], e))
+            row_nodes.append(
+                N.ProjectNode(source=N.ValuesNode(), projections=tuple(projs))
+            )
+        node = (
+            row_nodes[0]
+            if len(row_nodes) == 1
+            else N.UnionAllNode(sources=tuple(row_nodes))
+        )
+        scope = Scope(
+            {n: t for n, t in zip(internal, types)},
+            {rel.alias: dict(zip(visible, internal))},
+            outer,
+        )
+        return node, scope
 
     def _plan_union(self, rel: ast.UnionRel, outer):
         """Set operations (reference: UNION [ALL] via UnionNode +
@@ -2214,6 +2290,12 @@ def _coerce_literal(lit: E.Literal, to: T.DataType) -> E.Literal:
     v = lit.value
     if to.is_decimal and lit.dtype.is_integer:
         return E.Literal(int(v) * 10 ** to.scale, to)
+    if to.is_decimal and lit.dtype.is_decimal:
+        # decimal literals store UNSCALED values: rescale, don't retype
+        shift = to.scale - lit.dtype.scale
+        if shift >= 0:
+            return E.Literal(int(v) * 10 ** shift, to)
+        return E.Literal(int(v) // 10 ** (-shift), to)
     if to.is_integer and lit.dtype.is_integer:
         return E.Literal(int(v), to)
     if to.name == "date" and lit.dtype.is_integer:

@@ -198,6 +198,16 @@ class UnnestRef(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ValuesRel(Node):
+    """(VALUES (...), (...)) AS alias [(col, ...)] — an inline table
+    relation (reference: Values as a query body)."""
+
+    rows: Tuple[Tuple[Node, ...], ...]
+    alias: str
+    column_names: Tuple[str, ...] = ()  # defaults: _col1, _col2, ...
+
+
+@dataclasses.dataclass(frozen=True)
 class UnionRel(Node):
     """A set-operation chain as a relation: terms[0] (op terms[i+1])*,
     left-associative; ``ops[i]`` in {"union_all", "union",

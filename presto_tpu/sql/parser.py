@@ -419,6 +419,35 @@ class _Parser:
                 )
             return ast.UnnestRef(arr, alias, col, ordname)
         if self.accept_op("("):
+            if self.peek_kw("values"):
+                self.advance()
+                rows: List[tuple] = []
+                while True:
+                    self.expect_op("(")
+                    row = [self.parse_expr()]
+                    while self.accept_op(","):
+                        row.append(self.parse_expr())
+                    self.expect_op(")")
+                    rows.append(tuple(row))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                alias = self._relation_alias()
+                if alias is None:
+                    raise ParseError(
+                        "VALUES relation requires an alias "
+                        f"at {self.cur.pos}"
+                    )
+                names: List[str] = []
+                if self.accept_op("("):
+                    names.append(self.expect_ident())
+                    while self.accept_op(","):
+                        names.append(self.expect_ident())
+                    self.expect_op(")")
+                return ast.ValuesRel(
+                    rows=tuple(rows), alias=alias,
+                    column_names=tuple(names),
+                )
             q = self.parse_select()
             self.expect_op(")")
             alias = self._relation_alias()

@@ -304,6 +304,22 @@ def _r(n: ast.Node) -> str:
         return f"CAST(strftime('{fmt}', {_r(n.arg)}) AS INTEGER)"
     if isinstance(n, ast.Star):
         return (n.qualifier + ".*") if n.qualifier else "*"
+    if isinstance(n, ast.ValuesRel):
+        # portable rendering: UNION ALL of FROM-less SELECTs (sqlite's
+        # VALUES form cannot name columns)
+        names = n.column_names or tuple(
+            f"_col{i}" for i in range(len(n.rows[0]))
+        )
+        selects = []
+        for ri, row in enumerate(n.rows):
+            cols = ", ".join(
+                _r(v) + (f" AS {names[ci]}" if ri == 0 else "")
+                for ci, v in enumerate(row)
+            )
+            selects.append("SELECT " + cols)
+        return (
+            "(" + " UNION ALL ".join(selects) + f") AS {n.alias}"
+        )
     if isinstance(n, ast.UnionRel):
         kw = {
             "union_all": "UNION ALL",

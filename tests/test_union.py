@@ -174,3 +174,40 @@ def test_intersect_except(name, runner, oracle):
         runner, oracle, INTERSECT_QUERIES[name], rel_tol=1e-6
     )
     assert diff is None, f"{name}: {diff}"
+
+
+# ------------------------------------------------------ VALUES relation
+
+
+VALUES_QUERIES = {
+    "basic_with_null": (
+        "select a, b from (values (1, 'x'), (2, 'y'), (3, null)) "
+        "as t(a, b) order by a"
+    ),
+    "expression_over_values": (
+        "select t.a + 1 as a1 from (values (1), (2)) t(a) order by a1"
+    ),
+    "joined_to_table": (
+        "select n_name from tpch.tiny.nation, (values (1), (2)) v(k) "
+        "where n_nationkey = v.k order by n_name"
+    ),
+    "mixed_numeric_literals": (
+        "select sum(a) as s from (values (1.5), (2), (3.25)) t(a)"
+    ),
+    "default_column_names": (
+        "select count(*) as c from (values (1, 2), (3, 4)) t"
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(VALUES_QUERIES))
+def test_values_relation(name, runner, oracle):
+    diff = verify_query(runner, oracle, VALUES_QUERIES[name], rel_tol=1e-6)
+    assert diff is None, f"{name}: {diff}"
+
+
+def test_values_arity_mismatch(runner):
+    from presto_tpu.plan.planner import PlanningError
+
+    with pytest.raises(PlanningError):
+        runner.execute("select * from (values (1, 2), (3)) t(a, b)")
