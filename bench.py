@@ -212,8 +212,17 @@ def main() -> None:
     ]
     failed = 0
     for metric, sql, schema, driving, expect, props, iters in extra:
-        if only is not None and only not in metric:
-            continue
+        if only is not None:
+            # substring match, but never across a digit boundary:
+            # --only tpch_q3_sf1 must NOT drag tpch_q3_sf10 along (an
+            # unintended heavy config can crash the tunnel backend and
+            # poison the rest of the matrix)
+            i = metric.find(only)
+            if i < 0 or (
+                i + len(only) < len(metric)
+                and metric[i + len(only)].isdigit()
+            ):
+                continue
         try:
             saved = {
                 k: str(runner.session.get(k)) for k in (props or {})

@@ -532,6 +532,34 @@ class DateAdd(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class ValueHash(Expr):
+    """checksum() support: an order-insensitive per-value hash.
+
+    Maps any column to a 32-bit avalanche hash zero-extended into
+    BIGINT, with NULL contributing a fixed non-zero constant — so a
+    wrapping-free int64 SUM over the hashes (exact below 2^31 rows) is
+    an order- and partitioning-insensitive set digest. Reference parity:
+    the ``checksum()`` aggregate's per-value XXHash64 step (SURVEY.md
+    §2.1 "Function registry"); deviation: 32-bit mix + BIGINT result
+    (the reference emits varbinary), values hash their physical device
+    image (dictionary ids for strings), so checksums compare equal only
+    within one engine — the reference makes the same single-engine
+    assumption for its own hash seed.
+
+    The output has no validity lane (NULLs are folded INTO the hash),
+    which is what lets the SUM state see every live row."""
+
+    arg: Expr
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.BIGINT
+
+
+@dataclasses.dataclass(frozen=True)
 class DictIntFunc(Expr):
     """Integer-valued function of a dictionary column (length, strpos),
     evaluated host-side per dictionary entry into an int64 LUT that the
@@ -1334,6 +1362,11 @@ class ExprLowerer:
             return x * (180.0 / float(np.pi)), v
         if e.func == "radians":
             return x * (float(np.pi) / 180.0), v
+        if e.func in ("sinh", "cosh", "tanh"):
+            fn = {
+                "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+            }[e.func]
+            return fn(x), v
         raise NotImplementedError(f"math function {e.func}")
 
     def _eval_mathfunc2(self, e: MathFunc2):
@@ -1489,6 +1522,31 @@ class ExprLowerer:
         if idx_v is not None:
             valid = valid & jnp.broadcast_to(idx_v, (blk.capacity,))
         return data, valid
+
+    def _eval_valuehash(self, e: ValueHash):
+        d, v = self.eval(e.arg)
+        at = e.arg.dtype
+        if at.is_long_decimal:
+            x = (
+                d[..., 0].astype(jnp.uint64)
+                * jnp.uint64(0x9E3779B97F4A7C15)
+            ) ^ d[..., 1].astype(jnp.uint64)
+        elif at.name in ("double", "real"):
+            f = jnp.asarray(d, jnp.float64)
+            f = jnp.where(f == 0, 0.0, f)  # +0.0 and -0.0 are SQL-equal
+            x = f.view(jnp.int64).astype(jnp.uint64)
+        else:
+            x = jnp.asarray(d).astype(jnp.int64).astype(jnp.uint64)
+        # splitmix64 finalizer (public-domain mixing constants), folded
+        # to 32 bits so int64 sums of the hashes cannot wrap
+        z = x + jnp.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> jnp.uint64(31))
+        h = (z & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+        if v is not None:
+            h = jnp.where(v, h, jnp.int64(0x9E3779B9))
+        return h, None
 
     def _eval_dictintfunc(self, e: DictIntFunc):
         data, valid = self.eval(e.arg)

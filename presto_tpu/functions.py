@@ -178,9 +178,68 @@ def _math1(func: str):
 for _f in (
     "sqrt", "ln", "exp", "abs", "sign", "cbrt",
     "log2", "log10", "sin", "cos", "tan", "asin", "acos", "atan",
-    "degrees", "radians",
+    "degrees", "radians", "sinh", "cosh", "tanh",
 ):
     _register(_f, 1, description=f"{_f}(x)", fuzz=("num",))(_math1(_f))
+
+
+@_register(
+    "width_bucket", 4,
+    description="width_bucket(x, lo, hi, n) -> bucket in [0, n+1]; "
+    "descending bounds (lo > hi) reverse the buckets like the "
+    "reference; equal bounds -> NULL (deviation: the reference raises)",
+)
+def _width_bucket(args):
+    x = _numeric_arg(args[0], "width_bucket")
+    lo = _numeric_arg(args[1], "width_bucket")
+    hi = _numeric_arg(args[2], "width_bucket")
+    n_count = _lit_int(args[3], "width_bucket bucket count")
+    if n_count < 1:
+        raise FunctionError(
+            f"width_bucket bucket count must be >= 1, got {n_count}"
+        )
+    xf = E.Cast(x, T.DOUBLE) if x.dtype != T.DOUBLE else x
+    lof = E.Cast(lo, T.DOUBLE) if lo.dtype != T.DOUBLE else lo
+    hif = E.Cast(hi, T.DOUBLE) if hi.dtype != T.DOUBLE else hi
+    nf = _flit(n_count)
+    over = E.Literal(n_count + 1, T.BIGINT)
+
+    def bucket(span_from, span_to):
+        # floor((x - from) / (to - from) * n) + 1
+        span = _fsub(span_to, span_from)
+        frac = _fdiv(_fsub(xf, span_from), span)
+        return E.Arithmetic(
+            "+",
+            E.MathFunc("floor", _fmul(frac, nf)),
+            E.Literal(1, T.BIGINT),
+            T.BIGINT,
+        )
+
+    asc = E.Case(
+        whens=(
+            (E.Compare("<", xf, lof), E.Literal(0, T.BIGINT)),
+            (E.Compare(">=", xf, hif), over),
+        ),
+        default=bucket(lof, hif),
+        _dtype=T.BIGINT,
+    )
+    # descending bounds: buckets decrease from lo to hi, (hi, lo]-open
+    desc = E.Case(
+        whens=(
+            (E.Compare(">", xf, lof), E.Literal(0, T.BIGINT)),
+            (E.Compare("<=", xf, hif), over),
+        ),
+        default=bucket(lof, hif),
+        _dtype=T.BIGINT,
+    )
+    return E.Case(
+        whens=(
+            (E.Compare("<", lof, hif), asc),
+            (E.Compare(">", lof, hif), desc),
+        ),
+        default=E.Literal(None, T.BIGINT),
+        _dtype=T.BIGINT,
+    )
 
 
 @_register("floor", 1, description="floor(x) -> bigint", fuzz=("num",))
@@ -541,18 +600,662 @@ for _f in (
     )
 
 
-# ------------------------------------------------------- aggregate aliases
+# --------------------------------------------------- aggregate registry
 
-#: aggregate-name aliases resolved in the planner's aggregation path
-#: (these are AGGREGATES, not scalars — listed here so the registry is
-#: the one catalog of builtin names): approx_distinct(x) plans as the
-#: exact count(DISTINCT x) two-level rewrite (error 0 <= any HLL
-#: standard error); arbitrary/any_value take min (any value is valid);
-#: bool_and/bool_or/every are min/max over booleans.
-AGGREGATE_ALIASES: Dict[str, str] = {
-    "arbitrary": "min",
-    "any_value": "min",
-    "bool_and": "min",
-    "every": "min",
-    "bool_or": "max",
-}
+@dataclasses.dataclass(frozen=True)
+class KernelAgg:
+    """Aggregate lowering onto a native kernel accumulator: ``func`` is
+    an ops/aggregation.py kernel name (count/count_star/sum/min/max/
+    array_agg/approx_percentile/min_by/max_by), ``arg2`` the ordering
+    argument of min_by/max_by, ``param`` approx_percentile's quantile."""
+
+    func: str
+    arg: Optional[E.Expr]
+    arg2: Optional[E.Expr] = None
+    param: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ComposedAgg:
+    """Aggregate lowering as primitive mergeable states + an Expr
+    finisher — the engine's form of the reference's accumulator quartet
+    (@InputFunction/@CombineFunction/@OutputFunction — SURVEY.md §2.1
+    "Function registry"): each state is a (suffix, primitive, expr)
+    where primitive ∈ {sum, count, min, max} merges with itself (sum/
+    count by summing, min/max by re-reducing), and ``finish`` maps
+    ColumnRefs of the state columns to the output expression. Because
+    states are self-mergeable primitives, the partial/final distributed
+    split (parallel/agg_split.py) handles every composed aggregate with
+    NO per-function code."""
+
+    states: Tuple[Tuple[str, str, E.Expr], ...]
+    finish: Callable[[Dict[str, E.Expr]], E.Expr]
+    dtype: T.DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateFunction:
+    """One registered aggregate builtin. ``build`` validates the
+    lowered argument exprs and returns a KernelAgg or ComposedAgg;
+    ``distinct_rewrite`` marks approx_distinct-style functions the
+    planner rewrites into the two-level count(DISTINCT) tree before
+    lowering ever happens."""
+
+    name: str
+    min_args: int
+    max_args: int  # -1 = variadic
+    build: Optional[Callable[[List[E.Expr]], object]]
+    description: str = ""
+    #: fuzzer argument classes (see ScalarFunction.fuzz); aggregates
+    #: with no sqlite oracle equivalent set None
+    fuzz: Optional[Tuple[str, ...]] = None
+    distinct_rewrite: bool = False
+
+
+AGGREGATE: Dict[str, AggregateFunction] = {}
+
+
+def _register_agg(
+    name: str,
+    min_args: int,
+    max_args: Optional[int] = None,
+    description: str = "",
+    fuzz: Optional[Tuple[str, ...]] = None,
+    distinct_rewrite: bool = False,
+):
+    def deco(fn):
+        AGGREGATE[name] = AggregateFunction(
+            name=name,
+            min_args=min_args,
+            max_args=min_args if max_args is None else max_args,
+            build=fn,
+            description=description,
+            fuzz=fuzz,
+            distinct_rewrite=distinct_rewrite,
+        )
+        return fn
+
+    return deco
+
+
+def is_aggregate(name: str) -> bool:
+    return name in AGGREGATE
+
+
+def lower_aggregate(name: str, args: List[E.Expr]):
+    """Resolve + build an aggregate call -> KernelAgg | ComposedAgg;
+    FunctionError on unknown name or arity/type mismatch. The planner's
+    single entry point (plan/planner.py::_plain_agg_node)."""
+    fn = AGGREGATE.get(name)
+    if fn is None:
+        raise FunctionError(f"unknown aggregate function: {name}")
+    n = len(args)
+    if n < fn.min_args or (fn.max_args >= 0 and n > fn.max_args):
+        want = (
+            str(fn.min_args)
+            if fn.min_args == fn.max_args
+            else f"{fn.min_args}..{'*' if fn.max_args < 0 else fn.max_args}"
+        )
+        raise FunctionError(f"{name}() takes {want} arguments, got {n}")
+    return fn.build(args)
+
+
+def agg_state_type(prim: str, expr: Optional[E.Expr]) -> T.DataType:
+    """Result type of one primitive state column (mirrors the kernel's
+    AggCall.result_type for the primitive subset)."""
+    if prim in ("count", "count_star"):
+        return T.BIGINT
+    t = expr.dtype
+    if prim == "sum":
+        if t.is_decimal:
+            return T.decimal(18, t.scale)
+        if t.is_integer:
+            return T.BIGINT
+        return T.DOUBLE
+    if prim in ("min", "max"):
+        return t
+    raise FunctionError(f"unknown aggregate state primitive {prim}")
+
+
+# --- Expr algebra helpers for finishers (all in DOUBLE) ---------------
+
+
+def _f64(e: E.Expr) -> E.Expr:
+    return e if e.dtype == T.DOUBLE else E.Cast(e, T.DOUBLE)
+
+
+def _flit(v: float) -> E.Expr:
+    return E.Literal(float(v), T.DOUBLE)
+
+
+def _fmul(a: E.Expr, b: E.Expr) -> E.Expr:
+    return E.Arithmetic("*", a, b, T.DOUBLE)
+
+
+def _fdiv(a: E.Expr, b: E.Expr) -> E.Expr:
+    return E.Arithmetic("/", a, b, T.DOUBLE)
+
+
+def _fadd(a: E.Expr, b: E.Expr) -> E.Expr:
+    return E.Arithmetic("+", a, b, T.DOUBLE)
+
+
+def _fsub(a: E.Expr, b: E.Expr) -> E.Expr:
+    return E.Arithmetic("-", a, b, T.DOUBLE)
+
+
+def _null_unless(cond: E.Expr, body: E.Expr, dtype: T.DataType) -> E.Expr:
+    """body where cond, SQL NULL otherwise."""
+    return E.Case(
+        whens=((E.Not(cond), E.Literal(None, dtype)),),
+        default=body,
+        _dtype=dtype,
+    )
+
+
+def _cnt_ge(cnt: E.Expr, n: int) -> E.Expr:
+    return E.Compare(">=", cnt, E.Literal(n, T.BIGINT))
+
+
+def _pair_masked(x: E.Expr, y: E.Expr, e: E.Expr) -> E.Expr:
+    """e where BOTH x and y are non-null, else NULL — two-argument
+    aggregates (corr/covar/regr) skip a row when either input is NULL."""
+    both = E.And((E.Not(E.IsNull(x)), E.Not(E.IsNull(y))))
+    return E.Case(
+        whens=((both, e),),
+        default=E.Literal(None, e.dtype),
+        _dtype=e.dtype,
+    )
+
+
+def _orderable_arg(e: E.Expr, fname: str) -> E.Expr:
+    t = e.dtype
+    ok = (
+        t.is_integer or t.is_decimal or t.is_string
+        or t.name in ("double", "real", "date", "timestamp", "boolean")
+    )
+    if not ok:
+        raise FunctionError(f"{fname}() cannot order type {t}")
+    return e
+
+
+# --- entries ----------------------------------------------------------
+
+
+@_register_agg("count", 0, 1, description="count(*) | count(x)",
+               fuzz=("any",))
+def _agg_count(args):
+    if not args:
+        return KernelAgg("count_star", None)
+    return KernelAgg("count", args[0])
+
+
+@_register_agg("sum", 1, description="sum(x)", fuzz=("num",))
+def _agg_sum(args):
+    return KernelAgg("sum", _numeric_arg(args[0], "sum"))
+
+
+@_register_agg("min", 1, description="min(x)", fuzz=("any",))
+def _agg_min(args):
+    return KernelAgg("min", _orderable_arg(args[0], "min"))
+
+
+@_register_agg("max", 1, description="max(x)", fuzz=("any",))
+def _agg_max(args):
+    return KernelAgg("max", _orderable_arg(args[0], "max"))
+
+
+@_register_agg("arbitrary", 1, description="any value of the group")
+@_register_agg("any_value", 1, description="alias of arbitrary")
+def _agg_arbitrary(args):
+    return KernelAgg("min", _orderable_arg(args[0], "arbitrary"))
+
+
+def _bool_arg(e: E.Expr, fname: str) -> E.Expr:
+    if e.dtype.name != "boolean":
+        raise FunctionError(f"{fname}() requires a boolean argument")
+    return e
+
+
+@_register_agg("bool_and", 1, description="true iff every value true")
+@_register_agg("every", 1, description="alias of bool_and")
+def _agg_bool_and(args):
+    return KernelAgg("min", _bool_arg(args[0], "bool_and"))
+
+
+@_register_agg("bool_or", 1, description="true iff any value true")
+def _agg_bool_or(args):
+    return KernelAgg("max", _bool_arg(args[0], "bool_or"))
+
+
+@_register_agg("array_agg", 1, description="array_agg(x)")
+def _agg_array_agg(args):
+    return KernelAgg("array_agg", args[0])
+
+
+@_register_agg(
+    "approx_distinct", 1,
+    description="plans as exact count(DISTINCT x) — error 0 <= any "
+    "HLL standard error",
+    distinct_rewrite=True,
+)
+def _agg_approx_distinct(args):
+    raise FunctionError(
+        "approx_distinct is rewritten by the planner before lowering"
+    )
+
+
+@_register_agg("avg", 1, description="avg(x) = sum/count", fuzz=("num",))
+def _agg_avg(args):
+    x = _numeric_arg(args[0], "avg")
+
+    def finish(s):
+        return _null_unless(
+            _cnt_ge(s["cnt"], 1),
+            _fdiv(_f64(s["sum"]), _f64(s["cnt"])),
+            T.DOUBLE,
+        )
+
+    return ComposedAgg(
+        states=(("sum", "sum", x), ("cnt", "count", x)),
+        finish=finish,
+        dtype=T.DOUBLE,
+    )
+
+
+def _variance_entry(func: str):
+    """stddev/variance family from (Σx, Σx², n) — the same mergeable
+    decomposition the single-node kernel used to hardcode."""
+
+    def build(args, _func=func):
+        x = _f64(_numeric_arg(args[0], _func))
+        sq = _fmul(x, x)
+        samp = _func.endswith("_samp")
+
+        def finish(s, _samp=samp, _f=_func):
+            nf = _f64(s["cnt"])
+            mean = _fdiv(s["s1"], nf)
+            var = _fsub(_fdiv(s["s2"], nf), _fmul(mean, mean))
+            if _samp:
+                var = _fdiv(
+                    _fmul(var, nf), _fsub(nf, _flit(1.0))
+                )
+            # clamp fp cancellation residue: tiny negative -> 0
+            var = E.Case(
+                whens=(
+                    (E.Compare("<", var, _flit(0.0)), _flit(0.0)),
+                ),
+                default=var,
+                _dtype=T.DOUBLE,
+            )
+            if _f.startswith("stddev"):
+                var = E.MathFunc("sqrt", var)
+            return _null_unless(
+                _cnt_ge(s["cnt"], 2 if _samp else 1), var, T.DOUBLE
+            )
+
+        return ComposedAgg(
+            states=(
+                ("s1", "sum", x),
+                ("s2", "sum", sq),
+                ("cnt", "count", x),
+            ),
+            finish=finish,
+            dtype=T.DOUBLE,
+        )
+
+    return build
+
+
+for _f, _target in (
+    ("stddev", "stddev_samp"), ("stddev_samp", "stddev_samp"),
+    ("stddev_pop", "stddev_pop"), ("variance", "var_samp"),
+    ("var_samp", "var_samp"), ("var_pop", "var_pop"),
+):
+    _register_agg(_f, 1, description=f"{_f}(x)", fuzz=None)(
+        _variance_entry(_target)
+    )
+
+
+@_register_agg("geometric_mean", 1,
+               description="exp(avg(ln(x))); non-positive values are "
+               "skipped as NULL ln (deviation: the reference raises)")
+def _agg_geometric_mean(args):
+    x = _f64(_numeric_arg(args[0], "geometric_mean"))
+    lx = E.MathFunc("ln", x)
+
+    def finish(s):
+        return _null_unless(
+            _cnt_ge(s["cnt"], 1),
+            E.MathFunc("exp", _fdiv(s["s"], _f64(s["cnt"]))),
+            T.DOUBLE,
+        )
+
+    return ComposedAgg(
+        states=(("s", "sum", lx), ("cnt", "count", lx)),
+        finish=finish,
+        dtype=T.DOUBLE,
+    )
+
+
+@_register_agg("count_if", 1, description="count_if(b) = rows where true")
+def _agg_count_if(args):
+    b = _bool_arg(args[0], "count_if")
+    one_if = E.Case(
+        whens=((b, E.Literal(1, T.BIGINT)),),
+        default=E.Literal(None, T.BIGINT),
+        _dtype=T.BIGINT,
+    )
+
+    def finish(s):
+        return s["c"]
+
+    return ComposedAgg(
+        states=(("c", "count", one_if),), finish=finish, dtype=T.BIGINT
+    )
+
+
+@_register_agg(
+    "checksum", 1,
+    description="order/partitioning-insensitive BIGINT digest: sum of "
+    "per-value 32-bit hashes (deviation: the reference emits varbinary)",
+)
+def _agg_checksum(args):
+    h = E.ValueHash(args[0])
+
+    def finish(s):
+        return s["s"]
+
+    return ComposedAgg(
+        states=(("s", "sum", h),), finish=finish, dtype=T.BIGINT
+    )
+
+
+def _covar_states(y: E.Expr, x: E.Expr):
+    """Pairwise-masked (Σx, Σy, Σxy, n) over rows where BOTH non-null."""
+    xf, yf = _f64(x), _f64(y)
+    return (
+        ("sx", "sum", _pair_masked(x, y, xf)),
+        ("sy", "sum", _pair_masked(x, y, yf)),
+        ("sxy", "sum", _pair_masked(x, y, _fmul(xf, yf))),
+        ("cnt", "count", _pair_masked(x, y, xf)),
+    )
+
+
+def _covar_entry(pop: bool):
+    def build(args, _pop=pop):
+        y = _numeric_arg(args[0], "covar")
+        x = _numeric_arg(args[1], "covar")
+
+        def finish(s, _p=_pop):
+            nf = _f64(s["cnt"])
+            num = _fsub(
+                s["sxy"], _fdiv(_fmul(s["sx"], s["sy"]), nf)
+            )
+            if _p:
+                out = _fdiv(num, nf)
+                min_n = 1
+            else:
+                out = _fdiv(num, _fsub(nf, _flit(1.0)))
+                min_n = 2
+            return _null_unless(
+                _cnt_ge(s["cnt"], min_n), out, T.DOUBLE
+            )
+
+        return ComposedAgg(
+            states=_covar_states(y, x), finish=finish, dtype=T.DOUBLE
+        )
+
+    return build
+
+
+_register_agg("covar_samp", 2, description="sample covariance(y, x)")(
+    _covar_entry(False)
+)
+_register_agg("covar_pop", 2, description="population covariance(y, x)")(
+    _covar_entry(True)
+)
+
+
+@_register_agg("corr", 2, description="Pearson correlation of (y, x)")
+def _agg_corr(args):
+    y = _numeric_arg(args[0], "corr")
+    x = _numeric_arg(args[1], "corr")
+    xf, yf = _f64(x), _f64(y)
+    states = _covar_states(y, x) + (
+        ("sx2", "sum", _pair_masked(x, y, _fmul(xf, xf))),
+        ("sy2", "sum", _pair_masked(x, y, _fmul(yf, yf))),
+    )
+
+    def finish(s):
+        nf = _f64(s["cnt"])
+        num = _fsub(_fmul(nf, s["sxy"]), _fmul(s["sx"], s["sy"]))
+        dx = _fsub(_fmul(nf, s["sx2"]), _fmul(s["sx"], s["sx"]))
+        dy = _fsub(_fmul(nf, s["sy2"]), _fmul(s["sy"], s["sy"]))
+        den = E.MathFunc("sqrt", _fmul(dx, dy))
+        # sqrt() NULLs on negative domain; also NULL a zero denominator
+        out = _null_unless(
+            E.Compare(">", den, _flit(0.0)), _fdiv(num, den), T.DOUBLE
+        )
+        return _null_unless(_cnt_ge(s["cnt"], 2), out, T.DOUBLE)
+
+    return ComposedAgg(states=states, finish=finish, dtype=T.DOUBLE)
+
+
+@_register_agg("regr_slope", 2,
+               description="regr_slope(y, x) = covar_pop(y,x)/var_pop(x)")
+def _agg_regr_slope(args):
+    y = _numeric_arg(args[0], "regr_slope")
+    x = _numeric_arg(args[1], "regr_slope")
+    xf = _f64(x)
+    states = _covar_states(y, x) + (
+        ("sx2", "sum", _pair_masked(x, y, _fmul(xf, xf))),
+    )
+
+    def finish(s):
+        nf = _f64(s["cnt"])
+        num = _fsub(_fmul(nf, s["sxy"]), _fmul(s["sx"], s["sy"]))
+        den = _fsub(_fmul(nf, s["sx2"]), _fmul(s["sx"], s["sx"]))
+        out = _null_unless(
+            E.Compare("!=", den, _flit(0.0)), _fdiv(num, den), T.DOUBLE
+        )
+        return _null_unless(_cnt_ge(s["cnt"], 1), out, T.DOUBLE)
+
+    return ComposedAgg(states=states, finish=finish, dtype=T.DOUBLE)
+
+
+@_register_agg("regr_intercept", 2,
+               description="regr_intercept(y, x) = avg(y) - slope*avg(x)")
+def _agg_regr_intercept(args):
+    y = _numeric_arg(args[0], "regr_intercept")
+    x = _numeric_arg(args[1], "regr_intercept")
+    xf = _f64(x)
+    states = _covar_states(y, x) + (
+        ("sx2", "sum", _pair_masked(x, y, _fmul(xf, xf))),
+    )
+
+    def finish(s):
+        nf = _f64(s["cnt"])
+        num = _fsub(_fmul(nf, s["sxy"]), _fmul(s["sx"], s["sy"]))
+        den = _fsub(_fmul(nf, s["sx2"]), _fmul(s["sx"], s["sx"]))
+        slope = _fdiv(num, den)
+        icept = _fdiv(
+            _fsub(s["sy"], _fmul(slope, s["sx"])), nf
+        )
+        out = _null_unless(
+            E.Compare("!=", den, _flit(0.0)), icept, T.DOUBLE
+        )
+        return _null_unless(_cnt_ge(s["cnt"], 1), out, T.DOUBLE)
+
+    return ComposedAgg(states=states, finish=finish, dtype=T.DOUBLE)
+
+
+def _moment_states(x: E.Expr, upto: int):
+    xf = _f64(x)
+    states = [("s1", "sum", xf), ("cnt", "count", xf)]
+    p = xf
+    for k in range(2, upto + 1):
+        p = _fmul(p, xf)
+        states.append((f"s{k}", "sum", p))
+    return tuple(states)
+
+
+@_register_agg("skewness", 1,
+               description="sqrt(n) * m3 / m2^1.5 over central moment "
+               "sums (the reference's AggregationUtils formula)")
+def _agg_skewness(args):
+    x = _numeric_arg(args[0], "skewness")
+
+    def finish(s):
+        nf = _f64(s["cnt"])
+        mean = _fdiv(s["s1"], nf)
+        # central moment SUMS from raw moment sums
+        m2 = _fsub(s["s2"], _fdiv(_fmul(s["s1"], s["s1"]), nf))
+        m3 = _fadd(
+            _fsub(
+                s["s3"],
+                _fmul(_flit(3.0), _fmul(mean, s["s2"])),
+            ),
+            _fmul(_flit(2.0), _fmul(_fmul(mean, mean), s["s1"])),
+        )
+        den = E.MathFunc2("power", m2, _flit(1.5))
+        out = _fdiv(_fmul(E.MathFunc("sqrt", nf), m3), den)
+        out = _null_unless(E.Compare(">", m2, _flit(0.0)), out, T.DOUBLE)
+        return _null_unless(_cnt_ge(s["cnt"], 3), out, T.DOUBLE)
+
+    return ComposedAgg(
+        states=_moment_states(x, 3), finish=finish, dtype=T.DOUBLE
+    )
+
+
+@_register_agg("kurtosis", 1,
+               description="sample excess kurtosis (the reference's "
+               "AggregationUtils formula)")
+def _agg_kurtosis(args):
+    x = _numeric_arg(args[0], "kurtosis")
+
+    def finish(s):
+        nf = _f64(s["cnt"])
+        mean = _fdiv(s["s1"], nf)
+        m2 = _fsub(s["s2"], _fdiv(_fmul(s["s1"], s["s1"]), nf))
+        m3 = _fadd(
+            _fsub(
+                s["s3"], _fmul(_flit(3.0), _fmul(mean, s["s2"]))
+            ),
+            _fmul(_flit(2.0), _fmul(_fmul(mean, mean), s["s1"])),
+        )
+        mean2 = _fmul(mean, mean)
+        m4 = _fadd(
+            _fsub(
+                _fadd(
+                    s["s4"],
+                    _fmul(
+                        _flit(6.0), _fmul(mean2, s["s2"])
+                    ),
+                ),
+                _fmul(_flit(4.0), _fmul(mean, s["s3"])),
+            ),
+            _fmul(_flit(-3.0), _fmul(mean2, _fmul(mean2, nf))),
+        )
+        _ = m3  # m3 not used by kurtosis; kept for clarity of family
+        n1 = _fsub(nf, _flit(1.0))
+        n2 = _fsub(nf, _flit(2.0))
+        n3 = _fsub(nf, _flit(3.0))
+        term = _fdiv(_fmul(nf, _fadd(nf, _flit(1.0))), _fmul(n1, _fmul(n2, n3)))
+        # Σd⁴ / s⁴ with s² = m2/(n-1):  m4 · (n-1)² / m2²
+        core = _fdiv(_fmul(_fmul(n1, n1), m4), _fmul(m2, m2))
+        adj = _fdiv(
+            _fmul(_flit(3.0), _fmul(n1, n1)), _fmul(n2, n3)
+        )
+        out = _fsub(_fmul(term, core), adj)
+        out = _null_unless(E.Compare(">", m2, _flit(0.0)), out, T.DOUBLE)
+        return _null_unless(_cnt_ge(s["cnt"], 4), out, T.DOUBLE)
+
+    return ComposedAgg(
+        states=_moment_states(x, 4), finish=finish, dtype=T.DOUBLE
+    )
+
+
+@_register_agg(
+    "approx_percentile", 2,
+    description="approx_percentile(x, p): exact nearest-rank percentile "
+    "(error 0 <= any qdigest bound); p must be a literal in [0, 1]",
+)
+def _agg_approx_percentile(args):
+    x = _numeric_arg(args[0], "approx_percentile")
+    p = args[1]
+    if not isinstance(p, E.Literal) or p.value is None:
+        raise FunctionError(
+            "approx_percentile percentile must be a numeric literal"
+        )
+    pv = float(p.value)
+    if isinstance(p.value, int) and p.dtype.is_decimal:
+        pv = pv / (10 ** p.dtype.scale)
+    if not (0.0 <= pv <= 1.0):
+        raise FunctionError(
+            f"approx_percentile percentile must be in [0, 1], got {pv}"
+        )
+    return KernelAgg("approx_percentile", x, param=pv)
+
+
+@_register_agg("min_by", 2, description="min_by(x, y): x at minimal y")
+def _agg_min_by(args):
+    return KernelAgg(
+        "min_by", args[0], arg2=_orderable_arg(args[1], "min_by")
+    )
+
+
+@_register_agg("max_by", 2, description="max_by(x, y): x at maximal y")
+def _agg_max_by(args):
+    return KernelAgg(
+        "max_by", args[0], arg2=_orderable_arg(args[1], "max_by")
+    )
+
+
+# ------------------------------------------------------ window registry
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFunction:
+    """One registered window builtin. ``kind`` selects the planner's
+    argument protocol (plan/planner.py::_plan_windows):
+
+    - "rank":  no arguments (row_number/rank/dense_rank/percent_rank/
+               cume_dist) — pure position arithmetic in the kernel
+    - "ntile": one constant bucket-count argument
+    - "nav":   lag/lead — value, optional constant offset + default
+    - "value": first_value/last_value (one value argument) and
+               nth_value (value + constant n)
+    - "agg":   aggregate-over-frame (sum/count/avg/min/max)
+    """
+
+    name: str
+    kind: str
+    description: str = ""
+
+
+WINDOW: Dict[str, WindowFunction] = {}
+
+for _n, _k, _d in (
+    ("row_number", "rank", "1-based row position in partition"),
+    ("rank", "rank", "rank with gaps over the peer groups"),
+    ("dense_rank", "rank", "rank without gaps"),
+    ("percent_rank", "rank", "(rank-1)/(rows-1); 0 for 1-row partitions"),
+    ("cume_dist", "rank", "peers-through-current / partition rows"),
+    ("ntile", "ntile", "ntile(n): n near-equal buckets"),
+    ("lag", "nav", "lag(x[, offset[, default]])"),
+    ("lead", "nav", "lead(x[, offset[, default]])"),
+    ("first_value", "value", "first frame value"),
+    ("last_value", "value", "last frame value"),
+    ("nth_value", "value", "nth_value(x, n): n-th frame row's value"),
+    ("sum", "agg", "running/frame sum"),
+    ("count", "agg", "running/frame count"),
+    ("avg", "agg", "running/frame average"),
+    ("min", "agg", "running/frame minimum"),
+    ("max", "agg", "running/frame maximum"),
+):
+    WINDOW[_n] = WindowFunction(name=_n, kind=_k, description=_d)
+
+
+def is_window(name: str) -> bool:
+    return name in WINDOW

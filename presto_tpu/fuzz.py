@@ -65,7 +65,18 @@ _STR_LITS = {
     "p_container": ["JUMBO BOX", "LG CASE", "SM PKG"],
     "s_name": ["Supplier#000000001"],
 }
-_AGGS = ["count", "sum", "min", "max", "avg"]
+def _registry_aggs() -> List[str]:
+    """Aggregates drawn from the function registry: every entry with a
+    declared fuzz signature has sqlite-oracle-compatible semantics over
+    the numeric columns the generator feeds it."""
+    from presto_tpu import functions as _F
+
+    return sorted(
+        n for n, f in _F.AGGREGATE.items() if f.fuzz is not None
+    )
+
+
+_AGGS = _registry_aggs()
 
 
 def _pick(rng: random.Random, xs):

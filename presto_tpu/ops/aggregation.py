@@ -63,13 +63,23 @@ from presto_tpu.page import Block, Page
 
 @dataclasses.dataclass(frozen=True)
 class AggCall:
-    """One aggregate: func in {count, count_star, sum, min, max, avg,
-    stddev_samp, stddev_pop, var_samp, var_pop, array_agg} (the planner
-    folds the stddev/variance aliases onto the _samp forms)."""
+    """One KERNEL aggregate: func in {count, count_star, sum, min, max,
+    avg, stddev_samp, stddev_pop, var_samp, var_pop, array_agg,
+    approx_percentile, min_by, max_by}.
+
+    Composed aggregates (corr, covar, skewness, checksum, ... —
+    presto_tpu.functions.ComposedAgg) never reach the kernel: the
+    planner lowers them to primitive AggCalls plus a finisher
+    projection, so the kernel surface stays the primitive set.
+
+    ``arg2`` is min_by/max_by's ordering argument; ``param`` is
+    approx_percentile's quantile in [0, 1]."""
 
     func: str
     arg: Optional[Expr]  # None only for count_star
     out_name: str
+    arg2: Optional[Expr] = None
+    param: Optional[float] = None
 
     def result_type(self) -> T.DataType:
         if self.func in ("count", "count_star"):
@@ -87,12 +97,16 @@ class AggCall:
             return T.DOUBLE
         if self.func == "avg":
             return T.DOUBLE
-        if self.func in ("min", "max"):
+        if self.func in ("min", "max", "approx_percentile",
+                         "min_by", "max_by"):
             return t
         raise NotImplementedError(f"aggregate {self.func}")
 
 
 _VARIANCE_FUNCS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
+
+#: aggregates that require the sorted layout (a per-group value order)
+_ORDER_FUNCS = ("array_agg", "approx_percentile", "min_by", "max_by")
 
 
 def _variance_block(
@@ -171,9 +185,10 @@ def hash_aggregate(
     keys = [(name, *lowerer.eval(e), e) for name, e in group_keys]
 
     domains = [_static_domain(e, lowerer) for _, _, _, e in keys]
-    if any(a.func == "array_agg" for a in aggs):
-        # array_agg needs the sorted layout (group spans ARE the
-        # output arrays); skip the one-hot fast path
+    if any(a.func in _ORDER_FUNCS for a in aggs):
+        # these need the sorted layout (array_agg: group spans ARE the
+        # output arrays; percentile/min_by/max_by: a per-group value
+        # ordering); skip the one-hot fast path
         return _sorted_aggregate(
             page, keys, aggs, max_groups, live, lowerer, errors_out
         )
@@ -435,10 +450,15 @@ def _sorted_aggregate(
         )
 
     for agg in aggs:
-        blk = _sorted_one_agg(
-            agg, page, order, live_s, bnd, starts, ends, lowerer,
-            errors_out,
-        )
+        if agg.func in ("approx_percentile", "min_by", "max_by"):
+            blk = _order_stat_agg(
+                agg, page, keys, live, starts, ends, lowerer
+            )
+        else:
+            blk = _sorted_one_agg(
+                agg, page, order, live_s, bnd, starts, ends, lowerer,
+                errors_out,
+            )
         names.append(agg.out_name)
         blocks.append(blk)
 
@@ -448,6 +468,82 @@ def _sorted_aggregate(
         names=tuple(names),
     )
     return out, overflow
+
+
+def _order_stat_agg(
+    agg: AggCall,
+    page: Page,
+    keys,  # ORIGINAL (unsorted) key evals: [(name, d, v, e), ...]
+    live: jnp.ndarray,
+    starts: jnp.ndarray,
+    ends: jnp.ndarray,
+    lowerer: ExprLowerer,
+) -> Block:
+    """approx_percentile / min_by / max_by on the sorted path.
+
+    Each takes its own secondary sort: (group keys, ordering value) —
+    within every group the ordering value's non-null rows form an
+    ascending prefix (sort_order puts value-NULLs after valid values,
+    dead rows after everything). Because the secondary sort is the same
+    stable lexicographic key order, every group occupies the SAME
+    [start, end] span positions as in the primary order, so the caller's
+    spans are reused; only the within-group permutation differs.
+
+    - approx_percentile(x, p): element at nearest rank ceil(p*n) among
+      the group's n valid values (exact — error 0 is within any qdigest
+      bound the reference guarantees; SURVEY.md §2.1 approx family).
+    - min_by(x, y)/max_by(x, y): x gathered at the group's first/last
+      y-valid position (any tie representative, like the reference).
+    """
+    cap = page.capacity
+    is_by = agg.func in ("min_by", "max_by")
+    val = agg.arg2 if is_by else agg.arg
+    vd, vv = lowerer.eval(val)
+    vd = jnp.broadcast_to(vd, (cap,))
+    vvb = None if vv is None else jnp.broadcast_to(vv, (cap,))
+    order2 = sort_order(
+        [(d, v, e.dtype) for _, d, v, e in keys]
+        + [(vd, vvb, val.dtype)],
+        live,
+    )
+    live2 = live[order2]
+    valid2 = live2 if vvb is None else (live2 & vvb[order2])
+    cntv = _cumsum_span(valid2.astype(jnp.int64), starts, ends)
+    group_has = cntv > 0
+
+    if agg.func == "approx_percentile":
+        p = float(agg.param if agg.param is not None else 0.5)
+        k = jnp.clip(
+            jnp.ceil(p * cntv.astype(jnp.float64)).astype(jnp.int64) - 1,
+            0,
+            jnp.maximum(cntv - 1, 0),
+        )
+        idx = jnp.minimum(
+            starts.astype(jnp.int64) + k, cap - 1
+        ).astype(jnp.int32)
+        return Block(
+            data=vd[order2][idx], valid=group_has, dtype=agg.arg.dtype
+        )
+
+    xd, xv = lowerer.eval(agg.arg)
+    xd2 = jnp.broadcast_to(xd, (cap,))[order2]
+    if agg.func == "min_by":
+        idx = starts
+    else:
+        idx = jnp.minimum(
+            starts.astype(jnp.int64) + jnp.maximum(cntv - 1, 0),
+            cap - 1,
+        ).astype(jnp.int32)
+    valid = group_has
+    if xv is not None:
+        valid = valid & jnp.broadcast_to(xv, (cap,))[order2][idx]
+    dictionary = None
+    if agg.arg.dtype.is_string:
+        dictionary = lowerer.dictionary_of(agg.arg)
+    return Block(
+        data=xd2[idx], valid=valid, dtype=agg.arg.dtype,
+        dictionary=dictionary,
+    )
 
 
 def _cumsum_span(
@@ -663,6 +759,46 @@ def _global_one_agg(
             dtype=agg.result_type(),
             dictionary=dictionary,
             offsets=jnp.stack([jnp.int32(0), n]),
+        )
+
+    if agg.func in ("approx_percentile", "min_by", "max_by"):
+        cap = page.capacity
+        is_by = agg.func in ("min_by", "max_by")
+        val = agg.arg2 if is_by else agg.arg
+        vd, vv = lowerer.eval(val)
+        vd = jnp.broadcast_to(vd, (cap,))
+        vvb = None if vv is None else jnp.broadcast_to(vv, (cap,))
+        order = sort_order([(vd, vvb, val.dtype)], live)
+        live_s = live[order]
+        valid_s = live_s if vvb is None else (live_s & vvb[order])
+        cntv = jnp.sum(valid_s).astype(jnp.int64)
+        has = one(cntv > 0)
+        if agg.func == "approx_percentile":
+            p = float(agg.param if agg.param is not None else 0.5)
+            k = jnp.clip(
+                jnp.ceil(p * cntv.astype(jnp.float64)).astype(jnp.int64)
+                - 1,
+                0,
+                jnp.maximum(cntv - 1, 0),
+            )
+            data = one(vd[order][jnp.minimum(k, cap - 1)])
+            return Block(data=data, valid=has, dtype=agg.arg.dtype)
+        xd, xv = lowerer.eval(agg.arg)
+        xd_s = jnp.broadcast_to(xd, (cap,))[order]
+        idx = (
+            jnp.int64(0)
+            if agg.func == "min_by"
+            else jnp.minimum(jnp.maximum(cntv - 1, 0), cap - 1)
+        )
+        valid = cntv > 0
+        if xv is not None:
+            valid = valid & jnp.broadcast_to(xv, (cap,))[order][idx]
+        dictionary = None
+        if agg.arg.dtype.is_string:
+            dictionary = lowerer.dictionary_of(agg.arg)
+        return Block(
+            data=one(xd_s[idx]), valid=one(valid),
+            dtype=agg.arg.dtype, dictionary=dictionary,
         )
 
     d, v = lowerer.eval(agg.arg)

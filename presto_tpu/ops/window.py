@@ -54,8 +54,11 @@ class WindowCall:
         if self.func in ("row_number", "rank", "dense_rank", "count",
                          "ntile"):
             return T.BIGINT
+        if self.func in ("percent_rank", "cume_dist"):
+            return T.DOUBLE
         t = self.arg.dtype
-        if self.func in ("lag", "lead", "first_value", "last_value"):
+        if self.func in ("lag", "lead", "first_value", "last_value",
+                         "nth_value"):
             return t
         if self.func == "sum":
             if t.is_decimal:
@@ -170,7 +173,8 @@ def window(
                 r + (rn0 - big) // jnp.maximum(q, 1),
             ) + 1
             blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
-        elif call.func in ("lag", "lead", "first_value", "last_value"):
+        elif call.func in ("lag", "lead", "first_value", "last_value",
+                           "nth_value"):
             blocks.append(
                 _window_nav(
                     call, page, perm, live_s, safe_pid, part_start,
@@ -182,6 +186,23 @@ def window(
                 peer_start[safe_peer] - part_start[safe_pid] + 1
             ).astype(jnp.int32)
             blocks.append(Block(data=data, valid=None, dtype=T.BIGINT))
+        elif call.func == "percent_rank":
+            # (rank - 1) / (partition rows - 1); 0 for 1-row partitions
+            rank0 = (
+                peer_start[safe_peer] - part_start[safe_pid]
+            ).astype(jnp.float64)
+            denom = (part_cnt[safe_pid] - 1).astype(jnp.float64)
+            data = jnp.where(denom > 0, rank0 / jnp.maximum(denom, 1.0), 0.0)
+            blocks.append(Block(data=data, valid=None, dtype=T.DOUBLE))
+        elif call.func == "cume_dist":
+            # rows with position <= last peer row, over partition rows
+            thru = (
+                peer_end[safe_peer] - part_start[safe_pid] + 1
+            ).astype(jnp.float64)
+            data = thru / jnp.maximum(
+                part_cnt[safe_pid].astype(jnp.float64), 1.0
+            )
+            blocks.append(Block(data=data, valid=None, dtype=T.DOUBLE))
         elif call.func == "dense_rank":
             first_peer_of_part = jax.ops.segment_min(
                 peer_gid, pid, num_segments=nseg
@@ -250,6 +271,13 @@ def _window_nav(
     elif call.func == "first_value":
         src = part_start[safe_pid].astype(jnp.int64)
         in_part = jnp.ones((cap,), jnp.bool_)
+    elif call.func == "nth_value":
+        # n-th row of the frame (default RANGE frame ends at the last
+        # peer row): NULL until the frame has grown past n rows
+        src = part_start[safe_pid].astype(jnp.int64) + jnp.int64(
+            call.offset - 1
+        )
+        in_part = src <= peer_end[safe_peer]
     else:  # last_value: frame ends at the last peer row
         src = peer_end[safe_peer].astype(jnp.int64)
         in_part = jnp.ones((cap,), jnp.bool_)

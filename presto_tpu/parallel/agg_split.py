@@ -6,16 +6,23 @@ hash repartition, with the accumulator's combine function merging
 partial states (SURVEY.md §2.1 "Function registry":
 @CombineFunction; §3.3 HashAggregationOperator).
 
-Here the decomposition is a pure plan rewrite: each AggCall splits into
-a partial call (runs per worker on its shard) and a final merge call
-(runs after the key-hash exchange), plus an optional post-projection
-that reassembles non-linear aggregates (avg = sum/count) from their
-mergeable parts.
+The planner lowers every COMPOSED aggregate (avg, variance family,
+corr, covar, skewness, checksum, ... — functions.ComposedAgg) into
+primitive mergeable states plus a finisher projection ABOVE the
+AggregationNode, so by the time a plan reaches this rewrite the
+aggregate list contains only self-mergeable primitives plus the
+order-sensitive kernel aggregates. The decomposition is therefore a
+tiny table: count/count_star merge by SUM, sum/min/max merge with
+themselves. Order-sensitive kernels (array_agg, approx_percentile,
+min_by, max_by) have no mergeable partial state without carrying the
+full value multiset — they raise, and the scheduler falls back to a
+single-node aggregation (server/scheduler.py catches
+NotImplementedError), exactly like the pre-registry behavior.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from presto_tpu import types as T
 from presto_tpu import expr as E
@@ -36,139 +43,18 @@ def split_aggregation(
     - partial stage: ``hash_aggregate(shard, group_keys, partial_aggs)``
     - exchange: hash-partition partial rows by the key output columns
     - final stage: ``hash_aggregate(routed, final_group_keys, final_aggs)``
-    - post_projs: None when every output column is already exact, else
-      the full ordered projection list (keys + aggregates) with avg
-      reassembled as sum/count.
+    - post_projs: always None now that non-linear aggregates are
+      composed above the aggregation by the planner (kept in the
+      signature for the call sites' unpacking).
     """
     partial_aggs: List[AggCall] = []
     final_aggs: List[AggCall] = []
-    post: List[Tuple[str, E.Expr]] = [
-        (name, E.ColumnRef(name, e.dtype)) for name, e in group_keys
-    ]
-    needs_post = False
 
     final_group_keys = tuple(
         (name, E.ColumnRef(name, e.dtype)) for name, e in group_keys
     )
 
-    for i, a in enumerate(aggs):
-        if a.func == "avg":
-            s_name, c_name = f"$p{i}_sum", f"$p{i}_cnt"
-            p_sum = AggCall("sum", a.arg, s_name)
-            p_cnt = AggCall("count", a.arg, c_name)
-            partial_aggs += [p_sum, p_cnt]
-            sum_t = p_sum.result_type()
-            final_aggs += [
-                AggCall("sum", E.ColumnRef(s_name, sum_t), s_name),
-                AggCall("sum", E.ColumnRef(c_name, T.BIGINT), c_name),
-            ]
-            # avg = sum/count; NULL over empty groups (count = 0)
-            f_sum_t = T.BIGINT if sum_t.is_integer else sum_t
-            sum_ref = E.ColumnRef(s_name, f_sum_t)
-            cnt_ref = E.ColumnRef(c_name, T.BIGINT)
-            division = E.Arithmetic(
-                "/",
-                E.Cast(sum_ref, T.DOUBLE),
-                E.Cast(cnt_ref, T.DOUBLE),
-                T.DOUBLE,
-            )
-            post.append(
-                (
-                    a.out_name,
-                    E.Case(
-                        whens=(
-                            (
-                                E.Compare(
-                                    "=", cnt_ref, E.Literal(0, T.BIGINT)
-                                ),
-                                E.Literal(None, T.DOUBLE),
-                            ),
-                        ),
-                        default=division,
-                        _dtype=T.DOUBLE,
-                    ),
-                )
-            )
-            needs_post = True
-            continue
-
-        if a.func in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
-            # mergeable parts: (Σx, Σx², n) in DOUBLE; the post
-            # projection reassembles the variance exactly as the
-            # single-node kernel does (ops.aggregation._variance_block)
-            s1n, s2n, cn = f"$p{i}_s1", f"$p{i}_s2", f"$p{i}_cnt"
-            xd = E.Cast(a.arg, T.DOUBLE)
-            partial_aggs += [
-                AggCall("sum", xd, s1n),
-                AggCall("sum", E.Arithmetic("*", xd, xd, T.DOUBLE), s2n),
-                AggCall("count", a.arg, cn),
-            ]
-            final_aggs += [
-                AggCall("sum", E.ColumnRef(s1n, T.DOUBLE), s1n),
-                AggCall("sum", E.ColumnRef(s2n, T.DOUBLE), s2n),
-                AggCall("sum", E.ColumnRef(cn, T.BIGINT), cn),
-            ]
-            s1 = E.ColumnRef(s1n, T.DOUBLE)
-            s2 = E.ColumnRef(s2n, T.DOUBLE)
-            cnt_ref = E.ColumnRef(cn, T.BIGINT)
-            nf = E.Cast(cnt_ref, T.DOUBLE)
-            mean = E.Arithmetic("/", s1, nf, T.DOUBLE)
-            var_pop = E.Arithmetic(
-                "-",
-                E.Arithmetic("/", s2, nf, T.DOUBLE),
-                E.Arithmetic("*", mean, mean, T.DOUBLE),
-                T.DOUBLE,
-            )
-            if a.func.endswith("_samp"):
-                nm1 = E.Arithmetic(
-                    "-", nf, E.Literal(1.0, T.DOUBLE), T.DOUBLE
-                )
-                var = E.Arithmetic(
-                    "/",
-                    E.Arithmetic("*", var_pop, nf, T.DOUBLE),
-                    nm1,
-                    T.DOUBLE,
-                )
-                min_n = 2
-            else:
-                var = var_pop
-                min_n = 1
-            # clamp fp cancellation residue: a tiny negative variance
-            # must read as 0, not as a NULLed sqrt domain error
-            var = E.Case(
-                whens=(
-                    (
-                        E.Compare("<", var, E.Literal(0.0, T.DOUBLE)),
-                        E.Literal(0.0, T.DOUBLE),
-                    ),
-                ),
-                default=var,
-                _dtype=T.DOUBLE,
-            )
-            if a.func.startswith("stddev"):
-                var = E.MathFunc("sqrt", var)
-            post.append(
-                (
-                    a.out_name,
-                    E.Case(
-                        whens=(
-                            (
-                                E.Compare(
-                                    "<",
-                                    cnt_ref,
-                                    E.Literal(min_n, T.BIGINT),
-                                ),
-                                E.Literal(None, T.DOUBLE),
-                            ),
-                        ),
-                        default=var,
-                        _dtype=T.DOUBLE,
-                    ),
-                )
-            )
-            needs_post = True
-            continue
-
+    for a in aggs:
         rt = a.result_type()
         if a.func in ("count", "count_star"):
             partial_aggs.append(a)
@@ -188,11 +74,10 @@ def split_aggregation(
             raise NotImplementedError(
                 f"no distributed decomposition for aggregate {a.func}"
             )
-        post.append((a.out_name, E.ColumnRef(a.out_name, rt)))
 
     return (
         tuple(partial_aggs),
         final_group_keys,
         tuple(final_aggs),
-        tuple(post) if needs_post else None,
+        None,
     )

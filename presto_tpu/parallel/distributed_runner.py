@@ -303,9 +303,22 @@ class DistributedQueryRunner(LocalQueryRunner):
             )
             flags.append(ovf)
             return out, "repl"
-        partial_aggs, fkeys, faggs, post = split_aggregation(
-            node.group_keys, node.aggs
-        )
+        try:
+            partial_aggs, fkeys, faggs, post = split_aggregation(
+                node.group_keys, node.aggs
+            )
+        except NotImplementedError:
+            # order-sensitive aggregates (array_agg / approx_percentile
+            # / min_by / max_by) have no mergeable partial state:
+            # replicate the sharded input and aggregate single-node
+            # (same fallback the HTTP scheduler takes —
+            # server/scheduler.py)
+            merged = replicate(src, nw, _AXIS)
+            out, ovf = hash_aggregate(
+                merged, node.group_keys, node.aggs, node.max_groups
+            )
+            flags.append(ovf)
+            return out, "repl"
         if not node.group_keys:
             part_pg, _ = hash_aggregate(src, (), partial_aggs, 1)
             merged = replicate(part_pg, nw, _AXIS)

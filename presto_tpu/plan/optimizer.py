@@ -335,6 +335,8 @@ def prune_columns(node: N.PlanNode, required: Optional[Set[str]] = None):
         for a in node.aggs:
             if a.arg is not None:
                 _expr_columns(a.arg, need)
+            if a.arg2 is not None:  # min_by/max_by ordering argument
+                _expr_columns(a.arg2, need)
         return dataclasses.replace(
             node, source=prune_columns(node.source, need)
         )

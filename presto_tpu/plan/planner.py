@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from presto_tpu import types as T
 from presto_tpu import expr as E
+from presto_tpu import functions
 from presto_tpu.connectors.spi import TableHandle
 from presto_tpu.exec.staging import bucket_capacity
 from presto_tpu.ops.aggregation import AggCall
@@ -128,20 +129,11 @@ class Scope:
         raise PlanningError(f"column not found: {'.'.join(parts)}")
 
 
-AGG_FUNCS = {
-    "sum", "count", "avg", "min", "max",
-    "stddev", "stddev_samp", "stddev_pop",
-    "variance", "var_samp", "var_pop",
-    # registry aliases (functions.AGGREGATE_ALIASES) + approx_distinct
-    # (plans as the exact count(DISTINCT x) rewrite, error 0)
-    "approx_distinct", "arbitrary", "any_value",
-    "bool_and", "bool_or", "every",
-    "array_agg",
-}
-NAV_WINDOW_FUNCS = {"lag", "lead", "first_value", "last_value", "ntile"}
-WINDOW_FUNCS = (
-    {"row_number", "rank", "dense_rank"} | NAV_WINDOW_FUNCS | AGG_FUNCS
-)
+# Aggregate and window builtins resolve through the declarative
+# registry (presto_tpu.functions.AGGREGATE / .WINDOW) — the reference's
+# FunctionAndTypeManager seam. Adding an aggregate or window function
+# touches only functions.py (and, for new KERNEL accumulators, the
+# ops kernel); the planner has no builtin name lists of its own.
 
 
 def plan_statement(
@@ -1614,7 +1606,7 @@ class _Planner:
 
     def _contains_agg(self, e: ast.Node) -> bool:
         if isinstance(e, ast.FuncCall):
-            if e.window is None and e.name in AGG_FUNCS:
+            if e.window is None and functions.is_aggregate(e.name):
                 return True
         return any(
             self._contains_agg(c) for c in _ast_children(e)
@@ -1626,7 +1618,11 @@ class _Planner:
         return any(self._contains_window(c) for c in _ast_children(e))
 
     def _collect_aggs(self, e: ast.Node, out: List[ast.FuncCall]):
-        if isinstance(e, ast.FuncCall) and e.window is None and e.name in AGG_FUNCS:
+        if (
+            isinstance(e, ast.FuncCall)
+            and e.window is None
+            and functions.is_aggregate(e.name)
+        ):
             if e not in out:
                 out.append(e)
             return
@@ -1780,22 +1776,34 @@ class _Planner:
         return agg_node, out_scope, agg_map
 
     def _plain_agg_node(self, node, group_keys, agg_calls, scope):
-        from presto_tpu.functions import AGGREGATE_ALIASES
-
+        """Lower aggregate calls through the function registry
+        (functions.AGGREGATE — the reference's FunctionAndTypeManager
+        resolution seam). Kernel aggregates become AggCalls directly;
+        COMPOSED aggregates (avg, variance family, corr, ... —
+        functions.ComposedAgg) become their primitive mergeable state
+        AggCalls plus a finisher projection stacked on the aggregation
+        (the reference's accumulator/output split), so the kernel and
+        the distributed partial/final rewrite only ever see
+        self-mergeable primitives."""
         aggs: List[AggCall] = []
         agg_map: Dict[ast.Node, str] = {}
-        alias = {
-            "stddev": "stddev_samp",
-            "variance": "var_samp",
-            **AGGREGATE_ALIASES,
-        }
+        #: ordered final outputs: (name, finish_expr|None, dtype|None)
+        outputs: List[Tuple[str, Optional[E.Expr]]] = []
+        any_composed = False
         for a in agg_calls:
             out_name = self._fresh("agg")
             if a.name == "count" and not a.args:
                 aggs.append(AggCall("count_star", None, out_name))
-            else:
-                arg = self._lower(a.args[0], scope)
-                if arg.dtype.is_long_decimal and a.name != "count":
+                outputs.append((out_name, None))
+                agg_map[a] = out_name
+                continue
+            args = [self._lower(x, scope) for x in a.args]
+            for arg in args:
+                # count ignores the value; checksum hashes the (hi, lo)
+                # limb pair directly (expr.ValueHash long-decimal path)
+                if arg.dtype.is_long_decimal and a.name not in (
+                    "count", "checksum",
+                ):
                     raise PlanningError(
                         f"{a.name}() over {arg.dtype} is not supported: "
                         "long-decimal accumulators are a documented "
@@ -1803,16 +1811,48 @@ class _Planner:
                         ">18-digit decimals) — cast to decimal(18,s) "
                         "or double to aggregate"
                     )
+            try:
+                low = functions.lower_aggregate(a.name, args)
+            except functions.FunctionError as err:
+                raise PlanningError(str(err)) from None
+            if isinstance(low, functions.KernelAgg):
                 aggs.append(
-                    AggCall(alias.get(a.name, a.name), arg, out_name)
+                    AggCall(
+                        low.func, low.arg, out_name,
+                        arg2=low.arg2, param=low.param,
+                    )
                 )
+                outputs.append((out_name, None))
+            else:  # ComposedAgg: primitive states + finisher expr
+                any_composed = True
+                refs: Dict[str, E.Expr] = {}
+                for suffix, prim, sexpr in low.states:
+                    sname = f"{out_name}${suffix}"
+                    aggs.append(AggCall(prim, sexpr, sname))
+                    refs[suffix] = E.ColumnRef(
+                        sname, functions.agg_state_type(prim, sexpr)
+                    )
+                outputs.append((out_name, (low.finish(refs), low.dtype)))
             agg_map[a] = out_name
-        agg_node = N.AggregationNode(
+        agg_node: N.PlanNode = N.AggregationNode(
             source=node,
             group_keys=tuple(group_keys),
             aggs=tuple(aggs),
             max_groups=self._agg_bucket(node) if group_keys else 1,
         )
+        if any_composed:
+            projs: List[Tuple[str, E.Expr]] = [
+                (n, E.ColumnRef(n, e.dtype)) for n, e in group_keys
+            ]
+            for name, fin in outputs:
+                if fin is None:
+                    dt = dict(agg_node.output_schema())[name]
+                    projs.append((name, E.ColumnRef(name, dt)))
+                else:
+                    projs.append((name, fin[0]))
+            agg_node = N.ProjectNode(
+                source=agg_node, projections=tuple(projs)
+            )
         return agg_node, agg_map
 
     def _post_agg_scope(self, agg_node, scope) -> Scope:
@@ -1868,16 +1908,39 @@ class _Planner:
             wcalls = []
             for f in fns:
                 out_name = self._fresh("win")
-                if f.name in ("row_number", "rank", "dense_rank"):
+                wf = functions.WINDOW.get(f.name)
+                if wf is None:
+                    raise PlanningError(
+                        f"{f.name}() is not a window function"
+                    )
+                if wf.kind == "rank":
+                    if f.args:
+                        raise PlanningError(
+                            f"{f.name}() takes no arguments"
+                        )
                     wcalls.append(WindowCall(f.name, None, out_name))
                 elif f.name == "count" and not f.args:
                     wcalls.append(WindowCall("count", None, out_name))
-                elif f.name == "ntile":
+                elif wf.kind == "ntile":
                     n = self._const_int(f.args[0], "ntile bucket count")
                     wcalls.append(
                         WindowCall("ntile", None, out_name, offset=n)
                     )
-                elif f.name in ("lag", "lead"):
+                elif f.name == "nth_value":
+                    if len(f.args) != 2:
+                        raise PlanningError(
+                            "nth_value() takes two arguments"
+                        )
+                    arg = lower_w(f.args[0])
+                    n = self._const_int(f.args[1], "nth_value offset")
+                    if n < 1:
+                        raise PlanningError(
+                            "nth_value offset must be >= 1"
+                        )
+                    wcalls.append(
+                        WindowCall("nth_value", arg, out_name, offset=n)
+                    )
+                elif wf.kind == "nav":
                     arg = lower_w(f.args[0])
                     off = (
                         self._const_int(f.args[1], f"{f.name} offset")
@@ -1912,13 +1975,11 @@ class _Planner:
                         )
                     )
                 else:
-                    if f.name not in (
-                        "sum", "count", "avg", "min", "max",
-                        "first_value", "last_value",
-                    ):
+                    # "value" (first_value/last_value) and "agg" kinds:
+                    # one value argument over the frame
+                    if not f.args:
                         raise PlanningError(
-                            f"{f.name}() is not supported as a window "
-                            "function"
+                            f"{f.name}() requires an argument"
                         )
                     arg = lower_w(f.args[0])
                     wcalls.append(WindowCall(f.name, arg, out_name))
@@ -2071,7 +2132,7 @@ class _Planner:
                 raise PlanningError(
                     "window function in an unsupported position"
                 )
-            if e.name in AGG_FUNCS:
+            if functions.is_aggregate(e.name):
                 raise PlanningError(
                     f"aggregate {e.name}() in an unsupported position"
                 )

@@ -408,15 +408,23 @@ class CoordinatorServer:
         in the given root SortNode so workers emit sorted runs, and
         k-way merge the runs at the gather instead of re-sorting. The
         caller guarantees the stage has no aggregation cut."""
+        jdt = str(
+            self.local.session.get("join_distribution_type")
+        ).upper()
         if (
             order_by is None
             and len(workers) > 1
-            and str(
-                self.local.session.get("join_distribution_type")
-            ).upper()
-            == "PARTITIONED"
+            and jdt in ("PARTITIONED", "AUTOMATIC", "AUTO")
         ):
-            out = self._run_join_partitioned(fragment_root, workers, q)
+            # PARTITIONED forces the hash-partitioned stage for every
+            # qualifying join; AUTOMATIC chooses it per join from stats
+            # (reference: AddExchanges' cost-driven distribution choice)
+            # — partitioned only when BOTH sides exceed the broadcast
+            # bound, so small-table plans keep the replicated fast path
+            out = self._run_join_partitioned(
+                fragment_root, workers, q,
+                auto=jdt != "PARTITIONED",
+            )
             if out is not None:
                 return out
         if stage is None:
@@ -539,8 +547,10 @@ class CoordinatorServer:
         pages = [page] + [self.local._load_table(s) for s in local_scans]
         return self.local._run_with_pages(stage.final_root, leaves, pages)
 
-    def _run_join_partitioned(self, fragment_root, workers, q: _Query):
-        """Hash-partitioned intermediate JOIN stage (reference:
+    def _run_join_partitioned(
+        self, fragment_root, workers, q: _Query, auto: bool = False
+    ):
+        """Hash-partitioned intermediate JOIN stages (reference:
         FIXED_HASH_DISTRIBUTION intermediate stages — SURVEY.md §2.4
         "Join distribution choice"): BOTH join inputs run as
         partitioned producer stages that hash their output by the
@@ -551,16 +561,96 @@ class CoordinatorServer:
         sides (value-stable hash), so per-partition joins partition the
         full join.
 
-        Applies when the session forces
-        ``join_distribution_type=PARTITIONED`` and a join's two sides
-        each admit a cut-free source-partitioned stage; returns None
-        otherwise (caller falls through to the replicated-build path).
+        ``auto=False`` (session ``join_distribution_type=PARTITIONED``)
+        takes every qualifying join — one whose two sides each admit a
+        cut-free source-partitioned stage. ``auto=True`` (AUTOMATIC)
+        additionally requires BOTH sides' estimated rows to exceed
+        ``join_max_broadcast_rows``, the engine's form of the
+        reference's stats-driven AddExchanges choice: when one side is
+        small, replicating it (the caller's fallback path) ships less
+        data than repartitioning both. Qualifying joins are taken
+        best-first (largest min-side estimate — where broadcast would
+        hurt most) and ITERATED: independent joins elsewhere in the
+        plan each get their own partitioned stage, their result pages
+        feeding the final local splice. Returns None when no join
+        qualifies (caller falls through to the replicated-build path).
         """
-        from concurrent.futures import ThreadPoolExecutor
+        thresh = (
+            int(self.local.session.get("join_max_broadcast_rows"))
+            if auto
+            else None
+        )
+        root = fragment_root
+        pages_map: Dict[int, object] = {}
+        ran = False
+        while True:
+            target = self._choose_partitioned_join(root, thresh)
+            if target is None:
+                break
+            J, side_stages = target
+            page = self._run_one_partitioned_join(
+                J, side_stages, workers, q
+            )
+            ran = True
+            if J is root and not pages_map:
+                return page
+            remote = N.RemoteSourceNode(fragment_root=J)
+            from presto_tpu.server.scheduler import (
+                _path_to,
+                _replace_on_path,
+            )
 
-        target = None
-        for J in N.walk(fragment_root):
-            if not isinstance(J, N.JoinNode):
+            path = _path_to(root, J)
+            root = _replace_on_path(path[:-1], J, remote)
+            pages_map[id(remote)] = page
+        if not ran:
+            return None
+        leaves, pages = self.local.leaf_pages(root, pages_map)
+        return self.local._run_with_pages(root, leaves, pages)
+
+    def _choose_partitioned_join(self, root, thresh: Optional[int]):
+        """Best qualifying join for a partitioned stage, or None.
+
+        Qualifying: an equi-join whose sides BOTH admit cut-free
+        source-partitioned stages. With ``thresh`` (AUTOMATIC mode) the
+        min-side row estimate must exceed it, and candidates rank by
+        that estimate — the join where replicating the smaller side
+        would ship the most rows wins first."""
+        from presto_tpu.plan import optimizer
+
+        best = None
+        best_score = -1.0
+        for J in N.walk(root):
+            if not isinstance(J, N.JoinNode) or not J.left_keys:
+                continue
+            # a side spliced with a prior iteration's materialized
+            # RemoteSourceNode cannot run as a producer stage (workers
+            # have no way to resolve the remote page) — skip before any
+            # stage planning
+            if any(
+                isinstance(n, N.RemoteSourceNode)
+                for side in (J.left, J.right)
+                for n in N.walk(side)
+            ):
+                continue
+            if thresh is not None:
+                # cheap stats gate BEFORE the stage-planning work: in
+                # the default AUTOMATIC mode most joins are small and
+                # exit here without paying plan_stage
+                small = min(
+                    optimizer.estimate_rows(
+                        J.left, self.local.catalogs
+                    ),
+                    optimizer.estimate_rows(
+                        J.right, self.local.catalogs
+                    ),
+                )
+                if small <= thresh:
+                    continue
+                score = float(small)
+            else:
+                score = 0.0
+            if best is not None and score <= best_score:
                 continue
             stages = []
             for side in (J.left, J.right):
@@ -571,12 +661,16 @@ class CoordinatorServer:
                     stages = None
                     break
                 stages.append(st)
-            if stages:
-                target = (J, stages)
-                break
-        if target is None:
-            return None
-        J, side_stages = target
+            if not stages:
+                continue
+            best, best_score = (J, stages), score
+        return best
+
+    def _run_one_partitioned_join(self, J, side_stages, workers, q):
+        """Run ONE join as producer stages + a partitioned join stage;
+        returns the gathered join output page."""
+        from concurrent.futures import ThreadPoolExecutor
+
         REGISTRY.counter("coordinator.partitioned_join_stages").update()
         nparts = len(workers)
         over = max(1, int(self.local.session.get("split_queue_factor")))
@@ -681,21 +775,7 @@ class CoordinatorServer:
             merged = {
                 nm: np.empty(0, t.np_dtype) for nm, t in schema.items()
             }
-        page = stage_page(merged, schema)
-        if J is fragment_root:
-            return page
-        remote = N.RemoteSourceNode(fragment_root=J)
-        from presto_tpu.server.scheduler import (
-            _path_to,
-            _replace_on_path,
-        )
-
-        path = _path_to(fragment_root, J)
-        rest_root = _replace_on_path(path[:-1], J, remote)
-        leaves, pages = self.local.leaf_pages(
-            rest_root, {id(remote): page}
-        )
-        return self.local._run_with_pages(rest_root, leaves, pages)
+        return stage_page(merged, schema)
 
     def _run_stage_shuffled(
         self, stage, workers, q: _Query, key_names, bucket_root, rest_root

