@@ -113,6 +113,11 @@ STREET_W2 = [
 ]
 STORE_NAMES = ["able", "anti", "ation", "bar", "cally", "eing", "ese",
                "n st", "ought", "pri"]
+COUNTIES = [
+    "Barrow County", "Bronx County", "Daviess County", "Fairfield County",
+    "Franklin Parish", "Luce County", "Mobile County", "Richland County",
+    "Walker County", "Williamson County",
+]
 COMPANIES = ["pri", "able", "ese", "anti", "cally", "ation"]
 CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
               "Men", "Music", "Shoes", "Sports", "Women"]
@@ -217,6 +222,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "d_dow": T.INTEGER,
         "d_qoy": T.INTEGER,
         "d_day_name": T.VARCHAR,
+        "d_month_seq": T.INTEGER,
+        "d_week_seq": T.INTEGER,
     },
     "income_band": {
         "ib_income_band_sk": T.INTEGER,
@@ -307,6 +314,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "s_state": T.VARCHAR,
         "s_zip": T.VARCHAR,
         "s_number_employees": T.INTEGER,
+        "s_company_name": T.VARCHAR,
+        "s_county": T.VARCHAR,
     },
     "promotion": {
         "p_promo_sk": T.INTEGER,
@@ -331,6 +340,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "i_manufact_id": T.INTEGER,
         "i_manufact": T.VARCHAR,
         "i_manager_id": T.INTEGER,
+        "i_wholesale_cost": D7_2,
     },
     "customer": {
         "c_customer_sk": T.INTEGER,
@@ -343,6 +353,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "c_first_sales_date_sk": T.INTEGER,
         "c_first_shipto_date_sk": T.INTEGER,
         "c_birth_year": T.INTEGER,
+        "c_salutation": T.VARCHAR,
+        "c_preferred_cust_flag": T.VARCHAR,
     },
     "customer_address": {
         "ca_address_sk": T.INTEGER,
@@ -351,6 +363,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ca_city": T.VARCHAR,
         "ca_state": T.VARCHAR,
         "ca_zip": T.VARCHAR,
+        "ca_county": T.VARCHAR,
+        "ca_gmt_offset": T.INTEGER,
     },
     "store_sales": {
         "ss_sold_date_sk": T.INTEGER,
@@ -377,6 +391,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "sr_item_sk": T.INTEGER,
         "sr_ticket_number": T.INTEGER,
         "sr_return_amt": D7_2,
+        "sr_store_sk": T.INTEGER,
+        "sr_customer_sk": T.INTEGER,
     },
     "catalog_sales": {
         "cs_sold_date_sk": T.INTEGER,
@@ -395,6 +411,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cs_coupon_amt": D7_2,
         "cs_ext_list_price": D7_2,
         "cs_ext_sales_price": D7_2,
+        "cs_bill_hdemo_sk": T.INTEGER,
     },
     "catalog_returns": {
         "cr_returned_date_sk": T.INTEGER,
@@ -416,6 +433,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ws_ext_ship_cost": D7_2,
         "ws_ext_sales_price": D7_2,
         "ws_net_profit": D7_2,
+        "ws_bill_customer_sk": T.INTEGER,
+        "ws_bill_addr_sk": T.INTEGER,
     },
     "web_returns": {
         "wr_returned_date_sk": T.INTEGER,
@@ -469,6 +488,17 @@ class TpcdsGenerator:
                      "Thursday", "Friday", "Saturday"],
                     (days + 4) % 7,  # 1970-01-01 was a Thursday
                 )
+            elif c == "d_month_seq":
+                # monotone month counter (the official dimension's
+                # sequence anchor differs; queries only ever use
+                # RANGES of it, which are translation-invariant)
+                out[c] = np.asarray(
+                    [(d.year - 1900) * 12 + d.month - 1 for d in dates],
+                    np.int64,
+                )
+            elif c == "d_week_seq":
+                # monotone week counter, Sunday-aligned like d_dow
+                out[c] = (days + 4) // 7
         return out
 
     def _date_sk_for(self, days: np.ndarray) -> np.ndarray:
@@ -700,6 +730,10 @@ class TpcdsGenerator:
                 out[c] = _fixed(STATES, rows % len(STATES))
             elif c == "s_zip":
                 out[c] = _fixed(_ZIPS, rows % len(_ZIPS))
+            elif c == "s_company_name":
+                out[c] = _fixed(["Unknown", "ought"], rows % 2)
+            elif c == "s_county":
+                out[c] = _fixed(COUNTIES, rows % len(COUNTIES))
         return out
 
     def _gen_promotion(self, rows, columns):
@@ -768,6 +802,10 @@ class TpcdsGenerator:
                 out[c] = _numbered("manufact", 1000, manufact)
             elif c == "i_manager_id":
                 out[c] = _uniform(1408, rows, 1, 100)
+            elif c == "i_wholesale_cost":
+                # 20.00..80.00, independent of i_current_price like the
+                # official generator's separate draw
+                out[c] = _uniform(1410, rows, 2000, 8000)
         return out
 
     def _gen_customer(self, rows, columns):
@@ -808,6 +846,13 @@ class TpcdsGenerator:
                 )
             elif c == "c_birth_year":
                 out[c] = _uniform(1506, rows, 1930, 1990)
+            elif c == "c_salutation":
+                out[c] = _fixed(
+                    ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir", "Miss"],
+                    _uniform(1509, rows, 0, 5),
+                )
+            elif c == "c_preferred_cust_flag":
+                out[c] = _fixed(["N", "Y"], _uniform(1510, rows, 0, 1))
         return out
 
     def _gen_customer_address(self, rows, columns):
@@ -834,6 +879,16 @@ class TpcdsGenerator:
                 out[c] = _fixed(
                     _ZIPS, _uniform(1605, rows, 0, len(_ZIPS) - 1)
                 )
+            elif c == "ca_county":
+                out[c] = _fixed(
+                    COUNTIES, _uniform(1606, rows, 0, len(COUNTIES) - 1)
+                )
+            elif c == "ca_gmt_offset":
+                # continental offsets; -5 is the modal official
+                # substitution value so it must select a real slice
+                out[c] = np.asarray([-5, -5, -6, -7, -8], np.int64)[
+                    _uniform(1607, rows, 0, 4)
+                ]
         return out
 
     # -- fact tables --------------------------------------------------
@@ -922,6 +977,12 @@ class TpcdsGenerator:
                 out[c] = f["ticket"]
             elif c == "sr_return_amt":
                 out[c] = _uniform(1802, rows, 100, 10000)
+            elif c == "sr_store_sk":
+                # SAME closed form store_sales evaluates at the source
+                # row: the (ticket, item) FK pair stays store-consistent
+                out[c] = _uniform(1708, src, 1, self.counts["store"])
+            elif c == "sr_customer_sk":
+                out[c] = _uniform(1704, src, 1, self.counts["customer"])
         return out
 
     def _cs_fields(self, rows):
@@ -979,6 +1040,10 @@ class TpcdsGenerator:
                 out[c] = _uniform(1905, rows, 10000, 100000)
             elif c == "cs_ext_sales_price":
                 out[c] = _uniform(1916, rows, 100, 30000)
+            elif c == "cs_bill_hdemo_sk":
+                out[c] = _uniform(
+                    1920, rows, 1, cn["household_demographics"]
+                )
         return out
 
     def _gen_catalog_returns(self, rows, columns):
@@ -1046,6 +1111,15 @@ class TpcdsGenerator:
                 out[c] = _uniform(2107, rows, 100, 10000)
             elif c == "ws_net_profit":
                 out[c] = _uniform(2108, rows, -5000, 20000)
+            elif c == "ws_bill_customer_sk":
+                # drawn from the ORDER number, not the row: every line
+                # of an order bills the same customer (q38/q87/q97
+                # count distinct customers per channel)
+                out[c] = _uniform(2111, f["order"], 1, cn["customer"])
+            elif c == "ws_bill_addr_sk":
+                out[c] = _uniform(
+                    2112, f["order"], 1, cn["customer_address"]
+                )
         return out
 
     def _gen_web_returns(self, rows, columns):

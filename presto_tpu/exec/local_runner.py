@@ -77,7 +77,11 @@ class QueryResult:
 class LocalQueryRunner:
     """Parse -> analyze/plan -> optimize -> one-jit-program execution."""
 
-    MAX_RETRIES = 4
+    # each retry scales capacity buckets 4x, so 6 tries = up to 1024x
+    # over the initial estimate — stats-less derived relations (CTE
+    # self-joins on 5 keys, q47-class) can be orders of magnitude under
+    # the true fan-out before the residual filter prunes it
+    MAX_RETRIES = 6
 
     def __init__(
         self,

@@ -1108,18 +1108,18 @@ class _Planner:
         if (
             isinstance(inner, ast.BinaryOp)
             and inner.op in ("=", "<>", "!=", "<", "<=", ">", ">=")
-            and (
-                isinstance(inner.left, ast.ScalarSubquery)
-                or isinstance(inner.right, ast.ScalarSubquery)
-            )
             and not negate
         ):
-            sub = (
-                inner.left
-                if isinstance(inner.left, ast.ScalarSubquery)
-                else inner.right
-            )
-            if self._is_correlated(sub.query, scope):
+            # the subquery may sit anywhere inside the comparison
+            # (q6-class: i_current_price > 1.2 * (select avg(...))) —
+            # exactly one CORRELATED ScalarSubquery qualifies;
+            # uncorrelated siblings keep lowering via Param
+            subs = [
+                s
+                for s in _find_scalar_subqueries(inner)
+                if self._is_correlated(s.query, scope)
+            ]
+            if len(subs) == 1:
                 return ("scalar_cmp", inner, False)
             return None  # uncorrelated: handled by Param in _lower
         return None
@@ -1489,10 +1489,11 @@ class _Planner:
         return node, scope
 
     def _apply_correlated_scalar(self, node, scope, cmp: ast.BinaryOp):
-        sub = (
-            cmp.left if isinstance(cmp.left, ast.ScalarSubquery) else cmp.right
+        (sub,) = (
+            s
+            for s in _find_scalar_subqueries(cmp)
+            if self._is_correlated(s.query, scope)
         )
-        other_ast = cmp.right if sub is cmp.left else cmp.left
         q = sub.query
         corr_pairs, residual_where = self._extract_correlation(q, scope)
         if not corr_pairs:
@@ -1531,12 +1532,10 @@ class _Planner:
         )
         sch = node.output_schema()
         scope = Scope(dict(sch), scope.qualifiers, scope.parent)
-        val_ref = E.ColumnRef(val_col, sch[val_col])
-        other = self._lower(other_ast, scope)
-        if sub is cmp.left:
-            pred = E.Compare(cmp.op, val_ref, other)
-        else:
-            pred = E.Compare(cmp.op, other, val_ref)
+        # lower the WHOLE comparison with the subquery ast mapped to
+        # the joined value column (agg_map doubles as an ast->column
+        # substitution), so arithmetic around the subquery just works
+        pred = self._lower(cmp, scope, agg_map={sub: val_col})
         return N.FilterNode(node, pred), scope
 
     def _extract_correlation(
@@ -1849,7 +1848,13 @@ class _Planner:
                     dt = dict(agg_node.output_schema())[name]
                     projs.append((name, E.ColumnRef(name, dt)))
                 else:
-                    projs.append((name, fin[0]))
+                    fexpr, fdtype = fin
+                    # the registry's declared dtype is the contract;
+                    # coerce a mismatched finisher rather than letting
+                    # the drift ship silently
+                    if fexpr.dtype != fdtype:
+                        fexpr = E.Cast(fexpr, fdtype)
+                    projs.append((name, fexpr))
             agg_node = N.ProjectNode(
                 source=agg_node, projections=tuple(projs)
             )
@@ -2285,6 +2290,22 @@ def _ast_children(e: ast.Node):
                             y, ast.Select
                         ):
                             yield y
+
+
+def _find_scalar_subqueries(e: ast.Node) -> List["ast.ScalarSubquery"]:
+    """All ScalarSubquery nodes in an expression (not descending into
+    them — nesting belongs to the inner query's own planning)."""
+    out: List[ast.ScalarSubquery] = []
+
+    def walk(n):
+        if isinstance(n, ast.ScalarSubquery):
+            out.append(n)
+            return
+        for c in _ast_children(n):
+            walk(c)
+
+    walk(e)
+    return out
 
 
 def _split_conjuncts(e: ast.Node) -> List[ast.Node]:

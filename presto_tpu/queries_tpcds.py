@@ -512,4 +512,530 @@ OFFICIAL = {
         group by i_item_id, i_item_desc, i_current_price
         order by i_item_id
         limit 100""",
+    # Q15: catalog revenue by customer zip for one quarter (zip-prefix
+    # OR state OR big-ticket filter)
+    "q15": f"""
+        select ca_zip, sum(cs_sales_price) as sum_sales
+        from {S}.catalog_sales, {S}.customer, {S}.customer_address,
+             {S}.date_dim
+        where cs_bill_customer_sk = c_customer_sk
+          and c_current_addr_sk = ca_address_sk
+          and (substring(ca_zip, 1, 5) in
+                 ('85669','86197','88274','83405','86475',
+                  '85392','85460','80348','81792')
+               or ca_state in ('CA','WA','GA')
+               or cs_sales_price > 500)
+          and cs_sold_date_sk = d_date_sk
+          and d_qoy = 2 and d_year = 1999
+        group by ca_zip
+        order by ca_zip
+        limit 100""",
+    # Q21: warehouse inventory ratio before/after a pivot date for a
+    # price band of items
+    "q21": f"""
+        select w_warehouse_name, i_item_id,
+               sum(case when d_date < date '1999-06-01'
+                        then inv_quantity_on_hand else 0 end)
+                 as inv_before,
+               sum(case when d_date >= date '1999-06-01'
+                        then inv_quantity_on_hand else 0 end)
+                 as inv_after
+        from {S}.inventory, {S}.warehouse, {S}.item, {S}.date_dim
+        where i_current_price between 50 and 60
+          and i_item_sk = inv_item_sk
+          and inv_warehouse_sk = w_warehouse_sk
+          and inv_date_sk = d_date_sk
+          and d_date between date '1999-06-01' - interval '30' day
+                         and date '1999-06-01' + interval '30' day
+        group by w_warehouse_name, i_item_id
+        having case when sum(case when d_date < date '1999-06-01'
+                                  then inv_quantity_on_hand else 0 end)
+                         > 0
+                    then cast(sum(case when d_date >= date '1999-06-01'
+                                       then inv_quantity_on_hand
+                                       else 0 end) as double)
+                         / cast(sum(case when d_date < date '1999-06-01'
+                                         then inv_quantity_on_hand
+                                         else 0 end) as double)
+                    else null end between 0.666667 and 1.5
+        order by w_warehouse_name, i_item_id
+        limit 100""",
+    # Q40: catalog sales net of returns by warehouse state, before and
+    # after a pivot date (left join to returns on order+item)
+    "q40": f"""
+        select w_state, i_item_id,
+               sum(case when d_date < date '1999-06-01'
+                        then cs_sales_price
+                             - coalesce(cr_refunded_cash, 0)
+                        else 0 end) as sales_before,
+               sum(case when d_date >= date '1999-06-01'
+                        then cs_sales_price
+                             - coalesce(cr_refunded_cash, 0)
+                        else 0 end) as sales_after
+        from {S}.catalog_sales
+             left outer join {S}.catalog_returns
+               on (cs_order_number = cr_order_number
+                   and cs_item_sk = cr_item_sk),
+             {S}.warehouse, {S}.item, {S}.date_dim
+        where i_current_price between 55 and 60
+          and i_item_sk = cs_item_sk
+          and cs_warehouse_sk = w_warehouse_sk
+          and cs_sold_date_sk = d_date_sk
+          and d_date between date '1999-06-01' - interval '30' day
+                         and date '1999-06-01' + interval '30' day
+        group by w_state, i_item_id
+        order by w_state, i_item_id
+        limit 100""",
+    # Q46: weekend sales tickets by demographic slice where the bought
+    # city differs from the customer's current city
+    "q46": f"""
+        select c_last_name, c_first_name, ca_city, bought_city,
+               ss_ticket_number, amt, profit
+        from (select ss_ticket_number, ss_customer_sk,
+                     ca_city as bought_city,
+                     sum(ss_coupon_amt) as amt,
+                     sum(ss_net_profit) as profit
+              from {S}.store_sales, {S}.date_dim, {S}.store,
+                   {S}.household_demographics, {S}.customer_address
+              where ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and ss_addr_sk = ca_address_sk
+                and (household_demographics.hd_dep_count = 5
+                     or household_demographics.hd_vehicle_count = 3)
+                and d_dow in (6, 0)
+                and d_year in (1999, 2000, 2001)
+                and s_city in ('Antioch', 'Bridgeport')
+              group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                       ca_city) dn,
+             {S}.customer, {S}.customer_address current_addr
+        where ss_customer_sk = c_customer_sk
+          and customer.c_current_addr_sk = current_addr.ca_address_sk
+          and current_addr.ca_city <> bought_city
+        order by c_last_name, c_first_name, ca_city, bought_city,
+                 ss_ticket_number
+        limit 100""",
+    # Q48: quantity sold under OR'd demographic x price and
+    # address x profit bands (the join equalities factored out of the
+    # OR groups — distributively identical to the official template)
+    "q48": f"""
+        select sum(ss_quantity) as total_quantity
+        from {S}.store_sales, {S}.store, {S}.customer_demographics,
+             {S}.customer_address, {S}.date_dim
+        where s_store_sk = ss_store_sk
+          and ss_sold_date_sk = d_date_sk and d_year = 1999
+          and cd_demo_sk = ss_cdemo_sk
+          and ((cd_marital_status = 'M'
+                and cd_education_status = '4 yr Degree'
+                and ss_sales_price between 100.00 and 150.00)
+            or (cd_marital_status = 'D'
+                and cd_education_status = '2 yr Degree'
+                and ss_sales_price between 50.00 and 100.00)
+            or (cd_marital_status = 'S'
+                and cd_education_status = 'College'
+                and ss_sales_price between 150.00 and 200.00))
+          and ss_addr_sk = ca_address_sk
+          and ((ca_state in ('CO', 'OH', 'TX')
+                and ss_net_profit between 0 and 2000)
+            or (ca_state in ('OR', 'MN', 'KY')
+                and ss_net_profit between 150 and 3000)
+            or (ca_state in ('VA', 'CA', 'MS')
+                and ss_net_profit between 50 and 25000))""",
+    # Q63: manager monthly sales vs their yearly monthly average
+    # (window aggregate over a grouped aggregate)
+    "q63": f"""
+        select *
+        from (select i_manager_id,
+                     sum(ss_sales_price) as sum_sales,
+                     avg(sum(ss_sales_price))
+                       over (partition by i_manager_id)
+                       as avg_monthly_sales
+              from {S}.item, {S}.store_sales, {S}.date_dim, {S}.store
+              where ss_item_sk = i_item_sk
+                and ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and d_year = 1999
+                and ((i_category in ('Books', 'Children', 'Electronics')
+                      and i_class in ('personal', 'portable',
+                                      'reference', 'self-help'))
+                  or (i_category in ('Women', 'Music', 'Men')
+                      and i_class in ('accessories', 'classical',
+                                      'fragrances', 'pants')))
+              group by i_manager_id, d_moy) tmp1
+        where case when avg_monthly_sales > 0
+                   then abs(sum_sales - avg_monthly_sales)
+                        / avg_monthly_sales
+                   else null end > 0.1
+        order by i_manager_id, avg_monthly_sales, sum_sales
+        limit 100""",
+    # Q1: customers returning over 1.2x their store's average return
+    # (CTE referenced twice + equality-correlated scalar subquery)
+    "q1": f"""
+        with customer_total_return as (
+          select sr_customer_sk as ctr_customer_sk,
+                 sr_store_sk as ctr_store_sk,
+                 sum(sr_return_amt) as ctr_total_return
+          from {S}.store_returns, {S}.date_dim
+          where sr_returned_date_sk = d_date_sk and d_year = 1999
+          group by sr_customer_sk, sr_store_sk)
+        select c_customer_id
+        from customer_total_return ctr1, {S}.store, {S}.customer
+        where ctr1.ctr_total_return >
+                (select avg(ctr_total_return) * 1.2
+                 from customer_total_return ctr2
+                 where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+          and s_store_sk = ctr1.ctr_store_sk
+          and s_state = 'CA'
+          and ctr1.ctr_customer_sk = c_customer_sk
+        order by c_customer_id
+        limit 100""",
+    # Q6: states whose customers bought items priced 20% over their
+    # category average, for one month (two scalar subqueries)
+    "q6": f"""
+        select a.ca_state as state, count(*) as cnt
+        from {S}.customer_address a, {S}.customer c,
+             {S}.store_sales s, {S}.date_dim d, {S}.item i
+        where a.ca_address_sk = c.c_current_addr_sk
+          and c.c_customer_sk = s.ss_customer_sk
+          and s.ss_sold_date_sk = d.d_date_sk
+          and s.ss_item_sk = i.i_item_sk
+          and d.d_month_seq =
+                (select distinct d_month_seq from {S}.date_dim
+                 where d_year = 2000 and d_moy = 8)
+          and i.i_current_price >
+                1.2 * (select avg(j.i_current_price) from {S}.item j
+                       where j.i_category = i.i_category)
+        group by a.ca_state
+        having count(*) >= 10
+        order by cnt, a.ca_state
+        limit 100""",
+    # Q31: counties where web sales grew faster than store sales across
+    # two consecutive quarters (six self-joined CTE instances)
+    "q31": f"""
+        with ss as (
+          select ca_county, d_qoy, d_year,
+                 sum(ss_ext_sales_price) as store_sales
+          from {S}.store_sales, {S}.date_dim, {S}.customer_address
+          where ss_sold_date_sk = d_date_sk
+            and ss_addr_sk = ca_address_sk
+          group by ca_county, d_qoy, d_year),
+        ws as (
+          select ca_county, d_qoy, d_year,
+                 sum(ws_ext_sales_price) as web_sales
+          from {S}.web_sales, {S}.date_dim, {S}.customer_address
+          where ws_sold_date_sk = d_date_sk
+            and ws_bill_addr_sk = ca_address_sk
+          group by ca_county, d_qoy, d_year)
+        select ss1.ca_county, ss1.d_year,
+               ws2.web_sales / ws1.web_sales as web_q1_q2_increase,
+               ss2.store_sales / ss1.store_sales as store_q1_q2_increase,
+               ws3.web_sales / ws2.web_sales as web_q2_q3_increase,
+               ss3.store_sales / ss2.store_sales as store_q2_q3_increase
+        from ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+        where ss1.d_qoy = 1 and ss1.d_year = 1999
+          and ss1.ca_county = ss2.ca_county
+          and ss2.d_qoy = 2 and ss2.d_year = 1999
+          and ss2.ca_county = ss3.ca_county
+          and ss3.d_qoy = 3 and ss3.d_year = 1999
+          and ss1.ca_county = ws1.ca_county
+          and ws1.d_qoy = 1 and ws1.d_year = 1999
+          and ws1.ca_county = ws2.ca_county
+          and ws2.d_qoy = 2 and ws2.d_year = 1999
+          and ws1.ca_county = ws3.ca_county
+          and ws3.d_qoy = 3 and ws3.d_year = 1999
+          and case when ws1.web_sales > 0
+                   then ws2.web_sales / ws1.web_sales
+                   else null end
+            > case when ss1.store_sales > 0
+                   then ss2.store_sales / ss1.store_sales
+                   else null end
+          and case when ws2.web_sales > 0
+                   then ws3.web_sales / ws2.web_sales
+                   else null end
+            > case when ss2.store_sales > 0
+                   then ss3.store_sales / ss2.store_sales
+                   else null end
+        order by ss1.ca_county""",
+    # Q38: customers active in ALL THREE channels for one year
+    # (INTERSECT chain under a count)
+    "q38": f"""
+        select count(*) as cnt from (
+          (select distinct c_last_name, c_first_name, d_date
+           from {S}.store_sales, {S}.date_dim, {S}.customer
+           where ss_sold_date_sk = d_date_sk
+             and ss_customer_sk = c_customer_sk
+             and d_month_seq between 1188 and 1199)
+          intersect
+          (select distinct c_last_name, c_first_name, d_date
+           from {S}.catalog_sales, {S}.date_dim, {S}.customer
+           where cs_sold_date_sk = d_date_sk
+             and cs_bill_customer_sk = c_customer_sk
+             and d_month_seq between 1188 and 1199)
+          intersect
+          (select distinct c_last_name, c_first_name, d_date
+           from {S}.web_sales, {S}.date_dim, {S}.customer
+           where ws_sold_date_sk = d_date_sk
+             and ws_bill_customer_sk = c_customer_sk
+             and d_month_seq between 1188 and 1199)
+        ) hot_cust
+        limit 100""",
+    # Q47 (v1): store-brand months deviating >10% from the yearly
+    # average, with the neighbouring months via rank self-joins
+    "q47": f"""
+        with v1 as (
+          select i_category, i_brand, s_store_name, s_company_name,
+                 d_year, d_moy,
+                 sum(ss_sales_price) as sum_sales,
+                 avg(sum(ss_sales_price)) over (
+                   partition by i_category, i_brand, s_store_name,
+                                s_company_name, d_year)
+                   as avg_monthly_sales,
+                 rank() over (
+                   partition by i_category, i_brand, s_store_name,
+                                s_company_name
+                   order by d_year, d_moy) as rn
+          from {S}.item, {S}.store_sales, {S}.date_dim, {S}.store
+          where ss_item_sk = i_item_sk
+            and ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and (d_year = 1999
+                 or (d_year = 1998 and d_moy = 12)
+                 or (d_year = 2000 and d_moy = 1))
+          group by i_category, i_brand, s_store_name, s_company_name,
+                   d_year, d_moy),
+        v2 as (
+          select v1.i_category, v1.i_brand, v1.s_store_name,
+                 v1.s_company_name, v1.d_year, v1.d_moy,
+                 v1.avg_monthly_sales, v1.sum_sales,
+                 v1_lag.sum_sales as psum,
+                 v1_lead.sum_sales as nsum
+          from v1, v1 v1_lag, v1 v1_lead
+          where v1.i_category = v1_lag.i_category
+            and v1.i_brand = v1_lag.i_brand
+            and v1.s_store_name = v1_lag.s_store_name
+            and v1.s_company_name = v1_lag.s_company_name
+            and v1.i_category = v1_lead.i_category
+            and v1.i_brand = v1_lead.i_brand
+            and v1.s_store_name = v1_lead.s_store_name
+            and v1.s_company_name = v1_lead.s_company_name
+            and v1.rn = v1_lag.rn + 1
+            and v1.rn = v1_lead.rn - 1)
+        select *
+        from v2
+        where d_year = 1999
+          and avg_monthly_sales > 0
+          and case when avg_monthly_sales > 0
+                   then abs(sum_sales - avg_monthly_sales)
+                        / avg_monthly_sales
+                   else null end > 0.1
+        order by sum_sales - avg_monthly_sales, 3
+        limit 100""",
+    # Q57: the catalog-channel sibling of Q47 (call centers for stores)
+    "q57": f"""
+        with v1 as (
+          select i_category, i_brand, cc_name, d_year, d_moy,
+                 sum(cs_sales_price) as sum_sales,
+                 avg(sum(cs_sales_price)) over (
+                   partition by i_category, i_brand, cc_name, d_year)
+                   as avg_monthly_sales,
+                 rank() over (
+                   partition by i_category, i_brand, cc_name
+                   order by d_year, d_moy) as rn
+          from {S}.item, {S}.catalog_sales, {S}.date_dim,
+               {S}.call_center
+          where cs_item_sk = i_item_sk
+            and cs_sold_date_sk = d_date_sk
+            and cc_call_center_sk = cs_call_center_sk
+            and (d_year = 1999
+                 or (d_year = 1998 and d_moy = 12)
+                 or (d_year = 2000 and d_moy = 1))
+          group by i_category, i_brand, cc_name, d_year, d_moy),
+        v2 as (
+          select v1.i_category, v1.i_brand, v1.cc_name, v1.d_year,
+                 v1.d_moy, v1.avg_monthly_sales, v1.sum_sales,
+                 v1_lag.sum_sales as psum,
+                 v1_lead.sum_sales as nsum
+          from v1, v1 v1_lag, v1 v1_lead
+          where v1.i_category = v1_lag.i_category
+            and v1.i_brand = v1_lag.i_brand
+            and v1.cc_name = v1_lag.cc_name
+            and v1.i_category = v1_lead.i_category
+            and v1.i_brand = v1_lead.i_brand
+            and v1.cc_name = v1_lead.cc_name
+            and v1.rn = v1_lag.rn + 1
+            and v1.rn = v1_lead.rn - 1)
+        select *
+        from v2
+        where d_year = 1999
+          and avg_monthly_sales > 0
+          and case when avg_monthly_sales > 0
+                   then abs(sum_sales - avg_monthly_sales)
+                        / avg_monthly_sales
+                   else null end > 0.1
+        order by sum_sales - avg_monthly_sales, 3
+        limit 100""",
+    # Q65: items selling at or below a tenth of their store's average
+    # item revenue. Parameter deviation: a 2-month window instead of
+    # the official 12 — the closed-form generator draws item
+    # popularity uniformly (no official Pareto skew), so over 12
+    # months no item sits 10x below its store's average; the 2-month
+    # window reintroduces the cold items the template is after
+    "q65": f"""
+        select s_store_name, i_item_desc, sc.revenue,
+               i_current_price, i_wholesale_cost, i_brand
+        from {S}.store, {S}.item,
+             (select ss_store_sk, avg(revenue) as ave
+              from (select ss_store_sk, ss_item_sk,
+                           sum(ss_sales_price) as revenue
+                    from {S}.store_sales, {S}.date_dim
+                    where ss_sold_date_sk = d_date_sk
+                      and d_month_seq between 1198 and 1199
+                    group by ss_store_sk, ss_item_sk) sa
+              group by ss_store_sk) sb,
+             (select ss_store_sk, ss_item_sk,
+                     sum(ss_sales_price) as revenue
+              from {S}.store_sales, {S}.date_dim
+              where ss_sold_date_sk = d_date_sk
+                and d_month_seq between 1198 and 1199
+              group by ss_store_sk, ss_item_sk) sc
+        where sb.ss_store_sk = sc.ss_store_sk
+          and sc.revenue <= 0.1 * sb.ave
+          and s_store_sk = sc.ss_store_sk
+          and i_item_sk = sc.ss_item_sk
+        order by s_store_name, i_item_desc
+        limit 100""",
+    # Q73: frequent small-basket shoppers for a demographic slice
+    # (ticket line counts 1..5, the official bound)
+    "q73": f"""
+        select c_last_name, c_first_name, c_salutation,
+               c_preferred_cust_flag, ss_ticket_number, cnt
+        from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+              from {S}.store_sales, {S}.date_dim, {S}.store,
+                   {S}.household_demographics
+              where ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and d_dom between 1 and 2
+                and (hd_buy_potential = '>10000'
+                     or hd_buy_potential = 'Unknown')
+                and hd_vehicle_count > 0
+                and case when hd_vehicle_count > 0
+                         then cast(hd_dep_count as double)
+                              / cast(hd_vehicle_count as double)
+                         else null end > 1
+                and d_year in (1999, 2000, 2001)
+                and s_county in ('Barrow County', 'Bronx County')
+              group by ss_ticket_number, ss_customer_sk) dj,
+             {S}.customer
+        where ss_customer_sk = c_customer_sk
+          and cnt between 1 and 5
+        order by cnt desc, c_last_name asc, c_first_name,
+                 ss_ticket_number
+        limit 100""",
+    # Q87: customers who bought in-store but never by catalog or web
+    # in one year (EXCEPT chain under a count)
+    "q87": f"""
+        select count(*) as cnt from (
+          (select distinct c_last_name, c_first_name, d_date
+           from {S}.store_sales, {S}.date_dim, {S}.customer
+           where ss_sold_date_sk = d_date_sk
+             and ss_customer_sk = c_customer_sk
+             and d_month_seq between 1188 and 1199)
+          except
+          (select distinct c_last_name, c_first_name, d_date
+           from {S}.catalog_sales, {S}.date_dim, {S}.customer
+           where cs_sold_date_sk = d_date_sk
+             and cs_bill_customer_sk = c_customer_sk
+             and d_month_seq between 1188 and 1199)
+          except
+          (select distinct c_last_name, c_first_name, d_date
+           from {S}.web_sales, {S}.date_dim, {S}.customer
+           where ws_sold_date_sk = d_date_sk
+             and ws_bill_customer_sk = c_customer_sk
+             and d_month_seq between 1188 and 1199)
+        ) cool_cust""",
+    # Q89: store-brand months deviating from the yearly class average
+    # (window aggregate over grouped sums, two category groups)
+    "q89": f"""
+        select *
+        from (select i_category, i_class, i_brand, s_store_name,
+                     s_company_name, d_moy,
+                     sum(ss_sales_price) as sum_sales,
+                     avg(sum(ss_sales_price)) over (
+                       partition by i_category, i_brand, s_store_name,
+                                    s_company_name)
+                       as avg_monthly_sales
+              from {S}.item, {S}.store_sales, {S}.date_dim, {S}.store
+              where ss_item_sk = i_item_sk
+                and ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and d_year = 1999
+                and ((i_category in ('Books', 'Electronics', 'Sports')
+                      and i_class in ('computers', 'stereo',
+                                      'football'))
+                  or (i_category in ('Men', 'Jewelry', 'Women')
+                      and i_class in ('shirts', 'birdal', 'dresses')))
+              group by i_category, i_class, i_brand, s_store_name,
+                       s_company_name, d_moy) tmp1
+        where case when avg_monthly_sales <> 0
+                   then abs(sum_sales - avg_monthly_sales)
+                        / avg_monthly_sales
+                   else null end > 0.1
+        order by sum_sales - avg_monthly_sales, s_store_name
+        limit 100""",
+    # Q97: store/catalog channel overlap of (customer, item) pairs for
+    # one year (full outer join of grouped CTEs)
+    "q97": f"""
+        with ssci as (
+          select ss_customer_sk as customer_sk, ss_item_sk as item_sk
+          from {S}.store_sales, {S}.date_dim
+          where ss_sold_date_sk = d_date_sk
+            and d_month_seq between 1188 and 1199
+          group by ss_customer_sk, ss_item_sk),
+        csci as (
+          select cs_bill_customer_sk as customer_sk,
+                 cs_item_sk as item_sk
+          from {S}.catalog_sales, {S}.date_dim
+          where cs_sold_date_sk = d_date_sk
+            and d_month_seq between 1188 and 1199
+          group by cs_bill_customer_sk, cs_item_sk)
+        select sum(case when ssci.customer_sk is not null
+                         and csci.customer_sk is null
+                        then 1 else 0 end) as store_only,
+               sum(case when ssci.customer_sk is null
+                         and csci.customer_sk is not null
+                        then 1 else 0 end) as catalog_only,
+               sum(case when ssci.customer_sk is not null
+                         and csci.customer_sk is not null
+                        then 1 else 0 end) as store_and_catalog
+        from ssci full outer join csci
+          on (ssci.customer_sk = csci.customer_sk
+              and ssci.item_sk = csci.item_sk)
+        limit 100""",
+    # Q94: web orders shipped from multiple warehouses with NO return,
+    # for one state/site/60-day window (q95's sibling: anti-join on
+    # returns instead of the returns semi-join)
+    "q94": f"""
+        select count(distinct ws_order_number) as order_count,
+               sum(ws_ext_ship_cost) as total_shipping_cost,
+               sum(ws_net_profit) as total_net_profit
+        from {S}.web_sales ws1, {S}.date_dim, {S}.customer_address,
+             {S}.web_site
+        where d_date between date '1999-02-01'
+              and date '1999-02-01' + interval '60' day
+          and ws1.ws_ship_date_sk = d_date_sk
+          and ws1.ws_ship_addr_sk = ca_address_sk
+          and ca_state = 'IL'
+          and ws1.ws_web_site_sk = web_site_sk
+          and web_company_name = 'pri'
+          and exists (select *
+                      from {S}.web_sales ws2
+                      where ws1.ws_order_number = ws2.ws_order_number
+                        and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+          and not exists (select *
+                          from {S}.web_returns wr1
+                          where ws1.ws_order_number
+                                = wr1.wr_order_number)
+        order by count(distinct ws_order_number)
+        limit 100""",
 }

@@ -618,8 +618,7 @@ class CoordinatorServer:
         would ship the most rows wins first."""
         from presto_tpu.plan import optimizer
 
-        best = None
-        best_score = -1.0
+        cands = []
         for J in N.walk(root):
             if not isinstance(J, N.JoinNode) or not J.left_keys:
                 continue
@@ -634,7 +633,7 @@ class CoordinatorServer:
             ):
                 continue
             if thresh is not None:
-                # cheap stats gate BEFORE the stage-planning work: in
+                # cheap stats gate BEFORE any stage-planning work: in
                 # the default AUTOMATIC mode most joins are small and
                 # exit here without paying plan_stage
                 small = min(
@@ -647,11 +646,15 @@ class CoordinatorServer:
                 )
                 if small <= thresh:
                     continue
-                score = float(small)
+                cands.append((float(small), J))
             else:
-                score = 0.0
-            if best is not None and score <= best_score:
-                continue
+                cands.append((0.0, J))
+        if thresh is not None:
+            # best-first by min-side estimate; plan stages only for the
+            # winner, falling back down the ranking when a candidate's
+            # sides don't admit source-partitioned stages
+            cands.sort(key=lambda t: -t[0])
+        for _, J in cands:
             stages = []
             for side in (J.left, J.right):
                 st = plan_stage(side, self.local.catalogs)
@@ -661,10 +664,9 @@ class CoordinatorServer:
                     stages = None
                     break
                 stages.append(st)
-            if not stages:
-                continue
-            best, best_score = (J, stages), score
-        return best
+            if stages:
+                return (J, stages)
+        return None
 
     def _run_one_partitioned_join(self, J, side_stages, workers, q):
         """Run ONE join as producer stages + a partitioned join stage;

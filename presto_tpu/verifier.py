@@ -107,6 +107,14 @@ class SqliteOracle:
                         for row in rows
                     ],
                 )
+        # surrogate/join-key indexes: at SF1+ sqlite's nested-loop
+        # joins over multi-million-row fact tables need them to finish
+        # in suite-tolerable time (tiny-scale cost is negligible)
+        for c in cols:
+            if c.endswith("_sk") or c.endswith("key"):
+                self.conn.execute(
+                    f"CREATE INDEX idx_{table}_{c} ON {table} ({c})"
+                )
         self.conn.commit()
         self._loaded.add(table)
 
