@@ -45,6 +45,19 @@ def orderable_np(data: np.ndarray, dtype: T.DataType) -> np.ndarray:
     return np.asarray(data).astype(np.int64)
 
 
+def key_lanes_np(data: np.ndarray, dtype: T.DataType) -> List[np.ndarray]:
+    """numpy mirror of ops.common.key_lanes: long decimals expand to
+    [hi, lo-as-unsigned] int64 lanes, everything else is one
+    orderable_np lane."""
+    if dtype.is_long_decimal:
+        d = np.asarray(data)
+        return [
+            d[..., 0].astype(np.int64),
+            d[..., 1].astype(np.int64) ^ np.int64(-(2 ** 63)),
+        ]
+    return [orderable_np(data, dtype)]
+
+
 def peel_host_ops(
     root: N.PlanNode,
 ) -> Tuple[N.PlanNode, List[N.PlanNode]]:
@@ -93,6 +106,73 @@ def apply_host_ops(
     cols = {}  # name -> (np_data, np_valid, dtype, dictionary)
     i = 0
     for name, blk in zip(page.names, page.blocks):
+        if blk.dtype.is_map:
+            # leaves: offsets[:n+1], then per child full flat data
+            # (+valid). Host form = object array of per-row
+            # (keys, values, values_valid) slice triples; the child
+            # dictionaries ride the dictionary slot as a tuple.
+            off = np.asarray(fetched[i])
+            i += 1
+            chd = []
+            for ch in blk.children:
+                d = np.asarray(fetched[i])
+                i += 1
+                if ch.valid is not None:
+                    v = np.asarray(fetched[i])
+                    i += 1
+                else:
+                    v = None
+                chd.append((d, v))
+            (kd, _), (vd, vv) = chd
+            rows = np.empty(n, dtype=object)
+            for r in range(n):
+                lo, hi = off[r], off[r + 1]
+                rows[r] = (
+                    kd[lo:hi],
+                    vd[lo:hi],
+                    None if vv is None else vv[lo:hi],
+                )
+            if blk.valid is not None:
+                valid = fetched[i]
+                i += 1
+            else:
+                valid = np.ones(n, dtype=bool)
+            cols[name] = (
+                rows,
+                valid,
+                blk.dtype,
+                tuple(ch.dictionary for ch in blk.children),
+            )
+            continue
+        if blk.dtype.is_row:
+            chd = []
+            for ch in blk.children:
+                d = np.asarray(fetched[i])
+                i += 1
+                if ch.valid is not None:
+                    v = np.asarray(fetched[i])
+                    i += 1
+                else:
+                    v = None
+                chd.append((d, v))
+            rows = np.empty(n, dtype=object)
+            for r in range(n):
+                rows[r] = tuple(
+                    (d[r], True if v is None else bool(v[r]))
+                    for d, v in chd
+                )
+            if blk.valid is not None:
+                valid = fetched[i]
+                i += 1
+            else:
+                valid = np.ones(n, dtype=bool)
+            cols[name] = (
+                rows,
+                valid,
+                blk.dtype,
+                tuple(ch.dictionary for ch in blk.children),
+            )
+            continue
         if blk.offsets is not None:
             # array block leaves: offsets[:n+1] + full flat values.
             # Host form = object array of per-row value slices, so the
@@ -144,6 +224,110 @@ def apply_host_ops(
     blocks = []
     names = []
     for name, (d, v, t, dic) in cols.items():
+        if t.is_map:
+            kdic, vdic = dic
+            lengths = [len(d[r][0]) for r in range(n)]
+            from presto_tpu.exec.staging import bucket_capacity
+
+            offsets = np.zeros(cap + 1, np.int32)
+            np.cumsum(lengths, out=offsets[1: n + 1])
+            offsets[n + 1:] = offsets[n]
+            total = int(offsets[n])
+            # value-axis bucketing: exact flat lengths would make every
+            # distinct entry total a fresh XLA input shape downstream
+            # (same discipline as Block.from_pylist/_pad_flat_child)
+            vcap = bucket_capacity(total)
+            flat_k = np.zeros((vcap,), t.key.np_dtype)
+            flat_v = np.zeros((vcap,), t.value.np_dtype)
+            if total:
+                flat_k[:total] = np.concatenate(
+                    [np.asarray(d[r][0]) for r in range(n)]
+                )
+                flat_v[:total] = np.concatenate(
+                    [np.asarray(d[r][1]) for r in range(n)]
+                )
+            has_vv = any(d[r][2] is not None for r in range(n))
+            flat_vv = None
+            if has_vv and total:
+                flat_vv = np.zeros((vcap,), bool)
+                flat_vv[:total] = np.concatenate(
+                    [
+                        np.ones(len(d[r][1]), bool)
+                        if d[r][2] is None
+                        else np.asarray(d[r][2])
+                        for r in range(n)
+                    ]
+                )
+            vpad = np.zeros(cap, bool)
+            vpad[:n] = v[:n]
+            valid = None if bool(np.all(v[:n])) else jnp.asarray(vpad)
+            blocks.append(
+                Block(
+                    data=Block.placeholder_data(cap),
+                    valid=valid,
+                    dtype=t,
+                    offsets=jnp.asarray(offsets),
+                    children=(
+                        Block(
+                            data=jnp.asarray(flat_k),
+                            valid=None,
+                            dtype=t.key,
+                            dictionary=kdic,
+                        ),
+                        Block(
+                            data=jnp.asarray(flat_v),
+                            valid=(
+                                None
+                                if flat_vv is None
+                                else jnp.asarray(flat_vv)
+                            ),
+                            dtype=t.value,
+                            dictionary=vdic,
+                        ),
+                    ),
+                )
+            )
+            names.append(name)
+            continue
+        if t.is_row:
+            children = []
+            for fi, ((fname, ftype), fdic) in enumerate(
+                zip(t.fields, dic)
+            ):
+                fd = np.zeros(
+                    (cap,), dtype=ftype.np_dtype
+                ) if not ftype.is_long_decimal else np.zeros(
+                    (cap, 2), np.int64
+                )
+                fv = np.zeros(cap, bool)
+                for r in range(n):
+                    fd[r] = d[r][fi][0]
+                    fv[r] = d[r][fi][1]
+                children.append(
+                    Block(
+                        data=jnp.asarray(fd),
+                        valid=(
+                            None
+                            if bool(np.all(fv[:n]))
+                            else jnp.asarray(fv)
+                        ),
+                        dtype=ftype,
+                        dictionary=fdic,
+                    )
+                )
+            vpad = np.zeros(cap, bool)
+            vpad[:n] = v[:n]
+            valid = None if bool(np.all(v[:n])) else jnp.asarray(vpad)
+            blocks.append(
+                Block(
+                    data=Block.placeholder_data(cap),
+                    valid=valid,
+                    dtype=t,
+                    children=tuple(children),
+                )
+            )
+            names.append(name)
+            continue
         if t.is_array:
             # object array of per-row slices -> offsets + flat values
             lengths = [len(d[r]) for r in range(n)]
@@ -171,7 +355,10 @@ def apply_host_ops(
             continue
         pad = cap - len(d)
         if pad:
-            d = np.concatenate([d, np.zeros(pad, dtype=d.dtype)])
+            # long-decimal columns are (n, 2) limb pairs — pad rows only
+            d = np.concatenate(
+                [d, np.zeros((pad,) + d.shape[1:], dtype=d.dtype)]
+            )
             v = np.concatenate([v, np.zeros(pad, dtype=bool)])
         valid = None if bool(np.all(v[:n])) else jnp.asarray(v)
         blocks.append(
@@ -193,12 +380,12 @@ def _host_sort_perm(cols, keys, n: int) -> np.ndarray:
     for k in reversed(list(keys)):
         name = k.expr.name
         d, v, t, dic = cols[name]
-        img = orderable_np(d, t)
+        lanes = key_lanes_np(d, t)
         if k.descending:
-            img = ~img
+            lanes = [~img for img in lanes]
         nf = k.nulls_first if k.nulls_first is not None else k.descending
         null_rank = np.where(v, 0, -1 if nf else 1).astype(np.int64)
-        lex.append(img)
+        lex.extend(reversed(lanes))
         lex.append(null_rank)
     if not lex:
         return np.arange(n)

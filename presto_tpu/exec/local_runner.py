@@ -817,6 +817,51 @@ def _page_from_prefix(page: Page, prefix_leaves, n: int) -> Page:
     cap = bucket_capacity(n)
     blocks = []
     for blk in page.blocks:
+        if blk.dtype.is_map or blk.dtype.is_row:
+            # leaf order mirrors Page.prefix_leaves: [offsets[:n+1]]
+            # (map only), per child data (+child valid), parent valid
+            offsets = None
+            if blk.dtype.is_map:
+                opref = next(fetched)
+                offsets = np.zeros((cap + 1,), np.int32)
+                offsets[: n + 1] = opref[: n + 1]
+                offsets[n + 1:] = offsets[n]
+            children = []
+            for ch in blk.children:
+                chd = np.asarray(next(fetched))
+                chv = None
+                if ch.valid is not None:
+                    chv = np.asarray(next(fetched))
+                if blk.dtype.is_row:
+                    # row children are row-capacity blocks: re-pad
+                    d = np.zeros(
+                        (cap,) + chd.shape[1:], page_np_dtype(ch)
+                    )
+                    d[:n] = chd[:n]
+                    v = None
+                    if chv is not None:
+                        v = np.zeros((cap,), bool)
+                        v[:n] = chv[:n]
+                    chd, chv = d, v
+                children.append(
+                    dataclasses.replace(ch, data=chd, valid=chv)
+                )
+            if blk.valid is not None:
+                vpref = next(fetched)
+                valid = np.zeros((cap,), bool)
+                valid[:n] = vpref[:n]
+            else:
+                valid = None
+            blocks.append(
+                dataclasses.replace(
+                    blk,
+                    data=np.zeros((cap, 0), np.int8),
+                    valid=valid,
+                    offsets=offsets,
+                    children=tuple(children),
+                )
+            )
+            continue
         if blk.offsets is not None:
             # array block leaves: offsets[:n+1] + the full values array
             opref = next(fetched)

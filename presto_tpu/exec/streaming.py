@@ -640,12 +640,17 @@ def _col_hash_input(col, nrows: int) -> np.ndarray:
             return np.zeros(nrows, np.uint64)
         return crc[ids[:nrows]]
     data = np.asarray(col)[:nrows]
+    if data.ndim == 2 and data.shape[1] == 2:
+        # long-decimal limb pairs: mix the hi limb, fold in lo — equal
+        # int128 values hash equally (matches exchange.partition_hash's
+        # two-lane fold up to the mixing order, which only this host
+        # bucketing uses)
+        hi = data[:, 0].astype(np.int64).view(np.uint64)
+        lo = data[:, 1].astype(np.int64).view(np.uint64)
+        return _mix64(hi) ^ lo
     if data.ndim != 1:
-        # int128 limb pairs etc. — the planner gates long decimals out
-        # of key positions; this backstop keeps the failure loud
         raise NotImplementedError(
-            f"cannot bucket-hash a {data.ndim}-D column (long-decimal "
-            "keys are a documented deviation)"
+            f"cannot bucket-hash a {data.ndim}-D column"
         )
     if data.dtype.kind == "f":
         d = data.astype(np.float64, copy=True)

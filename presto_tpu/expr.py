@@ -513,6 +513,43 @@ class ArraySubscript(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class MapSubscript(Expr):
+    """m[k] / element_at(m, k) over a physical map column: a flat
+    segment scan — the matching entry's flat position per row is a
+    segmented running max over ``match ? j : -1`` read at each row's
+    segment end (branch-free, one pass over the values axis, no
+    scatter). Missing key -> NULL (Presto element_at; the reference's
+    subscript raises — same documented deviation as ArraySubscript)."""
+
+    arg: Expr  # ColumnRef to a map column
+    key: Expr
+
+    def children(self):
+        return (self.arg, self.key)
+
+    @property
+    def dtype(self):
+        return self.arg.dtype.value
+
+
+@dataclasses.dataclass(frozen=True)
+class RowFieldAccess(Expr):
+    """r.f over a physical row (struct) column: zero-copy select of the
+    field's child block; row-NULL propagates into the field."""
+
+    arg: Expr  # ColumnRef to a row column
+    field: str
+    field_type: T.DataType
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return self.field_type
+
+
+@dataclasses.dataclass(frozen=True)
 class DateAdd(Expr):
     """date_add(unit, n, x): shift a date/timestamp by n units (unit in
     day|week|month|year). Month/year shifts clamp the day-of-month to
@@ -749,6 +786,11 @@ class ExprLowerer:
         if isinstance(expr, ArraySubscript):
             # elements share the array block's dictionary
             return self._array_block(expr.arg).dictionary
+        if isinstance(expr, MapSubscript):
+            return self._map_block(expr.arg).children[1].dictionary
+        if isinstance(expr, RowFieldAccess):
+            blk = self.page.block(expr.arg.name)
+            return blk.children[blk.dtype.field_index(expr.field)].dictionary
         raise NotImplementedError(
             f"no dictionary for string expression {type(expr).__name__}"
         )
@@ -1522,6 +1564,102 @@ class ExprLowerer:
         if idx_v is not None:
             valid = valid & jnp.broadcast_to(idx_v, (blk.capacity,))
         return data, valid
+
+    def _map_block(self, e: Expr):
+        if not isinstance(e, ColumnRef):
+            raise NotImplementedError(
+                "map operations require a physical map column"
+            )
+        blk = self.page.block(e.name)
+        if not blk.dtype.is_map:
+            raise NotImplementedError(f"{e.name} is not a map column")
+        return blk
+
+    def _eval_mapsubscript(self, e: MapSubscript):
+        blk = self._map_block(e.arg)
+        kc, vc = blk.children
+        cap = blk.capacity
+        vcap = kc.data.shape[0]
+        off = blk.offsets.astype(jnp.int32)
+
+        # per-row lookup key in the child's device representation
+        if e.key.dtype.is_string:
+            if isinstance(e.key, Literal):
+                kid = (
+                    -1
+                    if kc.dictionary is None or e.key.value is None
+                    else kc.dictionary.id_of(str(e.key.value))
+                )
+                key_rows = jnp.full((cap,), kid, jnp.int32)
+                kv = None
+            else:
+                kd, kv = self.eval(e.key)
+                if self.dictionary_of(e.key) != kc.dictionary:
+                    raise NotImplementedError(
+                        "map subscript with a different-dictionary "
+                        "string key requires re-encode"
+                    )
+                key_rows = jnp.broadcast_to(kd, (cap,))
+        else:
+            kd, kv = self.eval(e.key)
+            key_rows = jnp.broadcast_to(jnp.asarray(kd), (cap,))
+
+        j = jnp.arange(vcap, dtype=jnp.int32)
+        row_of_j = jnp.minimum(
+            jnp.searchsorted(off[1:], j, side="right"), cap - 1
+        ).astype(jnp.int32)
+        in_seg = j < off[cap]
+        # compare in the WIDER domain: narrowing the key to the child
+        # dtype would wrap modulo 2^32 and fabricate matches (a bigint
+        # subscript of 2^32+5 must miss integer key 5, not hit it)
+        flat_keys = kc.data
+        if not e.key.dtype.is_string and jnp.issubdtype(
+            flat_keys.dtype, jnp.integer
+        ):
+            flat_keys = flat_keys.astype(jnp.int64)
+            key_rows = key_rows.astype(jnp.int64)
+        match = in_seg & (flat_keys == key_rows[row_of_j])
+        # segmented running max of (match ? j : -1), restart at segment
+        # starts; read at each row's last flat slot
+        seg_start = j == off[row_of_j]
+        from jax import lax
+
+        def combine(a, b):
+            av, af = a
+            bv, bf = b
+            return jnp.where(bf, bv, jnp.maximum(av, bv)), af | bf
+
+        vals, _ = lax.associative_scan(
+            combine,
+            (jnp.where(match, j, -1).astype(jnp.int32), seg_start),
+        )
+        last = jnp.clip(off[1:] - 1, 0, max(vcap - 1, 0))
+        idx = jnp.where(off[1:] > off[:-1], vals[last], -1)
+        found = idx >= 0
+        safe = jnp.clip(idx, 0, max(vcap - 1, 0))
+        data = vc.data[safe]
+        valid = found
+        if vc.valid is not None:
+            valid = valid & vc.valid[safe]
+        if blk.valid is not None:
+            valid = valid & blk.valid
+        if kv is not None:
+            valid = valid & jnp.broadcast_to(kv, (cap,))
+        return data, valid
+
+    def _eval_rowfieldaccess(self, e: RowFieldAccess):
+        if not isinstance(e.arg, ColumnRef):
+            raise NotImplementedError(
+                "row field access requires a physical row column"
+            )
+        blk = self.page.block(e.arg.name)
+        if not blk.dtype.is_row:
+            raise NotImplementedError(
+                f"{e.arg.name} is not a row column"
+            )
+        ch = blk.children[blk.dtype.field_index(e.field)]
+        valid = _and_valid(blk.valid, ch.valid)
+        return ch.data, valid
 
     def _eval_valuehash(self, e: ValueHash):
         d, v = self.eval(e.arg)

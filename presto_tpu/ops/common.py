@@ -25,11 +25,13 @@ def orderable_i64(data: jnp.ndarray, dtype: T.DataType) -> jnp.ndarray:
       non-NaN; NaN sorts last as in the reference's ORDER BY)
     """
     if dtype.is_long_decimal:
-        # kernel-level backstop for the planner gates: (cap, 2) limb
-        # pairs do not fit a single orderable int64
+        # a (cap, 2) limb pair does not fit ONE orderable int64 — the
+        # multi-lane callers (sort_order/boundaries via key_lanes)
+        # handle long decimals; anything still calling the scalar form
+        # (single-int64 join packing) gets the documented deviation
         raise NotImplementedError(
-            "long decimals (p>18) as sort/group/join/distinct keys are "
-            "a documented deviation — cast to decimal(18,s) or double"
+            "long decimals (p>18) do not reduce to a single orderable "
+            "int64 lane — use key_lanes()"
         )
     if dtype.name in ("double", "real"):
         f = jnp.asarray(data, jnp.float64)
@@ -44,6 +46,22 @@ def orderable_i64(data: jnp.ndarray, dtype: T.DataType) -> jnp.ndarray:
     return jnp.asarray(data).astype(jnp.int64)
 
 
+def key_lanes(data: jnp.ndarray, dtype: T.DataType) -> List[jnp.ndarray]:
+    """A key column as 1..2 order-preserving int64 lanes, most
+    significant first. Long decimals ((cap, 2) int64 limb pairs —
+    types.LongDecimalType layout) expand to [hi, lo-as-unsigned]:
+    lexicographic comparison of the lane pair equals int128 comparison
+    (lo's int64 bit pattern gets the sign bit flipped so signed lane
+    order matches its unsigned-limb order). Every other type is the
+    single ``orderable_i64`` lane."""
+    if dtype.is_long_decimal:
+        d = jnp.asarray(data)
+        hi = d[..., 0].astype(jnp.int64)
+        lo = d[..., 1].astype(jnp.int64) ^ jnp.int64(-(2 ** 63))
+        return [hi, lo]
+    return [orderable_i64(data, dtype)]
+
+
 def sort_order(
     keys: Sequence[Tuple[jnp.ndarray, Optional[jnp.ndarray], T.DataType]],
     live: jnp.ndarray,
@@ -53,6 +71,10 @@ def sort_order(
     """Permutation sorting rows by keys (list of (data, valid, dtype)),
     live rows first. SQL default: nulls last in ASC, first in DESC
     (reference: NULLS LAST semantics for ASC ordering).
+
+    Multi-lane keys (long decimals) contribute all their lanes at one
+    significance position: DESC flips every lane (lexicographic reverse
+    of (hi, lo) is (~hi, ~lo)), and the null rank stays per-KEY.
     """
     n = len(keys)
     descending = descending or [False] * n
@@ -62,15 +84,16 @@ def sort_order(
     for (data, valid, dtype), desc, nf in zip(
         reversed(list(keys)), reversed(list(descending)), reversed(list(nulls_first))
     ):
-        k = orderable_i64(data, dtype)
+        lanes = key_lanes(data, dtype)
         if desc:
-            k = ~k  # bitwise-not reverses order without INT64_MIN overflow
+            # bitwise-not reverses order without INT64_MIN overflow
+            lanes = [~k for k in lanes]
         null_rank = (
-            jnp.zeros(k.shape, jnp.int64)
+            jnp.zeros(lanes[0].shape, jnp.int64)
             if valid is None
             else jnp.where(valid, 0, -1 if nf else 1)
         )
-        lex.append(k)
+        lex.extend(reversed(lanes))
         lex.append(null_rank)  # more significant than the value
     lex.append(jnp.where(live, 0, 1).astype(jnp.int64))  # live first
     return jnp.lexsort(lex)
@@ -87,6 +110,8 @@ def boundaries(
     for data, valid in sorted_keys:
         d = jnp.asarray(data)
         neq = d[1:] != d[:-1]
+        if d.ndim == 2:  # long-decimal limb pairs: any limb differs
+            neq = jnp.any(neq, axis=-1)
         if jnp.issubdtype(d.dtype, jnp.floating):
             # NaN != NaN, but SQL grouping puts all NaNs in one group
             neq = neq & ~(jnp.isnan(d[1:]) & jnp.isnan(d[:-1]))

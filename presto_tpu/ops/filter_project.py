@@ -34,9 +34,10 @@ def project(
     lowerer = ExprLowerer(page)
     names, blocks = [], []
     for name, expr in projections:
-        if isinstance(expr, ColumnRef) and expr.dtype.is_array:
-            # array columns pass through whole (offsets + flat values);
-            # non-identity array expressions have no lane form
+        if isinstance(expr, ColumnRef) and expr.dtype.is_nested:
+            # array/map/row columns pass through whole (offsets +
+            # flat/child blocks); non-identity nested expressions have
+            # no lane form
             blocks.append(page.block(expr.name))
             names.append(name)
             continue
@@ -249,11 +250,12 @@ def unnest_column(
 
     blocks, names = [], []
     for name, b in zip(page.names, page.blocks):
-        if b.offsets is not None:
-            # array columns do not ride through the expansion (their
-            # repeated rows could exceed the flat value capacity);
-            # UnnestNode.output_schema drops them identically, so a
-            # post-unnest reference fails at PLAN time, not here
+        if b.offsets is not None or b.children is not None:
+            # nested columns do not ride through the expansion (flat
+            # repeats could exceed value capacity; row children would
+            # need their own gather); UnnestNode.output_schema drops
+            # them identically, so a post-unnest reference fails at
+            # PLAN time, not here
             continue
         blocks.append(
             dataclasses.replace(
@@ -306,9 +308,12 @@ def union_all(pages: Sequence[Page]) -> Page:
     blocks: List[Block] = []
     for ci, name in enumerate(first.names):
         blks = [p.blocks[ci] for p in pages]
-        if any(b.offsets is not None for b in blks):
+        if any(
+            b.offsets is not None or b.children is not None
+            for b in blks
+        ):
             raise NotImplementedError(
-                f"array column {name} through UNION is not supported"
+                f"nested column {name} through UNION is not supported"
             )
         dictionary = None
         if first.blocks[ci].dtype.is_string:
@@ -408,13 +413,17 @@ def filter_project(
     lowerer = ExprLowerer(page)
     names, blocks = [], []
     for name, expr in projections:
-        if isinstance(expr, ColumnRef) and expr.dtype.is_array:
-            from presto_tpu.page import _gather_array_block
+        if isinstance(expr, ColumnRef) and expr.dtype.is_nested:
+            from presto_tpu.page import (
+                _gather_array_block,
+                _gather_row_block,
+            )
 
+            blk = page.block(expr.name)
             blocks.append(
-                _gather_array_block(
-                    page.block(expr.name), sel, count
-                )
+                _gather_row_block(blk, sel, count)
+                if expr.dtype.is_row
+                else _gather_array_block(blk, sel, count)
             )
             names.append(name)
             continue

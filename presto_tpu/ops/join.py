@@ -49,7 +49,23 @@ def _key_of(page: Page, key_cols: Sequence[str]) -> Tuple[jnp.ndarray, jnp.ndarr
     widths = []
     for name in key_cols:
         blk = page.block(name)
-        datas.append(orderable_i64(blk.data, blk.dtype))
+        if blk.dtype.is_long_decimal:
+            # int128 limb pair -> one int64 via a splitmix64 fold. NOT
+            # injective: the planner only emits a long-decimal kernel
+            # key on INNER joins with a residual limb-equality filter
+            # attached (JoinNode.residual), which removes any
+            # mix-collision false match — collisions cost out_capacity,
+            # never correctness (plan/planner.py long-decimal join path)
+            d = jnp.asarray(blk.data)
+            hi = d[..., 0].astype(jnp.uint64)
+            z = hi + jnp.uint64(0x9E3779B97F4A7C15)
+            z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+            z = z ^ (z >> jnp.uint64(31))
+            mixed = (z ^ d[..., 1].astype(jnp.uint64)).astype(jnp.int64)
+            datas.append(mixed)
+        else:
+            datas.append(orderable_i64(blk.data, blk.dtype))
         widths.append(blk.dtype.np_dtype.itemsize)
         if blk.valid is not None:
             ok = ok & blk.valid
@@ -341,13 +357,15 @@ def _join_output(
 ) -> Page:
     for name in list(probe.names) + list(build_payload):
         src = probe if name in probe.names else build
-        if src.block(name).offsets is not None:
+        blk = src.block(name)
+        if blk.offsets is not None or blk.children is not None:
             # a row-index gather of the FLAT values array with stale
-            # offsets would silently corrupt array columns
+            # offsets (arrays/maps) or of the placeholder without the
+            # children (rows) would silently corrupt nested columns
             raise NotImplementedError(
-                f"array column {name} cannot ride through a join "
-                "output; select it before the join or join on its "
-                "parent rows and unnest after"
+                f"nested column {name} ({blk.dtype}) cannot ride "
+                "through a join output; select it before the join or "
+                "join on its parent rows and access fields/unnest after"
             )
     names: List[str] = []
     blocks: List[Block] = []

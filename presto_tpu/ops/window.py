@@ -127,11 +127,13 @@ def window(
 
     names = list(page.names)
     for name, blk in zip(names, page.blocks):
-        if blk.offsets is not None:
-            # flat-values gather with stale offsets would corrupt
+        if blk.offsets is not None or blk.children is not None:
+            # flat-values gather with stale offsets (arrays/maps) or a
+            # permuted placeholder with unpermuted children (rows)
+            # would silently corrupt nested columns
             raise NotImplementedError(
-                f"array column {name} cannot ride through a window "
-                "operator; select it separately"
+                f"nested column {name} ({blk.dtype}) cannot ride "
+                "through a window operator; select it separately"
             )
     blocks = [
         dataclasses.replace(

@@ -105,6 +105,24 @@ class Dictionary:
 _NATIVE_ENCODE_MIN_ROWS = 4096
 
 
+def _pad_flat_child(child: "Block", vcap: int) -> "Block":
+    """Pad a flat child block (map keys/values) to the bucketed value
+    capacity — same value-axis discipline as array blocks."""
+    n = child.data.shape[0]
+    if n >= vcap:
+        return child
+    pad = [(0, vcap - n)] + [(0, 0)] * (child.data.ndim - 1)
+    return dataclasses.replace(
+        child,
+        data=jnp.pad(child.data, pad),
+        valid=(
+            None
+            if child.valid is None
+            else jnp.pad(child.valid, [(0, vcap - n)])
+        ),
+    )
+
+
 def encode_strings(
     values: Sequence, force_numpy: bool = False
 ) -> tuple[np.ndarray, np.ndarray, Dictionary]:
@@ -135,7 +153,7 @@ def encode_strings(
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["data", "valid", "offsets"],
+    data_fields=["data", "valid", "offsets", "children"],
     meta_fields=["dtype", "dictionary"],
 )
 @dataclasses.dataclass
@@ -151,6 +169,14 @@ class Block:
     is an int32 (row_capacity + 1,) array — row i's elements are
     ``data[offsets[i]:offsets[i+1]]``; ``valid`` stays per-ROW. Scalar
     columns carry offsets=None.
+
+    Map columns (``dtype.is_map``, reference: MapBlock): ``offsets`` as
+    for arrays, ``children`` = (keys Block, values Block) — two flat
+    blocks sharing the offsets; ``data`` is a zero-width placeholder.
+    Row columns (``dtype.is_row``, reference: RowBlock): ``children`` =
+    one Block per field at ROW capacity, no offsets, placeholder data.
+    ``children`` is a pytree data field (None for scalar/array blocks —
+    an empty pytree, so existing block traversals see no new leaves).
     """
 
     data: jnp.ndarray
@@ -158,12 +184,20 @@ class Block:
     dtype: T.DataType
     dictionary: Optional[Dictionary] = None
     offsets: Optional[jnp.ndarray] = None  # int32 (capacity+1,) arrays only
+    children: Optional[tuple] = None  # map: (keys, values); row: fields
 
     @property
     def capacity(self) -> int:
         if self.offsets is not None:
             return self.offsets.shape[0] - 1
         return self.data.shape[0]
+
+    @staticmethod
+    def placeholder_data(cap: int) -> jnp.ndarray:
+        """Zero-byte per-row stand-in for blocks whose payload lives in
+        ``children`` (map/row): keeps ``data.shape[0] == capacity`` with
+        no device memory."""
+        return jnp.zeros((cap, 0), jnp.int8)
 
     @classmethod
     def from_numpy(
@@ -219,6 +253,63 @@ class Block:
                 dtype=dtype,
                 dictionary=child.dictionary,
                 offsets=jnp.asarray(offsets),
+            )
+        if dtype.is_map:
+            # python dicts -> offsets + flat keys/values child blocks
+            if dtype.key.is_nested or dtype.value.is_nested:
+                raise NotImplementedError(
+                    "nested map key/value types are not supported "
+                    "(one nesting level; documented deviation)"
+                )
+            lengths = [0 if v is None else len(v) for v in values]
+            offsets = np.zeros(len(values) + 1, np.int32)
+            np.cumsum(lengths, out=offsets[1:])
+            flat_k: list = []
+            flat_v: list = []
+            for v in values:
+                if v is not None:
+                    for k, val in v.items():
+                        flat_k.append(k)
+                        flat_v.append(val)
+            if any(x is None for x in flat_k):
+                raise NotImplementedError("NULL map keys are invalid")
+            kchild = cls.from_pylist(flat_k, dtype.key)
+            vchild = cls.from_pylist(flat_v, dtype.value)
+            from presto_tpu.exec.staging import bucket_capacity
+
+            vcap = bucket_capacity(len(flat_k))
+            kchild = _pad_flat_child(kchild, vcap)
+            vchild = _pad_flat_child(vchild, vcap)
+            isnull = np.array([v is None for v in values], bool)
+            return cls(
+                data=cls.placeholder_data(len(values)),
+                valid=None if not isnull.any() else jnp.asarray(~isnull),
+                dtype=dtype,
+                offsets=jnp.asarray(offsets),
+                children=(kchild, vchild),
+            )
+        if dtype.is_row:
+            # python dicts (by field name) or sequences (positional)
+            if any(t.is_nested for _, t in dtype.fields):
+                raise NotImplementedError(
+                    "nested row field types are not supported "
+                    "(one nesting level; documented deviation)"
+                )
+            isnull = np.array([v is None for v in values], bool)
+            children = []
+            for i, (fname, ftype) in enumerate(dtype.fields):
+                fv = [
+                    None
+                    if v is None
+                    else (v[fname] if isinstance(v, dict) else v[i])
+                    for v in values
+                ]
+                children.append(cls.from_pylist(fv, ftype))
+            return cls(
+                data=cls.placeholder_data(len(values)),
+                valid=None if not isnull.any() else jnp.asarray(~isnull),
+                dtype=dtype,
+                children=tuple(children),
             )
         if dtype.is_string:
             ids, valid, dictionary = encode_strings(values)
@@ -333,7 +424,18 @@ class Page:
         bytes for the round trip)."""
         leaves = []
         for blk in self.blocks:
-            if blk.offsets is not None:
+            if blk.dtype.is_map:
+                leaves.append(blk.offsets[: k + 1])
+                for ch in blk.children:
+                    leaves.append(ch.data)
+                    if ch.valid is not None:
+                        leaves.append(ch.valid)
+            elif blk.dtype.is_row:
+                for ch in blk.children:
+                    leaves.append(ch.data[:k])
+                    if ch.valid is not None:
+                        leaves.append(ch.valid[:k])
+            elif blk.offsets is not None:
                 leaves.append(blk.offsets[: k + 1])
                 leaves.append(blk.data)
             else:
@@ -394,6 +496,78 @@ class Page:
         n = len(idx)
         out_cols = {}
         for name, blk in zip(self.names, self.blocks):
+            if blk.dtype.is_map:
+                off = np.asarray(blk.offsets)
+                kc, vc = blk.children
+                kdata = np.asarray(kc.data)
+                vdata = np.asarray(vc.data)
+                vvalid = (
+                    None if vc.valid is None else np.asarray(vc.valid)
+                )
+                rvalid = (
+                    np.ones(blk.capacity, bool)
+                    if blk.valid is None
+                    else np.asarray(blk.valid)
+                )
+                col = []
+                for i in idx:
+                    if not rvalid[i]:
+                        col.append(None)
+                        continue
+                    d = {}
+                    for j in range(int(off[i]), int(off[i + 1])):
+                        k = _decode_value(
+                            kdata[j], blk.dtype.key, kc.dictionary
+                        )
+                        v = (
+                            None
+                            if vvalid is not None and not vvalid[j]
+                            else _decode_value(
+                                vdata[j], blk.dtype.value, vc.dictionary
+                            )
+                        )
+                        d[k] = v
+                    col.append(d)
+                out_cols[name] = col
+                continue
+            if blk.dtype.is_row:
+                rvalid = (
+                    np.ones(blk.capacity, bool)
+                    if blk.valid is None
+                    else np.asarray(blk.valid)
+                )
+                fdatas = []
+                for (fname, ftype), ch in zip(
+                    blk.dtype.fields, blk.children
+                ):
+                    fdatas.append(
+                        (
+                            fname,
+                            ftype,
+                            np.asarray(ch.data),
+                            None
+                            if ch.valid is None
+                            else np.asarray(ch.valid),
+                            ch.dictionary,
+                        )
+                    )
+                col = []
+                for i in idx:
+                    if not rvalid[i]:
+                        col.append(None)
+                        continue
+                    col.append(
+                        {
+                            fname: (
+                                None
+                                if fvalid is not None and not fvalid[i]
+                                else _decode_value(fd[i], ftype, fdic)
+                            )
+                            for fname, ftype, fd, fvalid, fdic in fdatas
+                        }
+                    )
+                out_cols[name] = col
+                continue
             if blk.dtype.is_array:
                 off = np.asarray(blk.offsets)
                 vals = np.asarray(blk.data)
@@ -483,6 +657,9 @@ def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
                 _gather_array_block(blk, sel, page.num_valid)
             )
             continue
+        if blk.dtype.is_row:
+            blocks.append(_gather_row_block(blk, sel, page.num_valid))
+            continue
         blocks.append(
             dataclasses.replace(
                 blk,
@@ -500,11 +677,12 @@ def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
 def _gather_array_block(
     blk: Block, sel: jnp.ndarray, num_live
 ) -> Block:
-    """Row-gather an array block: new offsets from the selected rows'
-    lengths, values re-laid-out by the prefix-sum + inverse-searchsorted
-    expansion (the engine's standard static-shape gather-of-segments).
-    ``sel`` fill entries (padding rows) contribute length 0 via the
-    ``num_live`` cutoff."""
+    """Row-gather an array/map block: new offsets from the selected
+    rows' lengths, values re-laid-out by the prefix-sum +
+    inverse-searchsorted expansion (the engine's standard static-shape
+    gather-of-segments). ``sel`` fill entries (padding rows) contribute
+    length 0 via the ``num_live`` cutoff. Map blocks apply the same
+    flat-axis gather to both children."""
     cap = sel.shape[0]
     off = blk.offsets
     lengths = off[1:] - off[:-1]
@@ -514,17 +692,107 @@ def _gather_array_block(
     new_off = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(sel_len).astype(jnp.int32)]
     )
-    vcap = blk.data.shape[0]
+    vcap = (
+        blk.children[0].data.shape[0]
+        if blk.dtype.is_map
+        else blk.data.shape[0]
+    )
     j = jnp.arange(vcap, dtype=jnp.int32)
     p = jnp.searchsorted(new_off[1:], j, side="right")
     p = jnp.minimum(p, cap - 1)
     src = off[sel[p]] + (j - new_off[p])
     src = jnp.clip(src, 0, vcap - 1)
+    if blk.dtype.is_map:
+        children = tuple(
+            dataclasses.replace(
+                ch,
+                data=ch.data[src],
+                valid=None if ch.valid is None else ch.valid[src],
+            )
+            for ch in blk.children
+        )
+        return dataclasses.replace(
+            blk,
+            data=Block.placeholder_data(cap),
+            valid=None if blk.valid is None else blk.valid[sel],
+            offsets=new_off,
+            children=children,
+        )
     return dataclasses.replace(
         blk,
         data=blk.data[src],
         valid=None if blk.valid is None else blk.valid[sel],
         offsets=new_off,
+    )
+
+
+def _gather_row_block(blk: Block, sel: jnp.ndarray, num_live) -> Block:
+    """Row-gather a row (struct) block: children gather positionally
+    with the parent. ``num_live`` zeroes the lengths of sel's fill
+    entries in any offsets-bearing child (same invariant as
+    _gather_array_block)."""
+    children = tuple(
+        _gather_row_block(ch, sel, num_live)
+        if ch.dtype.is_row
+        else (
+            _gather_array_block(ch, sel, num_live)
+            if ch.offsets is not None
+            else dataclasses.replace(
+                ch,
+                data=ch.data[sel],
+                valid=None if ch.valid is None else ch.valid[sel],
+            )
+        )
+        for ch in blk.children
+    )
+    return dataclasses.replace(
+        blk,
+        data=Block.placeholder_data(sel.shape[0]),
+        valid=None if blk.valid is None else blk.valid[sel],
+        children=children,
+    )
+
+
+def _rebucket_row_block(blk: Block, capacity: int) -> Block:
+    """Row-axis pad/slice of a row block and its children."""
+    cap = blk.capacity
+    if capacity == cap:
+        return blk
+
+    def fit(ch: Block) -> Block:
+        if ch.dtype.is_row:
+            return _rebucket_row_block(ch, capacity)
+        if ch.offsets is not None:
+            if capacity > cap:
+                offs = jnp.pad(
+                    ch.offsets, [(0, capacity - cap)], mode="edge"
+                )
+            else:
+                offs = ch.offsets[: capacity + 1]
+            return dataclasses.replace(
+                ch, offsets=offs, valid=_fit_valid(ch.valid)
+            )
+        if capacity > cap:
+            pad = [(0, capacity - cap)] + [(0, 0)] * (ch.data.ndim - 1)
+            return dataclasses.replace(
+                ch, data=jnp.pad(ch.data, pad), valid=_fit_valid(ch.valid)
+            )
+        return dataclasses.replace(
+            ch, data=ch.data[:capacity], valid=_fit_valid(ch.valid)
+        )
+
+    def _fit_valid(v):
+        if v is None:
+            return None
+        if capacity > cap:
+            return jnp.pad(v, [(0, capacity - cap)])
+        return v[:capacity]
+
+    return dataclasses.replace(
+        blk,
+        data=Block.placeholder_data(capacity),
+        valid=_fit_valid(blk.valid),
+        children=tuple(fit(ch) for ch in blk.children),
     )
 
 
@@ -562,13 +830,24 @@ def pad_capacity(page: Page, capacity: int) -> Page:
                     else blk.valid[:capacity]
                 )
             )
+            if blk.dtype.is_map:
+                blk = dataclasses.replace(
+                    blk, data=Block.placeholder_data(capacity)
+                )
             blocks.append(
                 dataclasses.replace(blk, offsets=offsets, valid=valid)
             )
+        elif blk.dtype.is_row:
+            blocks.append(_rebucket_row_block(blk, capacity))
         elif capacity > cap:
-            pad = [(0, capacity - cap)]
+            # row-axis pad only (long decimals are (cap, 2) limb pairs)
+            pad = [(0, capacity - cap)] + [(0, 0)] * (blk.data.ndim - 1)
             data = jnp.pad(blk.data, pad)
-            valid = None if blk.valid is None else jnp.pad(blk.valid, pad)
+            valid = (
+                None
+                if blk.valid is None
+                else jnp.pad(blk.valid, [(0, capacity - cap)])
+            )
             blocks.append(dataclasses.replace(blk, data=data, valid=valid))
         else:
             data = blk.data[:capacity]

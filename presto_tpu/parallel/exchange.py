@@ -55,13 +55,18 @@ def partition_hash(page: Page, key_cols: Sequence[str]) -> jnp.ndarray:
     (NULLs normalized to a sentinel), so equal keys hash equally on every
     worker and both sides of a join.
     """
+    from presto_tpu.ops.common import key_lanes
+
     h = jnp.full((page.capacity,), 0x9E3779B97F4A7C15, dtype=jnp.uint64)
     for c in key_cols:
         blk = page.block(c)
-        x = orderable_i64(blk.data, blk.dtype).astype(jnp.uint64)
-        if blk.valid is not None:
-            x = jnp.where(blk.valid, x, jnp.uint64(_NULL_SENTINEL))
-        h = _mix64(h ^ x)
+        # long decimals contribute both int64 limb lanes (key_lanes),
+        # so equal int128 values hash equally; other types are one lane
+        for lane in key_lanes(blk.data, blk.dtype):
+            x = lane.astype(jnp.uint64)
+            if blk.valid is not None:
+                x = jnp.where(blk.valid, x, jnp.uint64(_NULL_SENTINEL))
+            h = _mix64(h ^ x)
     return h
 
 

@@ -222,9 +222,9 @@ class UnnestNode(PlanNode):
     def output_schema(self):
         out = dict(self.source.output_schema())
         if self.array_column is not None:
-            # column form drops array columns (their repeated rows
+            # column form drops nested columns (their repeated rows
             # could exceed the flat value capacity; see ops.unnest_column)
-            out = {n: t for n, t in out.items() if not t.is_array}
+            out = {n: t for n, t in out.items() if not t.is_nested}
         out[self.out_name] = self.out_type
         if self.ordinality_name is not None:
             out[self.ordinality_name] = T.BIGINT

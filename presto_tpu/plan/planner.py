@@ -240,15 +240,10 @@ class _Planner:
                     nm = self._fresh("sort")
                     order_extra.append((nm, key_expr))
                     k = E.ColumnRef(nm, key_expr.dtype)
-                if k.dtype.is_long_decimal:
+                if k.dtype.is_nested:
                     raise PlanningError(
-                        "ORDER BY a long decimal is not supported "
-                        "(documented deviation; cast to decimal(18,s) "
-                        "or double to sort)"
-                    )
-                if k.dtype.is_array:
-                    raise PlanningError(
-                        "ORDER BY an array column is not supported"
+                        f"ORDER BY a {k.dtype.name} column is not "
+                        "supported"
                     )
                 sort_keys.append(
                     SortKey(k, si.descending, si.nulls_first)
@@ -758,6 +753,16 @@ class _Planner:
             )
         if not lkeys:
             raise PlanningError("outer join requires at least one equi key")
+        lsch = dict(left_scope.columns)
+        for k in lkeys:
+            if lsch[k].is_long_decimal:
+                # preserved-row semantics leave no place to apply a
+                # residual collision filter over the 128->64 key mix
+                raise PlanningError(
+                    "outer join on a long decimal (p>18) key is not "
+                    "supported (documented deviation; cast to "
+                    "decimal(18,s))"
+                )
         if build_filters and jt == "full":
             # pushing an ON filter into the build side is only sound when
             # the build's unmatched rows are dropped (left) — a FULL join
@@ -997,6 +1002,31 @@ class _Planner:
             build = rels[nxt]
             extra_pairs: List[Tuple[str, str]] = []
             forced_unique = None
+            tree_sch = dict(tree.output_schema())
+            for ci, _ in pairs:
+                if tree_sch[ci].is_nested:
+                    raise PlanningError(
+                        f"join on a {tree_sch[ci].name} column is not "
+                        "supported"
+                    )
+            ld_pairs = [
+                p for p in pairs if tree_sch[p[0]].is_long_decimal
+            ]
+            if ld_pairs:
+                # long-decimal (int128) equi keys: the kernel key is a
+                # 128->64 mix (ops.join._key_of), so EVERY long-decimal
+                # pair — including one used as the kernel key — is also
+                # demoted to a residual limb-equality filter; a mix
+                # collision becomes a filtered row, never a wrong one.
+                # Inner joins only (this pool is inner by construction).
+                norm = [p for p in pairs if p not in ld_pairs]
+                extra_pairs.extend(ld_pairs)
+                if norm:
+                    pairs = norm
+                else:
+                    pairs = ld_pairs[:1]
+                    # the mix can collide: never trust m in {0,1}
+                    forced_unique = False
             if len(pairs) > 2:
                 # widen past the kernel's 2x32-bit composite: when
                 # connector stats bound every key column's range, the
@@ -1023,9 +1053,9 @@ class _Planner:
                             break
                     if best is None:
                         best = (0, 1)
-                    extra_pairs = [
+                    extra_pairs.extend(
                         p for k, p in enumerate(pairs) if k not in best
-                    ]
+                    )
                     pairs = [pairs[k] for k in best]
             lkeys = tuple(p[0] for p in pairs)
             rkeys = tuple(p[1] for p in pairs)
@@ -1255,6 +1285,15 @@ class _Planner:
         if len(sub_names) != 1:
             raise PlanningError("IN subquery must return one column")
         node, scope, key = self._probe_key(node, scope, a.arg)
+        if scope.columns[key].is_long_decimal:
+            # semi/anti output has no build columns, so the kernel's
+            # mixed long-decimal key cannot be residual-verified — a mix
+            # collision would KEEP a wrong row. Inner joins stay exact
+            # (residual limb equality); membership tests keep the gate.
+            raise PlanningError(
+                "IN/NOT IN on a long decimal (p>18) is not supported "
+                "(documented deviation; cast to decimal(18,s))"
+            )
         if negate:
             node = self._null_aware_prefilter(node, scope, a.query, key)
         node = N.JoinNode(
@@ -1360,6 +1399,16 @@ class _Planner:
                 "uncorrelated or non-equality-correlated EXISTS is not "
                 "supported yet"
             )
+        for _, outer_col in corr_pairs:
+            if scope.columns[outer_col].is_long_decimal:
+                # before the neq_pairs branch: BOTH decorrelation forms
+                # end in a semi/anti join whose keys cannot
+                # residual-verify the 128->64 key mix
+                raise PlanningError(
+                    "EXISTS correlated on a long decimal (p>18) is not "
+                    "supported (documented deviation: semi-join keys "
+                    "cannot residual-verify the 128->64 key mix)"
+                )
         if neq_pairs:
             if len(neq_pairs) > 1:
                 raise PlanningError(
@@ -1660,16 +1709,9 @@ class _Planner:
                     )
                 g = sel.items[idx].expr
             e = self._lower(g, scope)
-            if e.dtype.is_long_decimal:
+            if e.dtype.is_nested:
                 raise PlanningError(
-                    "GROUP BY a long decimal is not supported "
-                    "(documented deviation; cast to decimal(18,s) "
-                    "or varchar to group)"
-                )
-            if e.dtype.is_array:
-                raise PlanningError(
-                    "GROUP BY an array column is not supported "
-                    "(unnest first)"
+                    f"GROUP BY a {e.dtype.name} column is not supported"
                 )
             if isinstance(e, E.ColumnRef):
                 group_keys.append((e.name, e))
@@ -2024,7 +2066,31 @@ class _Planner:
             return E.ColumnRef(name, scope.columns[name])
 
         if isinstance(e, ast.Ident):
-            name, dtype, is_outer = scope.resolve(e.parts)
+            try:
+                name, dtype, is_outer = scope.resolve(e.parts)
+            except PlanningError:
+                # row field access: the trailing part may be a field of
+                # a ROW column (reference: DereferenceExpression)
+                if len(e.parts) < 2:
+                    raise
+                base, dtype, is_outer = scope.resolve(e.parts[:-1])
+                if not dtype.is_row:
+                    raise
+                field = e.parts[-1]
+                try:
+                    fi = dtype.field_index(field)
+                except KeyError:
+                    raise PlanningError(
+                        f"row type {dtype} has no field {field}"
+                    ) from None
+                if is_outer:
+                    raise PlanningError(
+                        f"correlated reference {e} outside a supported "
+                        "decorrelation pattern"
+                    )
+                return E.RowFieldAccess(
+                    E.ColumnRef(base, dtype), field, dtype.fields[fi][1]
+                )
             if is_outer:
                 raise PlanningError(
                     f"correlated reference {e} outside a supported "
@@ -2161,6 +2227,54 @@ class _Planner:
             )
         raise PlanningError(f"cannot lower {type(e).__name__}")
 
+    def _map_subscript_key(self, key: E.Expr, kt) -> E.Expr:
+        """Normalize a map-subscript key into the key child's VALUE
+        DOMAIN so the kernel's raw device-representation compare is
+        exact (unscaled decimals would otherwise compare 10 vs 1 for
+        the same value; fractional doubles would truncate onto spurious
+        integer matches)."""
+        if kt.is_long_decimal:
+            raise PlanningError(
+                "long-decimal map keys are not supported"
+            )
+        if key.dtype == kt:
+            return key
+        if kt.is_integer and key.dtype.is_integer:
+            return key  # widths widen exactly in the kernel
+        if (
+            kt.is_integer
+            and key.dtype.is_decimal
+            and not key.dtype.is_long_decimal
+            and isinstance(key, E.Literal)
+            and key.value is not None
+        ):
+            # integer-valued decimal literal (m[1.0]): fold to the
+            # integer it equals; fractional literals match no key
+            unscaled, s = int(key.value), key.dtype.scale
+            if unscaled % (10 ** s) == 0:
+                return E.Literal(unscaled // (10 ** s), kt)
+            return E.Literal(None, kt)  # x.5 = no integer key
+        if kt.name in ("double", "real") and (
+            key.dtype.is_integer or key.dtype.name in ("double", "real")
+        ):
+            return E.Cast(key, kt)
+        if kt.is_decimal and (
+            key.dtype.is_integer
+            or (
+                key.dtype.is_decimal
+                and not key.dtype.is_long_decimal
+                and key.dtype.scale <= kt.scale
+            )
+        ):
+            # exact rescale into kt's unscaled domain
+            return E.Cast(key, kt)
+        if kt.is_string and key.dtype.is_string:
+            return key
+        raise PlanningError(
+            f"map key type {kt} does not admit a subscript of type "
+            f"{key.dtype} (exact-equality domains only)"
+        )
+
     def _lower_array_func(self, e: ast.FuncCall, lower):
         """Array functions over ARRAY[...] constructors. Arrays are
         trace-time expression lists (see N.UnnestNode), so these fold
@@ -2194,6 +2308,22 @@ class _Planner:
                 raise PlanningError(
                     f"{e.name}() over physical array columns is not "
                     "supported (cardinality/element_at/unnest are)"
+                )
+            if arg0.dtype.is_map:
+                if e.name == "cardinality":
+                    return E.ArrayLength(arg0)
+                if e.name == "element_at":
+                    if len(e.args) != 2:
+                        raise PlanningError(
+                            "element_at() takes two arguments"
+                        )
+                    key = lower(e.args[1])
+                    kt = arg0.dtype.key
+                    key = self._map_subscript_key(key, kt)
+                    return E.MapSubscript(arg0, key)
+                raise PlanningError(
+                    f"{e.name}() over map columns is not supported "
+                    "(cardinality/element_at/the [] subscript are)"
                 )
         if not e.args or not isinstance(e.args[0], ast.ArrayLit):
             raise PlanningError(

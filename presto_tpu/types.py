@@ -73,6 +73,19 @@ class DataType:
     def is_array(self) -> bool:
         return False
 
+    @property
+    def is_map(self) -> bool:
+        return False
+
+    @property
+    def is_row(self) -> bool:
+        return False
+
+    @property
+    def is_nested(self) -> bool:
+        """array/map/row — types whose blocks carry offsets/children."""
+        return self.is_array or self.is_map or self.is_row
+
     def __str__(self) -> str:
         return self.name
 
@@ -373,6 +386,76 @@ def array(element: DataType) -> ArrayType:
     return ArrayType(element=element)
 
 
+@dataclasses.dataclass(frozen=True)
+class MapType(DataType):
+    """map(K, V) — physical map columns (reference: MapType /
+    MapBlock, SURVEY.md §2.1 "Type system").
+
+    Device representation: an int32 offsets array (capacity+1) shared
+    by TWO flat child blocks — keys and values (``Block.children``);
+    row i's entries are ``keys[offsets[i]:offsets[i+1]]`` zipped with
+    the same span of values. Per-row validity as usual. Key lookup is
+    a flat segment-max scan (expr.MapSubscript) — branch-free, one
+    pass over the values axis."""
+
+    key: DataType = None  # type: ignore[assignment]
+    value: DataType = None  # type: ignore[assignment]
+    name: str = "map"
+
+    @property
+    def jnp_dtype(self):
+        raise TypeError("map columns have no single dtype (children)")
+
+    @property
+    def is_map(self) -> bool:
+        return True
+
+    def __str__(self) -> str:
+        return f"map({self.key},{self.value})"
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(DataType):
+    """row(f1 T1, ..., fk Tk) — physical struct columns (reference:
+    RowType / RowBlock, SURVEY.md §2.1 "Type system").
+
+    Device representation: one child block per field, all at the row
+    capacity (``Block.children``, shredded layout — the columnar form
+    parquet/ORC use for structs); per-row validity on the parent.
+    Field access (expr.RowFieldAccess) is a zero-copy child select."""
+
+    fields: tuple = ()  # ((name, DataType), ...)
+    name: str = "row"
+
+    @property
+    def jnp_dtype(self):
+        raise TypeError("row columns have no single dtype (children)")
+
+    @property
+    def is_row(self) -> bool:
+        return True
+
+    def field_index(self, name: str) -> int:
+        for i, (n, _) in enumerate(self.fields):
+            if n.lower() == name.lower():
+                return i
+        raise KeyError(name)
+
+    def __str__(self) -> str:
+        inner = ", ".join(f"{n} {t}" for n, t in self.fields)
+        return f"row({inner})"
+
+
+def map_(key: DataType, value: DataType) -> MapType:
+    return MapType(key=key, value=value)
+
+
+def row(*fields) -> RowType:
+    """row(("a", BIGINT), ("b", VARCHAR)) or row(a=BIGINT, ...) via
+    tuple pairs."""
+    return RowType(fields=tuple((n, t) for n, t in fields))
+
+
 def parse_type(text: str) -> DataType:
     """Parse a SQL type string, e.g. ``decimal(12,2)`` or ``varchar(25)``."""
     t = text.strip().lower()
@@ -389,7 +472,39 @@ def parse_type(text: str) -> DataType:
         return varchar(int(inner))
     if t.startswith("array(") and t.endswith(")"):
         return array(parse_type(t[len("array(") : -1]))
+    if t.startswith("map(") and t.endswith(")"):
+        parts = _split_top(t[len("map(") : -1])
+        if len(parts) != 2:
+            raise ValueError(f"map type needs key,value: {text}")
+        return map_(parse_type(parts[0]), parse_type(parts[1]))
+    if t.startswith("row(") and t.endswith(")"):
+        fields = []
+        for p in _split_top(t[len("row(") : -1]):
+            p = p.strip()
+            sp = p.find(" ")
+            if sp < 0:
+                raise ValueError(f"row field needs 'name type': {p}")
+            fields.append((p[:sp], parse_type(p[sp + 1 :])))
+        return RowType(fields=tuple(fields))
     raise ValueError(f"unknown type: {text}")
+
+
+def _split_top(s: str) -> list:
+    """Split on commas at paren depth 0."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur or parts:
+        parts.append("".join(cur).strip())
+    return parts
 
 
 # --- coercion lattice (reference: presto-common TypeCoercion) -------------

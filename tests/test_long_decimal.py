@@ -212,14 +212,97 @@ def test_cast_short_to_long_and_back(runner):
 
 
 def test_documented_gates(runner):
-    for sql, frag in [
-        ("select sum(amt) from lake.s.t", "long-decimal"),
-        ("select amt from lake.s.t group by amt", "GROUP BY a long"),
-        ("select amt from lake.s.t order by amt", "ORDER BY a long"),
+    """Remaining long-decimal gates: accumulators and membership tests
+    (semi/anti keys cannot residual-verify the 128->64 key mix)."""
+    for sql in [
+        "select sum(amt) from lake.s.t",
+        "select id from lake.s.t where amt in "
+        "(select amt from lake.s.t where id < 5)",
     ]:
         with pytest.raises(Exception) as ei:
             runner.execute(sql).rows()
         assert "long" in str(ei.value).lower(), sql
+
+
+def test_group_by_long_decimal_exact(runner, lake):
+    """GROUP BY decimal(30,3): limb-pair key lanes (ops.common.key_lanes)
+    — every distinct int128 value is its own group, exactly."""
+    _, vals = lake
+    rows = runner.execute(
+        "select amt, count(*) as n from lake.s.t group by amt"
+    ).rows()
+    import collections
+
+    expect = collections.Counter(vals)
+    got = {a: n for a, n in rows}
+    assert len(got) == len(expect)
+    assert got == dict(expect)
+
+
+def test_group_by_long_decimal_with_nulls(runner):
+    t = T.decimal(25, 2)
+    vals = [
+        decimal.Decimal("123456789012345678901.01"),
+        None,
+        decimal.Decimal("123456789012345678901.01"),
+        decimal.Decimal("-0.02"),
+        None,
+        None,
+    ]
+    from presto_tpu.connectors import create_connector
+    from presto_tpu.connectors.spi import TableHandle
+
+    mem = create_connector("memory")
+    runner.catalogs.register("ldmem", mem)
+    h = TableHandle("ldmem", "s", "g")
+    mem.create_table(h, {"x": t})
+    mem.append_rows(h, {"x": np.asarray(vals, dtype=object)})
+    rows = runner.execute(
+        "select x, count(*) as n from ldmem.s.g group by x"
+    ).rows()
+    got = dict(rows)
+    assert got == {
+        decimal.Decimal("123456789012345678901.01"): 2,
+        decimal.Decimal("-0.02"): 1,
+        None: 3,
+    }
+
+
+def test_order_by_long_decimal_exact(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select id, amt from lake.s.t order by amt desc, id limit 50"
+    ).rows()
+    expect = sorted(
+        enumerate(vals), key=lambda p: (-p[1], p[0])
+    )[:50]
+    assert [(i, a) for i, a in rows] == expect
+
+
+def test_distinct_long_decimal(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select distinct amt from lake.s.t where id < 100"
+    ).rows()
+    assert sorted(r[0] for r in rows) == sorted(set(vals[:100]))
+
+
+def test_inner_join_on_long_decimal(runner, lake):
+    """Inner equi-join on decimal(30,3): kernel key is the 128->64 mix
+    with a residual limb-equality filter (plan/planner.py ld_pairs) —
+    exact regardless of mix collisions."""
+    _, vals = lake
+    rows = runner.execute(
+        "select a.id, b.id from lake.s.t a, lake.s.t b "
+        "where a.amt = b.amt and a.id < 30 and b.id < 30"
+    ).rows()
+    expect = sorted(
+        (i, j)
+        for i in range(30)
+        for j in range(30)
+        if vals[i] == vals[j]
+    )
+    assert sorted(rows) == expect
 
 
 def test_long_plus_double_is_double(runner, lake):
@@ -253,15 +336,16 @@ def test_unnest_page_with_long_decimal_column(runner, lake):
         assert a == vals[i], (i, m)
 
 
-def test_join_key_gate(runner):
-    with pytest.raises(Exception) as ei:
-        runner.execute(
-            "select count(*) from lake.s.t a, lake.s.t b "
-            "where a.amt = b.amt"
-        ).rows()
-    assert "long decimal" in str(ei.value).lower() or "long-decimal" in (
-        str(ei.value).lower()
-    )
+def test_join_on_long_decimal_count(runner, lake):
+    _, vals = lake
+    rows = runner.execute(
+        "select count(*) as n from lake.s.t a, lake.s.t b "
+        "where a.amt = b.amt"
+    ).rows()
+    import collections
+
+    cnt = collections.Counter(vals)
+    assert rows == [(sum(c * c for c in cnt.values()),)]
 
 
 def test_element_at_negative_index(runner):

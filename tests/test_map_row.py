@@ -1,0 +1,228 @@
+"""MAP/ROW physical types (reference: presto-common MapType/RowType,
+MapBlock/RowBlock — SURVEY.md §2.1 "Type system" / "Block/Page data
+model"). Device layout: maps = shared offsets over flat key/value child
+blocks; rows = shredded per-field child blocks (Block.children).
+Oracle: the host language (sqlite has no nested types)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import create_connector
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager, obj_array
+from presto_tpu.page import Page
+from presto_tpu.plan.planner import PlanningError
+
+
+def test_parse_nested_types():
+    m = T.parse_type("map(varchar, bigint)")
+    assert m.is_map and m.key.is_string and m.value.name == "bigint"
+    r = T.parse_type("row(a bigint, b varchar)")
+    assert r.is_row and r.fields[0] == ("a", T.BIGINT)
+    nested = T.parse_type("map(integer, row(x double, y double))")
+    assert nested.value.is_row
+    assert not T.BIGINT.is_nested and m.is_nested and r.is_nested
+
+
+MAPS = [
+    {"a": 1, "b": 2},
+    {},
+    None,
+    {"c": 30, "a": 10},
+    {"z": None, "q": 7},
+]
+ROWS = [
+    {"x": 1.5, "y": "one"},
+    {"x": -2.0, "y": "two"},
+    None,
+    {"x": 0.25, "y": None},
+    {"x": 9.0, "y": "nine"},
+]
+
+
+def test_page_roundtrip_map_row():
+    mt = T.map_(T.VARCHAR, T.BIGINT)
+    rt = T.row(("x", T.DOUBLE), ("y", T.VARCHAR))
+    p = Page.from_pydict(
+        {"m": MAPS, "r": ROWS}, {"m": mt, "r": rt}, capacity=8
+    )
+    out = p.to_pylist()
+    assert [row["m"] for row in out] == MAPS
+    assert [row["r"] for row in out] == ROWS
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    mem = create_connector("memory")
+    catalogs.register("mem", mem)
+    h = TableHandle("mem", "s", "t")
+    mem.create_table(
+        h,
+        {
+            "id": T.BIGINT,
+            "m": T.map_(T.VARCHAR, T.BIGINT),
+            "im": T.map_(T.INTEGER, T.DOUBLE),
+            "r": T.row(("x", T.DOUBLE), ("y", T.VARCHAR)),
+        },
+    )
+    mem.append_rows(
+        h,
+        {
+            "id": np.arange(5, dtype=np.int64),
+            "m": obj_array(MAPS),
+            "im": obj_array(
+                [{1: 0.5}, {2: 1.5, 3: -2.5}, {}, None, {1: 9.0}]
+            ),
+            "r": obj_array(ROWS),
+        },
+    )
+    return LocalQueryRunner(catalogs=catalogs)
+
+
+def test_select_whole_map_and_row(runner):
+    rows = runner.execute("select id, m, r from mem.s.t").rows()
+    assert [r[1] for r in rows] == MAPS
+    assert [r[2] for r in rows] == ROWS
+
+
+def test_map_subscript_string_key(runner):
+    rows = runner.execute("select id, m['a'] as v from mem.s.t").rows()
+    assert rows == [(0, 1), (1, None), (2, None), (3, 10), (4, None)]
+
+
+def test_map_element_at_int_key(runner):
+    rows = runner.execute(
+        "select id, element_at(im, 1) as v from mem.s.t"
+    ).rows()
+    assert rows == [
+        (0, 0.5), (1, None), (2, None), (3, None), (4, 9.0),
+    ]
+
+
+def test_map_subscript_null_value(runner):
+    rows = runner.execute("select m['z'] as v from mem.s.t").rows()
+    assert [r[0] for r in rows] == [None, None, None, None, None]
+
+
+def test_map_cardinality(runner):
+    rows = runner.execute(
+        "select id, cardinality(m) as n from mem.s.t"
+    ).rows()
+    assert rows == [(0, 2), (1, 0), (2, None), (3, 2), (4, 2)]
+
+
+def test_row_field_access(runner):
+    rows = runner.execute("select id, r.x, r.y from mem.s.t").rows()
+    assert rows == [
+        (0, 1.5, "one"),
+        (1, -2.0, "two"),
+        (2, None, None),
+        (3, 0.25, None),
+        (4, 9.0, "nine"),
+    ]
+
+
+def test_filter_on_row_field(runner):
+    rows = runner.execute(
+        "select id from mem.s.t where r.x > 0 order by id"
+    ).rows()
+    assert rows == [(0,), (3,), (4,)]
+
+
+def test_filter_on_map_subscript(runner):
+    rows = runner.execute(
+        "select id from mem.s.t where m['a'] >= 10"
+    ).rows()
+    assert rows == [(3,)]
+
+
+def test_group_by_row_field(runner):
+    rows = runner.execute(
+        "select r.y is null as has_null, count(*) as n from mem.s.t "
+        "where id <> 2 group by r.y is null order by has_null"
+    ).rows()
+    assert rows == [(False, 3), (True, 1)]
+
+
+def test_nested_key_bans(runner):
+    for sql in [
+        "select m from mem.s.t group by m",
+        "select m from mem.s.t order by m",
+        "select count(*) from mem.s.t a, mem.s.t b where a.m = b.m",
+    ]:
+        with pytest.raises(PlanningError):
+            runner.execute(sql).rows()
+
+
+def test_row_field_missing(runner):
+    with pytest.raises(PlanningError) as ei:
+        runner.execute("select r.zz from mem.s.t").rows()
+    assert "no field" in str(ei.value)
+
+
+def test_nested_in_nested_gated():
+    """One nesting level (documented deviation): constructing a block
+    whose map/row CHILD is itself nested raises loud instead of
+    silently mis-decoding (review finding r5)."""
+    rt = T.row(("a", T.array(T.BIGINT)), ("b", T.BIGINT))
+    with pytest.raises(NotImplementedError):
+        Page.from_pydict(
+            {"r": [{"a": [1, 2], "b": 3}]}, {"r": rt}
+        )
+    mt = T.map_(T.VARCHAR, T.row(("x", T.BIGINT)))
+    with pytest.raises(NotImplementedError):
+        Page.from_pydict({"m": [{"k": {"x": 1}}]}, {"m": mt})
+
+
+def test_map_subscript_key_domain(runner):
+    """Numeric subscripts normalize into the key child's exact value
+    domain: 1.0 (decimal) finds integer key 1; fractional keys are
+    rejected at plan time, never truncated (review finding r5)."""
+    rows = runner.execute(
+        "select id, element_at(im, 1.0) as v from mem.s.t order by id"
+    ).rows()
+    assert [r[1] for r in rows] == [0.5, None, None, None, 9.0]
+    with pytest.raises(PlanningError):
+        runner.execute("select m[1] from mem.s.t").rows()
+
+
+def test_nested_through_join_window_raise_loud(runner):
+    """Row/map columns riding a join output or a window operator would
+    be silently mis-gathered (children unpermuted) — they must raise at
+    the kernel guard instead (review finding r5 #2)."""
+    with pytest.raises(Exception) as ei:
+        runner.execute(
+            "select a.id, a.r from mem.s.t a, mem.s.t b "
+            "where a.id = b.id"
+        ).rows()
+    assert "nested column" in str(ei.value)
+    with pytest.raises(Exception) as ei:
+        runner.execute(
+            "select id, r, row_number() over (order by id) as rn "
+            "from mem.s.t"
+        ).rows()
+    assert "nested column" in str(ei.value)
+
+
+def test_map_subscript_wide_key_no_wrap(runner):
+    """A bigint subscript of 2^32+1 must MISS integer key 1, not wrap
+    onto it (review finding r5 #3)."""
+    rows = runner.execute(
+        "select id, element_at(im, 4294967297) as v from mem.s.t "
+        "order by id"
+    ).rows()
+    assert [r[1] for r in rows] == [None] * 5
+
+
+def test_whole_map_through_order_by_id(runner):
+    """Host root-stage sort permutes object-form map/row columns."""
+    rows = runner.execute(
+        "select id, m, r from mem.s.t order by id desc"
+    ).rows()
+    assert [r[0] for r in rows] == [4, 3, 2, 1, 0]
+    assert [r[1] for r in rows] == MAPS[::-1]
+    assert [r[2] for r in rows] == ROWS[::-1]
