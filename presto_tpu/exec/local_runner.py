@@ -111,6 +111,10 @@ class LocalQueryRunner:
             catalogs.register("system", SystemConnector(runner=self))
         self._compiled: Dict[object, object] = {}
         self._table_cache: Dict[Tuple, Page] = {}
+        #: staged split-batch pages, keyed down to (lo, hi, capacity) —
+        #: the table cache at split granularity, gated by the
+        #: stream_split_cache session property (SURVEY.md §5.7)
+        self._split_cache: Dict[Tuple, Page] = {}
         # QueryStats while a query is in flight — THREAD-local: a
         # server embedding this runner executes admitted queries on
         # concurrent threads, and a shared slot races (one thread's
@@ -295,6 +299,16 @@ class LocalQueryRunner:
             }
             conn.append_rows(handle, cols)
             n = len(rows)
+        # a write invalidates every cached page of the written table —
+        # whole-table AND split granularity — else a cacheable writable
+        # connector (memory) silently serves stale pages on re-run
+        for cache in (self._table_cache, self._split_cache):
+            for k in [k for k in cache if k[0] == handle]:
+                stale = cache.pop(k)
+                if self.memory_pool is not None:
+                    self.memory_pool.release(
+                        "table-cache", _page_nbytes(stale)
+                    )
         page = Page.from_pydict({"rows": [n]}, {"rows": T.BIGINT})
         return QueryResult(("rows",), page)
 
@@ -756,11 +770,7 @@ class LocalQueryRunner:
             with self._device_scope():
                 page = stage_page(merged, dict(scan.schema))
             if self.memory_pool is not None:
-                nbytes = sum(
-                    int(b.data.nbytes)
-                    + (int(b.valid.nbytes) if b.valid is not None else 0)
-                    for b in page.blocks
-                )
+                nbytes = _page_nbytes(page)
                 cacheable = self.catalogs.get(
                     scan.handle.catalog
                 ).cacheable()
@@ -789,6 +799,58 @@ class LocalQueryRunner:
             )
         return page
 
+    def _load_split(
+        self, scan: N.TableScanNode, lo: int, hi: int, capacity: int
+    ) -> Page:
+        """Stage ONE split batch [lo, hi) of a scan to device at a
+        fixed capacity — with an optional device-resident cache across
+        queries (``stream_split_cache``), so repeated streamed passes
+        over the same splits pay the host->device transfer once
+        (SURVEY.md §5.7: the table cache at split granularity).
+
+        The pushed constraint is deliberately NOT part of the identity:
+        split page sources read raw split ranges (constraints act at
+        enumeration/filter time), so the staged batch is
+        constraint-independent."""
+        from presto_tpu.connectors.spi import ConnectorSplit
+        from presto_tpu.exec.staging import stage_page
+
+        cache_on = bool(self.session.get("stream_split_cache"))
+        conn = self.catalogs.get(scan.handle.catalog)
+        key = (
+            scan.handle,
+            scan.columns,
+            lo,
+            hi,
+            capacity,
+            self.session.get("tpu_offload"),
+        )
+        if cache_on:
+            page = self._split_cache.get(key)
+            if page is not None:
+                return page
+        t0 = time.perf_counter()
+        payload = conn.create_page_source(
+            ConnectorSplit(scan.handle, lo, hi), list(scan.columns)
+        )
+        with self._device_scope():
+            page = stage_page(
+                payload, dict(scan.schema), capacity=capacity
+            )
+        if self._active_qs is not None:
+            self._active_qs.staging_ms += (
+                time.perf_counter() - t0
+            ) * 1000.0
+        if cache_on and conn.cacheable():
+            # the staged page still serves THIS batch either way; a
+            # full pool just means the split isn't cached (try_reserve
+            # never kills a query to make cache room)
+            if self.memory_pool is None or self.memory_pool.try_reserve(
+                "table-cache", _page_nbytes(page)
+            ):
+                self._split_cache[key] = page
+        return page
+
     def _load_merged_payload(self, scan: N.TableScanNode) -> Dict:
         """Fetch all splits of a scan and merge their column payloads.
         The scan's pushed constraint reaches the connector here (hive
@@ -806,6 +868,24 @@ class LocalQueryRunner:
                     conn.create_page_source(split, list(scan.columns))
                 )
         return _merge_split_payloads(datas, list(scan.columns))
+
+
+def _block_nbytes(b) -> int:
+    n = int(b.data.nbytes)
+    if b.valid is not None:
+        n += int(b.valid.nbytes)
+    if b.offsets is not None:
+        n += int(b.offsets.nbytes)
+    for child in b.children or ():
+        n += _block_nbytes(child)
+    return n
+
+
+def _page_nbytes(page: Page) -> int:
+    """Device bytes a staged page holds (data/validity/offsets buffers,
+    recursing into array/map/row children) — the memory-pool
+    reservation unit for cached pages."""
+    return sum(_block_nbytes(b) for b in page.blocks)
 
 
 def _page_from_prefix(page: Page, prefix_leaves, n: int) -> Page:

@@ -31,7 +31,6 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
-from presto_tpu.connectors.spi import ConnectorSplit
 from presto_tpu.connectors.tpch import DictColumn
 from presto_tpu.exec.staging import MaskedColumn, stage_page
 from presto_tpu.plan import nodes as N
@@ -157,19 +156,12 @@ def _run_fragment(runner, frag_root: N.PlanNode, materialized: Dict):
         elif n is not part_scan:
             base_pages[id(n)] = runner._load_table(n)
 
-    conn = runner.catalogs.get(part_scan.handle.catalog)
     spill: List[List[tuple]] = [[] for _ in range(n_buckets)]
     for lo in range(0, stage.partition_rows, batch):
         hi = min(lo + batch, stage.partition_rows)
-        payload = conn.create_page_source(
-            ConnectorSplit(part_scan.handle, lo, hi),
-            list(part_scan.columns),
-        )
         # fixed capacity: every batch (incl. the tail) reuses ONE
         # compiled partial-fragment program
-        batch_page = stage_page(
-            payload, dict(part_scan.schema), capacity=batch_cap
-        )
+        batch_page = runner._load_split(part_scan, lo, hi, batch_cap)
         pages = [
             batch_page if n is part_scan else base_pages[id(n)]
             for n in leaves
@@ -548,16 +540,9 @@ def _stream_side_to_buckets(
     batch = min(int(runner.session.get("page_capacity")), max_rows)
     batch_cap = bucket_capacity(batch)
     total = _scan_rows(runner.catalogs, big_scan)
-    conn = runner.catalogs.get(big_scan.handle.catalog)
     for lo in range(0, total, batch):
         hi = min(lo + batch, total)
-        payload = conn.create_page_source(
-            ConnectorSplit(big_scan.handle, lo, hi),
-            list(big_scan.columns),
-        )
-        batch_page = stage_page(
-            payload, dict(big_scan.schema), capacity=batch_cap
-        )
+        batch_page = runner._load_split(big_scan, lo, hi, batch_cap)
         spill_page(
             runner._run_with_pages(side_root, [big_scan], [batch_page])
         )

@@ -165,6 +165,18 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _positive("max_device_rows"),
         ),
         PropertyMetadata(
+            "stream_split_cache",
+            "Keep staged split-batch pages device-resident across "
+            "queries (cacheable connectors only), so repeated streamed "
+            "passes over the same splits skip the host->device "
+            "re-staging transfer (the table cache at split "
+            "granularity — SURVEY.md §5.7). Off by default: caching "
+            "every split defeats larger-than-HBM discipline when the "
+            "working set genuinely exceeds device memory",
+            bool,
+            False,
+        ),
+        PropertyMetadata(
             "max_fragment_weight",
             "Largest plan weight compiled as ONE XLA program; heavier "
             "plans execute stage-at-a-time with device-resident "

@@ -90,6 +90,21 @@ class MemoryPool:
                 f"holder {largest})"
             )
 
+    def try_reserve(self, query_id: str, nbytes: int) -> bool:
+        """Reserve only if headroom already exists — never invokes the
+        kill-largest policy, never raises. For opportunistic holders
+        (the split cache) where failure just means "don't cache"; a
+        cache fill must never kill a running query to make room."""
+        with self._lock:
+            if query_id in self._dead:
+                return False
+            if sum(self._used.values()) + int(nbytes) > self.limit:
+                return False
+            self._used[query_id] = (
+                self._used.get(query_id, 0) + int(nbytes)
+            )
+            return True
+
     def release(self, query_id: str, nbytes: Optional[int] = None) -> None:
         """Release ``nbytes`` of a holder's reservation (None = all)."""
         with self._lock:

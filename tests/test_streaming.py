@@ -208,3 +208,80 @@ def test_join_build_spill_left_payload(tight_runner, oracle):
     )
     diff = verify_query(tight_runner, oracle, q)
     assert diff is None, diff
+
+
+def test_split_cache_skips_restaging(oracle):
+    """With stream_split_cache on, the SECOND streamed pass over the
+    same scan must not touch the connector for split batches (the
+    table cache at split granularity — SURVEY.md §5.7; the bench's
+    q18_sf1_streamed protocol fix)."""
+    r = LocalQueryRunner(
+        session=Session(
+            properties={
+                "max_device_rows": MAX_DEVICE_ROWS,
+                "page_capacity": BATCH_ROWS,
+                "stream_split_cache": True,
+            }
+        )
+    )
+    conn = r.catalogs.get("tpch")
+    calls = []
+    orig = conn.create_page_source
+
+    def spy(split, columns):
+        calls.append(split)
+        return orig(split, columns)
+
+    q = (
+        "select l_returnflag, sum(l_quantity) as s, count(*) as c "
+        "from tpch.tiny.lineitem group by l_returnflag"
+    )
+    conn.create_page_source = spy
+    try:
+        first = r.execute(q)
+        n_first = len(calls)
+        calls.clear()
+        second = r.execute(q)
+        n_second = len(calls)
+    finally:
+        conn.create_page_source = orig
+    assert n_first >= 10, f"expected >=10 staged batches, {n_first}"
+    assert n_second == 0, (
+        f"second pass re-staged {n_second} splits through the cache"
+    )
+    assert sorted(first.rows()) == sorted(second.rows())
+
+
+def test_split_cache_off_by_default(oracle):
+    """Default sessions must re-stage (caching every split defeats
+    larger-than-HBM discipline when the set genuinely exceeds HBM)."""
+    r = LocalQueryRunner(
+        session=Session(
+            properties={
+                "max_device_rows": MAX_DEVICE_ROWS,
+                "page_capacity": BATCH_ROWS,
+            }
+        )
+    )
+    conn = r.catalogs.get("tpch")
+    calls = []
+    orig = conn.create_page_source
+
+    def spy(split, columns):
+        calls.append(split)
+        return orig(split, columns)
+
+    q = (
+        "select count(*) as c from tpch.tiny.lineitem "
+        "where l_quantity < 10"
+    )
+    conn.create_page_source = spy
+    try:
+        r.execute(q)
+        n_first = len(calls)
+        calls.clear()
+        r.execute(q)
+        n_second = len(calls)
+    finally:
+        conn.create_page_source = orig
+    assert n_second == n_first >= 10

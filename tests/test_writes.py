@@ -73,3 +73,18 @@ def test_insert_into_readonly_catalog_fails(runner):
 def test_insert_arity_mismatch(runner):
     with pytest.raises(ExecutionError, match="arity"):
         runner.execute("insert into mem.default.kv values (1, 'a', 2)")
+
+
+def test_insert_invalidates_cached_pages(runner):
+    """A write must drop every cached page of the written table: the
+    staged-page caches (whole-table and split granularity) otherwise
+    serve stale rows to the NEXT query (regression: the second SELECT
+    returned the pre-insert count)."""
+    runner.execute("insert into mem.default.kv values (1, 'one')")
+    assert runner.execute(
+        "select count(*) as c from mem.default.kv"
+    ).rows() == [(1,)]
+    runner.execute("insert into mem.default.kv values (2, 'two')")
+    assert runner.execute(
+        "select count(*) as c from mem.default.kv"
+    ).rows() == [(2,)]
