@@ -287,6 +287,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cd_education_status": T.VARCHAR,
         "cd_purchase_estimate": T.INTEGER,
         "cd_credit_rating": T.VARCHAR,
+        "cd_dep_count": T.INTEGER,
     },
     "household_demographics": {
         "hd_demo_sk": T.INTEGER,
@@ -323,6 +324,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "p_channel_email": T.VARCHAR,
         "p_channel_event": T.VARCHAR,
         "p_channel_dmail": T.VARCHAR,
+        "p_channel_tv": T.VARCHAR,
     },
     "item": {
         "i_item_sk": T.INTEGER,
@@ -353,6 +355,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "c_first_sales_date_sk": T.INTEGER,
         "c_first_shipto_date_sk": T.INTEGER,
         "c_birth_year": T.INTEGER,
+        "c_birth_month": T.INTEGER,
         "c_salutation": T.VARCHAR,
         "c_preferred_cust_flag": T.VARCHAR,
     },
@@ -365,6 +368,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ca_zip": T.VARCHAR,
         "ca_county": T.VARCHAR,
         "ca_gmt_offset": T.INTEGER,
+        "ca_country": T.VARCHAR,
     },
     "store_sales": {
         "ss_sold_date_sk": T.INTEGER,
@@ -391,6 +395,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "sr_item_sk": T.INTEGER,
         "sr_ticket_number": T.INTEGER,
         "sr_return_amt": D7_2,
+        "sr_net_loss": D7_2,
         "sr_store_sk": T.INTEGER,
         "sr_customer_sk": T.INTEGER,
     },
@@ -411,6 +416,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cs_coupon_amt": D7_2,
         "cs_ext_list_price": D7_2,
         "cs_ext_sales_price": D7_2,
+        "cs_net_profit": D7_2,
+        "cs_catalog_page_sk": T.INTEGER,
         "cs_bill_hdemo_sk": T.INTEGER,
     },
     "catalog_returns": {
@@ -420,6 +427,9 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cr_refunded_cash": D7_2,
         "cr_reversed_charge": D7_2,
         "cr_store_credit": D7_2,
+        "cr_return_amount": D7_2,
+        "cr_net_loss": D7_2,
+        "cr_catalog_page_sk": T.INTEGER,
     },
     "web_sales": {
         "ws_sold_date_sk": T.INTEGER,
@@ -433,6 +443,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ws_ext_ship_cost": D7_2,
         "ws_ext_sales_price": D7_2,
         "ws_net_profit": D7_2,
+        "ws_web_page_sk": T.INTEGER,
+        "ws_promo_sk": T.INTEGER,
         "ws_bill_customer_sk": T.INTEGER,
         "ws_bill_addr_sk": T.INTEGER,
     },
@@ -441,6 +453,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "wr_item_sk": T.INTEGER,
         "wr_order_number": T.INTEGER,
         "wr_return_amt": D7_2,
+        "wr_net_loss": D7_2,
+        "wr_web_page_sk": T.INTEGER,
     },
 }
 
@@ -662,6 +676,8 @@ class TpcdsGenerator:
                 out[c] = 500 * (1 + (rows // 70) % 20)
             elif c == "cd_credit_rating":
                 out[c] = _fixed(CREDIT, (rows // 1400) % 4)
+            elif c == "cd_dep_count":
+                out[c] = (rows // 35) % 7
         return out
 
     def _gen_household_demographics(self, rows, columns):
@@ -752,6 +768,8 @@ class TpcdsGenerator:
                 out[c] = _fixed(PROMO_CHANNELS, (rows // 2) % 2)
             elif c == "p_channel_dmail":
                 out[c] = _fixed(PROMO_CHANNELS, (rows // 4) % 2)
+            elif c == "p_channel_tv":
+                out[c] = _fixed(PROMO_CHANNELS, (rows // 8) % 2)
         return out
 
     def _gen_item(self, rows, columns):
@@ -846,6 +864,8 @@ class TpcdsGenerator:
                 )
             elif c == "c_birth_year":
                 out[c] = _uniform(1506, rows, 1930, 1990)
+            elif c == "c_birth_month":
+                out[c] = _uniform(1511, rows, 1, 12)
             elif c == "c_salutation":
                 out[c] = _fixed(
                     ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir", "Miss"],
@@ -883,6 +903,8 @@ class TpcdsGenerator:
                 out[c] = _fixed(
                     COUNTIES, _uniform(1606, rows, 0, len(COUNTIES) - 1)
                 )
+            elif c == "ca_country":
+                out[c] = _fixed(["United States"], rows * 0)
             elif c == "ca_gmt_offset":
                 # continental offsets; -5 is the modal official
                 # substitution value so it must select a real slice
@@ -977,6 +999,8 @@ class TpcdsGenerator:
                 out[c] = f["ticket"]
             elif c == "sr_return_amt":
                 out[c] = _uniform(1802, rows, 100, 10000)
+            elif c == "sr_net_loss":
+                out[c] = _uniform(1803, rows, 100, 8000)
             elif c == "sr_store_sk":
                 # SAME closed form store_sales evaluates at the source
                 # row: the (ticket, item) FK pair stays store-consistent
@@ -1044,6 +1068,12 @@ class TpcdsGenerator:
                 out[c] = _uniform(
                     1920, rows, 1, cn["household_demographics"]
                 )
+            elif c == "cs_net_profit":
+                out[c] = _uniform(1917, rows, -5000, 20000)
+            elif c == "cs_catalog_page_sk":
+                out[c] = _uniform(
+                    1918, rows, 1, cn["catalog_page"]
+                )
         return out
 
     def _gen_catalog_returns(self, rows, columns):
@@ -1067,6 +1097,16 @@ class TpcdsGenerator:
                 out[c] = _uniform(2003, rows, 0, 5000)
             elif c == "cr_store_credit":
                 out[c] = _uniform(2004, rows, 0, 5000)
+            elif c == "cr_return_amount":
+                out[c] = _uniform(2005, rows, 100, 10000)
+            elif c == "cr_net_loss":
+                out[c] = _uniform(2006, rows, 100, 8000)
+            elif c == "cr_catalog_page_sk":
+                # SAME closed form catalog_sales evaluates at the
+                # source row: a return's page is its sale's page
+                out[c] = _uniform(
+                    1918, src, 1, self.counts["catalog_page"]
+                )
         return out
 
     def _ws_fields(self, rows):
@@ -1120,6 +1160,10 @@ class TpcdsGenerator:
                 out[c] = _uniform(
                     2112, f["order"], 1, cn["customer_address"]
                 )
+            elif c == "ws_web_page_sk":
+                out[c] = _uniform(2113, rows, 1, cn["web_page"])
+            elif c == "ws_promo_sk":
+                out[c] = _uniform(2115, rows, 1, cn["promotion"])
         return out
 
     def _gen_web_returns(self, rows, columns):
@@ -1137,6 +1181,13 @@ class TpcdsGenerator:
                 out[c] = f["order"]
             elif c == "wr_return_amt":
                 out[c] = _uniform(2202, rows, 100, 10000)
+            elif c == "wr_net_loss":
+                out[c] = _uniform(2203, rows, 100, 8000)
+            elif c == "wr_web_page_sk":
+                # source web_sales row's page (same closed form)
+                out[c] = _uniform(
+                    2113, src, 1, self.counts["web_page"]
+                )
         return out
 
 

@@ -168,6 +168,16 @@ class _Planner:
     def plan_select(
         self, sel: ast.Select, outer: Optional[Scope]
     ) -> Tuple[N.PlanNode, Scope, Tuple[str, ...]]:
+        from presto_tpu.sql.grouping_sets import (
+            desugar_select,
+            has_grouping_sets,
+        )
+
+        if has_grouping_sets(sel):
+            try:
+                sel = desugar_select(sel)
+            except ValueError as e:
+                raise PlanningError(str(e))
         saved_ctes = dict(self.ctes)
         for name, q in sel.ctes:
             self.ctes[name] = q
@@ -594,14 +604,29 @@ class _Planner:
                     "UNION terms must have the same number of columns "
                     f"({arity} vs {len(names)})"
                 )
-        # common types per position
+        # common types per position. A term column that is a bare NULL
+        # literal (reference: UNKNOWN type coercing to anything) does
+        # not vote — it adopts the other terms' type; the grouping-sets
+        # desugar emits exactly this shape for absent group columns
+        def _null_literal_expr(node, name):
+            while isinstance(node, N.OutputNode):
+                name = dict(node.columns).get(name, name)
+                node = node.source
+            if isinstance(node, N.ProjectNode):
+                e = dict(node.projections).get(name)
+                if isinstance(e, E.Literal) and e.value is None:
+                    return e
+            return None
+
         types = []
         for i in range(arity):
             ct = None
             for node, names in planned:
+                if _null_literal_expr(node, names[i]) is not None:
+                    continue
                 t_i = node.output_schema()[names[i]]
                 ct = t_i if ct is None else T.common_super_type(ct, t_i)
-            types.append(ct)
+            types.append(ct if ct is not None else T.BIGINT)
         # canonical output names: the first term's visible names
         # (de-duplicated — they become this relation's columns)
         out_names: List[str] = []
@@ -615,6 +640,13 @@ class _Planner:
             schema = node.output_schema()
             projs = []
             for i, out in enumerate(out_names):
+                if (
+                    _null_literal_expr(node, names[i]) is not None
+                    and schema[names[i]] != types[i]
+                ):
+                    # retype the NULL in place — no runtime cast kernel
+                    projs.append((out, E.Literal(None, types[i])))
+                    continue
                 src = E.ColumnRef(names[i], schema[names[i]])
                 e = src if src.dtype == types[i] else E.Cast(
                     src, types[i]

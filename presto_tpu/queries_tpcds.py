@@ -1038,4 +1038,372 @@ OFFICIAL = {
                                 = wr1.wr_order_number)
         order by count(distinct ws_order_number)
         limit 100""",
+    # Q5: per-channel sales/returns/profit report — three
+    # sales+returns UNION ALL CTEs (store/catalog page/web site), then
+    # ROLLUP (channel, id) over the spliced channels
+    "q5": f"""
+        with ssr as (
+          select s_store_id as store_id,
+                 sum(sales_price) as sales,
+                 sum(profit) as profit,
+                 sum(return_amt) as returns_,
+                 sum(net_loss) as profit_loss
+          from (select ss_store_sk as store_sk,
+                       ss_sold_date_sk as date_sk,
+                       ss_ext_sales_price as sales_price,
+                       ss_net_profit as profit,
+                       cast(0 as decimal(7,2)) as return_amt,
+                       cast(0 as decimal(7,2)) as net_loss
+                from {S}.store_sales
+                union all
+                select sr_store_sk as store_sk,
+                       sr_returned_date_sk as date_sk,
+                       cast(0 as decimal(7,2)) as sales_price,
+                       cast(0 as decimal(7,2)) as profit,
+                       sr_return_amt as return_amt,
+                       sr_net_loss as net_loss
+                from {S}.store_returns) salesreturns,
+               {S}.date_dim, {S}.store
+          where date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '14' day
+            and store_sk = s_store_sk
+          group by s_store_id),
+        csr as (
+          select cp_catalog_page_id as catalog_page_id,
+                 sum(sales_price) as sales,
+                 sum(profit) as profit,
+                 sum(return_amt) as returns_,
+                 sum(net_loss) as profit_loss
+          from (select cs_catalog_page_sk as page_sk,
+                       cs_sold_date_sk as date_sk,
+                       cs_ext_sales_price as sales_price,
+                       cs_net_profit as profit,
+                       cast(0 as decimal(7,2)) as return_amt,
+                       cast(0 as decimal(7,2)) as net_loss
+                from {S}.catalog_sales
+                union all
+                select cr_catalog_page_sk as page_sk,
+                       cr_returned_date_sk as date_sk,
+                       cast(0 as decimal(7,2)) as sales_price,
+                       cast(0 as decimal(7,2)) as profit,
+                       cr_return_amount as return_amt,
+                       cr_net_loss as net_loss
+                from {S}.catalog_returns) salesreturns,
+               {S}.date_dim, {S}.catalog_page
+          where date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '14' day
+            and page_sk = cp_catalog_page_sk
+          group by cp_catalog_page_id),
+        wsr as (
+          select web_site_id,
+                 sum(sales_price) as sales,
+                 sum(profit) as profit,
+                 sum(return_amt) as returns_,
+                 sum(net_loss) as profit_loss
+          from (select ws_web_site_sk as wsr_web_site_sk,
+                       ws_sold_date_sk as date_sk,
+                       ws_ext_sales_price as sales_price,
+                       ws_net_profit as profit,
+                       cast(0 as decimal(7,2)) as return_amt,
+                       cast(0 as decimal(7,2)) as net_loss
+                from {S}.web_sales
+                union all
+                select ws.ws_web_site_sk as wsr_web_site_sk,
+                       wr_returned_date_sk as date_sk,
+                       cast(0 as decimal(7,2)) as sales_price,
+                       cast(0 as decimal(7,2)) as profit,
+                       wr_return_amt as return_amt,
+                       wr_net_loss as net_loss
+                from {S}.web_returns wr
+                     left join {S}.web_sales ws
+                       on wr.wr_item_sk = ws.ws_item_sk
+                      and wr.wr_order_number = ws.ws_order_number)
+               salesreturns,
+               {S}.date_dim, {S}.web_site
+          where date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '14' day
+            and wsr_web_site_sk = web_site_sk
+          group by web_site_id)
+        select channel, id,
+               sum(sales) as sales,
+               sum(returns_) as returns_,
+               sum(profit) as profit
+        from (select 'store channel' as channel,
+                     'store' || store_id as id,
+                     sales, returns_,
+                     profit - profit_loss as profit
+              from ssr
+              union all
+              select 'catalog channel' as channel,
+                     'catalog_page' || catalog_page_id as id,
+                     sales, returns_,
+                     profit - profit_loss as profit
+              from csr
+              union all
+              select 'web channel' as channel,
+                     'web_site' || web_site_id as id,
+                     sales, returns_,
+                     profit - profit_loss as profit
+              from wsr) x
+        group by rollup (channel, id)
+        order by channel, id
+        limit 100""",
+    # Q18: catalog demographic averages over a four-level geography
+    # ROLLUP, two customer_demographics instances
+    "q18": f"""
+        select i_item_id, ca_country, ca_state, ca_county,
+               avg(cast(cs_quantity as decimal(12,2))) as agg1,
+               avg(cast(cs_list_price as decimal(12,2))) as agg2,
+               avg(cast(cs_coupon_amt as decimal(12,2))) as agg3,
+               avg(cast(cs_sales_price as decimal(12,2))) as agg4,
+               avg(cast(cs_net_profit as decimal(12,2))) as agg5,
+               avg(cast(c_birth_year as decimal(12,2))) as agg6,
+               avg(cast(cd1.cd_dep_count as decimal(12,2))) as agg7
+        from {S}.catalog_sales,
+             {S}.customer_demographics cd1,
+             {S}.customer_demographics cd2,
+             {S}.customer, {S}.customer_address, {S}.date_dim,
+             {S}.item
+        where cs_sold_date_sk = d_date_sk
+          and cs_item_sk = i_item_sk
+          and cs_bill_cdemo_sk = cd1.cd_demo_sk
+          and cs_bill_customer_sk = c_customer_sk
+          and cd1.cd_gender = 'F'
+          and cd1.cd_education_status = 'Unknown'
+          and c_current_cdemo_sk = cd2.cd_demo_sk
+          and c_current_addr_sk = ca_address_sk
+          and c_birth_month in (1, 6, 8, 9, 12, 2)
+          and d_year = 1998
+          and ca_state in ('GA', 'IL', 'MI', 'NY', 'OH', 'PA', 'TX')
+        group by rollup (i_item_id, ca_country, ca_state, ca_county)
+        order by ca_country, ca_state, ca_county, i_item_id
+        limit 100""",
+    # Q77: per-channel sales vs returns with outer-joined return CTEs
+    # (catalog returns ride a global-agg CROSS JOIN), ROLLUP splice
+    "q77": f"""
+        with ss as (
+          select s_store_sk,
+                 sum(ss_ext_sales_price) as sales,
+                 sum(ss_net_profit) as profit
+          from {S}.store_sales, {S}.date_dim, {S}.store
+          where ss_sold_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and ss_store_sk = s_store_sk
+          group by s_store_sk),
+        sr as (
+          select s_store_sk,
+                 sum(sr_return_amt) as returns_,
+                 sum(sr_net_loss) as profit_loss
+          from {S}.store_returns, {S}.date_dim, {S}.store
+          where sr_returned_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and sr_store_sk = s_store_sk
+          group by s_store_sk),
+        cs as (
+          select cs_call_center_sk,
+                 sum(cs_ext_sales_price) as sales,
+                 sum(cs_net_profit) as profit
+          from {S}.catalog_sales, {S}.date_dim
+          where cs_sold_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+          group by cs_call_center_sk),
+        cr as (
+          select sum(cr_return_amount) as returns_,
+                 sum(cr_net_loss) as profit_loss
+          from {S}.catalog_returns, {S}.date_dim
+          where cr_returned_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day),
+        ws as (
+          select wp_web_page_sk,
+                 sum(ws_ext_sales_price) as sales,
+                 sum(ws_net_profit) as profit
+          from {S}.web_sales, {S}.date_dim, {S}.web_page
+          where ws_sold_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and ws_web_page_sk = wp_web_page_sk
+          group by wp_web_page_sk),
+        wr as (
+          select wp_web_page_sk,
+                 sum(wr_return_amt) as returns_,
+                 sum(wr_net_loss) as profit_loss
+          from {S}.web_returns, {S}.date_dim, {S}.web_page
+          where wr_returned_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and wr_web_page_sk = wp_web_page_sk
+          group by wp_web_page_sk)
+        select channel, id,
+               sum(sales) as sales,
+               sum(returns_) as returns_,
+               sum(profit) as profit
+        from (select 'store channel' as channel,
+                     ss.s_store_sk as id, sales,
+                     coalesce(returns_, 0) as returns_,
+                     profit - coalesce(profit_loss, 0) as profit
+              from ss left join sr on ss.s_store_sk = sr.s_store_sk
+              union all
+              select 'catalog channel' as channel,
+                     cs_call_center_sk as id, sales, returns_,
+                     profit - profit_loss as profit
+              from cs cross join cr
+              union all
+              select 'web channel' as channel,
+                     ws.wp_web_page_sk as id, sales,
+                     coalesce(returns_, 0) as returns_,
+                     profit - coalesce(profit_loss, 0) as profit
+              from ws left join wr
+                on ws.wp_web_page_sk = wr.wp_web_page_sk) x
+        group by rollup (channel, id)
+        order by channel, id, returns_
+        limit 100""",
+    # Q80: per-channel promotional sales/returns with outer-joined
+    # returns at line granularity, TV-channel promotion filter, ROLLUP
+    "q80": f"""
+        with ssr as (
+          select 'store' || s_store_id as id,
+                 sum(ss_ext_sales_price) as sales,
+                 sum(coalesce(sr_return_amt, 0)) as returns_,
+                 sum(ss_net_profit - coalesce(sr_net_loss, 0))
+                   as profit
+          from {S}.store_sales
+               left join {S}.store_returns
+                 on ss_item_sk = sr_item_sk
+                and ss_ticket_number = sr_ticket_number,
+               {S}.date_dim, {S}.store, {S}.item, {S}.promotion
+          where ss_sold_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and ss_store_sk = s_store_sk
+            and ss_item_sk = i_item_sk
+            and i_current_price > 50
+            and ss_promo_sk = p_promo_sk
+            and p_channel_tv = 'N'
+          group by s_store_id),
+        csr as (
+          select 'catalog_page' || cp_catalog_page_id as id,
+                 sum(cs_ext_sales_price) as sales,
+                 sum(coalesce(cr_return_amount, 0)) as returns_,
+                 sum(cs_net_profit - coalesce(cr_net_loss, 0))
+                   as profit
+          from {S}.catalog_sales
+               left join {S}.catalog_returns
+                 on cs_item_sk = cr_item_sk
+                and cs_order_number = cr_order_number,
+               {S}.date_dim, {S}.catalog_page, {S}.item,
+               {S}.promotion
+          where cs_sold_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and cs_catalog_page_sk = cp_catalog_page_sk
+            and cs_item_sk = i_item_sk
+            and i_current_price > 50
+            and cs_promo_sk = p_promo_sk
+            and p_channel_tv = 'N'
+          group by cp_catalog_page_id),
+        wsr as (
+          select 'web_site' || web_site_id as id,
+                 sum(ws_ext_sales_price) as sales,
+                 sum(coalesce(wr_return_amt, 0)) as returns_,
+                 sum(ws_net_profit - coalesce(wr_net_loss, 0))
+                   as profit
+          from {S}.web_sales
+               left join {S}.web_returns
+                 on ws_item_sk = wr_item_sk
+                and ws_order_number = wr_order_number,
+               {S}.date_dim, {S}.web_site, {S}.item, {S}.promotion
+          where ws_sold_date_sk = d_date_sk
+            and d_date between date '2000-08-23'
+                and date '2000-08-23' + interval '30' day
+            and ws_web_site_sk = web_site_sk
+            and ws_item_sk = i_item_sk
+            and i_current_price > 50
+            and ws_promo_sk = p_promo_sk
+            and p_channel_tv = 'N'
+          group by web_site_id)
+        select channel, id,
+               sum(sales) as sales,
+               sum(returns_) as returns_,
+               sum(profit) as profit
+        from (select 'store channel' as channel, id, sales,
+                     returns_, profit
+              from ssr
+              union all
+              select 'catalog channel' as channel, id, sales,
+                     returns_, profit
+              from csr
+              union all
+              select 'web channel' as channel, id, sales,
+                     returns_, profit
+              from wsr) x
+        group by rollup (channel, id)
+        order by channel, id
+        limit 100""",
+    # Q22: inventory quantity-on-hand over a 12-month window, item
+    # hierarchy ROLLUP (grouping-sets desugar: 5 aggregation branches)
+    "q22": f"""
+        select i_product_name, i_brand, i_class, i_category,
+               avg(inv_quantity_on_hand) as qoh
+        from {S}.inventory, {S}.date_dim, {S}.item
+        where inv_date_sk = d_date_sk
+          and inv_item_sk = i_item_sk
+          and d_month_seq between 1200 and 1200 + 11
+        group by rollup (i_product_name, i_brand, i_class, i_category)
+        order by qoh, i_product_name, i_brand, i_class, i_category
+        limit 100""",
+    # Q27: store-channel demographic averages with state ROLLUP and
+    # grouping() in the select list
+    "q27": f"""
+        select i_item_id, s_state, grouping(s_state) as g_state,
+               avg(ss_quantity) as agg1,
+               avg(ss_list_price) as agg2,
+               avg(ss_coupon_amt) as agg3,
+               avg(ss_sales_price) as agg4
+        from {S}.store_sales, {S}.customer_demographics, {S}.date_dim,
+             {S}.store, {S}.item
+        where ss_sold_date_sk = d_date_sk
+          and ss_item_sk = i_item_sk
+          and ss_store_sk = s_store_sk
+          and ss_cdemo_sk = cd_demo_sk
+          and cd_gender = 'M'
+          and cd_marital_status = 'S'
+          and cd_education_status = 'College'
+          and d_year = 2002
+          and s_state in ('TN', 'GA', 'AL', 'SC', 'KY', 'VA')
+        group by rollup (i_item_id, s_state)
+        order by i_item_id, s_state
+        limit 100""",
+    # Q67: the 8-column ROLLUP stress (9 aggregation branches) with a
+    # rank() within category over the unioned grouping sets
+    "q67": f"""
+        select *
+        from (select i_category, i_class, i_brand, i_product_name,
+                     d_year, d_qoy, d_moy, s_store_id, sumsales,
+                     rank() over (partition by i_category
+                                  order by sumsales desc) as rk
+              from (select i_category, i_class, i_brand,
+                           i_product_name, d_year, d_qoy, d_moy,
+                           s_store_id,
+                           sum(coalesce(ss_sales_price * ss_quantity,
+                                        0)) as sumsales
+                    from {S}.store_sales, {S}.date_dim, {S}.store,
+                         {S}.item
+                    where ss_sold_date_sk = d_date_sk
+                      and ss_item_sk = i_item_sk
+                      and ss_store_sk = s_store_sk
+                      and d_month_seq between 1200 and 1200 + 11
+                    group by rollup (i_category, i_class, i_brand,
+                                     i_product_name, d_year, d_qoy,
+                                     d_moy, s_store_id)) dw1) dw2
+        where rk <= 100
+        order by i_category, i_class, i_brand, i_product_name, d_year,
+                 d_qoy, d_moy, s_store_id, sumsales, rk
+        limit 100""",
 }

@@ -220,6 +220,19 @@ class UnionRel(Node):
     ops: Tuple[str, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class GroupingSets(Node):
+    """One GROUP BY element carrying multiple grouping sets — the
+    parsed form of ROLLUP(...) / CUBE(...) / GROUPING SETS (...).
+    Desugared before planning AND before sqlite rendering into an
+    outer select over a UNION ALL of per-set aggregations
+    (sql/grouping_sets.py) — sqlite has no native grouping sets, and
+    the engine's one-hot aggregation needs fixed key sets per program
+    anyway (reference: GroupIdNode + repeated-source expansion)."""
+
+    sets: Tuple[Tuple[Node, ...], ...]
+
+
 # ------------------------------------------------------------ statements
 
 

@@ -288,9 +288,9 @@ class _Parser:
         group_by: List[ast.Node] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.parse_expr())
+            group_by.append(self._group_by_element())
             while self.accept_op(","):
-                group_by.append(self.parse_expr())
+                group_by.append(self._group_by_element())
         having = self.parse_expr() if self.accept_kw("having") else None
         return ast.Select(
             items=tuple(items),
@@ -300,6 +300,73 @@ class _Parser:
             having=having,
             distinct=distinct,
         )
+
+    def _group_by_element(self) -> ast.Node:
+        """One GROUP BY element: a plain expression, or
+        ROLLUP(...) / CUBE(...) / GROUPING SETS ((...), ...) parsed
+        into ast.GroupingSets (reference: GroupingElement grammar).
+        rollup/cube/grouping are soft keywords — only treated as
+        grouping constructs in exactly these token shapes."""
+        t = self.cur
+        word = str(t.value).lower() if t.kind == "ident" else None
+        nxt = self.tokens[self.pos + 1] if self.pos + 1 < len(
+            self.tokens
+        ) else None
+        if (
+            word in ("rollup", "cube")
+            and nxt is not None
+            and nxt.kind == "op"
+            and nxt.value == "("
+        ):
+            self.advance()
+            self.expect_op("(")
+            cols = [self.parse_expr()]
+            while self.accept_op(","):
+                cols.append(self.parse_expr())
+            self.expect_op(")")
+            if word == "rollup":
+                # prefixes, most detailed first: (a,b), (a), ()
+                sets = tuple(
+                    tuple(cols[:i]) for i in range(len(cols), -1, -1)
+                )
+            else:
+                # cube: every subset, most detailed first
+                n = len(cols)
+                sets = tuple(
+                    tuple(
+                        c
+                        for j, c in enumerate(cols)
+                        if (mask >> (n - 1 - j)) & 1
+                    )
+                    for mask in range((1 << n) - 1, -1, -1)
+                )
+            return ast.GroupingSets(sets=sets)
+        if (
+            word == "grouping"
+            and nxt is not None
+            and str(nxt.value).lower() == "sets"
+        ):
+            self.advance()
+            self.advance()
+            self.expect_op("(")
+            sets = [self._grouping_set()]
+            while self.accept_op(","):
+                sets.append(self._grouping_set())
+            self.expect_op(")")
+            return ast.GroupingSets(sets=tuple(sets))
+        return self.parse_expr()
+
+    def _grouping_set(self) -> tuple:
+        """( col [, col]* ) | ( ) | col inside GROUPING SETS."""
+        if self.accept_op("("):
+            cols: List[ast.Node] = []
+            if not self.accept_op(")"):
+                cols.append(self.parse_expr())
+                while self.accept_op(","):
+                    cols.append(self.parse_expr())
+                self.expect_op(")")
+            return tuple(cols)
+        return (self.parse_expr(),)
 
     def _select_item(self) -> ast.SelectItem:
         if self.peek_op("*"):
@@ -492,16 +559,16 @@ class _Parser:
         return self._predicate()
 
     def _predicate(self) -> ast.Node:
-        left = self._additive()
+        left = self._concat()
         while True:
             negate = False
             save = self.pos
             if self.accept_kw("not"):
                 negate = True
             if self.accept_kw("between"):
-                low = self._additive()
+                low = self._concat()
                 self.expect_kw("and")
-                high = self._additive()
+                high = self._concat()
                 left = ast.BetweenExpr(left, low, high, negate)
                 continue
             if self.accept_kw("in"):
@@ -518,7 +585,7 @@ class _Parser:
                     left = ast.InList(left, tuple(values), negate)
                 continue
             if self.accept_kw("like"):
-                pattern = self._additive()
+                pattern = self._concat()
                 if self.accept_kw("escape"):
                     self._additive()  # escape char: accepted, default '\'
                 left = ast.LikeExpr(left, pattern, negate)
@@ -533,10 +600,18 @@ class _Parser:
                 continue
             op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
             if op:
-                right = self._additive()
+                right = self._concat()
                 left = ast.BinaryOp(op, left, right)
                 continue
             return left
+
+    def _concat(self) -> ast.Node:
+        """|| at Presto's precedence: below +/- (so 'x' || a + 1 is
+        'x' || (a + 1)), above comparisons; desugars to concat()."""
+        left = self._additive()
+        while self.accept_op("||"):
+            left = ast.FuncCall("concat", (left, self._additive()))
+        return left
 
     def _additive(self) -> ast.Node:
         left = self._multiplicative()

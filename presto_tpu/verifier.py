@@ -119,8 +119,14 @@ class SqliteOracle:
         self._loaded.add(table)
 
     def execute(self, sql: str) -> List[tuple]:
+        from presto_tpu.sql.grouping_sets import desugar_tree
+
         stmt = parse_statement(sql)
         assert isinstance(stmt, ast.Select)
+        # sqlite has no GROUPING SETS: render the same desugared tree
+        # the planner executes (an independent execution of identical
+        # plain-SQL semantics)
+        stmt = desugar_tree(stmt)
         for t in _tables_of(stmt):
             if t in self._table_schemas:
                 self.load_table(t)
@@ -191,11 +197,16 @@ def _r(n: ast.Node) -> str:
                     _r(s.expr)
                     + (" DESC" if s.descending else "")
                     + (
-                        ""
-                        if s.nulls_first is None
-                        else (
-                            " NULLS FIRST" if s.nulls_first else " NULLS LAST"
+                        # engine default (ops/sort.py): NULLS LAST in
+                        # ASC, FIRST in DESC; sqlite defaults differ,
+                        # so render it explicitly either way
+                        " NULLS FIRST"
+                        if (
+                            s.nulls_first
+                            if s.nulls_first is not None
+                            else s.descending
                         )
+                        else " NULLS LAST"
                     )
                     for s in n.order_by
                 )
@@ -265,6 +276,9 @@ def _r(n: ast.Node) -> str:
         if n.name == "substring":
             args = ", ".join(_r(a) for a in n.args)
             return f"substr({args})"
+        if n.name == "concat":
+            # sqlite <3.44 has no concat(); render the || operator
+            return "(" + " || ".join(_r(a) for a in n.args) + ")"
         d = "DISTINCT " if n.distinct else ""
         return f"{n.name}({d}{', '.join(_r(a) for a in n.args)})"
     if isinstance(n, ast.CaseExpr):
