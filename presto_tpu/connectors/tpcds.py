@@ -223,6 +223,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "d_qoy": T.INTEGER,
         "d_day_name": T.VARCHAR,
         "d_month_seq": T.INTEGER,
+        "d_quarter_name": T.VARCHAR,
         "d_week_seq": T.INTEGER,
     },
     "income_band": {
@@ -238,6 +239,7 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "t_minute": T.INTEGER,
         "t_second": T.INTEGER,
         "t_am_pm": T.VARCHAR,
+        "t_meal_time": T.VARCHAR,
         "t_shift": T.VARCHAR,
     },
     "reason": {
@@ -288,6 +290,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cd_purchase_estimate": T.INTEGER,
         "cd_credit_rating": T.VARCHAR,
         "cd_dep_count": T.INTEGER,
+        "cd_dep_employed_count": T.INTEGER,
+        "cd_dep_college_count": T.INTEGER,
     },
     "household_demographics": {
         "hd_demo_sk": T.INTEGER,
@@ -300,6 +304,10 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "w_warehouse_sk": T.INTEGER,
         "w_warehouse_name": T.VARCHAR,
         "w_state": T.VARCHAR,
+        "w_warehouse_sq_ft": T.INTEGER,
+        "w_city": T.VARCHAR,
+        "w_county": T.VARCHAR,
+        "w_country": T.VARCHAR,
     },
     "web_site": {
         "web_site_sk": T.INTEGER,
@@ -317,6 +325,13 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "s_number_employees": T.INTEGER,
         "s_company_name": T.VARCHAR,
         "s_county": T.VARCHAR,
+        "s_gmt_offset": T.INTEGER,
+        "s_company_id": T.INTEGER,
+        "s_street_number": T.VARCHAR,
+        "s_street_name": T.VARCHAR,
+        "s_street_type": T.VARCHAR,
+        "s_suite_number": T.VARCHAR,
+        "s_market_id": T.INTEGER,
     },
     "promotion": {
         "p_promo_sk": T.INTEGER,
@@ -341,6 +356,8 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "i_brand_id": T.INTEGER,
         "i_manufact_id": T.INTEGER,
         "i_manufact": T.VARCHAR,
+        "i_size": T.VARCHAR,
+        "i_units": T.VARCHAR,
         "i_manager_id": T.INTEGER,
         "i_wholesale_cost": D7_2,
     },
@@ -356,6 +373,11 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "c_first_shipto_date_sk": T.INTEGER,
         "c_birth_year": T.INTEGER,
         "c_birth_month": T.INTEGER,
+        "c_birth_day": T.INTEGER,
+        "c_birth_country": T.VARCHAR,
+        "c_login": T.VARCHAR,
+        "c_email_address": T.VARCHAR,
+        "c_last_review_date_sk": T.INTEGER,
         "c_salutation": T.VARCHAR,
         "c_preferred_cust_flag": T.VARCHAR,
     },
@@ -369,6 +391,9 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ca_county": T.VARCHAR,
         "ca_gmt_offset": T.INTEGER,
         "ca_country": T.VARCHAR,
+        "ca_street_type": T.VARCHAR,
+        "ca_suite_number": T.VARCHAR,
+        "ca_location_type": T.VARCHAR,
     },
     "store_sales": {
         "ss_sold_date_sk": T.INTEGER,
@@ -389,6 +414,10 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ss_ext_tax": D7_2,
         "ss_coupon_amt": D7_2,
         "ss_net_profit": D7_2,
+        "ss_ext_discount_amt": D7_2,
+        "ss_ext_wholesale_cost": D7_2,
+        "ss_net_paid": D7_2,
+        "ss_sold_time_sk": T.INTEGER,
     },
     "store_returns": {
         "sr_returned_date_sk": T.INTEGER,
@@ -398,6 +427,9 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "sr_net_loss": D7_2,
         "sr_store_sk": T.INTEGER,
         "sr_customer_sk": T.INTEGER,
+        "sr_return_quantity": T.INTEGER,
+        "sr_reason_sk": T.INTEGER,
+        "sr_cdemo_sk": T.INTEGER,
     },
     "catalog_sales": {
         "cs_sold_date_sk": T.INTEGER,
@@ -419,6 +451,15 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cs_net_profit": D7_2,
         "cs_catalog_page_sk": T.INTEGER,
         "cs_bill_hdemo_sk": T.INTEGER,
+        "cs_ext_discount_amt": D7_2,
+        "cs_wholesale_cost": D7_2,
+        "cs_ext_ship_cost": D7_2,
+        "cs_ext_wholesale_cost": D7_2,
+        "cs_net_paid": D7_2,
+        "cs_ship_addr_sk": T.INTEGER,
+        "cs_bill_addr_sk": T.INTEGER,
+        "cs_ship_customer_sk": T.INTEGER,
+        "cs_sold_time_sk": T.INTEGER,
     },
     "catalog_returns": {
         "cr_returned_date_sk": T.INTEGER,
@@ -430,6 +471,10 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "cr_return_amount": D7_2,
         "cr_net_loss": D7_2,
         "cr_catalog_page_sk": T.INTEGER,
+        "cr_return_quantity": T.INTEGER,
+        "cr_returning_customer_sk": T.INTEGER,
+        "cr_returning_addr_sk": T.INTEGER,
+        "cr_call_center_sk": T.INTEGER,
     },
     "web_sales": {
         "ws_sold_date_sk": T.INTEGER,
@@ -445,6 +490,16 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "ws_net_profit": D7_2,
         "ws_web_page_sk": T.INTEGER,
         "ws_promo_sk": T.INTEGER,
+        "ws_sales_price": D7_2,
+        "ws_quantity": T.INTEGER,
+        "ws_list_price": D7_2,
+        "ws_wholesale_cost": D7_2,
+        "ws_ext_discount_amt": D7_2,
+        "ws_ext_wholesale_cost": D7_2,
+        "ws_ext_list_price": D7_2,
+        "ws_net_paid": D7_2,
+        "ws_sold_time_sk": T.INTEGER,
+        "ws_ship_hdemo_sk": T.INTEGER,
         "ws_bill_customer_sk": T.INTEGER,
         "ws_bill_addr_sk": T.INTEGER,
     },
@@ -455,6 +510,15 @@ TABLE_SCHEMAS: Dict[str, Dict[str, T.DataType]] = {
         "wr_return_amt": D7_2,
         "wr_net_loss": D7_2,
         "wr_web_page_sk": T.INTEGER,
+        "wr_return_quantity": T.INTEGER,
+        "wr_returning_customer_sk": T.INTEGER,
+        "wr_returning_addr_sk": T.INTEGER,
+        "wr_refunded_cash": D7_2,
+        "wr_reason_sk": T.INTEGER,
+        "wr_refunded_cdemo_sk": T.INTEGER,
+        "wr_refunded_addr_sk": T.INTEGER,
+        "wr_returning_cdemo_sk": T.INTEGER,
+        "wr_fee": D7_2,
     },
 }
 
@@ -495,6 +559,16 @@ class TpcdsGenerator:
             elif c == "d_qoy":
                 out[c] = np.asarray(
                     [(d.month - 1) // 3 + 1 for d in dates], np.int64
+                )
+            elif c == "d_quarter_name":
+                out[c] = _fixed(
+                    [f"{y}Q{q}" for y in range(1990, 2004)
+                     for q in range(1, 5)],
+                    np.asarray(
+                        [(d.year - 1990) * 4 + (d.month - 1) // 3
+                         for d in dates],
+                        np.int64,
+                    ),
                 )
             elif c == "d_day_name":
                 out[c] = _fixed(
@@ -539,6 +613,19 @@ class TpcdsGenerator:
                 out[c] = rows % 60
             elif c == "t_am_pm":
                 out[c] = _fixed(["AM", "PM"], (hour >= 12).astype(np.int64))
+            elif c == "t_meal_time":
+                # official domains: breakfast 6-9, lunch 11-14,
+                # dinner 17-20, empty otherwise
+                pick = np.where(
+                    (hour >= 6) & (hour < 9), 1,
+                    np.where(
+                        (hour >= 11) & (hour < 14), 2,
+                        np.where((hour >= 17) & (hour < 20), 3, 0),
+                    ),
+                )
+                out[c] = _fixed(
+                    ["", "breakfast", "lunch", "dinner"], pick
+                )
             elif c == "t_shift":
                 out[c] = _fixed(
                     ["first", "second", "third"],
@@ -592,7 +679,7 @@ class TpcdsGenerator:
                     "Manager", self.counts["call_center"], rows + 1
                 )
             elif c == "cc_county":
-                out[c] = _fixed(CITIES, rows % len(CITIES))
+                out[c] = _fixed(COUNTIES, rows % len(COUNTIES))
             elif c == "cc_state":
                 out[c] = _fixed(STATES, rows % len(STATES))
         return out
@@ -678,6 +765,10 @@ class TpcdsGenerator:
                 out[c] = _fixed(CREDIT, (rows // 1400) % 4)
             elif c == "cd_dep_count":
                 out[c] = (rows // 35) % 7
+            elif c == "cd_dep_employed_count":
+                out[c] = (rows // 245) % 7
+            elif c == "cd_dep_college_count":
+                out[c] = (rows // 1715) % 7
         return out
 
     def _gen_household_demographics(self, rows, columns):
@@ -700,6 +791,14 @@ class TpcdsGenerator:
         for c in columns:
             if c == "w_warehouse_sk":
                 out[c] = rows + 1
+            elif c == "w_warehouse_sq_ft":
+                out[c] = _uniform(1220, rows, 50000, 1000000)
+            elif c == "w_city":
+                out[c] = _fixed(CITIES, rows % len(CITIES))
+            elif c == "w_county":
+                out[c] = _fixed(COUNTIES, rows % len(COUNTIES))
+            elif c == "w_country":
+                out[c] = _fixed(["United States"], rows * 0)
             elif c == "w_warehouse_name":
                 out[c] = _fixed(
                     ["Bad cards must make.",
@@ -750,6 +849,30 @@ class TpcdsGenerator:
                 out[c] = _fixed(["Unknown", "ought"], rows % 2)
             elif c == "s_county":
                 out[c] = _fixed(COUNTIES, rows % len(COUNTIES))
+            elif c == "s_gmt_offset":
+                # continental offsets; -5 modal like customer_address
+                out[c] = -5 - (rows % 4) % 3 - (rows % 4) // 3 * 3
+            elif c == "s_company_id":
+                out[c] = rows % 2 + 1
+            elif c == "s_street_number":
+                out[c] = _fixed(
+                    _STREET_NUMS,
+                    _uniform(1211, rows, 0, len(_STREET_NUMS) - 1),
+                )
+            elif c == "s_street_name":
+                out[c] = _STREET_NAME.column(1212, rows)
+            elif c == "s_street_type":
+                out[c] = _fixed(
+                    ["Street", "Ave", "Blvd", "Road", "Lane"],
+                    rows % 5,
+                )
+            elif c == "s_suite_number":
+                out[c] = _fixed(
+                    [f"Suite {n}" for n in range(0, 300, 10)],
+                    _uniform(1213, rows, 0, 29),
+                )
+            elif c == "s_market_id":
+                out[c] = rows % 10 + 1
         return out
 
     def _gen_promotion(self, rows, columns):
@@ -818,6 +941,18 @@ class TpcdsGenerator:
                 out[c] = manufact
             elif c == "i_manufact":
                 out[c] = _numbered("manufact", 1000, manufact)
+            elif c == "i_size":
+                out[c] = _fixed(
+                    ["small", "medium", "large", "extra large",
+                     "economy", "petite", "N/A"],
+                    _uniform(1411, rows, 0, 6),
+                )
+            elif c == "i_units":
+                out[c] = _fixed(
+                    ["Each", "Oz", "Pound", "Dozen", "Carton",
+                     "Case", "Bunch", "Unknown"],
+                    _uniform(1412, rows, 0, 7),
+                )
             elif c == "i_manager_id":
                 out[c] = _uniform(1408, rows, 1, 100)
             elif c == "i_wholesale_cost":
@@ -866,6 +1001,22 @@ class TpcdsGenerator:
                 out[c] = _uniform(1506, rows, 1930, 1990)
             elif c == "c_birth_month":
                 out[c] = _uniform(1511, rows, 1, 12)
+            elif c == "c_birth_day":
+                out[c] = _uniform(1512, rows, 1, 28)
+            elif c == "c_birth_country":
+                out[c] = _fixed(
+                    ["UNITED STATES", "CANADA", "MEXICO", "FRANCE",
+                     "GERMANY", "JAPAN", "BRAZIL", "INDIA"],
+                    _uniform(1513, rows, 0, 7),
+                )
+            elif c == "c_login":
+                out[c] = _numbered("login", cn["customer"], rows + 1)
+            elif c == "c_email_address":
+                out[c] = _numbered("email", cn["customer"], rows + 1)
+            elif c == "c_last_review_date_sk":
+                out[c] = self._date_sk_for(
+                    _uniform(1514, rows, _D_START, _SOLD_HI)
+                )
             elif c == "c_salutation":
                 out[c] = _fixed(
                     ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir", "Miss"],
@@ -905,6 +1056,21 @@ class TpcdsGenerator:
                 )
             elif c == "ca_country":
                 out[c] = _fixed(["United States"], rows * 0)
+            elif c == "ca_street_type":
+                out[c] = _fixed(
+                    ["Street", "Ave", "Blvd", "Road", "Lane"],
+                    _uniform(1608, rows, 0, 4),
+                )
+            elif c == "ca_suite_number":
+                out[c] = _fixed(
+                    [f"Suite {n}" for n in range(0, 300, 10)],
+                    _uniform(1609, rows, 0, 29),
+                )
+            elif c == "ca_location_type":
+                out[c] = _fixed(
+                    ["apartment", "condo", "single family"],
+                    _uniform(1610, rows, 0, 2),
+                )
             elif c == "ca_gmt_offset":
                 # continental offsets; -5 is the modal official
                 # substitution value so it must select a real slice
@@ -944,7 +1110,10 @@ class TpcdsGenerator:
             elif c == "ss_item_sk":
                 out[c] = f["item"]
             elif c == "ss_customer_sk":
-                out[c] = _uniform(1704, rows, 1, cn["customer"])
+                # drawn from the TICKET, not the row: every line of a
+                # ticket belongs to one customer (official dsdgen;
+                # Q34-class per-ticket counts join this to customer)
+                out[c] = _uniform(1704, f["ticket"], 1, cn["customer"])
             elif c == "ss_cdemo_sk":
                 out[c] = _uniform(
                     1705, rows, 1, cn["customer_demographics"]
@@ -982,6 +1151,15 @@ class TpcdsGenerator:
                 out[c] = _sparse_amount(1712, 1713, rows)
             elif c == "ss_net_profit":
                 out[c] = _uniform(1716, rows, -500000, 1000000)
+            elif c == "ss_ext_discount_amt":
+                out[c] = _uniform(1717, rows, 0, 100000)
+            elif c == "ss_ext_wholesale_cost":
+                out[c] = wholesale * quantity
+            elif c == "ss_net_paid":
+                # quantity * sales_price, from the SAME hoisted draws
+                out[c] = quantity * sales_price
+            elif c == "ss_sold_time_sk":
+                out[c] = _uniform(1718, rows, 0, 86399)
         return out
 
     def _gen_store_returns(self, rows, columns):
@@ -1006,7 +1184,21 @@ class TpcdsGenerator:
                 # row: the (ticket, item) FK pair stays store-consistent
                 out[c] = _uniform(1708, src, 1, self.counts["store"])
             elif c == "sr_customer_sk":
-                out[c] = _uniform(1704, src, 1, self.counts["customer"])
+                # SAME ticket-keyed closed form store_sales evaluates
+                # at the source row, so (ticket, customer) stays exact
+                out[c] = _uniform(
+                    1704, f["ticket"], 1, self.counts["customer"]
+                )
+            elif c == "sr_return_quantity":
+                out[c] = _uniform(1805, rows, 1, 40)
+            elif c == "sr_reason_sk":
+                out[c] = _uniform(
+                    1806, rows, 1, self.counts["reason"]
+                )
+            elif c == "sr_cdemo_sk":
+                out[c] = _uniform(
+                    1807, rows, 1, self.counts["customer_demographics"]
+                )
         return out
 
     def _cs_fields(self, rows):
@@ -1037,7 +1229,9 @@ class TpcdsGenerator:
             elif c == "cs_warehouse_sk":
                 out[c] = _uniform(1915, rows, 1, cn["warehouse"])
             elif c == "cs_bill_customer_sk":
-                out[c] = _uniform(1903, rows, 1, cn["customer"])
+                # ORDER-keyed: every line of an order bills one
+                # customer (official dsdgen; matches ws/ss channels)
+                out[c] = _uniform(1903, f["order"], 1, cn["customer"])
             elif c == "cs_bill_cdemo_sk":
                 out[c] = _uniform(
                     1906, rows, 1, cn["customer_demographics"]
@@ -1074,6 +1268,30 @@ class TpcdsGenerator:
                 out[c] = _uniform(
                     1918, rows, 1, cn["catalog_page"]
                 )
+            elif c == "cs_ext_discount_amt":
+                out[c] = _uniform(1921, rows, 0, 100000)
+            elif c == "cs_wholesale_cost":
+                out[c] = _uniform(1929, rows, 100, 10000)
+            elif c == "cs_ext_ship_cost":
+                out[c] = _uniform(1922, rows, 100, 10000)
+            elif c == "cs_ext_wholesale_cost":
+                out[c] = _uniform(1923, rows, 100, 1000000)
+            elif c == "cs_net_paid":
+                out[c] = _uniform(1924, rows, 100, 300000)
+            elif c == "cs_ship_addr_sk":
+                out[c] = _uniform(
+                    1925, rows, 1, cn["customer_address"]
+                )
+            elif c == "cs_bill_addr_sk":
+                # order-keyed like the bill customer: one address per
+                # order (q33/q56/q60 group channel revenue by it)
+                out[c] = _uniform(
+                    1926, f["order"], 1, cn["customer_address"]
+                )
+            elif c == "cs_ship_customer_sk":
+                out[c] = _uniform(1927, rows, 1, cn["customer"])
+            elif c == "cs_sold_time_sk":
+                out[c] = _uniform(1928, rows, 0, 86399)
         return out
 
     def _gen_catalog_returns(self, rows, columns):
@@ -1106,6 +1324,29 @@ class TpcdsGenerator:
                 # source row: a return's page is its sale's page
                 out[c] = _uniform(
                     1918, src, 1, self.counts["catalog_page"]
+                )
+            elif c == "cr_return_quantity":
+                out[c] = _uniform(2008, rows, 1, 40)
+            elif c == "cr_returning_customer_sk":
+                # usually the billing customer of the source sale,
+                # sometimes a different party (official mix); 1903 is
+                # catalog_sales' ORDER-keyed bill-customer closed form
+                bill = _uniform(
+                    1903, f["order"], 1, self.counts["customer"]
+                )
+                other = _uniform(
+                    2009, rows, 1, self.counts["customer"]
+                )
+                out[c] = np.where(
+                    _uniform(2010, rows, 0, 9) < 8, bill, other
+                )
+            elif c == "cr_returning_addr_sk":
+                out[c] = _uniform(
+                    2011, rows, 1, self.counts["customer_address"]
+                )
+            elif c == "cr_call_center_sk":
+                out[c] = _uniform(
+                    2012, rows, 1, self.counts["call_center"]
                 )
         return out
 
@@ -1164,6 +1405,28 @@ class TpcdsGenerator:
                 out[c] = _uniform(2113, rows, 1, cn["web_page"])
             elif c == "ws_promo_sk":
                 out[c] = _uniform(2115, rows, 1, cn["promotion"])
+            elif c == "ws_sales_price":
+                out[c] = _uniform(2116, rows, 50, 9900)
+            elif c == "ws_quantity":
+                out[c] = _uniform(2117, rows, 1, 100)
+            elif c == "ws_list_price":
+                out[c] = _uniform(2118, rows, 100, 15000)
+            elif c == "ws_wholesale_cost":
+                out[c] = _uniform(2119, rows, 100, 10000)
+            elif c == "ws_ext_discount_amt":
+                out[c] = _uniform(2120, rows, 0, 100000)
+            elif c == "ws_ext_wholesale_cost":
+                out[c] = _uniform(2121, rows, 100, 1000000)
+            elif c == "ws_ext_list_price":
+                out[c] = _uniform(2122, rows, 100, 1500000)
+            elif c == "ws_net_paid":
+                out[c] = _uniform(2123, rows, 100, 990000)
+            elif c == "ws_sold_time_sk":
+                out[c] = _uniform(2124, rows, 0, 86399)
+            elif c == "ws_ship_hdemo_sk":
+                out[c] = _uniform(
+                    2125, rows, 1, cn["household_demographics"]
+                )
         return out
 
     def _gen_web_returns(self, rows, columns):
@@ -1188,10 +1451,64 @@ class TpcdsGenerator:
                 out[c] = _uniform(
                     2113, src, 1, self.counts["web_page"]
                 )
+            elif c == "wr_return_quantity":
+                out[c] = _uniform(2205, rows, 1, 40)
+            elif c == "wr_returning_customer_sk":
+                bill = _uniform(
+                    2111, f["order"], 1, self.counts["customer"]
+                )
+                other = _uniform(
+                    2206, rows, 1, self.counts["customer"]
+                )
+                out[c] = np.where(
+                    _uniform(2207, rows, 0, 9) < 8, bill, other
+                )
+            elif c == "wr_returning_addr_sk":
+                out[c] = _uniform(
+                    2208, rows, 1, self.counts["customer_address"]
+                )
+            elif c == "wr_refunded_cash":
+                out[c] = _uniform(2209, rows, 0, 15000)
+            elif c == "wr_reason_sk":
+                out[c] = _uniform(
+                    2210, rows, 1, self.counts["reason"]
+                )
+            elif c == "wr_refunded_cdemo_sk":
+                out[c] = _uniform(
+                    2211, rows, 1, self.counts["customer_demographics"]
+                )
+            elif c == "wr_refunded_addr_sk":
+                out[c] = _uniform(
+                    2212, rows, 1, self.counts["customer_address"]
+                )
+            elif c == "wr_returning_cdemo_sk":
+                out[c] = _uniform(
+                    2213, rows, 1, self.counts["customer_demographics"]
+                )
+            elif c == "wr_fee":
+                out[c] = _uniform(2214, rows, 50, 10000)
         return out
 
 
 # -------------------------------------------------------------- connector
+
+
+#: value-range stats for date-dimension attributes (the calendar is a
+#: known domain): lets grouped CTE outputs keyed on d_year pack into
+#: composite join keys, and sharpens range selectivities
+_DATE_COL_STATS = {
+    "d_year": ColumnStats(distinct_count=14, min_value=1990, max_value=2003),
+    "d_moy": ColumnStats(distinct_count=12, min_value=1, max_value=12),
+    "d_qoy": ColumnStats(distinct_count=4, min_value=1, max_value=4),
+    "d_dom": ColumnStats(distinct_count=31, min_value=1, max_value=31),
+    "d_dow": ColumnStats(distinct_count=7, min_value=0, max_value=6),
+    "d_week_seq": ColumnStats(
+        distinct_count=731, min_value=1043, max_value=1774
+    ),
+    "d_month_seq": ColumnStats(
+        distinct_count=168, min_value=1080, max_value=1247
+    ),
+}
 
 
 class _TpcdsMetadata(ConnectorMetadata):
@@ -1243,7 +1560,36 @@ class _TpcdsMetadata(ConnectorMetadata):
         "cs_ship_mode_sk": "ship_mode",
         "cs_call_center_sk": "call_center",
         "cs_warehouse_sk": "warehouse",
+        # round-5 columns (stats keep the optimizer's NDV formula and
+        # output-capacity sizing honest — a stats-less fan-in key
+        # otherwise defaults to the no-info path)
+        "sr_customer_sk": "customer",
+        "sr_store_sk": "store",
+        "sr_reason_sk": "reason",
+        "sr_cdemo_sk": "customer_demographics",
+        "cs_bill_hdemo_sk": "household_demographics",
+        "cs_catalog_page_sk": "catalog_page",
+        "cs_ship_addr_sk": "customer_address",
+        "cs_bill_addr_sk": "customer_address",
+        "cs_ship_customer_sk": "customer",
+        "cr_catalog_page_sk": "catalog_page",
+        "cr_returning_customer_sk": "customer",
+        "cr_returning_addr_sk": "customer_address",
+        "cr_call_center_sk": "call_center",
+        "ws_bill_customer_sk": "customer",
+        "ws_bill_addr_sk": "customer_address",
+        "ws_web_page_sk": "web_page",
+        "ws_promo_sk": "promotion",
+        "ws_ship_hdemo_sk": "household_demographics",
+        "wr_returning_customer_sk": "customer",
+        "wr_returning_addr_sk": "customer_address",
+        "wr_web_page_sk": "web_page",
+        "wr_reason_sk": "reason",
     }
+
+    #: 0-based time surrogate keys (t_time_sk = 0..86399): packed
+    #: separately so min/max stats stay exact for bijective key packing
+    TIME_FKS = ("ss_sold_time_sk", "cs_sold_time_sk", "ws_sold_time_sk")
 
     DATE_FKS = (
         "ss_sold_date_sk", "sr_returned_date_sk", "cs_sold_date_sk",
@@ -1281,6 +1627,14 @@ class _TpcdsMetadata(ConnectorMetadata):
                     min_value=_DATE_SK0,
                     max_value=_DATE_SK0 + N_DATES - 1,
                 )
+            elif name in self.TIME_FKS:
+                cols[name] = ColumnStats(
+                    distinct_count=min(86_400, n),
+                    min_value=0,
+                    max_value=86_399,
+                )
+            elif handle.table == "date_dim" and name in _DATE_COL_STATS:
+                cols[name] = _DATE_COL_STATS[name]
             elif name in self.FOREIGN_KEYS:
                 ref = counts[self.FOREIGN_KEYS[name]]
                 cols[name] = ColumnStats(

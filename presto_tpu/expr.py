@@ -403,6 +403,27 @@ class DictTransform(Expr):
         return T.VARCHAR
 
 
+@dataclasses.dataclass(frozen=True)
+class DictCombine(Expr):
+    """String-valued function of TWO dictionary columns (a || b): the
+    combined dictionary is the host-side cross product of both inputs'
+    values (bounded — names/labels, not free text), and the device id
+    is id_left * |right| + id_right gathered through one int32 LUT.
+    ``fn`` maps (str, str) -> str, rebuilt from ``fn_key``."""
+
+    left: Expr  # string-typed
+    right: Expr  # string-typed
+    fn_key: str
+    fn: object = dataclasses.field(hash=False, compare=False)
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def dtype(self):
+        return T.VARCHAR
+
+
 def dict_transform_fn(fn_key: str):
     """Rebuild a dictionary-function host callable from its key.
 
@@ -414,6 +435,11 @@ def dict_transform_fn(fn_key: str):
     JSON-encoded after the first colon (colon-safe)."""
     import json
 
+    if fn_key.startswith("concat2:"):
+        import json as _json
+
+        pre, mid, suf = _json.loads(fn_key.partition(":")[2])
+        return lambda a, b: pre + a + mid + b + suf
     if fn_key == "lower":
         return str.lower
     if fn_key == "upper":
@@ -778,6 +804,12 @@ class ExprLowerer:
             return self.page.block(expr.name).dictionary
         if isinstance(expr, DictTransform):
             return self._transform(expr)[0]
+        if isinstance(expr, DictCombine):
+            return self._combine(expr)[0]
+        if isinstance(expr, Coalesce) and expr.dtype.is_string:
+            return self._coalesce_dict(expr)[0]
+        if isinstance(expr, Case) and expr.dtype.is_string:
+            return self._case_dicts(expr)[0][0]
         if isinstance(expr, Literal):
             from presto_tpu.page import Dictionary
 
@@ -794,6 +826,57 @@ class ExprLowerer:
         raise NotImplementedError(
             f"no dictionary for string expression {type(expr).__name__}"
         )
+
+    def _combine(self, e: "DictCombine"):
+        """(new_dictionary, pair-id -> new-id LUT) for a two-dictionary
+        combine, cached. pair id = id_left * |right| + id_right."""
+        ld = self.dictionary_of(e.left)
+        rd = self.dictionary_of(e.right)
+        key = (e.fn_key, ld, rd)
+        if key not in self._transform_cache:
+            from presto_tpu.page import Dictionary
+
+            nl, nr = len(ld.values), len(rd.values)
+            if nl * nr > (1 << 20):
+                raise NotImplementedError(
+                    f"combined dictionary too large ({nl}x{nr}); "
+                    "two-column string functions are bounded to 2^20 "
+                    "combinations (names/labels, not free text)"
+                )
+            combined = np.asarray(
+                [
+                    str(e.fn(a, b))
+                    for a in ld.values
+                    for b in rd.values
+                ],
+                dtype=object,
+            )
+            if len(combined):
+                uniq = np.unique(combined.astype(str))
+                lut = np.searchsorted(
+                    uniq, combined.astype(str)
+                ).astype(np.int32)
+            else:
+                uniq = np.array([], dtype=object)
+                lut = np.zeros(0, np.int32)
+            new_dict = Dictionary(np.asarray(uniq, dtype=object))
+            self._transform_cache[key] = (new_dict, lut)
+        return self._transform_cache[key]
+
+    def _eval_dictcombine(self, e: "DictCombine"):
+        dl, vl = self.eval(e.left)
+        dr, vr = self.eval(e.right)
+        rd = self.dictionary_of(e.right)
+        _, lut = self._combine(e)
+        nr = max(len(rd.values), 1)
+        if len(lut) == 0:
+            return jnp.zeros((self.page.capacity,), jnp.int32), _and_valid(vl, vr)
+        pair = (
+            jnp.clip(dl, 0, (len(lut) // nr) - 1) * nr
+            + jnp.clip(dr, 0, nr - 1)
+        )
+        mapped = jnp.asarray(lut)[pair]
+        return mapped, _and_valid(vl, vr)
 
     def _transform(self, e: DictTransform):
         """(new_dictionary, old-id -> new-id LUT), cached per node."""
@@ -1051,10 +1134,20 @@ class ExprLowerer:
         if lt.is_string and rt.is_string:
             # both sides dictionary-typed: ids comparable only within ONE
             # dictionary (planner re-encodes otherwise)
-            if self.dictionary_of(e.left) != self.dictionary_of(e.right):
-                raise NotImplementedError(
-                    "cross-dictionary string comparison requires re-encode"
-                )
+            ldict = self.dictionary_of(e.left)
+            rdict = self.dictionary_of(e.right)
+            if ldict != rdict:
+                # re-encode both sides into the sorted union (Q24's
+                # c_birth_country <> upper(ca_country), s_zip = ca_zip)
+                _, (llut, rlut) = self._union_dicts((ldict, rdict))
+                if len(llut):
+                    ld = jnp.asarray(llut)[
+                        jnp.clip(ld, 0, len(llut) - 1)
+                    ]
+                if len(rlut):
+                    rd = jnp.asarray(rlut)[
+                        jnp.clip(rd, 0, len(rlut) - 1)
+                    ]
             return self._cmp(e.op, ld, rd), _and_valid(lv, rv)
         if lt.is_long_decimal or rt.is_long_decimal:
             from presto_tpu import int128
@@ -1140,7 +1233,59 @@ class ExprLowerer:
 
     # -- conditional -------------------------------------------------------
 
+    def _case_dicts(self, e: Case):
+        """((union dictionary, per-branch LUTs), branch exprs) for a
+        string-valued CASE — branches and the default re-encode into
+        one sorted union (Q36/Q70/Q86's
+        `case when lochierarchy = 0 then s_state end` sort keys)."""
+        args = [v for _, v in e.whens]
+        if e.default is not None:
+            args.append(e.default)
+        return (
+            self._union_dicts(
+                tuple(self.dictionary_of(a) for a in args)
+            ),
+            args,
+        )
+
+    def _eval_case_string(self, e: Case):
+        (_, luts), _args = self._case_dicts(e)
+
+        def remap(d, lut):
+            if len(lut):
+                return jnp.asarray(lut)[jnp.clip(d, 0, len(lut) - 1)]
+            return d
+
+        conds = []
+        vals = []
+        for (c, v), lut in zip(e.whens, luts):
+            cd, cv = self.eval(c)
+            cd = cd & cv if cv is not None else cd
+            vd, vv = self.eval(v)
+            conds.append(cd)
+            vals.append((remap(vd, lut), vv))
+        if e.default is not None:
+            dd, dv = self.eval(e.default)
+            dd = remap(dd, luts[-1])
+        else:
+            dd = jnp.zeros((self.page.capacity,), jnp.int32)
+            dv = jnp.zeros((self.page.capacity,), jnp.bool_)
+        out_d, out_v = dd, dv
+        if out_v is None:
+            out_v = jnp.ones((self.page.capacity,), jnp.bool_)
+        for cd, (vd, vv) in zip(reversed(conds), reversed(vals)):
+            out_d = jnp.where(cd, vd, out_d)
+            bv = (
+                vv
+                if vv is not None
+                else jnp.ones((self.page.capacity,), jnp.bool_)
+            )
+            out_v = jnp.where(cd, bv, out_v)
+        return out_d, out_v
+
     def _eval_case(self, e: Case):
+        if e.dtype.is_string:
+            return self._eval_case_string(e)
         # evaluate all branches, select first matching WHEN (SQL order)
         conds = []
         vals = []
@@ -1179,7 +1324,64 @@ class ExprLowerer:
                 out_v = jnp.where(cd, branch_v, out_v)
         return out_d, (out_v if needs_valid else None)
 
+    def _union_dicts(self, dicts):
+        """(sorted union Dictionary, per-input id LUTs): the shared
+        re-encode for string coalesce and cross-dictionary compares —
+        sorted union ids preserve value order, so </> stay valid."""
+        key = ("union",) + tuple(dicts)
+        if key not in self._transform_cache:
+            from presto_tpu.page import Dictionary
+
+            parts = [
+                np.asarray(d.values, dtype=object) for d in dicts
+            ]
+            allv = (
+                np.concatenate([p for p in parts if len(p)])
+                if any(len(p) for p in parts)
+                else np.array([], dtype=object)
+            )
+            uniq = (
+                np.unique(allv.astype(str))
+                if len(allv)
+                else np.array([], dtype=str)
+            )
+            luts = [
+                np.searchsorted(uniq, p.astype(str)).astype(np.int32)
+                if len(p)
+                else np.zeros(0, np.int32)
+                for p in parts
+            ]
+            self._transform_cache[key] = (
+                Dictionary(np.asarray(uniq, dtype=object)),
+                luts,
+            )
+        return self._transform_cache[key]
+
+    def _coalesce_dict(self, e: Coalesce):
+        """(union dictionary, per-arg id LUTs) for string coalesce."""
+        return self._union_dicts(
+            tuple(self.dictionary_of(a) for a in e.args)
+        )
+
     def _eval_coalesce(self, e: Coalesce):
+        if e.dtype.is_string:
+            _, luts = self._coalesce_dict(e)
+            out_d = None
+            out_v = None
+            for a, lut in zip(e.args, luts):
+                d, v = self.eval(a)
+                if len(lut):
+                    d = jnp.asarray(lut)[
+                        jnp.clip(d, 0, len(lut) - 1)
+                    ]
+                if out_d is None:
+                    out_d, out_v = d, v
+                    continue
+                if out_v is None:
+                    break
+                out_d = jnp.where(out_v, out_d, d)
+                out_v = out_v | (v if v is not None else True)
+            return out_d, out_v
         long = e.dtype.is_long_decimal
         out_d, out_v = self.eval(e.args[0])
         out_d = _coerce_to(out_d, e.args[0].dtype, e.dtype)

@@ -440,11 +440,37 @@ def _replace(args):
 )
 def _concat(args):
     cols = [a for a in args if not isinstance(a, E.Literal)]
-    if len(cols) > 1:
+    if len(cols) == 2:
+        # two dictionary columns: cross-product combined dictionary
+        # (E.DictCombine). Literals between/around the columns fold
+        # into the combine function; deeper chains nest (|| is
+        # left-associative, so a || b || c combines pairwise)
+        for col in cols:
+            _string_arg(col, "concat")
+        i0 = next(i for i, a in enumerate(args) if a is cols[0])
+        i1 = next(
+            i
+            for i, a in enumerate(args)
+            if a is cols[1] and i > i0
+        )
+        pre = "".join(
+            _lit_str(a, "concat argument") for a in args[:i0]
+        )
+        mid = "".join(
+            _lit_str(a, "concat argument")
+            for a in args[i0 + 1: i1]
+        )
+        suf = "".join(
+            _lit_str(a, "concat argument") for a in args[i1 + 1:]
+        )
+        key = f"concat2:{json.dumps([pre, mid, suf])}"
+        return E.DictCombine(
+            cols[0], cols[1], key, E.dict_transform_fn(key)
+        )
+    if len(cols) > 2:
         raise FunctionError(
-            "concat() supports one non-literal argument (dictionary "
-            "LUT design); concatenating two columns requires a "
-            "cross-dictionary rebuild"
+            "concat() supports at most two non-literal arguments "
+            "(chain || pairwise for more)"
         )
     if not cols:
         return E.Literal(

@@ -43,6 +43,32 @@ def _column_stats(node: N.PlanNode, col: str, catalogs):
             if out_name == col and isinstance(e, E.ColumnRef):
                 return _column_stats(node.source, e.name, catalogs)
         return None
+    if isinstance(node, N.JoinNode):
+        # a join carries probe columns plus build payload under their
+        # own names — thread through to whichever side owns the column
+        # (the bushy-rescue pseudo-relation is such a tree; without
+        # this its NDVs vanish and output caps explode)
+        if col in node.left.output_schema():
+            return _column_stats(node.left, col, catalogs)
+        if col in node.right.output_schema():
+            return _column_stats(node.right, col, catalogs)
+        return None
+    if isinstance(node, N.AggregationNode):
+        # group keys carry source values through (value RANGE stats
+        # stay valid; NDV can only shrink, which the consumers treat
+        # as an upper bound) — the q78 CTE shape packs its 3-key
+        # outer join on these
+        for name, e in node.group_keys:
+            if name == col and isinstance(e, E.ColumnRef):
+                return _column_stats(node.source, e.name, catalogs)
+        return None
+    if isinstance(node, N.OutputNode):
+        src = dict(node.columns).get(col)
+        if src is not None:
+            return _column_stats(node.source, src, catalogs)
+        return None
+    if isinstance(node, (N.SortNode, N.LimitNode, N.DistinctNode)):
+        return _column_stats(node.source, col, catalogs)
     return None
 
 
@@ -189,15 +215,13 @@ def unique_key_sets(node: N.PlanNode, catalogs) -> List[FrozenSet[str]]:
             node.handle
         )
         out = []
-        rc = stats.row_count
-        for col, cs in (stats.columns or {}).items():
-            if (
-                rc
-                and cs.distinct_count
-                and cs.distinct_count >= rc
-                and col in node.columns
-            ):
-                out.append(frozenset([col]))
+        # NDV stats are ESTIMATES (FK columns report min(ref, n), which
+        # equals the row count whenever the referenced table is bigger
+        # — e.g. 1000 customers drawing from 5600 demographics rows
+        # have ~917 DISTINCT values while stats claim 1000). Inferring
+        # uniqueness from them made join kernels keep ONE match per
+        # probe row and silently drop the rest; only declared primary
+        # keys prove uniqueness.
         if stats.primary_key and all(
             c in node.columns for c in stats.primary_key
         ):

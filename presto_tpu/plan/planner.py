@@ -187,13 +187,65 @@ class _Planner:
             self.ctes = saved_ctes
 
     def _plan_select_body(self, sel: ast.Select, outer):
-        # 1. FROM -> relations + equi-edge pool + outer-join structures
-        node, scope = self._plan_from(sel.from_, outer)
+        # 1. FROM -> relations + equi-edge pool; LEFT joins defer so
+        # the probe spine's pool sees WHERE equi-edges first
+        pending_on: List[ast.Node] = []
+        deferred: List[Tuple[ast.Node, Optional[ast.Node]]] = []
+        node, scope = self._plan_from(
+            sel.from_, outer,
+            pending_out=pending_on,
+            deferred_out=deferred,
+        )
 
-        # 2. WHERE: subquery predicates + plain conjuncts
+        # 2. WHERE + JOIN..ON conjuncts in ONE application, so the
+        # join pool sees the full equi-edge set at once. With deferred
+        # LEFT joins, conjuncts that resolve against the probe scope
+        # push down (preserved-side pushdown); the rest — anything
+        # touching a deferred build column (`p_promo_sk is null`) —
+        # apply after those joins attach.
+        conjs = list(pending_on)
         if sel.where is not None:
-            node, scope = self._apply_where(node, scope, sel.where)
+            conjs.extend(_split_conjuncts(sel.where))
+        if deferred:
+            probe_conjs, post_conjs = [], []
+            for c in conjs:
+                # subquery-bearing conjuncts go post unconditionally:
+                # _resolvable_in skips nested Select bodies, so a
+                # subquery correlated to a deferred build column would
+                # otherwise misclassify as probe-pushable; applying
+                # after the joins is always the plain WHERE semantics
+                (
+                    probe_conjs
+                    if not _contains_select(c)
+                    and self._resolvable_in(c, scope)
+                    else post_conjs
+                ).append(c)
+        else:
+            probe_conjs, post_conjs = conjs, []
+
+        def _and_all(cs):
+            combined = None
+            for c in cs:
+                combined = (
+                    c if combined is None
+                    else ast.BinaryOp("and", combined, c)
+                )
+            return combined
+
+        combined = _and_all(probe_conjs)
+        if combined is not None:
+            node, scope = self._apply_where(node, scope, combined)
         node = self._finalize_pool(node, scope)
+        for right_rel, on_ast in deferred:
+            right_node, right_scope = self._plan_join_child(
+                right_rel, outer
+            )
+            node, scope = self._outer_join_construct(
+                node, scope, right_node, right_scope, "left", on_ast
+            )
+        post = _and_all(post_conjs)
+        if post is not None:
+            node, scope = self._apply_where(node, scope, post)
 
         # 3. aggregation / grouping
         agg_map: Dict[ast.Node, str] = {}
@@ -299,7 +351,14 @@ class _Planner:
 
     # -------------------------------------------------------------- FROM
 
-    def _plan_from(self, from_, outer):
+    def _plan_from(self, from_, outer, pending_out=None, deferred_out=None):
+        """Plan a FROM clause. With ``pending_out`` (a list), ON
+        conjuncts of flattened inner joins are APPENDED to it and the
+        returned node may be a _PendingJoin — the caller combines them
+        with its WHERE so the join pool sees every equi-edge at once
+        (one-at-a-time application resolved the pool on the FIRST
+        conjunct's edges alone, degrading explicit JOIN..ON chains to
+        cross joins + filters). Without it, conjuncts apply here."""
         if from_ is None:
             return N.ValuesNode(), Scope({}, {}, outer)
         rels: List[Tuple[N.PlanNode, Scope]] = []
@@ -335,6 +394,15 @@ class _Planner:
                     self._pending_conjuncts.append(rel.on)
                 return
             if isinstance(rel, ast.JoinRel):
+                if deferred_out is not None and rel.join_type == "left":
+                    # defer the LEFT join: flatten its probe spine into
+                    # the pool so WHERE equi-edges join it (Q72's week
+                    # link), and attach the preserved-side build AFTER
+                    # pool resolution — probe-side filters before a
+                    # left join are the standard safe pushdown
+                    flatten2(rel.left)
+                    deferred_out.append((rel.right, rel.on))
+                    return
                 # plan the outer join as a unit
                 node, scope = self._plan_outer_join(rel, outer)
                 rels.append((node, scope))
@@ -370,8 +438,16 @@ class _Planner:
         # join pool must see its edges before any unnest caps it.
         pending = self._pending_conjuncts
         self._pending_conjuncts = []
-        for c in pending:
-            node, scope = self._apply_where(node, scope, c)
+        if pending_out is not None and not pending_unnests:
+            # defer: the caller merges these with its WHERE so the
+            # pool resolves with the full edge set
+            pending_out.extend(pending)
+            return node, scope
+        if pending:
+            combined = pending[0]
+            for c in pending[1:]:
+                combined = ast.BinaryOp("and", combined, c)
+            node, scope = self._apply_where(node, scope, combined)
         for u in pending_unnests:
             node, scope = self._apply_unnest(node, scope, u)
         return node, scope
@@ -735,17 +811,30 @@ class _Planner:
             ),
         )
 
+    def _plan_join_child(self, rel, outer):
+        """One side of an outer join: a leaf relation, a nested outer
+        join, or an INNER/CROSS JoinRel chain (the Q72 shape `a join b
+        on ... left join c`) planned through the flatten machinery —
+        saving the in-flight conjunct state the nested _plan_from call
+        would otherwise clobber."""
+        if isinstance(rel, ast.JoinRel):
+            if rel.join_type in ("cross", "inner"):
+                saved = self._pending_conjuncts
+                try:
+                    node, scope = self._plan_from(rel, outer)
+                finally:
+                    self._pending_conjuncts = saved
+                if isinstance(node, _PendingJoin):
+                    node = self._finalize_pool(node, scope)
+                return node, scope
+            return self._plan_outer_join(rel, outer)
+        return self._plan_relation(rel, outer)
+
     def _plan_outer_join(self, rel: ast.JoinRel, outer):
         jt = rel.join_type
-        left_node, left_scope = (
-            self._plan_relation(rel.left, outer)
-            if not isinstance(rel.left, ast.JoinRel)
-            else self._plan_outer_join(rel.left, outer)
-        )
-        right_node, right_scope = (
-            self._plan_relation(rel.right, outer)
-            if not isinstance(rel.right, ast.JoinRel)
-            else self._plan_outer_join(rel.right, outer)
+        left_node, left_scope = self._plan_join_child(rel.left, outer)
+        right_node, right_scope = self._plan_join_child(
+            rel.right, outer
         )
         if jt == "right":  # normalize: probe side is preserved side
             left_node, right_node = right_node, left_node
@@ -753,13 +842,24 @@ class _Planner:
             jt = "left"
         if jt not in ("left", "full"):
             raise PlanningError(f"unsupported join type: {rel.join_type}")
+        return self._outer_join_construct(
+            left_node, left_scope, right_node, right_scope, jt, rel.on
+        )
+
+    def _outer_join_construct(
+        self, left_node, left_scope, right_node, right_scope, jt, on
+    ):
+        """Build the LEFT/FULL JoinNode given both planned sides — the
+        shared tail of _plan_outer_join and the deferred-outer-join
+        path (probe side resolved first so WHERE equi-edges join its
+        pool)."""
         (left_node, left_scope), (right_node, right_scope) = (
             self._rename_clashes(
                 [(left_node, left_scope), (right_node, right_scope)]
             )
         )
         scope = left_scope.merge(right_scope)
-        conjs = _split_conjuncts(rel.on)
+        conjs = _split_conjuncts(on)
         lkeys, rkeys, build_filters, residual = [], [], [], []
         for c in conjs:
             pair = self._as_equi_pair(c, left_scope, right_scope)
@@ -811,8 +911,29 @@ class _Planner:
                 else E.And(tuple(build_filters)),
             )
         payload = tuple(right_scope.columns)
-        unique = optimizer.is_build_unique(
-            right_node, tuple(rkeys), self.catalogs
+        forced_unique = None
+        if len(lkeys) > 2:
+            # the kernel key is a 2x32-bit composite: wider outer-join
+            # keys must pack bijectively (stats-allocated bit widths);
+            # residual demotion is NOT available here — an outer join's
+            # preserved rows leave no place to re-check demoted keys
+            packed = self._pack_composite_keys(
+                left_node, right_node, list(zip(lkeys, rkeys))
+            )
+            if packed is None:
+                raise PlanningError(
+                    ">2 outer-join key columns need stats-backed "
+                    "bijective packing (unavailable here)"
+                )
+            left_node, right_node, pairs2, forced_unique = packed
+            lkeys = [p[0] for p in pairs2]
+            rkeys = [p[1] for p in pairs2]
+        unique = (
+            forced_unique
+            if forced_unique is not None
+            else optimizer.is_build_unique(
+                right_node, tuple(rkeys), self.catalogs
+            )
         )
         out_cap = None
         if not unique:
@@ -871,10 +992,13 @@ class _Planner:
         ]
         subq_ops = []
         plain = []
+        marked = []
         for c in conjuncts:
             m = self._match_subquery_conjunct(c, scope)
             if m is not None:
                 subq_ops.append(m)
+            elif _contains_membership_subquery(c):
+                marked.append(c)
             else:
                 plain.append(c)
         if isinstance(node, _PendingJoin):
@@ -884,90 +1008,231 @@ class _Planner:
             node = N.FilterNode(
                 node, preds[0] if len(preds) == 1 else E.And(tuple(preds))
             )
+        for c in marked:
+            node = self._finalize_pool(node, scope)
+            node, scope = self._apply_mark_join_conjunct(node, scope, c)
         for op in subq_ops:
             node, scope = self._apply_subquery_op(node, scope, op)
         return node, scope
 
-    def _finalize_pool(self, node, scope):
-        if isinstance(node, _PendingJoin):
-            node = self._resolve_join_pool(node, scope, [])
-        return node
+    def _apply_mark_join_conjunct(self, node, scope, c):
+        """OR-embedded IN-subquery / EXISTS predicates via MARK joins
+        (reference: SemiJoinNode's semiJoinOutput column): each
+        subquery attaches as a LEFT join against the DISTINCT inner
+        rows carrying a constant marker payload, and the predicate
+        lowers with the subquery replaced by a `marker IS NOT NULL`
+        test (the Q45 `zip-list OR item IN (subquery)` and Q10/Q35
+        `exists(...) or exists(...)` shapes). Positive polarity only:
+        under a WHERE filter, UNKNOWN and FALSE coincide, so the
+        marker test is exact; a subquery under NOT would need
+        three-valued null-awareness and raises instead."""
 
-    def _resolve_join_pool(
-        self, pool: "_PendingJoin", scope: Scope, conjuncts
-    ) -> N.PlanNode:
-        rels = list(pool.rels)
-        scopes = list(pool.scopes)
-        # ownership map: column/qualified name -> relation index
-        owner: Dict[str, int] = {}
-        for i, s in enumerate(scopes):
-            for c in s.columns:
-                owner[c] = i
-
-        def rels_of(c) -> Set[int]:
-            found: Set[int] = set()
-
-            def visit(n):
-                if isinstance(n, ast.Ident):
-                    for i, s in enumerate(scopes):
-                        try:
-                            _, _, is_outer = s.resolve(n.parts)
-                            if not is_outer:
-                                found.add(i)
-                                return
-                        except PlanningError:
-                            continue
-                    return
-                for f in dataclasses.fields(n) if dataclasses.is_dataclass(n) else []:
-                    v = getattr(n, f.name)
-                    if isinstance(v, ast.Node):
-                        visit(v)
-                    elif isinstance(v, tuple):
-                        for x in v:
-                            if isinstance(x, ast.Node):
-                                visit(x)
-                            elif (
-                                isinstance(x, tuple)
-                                and len(x) == 2
-                                and all(isinstance(y, ast.Node) for y in x)
-                            ):
-                                visit(x[0])
-                                visit(x[1])
-            visit(c)
-            return found
-
-        filters: Dict[int, List] = {}
-        edges: List[Tuple[int, int, str, str]] = []  # (i, j, col_i, col_j)
-        residual: List = []
-        for c in conjuncts:
-            rs = rels_of(c)
-            if len(rs) == 1:
-                filters.setdefault(next(iter(rs)), []).append(c)
-            elif (
-                len(rs) == 2
-                and isinstance(c, ast.BinaryOp)
-                and c.op == "="
-                and isinstance(c.left, ast.Ident)
-                and isinstance(c.right, ast.Ident)
-            ):
-                i = next(iter(rels_of(c.left)))
-                j = next(iter(rels_of(c.right)))
-                li, _, _ = scopes[i].resolve(c.left.parts)
-                rj, _, _ = scopes[j].resolve(c.right.parts)
-                edges.append((i, j, li, rj))
+        def attach(sub, negated):
+            nonlocal node, scope
+            if isinstance(sub, ast.InSubquery):
+                # the marker test collapses UNKNOWN to FALSE — exact
+                # only for a non-negated IN in positive polarity
+                if negated or sub.negate:
+                    raise PlanningError(
+                        "NOT IN (or IN under NOT) inside OR requires "
+                        "null-aware three-valued semantics "
+                        "(unsupported)"
+                    )
+                if self._is_correlated(sub.query, scope):
+                    raise PlanningError(
+                        "correlated IN under OR is not supported"
+                    )
+                sub_node, _, sub_names = self.plan_select(
+                    sub.query, outer=None
+                )
+                if len(sub_names) != 1:
+                    raise PlanningError(
+                        "IN subquery must return one column"
+                    )
+                node, scope, key = self._probe_key(node, scope, sub.arg)
+                if scope.columns[key].is_long_decimal:
+                    raise PlanningError(
+                        "IN on a long decimal (p>18) is not supported"
+                    )
+                outer_keys = (key,)
+                right_keys = tuple(sub_names)
+                build = sub_node
+                invert = False
+            elif isinstance(sub, ast.Exists):
+                q = sub.query
+                if q.group_by or q.having:
+                    raise PlanningError(
+                        "EXISTS with GROUP BY/HAVING under OR is not "
+                        "supported"
+                    )
+                corr_pairs, residual_where = self._extract_correlation(
+                    q, scope
+                )
+                if not corr_pairs:
+                    raise PlanningError(
+                        "uncorrelated or non-equality-correlated "
+                        "EXISTS under OR is not supported"
+                    )
+                inner_cols = tuple(p[0] for p in corr_pairs)
+                inner_sel = ast.Select(
+                    items=tuple(
+                        ast.SelectItem(ast.Ident((ic,)), None)
+                        for ic in inner_cols
+                    ),
+                    from_=q.from_,
+                    where=residual_where,
+                    ctes=q.ctes,
+                )
+                build, _, right_keys = self.plan_select(
+                    inner_sel, outer=None
+                )
+                right_keys = tuple(right_keys)
+                outer_keys = tuple(p[1] for p in corr_pairs)
+                # return THIS node's truth value (enclosing NOTs stay
+                # in the tree and invert it); NOT EXISTS is 2-valued,
+                # so inverting the marker is exact
+                invert = sub.negate
             else:
-                residual.append(c)
-
-        for i, fs in filters.items():
-            preds = [self._lower(f, scopes[i]) for f in fs]
-            rels[i] = N.FilterNode(
-                rels[i], preds[0] if len(preds) == 1 else E.And(tuple(preds))
+                raise PlanningError(
+                    "unsupported subquery shape under OR"
+                )
+            for k in outer_keys:
+                if scope.columns[k].is_long_decimal:
+                    raise PlanningError(
+                        "mark join on a long decimal key is not "
+                        "supported"
+                    )
+            marker = self._fresh("mark")
+            bschema = dict(build.output_schema())
+            build = N.DistinctNode(
+                source=build, max_groups=self._agg_bucket(build)
+            )
+            build = N.ProjectNode(
+                build,
+                tuple(
+                    (n, E.ColumnRef(n, bschema[n])) for n in right_keys
+                )
+                + ((marker, E.Literal(1, T.BIGINT)),),
+            )
+            node = N.JoinNode(
+                left=node,
+                right=build,
+                join_type="left",
+                left_keys=outer_keys,
+                right_keys=right_keys,
+                payload=(marker,),
+                build_unique=True,
+            )
+            scope = Scope(
+                {**scope.columns, marker: T.BIGINT},
+                scope.qualifiers,
+                scope.parent,
+            )
+            return ast.IsNullExpr(
+                ast.Ident((marker,)), negate=not invert
             )
 
-        est = [optimizer.estimate_rows(r, self.catalogs) for r in rels]
-        joined = {max(range(len(rels)), key=lambda i: est[i])}
-        tree = rels[next(iter(joined))]
-        remaining = set(range(len(rels))) - joined
+        def rewrite(n, negated):
+            if isinstance(n, (ast.InSubquery, ast.Exists)):
+                return attach(n, negated)
+            if isinstance(n, ast.UnaryOp) and n.op == "not":
+                return dataclasses.replace(
+                    n, arg=rewrite(n.arg, not negated)
+                )
+            if isinstance(n, ast.Select) or not isinstance(n, ast.Node):
+                return n
+            kwargs = {}
+            changed = False
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, ast.Node):
+                    nv = rewrite(v, negated)
+                elif isinstance(v, tuple):
+                    nv = tuple(
+                        rewrite(x, negated)
+                        if isinstance(x, ast.Node)
+                        else x
+                        for x in v
+                    )
+                else:
+                    nv = v
+                kwargs[f.name] = nv
+                changed |= nv is not v
+            return dataclasses.replace(n, **kwargs) if changed else n
+
+        rewritten = rewrite(c, False)
+        pred = self._lower(rewritten, scope)
+        return N.FilterNode(node, pred), scope
+
+
+    def _resolvable_in(self, c, scope: Scope) -> bool:
+        """True when every column reference in ``c`` (outside nested
+        Select bodies) resolves in ``scope`` — the classifier that
+        decides whether a WHERE conjunct pushes below deferred LEFT
+        joins."""
+        ok = True
+
+        def visit(n):
+            nonlocal ok
+            if not ok or not isinstance(n, ast.Node):
+                return
+            if isinstance(n, ast.Select):
+                return
+            if isinstance(n, ast.Ident):
+                try:
+                    scope.resolve(n.parts)
+                except PlanningError:
+                    ok = False
+                return
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, ast.Node):
+                    visit(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, ast.Node):
+                            visit(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ast.Node):
+                                    visit(y)
+        visit(c)
+        return ok
+
+    @staticmethod
+    def _edge_connected(indices, edges) -> bool:
+        """True when ``indices`` form one connected component under
+        ``edges`` — the bushy rescue must not cross-join unrelated
+        relations into its subtree."""
+        indices = set(indices)
+        if len(indices) <= 1:
+            return True
+        adj: Dict[int, Set[int]] = {i: set() for i in indices}
+        for (i, j, _ci, _cj) in edges:
+            if i in indices and j in indices:
+                adj[i].add(j)
+                adj[j].add(i)
+        seen = set()
+        stack = [next(iter(indices))]
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj[n] - seen)
+        return seen == indices
+
+    def _grow_join_tree(
+        self, tree, joined, remaining, rels, scopes, est, edges, grow
+    ):
+        """The greedy left-deep join loop over a shared relation pool
+        (indices into ``rels``/``est``; ``edges`` as (i, j, col_i,
+        col_j)). ``grow`` re-enters this method for the bushy rescue:
+        when the best edged candidate explodes (Q72's inventory x
+        catalog_sales on item alone), the REMAINING relations resolve
+        into their own subtree first, which then attaches as one
+        pseudo-relation over every crossing edge — the composite
+        (item, week) join the reference's CBO produces."""
         while remaining:
             # edges from joined set to a candidate relation
             cand: Dict[int, List[Tuple[str, str]]] = {}
@@ -1030,6 +1295,48 @@ class _Planner:
                 return (out_est, not unique, est[i])
 
             nxt = min(cand, key=rank)
+            out_est_nxt, nxt_nonunique, _ = rank(nxt)
+            if (
+                grow is not None
+                and nxt_nonunique
+                and len(remaining) >= 2
+                and out_est_nxt > 8.0 * max(tree_est, 1024.0)
+                and out_est_nxt > float(1 << 20)
+                and self._edge_connected(remaining, edges)
+            ):
+                # bushy rescue: the best edged candidate fans out
+                # (Q72: inventory x catalog_sales on item alone,
+                # probe*build/NDV ~ 9M). Resolve the REMAINING
+                # relations into their own subtree first, then attach
+                # it as ONE pseudo-relation — every tree<->subtree
+                # edge (item AND the d1/d2 week link) composites into
+                # a single selective join, the shape the reference's
+                # CBO produces for this plan.
+                sub_set = frozenset(remaining)
+                sub_seed = max(sub_set, key=lambda i: est[i])
+                sub_tree = grow(
+                    rels[sub_seed],
+                    {sub_seed},
+                    set(sub_set) - {sub_seed},
+                )
+                new_i = len(rels)
+                rels.append(sub_tree)
+                scopes.append(
+                    Scope(dict(sub_tree.output_schema()), {}, None)
+                )
+                est.append(
+                    optimizer.estimate_rows(sub_tree, self.catalogs)
+                )
+                remapped = []
+                for (i, j, ci, cj) in edges:
+                    ii = new_i if i in sub_set else i
+                    jj = new_i if j in sub_set else j
+                    if ii == jj:
+                        continue  # consumed inside the subtree
+                    remapped.append((ii, jj, ci, cj))
+                edges[:] = remapped
+                remaining = {new_i}
+                continue
             pairs = cand[nxt]
             build = rels[nxt]
             extra_pairs: List[Tuple[str, str]] = []
@@ -1110,9 +1417,32 @@ class _Planner:
             if not unique:
                 probe_est = optimizer.estimate_rows(tree, self.catalogs)
                 build_est = est[nxt]
-                out_cap = bucket_capacity(
-                    int(max(probe_est, build_est) * 4) + 1024
-                )
+                # stats-driven OUTPUT estimate (the ranker's FK-join
+                # formula over the kernel keys): a fan-out join like
+                # Q72's inventory x catalog_sales on item alone
+                # produces probe*build/NDV rows — sizing from inputs
+                # only sent it through the 4x capacity-retry loop,
+                # recompiling the whole program at each step
+                ndv = 1.0
+                saw_stats = False
+                for k in rkeys:
+                    cs_ = optimizer._column_stats(
+                        build, k, self.catalogs
+                    )
+                    if cs_ and cs_.distinct_count:
+                        ndv *= float(cs_.distinct_count)
+                        saw_stats = True
+                ndv = max(min(ndv, build_est), 1.0)
+                # ndv=1 with NO stats means "no information", not "one
+                # distinct value" — only widen the bucket beyond the
+                # input-sized default when stats actually back the
+                # fan-out estimate (a stats-less guess of probe*build
+                # compiled a 268M-row program for a 2k-row join)
+                cap_est = int(max(probe_est, build_est) * 4)
+                if saw_stats:
+                    out_est = probe_est * build_est / ndv
+                    cap_est = max(cap_est, int(out_est * 3 / 2))
+                out_cap = bucket_capacity(cap_est + 1024)
             join_residual = None
             if extra_pairs:
                 tree_schema = dict(tree.output_schema())
@@ -1147,6 +1477,105 @@ class _Planner:
             )
             joined.add(nxt)
             remaining.discard(nxt)
+        return tree
+
+    def _finalize_pool(self, node, scope):
+        if isinstance(node, _PendingJoin):
+            node = self._resolve_join_pool(node, scope, [])
+        return node
+
+    def _resolve_join_pool(
+        self, pool: "_PendingJoin", scope: Scope, conjuncts
+    ) -> N.PlanNode:
+        rels = list(pool.rels)
+        scopes = list(pool.scopes)
+        # ownership map: column/qualified name -> relation index
+        owner: Dict[str, int] = {}
+        for i, s in enumerate(scopes):
+            for c in s.columns:
+                owner[c] = i
+
+        def rels_of(c) -> Set[int]:
+            found: Set[int] = set()
+
+            def visit(n):
+                if isinstance(n, ast.Ident):
+                    for i, s in enumerate(scopes):
+                        try:
+                            _, _, is_outer = s.resolve(n.parts)
+                            if not is_outer:
+                                found.add(i)
+                                return
+                        except PlanningError:
+                            continue
+                    return
+                for f in dataclasses.fields(n) if dataclasses.is_dataclass(n) else []:
+                    v = getattr(n, f.name)
+                    if isinstance(v, ast.Node):
+                        visit(v)
+                    elif isinstance(v, tuple):
+                        for x in v:
+                            if isinstance(x, ast.Node):
+                                visit(x)
+                            elif (
+                                isinstance(x, tuple)
+                                and len(x) == 2
+                                and all(isinstance(y, ast.Node) for y in x)
+                            ):
+                                visit(x[0])
+                                visit(x[1])
+            visit(c)
+            return found
+
+        filters: Dict[int, List] = {}
+        edges: List[Tuple[int, int, str, str]] = []  # (i, j, col_i, col_j)
+        residual: List = []
+        for c in conjuncts:
+            rs = rels_of(c)
+            if len(rs) == 1:
+                filters.setdefault(next(iter(rs)), []).append(c)
+            elif (
+                len(rs) == 2
+                and isinstance(c, ast.BinaryOp)
+                and c.op == "="
+                and isinstance(c.left, ast.Ident)
+                and isinstance(c.right, ast.Ident)
+            ):
+                i = next(iter(rels_of(c.left)))
+                j = next(iter(rels_of(c.right)))
+                li, _, _ = scopes[i].resolve(c.left.parts)
+                rj, _, _ = scopes[j].resolve(c.right.parts)
+                edges.append((i, j, li, rj))
+            else:
+                residual.append(c)
+
+        for i, fs in filters.items():
+            preds = [self._lower(f, scopes[i]) for f in fs]
+            rels[i] = N.FilterNode(
+                rels[i], preds[0] if len(preds) == 1 else E.And(tuple(preds))
+            )
+
+        est = [optimizer.estimate_rows(r, self.catalogs) for r in rels]
+
+        def grow_sub(tree, joined, remaining):
+            # the subtree grower NEVER rescues: a nested rescue's
+            # in-place edge remap would orphan crossing edges of the
+            # outer rescue (silently dropping join predicates)
+            return self._grow_join_tree(
+                tree, joined, remaining, rels, scopes, est, edges,
+                grow=None,
+            )
+
+        def grow(tree, joined, remaining):
+            return self._grow_join_tree(
+                tree, joined, remaining, rels, scopes, est, edges,
+                grow=grow_sub,
+            )
+
+        joined = {max(range(len(rels)), key=lambda i: est[i])}
+        tree = rels[next(iter(joined))]
+        remaining = set(range(len(rels))) - joined
+        tree = grow(tree, joined, remaining)
 
         if residual:
             preds = [self._lower(c, scope) for c in residual]
@@ -2081,14 +2510,23 @@ class _Planner:
             if 0 <= idx < len(projections):
                 return projections[idx][0]
             raise PlanningError(f"ORDER BY position {e.text} out of range")
-        return self._lower(e, scope, agg_map=agg_map, win_map=win_map)
+        # output aliases may appear INSIDE order-key expressions
+        # (Q36-class `order by case when lochierarchy = 0 ...`): lower
+        # with the projection exprs as an Ident fallback
+        return self._lower(
+            e, scope, agg_map=agg_map, win_map=win_map,
+            alias_map=dict(projections),
+        )
 
     def _lower(
-        self, e: ast.Node, scope: Scope, agg_map=None, win_map=None
+        self, e: ast.Node, scope: Scope, agg_map=None, win_map=None,
+        alias_map=None,
     ) -> E.Expr:
         agg_map = agg_map or {}
         win_map = win_map or {}
-        lower = lambda x: self._lower(x, scope, agg_map, win_map)  # noqa: E731
+        lower = lambda x: self._lower(  # noqa: E731
+            x, scope, agg_map, win_map, alias_map
+        )
 
         if e in agg_map:
             name = agg_map[e]
@@ -2101,6 +2539,14 @@ class _Planner:
             try:
                 name, dtype, is_outer = scope.resolve(e.parts)
             except PlanningError:
+                # output-alias fallback (ORDER BY keys referencing
+                # select aliases inside expressions)
+                if (
+                    alias_map
+                    and len(e.parts) == 1
+                    and e.parts[0] in alias_map
+                ):
+                    return alias_map[e.parts[0]]
                 # row field access: the trailing part may be a field of
                 # a ROW column (reference: DereferenceExpression)
                 if len(e.parts) < 2:
@@ -2178,12 +2624,29 @@ class _Planner:
             else:
                 whens = [(lower(c), lower(v)) for c, v in e.whens]
             default = lower(e.default) if e.default is not None else None
-            rtypes = [v.dtype for _, v in whens]
-            if default is not None:
+
+            def _is_null_lit(x):
+                return isinstance(x, E.Literal) and x.value is None
+
+            # NULL-literal branches don't vote on the result type
+            # (reference UNKNOWN coercion): `then 'label' else null`
+            # stays varchar
+            rtypes = [
+                v.dtype for _, v in whens if not _is_null_lit(v)
+            ]
+            if default is not None and not _is_null_lit(default):
                 rtypes.append(default.dtype)
+            if not rtypes:
+                rtypes = [T.BIGINT]
             rt = rtypes[0]
             for t in rtypes[1:]:
                 rt = T.common_super_type(rt, t)
+            whens = [
+                (c, E.Literal(None, rt) if _is_null_lit(v) else v)
+                for c, v in whens
+            ]
+            if default is not None and _is_null_lit(default):
+                default = E.Literal(None, rt)
             return E.Case(tuple(whens), default, rt)
         if isinstance(e, ast.CastExpr):
             return E.Cast(lower(e.arg), T.parse_type(e.type_name))
@@ -2194,13 +2657,33 @@ class _Planner:
         if isinstance(e, ast.InList):
             arg = lower(e.arg)
             vals = []
+            exprs = []
             for v in e.values:
                 lv = lower(v)
+                lv = _fold_constant(lv)
                 if not isinstance(lv, E.Literal):
-                    raise PlanningError("IN list must be literals")
+                    exprs.append(lv)
+                    continue
                 if not arg.dtype.is_string and lv.dtype != arg.dtype:
                     lv = _coerce_literal(lv, arg.dtype)
                 vals.append(lv)
+            if exprs:
+                # non-constant members (x IN (a, col+1, ...)): the
+                # list form keeps the literals, the rest become OR'd
+                # equalities (reference: InPredicate rewrite)
+                terms = [
+                    E.Compare("=", arg, x) for x in exprs
+                ]
+                if vals:
+                    terms.append(
+                        E.InList(arg, tuple(vals), False)
+                    )
+                disj = (
+                    terms[0] if len(terms) == 1 else E.Or(tuple(terms))
+                )
+                if e.negate:
+                    return E.Not(disj)
+                return disj
             return E.InList(arg, tuple(vals), e.negate)
         if isinstance(e, ast.LikeExpr):
             pat = lower(e.pattern)
@@ -2468,6 +2951,70 @@ def _find_scalar_subqueries(e: ast.Node) -> List["ast.ScalarSubquery"]:
 
     walk(e)
     return out
+
+
+def _fold_constant(e):
+    """Fold integer Literal-Literal arithmetic (the `1999 + 1` of IN
+    lists and ROLLUP windows) into one Literal; anything else passes
+    through unchanged."""
+    if (
+        isinstance(e, E.Arithmetic)
+        and e.op in ("+", "-", "*")
+        and isinstance(e.left, E.Literal)
+        and isinstance(e.right, E.Literal)
+        and e.left.value is not None
+        and e.right.value is not None
+        and e.left.dtype.is_integer
+        and e.right.dtype.is_integer
+    ):
+        a, b = int(e.left.value), int(e.right.value)
+        v = a + b if e.op == "+" else (a - b if e.op == "-" else a * b)
+        return E.Literal(v, e.dtype)
+    return e
+
+
+def _contains_select(e) -> bool:
+    """True when a nested Select (sub)query appears anywhere in ``e``."""
+    if isinstance(e, ast.Select):
+        return True
+    if not isinstance(e, ast.Node):
+        return False
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, ast.Node):
+            if _contains_select(v):
+                return True
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, ast.Node) and _contains_select(x):
+                    return True
+                if isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Node) and _contains_select(y):
+                            return True
+    return False
+
+
+def _contains_membership_subquery(e: ast.Node) -> bool:
+    """True when an IN-subquery or EXISTS hides inside ``e`` (not as
+    the whole conjunct — those take the semi/anti fast path); such
+    conjuncts lower via mark joins."""
+    if isinstance(e, (ast.InSubquery, ast.Exists)):
+        return True
+    if isinstance(e, ast.Select) or not isinstance(e, ast.Node):
+        return False
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, ast.Node):
+            if _contains_membership_subquery(v):
+                return True
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(
+                    x, ast.Node
+                ) and _contains_membership_subquery(x):
+                    return True
+    return False
 
 
 def _split_conjuncts(e: ast.Node) -> List[ast.Node]:

@@ -74,7 +74,8 @@ def encode(obj: Any) -> Any:
         out = {"@": cls.__name__}
         for f in dataclasses.fields(obj):
             if f.name == "fn" and isinstance(
-                obj, (E.DictTransform, E.DictPredicate, E.DictIntFunc)
+                obj, (E.DictTransform, E.DictPredicate, E.DictIntFunc,
+                      E.DictCombine)
             ):
                 # host callables don't cross the wire: fn_key is the
                 # canonical identity, rebuilt at decode time
@@ -107,7 +108,8 @@ def decode(data: Any) -> Any:
         if f.name in data:
             kwargs[f.name] = _coerce(decode(data[f.name]), f.type, cls)
     if (
-        cls in (E.DictTransform, E.DictPredicate, E.DictIntFunc)
+        cls in (E.DictTransform, E.DictPredicate, E.DictIntFunc,
+            E.DictCombine)
         and "fn" not in kwargs
     ):
         kwargs["fn"] = E.dict_transform_fn(kwargs["fn_key"])

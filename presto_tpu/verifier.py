@@ -34,6 +34,28 @@ def _days_to_iso(days: np.ndarray) -> List[str]:
     ]
 
 
+def _make_moment_agg(ddof: int, sqrt_out: bool):
+    """sqlite aggregate factory for stddev/variance families."""
+
+    class _Agg:
+        def __init__(self):
+            self.vals = []
+
+        def step(self, v):
+            if v is not None:
+                self.vals.append(float(v))
+
+        def finalize(self):
+            n = len(self.vals)
+            if n <= ddof:
+                return None
+            mean = sum(self.vals) / n
+            var = sum((x - mean) ** 2 for x in self.vals) / (n - ddof)
+            return var ** 0.5 if sqrt_out else var
+
+    return _Agg
+
+
 class SqliteOracle:
     """sqlite mirror of a generated-catalog schema (decimals as REAL,
     dates as ISO TEXT) plus the dialect renderer. ``catalog`` selects
@@ -41,6 +63,15 @@ class SqliteOracle:
 
     def __init__(self, schema: str = "tiny", catalog: str = "tpch"):
         self.conn = sqlite3.connect(":memory:")
+        # statistics aggregates sqlite lacks (engine side:
+        # functions.py registry) — Welford-free two-pass-safe sums
+        for name, ddof in (
+            ("stddev_samp", 1), ("stddev", 1), ("stddev_pop", 0),
+            ("var_samp", 1), ("variance", 1), ("var_pop", 0),
+        ):
+            self.conn.create_aggregate(
+                name, 1, _make_moment_agg(ddof, name.startswith("std"))
+            )
         self.schema = schema
         self.catalog = catalog
         if catalog == "tpch":
