@@ -49,6 +49,10 @@ class WindowCall:
     out_name: str
     offset: int = 1
     default: Optional[Expr] = None
+    #: aggregate frame: "range" = default RANGE UNBOUNDED..CURRENT ROW
+    #: (peers share the last peer row's value); "rows" = ROWS
+    #: UNBOUNDED..CURRENT ROW (each row sees its own prefix)
+    frame: str = "range"
 
     def result_type(self) -> T.DataType:
         if self.func in ("row_number", "rank", "dense_rank", "count",
@@ -357,7 +361,12 @@ def _window_agg(
             cnt_cs[jnp.maximum(part_start[safe_pid] - 1, 0)],
             jnp.zeros((), jnp.int64),
         )
-        run_cnt = (cnt_cs - cnt_before)[peer_end[safe_peer]]
+        run_within = cnt_cs - cnt_before
+        run_cnt = (
+            run_within
+            if call.frame == "rows"
+            else run_within[peer_end[safe_peer]]
+        )
 
     if call.func in ("min", "max"):
         if at.name in ("double", "real"):
@@ -377,8 +386,12 @@ def _window_agg(
                 return bp, jnp.where(ap == bp, op(av, bv), bv)
 
             _, out = jax.lax.associative_scan(combine, (pid, xv))
-            # RANGE frame: peers share the value at the last peer row
-            data = out[peer_end[safe_peer]]
+            # RANGE: peers share the last peer row's value; ROWS: own
+            data = (
+                out
+                if call.frame == "rows"
+                else out[peer_end[safe_peer]]
+            )
             has = run_cnt > 0
         else:
             seg = (
@@ -407,8 +420,12 @@ def _window_agg(
             jnp.zeros((), cs.dtype),
         )
         within = cs - before_part
-        # RANGE frame: peers share the value at the last peer row
-        data = within[peer_end[safe_peer]]
+        # RANGE: peers share the last peer row's value; ROWS: own
+        data = (
+            within
+            if call.frame == "rows"
+            else within[peer_end[safe_peer]]
+        )
         if call.func == "count":
             return Block(data=data.astype(jnp.int64), valid=None, dtype=T.BIGINT)
         if call.func == "avg":

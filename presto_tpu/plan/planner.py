@@ -2490,7 +2490,12 @@ class _Planner:
                             f"{f.name}() requires an argument"
                         )
                     arg = lower_w(f.args[0])
-                    wcalls.append(WindowCall(f.name, arg, out_name))
+                    wcalls.append(
+                        WindowCall(
+                            f.name, arg, out_name,
+                            frame=spec.frame or "range",
+                        )
+                    )
                 win_map[f] = out_name
             node = N.WindowNode(node, pby, oby, tuple(wcalls))
         scope = Scope(dict(node.output_schema()), scope.qualifiers, scope.parent)

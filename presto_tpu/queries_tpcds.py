@@ -1038,6 +1038,216 @@ OFFICIAL = {
                                 = wr1.wr_order_number)
         order by count(distinct ws_order_number)
         limit 100""",
+    # Q14: brand/class/category combos sold in ALL three channels
+    # (INTERSECT chain), channel revenue over the average, ROLLUP over
+    # channel x hierarchy
+    "q14": f"""
+        with cross_items as (
+          select i_item_sk as ss_item_sk
+          from {S}.item,
+               (select iss.i_brand_id as brand_id,
+                       iss.i_class_id as class_id,
+                       iss.i_category_id as category_id
+                from {S}.store_sales, {S}.item iss, {S}.date_dim d1
+                where ss_item_sk = iss.i_item_sk
+                  and ss_sold_date_sk = d1.d_date_sk
+                  and d1.d_year between 1998 and 1998 + 2
+                intersect
+                select ics.i_brand_id as brand_id,
+                       ics.i_class_id as class_id,
+                       ics.i_category_id as category_id
+                from {S}.catalog_sales, {S}.item ics, {S}.date_dim d2
+                where cs_item_sk = ics.i_item_sk
+                  and cs_sold_date_sk = d2.d_date_sk
+                  and d2.d_year between 1998 and 1998 + 2
+                intersect
+                select iws.i_brand_id as brand_id,
+                       iws.i_class_id as class_id,
+                       iws.i_category_id as category_id
+                from {S}.web_sales, {S}.item iws, {S}.date_dim d3
+                where ws_item_sk = iws.i_item_sk
+                  and ws_sold_date_sk = d3.d_date_sk
+                  and d3.d_year between 1998 and 1998 + 2) x
+          where i_brand_id = brand_id
+            and i_class_id = class_id
+            and i_category_id = category_id),
+        avg_sales as (
+          select avg(quantity * list_price) as average_sales
+          from (select ss_quantity as quantity,
+                       ss_list_price as list_price
+                from {S}.store_sales, {S}.date_dim
+                where ss_sold_date_sk = d_date_sk
+                  and d_year between 1998 and 1998 + 2
+                union all
+                select cs_quantity as quantity,
+                       cs_list_price as list_price
+                from {S}.catalog_sales, {S}.date_dim
+                where cs_sold_date_sk = d_date_sk
+                  and d_year between 1998 and 1998 + 2
+                union all
+                select ws_quantity as quantity,
+                       ws_list_price as list_price
+                from {S}.web_sales, {S}.date_dim
+                where ws_sold_date_sk = d_date_sk
+                  and d_year between 1998 and 1998 + 2) x)
+        select channel, i_brand_id, i_class_id, i_category_id,
+               sum(sales) as sum_sales,
+               sum(number_sales) as sum_number_sales
+        from (select 'store' as channel, i_brand_id, i_class_id,
+                     i_category_id,
+                     sum(ss_quantity * ss_list_price) as sales,
+                     count(*) as number_sales
+              from {S}.store_sales, {S}.item, {S}.date_dim
+              where ss_item_sk in (select ss_item_sk
+                                   from cross_items)
+                and ss_item_sk = i_item_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2000
+                and d_moy = 11
+              group by i_brand_id, i_class_id, i_category_id
+              having sum(ss_quantity * ss_list_price) >
+                     (select average_sales from avg_sales)
+              union all
+              select 'catalog' as channel, i_brand_id, i_class_id,
+                     i_category_id,
+                     sum(cs_quantity * cs_list_price) as sales,
+                     count(*) as number_sales
+              from {S}.catalog_sales, {S}.item, {S}.date_dim
+              where cs_item_sk in (select ss_item_sk
+                                   from cross_items)
+                and cs_item_sk = i_item_sk
+                and cs_sold_date_sk = d_date_sk
+                and d_year = 2000
+                and d_moy = 11
+              group by i_brand_id, i_class_id, i_category_id
+              having sum(cs_quantity * cs_list_price) >
+                     (select average_sales from avg_sales)
+              union all
+              select 'web' as channel, i_brand_id, i_class_id,
+                     i_category_id,
+                     sum(ws_quantity * ws_list_price) as sales,
+                     count(*) as number_sales
+              from {S}.web_sales, {S}.item, {S}.date_dim
+              where ws_item_sk in (select ss_item_sk
+                                   from cross_items)
+                and ws_item_sk = i_item_sk
+                and ws_sold_date_sk = d_date_sk
+                and d_year = 2000
+                and d_moy = 11
+              group by i_brand_id, i_class_id, i_category_id
+              having sum(ws_quantity * ws_list_price) >
+                     (select average_sales from avg_sales)) y
+        group by rollup (channel, i_brand_id, i_class_id,
+                         i_category_id)
+        order by channel, i_brand_id, i_class_id, i_category_id
+        limit 100""",
+    # Q23: off-season catalog/web revenue from frequent-item,
+    # best-customer purchases (HAVING against scalar CTE maxima)
+    "q23": f"""
+        with frequent_ss_items as (
+          select substr(i_item_desc, 1, 30) as itemdesc,
+                 i_item_sk as item_sk, d_date as solddate,
+                 count(*) as cnt
+          from {S}.store_sales, {S}.date_dim, {S}.item
+          where ss_sold_date_sk = d_date_sk
+            and ss_item_sk = i_item_sk
+            and d_year in (1998, 1998 + 1, 1998 + 2)
+          group by substr(i_item_desc, 1, 30), i_item_sk, d_date
+          having count(*) > 4),
+        max_store_sales as (
+          select max(csales) as tpcds_cmax
+          from (select c_customer_sk,
+                       sum(ss_quantity * ss_sales_price) as csales
+                from {S}.store_sales, {S}.customer, {S}.date_dim
+                where ss_customer_sk = c_customer_sk
+                  and ss_sold_date_sk = d_date_sk
+                  and d_year in (1998, 1998 + 1, 1998 + 2)
+                group by c_customer_sk) t),
+        best_ss_customer as (
+          select c_customer_sk,
+                 sum(ss_quantity * ss_sales_price) as ssales
+          from {S}.store_sales, {S}.customer
+          where ss_customer_sk = c_customer_sk
+          group by c_customer_sk
+          having sum(ss_quantity * ss_sales_price) >
+                 (50 / 100.0) * (select tpcds_cmax
+                                 from max_store_sales))
+        select sum(sales) as total
+        from (select cs_quantity * cs_list_price as sales
+              from {S}.catalog_sales, {S}.date_dim
+              where d_year = 2000
+                and d_moy = 2
+                and cs_sold_date_sk = d_date_sk
+                and cs_item_sk in (select item_sk
+                                   from frequent_ss_items)
+                and cs_bill_customer_sk in
+                    (select c_customer_sk from best_ss_customer)
+              union all
+              select ws_quantity * ws_list_price as sales
+              from {S}.web_sales, {S}.date_dim
+              where d_year = 2000
+                and d_moy = 2
+                and ws_sold_date_sk = d_date_sk
+                and ws_item_sk in (select item_sk
+                                   from frequent_ss_items)
+                and ws_bill_customer_sk in
+                    (select c_customer_sk from best_ss_customer)) x
+        limit 100""",
+    # Q51: item-date cumulative web vs store revenue crossover — ROWS
+    # running sums inside the CTEs, running max over the FULL OUTER
+    # join of both channels
+    "q51": f"""
+        with web_v1 as (
+          select ws_item_sk as item_sk, d_date,
+                 sum(sum(ws_sales_price))
+                   over (partition by ws_item_sk
+                         order by d_date
+                         rows between unbounded preceding
+                         and current row) as cume_sales
+          from {S}.web_sales, {S}.date_dim
+          where ws_sold_date_sk = d_date_sk
+            and d_month_seq between 1188 and 1188 + 11
+          group by ws_item_sk, d_date),
+        store_v1 as (
+          select ss_item_sk as item_sk, d_date,
+                 sum(sum(ss_sales_price))
+                   over (partition by ss_item_sk
+                         order by d_date
+                         rows between unbounded preceding
+                         and current row) as cume_sales
+          from {S}.store_sales, {S}.date_dim
+          where ss_sold_date_sk = d_date_sk
+            and d_month_seq between 1188 and 1188 + 11
+          group by ss_item_sk, d_date)
+        select *
+        from (select item_sk, d_date, web_sales, store_sales,
+                     max(web_cumulative)
+                       over (partition by item_sk
+                             order by d_date
+                             rows between unbounded preceding
+                             and current row) as web_cumulative,
+                     max(store_cumulative)
+                       over (partition by item_sk
+                             order by d_date
+                             rows between unbounded preceding
+                             and current row) as store_cumulative
+              from (select case when web.item_sk is not null
+                                then web.item_sk
+                                else store.item_sk end as item_sk,
+                           case when web.d_date is not null
+                                then web.d_date
+                                else store.d_date end as d_date,
+                           web.cume_sales as web_sales,
+                           store.cume_sales as store_sales,
+                           web.cume_sales as web_cumulative,
+                           store.cume_sales as store_cumulative
+                    from web_v1 web
+                         full join store_v1 store
+                           on web.item_sk = store.item_sk
+                          and web.d_date = store.d_date) x) y
+        where web_cumulative > store_cumulative
+        order by item_sk, d_date
+        limit 100""",
     # Q36: gross margin by category hierarchy ROLLUP with rank within
     # each hierarchy level (grouping() in window partition keys and a
     # string CASE sort key)

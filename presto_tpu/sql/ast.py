@@ -89,6 +89,9 @@ class FuncCall(Node):
 class Over(Node):
     partition_by: Tuple[Node, ...]
     order_by: Tuple["SortItem", ...]
+    #: None = default frame; "rows"/"range" = explicit
+    #: BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
+    frame: "Optional[str]" = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -825,6 +825,33 @@ class _Parser:
                 oby.append(self._sort_item())
                 while self.accept_op(","):
                     oby.append(self._sort_item())
+            frame = None
+            fkw = self.accept_kw("rows", "range")
+            if fkw:
+                # only the UNBOUNDED PRECEDING .. CURRENT ROW frame is
+                # supported (running aggregates); reference frames
+                # beyond it raise here
+                self.expect_kw("between")
+                if not (
+                    self.accept_kw("unbounded")
+                    or self.cur.value == "unbounded"
+                ):
+                    raise ParseError(
+                        "only ROWS/RANGE BETWEEN UNBOUNDED PRECEDING "
+                        "AND CURRENT ROW frames are supported"
+                    )
+                if self.cur.value == "unbounded":
+                    self.advance()
+                if str(self.advance().value).lower() != "preceding":
+                    raise ParseError("expected PRECEDING in frame")
+                self.expect_kw("and")
+                cur = str(self.advance().value).lower()
+                row = str(self.advance().value).lower()
+                if (cur, row) != ("current", "row"):
+                    raise ParseError(
+                        "only ... AND CURRENT ROW frames are supported"
+                    )
+                frame = fkw
             self.expect_op(")")
-            win = ast.Over(tuple(pby), tuple(oby))
+            win = ast.Over(tuple(pby), tuple(oby), frame)
         return ast.FuncCall(name, tuple(args), distinct, win)

@@ -300,6 +300,11 @@ def _r(n: ast.Node) -> str:
                         for s in n.window.order_by
                     )
                 )
+            if n.window.frame:
+                over.append(
+                    f"{n.window.frame.upper()} BETWEEN UNBOUNDED "
+                    "PRECEDING AND CURRENT ROW"
+                )
             args = ", ".join(_r(a) for a in n.args)
             return f"{n.name}({args}) OVER ({' '.join(over)})"
         if n.name == "count" and not n.args:

@@ -36,7 +36,7 @@ EMPTY_AT_TINY = {"q4", "q24", "q41", "q44", "q54", "q76"}
 
 #: compile-heavy shapes (many-subquery / many-CTE-instance plans) kept
 #: out of the default CI run; the slow tier still exercises them
-HEAVY = {"q4", "q9", "q11", "q67", "q72", "q74", "q88"}
+HEAVY = {"q4", "q9", "q11", "q14", "q23", "q49", "q66", "q67", "q72", "q74", "q88"}
 
 
 @pytest.mark.parametrize(
