@@ -251,7 +251,21 @@ def _gen_core(rng) -> str:
     if preds:
         sql += " where " + " and ".join(preds)
     if group_cols:
-        sql += " group by " + ", ".join(group_cols)
+        # round-5 surface: a slice of grouped shapes go through the
+        # grouping-sets desugar (ROLLUP/CUBE + grouping())
+        r = rng.random()
+        if r < 0.12 and len(group_cols) >= 1:
+            sql = sql.replace(
+                f"select {', '.join(items)}",
+                "select "
+                + ", ".join(items)
+                + f", grouping({group_cols[0]}) as g0",
+                1,
+            )
+            kind = "rollup" if r < 0.08 else "cube"
+            sql += f" group by {kind} ({', '.join(group_cols)})"
+        else:
+            sql += " group by " + ", ".join(group_cols)
         if rng.random() < 0.3:
             hav = _pick(rng, ["count(*) > 1", "count(*) >= 2",
                               "min(" + _pick(rng, _KEYS[tables[0]]) + ") > 5"])
@@ -394,6 +408,32 @@ def _gen_setop(rng) -> str:
     return sql
 
 
+def _gen_mark_join(rng) -> str:
+    """OR-embedded membership predicates (round-5 mark joins)."""
+    kind = rng.random()
+    if kind < 0.5:
+        sub = (
+            "select o_custkey from tpch.tiny.orders "
+            f"where o_totalprice > {rng.randrange(50, 250) * 1000}"
+        )
+        pred = (
+            f"c_nationkey = {rng.randrange(0, 25)} "
+            f"or c_custkey in ({sub})"
+        )
+    else:
+        neg = "not " if rng.random() < 0.4 else ""
+        pred = (
+            f"c_nationkey = {rng.randrange(0, 25)} or {neg}exists "
+            "(select 1 from tpch.tiny.orders where "
+            "o_custkey = c_custkey and o_totalprice > "
+            f"{rng.randrange(50, 250) * 1000})"
+        )
+    return (
+        "select count(*) as c, min(c_acctbal) as m "
+        f"from tpch.tiny.customer where {pred}"
+    )
+
+
 def _gen_string_funcs(rng) -> str:
     """Registry string functions projected + grouped (LUT design)."""
     _, str_funcs = _registry_funcs()
@@ -426,8 +466,10 @@ def generate_query(seed: int) -> str:
         return _gen_distinct(rng)
     if shape < 0.34:
         return _gen_subquery(rng)
-    if shape < 0.42:
+    if shape < 0.40:
         return _gen_string_funcs(rng)
+    if shape < 0.42:
+        return _gen_mark_join(rng)
     if shape < 0.5:
         return _gen_setop(rng)
     if shape < 0.57:
