@@ -14,10 +14,16 @@ format- and layout-agnostic), payloads are device-ready columns, and
 partition-key columns materialize as constant dictionary/numeric
 columns per file (zero bytes read for them).
 
-Partition-key typing: a key whose every observed value parses as an
-integer is BIGINT; everything else is VARCHAR (the reference reads
-declared metastore types; without a metastore this engine infers — a
-documented deviation).
+Partition-key typing: a ``metastore.json`` at the connector root
+declares key types per table (the reference's Hive Metastore as a
+file — SURVEY.md §2.2 "metastore-backed schemas"):
+
+    {"schemas": {"<schema>": {"<table>":
+        {"partition_keys": {"year": "integer", "d": "date"}}}}}
+
+Without a declaration the engine INFERS: a key whose every observed
+value parses as an integer is BIGINT, everything else VARCHAR (a
+documented fallback, matching the pre-metastore behavior).
 
 No predicate pushdown into partition enumeration yet: partition columns
 filter like ordinary columns (correct; enumeration-time pruning is a
@@ -144,6 +150,30 @@ class HiveConnector(Connector):
         self._metadata = _HiveMetadata(self)
         self._layouts: Dict[TableHandle, tuple] = {}
         self._schemas: Dict[TableHandle, Dict[str, T.DataType]] = {}
+        self._metastore = self._load_metastore()
+
+    def _load_metastore(self) -> dict:
+        """Parse ``metastore.json`` at the root (absent = empty)."""
+        import json
+
+        path = os.path.join(self.root, "metastore.json")
+        if not os.path.isfile(path):
+            return {}
+        with open(path) as f:
+            doc = json.load(f)
+        return doc.get("schemas", {})
+
+    def _declared_keys(
+        self, handle: TableHandle
+    ) -> Optional[Dict[str, T.DataType]]:
+        """Declared partition-key types for a table, or None."""
+        tbl = self._metastore.get(handle.schema, {}).get(handle.table)
+        if not tbl:
+            return None
+        keys = tbl.get("partition_keys")
+        if not keys:
+            return None
+        return {k: T.parse_type(v) for k, v in keys.items()}
 
     def metadata(self):
         return self._metadata
@@ -184,14 +214,27 @@ class HiveConnector(Connector):
             n = self._file(f).metadata.num_rows
             f.row_start, f.row_end = lo, lo + n
             lo += n
-        part_types = {
-            k: (
-                T.BIGINT
-                if all(_is_int(v) for v in vs)
-                else T.VARCHAR
-            )
-            for k, vs in key_values.items()
-        }
+        declared = self._declared_keys(handle)
+        if declared is not None:
+            # metastore wins: strict agreement between declaration and
+            # the on-disk layout (like the reference failing a table
+            # whose partitions don't match the metastore)
+            if set(declared) != set(key_values) and key_values:
+                raise ValueError(
+                    f"metastore declares partition keys "
+                    f"{sorted(declared)} but the layout under {base} "
+                    f"has {sorted(key_values)}"
+                )
+            part_types = dict(declared)
+        else:
+            part_types = {
+                k: (
+                    T.BIGINT
+                    if all(_is_int(v) for v in vs)
+                    else T.VARCHAR
+                )
+                for k, vs in key_values.items()
+            }
         # mixed-depth layouts (a file missing a key seen elsewhere)
         # fail HERE with a layout error, not mid-scan with a KeyError
         for f in files:
@@ -324,7 +367,12 @@ def _key_matches(raw: str, t: T.DataType, allowed: set) -> bool:
             except (TypeError, ValueError):
                 return True  # can't interpret: don't prune on it
         return out
-    return str(raw) in {str(v) for v in allowed}
+    if t.is_string:
+        return str(raw) in {str(v) for v in allowed}
+    # date/decimal keys: constraint values are engine-internal units
+    # (epoch days, unscaled ints) while path values are text — skip
+    # enumeration-time pruning (over-retain; the filter still applies)
+    return True
 
 
 def _const_column(value: str, t: T.DataType, n: int):
@@ -333,6 +381,21 @@ def _const_column(value: str, t: T.DataType, n: int):
             ids=np.zeros(n, np.int32),
             values=np.asarray([value], dtype=object),
         )
+    if t.name == "date":
+        import datetime
+
+        days = (
+            datetime.date.fromisoformat(value)
+            - datetime.date(1970, 1, 1)
+        ).days
+        return np.full(n, days, dtype=np.int64)
+    if t.is_decimal:
+        from decimal import Decimal
+
+        unscaled = int(
+            (Decimal(value) * (10 ** t.scale)).to_integral_value()
+        )
+        return np.full(n, unscaled, dtype=np.int64)
     return np.full(n, int(value), dtype=np.int64)
 
 
