@@ -440,6 +440,47 @@ def dict_transform_fn(fn_key: str):
 
         pre, mid, suf = _json.loads(fn_key.partition(":")[2])
         return lambda a, b: pre + a + mid + b + suf
+    if fn_key == "initcap":
+        return lambda s: " ".join(
+            w[:1].upper() + w[1:].lower() for w in s.split(" ")
+        )
+    if fn_key == "md5":
+        import hashlib
+
+        return lambda s: hashlib.md5(s.encode()).hexdigest()
+    if fn_key == "sha256":
+        import hashlib
+
+        return lambda s: hashlib.sha256(s.encode()).hexdigest()
+    if fn_key == "crc32":
+        import zlib
+
+        return lambda s: zlib.crc32(s.encode())
+    if fn_key == "codepoint":
+        return lambda s: ord(s[0]) if s else 0
+    if fn_key.startswith("repeat:"):
+        (n_,) = json.loads(fn_key.partition(":")[2])
+        return lambda s: s * n_
+    if fn_key.startswith("translate:"):
+        src, dst = json.loads(fn_key.partition(":")[2])
+        table = str.maketrans(src, dst)
+        return lambda s: s.translate(table)
+    if fn_key.startswith("levenshtein:"):
+        (other,) = json.loads(fn_key.partition(":")[2])
+
+        def _lev(s, _o=other):
+            prev = list(range(len(_o) + 1))
+            for i, ca in enumerate(s, 1):
+                cur = [i]
+                for j, cb in enumerate(_o, 1):
+                    cur.append(min(
+                        prev[j] + 1, cur[-1] + 1,
+                        prev[j - 1] + (ca != cb),
+                    ))
+                prev = cur
+            return prev[-1]
+
+        return _lev
     if fn_key == "lower":
         return str.lower
     if fn_key == "upper":

@@ -489,6 +489,74 @@ def _concat(args):
     return _transform(col, f"concat:{json.dumps([prefix, suffix])}")
 
 
+@_register("initcap", 1, description="initcap(s)")
+def _initcap(args):
+    return _transform(_string_arg(args[0], "initcap"), "initcap")
+
+
+@_register("md5", 1, description="md5(s) -> hex digest")
+def _md5(args):
+    return _transform(_string_arg(args[0], "md5"), "md5")
+
+
+@_register("sha256", 1, description="sha256(s) -> hex digest")
+def _sha256(args):
+    return _transform(_string_arg(args[0], "sha256"), "sha256")
+
+
+@_register("crc32", 1, description="crc32(s) -> bigint")
+def _crc32(args):
+    return _int_func(_string_arg(args[0], "crc32"), "crc32")
+
+
+@_register("codepoint", 1, description="codepoint(s) -> first char")
+def _codepoint(args):
+    return _int_func(_string_arg(args[0], "codepoint"), "codepoint")
+
+
+@_register("repeat", 2, description="repeat(s, n)")
+def _repeat(args):
+    if not isinstance(args[1], E.Literal) or args[1].value is None:
+        raise FunctionError("repeat count must be a constant")
+    n = int(args[1].value)
+    if n < 0 or n > 100:
+        raise FunctionError("repeat count out of range [0, 100]")
+    return _transform(
+        _string_arg(args[0], "repeat"), f"repeat:{json.dumps([n])}"
+    )
+
+
+@_register("translate", 3, description="translate(s, from, to)")
+def _translate(args):
+    src = _lit_str(args[1], "translate from")
+    dst = _lit_str(args[2], "translate to")
+    if len(src) != len(dst):
+        raise FunctionError(
+            "translate from/to must have equal length"
+        )
+    return _transform(
+        _string_arg(args[0], "translate"),
+        f"translate:{json.dumps([src, dst])}",
+    )
+
+
+@_register(
+    "levenshtein_distance", 2,
+    description="levenshtein_distance(s, literal)",
+)
+def _levenshtein(args):
+    other = _lit_str(args[1], "levenshtein_distance reference")
+    return _int_func(
+        _string_arg(args[0], "levenshtein_distance"),
+        f"levenshtein:{json.dumps([other])}",
+    )
+
+
+@_register("char_length", 1, description="char_length(s)")
+def _char_length(args):
+    return _int_func(_string_arg(args[0], "char_length"), "length")
+
+
 @_register("strpos", 2, description="strpos(s, sub) -> 1-based, 0=absent")
 def _strpos(args):
     s = _string_arg(args[0], "strpos")

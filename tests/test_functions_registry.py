@@ -365,3 +365,32 @@ def test_registry_is_the_resolver(runner):
         assert math.isclose(got, float((x**2).sum()), rel_tol=1e-12)
     finally:
         del F.AGGREGATE[name]
+
+def test_round5_string_builtins():
+    """New registry scalars (initcap/md5/sha256/crc32/codepoint/
+    repeat/translate/levenshtein_distance/char_length) — pinned
+    against Python's reference implementations."""
+    import hashlib
+    import zlib
+
+    from presto_tpu.exec.local_runner import LocalQueryRunner
+
+    r = LocalQueryRunner()
+    rows = r.execute(
+        "select initcap(n_name) as a, md5(n_name) as b, "
+        "crc32(n_name) as c, codepoint(n_name) as d, "
+        "repeat(n_name, 2) as e, translate(n_name, 'AE', 'ae') as f, "
+        "levenshtein_distance(n_name, 'ALGERIA') as g, "
+        "char_length(n_name) as h, sha256(n_name) as i "
+        "from tpch.tiny.nation order by n_nationkey limit 2"
+    ).rows()
+    a = rows[0]
+    assert a[0] == "Algeria"
+    assert a[1] == hashlib.md5(b"ALGERIA").hexdigest()
+    assert a[2] == zlib.crc32(b"ALGERIA")
+    assert a[3] == ord("A")
+    assert a[4] == "ALGERIAALGERIA"
+    assert a[5] == "aLGeRIa"
+    assert a[6] == 0 and rows[1][6] == 4
+    assert a[7] == 7
+    assert a[8] == hashlib.sha256(b"ALGERIA").hexdigest()
