@@ -2189,81 +2189,90 @@ class _Planner:
         ]
         plain_aggs = [a for a in agg_calls if a not in distinct_aggs]
         if distinct_aggs:
-            if len(distinct_aggs) != 1 or distinct_aggs[0].name not in (
-                "count",
-                "approx_distinct",
-            ):
+            for a in distinct_aggs:
+                if a.name not in ("count", "approx_distinct"):
+                    raise PlanningError(
+                        f"{a.name}(DISTINCT x) is not supported "
+                        "(count/approx_distinct only)"
+                    )
+            needs_stitch = bool(plain_aggs) or len(distinct_aggs) > 1
+            if needs_stitch and len(group_keys) > 2:
                 raise PlanningError(
-                    "only a single count(DISTINCT x) aggregate is "
-                    "supported (reference: MarkDistinct breadth later)"
+                    "multiple/mixed DISTINCT aggregates support at "
+                    "most 2 group keys (stitch-join key width)"
                 )
-            if plain_aggs and len(group_keys) > 2:
-                raise PlanningError(
-                    "count(DISTINCT x) mixed with plain aggregates "
-                    "supports at most 2 group keys (join-key width)"
-                )
-            if plain_aggs and len(group_keys) == 2 and any(
+            if needs_stitch and len(group_keys) == 2 and any(
                 e.dtype.np_dtype.itemsize > 4 for _, e in group_keys
             ):
                 # the stitch join packs both keys into one int64
                 # (ops.join.pack_keys) — fail at plan time, not runtime
                 raise PlanningError(
-                    "count(DISTINCT x) mixed with plain aggregates "
-                    "requires 32-bit group keys when there are two"
+                    "multiple/mixed DISTINCT aggregates require "
+                    "32-bit group keys when there are two"
                 )
-            a = distinct_aggs[0]
-            arg = self._lower(a.args[0], scope)
-            dcol = self._fresh("dist")
-            pre = N.AggregationNode(
-                source=node,
-                group_keys=tuple(group_keys) + ((dcol, arg),),
-                aggs=(),
-                max_groups=self._agg_bucket(node),
-            )
-            out_name = self._fresh("agg")
-            post = N.AggregationNode(
-                source=pre,
-                group_keys=tuple(
-                    (n, E.ColumnRef(n, e.dtype)) for n, e in group_keys
-                ),
-                aggs=(
-                    AggCall("count", E.ColumnRef(dcol, arg.dtype), out_name),
-                ),
-                max_groups=self._agg_bucket(node),
-            )
-            agg_map[a] = out_name
-            if not plain_aggs:
-                out_scope = self._post_agg_scope(post, scope)
-                result: N.PlanNode = post
-                if sel.having is not None:
-                    pred = self._lower(
-                        sel.having, out_scope, agg_map=agg_map
-                    )
-                    result = N.FilterNode(result, pred)
-                return result, out_scope, agg_map
-            # mixed distinct + plain (reference: MarkDistinct feeding one
-            # HashAggregation): plain aggregates run beside the two-level
-            # distinct tree, stitched per group — a unique-build join on
-            # the group keys, or a single-row broadcast when global
-            plain_node, agg_map2 = self._plain_agg_node(
-                node, group_keys, plain_aggs, scope
-            )
-            agg_map.update(agg_map2)
-            if group_keys:
-                stitched: N.PlanNode = N.JoinNode(
-                    left=plain_node,
-                    right=post,
-                    join_type="inner",
-                    left_keys=tuple(n for n, _ in group_keys),
-                    right_keys=tuple(n for n, _ in group_keys),
-                    payload=(out_name,),
-                    build_unique=True,
+            # each DISTINCT agg gets its own two-level tree over the
+            # SAME source (reference: MarkDistinct feeding one
+            # HashAggregation); multiple trees stitch per group via
+            # unique-build joins (identical group sets by
+            # construction), or single-row broadcasts when global
+            parts: List[Tuple[N.PlanNode, str]] = []
+            for a in distinct_aggs:
+                arg = self._lower(a.args[0], scope)
+                dcol = self._fresh("dist")
+                pre = N.AggregationNode(
+                    source=node,
+                    group_keys=tuple(group_keys) + ((dcol, arg),),
+                    aggs=(),
+                    max_groups=self._agg_bucket(node),
                 )
+                out_name = self._fresh("agg")
+                post = N.AggregationNode(
+                    source=pre,
+                    group_keys=tuple(
+                        (n, E.ColumnRef(n, e.dtype))
+                        for n, e in group_keys
+                    ),
+                    aggs=(
+                        AggCall(
+                            "count",
+                            E.ColumnRef(dcol, arg.dtype),
+                            out_name,
+                        ),
+                    ),
+                    max_groups=self._agg_bucket(node),
+                )
+                agg_map[a] = out_name
+                parts.append((post, out_name))
+            if plain_aggs:
+                plain_node, agg_map2 = self._plain_agg_node(
+                    node, group_keys, plain_aggs, scope
+                )
+                agg_map.update(agg_map2)
+                stitched: N.PlanNode = plain_node
+                rest = parts
             else:
-                stitched = N.CrossJoinNode(left=plain_node, right=post)
+                stitched = parts[0][0]
+                rest = parts[1:]
+            for post, out_name in rest:
+                if group_keys:
+                    stitched = N.JoinNode(
+                        left=stitched,
+                        right=post,
+                        join_type="inner",
+                        left_keys=tuple(n for n, _ in group_keys),
+                        right_keys=tuple(n for n, _ in group_keys),
+                        payload=(out_name,),
+                        build_unique=True,
+                    )
+                else:
+                    stitched = N.CrossJoinNode(
+                        left=stitched, right=post
+                    )
             out_scope = self._post_agg_scope(stitched, scope)
             if sel.having is not None:
-                pred = self._lower(sel.having, out_scope, agg_map=agg_map)
+                pred = self._lower(
+                    sel.having, out_scope, agg_map=agg_map
+                )
                 stitched = N.FilterNode(stitched, pred)
             return stitched, out_scope, agg_map
 

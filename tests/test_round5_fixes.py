@@ -170,3 +170,23 @@ def test_in_list_column_expr(runner, oracle):
         "where l_quantity in (1, l_linenumber + 10)"
     )
     assert verify_query(runner, oracle, q) is None
+
+
+def test_multiple_count_distinct(runner, oracle):
+    """N DISTINCT aggregates per group (reference: MarkDistinct) —
+    each gets its own two-level tree, stitched per group."""
+    q = (
+        "select l_returnflag, count(distinct l_suppkey) as a, "
+        "count(distinct l_partkey) as b, sum(l_quantity) as s "
+        "from tpch.tiny.lineitem group by l_returnflag order by 1"
+    )
+    assert verify_query(runner, oracle, q, rel_tol=1e-6) is None
+
+
+def test_multiple_count_distinct_global(runner, oracle):
+    q = (
+        "select count(distinct l_suppkey) as a, "
+        "count(distinct l_partkey) as b, avg(l_quantity) as c "
+        "from tpch.tiny.lineitem"
+    )
+    assert verify_query(runner, oracle, q, rel_tol=1e-6) is None
