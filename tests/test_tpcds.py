@@ -32,7 +32,7 @@ def test_tpcds_breadth(name, runner, oracle):
 #: 180 items; q44/q76's NULL-key filters over NULL-free generator
 #: columns; q4's triple-channel growth conjunction) — they stay
 #: oracle-exact, and SF1 provides the non-vacuous coverage
-EMPTY_AT_TINY = {"q4", "q24", "q41", "q44", "q54", "q58", "q76"}
+EMPTY_AT_TINY = {"q4", "q24", "q41", "q44", "q54", "q58", "q76", "q91"}
 
 #: compile-heavy shapes (many-subquery / many-CTE-instance plans) kept
 #: out of the default CI run; the slow tier still exercises them
