@@ -424,6 +424,28 @@ class DictCombine(Expr):
         return T.VARCHAR
 
 
+@dataclasses.dataclass(frozen=True)
+class IntToDict(Expr):
+    """String-valued function of a BOUNDED integer column (dates as
+    epoch days -> formatted strings): the dictionary is a host-side
+    LUT over [lo, hi] (the date domain is a few tens of thousands of
+    values), the device gathers ``lut[clip(x - lo)]``. ``fn`` maps
+    int -> str, rebuilt from ``fn_key``."""
+
+    arg: Expr  # integer/date-typed
+    fn_key: str
+    lo: int
+    hi: int
+    fn: object = dataclasses.field(hash=False, compare=False)
+
+    def children(self):
+        return (self.arg,)
+
+    @property
+    def dtype(self):
+        return T.VARCHAR
+
+
 def dict_transform_fn(fn_key: str):
     """Rebuild a dictionary-function host callable from its key.
 
@@ -435,6 +457,18 @@ def dict_transform_fn(fn_key: str):
     JSON-encoded after the first colon (colon-safe)."""
     import json
 
+    if fn_key.startswith("date_format:"):
+        import datetime
+
+        (fmt,) = json.loads(fn_key.partition(":")[2])
+
+        def _df(days, _f=fmt):
+            d = datetime.date(1970, 1, 1) + datetime.timedelta(
+                days=int(days)
+            )
+            return d.strftime(_f)
+
+        return _df
     if fn_key.startswith("concat2:"):
         import json as _json
 
@@ -851,6 +885,8 @@ class ExprLowerer:
             return self._coalesce_dict(expr)[0]
         if isinstance(expr, Case) and expr.dtype.is_string:
             return self._case_dicts(expr)[0][0]
+        if isinstance(expr, IntToDict):
+            return self._int_to_dict(expr)[0]
         if isinstance(expr, Literal):
             from presto_tpu.page import Dictionary
 
@@ -1928,6 +1964,34 @@ class ExprLowerer:
         if v is not None:
             h = jnp.where(v, h, jnp.int64(0x9E3779B9))
         return h, None
+
+    def _int_to_dict(self, e: "IntToDict"):
+        """(Dictionary, value LUT over [lo, hi]), cached per key."""
+        key = (e.fn_key, e.lo, e.hi)
+        if key not in self._transform_cache:
+            from presto_tpu.page import Dictionary
+
+            vals = np.asarray(
+                [str(e.fn(i)) for i in range(e.lo, e.hi + 1)],
+                dtype=object,
+            )
+            uniq = np.unique(vals.astype(str))
+            lut = np.searchsorted(uniq, vals.astype(str)).astype(
+                np.int32
+            )
+            self._transform_cache[key] = (
+                Dictionary(np.asarray(uniq, dtype=object)),
+                lut,
+            )
+        return self._transform_cache[key]
+
+    def _eval_inttodict(self, e: "IntToDict"):
+        d, v = self.eval(e.arg)
+        _, lut = self._int_to_dict(e)
+        idx = jnp.clip(
+            d.astype(jnp.int64) - e.lo, 0, e.hi - e.lo
+        )
+        return jnp.asarray(lut)[idx], v
 
     def _eval_dictintfunc(self, e: DictIntFunc):
         data, valid = self.eval(e.arg)

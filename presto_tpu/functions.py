@@ -489,6 +489,90 @@ def _concat(args):
     return _transform(col, f"concat:{json.dumps([prefix, suffix])}")
 
 
+#: MySQL date_format directives -> strftime (the supported subset;
+#: unknown directives fail at plan time, not silently)
+_MYSQL_FMT = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%-m", "%d": "%d",
+    "%e": "%-d", "%j": "%j", "%W": "%A", "%a": "%a", "%M": "%B",
+    "%b": "%b", "%u": "%W", "%%": "%%",
+}
+
+#: JodaTime format_datetime tokens -> strftime (longest-match subset)
+_JODA_FMT = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MMMM", "%B"), ("MMM", "%b"),
+    ("MM", "%m"), ("M", "%-m"), ("dd", "%d"), ("d", "%-d"),
+    ("EEEE", "%A"), ("EEE", "%a"), ("DDD", "%j"),
+]
+
+#: date-domain LUT bounds: 1900-01-01 .. 2071-06-06 (epoch days)
+_DATE_LO, _DATE_HI = -25567, 37040
+
+
+def _date_arg(e: E.Expr, fname: str) -> E.Expr:
+    if e.dtype.name != "date":
+        raise FunctionError(f"{fname}() requires a DATE argument")
+    return e
+
+
+@_register(
+    "date_format", 2, description="date_format(d, '%Y-%m-%d') (MySQL "
+    "directives, date args)",
+)
+def _date_format(args):
+    arg = _date_arg(args[0], "date_format")
+    fmt = _lit_str(args[1], "date_format pattern")
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%":
+            tok = fmt[i:i + 2]
+            if tok not in _MYSQL_FMT:
+                raise FunctionError(
+                    f"date_format directive {tok!r} is not supported"
+                )
+            out.append(_MYSQL_FMT[tok])
+            i += 2
+        else:
+            ch = fmt[i]
+            out.append("%%" if ch == "%" else ch)
+            i += 1
+    key = f"date_format:{json.dumps([''.join(out)])}"
+    return E.IntToDict(
+        arg, key, _DATE_LO, _DATE_HI, E.dict_transform_fn(key)
+    )
+
+
+@_register(
+    "format_datetime", 2,
+    description="format_datetime(d, 'yyyy-MM-dd') (Joda tokens, "
+    "date args)",
+)
+def _format_datetime(args):
+    arg = _date_arg(args[0], "format_datetime")
+    fmt = _lit_str(args[1], "format_datetime pattern")
+    out = []
+    i = 0
+    while i < len(fmt):
+        for tok, st in _JODA_FMT:
+            if fmt.startswith(tok, i):
+                out.append(st)
+                i += len(tok)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                raise FunctionError(
+                    f"format_datetime token {ch!r} at {i} is not "
+                    "supported"
+                )
+            out.append("%%" if ch == "%" else ch)
+            i += 1
+    key = f"date_format:{json.dumps([''.join(out)])}"
+    return E.IntToDict(
+        arg, key, _DATE_LO, _DATE_HI, E.dict_transform_fn(key)
+    )
+
+
 @_register("initcap", 1, description="initcap(s)")
 def _initcap(args):
     return _transform(_string_arg(args[0], "initcap"), "initcap")

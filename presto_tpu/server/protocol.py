@@ -75,7 +75,7 @@ def encode(obj: Any) -> Any:
         for f in dataclasses.fields(obj):
             if f.name == "fn" and isinstance(
                 obj, (E.DictTransform, E.DictPredicate, E.DictIntFunc,
-                      E.DictCombine)
+                      E.DictCombine, E.IntToDict)
             ):
                 # host callables don't cross the wire: fn_key is the
                 # canonical identity, rebuilt at decode time
@@ -109,7 +109,7 @@ def decode(data: Any) -> Any:
             kwargs[f.name] = _coerce(decode(data[f.name]), f.type, cls)
     if (
         cls in (E.DictTransform, E.DictPredicate, E.DictIntFunc,
-            E.DictCombine)
+            E.DictCombine, E.IntToDict)
         and "fn" not in kwargs
     ):
         kwargs["fn"] = E.dict_transform_fn(kwargs["fn_key"])

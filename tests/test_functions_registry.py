@@ -394,3 +394,27 @@ def test_round5_string_builtins():
     assert a[6] == 0 and rows[1][6] == 4
     assert a[7] == 7
     assert a[8] == hashlib.sha256(b"ALGERIA").hexdigest()
+
+
+def test_date_format_family():
+    """date_format (MySQL directives) and format_datetime (Joda
+    tokens) over DATE columns via the bounded int->dictionary LUT."""
+    from presto_tpu.exec.local_runner import LocalQueryRunner
+
+    r = LocalQueryRunner()
+    rows = r.execute(
+        "select date_format(o_orderdate, '%Y-%m-%d') as a, "
+        "format_datetime(o_orderdate, 'yyyy/MM/dd EEE') as b, "
+        "o_orderdate as d from tpch.tiny.orders "
+        "order by o_orderkey limit 2"
+    ).rows()
+    for a, b, d in rows:
+        assert a == d.isoformat()
+        assert b == d.strftime("%Y/%m/%d %a")
+    # formatted strings as group keys
+    g = r.execute(
+        "select date_format(o_orderdate, '%Y') as y, count(*) as c "
+        "from tpch.tiny.orders group by 1 order by 1"
+    ).rows()
+    assert [int(y) for y, _ in g] == sorted(int(y) for y, _ in g)
+    assert sum(c for _, c in g) == 15000
