@@ -214,6 +214,13 @@ def main() -> None:
         ("tpcds_q3_sf1_rows_per_sec",
          queries_tpcds.official_for("sf1")["q3"], None,
          ("tpcds", "sf1", "store_sales"), None, None, 2),
+        # the join-order stress query (bushy rescue: composite
+        # (item, week) plan) at SF1 — 23.5M inventory x 14.4M
+        # catalog_sales
+        ("tpcds_q72_sf1_rows_per_sec",
+         queries_tpcds.official_for("sf1")["q72"], None,
+         ("tpcds", "sf1", "catalog_sales"),
+         None, {"max_device_rows": str(1 << 27)}, 2),
     ]
     failed = 0
     for metric, sql, schema, driving, expect, props, iters in extra:

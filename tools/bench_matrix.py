@@ -34,6 +34,7 @@ CONFIGS = [
     "window",
     "tpcds_q95",
     "tpcds_q64",
+    "tpcds_q72_sf1",
     "q3_sf10",
     "q5_sf10",
     "q18_sf10",
