@@ -78,6 +78,20 @@ class MemoryConnector(Connector):
             )
         self._store.tables[key] = (schema, merged)
 
+    def replace_rows(
+        self, handle: TableHandle, data: Dict[str, np.ndarray]
+    ):
+        """Overwrite the table's contents (the DELETE path keeps the
+        complement and replaces wholesale)."""
+        key = (handle.schema, handle.table)
+        schema, _ = self._store.tables[key]
+        from presto_tpu.exec.staging import obj_array
+
+        self._store.tables[key] = (
+            schema,
+            {c: obj_array(data[c]) for c in schema},
+        )
+
     def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20, constraint=()):
         schema, data = self._store.tables[(handle.schema, handle.table)]
         n = len(next(iter(data.values()))) if data else 0

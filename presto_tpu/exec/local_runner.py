@@ -110,6 +110,7 @@ class LocalQueryRunner:
 
             catalogs.register("system", SystemConnector(runner=self))
         self._compiled: Dict[object, object] = {}
+        self._prepared: Dict[str, object] = {}
         self._table_cache: Dict[Tuple, Page] = {}
         #: staged split-batch pages, keyed down to (lo, hi, capacity) —
         #: the table cache at split granularity, gated by the
@@ -187,6 +188,24 @@ class LocalQueryRunner:
             )
         if isinstance(stmt, (ast.Insert, ast.CreateTableAs)):
             return self._execute_write(stmt)
+        if isinstance(stmt, ast.ShowColumns):
+            return self._execute_show_columns(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._execute_delete(stmt)
+        if isinstance(stmt, ast.Prepare):
+            self._prepared[stmt.name] = stmt.statement
+            return QueryResult(("result",), _message_page("PREPARE"))
+        if isinstance(stmt, ast.Deallocate):
+            if stmt.name not in self._prepared:
+                raise ExecutionError(
+                    f"prepared statement {stmt.name!r} not found"
+                )
+            del self._prepared[stmt.name]
+            return QueryResult(
+                ("result",), _message_page("DEALLOCATE")
+            )
+        if isinstance(stmt, ast.Execute):
+            return self._execute_prepared(stmt)
         if isinstance(stmt, ast.ShowSchemas):
             conn = self.catalogs.get(stmt.catalog or self.session.catalog)
             return QueryResult(
@@ -231,6 +250,133 @@ class LocalQueryRunner:
         REGISTRY.counter("queries.finished").update()
         REGISTRY.distribution("query.output_rows").add(qs.output_rows)
         return result
+
+    def _execute_show_columns(self, stmt) -> QueryResult:
+        """SHOW COLUMNS FROM t / DESCRIBE t (reference: ShowColumns
+        rewritten onto the metadata catalog)."""
+        from presto_tpu.connectors.spi import TableHandle
+
+        parts = stmt.target
+        catalog, schema_name = self.session.catalog, self.session.schema
+        if len(parts) == 3:
+            catalog, schema_name, table = parts
+        elif len(parts) == 2:
+            schema_name, table = parts
+        else:
+            (table,) = parts
+        conn = self.catalogs.get(catalog)
+        tschema = conn.metadata().get_table_schema(
+            TableHandle(catalog, schema_name, table)
+        )
+        page = Page.from_pydict(
+            {
+                "Column": list(tschema),
+                "Type": [str(t) for t in tschema.values()],
+            },
+            {"Column": T.VARCHAR, "Type": T.VARCHAR},
+        )
+        return QueryResult(("Column", "Type"), page)
+
+    def _invalidate_table_caches(self, handle) -> None:
+        """Drop cached pages (whole-table AND split granularity) of a
+        written/deleted table, releasing their reservations."""
+        for cache in (self._table_cache, self._split_cache):
+            for k in [k for k in cache if k[0] == handle]:
+                stale = cache.pop(k)
+                if self.memory_pool is not None:
+                    self.memory_pool.release(
+                        "table-cache", _page_nbytes(stale)
+                    )
+
+    def _execute_delete(self, stmt) -> QueryResult:
+        """DELETE FROM t [WHERE pred]: keep the complement (rows where
+        the predicate is FALSE or NULL — SQL deletes only TRUE rows)
+        through the normal query path, then replace the table's
+        contents (reference: Delete via connector rowid strategies;
+        the memory connector replaces wholesale)."""
+        from presto_tpu.connectors.spi import TableHandle
+
+        parts = stmt.target
+        catalog, schema_name = self.session.catalog, self.session.schema
+        if len(parts) == 3:
+            catalog, schema_name, table = parts
+        elif len(parts) == 2:
+            schema_name, table = parts
+        else:
+            (table,) = parts
+        handle = TableHandle(catalog, schema_name, table)
+        conn = self.catalogs.get(catalog)
+        if not hasattr(conn, "replace_rows"):
+            raise ExecutionError(
+                f"catalog {catalog} does not support DELETE"
+            )
+        tschema = conn.metadata().get_table_schema(handle)
+        # row count without a table scan: splits carry the global row
+        # space (review: the SQL-text count(*) round trip staged the
+        # whole table a second time)
+        before = 0
+        src = conn.get_splits(handle)
+        while not src.exhausted:
+            for sp in src.next_batch(256):
+                before += sp.num_rows
+        if stmt.where is None:
+            keep_sel = None
+        else:
+            # build the keep-select AST directly — a text round trip
+            # breaks on keyword-named or mixed-case identifiers
+            keep_where = ast.BinaryOp(
+                "or",
+                ast.UnaryOp("not", stmt.where),
+                ast.IsNullExpr(stmt.where),
+            )
+            keep_sel = ast.Select(
+                items=tuple(
+                    ast.SelectItem(ast.Ident((c,)), None)
+                    for c in tschema
+                ),
+                from_=ast.TableRef((catalog, schema_name, table)),
+                where=keep_where,
+            )
+        if keep_sel is None:
+            kept = {c: [] for c in tschema}
+            n_kept = 0
+        else:
+            res = self.execute_plan(
+                plan_statement(keep_sel, self.catalogs, self.session)
+            )
+            payload = _result_columns(res)
+            kept = {c: payload[c] for c in tschema}
+            n_kept = int(res.page.num_valid)
+        conn.replace_rows(handle, kept)
+        self._invalidate_table_caches(handle)
+        page = Page.from_pydict(
+            {"rows": [before - n_kept]}, {"rows": T.BIGINT}
+        )
+        return QueryResult(("rows",), page)
+
+    def _execute_prepared(self, stmt) -> QueryResult:
+        """EXECUTE name [USING v, ...]: substitute ? markers in the
+        prepared AST with the literal arguments, then run the
+        statement through the normal path (reference: prepared
+        statements carried per-session)."""
+        inner = self._prepared.get(stmt.name)
+        if inner is None:
+            raise ExecutionError(
+                f"prepared statement {stmt.name!r} not found"
+            )
+        n_markers = _count_param_markers(inner)
+        if n_markers != len(stmt.params):
+            raise ExecutionError(
+                f"EXECUTE {stmt.name}: statement has {n_markers} "
+                f"parameter(s), {len(stmt.params)} given"
+            )
+        bound = _bind_param_markers(inner, stmt.params)
+        if isinstance(bound, (ast.Insert, ast.CreateTableAs)):
+            return self._execute_write(bound)
+        if isinstance(bound, ast.Delete):
+            return self._execute_delete(bound)
+        plan = plan_statement(bound, self.catalogs, self.session)
+        return self.execute_plan(plan)
 
     def _execute_write(self, stmt) -> QueryResult:
         """Table writer (reference: TableWriterOperator + the SPI's
@@ -302,13 +448,7 @@ class LocalQueryRunner:
         # a write invalidates every cached page of the written table —
         # whole-table AND split granularity — else a cacheable writable
         # connector (memory) silently serves stale pages on re-run
-        for cache in (self._table_cache, self._split_cache):
-            for k in [k for k in cache if k[0] == handle]:
-                stale = cache.pop(k)
-                if self.memory_pool is not None:
-                    self.memory_pool.release(
-                        "table-cache", _page_nbytes(stale)
-                    )
+        self._invalidate_table_caches(handle)
         page = Page.from_pydict({"rows": [n]}, {"rows": T.BIGINT})
         return QueryResult(("rows",), page)
 
@@ -879,6 +1019,62 @@ def _block_nbytes(b) -> int:
     for child in b.children or ():
         n += _block_nbytes(child)
     return n
+
+
+def _count_param_markers(node) -> int:
+    n = 0
+    if isinstance(node, ast.ParamMarker):
+        return 1
+    if not isinstance(node, ast.Node):
+        return 0
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, ast.Node):
+            n += _count_param_markers(v)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, ast.Node):
+                    n += _count_param_markers(x)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Node):
+                            n += _count_param_markers(y)
+    return n
+
+
+def _bind_param_markers(node, params):
+    """Replace ? markers (by index) with the EXECUTE arguments."""
+    if isinstance(node, ast.ParamMarker):
+        return params[node.index]
+    if not isinstance(node, ast.Node):
+        return node
+    kwargs = {}
+    changed = False
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, ast.Node):
+            nv = _bind_param_markers(v, params)
+        elif isinstance(v, tuple):
+            nv = tuple(
+                _bind_param_markers(x, params)
+                if isinstance(x, ast.Node)
+                else (
+                    tuple(
+                        _bind_param_markers(y, params)
+                        if isinstance(y, ast.Node)
+                        else y
+                        for y in x
+                    )
+                    if isinstance(x, tuple)
+                    else x
+                )
+                for x in v
+            )
+        else:
+            nv = v
+        kwargs[f.name] = nv
+        changed |= nv is not v
+    return dataclasses.replace(node, **kwargs) if changed else node
 
 
 def _page_nbytes(page: Page) -> int:

@@ -293,6 +293,51 @@ class ShowSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowColumns(Node):
+    """SHOW COLUMNS FROM t / DESCRIBE t (reference: ShowColumns)."""
+
+    target: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM target [WHERE pred] (reference: Delete)."""
+
+    target: Tuple[str, ...]
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    """PREPARE name FROM statement (reference: Prepare)."""
+
+    name: str
+    statement: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Execute(Node):
+    """EXECUTE name [USING expr, ...] (reference: Execute)."""
+
+    name: str
+    params: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    """DEALLOCATE PREPARE name."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamMarker(Node):
+    """A ``?`` placeholder inside a prepared statement."""
+
+    index: int
+
+
+@dataclasses.dataclass(frozen=True)
 class Insert(Node):
     """INSERT INTO target (SELECT ... | VALUES (...), ...). ``values``
     rows hold literal expression nodes."""

@@ -23,10 +23,20 @@ def parse_statement(sql: str) -> ast.Node:
     return _Parser(tokenize(sql)).parse_statement()
 
 
+#: keywords that stay usable as plain identifiers (table/column
+#: position AND expression position)
+SOFT_IDENT_KEYWORDS = frozenset({
+    "date", "year", "month", "day", "values", "tables", "schemas",
+    "first", "last", "columns", "using", "execute", "prepare",
+    "delete", "describe", "deallocate",
+})
+
+
 class _Parser:
     def __init__(self, tokens: List[Token]):
         self.tokens = tokens
         self.pos = 0
+        self._param_idx = 0
 
     # ------------------------------------------------------- token plumbing
 
@@ -76,10 +86,7 @@ class _Parser:
         if t.kind == "ident":
             return self.advance().value
         # soft keywords usable as identifiers in table/column position
-        if t.kind == "kw" and t.value in (
-            "date", "year", "month", "day", "values", "tables", "schemas",
-            "first", "last",
-        ):
+        if t.kind == "kw" and t.value in SOFT_IDENT_KEYWORDS:
             return self.advance().value
         raise ParseError(
             f"expected identifier but found {t.value!r} at position {t.pos}"
@@ -123,7 +130,68 @@ class _Parser:
             if self.accept_kw("session"):
                 self._finish()
                 return ast.ShowSession()
+            if self.accept_kw("columns"):
+                self.expect_kw("from")
+                target = self._qualified_name()
+                self._finish()
+                return ast.ShowColumns(target)
             raise ParseError(f"unsupported SHOW at {self.cur.pos}")
+        if self.accept_kw("describe"):
+            target = self._qualified_name()
+            self._finish()
+            return ast.ShowColumns(target)
+        if self.accept_kw("delete"):
+            self.expect_kw("from")
+            target = self._qualified_name()
+            where = (
+                self.parse_expr() if self.accept_kw("where") else None
+            )
+            self._finish()
+            return ast.Delete(target, where)
+        if self.accept_kw("prepare"):
+            name = self.expect_ident()
+            self.expect_kw("from")
+            if self.peek_kw("insert"):
+                self.advance()
+                self.expect_kw("into")
+                target = self._qualified_name()
+                if self.accept_kw("values"):
+                    rows = [self._values_row()]
+                    while self.accept_op(","):
+                        rows.append(self._values_row())
+                    inner: ast.Node = ast.Insert(
+                        target, values=tuple(rows)
+                    )
+                else:
+                    inner = ast.Insert(target, query=self.parse_select())
+            elif self.peek_kw("delete"):
+                self.advance()
+                self.expect_kw("from")
+                target = self._qualified_name()
+                where = (
+                    self.parse_expr()
+                    if self.accept_kw("where")
+                    else None
+                )
+                inner = ast.Delete(target, where)
+            else:
+                inner = self.parse_select()
+            self._finish()
+            return ast.Prepare(name, inner)
+        if self.accept_kw("execute"):
+            name = self.expect_ident()
+            params: List[ast.Node] = []
+            if self.accept_kw("using"):
+                params.append(self.parse_expr())
+                while self.accept_op(","):
+                    params.append(self.parse_expr())
+            self._finish()
+            return ast.Execute(name, tuple(params))
+        if self.accept_kw("deallocate"):
+            self.expect_kw("prepare")
+            name = self.expect_ident()
+            self._finish()
+            return ast.Deallocate(name)
         if self.accept_kw("insert"):
             self.expect_kw("into")
             target = self._qualified_name()
@@ -648,6 +716,10 @@ class _Parser:
 
     def _primary(self) -> ast.Node:
         t = self.cur
+        if self.accept_op("?"):
+            idx = self._param_idx
+            self._param_idx += 1
+            return ast.ParamMarker(idx)
         if t.kind == "number":
             self.advance()
             return ast.NumberLit(t.value)
@@ -774,9 +846,7 @@ class _Parser:
             return ast.ArrayLit(tuple(items))
         # identifier / function call / qualified name
         if t.kind == "ident" or (
-            t.kind == "kw"
-            and t.value in ("date", "year", "month", "day", "values",
-                            "first", "last")
+            t.kind == "kw" and t.value in SOFT_IDENT_KEYWORDS
         ):
             name = self.expect_ident()
             if self.accept_op("("):

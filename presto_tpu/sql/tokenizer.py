@@ -26,6 +26,8 @@ KEYWORDS = {
     "explain", "analyze", "show", "tables", "schemas", "substring",
     "substr", "for", "any", "some", "escape", "values",
     "insert", "into", "create", "table",
+    "delete", "describe", "columns", "prepare", "execute",
+    "deallocate", "using",
 }
 
 _TOKEN_RE = re.compile(
@@ -34,7 +36,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+\.\d*(e[+-]?\d+)?|\.\d+(e[+-]?\d+)?|\d+(e[+-]?\d+)?)
   | (?P<ident>[a-zA-Z_][a-zA-Z0-9_]*|"[^"]*")
   | (?P<string>'(?:[^']|'')*')
-  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.;<>=\[\]])
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.;<>=\[\]?])
     """,
     re.VERBOSE | re.IGNORECASE | re.DOTALL,
 )

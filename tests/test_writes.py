@@ -88,3 +88,64 @@ def test_insert_invalidates_cached_pages(runner):
     assert runner.execute(
         "select count(*) as c from mem.default.kv"
     ).rows() == [(2,)]
+
+
+def test_show_columns_and_describe(runner):
+    rows = runner.execute("show columns from mem.default.kv").rows()
+    assert rows == [("k", "integer"), ("v", "varchar")]
+    assert runner.execute("describe mem.default.kv").rows() == rows
+
+
+def test_delete_where(runner):
+    runner.execute(
+        "insert into mem.default.kv values (10, 'a'), (11, 'b'), "
+        "(12, null)"
+    )
+    before = runner.execute(
+        "select count(*) as c from mem.default.kv"
+    ).rows()[0][0]
+    # deletes only TRUE rows: the NULL-valued v row stays
+    assert runner.execute(
+        "delete from mem.default.kv where v = 'b' and k >= 10"
+    ).rows() == [(1,)]
+    assert runner.execute(
+        "select count(*) as c from mem.default.kv"
+    ).rows() == [(before - 1,)]
+    # unconditional delete empties the table
+    runner.execute("delete from mem.default.kv")
+    assert runner.execute(
+        "select count(*) as c from mem.default.kv"
+    ).rows() == [(0,)]
+
+
+def test_prepare_execute_deallocate(runner):
+    runner.execute(
+        "insert into mem.default.kv values (1, 'one'), (2, 'two')"
+    )
+    runner.execute(
+        "prepare q from select k, v from mem.default.kv "
+        "where k = ? or v = ?"
+    )
+    assert runner.execute("execute q using 1, 'two'").rows() == [
+        (1, "one"),
+        (2, "two"),
+    ]
+    with pytest.raises(ExecutionError, match="2 parameter"):
+        runner.execute("execute q using 1")
+    runner.execute("deallocate prepare q")
+    with pytest.raises(ExecutionError, match="not found"):
+        runner.execute("execute q using 1, 'x'")
+
+
+def test_prepared_insert_and_delete(runner):
+    runner.execute(
+        "prepare ins2 from insert into mem.default.kv values (?, ?)"
+    )
+    runner.execute("execute ins2 using 77, 'prep'")
+    assert runner.execute(
+        "select v from mem.default.kv where k = 77"
+    ).rows() == [("prep",)]
+    runner.execute(
+        "prepare del2 from delete from mem.default.kv where k = ?"
+    )
+    assert runner.execute("execute del2 using 77").rows() == [(1,)]
