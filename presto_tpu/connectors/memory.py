@@ -78,6 +78,14 @@ class MemoryConnector(Connector):
             )
         self._store.tables[key] = (schema, merged)
 
+    def drop_table(self, handle: TableHandle) -> bool:
+        return (
+            self._store.tables.pop(
+                (handle.schema, handle.table), None
+            )
+            is not None
+        )
+
     def replace_rows(
         self, handle: TableHandle, data: Dict[str, np.ndarray]
     ):

@@ -190,6 +190,10 @@ class LocalQueryRunner:
             return self._execute_write(stmt)
         if isinstance(stmt, ast.ShowColumns):
             return self._execute_show_columns(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._execute_create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._execute_drop_table(stmt)
         if isinstance(stmt, ast.Delete):
             return self._execute_delete(stmt)
         if isinstance(stmt, ast.Prepare):
@@ -287,6 +291,50 @@ class LocalQueryRunner:
                     self.memory_pool.release(
                         "table-cache", _page_nbytes(stale)
                     )
+
+    def _resolve_write_handle(self, parts):
+        from presto_tpu.connectors.spi import TableHandle
+
+        catalog, schema_name = self.session.catalog, self.session.schema
+        if len(parts) == 3:
+            catalog, schema_name, table = parts
+        elif len(parts) == 2:
+            schema_name, table = parts
+        else:
+            (table,) = parts
+        return TableHandle(catalog, schema_name, table), self.catalogs.get(
+            catalog
+        )
+
+    def _execute_create_table(self, stmt) -> QueryResult:
+        """CREATE TABLE t (col type, ...) — plain DDL against a
+        writable connector."""
+        handle, conn = self._resolve_write_handle(stmt.target)
+        if not conn.supports_writes():
+            raise ExecutionError(
+                f"catalog {handle.catalog} is read-only"
+            )
+        tschema = {
+            name: T.parse_type(tname) for name, tname in stmt.columns
+        }
+        conn.create_table(handle, tschema)
+        return QueryResult(
+            ("result",), _message_page("CREATE TABLE")
+        )
+
+    def _execute_drop_table(self, stmt) -> QueryResult:
+        handle, conn = self._resolve_write_handle(stmt.target)
+        if not hasattr(conn, "drop_table"):
+            raise ExecutionError(
+                f"catalog {handle.catalog} does not support DROP TABLE"
+            )
+        dropped = conn.drop_table(handle)
+        if not dropped and not stmt.if_exists:
+            raise ExecutionError(
+                f"table {handle.schema}.{handle.table} does not exist"
+            )
+        self._invalidate_table_caches(handle)
+        return QueryResult(("result",), _message_page("DROP TABLE"))
 
     def _execute_delete(self, stmt) -> QueryResult:
         """DELETE FROM t [WHERE pred]: keep the complement (rows where

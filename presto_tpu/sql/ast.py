@@ -351,3 +351,17 @@ class Insert(Node):
 class CreateTableAs(Node):
     target: Tuple[str, ...]
     query: Node = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Node):
+    """CREATE TABLE t (col type, ...) — plain DDL."""
+
+    target: Tuple[str, ...]
+    columns: Tuple[Tuple[str, str], ...]  # (name, type text)
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    target: Tuple[str, ...]
+    if_exists: bool = False

@@ -28,7 +28,7 @@ def parse_statement(sql: str) -> ast.Node:
 SOFT_IDENT_KEYWORDS = frozenset({
     "date", "year", "month", "day", "values", "tables", "schemas",
     "first", "last", "columns", "using", "execute", "prepare",
-    "delete", "describe", "deallocate",
+    "delete", "describe", "deallocate", "if", "drop",
 })
 
 
@@ -207,10 +207,29 @@ class _Parser:
         if self.accept_kw("create"):
             self.expect_kw("table")
             target = self._qualified_name()
+            if self.accept_op("("):
+                cols = []
+                while True:
+                    name = self.expect_ident()
+                    cols.append((name, self._type_text()))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                self._finish()
+                return ast.CreateTable(target, tuple(cols))
             self.expect_kw("as")
             sel = self.parse_select()
             self._finish()
             return ast.CreateTableAs(target, sel)
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            target = self._qualified_name()
+            self._finish()
+            return ast.DropTable(target, if_exists)
         sel = self.parse_select()
         self._finish()
         return sel
@@ -368,6 +387,17 @@ class _Parser:
             having=having,
             distinct=distinct,
         )
+
+    def _type_text(self) -> str:
+        """A type name with optional (args): varchar, decimal(9,2)."""
+        type_parts = [self.expect_ident()]
+        if self.accept_op("("):
+            inner = [self.advance().value]
+            while self.accept_op(","):
+                inner.append(self.advance().value)
+            self.expect_op(")")
+            type_parts.append("(" + ",".join(inner) + ")")
+        return "".join(type_parts)
 
     def _group_by_element(self) -> ast.Node:
         """One GROUP BY element: a plain expression, or
@@ -768,15 +798,9 @@ class _Parser:
             self.expect_op("(")
             arg = self.parse_expr()
             self.expect_kw("as")
-            type_parts = [self.expect_ident()]
-            if self.accept_op("("):
-                inner = [self.advance().value]
-                while self.accept_op(","):
-                    inner.append(self.advance().value)
-                self.expect_op(")")
-                type_parts.append("(" + ",".join(inner) + ")")
+            tname = self._type_text()
             self.expect_op(")")
-            return ast.CastExpr(arg, "".join(type_parts))
+            return ast.CastExpr(arg, tname)
         if self.accept_kw("extract"):
             self.expect_op("(")
             field_tok = self.advance()

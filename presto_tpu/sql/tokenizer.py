@@ -27,7 +27,7 @@ KEYWORDS = {
     "substr", "for", "any", "some", "escape", "values",
     "insert", "into", "create", "table",
     "delete", "describe", "columns", "prepare", "execute",
-    "deallocate", "using",
+    "deallocate", "using", "drop", "if",
 }
 
 _TOKEN_RE = re.compile(

@@ -149,3 +149,25 @@ def test_prepared_insert_and_delete(runner):
         "prepare del2 from delete from mem.default.kv where k = ?"
     )
     assert runner.execute("execute del2 using 77").rows() == [(1,)]
+
+
+def test_create_and_drop_table(runner):
+    runner.execute(
+        "create table mem.default.ddl (a bigint, s varchar, "
+        "d decimal(9,2))"
+    )
+    assert runner.execute(
+        "show columns from mem.default.ddl"
+    ).rows() == [
+        ("a", "bigint"), ("s", "varchar"), ("d", "decimal(9,2)"),
+    ]
+    runner.execute(
+        "insert into mem.default.ddl values (1, 'x', 2.50)"
+    )
+    assert runner.execute(
+        "select a, s, d from mem.default.ddl"
+    ).rows() == [(1, "x", __import__("decimal").Decimal("2.50"))]
+    runner.execute("drop table mem.default.ddl")
+    with pytest.raises(ExecutionError):
+        runner.execute("drop table mem.default.ddl")
+    runner.execute("drop table if exists mem.default.ddl")
