@@ -196,6 +196,8 @@ class LocalQueryRunner:
             return self._execute_drop_table(stmt)
         if isinstance(stmt, ast.Delete):
             return self._execute_delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._execute_update(stmt)
         if isinstance(stmt, ast.Prepare):
             self._prepared[stmt.name] = stmt.statement
             return QueryResult(("result",), _message_page("PREPARE"))
@@ -402,6 +404,74 @@ class LocalQueryRunner:
         )
         return QueryResult(("rows",), page)
 
+    def _execute_update(self, stmt) -> QueryResult:
+        """UPDATE t SET c = e [WHERE pred]: the new contents are ONE
+        select over the table — assigned columns become
+        ``case when <pred> then <expr> else c end`` (a NULL predicate
+        leaves the row unchanged, matching SQL update semantics) —
+        then the table replaces wholesale."""
+        handle, conn = self._resolve_write_handle(stmt.target)
+        if not hasattr(conn, "replace_rows"):
+            raise ExecutionError(
+                f"catalog {handle.catalog} does not support UPDATE"
+            )
+        tschema = conn.metadata().get_table_schema(handle)
+        assigns = dict(stmt.assignments)
+        unknown = set(assigns) - set(tschema)
+        if unknown:
+            raise ExecutionError(
+                f"UPDATE of unknown column(s) {sorted(unknown)}"
+            )
+        items = []
+        changed_rows_pred = None
+        for c in tschema:
+            if c in assigns:
+                e = assigns[c]
+                if stmt.where is not None:
+                    e = ast.CaseExpr(
+                        None,
+                        ((stmt.where, e),),
+                        ast.Ident((c,)),
+                    )
+                items.append(ast.SelectItem(e, c))
+            else:
+                items.append(ast.SelectItem(ast.Ident((c,)), c))
+        sel = ast.Select(
+            items=tuple(items),
+            from_=ast.TableRef(
+                (handle.catalog, handle.schema, handle.table)
+            ),
+        )
+        # affected-row count BEFORE replacing (the predicate must see
+        # the pre-update contents)
+        if stmt.where is not None:
+            cnt_sel = ast.Select(
+                items=(
+                    ast.SelectItem(ast.FuncCall("count", ()), "c"),
+                ),
+                from_=ast.TableRef(
+                    (handle.catalog, handle.schema, handle.table)
+                ),
+                where=stmt.where,
+            )
+            n = int(
+                self.execute_plan(
+                    plan_statement(
+                        cnt_sel, self.catalogs, self.session
+                    )
+                ).rows()[0][0]
+            )
+        res = self.execute_plan(
+            plan_statement(sel, self.catalogs, self.session)
+        )
+        if stmt.where is None:
+            n = int(res.page.num_valid)
+        payload = _result_columns(res)
+        conn.replace_rows(handle, {c: payload[c] for c in tschema})
+        self._invalidate_table_caches(handle)
+        page = Page.from_pydict({"rows": [n]}, {"rows": T.BIGINT})
+        return QueryResult(("rows",), page)
+
     def _execute_prepared(self, stmt) -> QueryResult:
         """EXECUTE name [USING v, ...]: substitute ? markers in the
         prepared AST with the literal arguments, then run the
@@ -423,6 +493,8 @@ class LocalQueryRunner:
             return self._execute_write(bound)
         if isinstance(bound, ast.Delete):
             return self._execute_delete(bound)
+        if isinstance(bound, ast.Update):
+            return self._execute_update(bound)
         plan = plan_statement(bound, self.catalogs, self.session)
         return self.execute_plan(plan)
 

@@ -365,3 +365,12 @@ class CreateTable(Node):
 class DropTable(Node):
     target: Tuple[str, ...]
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Update(Node):
+    """UPDATE t SET col = expr [, ...] [WHERE pred]."""
+
+    target: Tuple[str, ...]
+    assignments: Tuple[Tuple[str, Node], ...]
+    where: Optional[Node] = None

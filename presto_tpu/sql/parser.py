@@ -28,7 +28,7 @@ def parse_statement(sql: str) -> ast.Node:
 SOFT_IDENT_KEYWORDS = frozenset({
     "date", "year", "month", "day", "values", "tables", "schemas",
     "first", "last", "columns", "using", "execute", "prepare",
-    "delete", "describe", "deallocate", "if", "drop",
+    "delete", "describe", "deallocate", "if", "drop", "update",
 })
 
 
@@ -174,6 +174,23 @@ class _Parser:
                     else None
                 )
                 inner = ast.Delete(target, where)
+            elif self.peek_kw("update"):
+                self.advance()
+                target = self._qualified_name()
+                self.expect_kw("set")
+                assigns = []
+                while True:
+                    col = self.expect_ident()
+                    self.expect_op("=")
+                    assigns.append((col, self.parse_expr()))
+                    if not self.accept_op(","):
+                        break
+                where = (
+                    self.parse_expr()
+                    if self.accept_kw("where")
+                    else None
+                )
+                inner = ast.Update(target, tuple(assigns), where)
             else:
                 inner = self.parse_select()
             self._finish()
@@ -221,6 +238,21 @@ class _Parser:
             sel = self.parse_select()
             self._finish()
             return ast.CreateTableAs(target, sel)
+        if self.accept_kw("update"):
+            target = self._qualified_name()
+            self.expect_kw("set")
+            assigns = []
+            while True:
+                col = self.expect_ident()
+                self.expect_op("=")
+                assigns.append((col, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+            where = (
+                self.parse_expr() if self.accept_kw("where") else None
+            )
+            self._finish()
+            return ast.Update(target, tuple(assigns), where)
         if self.accept_kw("drop"):
             self.expect_kw("table")
             if_exists = False

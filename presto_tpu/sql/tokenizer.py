@@ -27,7 +27,7 @@ KEYWORDS = {
     "substr", "for", "any", "some", "escape", "values",
     "insert", "into", "create", "table",
     "delete", "describe", "columns", "prepare", "execute",
-    "deallocate", "using", "drop", "if",
+    "deallocate", "using", "drop", "if", "update",
 }
 
 _TOKEN_RE = re.compile(

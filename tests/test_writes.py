@@ -171,3 +171,35 @@ def test_create_and_drop_table(runner):
     with pytest.raises(ExecutionError):
         runner.execute("drop table mem.default.ddl")
     runner.execute("drop table if exists mem.default.ddl")
+
+
+def test_update(runner):
+    runner.execute(
+        "create table mem.default.upd (k bigint, v varchar)"
+    )
+    runner.execute(
+        "insert into mem.default.upd values (1, 'a'), (2, 'b'), "
+        "(3, null)"
+    )
+    # NULL predicate rows stay unchanged; count reflects TRUE rows
+    assert runner.execute(
+        "update mem.default.upd set v = 'z' where v = 'b'"
+    ).rows() == [(1,)]
+    assert runner.execute(
+        "select k, v from mem.default.upd order by k"
+    ).rows() == [(1, "a"), (2, "z"), (3, None)]
+    # unconditional update touches every row
+    assert runner.execute(
+        "update mem.default.upd set k = k + 10"
+    ).rows() == [(3,)]
+    assert runner.execute(
+        "select min(k) as m from mem.default.upd"
+    ).rows() == [(11,)]
+    runner.execute(
+        "prepare upd_p from update mem.default.upd set v = ? "
+        "where k = ?"
+    )
+    assert runner.execute("execute upd_p using 'w', 11").rows() == [
+        (1,)
+    ]
+    runner.execute("drop table mem.default.upd")
