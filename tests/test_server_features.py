@@ -329,3 +329,45 @@ def test_agg_query_skips_merge_path(merge_cluster, monkeypatch):
     )
     assert len(res.rows()) == 3
     assert not calls
+
+
+def test_statement_surface_over_http():
+    """The round-5 statement surface — DDL, DML, DESCRIBE, prepared
+    statements — works over the client protocol (result pages incl.
+    the two-varchar DESCRIBE page serialize on the wire)."""
+    from presto_tpu.connectors import create_connector
+    from presto_tpu.exec.staging import CatalogManager
+
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    catalogs.register("mem", create_connector("memory"))
+    coord = CoordinatorServer(catalogs=catalogs)
+    coord.start()
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        client.execute(
+            "create table mem.default.wire (k bigint, v varchar)"
+        )
+        assert client.execute(
+            "show columns from mem.default.wire"
+        ).data == [["k", "bigint"], ["v", "varchar"]]
+        client.execute(
+            "insert into mem.default.wire values (1, 'a'), (2, 'b')"
+        )
+        assert client.execute(
+            "update mem.default.wire set v = 'z' where k = 2"
+        ).data == [[1]]
+        assert client.execute(
+            "delete from mem.default.wire where k = 1"
+        ).data == [[1]]
+        assert client.execute(
+            "select k, v from mem.default.wire"
+        ).data == [[2, "z"]]
+        client.execute(
+            "prepare wp from select v from mem.default.wire "
+            "where k = ?"
+        )
+        assert client.execute("execute wp using 2").data == [["z"]]
+        client.execute("drop table mem.default.wire")
+    finally:
+        coord.shutdown()
