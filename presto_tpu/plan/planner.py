@@ -1741,7 +1741,74 @@ class _Planner:
 
     def _apply_in_subquery(self, node, scope, a: ast.InSubquery, negate):
         if self._is_correlated(a.query, scope):
-            raise PlanningError("correlated IN subquery is not supported yet")
+            # correlated IN rewrites to correlated EXISTS with the
+            # membership as one more equality (reference: the
+            # InPredicate -> quantified-comparison -> semi-join chain):
+            #   x IN (select y from t where corr)
+            #   == EXISTS (select 1 from t where corr and y = x)
+            # NOT IN keeps its null-awareness requirement: a NULL x or
+            # NULL y makes the anti join inexact, so reject it rather
+            # than risk silent wrong rows.
+            if negate:
+                raise PlanningError(
+                    "correlated NOT IN requires null-aware "
+                    "three-valued semantics (unsupported)"
+                )
+            q = a.query
+            if len(q.items) != 1 or q.group_by or q.having or q.distinct:
+                raise PlanningError(
+                    "unsupported correlated IN subquery shape"
+                )
+            item = q.items[0]
+            inner_expr = item.expr
+            # the rewrite moves a.arg INSIDE the subquery: sound only
+            # when none of its column names resolve against the inner
+            # relations (unqualified resolution prefers the inner
+            # scope, which would silently change the comparison into
+            # an inner self-equality — oracle-caught)
+            _, inner_scope = self._plan_from(q.from_, None)
+            shadowed = []
+
+            def _check(n):
+                if isinstance(n, ast.Ident):
+                    try:
+                        inner_scope.resolve(n.parts)
+                        shadowed.append(n)
+                    except PlanningError:
+                        pass
+                    return
+                if not isinstance(n, ast.Node):
+                    return
+                for f_ in dataclasses.fields(n):
+                    v = getattr(n, f_.name)
+                    if isinstance(v, ast.Node):
+                        _check(v)
+                    elif isinstance(v, tuple):
+                        for x in v:
+                            if isinstance(x, ast.Node):
+                                _check(x)
+
+            _check(a.arg)
+            if shadowed:
+                raise PlanningError(
+                    "correlated IN whose left side is shadowed by the "
+                    f"subquery's relations ({shadowed[0]}) is not "
+                    "supported (qualify the outer column)"
+                )
+            eq = ast.BinaryOp("=", inner_expr, a.arg)
+            inner = ast.Select(
+                items=(ast.SelectItem(ast.NumberLit("1"), None),),
+                from_=q.from_,
+                where=(
+                    eq
+                    if q.where is None
+                    else ast.BinaryOp("and", q.where, eq)
+                ),
+                ctes=q.ctes,
+            )
+            return self._apply_exists(
+                node, scope, ast.Exists(inner), False
+            )
         sub_node, _, sub_names = self.plan_select(a.query, outer=None)
         if len(sub_names) != 1:
             raise PlanningError("IN subquery must return one column")

@@ -190,3 +190,37 @@ def test_multiple_count_distinct_global(runner, oracle):
         "from tpch.tiny.lineitem"
     )
     assert verify_query(runner, oracle, q, rel_tol=1e-6) is None
+
+
+def test_correlated_in_subquery(runner, oracle):
+    """Correlated IN rewrites to correlated EXISTS with the membership
+    as one more equality."""
+    q = (
+        "select count(*) as c from tpch.tiny.orders o "
+        "where o_orderkey in (select l_orderkey "
+        "from tpch.tiny.lineitem l where l.l_suppkey = o.o_custkey)"
+    )
+    assert verify_query(runner, oracle, q) is None
+    q2 = (
+        "select count(*) as c from tpch.tiny.customer c "
+        "where c.c_mktsegment in (select c2.c_mktsegment "
+        "from tpch.tiny.customer c2 "
+        "where c2.c_nationkey = c.c_nationkey "
+        "and c2.c_acctbal > 9000)"
+    )
+    assert verify_query(runner, oracle, q2) is None
+
+
+def test_correlated_in_shadowed_arg_rejected(runner):
+    """An UNQUALIFIED left side whose name also exists in the subquery
+    relations must be rejected, not silently rewritten into an inner
+    self-equality (oracle-caught during development)."""
+    from presto_tpu.plan.planner import PlanningError
+
+    with pytest.raises(PlanningError, match="shadowed"):
+        runner.execute(
+            "select count(*) as c from tpch.tiny.customer c "
+            "where c_mktsegment in (select c2.c_mktsegment "
+            "from tpch.tiny.customer c2 "
+            "where c2.c_nationkey = c.c_nationkey)"
+        )
