@@ -116,6 +116,12 @@ class Connector:
         staged pages must not be reused across queries."""
         return True
 
+    def coordinator_only(self) -> bool:
+        """True when this catalog's data lives only in the coordinator
+        process (system.runtime.*): the scheduler must not ship its
+        scans to workers, whose copies of the tables are empty."""
+        return False
+
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError
 

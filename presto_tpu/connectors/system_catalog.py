@@ -28,6 +28,7 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "query_id": T.VARCHAR,
             "state": T.VARCHAR,
             "query": T.VARCHAR,
+            "trace_id": T.VARCHAR,
             "elapsed_ms": T.DOUBLE,
             "planning_ms": T.DOUBLE,
             "staging_ms": T.DOUBLE,
@@ -45,6 +46,21 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "node_version": T.VARCHAR,
             "coordinator": T.BOOLEAN,
             "state": T.VARCHAR,
+        },
+        "tasks": {
+            "query_id": T.VARCHAR,
+            "stage_id": T.BIGINT,
+            "task_id": T.VARCHAR,
+            "node_id": T.VARCHAR,
+            "state": T.VARCHAR,
+            "wall_ms": T.DOUBLE,
+            "staging_ms": T.DOUBLE,
+            "execute_ms": T.DOUBLE,
+            "input_rows": T.BIGINT,
+            "input_bytes": T.BIGINT,
+            "output_rows": T.BIGINT,
+            "output_bytes": T.BIGINT,
+            "retries": T.BIGINT,
         },
         "metrics": {
             "name": T.VARCHAR,
@@ -87,6 +103,9 @@ class SystemConnector(Connector):
     def cacheable(self):
         return False  # live data: never reuse staged pages
 
+    def coordinator_only(self):
+        return True  # workers' system tables are empty: never distribute
+
     def get_splits(self, handle: TableHandle, target_split_rows: int = 1 << 20, constraint=()):
         return SplitSource([ConnectorSplit(handle, 0, 0)])
 
@@ -107,6 +126,7 @@ class SystemConnector(Connector):
                     "query_id": q.query_id,
                     "state": q.state,
                     "query": q.sql.strip(),
+                    "trace_id": q.trace_id,
                     "elapsed_ms": q.elapsed_ms,
                     "planning_ms": q.planning_ms,
                     "staging_ms": q.staging_ms,
@@ -122,6 +142,8 @@ class SystemConnector(Connector):
             ]
         if key == ("runtime", "nodes"):
             return self._node_rows()
+        if key == ("runtime", "tasks"):
+            return self._task_rows()
         if key == ("runtime", "metrics"):
             from presto_tpu.utils.metrics import REGISTRY
 
@@ -141,6 +163,38 @@ class SystemConnector(Connector):
                 for n in names
             ]
         raise KeyError(f"system table {handle.schema}.{handle.table}")
+
+    def _task_rows(self):
+        """Per-task stats of distributed queries (reference:
+        system.runtime.tasks), from the embedding coordinator's stage
+        rollups; empty on a plain local runner. Retention follows the
+        coordinator's bounded query map (MAX_QUERY_HISTORY completed
+        queries) — tasks age out with their query."""
+        cluster = getattr(self._runner, "cluster", None)
+        if cluster is None:
+            return []
+        out = []
+        for q in list(cluster.queries.values()):
+            for stage in q.stats.stages:
+                for t in list(stage.tasks):
+                    out.append(
+                        {
+                            "query_id": t.query_id,
+                            "stage_id": stage.stage_id,
+                            "task_id": t.task_id,
+                            "node_id": t.node_id,
+                            "state": t.state,
+                            "wall_ms": t.wall_ms,
+                            "staging_ms": t.staging_ms,
+                            "execute_ms": t.execute_ms,
+                            "input_rows": t.input_rows,
+                            "input_bytes": t.input_bytes,
+                            "output_rows": t.output_rows,
+                            "output_bytes": t.output_bytes,
+                            "retries": t.retries,
+                        }
+                    )
+        return out
 
     def _node_rows(self):
         cluster = getattr(self._runner, "cluster", None)

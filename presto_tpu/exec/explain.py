@@ -105,3 +105,62 @@ def explain_text(runner, stmt: ast.Explain) -> str:
         f"(wall, single-device instrumented run)"
     )
     return text
+
+
+def render_span_tree(trace, indent: int = 0) -> str:
+    """Render a utils.tracing.Trace as an indented phase tree with
+    durations (the text form of /v1/query/{id}'s span tree)."""
+
+    def walk(d, depth):
+        lines = [
+            "    " * depth
+            + f"- {d['name']} {d['duration_ms']:.1f} ms"
+            + ("" if d["end"] else " (open)")
+        ]
+        for c in d.get("children", ()):
+            lines.extend(walk(c, depth + 1))
+        return lines
+
+    out = []
+    for root in trace.to_tree():
+        out.extend(walk(root, indent))
+    return "\n".join(out)
+
+
+def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
+    """Distributed EXPLAIN ANALYZE: the fragment-less plan tree plus
+    the per-stage/per-task stats rollup and the query's span tree —
+    the same data ``GET /v1/query/{id}`` serves, rendered as text
+    (reference: EXPLAIN ANALYZE's stats-in-plan output applied to the
+    distributed tier)."""
+    lines = [render_plan(root)] if root is not None else []
+    lines.append("")
+    lines.append(
+        f"Distributed EXPLAIN ANALYZE: {n_rows} rows, "
+        f"trace {qstats.trace_id}"
+    )
+    lines.append(
+        f"planning {qstats.planning_ms:.1f} ms, "
+        f"execution {qstats.execution_ms:.1f} ms, "
+        f"{len(qstats.stages)} stage(s)"
+    )
+    for st in qstats.stages:
+        r = st.rollup()
+        lines.append(
+            f"Stage {st.stage_id} [{st.kind}] {st.state}: "
+            f"{r['tasks']} task(s), wall {r['wall_ms']:.1f} ms, "
+            f"rows {r['input_rows']} -> {r['output_rows']}, "
+            f"retries {r['retries']}"
+        )
+        for t in st.tasks:
+            lines.append(
+                f"  Task {t.task_id} on {t.node_id}: {t.state}, "
+                f"wall {t.wall_ms:.1f} ms (staging {t.staging_ms:.1f}, "
+                f"execute {t.execute_ms:.1f}), rows "
+                f"{t.input_rows} -> {t.output_rows}, "
+                f"bytes {t.input_bytes} -> {t.output_bytes}"
+            )
+    lines.append("")
+    lines.append("Span tree:")
+    lines.append(render_span_tree(trace))
+    return "\n".join(lines)

@@ -109,6 +109,17 @@ class LocalQueryRunner:
             from presto_tpu.connectors.system_catalog import SystemConnector
 
             catalogs.register("system", SystemConnector(runner=self))
+        # query-event sink (reference: EventListener SPI): one JSONL
+        # record per finished/failed query, so benchmark runs produce
+        # machine-readable traces. Configured by env var here; servers
+        # additionally wire it from config (event-listener.path).
+        import os
+
+        event_log = os.environ.get("PRESTO_TPU_EVENT_LOG")
+        if event_log:
+            from presto_tpu.exec.stats import JsonlQueryEventListener
+
+            self.history.add_listener(JsonlQueryEventListener(event_log))
         self._compiled: Dict[object, object] = {}
         self._prepared: Dict[str, object] = {}
         self._table_cache: Dict[Tuple, Page] = {}
@@ -122,6 +133,10 @@ class LocalQueryRunner:
         # restore-to-None between another's is-not-None check and its
         # attribute writes)
         self._qs_local = threading.local()
+        # guards read-modify-write (+=) on a SHARED stats sink: a
+        # worker task with task_concurrency > 1 points every batch
+        # driver's thread-local at the same TaskStats
+        self._qs_mu = threading.Lock()
 
     @property
     def _active_qs(self):
@@ -234,16 +249,26 @@ class LocalQueryRunner:
                 ),
             )
         from presto_tpu.utils.metrics import REGISTRY
+        from presto_tpu.utils.tracing import Trace
 
         qs = self.history.begin(sql)
+        trace = Trace()
+        qs.trace = trace
+        qs.trace_id = trace.trace_id
         REGISTRY.counter("queries.submitted").update()
         t0 = time.perf_counter()
         try:
-            with REGISTRY.timer("query.wall_time").time():
-                plan = plan_statement(stmt, self.catalogs, self.session)
+            with REGISTRY.timer("query.wall_time").time(), trace.span(
+                "query", query_id=qs.query_id
+            ):
+                with trace.span("plan"):
+                    plan = plan_statement(
+                        stmt, self.catalogs, self.session
+                    )
                 qs.planning_ms = (time.perf_counter() - t0) * 1000.0
                 qs.state = "RUNNING"
-                result = self.execute_plan(plan, qs=qs)
+                with trace.span("execute"):
+                    result = self.execute_plan(plan, qs=qs)
         except Exception as e:
             REGISTRY.counter("queries.failed").update()
             self.history.finish(qs, error=f"{type(e).__name__}: {e}")
@@ -853,7 +878,8 @@ class LocalQueryRunner:
                 )
             )
         if self._active_qs is not None:
-            self._active_qs.dynamic_filters += len(conjuncts)
+            with self._qs_mu:
+                self._active_qs.dynamic_filters += len(conjuncts)
         pred = (
             conjuncts[0]
             if len(conjuncts) == 1
@@ -875,7 +901,8 @@ class LocalQueryRunner:
             subtree, leaves, pages, fetch_result=False
         )
         if self._active_qs is not None:
-            self._active_qs.device_fragments += 1
+            with self._qs_mu:
+                self._active_qs.device_fragments += 1
         remote = N.RemoteSourceNode(fragment_root=subtree)
         pages_map[id(remote)] = page
         return remote
@@ -906,9 +933,17 @@ class LocalQueryRunner:
             # execute_plan rebuilds the tree (prune/bind), and a retrace
             # per call would redo XLA cache lookups costing seconds
             offload = self.session.get("tpu_offload")
+            from presto_tpu.utils.metrics import REGISTRY
+
             entry = self._compiled.get(
                 (root.fingerprint(), analyzed, offload)
             )
+            # compile-amortization counters (bench.py runs read these):
+            # a miss pays trace + XLA compile; steady state is all hits
+            REGISTRY.counter(
+                "compile.cache_miss" if entry is None else
+                "compile.cache_hit"
+            ).update()
             if entry is None:
                 if self._active_qs is not None:
                     self._active_qs.compile_cache_hit = False
@@ -1010,7 +1045,8 @@ class LocalQueryRunner:
                     "(join fan-out or group count beyond buckets)"
                 )
             if self._active_qs is not None:
-                self._active_qs.retries += 1
+                with self._qs_mu:
+                    self._active_qs.retries += 1
             root = _scale_capacities(root, 4)
 
     def _load_table(self, scan: N.TableScanNode) -> Page:
@@ -1025,10 +1061,15 @@ class LocalQueryRunner:
         )
         page = self._table_cache.get(key)
         if page is None:
+            from presto_tpu.utils.metrics import REGISTRY
+
             t0 = time.perf_counter()
             merged = self._load_merged_payload(scan)
             with self._device_scope():
                 page = stage_page(merged, dict(scan.schema))
+            REGISTRY.distribution("staging.bytes").add(
+                _page_nbytes(page)
+            )
             if self.memory_pool is not None:
                 nbytes = _page_nbytes(page)
                 cacheable = self.catalogs.get(
@@ -1097,6 +1138,9 @@ class LocalQueryRunner:
             page = stage_page(
                 payload, dict(scan.schema), capacity=capacity
             )
+        from presto_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.distribution("staging.bytes").add(_page_nbytes(page))
         if self._active_qs is not None:
             self._active_qs.staging_ms += (
                 time.perf_counter() - t0

@@ -13,15 +13,29 @@ every intermediate page), traced as extra program outputs. Host-side
 phase timings (plan / stage / compile+execute / gather) plus those
 per-node row counts form the stats tree; whole-program device time is
 attributed to the fragment, as ``jax.profiler`` traces attribute it.
+
+Distributed rollup: workers populate a :class:`TaskStats` per task
+(wall/staging/execute ms, input/output rows+bytes, retries), returned
+in ``/v1/task/{id}/status``; the coordinator groups them into
+:class:`StageStats` and rolls the stage totals into the query's
+:class:`QueryStats` — served whole at ``GET /v1/query/{id}`` and as
+``system.runtime.tasks``.
+
+Query events: :class:`QueryHistory` fires a :class:`QueryCompletedEvent`
+per finished/failed query to registered listeners (reference: the
+EventListener SPI's queryCompleted); :class:`JsonlQueryEventListener`
+appends one JSON line per event to a sink file, so benchmark runs
+produce machine-readable traces.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import json
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -32,6 +46,84 @@ class PlanNodeStats:
     label: str
     output_rows: int = -1  # -1: not yet measured
     output_capacity: int = -1  # static bucket the rows sat in
+
+
+@dataclasses.dataclass
+class TaskStats:
+    """One task's stats (reference: TaskStats), populated worker-side
+    and shipped back in the task-status response.
+
+    Also usable as the runner's per-query stats sink (the attribute
+    subset LocalQueryRunner._active_qs touches: staging_ms, input_rows,
+    input_bytes, retries, compile_cache_hit, dynamic_filters,
+    device_fragments, query_id), so a worker task accumulates engine
+    stats with zero extra plumbing."""
+
+    task_id: str
+    query_id: str
+    node_id: str = ""
+    stage_id: int = -1
+    state: str = "QUEUED"
+    create_time: float = 0.0
+    end_time: float = 0.0
+    wall_ms: float = 0.0
+    staging_ms: float = 0.0
+    execute_ms: float = 0.0
+    input_rows: int = 0
+    input_bytes: int = 0
+    output_rows: int = 0
+    output_bytes: int = 0
+    retries: int = 0
+    compile_cache_hit: bool = True
+    dynamic_filters: int = 0
+    device_fragments: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "TaskStats":
+        known = {f.name for f in dataclasses.fields(TaskStats)}
+        return TaskStats(
+            **{k: v for k, v in d.items() if k in known}
+        )
+
+
+@dataclasses.dataclass
+class StageStats:
+    """One stage's task group + rollup (reference: StageStats)."""
+
+    stage_id: int
+    kind: str = "source"  # source|merge|join|producer
+    state: str = "RUNNING"
+    tasks: List[TaskStats] = dataclasses.field(default_factory=list)
+
+    def rollup(self) -> dict:
+        """Aggregate the stage's task stats (sums; wall is max — tasks
+        run concurrently, so the stage costs its slowest task)."""
+        return {
+            "tasks": len(self.tasks),
+            "wall_ms": max((t.wall_ms for t in self.tasks), default=0.0),
+            "staging_ms": sum(t.staging_ms for t in self.tasks),
+            "execute_ms": sum(t.execute_ms for t in self.tasks),
+            "input_rows": sum(t.input_rows for t in self.tasks),
+            "input_bytes": sum(t.input_bytes for t in self.tasks),
+            "output_rows": sum(t.output_rows for t in self.tasks),
+            "output_bytes": sum(t.output_bytes for t in self.tasks),
+            "retries": sum(t.retries for t in self.tasks),
+            "failed_tasks": sum(
+                1 for t in self.tasks if t.state == "FAILED"
+            ),
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            "stage_id": self.stage_id,
+            "kind": self.kind,
+            "state": self.state,
+            "rollup": self.rollup(),
+            "tasks": [t.to_dict() for t in self.tasks],
+        }
 
 
 @dataclasses.dataclass
@@ -54,12 +146,98 @@ class QueryStats:
     input_rows: int = 0
     input_bytes: int = 0
     output_rows: int = 0
+    trace_id: str = ""
     node_stats: List[PlanNodeStats] = dataclasses.field(default_factory=list)
+    stages: List[StageStats] = dataclasses.field(default_factory=list)
+    #: the query's utils.tracing.Trace (None on untraced paths)
+    trace: Optional[object] = None
 
     @property
     def elapsed_ms(self) -> float:
         end = self.end_time or time.time()
         return (end - self.create_time) * 1000.0
+
+    def roll_up(self) -> None:
+        """Fold stage rollups into the query-level totals (reference:
+        QueryStats summing its StageStats). Idempotent: totals are
+        recomputed from scratch on top of the coordinator-local
+        accumulators, so it is safe to call per status poll."""
+        if not self.stages:
+            return
+        # input/staging/retry attribution lives worker-side for
+        # distributed queries: overwrite (not add) from the freshest
+        # task stats
+        self.retries = sum(
+            t.retries for s in self.stages for t in s.tasks
+        )
+        self.staging_ms = sum(
+            t.staging_ms for s in self.stages for t in s.tasks
+        )
+        self.input_rows = sum(
+            t.input_rows for s in self.stages for t in s.tasks
+        )
+        self.input_bytes = sum(
+            t.input_bytes for s in self.stages for t in s.tasks
+        )
+
+    def to_dict(self, include_stages: bool = True) -> dict:
+        out = {
+            "query_id": self.query_id,
+            "query": self.sql,
+            "state": self.state,
+            "error": self.error,
+            "trace_id": self.trace_id,
+            "create_time": self.create_time,
+            "end_time": self.end_time,
+            "elapsed_ms": self.elapsed_ms,
+            "planning_ms": self.planning_ms,
+            "staging_ms": self.staging_ms,
+            "execution_ms": self.execution_ms,
+            "compile_cache_hit": self.compile_cache_hit,
+            "retries": self.retries,
+            "device_fragments": self.device_fragments,
+            "dynamic_filters": self.dynamic_filters,
+            "input_rows": self.input_rows,
+            "input_bytes": self.input_bytes,
+            "output_rows": self.output_rows,
+        }
+        if include_stages:
+            out["stages"] = [s.to_dict() for s in self.stages]
+        return out
+
+
+# --------------------------------------------------------- query events
+
+
+@dataclasses.dataclass
+class QueryCompletedEvent:
+    """Fired once per finished/failed query (reference: the
+    EventListener SPI's QueryCompletedEvent)."""
+
+    stats: QueryStats
+
+    def to_dict(self) -> dict:
+        out = {"event": "query_completed"}
+        out.update(self.stats.to_dict(include_stages=True))
+        trace = self.stats.trace
+        if trace is not None:
+            out["spans"] = trace.to_tree()
+        return out
+
+
+class JsonlQueryEventListener:
+    """Appends one JSON line per QueryCompletedEvent to ``path`` —
+    the machine-readable trace sink for benchmark runs."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        line = json.dumps(event.to_dict(), default=str)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
 
 
 class QueryHistory:
@@ -72,6 +250,26 @@ class QueryHistory:
         self._capacity = capacity
         self._queries: Dict[str, QueryStats] = {}
         self._ids = itertools.count(1)
+        #: query-completed listeners; each gets the QueryCompletedEvent
+        self._listeners: List[object] = []
+
+    def add_listener(self, listener) -> None:
+        """Register an event listener (needs ``query_completed(ev)``).
+        JSONL sinks dedup by real path here — the ONE registration
+        site — so a config path and the env var naming the same file
+        still produce one record per query."""
+        import os
+
+        with self._lock:
+            if isinstance(listener, JsonlQueryEventListener):
+                path = os.path.realpath(listener.path)
+                for ln in self._listeners:
+                    if (
+                        isinstance(ln, JsonlQueryEventListener)
+                        and os.path.realpath(ln.path) == path
+                    ):
+                        return
+            self._listeners.append(listener)
 
     def begin(self, sql: str) -> QueryStats:
         with self._lock:
@@ -85,10 +283,27 @@ class QueryHistory:
                 self._queries.pop(next(iter(self._queries)))
             return qs
 
+    def adopt(self, qs: QueryStats) -> None:
+        """Register an externally-created QueryStats (the coordinator's
+        distributed queries) so one history serves both tiers."""
+        with self._lock:
+            self._queries[qs.query_id] = qs
+            while len(self._queries) > self._capacity:
+                self._queries.pop(next(iter(self._queries)))
+
     def finish(self, qs: QueryStats, error: Optional[str] = None) -> None:
         qs.end_time = time.time()
         qs.state = "FAILED" if error else "FINISHED"
         qs.error = error
+        with self._lock:
+            listeners = list(self._listeners)
+        if listeners:
+            ev = QueryCompletedEvent(stats=qs)
+            for ln in listeners:
+                try:
+                    ln.query_completed(ev)
+                except Exception:
+                    pass  # a broken sink must never fail the query
 
     def snapshot(self) -> List[QueryStats]:
         with self._lock:

@@ -67,6 +67,18 @@ class PrestoTpuClient:
                 raise TimeoutError(f"query {qid} did not finish in time")
             cur = self._get_json(nxt)
 
+    # ----------------------------------------------------- observability
+
+    def query_info(self, query_id: str) -> dict:
+        """Full QueryInfo for one query — the stats rollup (per-stage
+        task timings) and the span tree (``GET /v1/query/{id}``)."""
+        return self._get_json(f"{self.uri}/v1/query/{query_id}")
+
+    def list_queries(self) -> List[dict]:
+        """Summaries of every query the coordinator remembers
+        (``GET /v1/query``)."""
+        return self._get_json(f"{self.uri}/v1/query")
+
     # ------------------------------------------------------------ http
 
     def _post_json(self, url: str, body: bytes) -> dict:

@@ -16,6 +16,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import logging
+import sys
 import threading
 import time
 import traceback
@@ -28,16 +30,26 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from presto_tpu.exec.staging import stage_page
+from presto_tpu.exec.stats import QueryStats, StageStats, TaskStats
 from presto_tpu.plan import nodes as N
 from presto_tpu.server import pages_wire
 from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.scheduler import assign_ranges, plan_stage
 from presto_tpu.utils.metrics import REGISTRY
+from presto_tpu.utils.tracing import Trace
+
+log = logging.getLogger("presto_tpu.coordinator")
 
 #: announcement TTL: a worker silent this long is dropped (reference:
 #: discovery TTL expiry removing dead nodes from scheduling)
 NODE_TTL_S = 10.0
 RESULT_PAGE_ROWS = 4096
+#: completed queries kept for /v1/query + system.runtime (reference:
+#: query.max-history); running/queued queries are never evicted
+MAX_QUERY_HISTORY = 100
+#: a finished query whose client has NOT drained its results survives
+#: eviction this long past end_time
+DRAIN_GRACE_S = 900.0
 
 
 @dataclasses.dataclass
@@ -59,6 +71,35 @@ class _Query:
         self.columns: List[dict] = []
         self.rows: List[list] = []
         self.done = threading.Event()
+        # observability: per-query span tree + the QueryInfo stats
+        # rollup served at GET /v1/query/{id}
+        self.trace = Trace()
+        self.stats = QueryStats(
+            query_id=qid, sql=sql, create_time=time.time(),
+            trace_id=self.trace.trace_id, trace=self.trace,
+        )
+        self._stats_lock = threading.Lock()
+        self._stage_seq = itertools.count(0)
+        self._task_stage: Dict[str, StageStats] = {}
+        self._recorded: set = set()
+        self._adopted = False  # registered in the runner's QueryHistory
+        self._plan_root = None  # pruned plan root (distributed EXPLAIN)
+        #: output_rows already holds the real result count (distributed
+        #: EXPLAIN ANALYZE, where q.rows is plan text, not the result)
+        self._output_rows_final = False
+        #: the client consumed the last result page (or the error):
+        #: history eviction must not drop a query mid-pagination
+        self._drained = False
+
+    def fail(self, error: str) -> None:
+        """Terminal rejection/kill close-out — one place for the
+        state/stats/clock contract (rejected and killed queries never
+        reach _finish_query_stats)."""
+        self.state = "FAILED"
+        self.error = error
+        self.stats.state = "FAILED"
+        self.stats.error = error
+        self.stats.end_time = time.time()
 
 
 class CoordinatorServer:
@@ -98,6 +139,16 @@ class CoordinatorServer:
             memory_pool=self.memory_pool,
         )
         self.local.cluster = self  # system.runtime.nodes source
+        # config-wired query-completed JSONL sink (the env-var hook in
+        # LocalQueryRunner covers bench/embedded runs; add_listener
+        # dedups same-file sinks, so both naming one path is fine)
+        event_log = config.get("event-listener.path") if config else None
+        if event_log:
+            from presto_tpu.exec.stats import JsonlQueryEventListener
+
+            self.local.history.add_listener(
+                JsonlQueryEventListener(event_log)
+            )
         self.workers: Dict[str, _WorkerNode] = {}
         self.queries: Dict[str, _Query] = {}
         self._lock = threading.Lock()
@@ -160,8 +211,7 @@ class CoordinatorServer:
             return None
         victim = max(candidates, key=candidates.get)
         vq = self.queries[victim]
-        vq.state = "FAILED"
-        vq.error = (
+        vq.fail(
             "Query killed by the cluster memory manager: largest "
             f"holder ({candidates[victim]}B) when the pool was exhausted"
         )
@@ -223,8 +273,7 @@ class CoordinatorServer:
         """Bytes reserved by running queries of one resource group (the
         manager's softMemoryLimit eligibility hook)."""
         with self._lock:
-            # live queries only: the history dict is unbounded, and
-            # finished queries hold no reservations anyway
+            # live queries only: finished queries hold no reservations
             qids = [
                 q.qid
                 for q in self.queries.values()
@@ -234,14 +283,34 @@ class CoordinatorServer:
         return sum(self.memory_pool.used_bytes(qid) for qid in qids)
 
     def submit(self, sql: str, user: str = "presto_tpu") -> _Query:
-        q = _Query(f"q_{next(self._qid)}", sql)
+        # "q_c" namespace: distributed queries join the runner's
+        # QueryHistory (adopt), whose own ids are "q_N" — the two
+        # counters are independent and must not collide there
+        q = _Query(f"q_c{next(self._qid)}", sql)
         q.user = user
         q.resource_group = None
         with self._lock:
             self.queries[q.qid] = q
+            # bounded retention (reference: query.max-history): evict
+            # the oldest COMPLETED queries — their stats/spans/result
+            # rows must not accumulate on a long-running coordinator.
+            # Un-drained queries (client still paginating) get a grace
+            # window before they too age out (abandoned clients must
+            # not pin memory forever).
+            now = time.time()
+            done = [
+                qid
+                for qid, old in self.queries.items()
+                if old.done.is_set()
+                and (
+                    old._drained
+                    or now - (old.stats.end_time or now) > DRAIN_GRACE_S
+                )
+            ]
+            for qid in done[: max(0, len(done) - MAX_QUERY_HISTORY)]:
+                del self.queries[qid]
             if self._pending >= self._max_queued:
-                q.state = "FAILED"
-                q.error = (
+                q.fail(
                     "Query rejected: too many queued queries "
                     f"(max {self._max_queued})"
                 )
@@ -267,8 +336,7 @@ class CoordinatorServer:
         if state == "rejected":
             with self._lock:
                 self._pending -= 1
-            q.state = "FAILED"
-            q.error = info
+            q.fail(info)
             REGISTRY.counter("coordinator.queries_rejected").update()
             q.done.set()
             return q
@@ -287,12 +355,17 @@ class CoordinatorServer:
                     self.resource_groups.finish(q.resource_group)
                 return
             q.state = "RUNNING"
+            q.stats.state = "RUNNING"
+            log.info(
+                "trace=%s query=%s state=RUNNING", q.trace.trace_id, q.qid
+            )
             # pool reservations this thread makes are owned by THIS
             # query id (one id space for holders, kills, and clients)
             self.local._owner_override.value = q.qid
             try:
                 with REGISTRY.timer("coordinator.query_time").time():
-                    self._run_sql(q)
+                    with q.trace.span("query", query_id=q.qid):
+                        self._run_sql(q)
                 if not q.done.is_set():  # a killed query stays FAILED
                     q.state = "FINISHED"
             except Exception as e:
@@ -304,6 +377,7 @@ class CoordinatorServer:
                     )
                 REGISTRY.counter("coordinator.queries_failed").update()
             finally:
+                self._finish_query_stats(q)
                 self.local._owner_override.value = None
                 self.memory_pool.release(q.qid)
                 with self._lock:
@@ -318,34 +392,109 @@ class CoordinatorServer:
                     self.resource_groups.finish(q.resource_group)
 
     def _run_sql(self, q: _Query) -> None:
-        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
-        from presto_tpu.parallel.fragmenter import insert_gathers
-        from presto_tpu.plan.optimizer import prune_columns
-        from presto_tpu.plan.planner import plan_statement
         from presto_tpu.sql import ast, parse_statement
 
         stmt = parse_statement(q.sql)
         workers = self.active_workers()
+        if (
+            isinstance(stmt, ast.Explain)
+            and stmt.analyze
+            and isinstance(stmt.statement, ast.Select)
+            and workers
+        ):
+            # distributed EXPLAIN ANALYZE: run the inner SELECT through
+            # the real scheduler, then render the plan with the
+            # per-stage/per-task rollup and the span tree
+            from presto_tpu.exec.explain import render_distributed_analyze
+
+            res = self._run_select(q, stmt.statement, workers)
+            q.stats.output_rows = int(res.page.num_valid)
+            q._output_rows_final = True
+            q.stats.roll_up()
+            # provisionally close the root span for the rendering (the
+            # context manager records the real end on exit), so the
+            # printed tree doesn't show the query span as open
+            if q.trace.root is not None and not q.trace.root.end:
+                q.trace.root.end = time.time()
+            text = render_distributed_analyze(
+                q._plan_root, q.stats, q.trace, int(res.page.num_valid)
+            )
+            q.columns = [{"name": "Query Plan"}]
+            q.rows = [[line] for line in text.split("\n")]
+            return
         if not isinstance(stmt, ast.Select) or not workers:
             # non-SELECT (SET SESSION / SHOW / EXPLAIN) or empty cluster:
             # run on the coordinator's local engine
-            res = self.local.execute(q.sql)
+            with q.trace.span("execute-local"):
+                res = self.local.execute(q.sql)
             self._store_result(q, res)
             return
+        res = self._run_select(q, stmt, workers)
+        self._store_result(q, res)
 
-        plan = plan_statement(stmt, self.local.catalogs, self.local.session)
-        root = prune_columns(self.local._bind_params(plan))
-        host_ops: List[N.PlanNode] = []
-        if self.local.session.get("host_root_stage"):
-            root, host_ops = peel_host_ops(root)
-        froot = insert_gathers(root)
+    def _run_select(self, q: _Query, stmt, workers):
+        """Distributed SELECT: plan -> fragment -> schedule stages ->
+        gather, each phase a span on the query's trace; returns the
+        QueryResult. Falls back to the local engine when fragmenting
+        yields no remote sources."""
+        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
+        from presto_tpu.parallel.fragmenter import insert_gathers
+        from presto_tpu.plan.optimizer import prune_columns
+        from presto_tpu.plan.planner import plan_statement
+
+        # distributed queries share the runner's QueryHistory (one
+        # system.runtime.queries across both tiers) and fire the
+        # query-completed event through it
+        self.local.history.adopt(q.stats)
+        q._adopted = True
+        t0 = time.perf_counter()
+        with q.trace.span("plan"):
+            plan = plan_statement(
+                stmt, self.local.catalogs, self.local.session
+            )
+            root = prune_columns(self.local._bind_params(plan))
+        q.stats.planning_ms = (time.perf_counter() - t0) * 1000.0
+        scans = [
+            n for n in N.walk(root) if isinstance(n, N.TableScanNode)
+        ]
+        if any(
+            self.local.catalogs.get(s.handle.catalog).coordinator_only()
+            for s in scans
+        ):
+            # system.runtime.* data lives in THIS process; a worker's
+            # copy of those tables is empty
+            t1 = time.perf_counter()
+            try:
+                with q.trace.span("execute-local"):
+                    return self.local.execute_plan(plan)
+            finally:
+                q.stats.execution_ms = (
+                    time.perf_counter() - t1
+                ) * 1000.0
+        with q.trace.span("fragment"):
+            host_ops: List[N.PlanNode] = []
+            if self.local.session.get("host_root_stage"):
+                root, host_ops = peel_host_ops(root)
+            froot = insert_gathers(root)
+        q._plan_root = root
         remotes = [
             n for n in N.walk(froot) if isinstance(n, N.RemoteSourceNode)
         ]
+        t1 = time.perf_counter()
+        try:
+            return self._run_select_fragments(
+                q, plan, root, froot, host_ops, remotes, workers
+            )
+        finally:
+            q.stats.execution_ms = (time.perf_counter() - t1) * 1000.0
+
+    def _run_select_fragments(
+        self, q: _Query, plan, root, froot, host_ops, remotes, workers
+    ):
+        from presto_tpu.exec.host_ops import apply_host_ops
+
         if not remotes:
-            res = self.local.execute_plan(plan)
-            self._store_result(q, res)
-            return
+            return self.local.execute_plan(plan)
         # ordered MERGE exchange (reference: MergeOperator): when the
         # peeled root sort sits directly over a single no-cut fragment,
         # push the sort into the worker fragment (per-batch sorted runs)
@@ -375,8 +524,7 @@ class CoordinatorServer:
                 page = apply_host_ops(page, host_ops)
             from presto_tpu.exec.local_runner import QueryResult
 
-            self._store_result(q, QueryResult(plan.output_names, page))
-            return
+            return QueryResult(plan.output_names, page)
         if len(remotes) == 1:
             pages = [self._run_stage(remotes[0].fragment_root, workers, q)]
         else:
@@ -390,12 +538,142 @@ class CoordinatorServer:
                     for r in remotes
                 ]
                 pages = [f.result() for f in futs]
-        page = self.local._run_with_pages(froot, remotes, pages)
-        if host_ops:
-            page = apply_host_ops(page, host_ops)
+        with q.trace.span("gather", phase="final-splice"):
+            page = self.local._run_with_pages(froot, remotes, pages)
+            if host_ops:
+                page = apply_host_ops(page, host_ops)
         from presto_tpu.exec.local_runner import QueryResult
 
-        self._store_result(q, QueryResult(plan.output_names, page))
+        return QueryResult(plan.output_names, page)
+
+    # --------------------------------------------------- stats collection
+
+    def _finish_query_stats(self, q: _Query) -> None:
+        """Close out the query's stats object and, for distributed
+        queries (adopted into the runner's history), fire the
+        query-completed event through the history."""
+        # distributed EXPLAIN ANALYZE already set the inner SELECT's
+        # real output count; q.rows there holds plan-text lines
+        if not q._output_rows_final:
+            q.stats.output_rows = len(q.rows)
+        # close any stage a failed (or early-exited) path left open:
+        # a finished query must not report RUNNING stages — and no
+        # task may stay RUNNING either (a timed-out pull records a
+        # provisional snapshot; the task was DELETEd on the worker)
+        with q._stats_lock:
+            for st in q.stats.stages:
+                if st.state == "RUNNING":
+                    st.state = q.state
+                for t in st.tasks:
+                    if t.state in ("QUEUED", "RUNNING"):
+                        t.state = (
+                            "ABORTED" if q.state == "FINISHED"
+                            else "FAILED"
+                        )
+        q.stats.roll_up()
+        if q._adopted:
+            self.local.history.finish(q.stats, error=q.error)
+        else:
+            q.stats.end_time = time.time()
+            q.stats.state = q.state
+            q.stats.error = q.error
+        log.info(
+            "trace=%s query=%s state=%s elapsed_ms=%.1f",
+            q.trace.trace_id, q.qid, q.state, q.stats.elapsed_ms,
+        )
+
+    def _new_stage(self, q: _Query, kind: str) -> StageStats:
+        with q._stats_lock:
+            st = StageStats(stage_id=next(q._stage_seq), kind=kind)
+            q.stats.stages.append(st)
+        return st
+
+    def _register_task(
+        self, q: _Query, stage: StageStats, spec: FragmentSpec
+    ) -> FragmentSpec:
+        """Remember which stage a task belongs to, so its final status
+        rolls up into the right StageStats."""
+        with q._stats_lock:
+            q._task_stage[spec.task_id] = stage
+        return spec
+
+    def _record_task_status(self, q: _Query, task_id: str, st: dict):
+        """Fold one task's status JSON into the query rollup and graft
+        its worker-side spans into the query trace. Only a TERMINAL
+        status seals the task: a non-terminal snapshot (a timed-out
+        pull reading a still-RUNNING worker) is folded provisionally
+        and replaced if the real final status arrives later."""
+        d = st.get("stats") or {}
+        ts = (
+            TaskStats.from_dict(d)
+            if d
+            else TaskStats(task_id=task_id, query_id=q.qid)
+        )
+        ts.state = st.get("state", ts.state)
+        terminal = ts.state in ("FINISHED", "FAILED", "ABORTED")
+        with q._stats_lock:
+            if task_id in q._recorded:
+                return
+            if terminal:
+                q._recorded.add(task_id)
+            stage = q._task_stage.get(task_id)
+            if stage is not None:
+                ts.stage_id = stage.stage_id
+                # replace an earlier provisional snapshot of this task
+                stage.tasks = [
+                    t for t in stage.tasks if t.task_id != task_id
+                ] + [ts]
+        q.trace.graft(st.get("spans"))
+
+    def _finish_task(
+        self, q: _Query, w, task_id: str, traceparent: str = ""
+    ) -> None:
+        """Collect a task's final stats, then DELETE it on the worker
+        (the one task-teardown path: stats must be read BEFORE the
+        DELETE removes the task)."""
+        try:
+            st = self._http_json(
+                "GET",
+                f"{w.uri}/v1/task/{task_id}/status",
+                None,
+                traceparent=traceparent,
+            )
+            self._record_task_status(q, task_id, st)
+        except Exception:
+            pass  # a dead worker's stats are simply lost
+        try:
+            self._http_json(
+                "DELETE",
+                f"{w.uri}/v1/task/{task_id}",
+                None,
+                traceparent=traceparent,
+            )
+        except Exception:
+            pass
+
+    def query_info(self, q: _Query) -> dict:
+        """Full QueryInfo (reference: ``GET /v1/query/{id}``): the
+        stats rollup, per-stage task stats, and the span tree —
+        servable while the query is RUNNING."""
+        q.stats.roll_up()
+        info = q.stats.to_dict(include_stages=True)
+        info["state"] = q.state  # _Query.state is authoritative
+        info["error"] = q.error
+        info["user"] = getattr(q, "user", None)
+        info["resource_group"] = getattr(q, "resource_group", None)
+        info["trace"] = q.trace.to_tree()
+        return info
+
+    def query_summary(self, q: _Query) -> dict:
+        return {
+            "query_id": q.qid,
+            "state": q.state,
+            "query": q.sql,
+            "trace_id": q.trace.trace_id,
+            "elapsed_ms": q.stats.elapsed_ms,
+            "user": getattr(q, "user", None),
+            "stages": len(q.stats.stages),
+        }
 
     # ------------------------------------------------------- stage runner
 
@@ -470,9 +748,10 @@ class CoordinatorServer:
             stage.partition_rows, max(len(workers) * over, 1)
         )
         ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
+        stage_stats = self._new_stage(q, "source")
 
         def make_spec(lo: int, hi: int) -> FragmentSpec:
-            return FragmentSpec(
+            return self._register_task(q, stage_stats, FragmentSpec(
                 task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
                 query_id=q.qid,
                 fragment=worker_fragment,
@@ -485,7 +764,8 @@ class CoordinatorServer:
                 task_concurrency=int(
                     self.local.session.get("task_concurrency")
                 ),
-            )
+                traceparent=q.trace.traceparent(),
+            ))
 
         # pull every worker concurrently (reference: the ExchangeClient
         # keeps all upstream tasks in flight; serial draining would
@@ -493,59 +773,76 @@ class CoordinatorServer:
         # retry a DEAD worker's range on a live one (recoverable
         # execution: reassign, don't fail the query)
         def pull_and_delete(w, spec):
-            out = self._pull_task(w, spec)
             try:
-                self._http_json(
-                    "DELETE", f"{w.uri}/v1/task/{spec.task_id}", None
-                )
+                out = self._pull_task(w, spec)
             except Exception:
-                pass
+                # the failed attempt's stats/spans still fold into the
+                # rollup and its buffered pages get DELETEd — but OFF
+                # this thread: the recoverable-execution retry must
+                # not wait out status/DELETE timeouts against a worker
+                # that may be hung (any still-open task state is
+                # closed when the query finishes)
+                threading.Thread(
+                    target=self._finish_task,
+                    args=(q, w, spec.task_id, spec.traceparent),
+                    daemon=True,
+                ).start()
+                raise
+            self._finish_task(q, w, spec.task_id, spec.traceparent)
             return out
 
-        results = self._ranged_tasks(
-            workers, ranges, make_spec, pull_and_delete
-        )
+        with q.trace.span("schedule", stage_id=stage_stats.stage_id):
+            results = self._ranged_tasks(
+                workers, ranges, make_spec, pull_and_delete
+            )
+        stage_stats.state = "FINISHED"
         payloads = [p for out in results for p in out]
 
         schema = dict(stage.worker_fragment.output_schema())
-        if order_by is not None:
-            merged = _merge_sorted_runs(payloads, schema, order_by)
-            return stage_page(merged, schema)
-        remote = [
-            n
-            for n in N.walk(stage.final_root)
-            if isinstance(n, N.RemoteSourceNode)
-        ]
-        # bucketed gather (reference: grouped execution at the merge):
-        # partial states beyond the device budget hash-bucket by group
-        # key and merge one bucket at a time instead of funnelling
-        # everything into one staged page (exec.streaming owns the
-        # policy, shared with the local streamed path)
-        from presto_tpu.exec import streaming as S
+        with q.trace.span("gather", stage_id=stage_stats.stage_id):
+            if order_by is not None:
+                merged = _merge_sorted_runs(payloads, schema, order_by)
+                return stage_page(merged, schema)
+            remote = [
+                n
+                for n in N.walk(stage.final_root)
+                if isinstance(n, N.RemoteSourceNode)
+            ]
+            # bucketed gather (reference: grouped execution at the
+            # merge): partial states beyond the device budget
+            # hash-bucket by group key and merge one bucket at a time
+            # instead of funnelling everything into one staged page
+            # (exec.streaming owns the policy, shared with the local
+            # streamed path)
+            from presto_tpu.exec import streaming as S
 
-        bucketed = S.grouped_final_merge(
-            self.local,
-            payloads,
-            schema,
-            stage.final_root,
-            stage.worker_fragment,
-            int(self.local.session.get("max_device_rows")),
-        )
-        if bucketed is not None:
-            return bucketed
-        merged = pages_wire.merge_payloads(payloads, schema)
-        page = stage_page(merged, schema)
-        # the final plan may contain real scans above the cut (e.g. a
-        # join against another table after the final aggregation) —
-        # load those locally alongside the gathered remote page
-        local_scans = [
-            n
-            for n in N.walk(stage.final_root)
-            if isinstance(n, N.TableScanNode)
-        ]
-        leaves = remote + local_scans
-        pages = [page] + [self.local._load_table(s) for s in local_scans]
-        return self.local._run_with_pages(stage.final_root, leaves, pages)
+            bucketed = S.grouped_final_merge(
+                self.local,
+                payloads,
+                schema,
+                stage.final_root,
+                stage.worker_fragment,
+                int(self.local.session.get("max_device_rows")),
+            )
+            if bucketed is not None:
+                return bucketed
+            merged = pages_wire.merge_payloads(payloads, schema)
+            page = stage_page(merged, schema)
+            # the final plan may contain real scans above the cut (e.g.
+            # a join against another table after the final aggregation)
+            # — load those locally alongside the gathered remote page
+            local_scans = [
+                n
+                for n in N.walk(stage.final_root)
+                if isinstance(n, N.TableScanNode)
+            ]
+            leaves = remote + local_scans
+            pages = [page] + [
+                self.local._load_table(s) for s in local_scans
+            ]
+            return self.local._run_with_pages(
+                stage.final_root, leaves, pages
+            )
 
     def _run_join_partitioned(
         self, fragment_root, workers, q: _Query, auto: bool = False
@@ -684,9 +981,10 @@ class CoordinatorServer:
                 stage.partition_rows, max(len(workers) * over, 1)
             )
             ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
+            pstage = self._new_stage(q, "producer")
 
             def make_spec(lo: int, hi: int) -> FragmentSpec:
-                return FragmentSpec(
+                return self._register_task(q, pstage, FragmentSpec(
                     task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
                     query_id=q.qid,
                     fragment=stage.worker_fragment,
@@ -701,7 +999,8 @@ class CoordinatorServer:
                     ),
                     n_partitions=nparts,
                     partition_keys=tuple(keys),
-                )
+                    traceparent=q.trace.traceparent(),
+                ))
 
             def wait_producer(w, spec):
                 with clock:
@@ -712,34 +1011,38 @@ class CoordinatorServer:
             # producer death fails the query: partitioned exchanges
             # are non-recoverable (same semantics as the shuffled
             # agg path; the replicated gather path keeps range retry)
-            return self._ranged_tasks(
+            res = self._ranged_tasks(
                 workers, ranges, make_spec, wait_producer, retry=False
             )
+            pstage.state = "FINISHED"
+            return res
 
         try:
             # both producer stages are independent: run concurrently
             # (sequential would cost sum, not max, of the side walls)
-            with ThreadPoolExecutor(2) as side_pool:
-                side_futs = [
-                    side_pool.submit(run_producers, stage, keys, group)
-                    for (stage, keys, group) in (
-                        (side_stages[0], J.left_keys, 0),
-                        (side_stages[1], J.right_keys, 1),
-                    )
-                ]
-                sources: List[tuple] = [
-                    s for f in side_futs for s in f.result()
-                ]
+            with q.trace.span("schedule", phase="join-producers"):
+                with ThreadPoolExecutor(2) as side_pool:
+                    side_futs = [
+                        side_pool.submit(run_producers, stage, keys, group)
+                        for (stage, keys, group) in (
+                            (side_stages[0], J.left_keys, 0),
+                            (side_stages[1], J.right_keys, 1),
+                        )
+                    ]
+                    sources: List[tuple] = [
+                        s for f in side_futs for s in f.result()
+                    ]
 
             join_frag = dataclasses.replace(
                 J,
                 left=N.RemoteSourceNode(fragment_root=J.left),
                 right=N.RemoteSourceNode(fragment_root=J.right),
             )
+            jstage = self._new_stage(q, "join")
 
             def run_join_task(i: int):
                 w = workers[i % len(workers)]
-                spec = FragmentSpec(
+                spec = self._register_task(q, jstage, FragmentSpec(
                     task_id=f"{q.qid}.join.{uuid.uuid4().hex[:8]}",
                     query_id=q.qid,
                     fragment=join_frag,
@@ -748,11 +1051,13 @@ class CoordinatorServer:
                     split_end=0,
                     sources=tuple(sources),
                     partition=i,
-                )
+                    traceparent=q.trace.traceparent(),
+                ))
                 with clock:
                     created.append((w, spec.task_id))
                 self._http_json(
-                    "POST", w.uri + "/v1/task", spec.to_json()
+                    "POST", w.uri + "/v1/task", spec.to_json(),
+                    traceparent=spec.traceparent,
                 )
                 return self._pull_task(w, spec)
 
@@ -761,14 +1066,10 @@ class CoordinatorServer:
                     pool.submit(run_join_task, i) for i in range(nparts)
                 ]
                 payloads = [p for f in futs for p in f.result()]
+            jstage.state = "FINISHED"
         finally:
             for w, tid in created:
-                try:
-                    self._http_json(
-                        "DELETE", f"{w.uri}/v1/task/{tid}", None
-                    )
-                except Exception:
-                    pass
+                self._finish_task(q, w, tid)
 
         schema = dict(join_frag.output_schema())
         if payloads:
@@ -801,9 +1102,11 @@ class CoordinatorServer:
         )
         ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
         nparts = len(workers)
+        prod_stage = self._new_stage(q, "producer")
+        merge_stage = self._new_stage(q, "merge")
 
         def make_spec(lo: int, hi: int) -> FragmentSpec:
-            return FragmentSpec(
+            return self._register_task(q, prod_stage, FragmentSpec(
                 task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
                 query_id=q.qid,
                 fragment=stage.worker_fragment,
@@ -818,7 +1121,8 @@ class CoordinatorServer:
                 ),
                 n_partitions=nparts,
                 partition_keys=tuple(key_names),
-            )
+                traceparent=q.trace.traceparent(),
+            ))
 
         from concurrent.futures import ThreadPoolExecutor
 
@@ -853,6 +1157,7 @@ class CoordinatorServer:
                         "PUT",
                         f"{w.uri}/v1/task/{spec.task_id}/sources",
                         body,
+                        traceparent=spec.traceparent,
                     )
                 except Exception:
                     pass
@@ -872,7 +1177,7 @@ class CoordinatorServer:
                 posted = False
                 for k in range(len(candidates)):
                     w = candidates[(i + k) % len(candidates)]
-                    spec = FragmentSpec(
+                    spec = self._register_task(q, merge_stage, FragmentSpec(
                         task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
                         query_id=q.qid,
                         fragment=bucket_root,
@@ -880,10 +1185,12 @@ class CoordinatorServer:
                         split_start=0,
                         split_end=0,
                         partition=i,
-                    )
+                        traceparent=q.trace.traceparent(),
+                    ))
                     try:
                         self._http_json(
-                            "POST", w.uri + "/v1/task", spec.to_json()
+                            "POST", w.uri + "/v1/task", spec.to_json(),
+                            traceparent=spec.traceparent,
                         )
                     except (
                         urllib.error.URLError, ConnectionError, OSError
@@ -897,9 +1204,10 @@ class CoordinatorServer:
                         "no live worker accepts merge tasks"
                     )
 
-            producers = self._ranged_tasks(
-                workers, ranges, make_spec, wait_producer, retry=False
-            )
+            with q.trace.span("schedule", stage_id=prod_stage.stage_id):
+                producers = self._ranged_tasks(
+                    workers, ranges, make_spec, wait_producer, retry=False
+                )
             sources = tuple((w.uri, tid) for w, tid in producers)
             # seal with the FULL list: add_sources dedups, so this
             # also repairs any announcement a merge task missed
@@ -909,7 +1217,7 @@ class CoordinatorServer:
                 # merge-worker death: re-run that partition's FINAL as
                 # a barrier-mode merge task (full source list known by
                 # now) on a live worker
-                spec = FragmentSpec(
+                spec = self._register_task(q, merge_stage, FragmentSpec(
                     task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
                     query_id=q.qid,
                     fragment=bucket_root,
@@ -918,21 +1226,18 @@ class CoordinatorServer:
                     split_end=0,
                     sources=sources,
                     partition=i,
-                )
+                    traceparent=q.trace.traceparent(),
+                ))
                 try:
                     self._http_json(
-                        "POST", w.uri + "/v1/task", spec.to_json()
+                        "POST", w.uri + "/v1/task", spec.to_json(),
+                        traceparent=spec.traceparent,
                     )
                     return self._pull_task(w, spec)
                 finally:
-                    try:
-                        self._http_json(
-                            "DELETE",
-                            f"{w.uri}/v1/task/{spec.task_id}",
-                            None,
-                        )
-                    except Exception:
-                        pass
+                    self._finish_task(
+                        q, w, spec.task_id, spec.traceparent
+                    )
 
             def run_merge(i: int):
                 w, spec = merge_specs[i]
@@ -951,26 +1256,22 @@ class CoordinatorServer:
                     REGISTRY.counter("coordinator.tasks_retried").update()
                     return run_merge_fallback(i, others[i % len(others)])
 
-            with ThreadPoolExecutor(nparts) as pool:
-                futs = [
-                    pool.submit(run_merge, i) for i in range(nparts)
-                ]
-                payloads = [p for f in futs for p in f.result()]
+            with q.trace.span("gather", stage_id=merge_stage.stage_id):
+                with ThreadPoolExecutor(nparts) as pool:
+                    futs = [
+                        pool.submit(run_merge, i) for i in range(nparts)
+                    ]
+                    payloads = [p for f in futs for p in f.result()]
         finally:
             for w, spec in merge_specs:
-                try:
-                    self._http_json(
-                        "DELETE", f"{w.uri}/v1/task/{spec.task_id}", None
-                    )
-                except Exception:
-                    pass
+                self._finish_task(q, w, spec.task_id, spec.traceparent)
             for w, tid in created:
-                try:
-                    self._http_json(
-                        "DELETE", f"{w.uri}/v1/task/{tid}", None
-                    )
-                except Exception:
-                    pass
+                self._finish_task(q, w, tid)
+            # success only: a propagating failure leaves the stages
+            # RUNNING for _finish_query_stats to close as FAILED
+            if sys.exc_info()[0] is None:
+                prod_stage.state = "FINISHED"
+                merge_stage.state = "FINISHED"
 
         schema = dict(bucket_root.output_schema())
         merged = pages_wire.merge_payloads(payloads, schema)
@@ -1012,7 +1313,8 @@ class CoordinatorServer:
             spec = make_spec(lo, hi)
             try:
                 self._http_json(
-                    "POST", w.uri + "/v1/task", spec.to_json()
+                    "POST", w.uri + "/v1/task", spec.to_json(),
+                    traceparent=spec.traceparent,
                 )
                 return consume(w, spec)
             except (urllib.error.URLError, ConnectionError, OSError):
@@ -1055,7 +1357,8 @@ class CoordinatorServer:
             if time.time() > deadline:
                 raise TimeoutError(f"task {spec.task_id} timed out")
             st = self._http_json(
-                "GET", f"{w.uri}/v1/task/{spec.task_id}/status", None
+                "GET", f"{w.uri}/v1/task/{spec.task_id}/status", None,
+                traceparent=spec.traceparent,
             )
             state = st.get("state")
             if state == "FINISHED":
@@ -1078,6 +1381,8 @@ class CoordinatorServer:
                 raise TimeoutError(f"task {spec.task_id} timed out")
             url = f"{w.uri}/v1/task/{spec.task_id}/results/0/{token}"
             req = urllib.request.Request(url)
+            if spec.traceparent:
+                req.add_header("traceparent", spec.traceparent)
             with urllib.request.urlopen(req, timeout=30) as resp:
                 complete = resp.headers.get("X-Complete") == "true"
                 nxt = int(resp.headers.get("X-Next-Token", token))
@@ -1103,13 +1408,16 @@ class CoordinatorServer:
 
     # ------------------------------------------------------------ helpers
 
-    def _http_json(self, method: str, url: str, body) -> dict:
+    def _http_json(
+        self, method: str, url: str, body, traceparent: str = ""
+    ) -> dict:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            # trace propagation on every coordinator->worker call
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
-            url,
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json"},
+            url, data=data, method=method, headers=headers
         )
         with urllib.request.urlopen(req, timeout=30) as resp:
             raw = resp.read()
@@ -1186,6 +1494,20 @@ def _make_handler(coord: CoordinatorServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if parts == ["v1", "query"]:
+                # query listing (reference: GET /v1/query)
+                with coord._lock:
+                    qs = list(coord.queries.values())
+                return self._json(
+                    200, [coord.query_summary(x) for x in qs]
+                )
+            if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                # full QueryInfo incl. stage/task stats + span tree
+                # (reference: GET /v1/query/{id}); works mid-flight
+                x = coord.queries.get(parts[2])
+                if x is None:
+                    return self._json(404, {"error": "no such query"})
+                return self._json(200, coord.query_info(x))
             if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
                 qid, token = parts[2], int(parts[3])
                 q = coord.queries.get(qid)
@@ -1194,6 +1516,7 @@ def _make_handler(coord: CoordinatorServer):
                 # long-poll up to 1s for progress (reference: long-poll)
                 q.done.wait(timeout=1.0)
                 if q.state == "FAILED":
+                    q._drained = True  # error delivered: safe to evict
                     return self._json(
                         200,
                         {
@@ -1225,6 +1548,8 @@ def _make_handler(coord: CoordinatorServer):
                     out["nextUri"] = (
                         f"{coord.uri}/v1/statement/{qid}/{token + 1}"
                     )
+                else:
+                    q._drained = True  # last page served
                 return self._json(200, out)
             self._json(404, {"error": f"no route {self.path}"})
 

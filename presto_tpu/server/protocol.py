@@ -163,6 +163,11 @@ class FragmentSpec:
     #: fed by the pulled pages instead of a table scan.
     sources: tuple = ()
     partition: int = 0
+    #: trace context (utils.tracing traceparent header value): the
+    #: coordinator stamps every task with the query's trace so
+    #: worker-side spans join the query's span tree; also sent as the
+    #: ``traceparent`` HTTP header on every coordinator->worker call
+    traceparent: str = ""
 
     def to_json(self) -> dict:
         return {
@@ -178,6 +183,7 @@ class FragmentSpec:
             "partition_keys": list(self.partition_keys),
             "sources": [list(s) for s in self.sources],
             "partition": self.partition,
+            "traceparent": self.traceparent,
         }
 
     @staticmethod
@@ -197,4 +203,5 @@ class FragmentSpec:
                 tuple(s) for s in d.get("sources", ())
             ),
             partition=d.get("partition", 0),
+            traceparent=d.get("traceparent", ""),
         )

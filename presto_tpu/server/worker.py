@@ -20,6 +20,7 @@ coordinator, and freed on DELETE.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 import traceback
@@ -29,10 +30,14 @@ from typing import Dict, List, Optional
 
 from presto_tpu.connectors.spi import ConnectorSplit
 from presto_tpu.exec.staging import stage_page
+from presto_tpu.exec.stats import TaskStats
 from presto_tpu.plan import nodes as N
 from presto_tpu.server import pages_wire
 from presto_tpu.server.protocol import FragmentSpec
+from presto_tpu.utils import tracing
 from presto_tpu.utils.metrics import REGISTRY
+
+log = logging.getLogger("presto_tpu.worker")
 
 #: rows per exchange page (the reference pages its exchange similarly)
 PAGE_ROWS = 1 << 16
@@ -54,13 +59,28 @@ def _offer_chunked(task: "_Task", cols, n: int) -> None:
             for name, d, v, t, dv in cols
         ]
         task.offer_page(pages_wire.serialize_page(chunk, hi - lo))
+        with task.cond:
+            task.stats.output_rows += hi - lo
 
 
 class _Task:
-    def __init__(self, spec: FragmentSpec, pool=None):
+    def __init__(self, spec: FragmentSpec, pool=None, node_id: str = ""):
         self.spec = spec
         self.state = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|ABORTED
         self.error: Optional[str] = None
+        #: per-task stats, shipped back in /v1/task/{id}/status
+        #: (reference: TaskStats on the task-status response)
+        self.stats = TaskStats(
+            task_id=spec.task_id,
+            query_id=spec.query_id,
+            node_id=node_id,
+            create_time=time.time(),
+        )
+        #: trace context propagated by the coordinator (the handler
+        #: folds the ``traceparent`` HTTP header into the spec)
+        self.trace_ctx = tracing.parse_traceparent(spec.traceparent)
+        #: synthesized span dicts, filled at task end (status payload)
+        self.spans: List[dict] = []
         # one output buffer per partition (reference:
         # PartitionedOutputBuffer); unpartitioned tasks use buffer 0
         nparts = max(spec.n_partitions, 1)
@@ -131,6 +151,7 @@ class _Task:
                 # (MemoryLimitExceeded -> task FAILED), not on OOM
                 self.pool.reserve(self.buf_key, len(page))
             self.parts[part].append(page)
+            self.stats.output_bytes += len(page)
 
     def ack_below(self, token: int, part: int = 0) -> None:
         """Consumer side: pulling token N acks pages < N.
@@ -258,7 +279,7 @@ class WorkerServer:
     def create_task(self, spec: FragmentSpec) -> str:
         if self._shutting_down:
             raise RuntimeError("worker is shutting down")
-        task = _Task(spec, pool=self.memory_pool)
+        task = _Task(spec, pool=self.memory_pool, node_id=self.node_id)
         with self._lock:
             self.tasks[spec.task_id] = task
         threading.Thread(
@@ -269,17 +290,55 @@ class WorkerServer:
 
     def _run_task(self, task: _Task) -> None:
         task.state = "RUNNING"
+        task.stats.state = "RUNNING"
+        trace_id = task.trace_ctx[0] if task.trace_ctx else ""
+        log.info(
+            "trace=%s task=%s node=%s state=RUNNING",
+            trace_id, task.spec.task_id, self.node_id,
+        )
+        t0 = time.perf_counter()
+        # this thread's engine-stats sink: the runner attributes
+        # staging time, input rows/bytes, compile-cache hits, and
+        # capacity-overflow retries to the active task
+        self.runner._qs_local.value = task.stats
+        outcome = "FINISHED"
         try:
             with REGISTRY.timer("worker.task_time").time():
                 self._execute(task)
-            task.state = "FINISHED"
         except Exception as e:  # report to coordinator via status
-            task.state = "FAILED"
+            outcome = "FAILED"
             task.error = (
                 f"{type(e).__name__}: {e}\n{traceback.format_exc()[-1000:]}"
             )
             REGISTRY.counter("worker.tasks_failed").update()
         finally:
+            self.runner._qs_local.value = None
+            task.stats.state = outcome
+            task.stats.end_time = time.time()
+            task.stats.wall_ms = (time.perf_counter() - t0) * 1000.0
+            if task.trace_ctx is not None:
+                task.spans = tracing.synthesize_task_spans(
+                    trace_id=task.trace_ctx[0],
+                    parent_span_id=task.trace_ctx[1],
+                    task_id=task.spec.task_id,
+                    node_id=self.node_id,
+                    start=task.stats.create_time,
+                    end=task.stats.end_time,
+                    staging_ms=task.stats.staging_ms,
+                    execute_ms=task.stats.execute_ms,
+                )
+            # publish the terminal state LAST: it flips X-Complete on
+            # the result stream, and the coordinator reads the final
+            # status (stats + spans above) as soon as it sees it
+            with task.cond:
+                if task.state != "ABORTED":
+                    task.state = outcome
+                task.cond.notify_all()
+            log.info(
+                "trace=%s task=%s node=%s state=%s wall_ms=%.1f",
+                trace_id, task.spec.task_id, self.node_id,
+                task.state, task.stats.wall_ms,
+            )
             # free this query's batch-staging reservations
             self.memory_pool.release(task.spec.query_id)
 
@@ -326,10 +385,14 @@ class WorkerServer:
         ] or [(spec.split_start, spec.split_end)]
 
         def run_batch(lo: int, hi: int):
+            # concurrent drivers run on pool threads: point each at the
+            # task's stats sink (thread-local on the runner)
+            self.runner._qs_local.value = task.stats
             pages = []
             staged_bytes = 0
             for s in scans:
                 if s is part_scan:
+                    t_stage = time.perf_counter()
                     payload = self._load_range(s, lo, hi)
                     # fixed capacity bucket: every full batch reuses one
                     # compiled program
@@ -339,15 +402,32 @@ class WorkerServer:
                         int(b.data.nbytes) for b in page.blocks
                     )
                     self.memory_pool.reserve(spec.query_id, staged_bytes)
+                    # task.cond guards the stats accumulators: with
+                    # task_concurrency > 1 concurrent drivers race the
+                    # read-modify-write (+=) and would drop updates
+                    with task.cond:
+                        task.stats.staging_ms += (
+                            time.perf_counter() - t_stage
+                        ) * 1000.0
+                        task.stats.input_rows += hi - lo
+                        task.stats.input_bytes += staged_bytes
+                    REGISTRY.distribution("worker.staging_bytes").add(
+                        staged_bytes
+                    )
                     pages.append(page)
                 else:
                     pages.append(repl_pages[id(s)])
+            t_exec = time.perf_counter()
             try:
                 out = self.runner._run_with_pages(root, scans, pages)
                 if pushed_ops:
                     out = apply_host_ops(out, pushed_ops)
                 return out
             finally:
+                with task.cond:
+                    task.stats.execute_ms += (
+                        time.perf_counter() - t_exec
+                    ) * 1000.0
                 self.memory_pool.release(spec.query_id, staged_bytes)
 
         def emit(out) -> None:
@@ -419,12 +499,16 @@ class WorkerServer:
             for src in pending:
                 uri, src_task = src[0], src[1]
                 group = int(src[2]) if len(src) > 2 else 0
-                by_group.setdefault(group, []).extend(
-                    _pull_partition(
-                        uri, src_task, spec.partition,
-                        self.runner.session,
-                    )
+                t_pull = time.perf_counter()
+                got = _pull_partition(
+                    uri, src_task, spec.partition,
+                    self.runner.session,
                 )
+                by_group.setdefault(group, []).extend(got)
+                task.stats.staging_ms += (
+                    time.perf_counter() - t_pull
+                ) * 1000.0
+                task.stats.input_rows += sum(p[2] for p in got)
                 pulled.add(tuple(src))
         root = spec.fragment
         remotes = [
@@ -459,9 +543,14 @@ class WorkerServer:
                 for b in pg.blocks
             )
             self.memory_pool.reserve(spec.query_id, staged)
+            task.stats.input_bytes += staged
+            t_exec = time.perf_counter()
             try:
                 out = self.runner._run_with_pages(root, remotes, pages)
             finally:
+                task.stats.execute_ms += (
+                    time.perf_counter() - t_exec
+                ) * 1000.0
                 self.memory_pool.release(spec.query_id, staged)
             cols, n = pages_wire.page_to_wire_columns(out)
             _offer_chunked(task, cols, n)
@@ -492,9 +581,14 @@ class WorkerServer:
             page = stage_page(merged, schema)
             staged = sum(int(b.data.nbytes) for b in page.blocks)
             self.memory_pool.reserve(spec.query_id, staged)
+            task.stats.input_bytes += staged
+            t_exec = time.perf_counter()
             try:
                 out = self.runner._run_with_pages(root, remotes, [page])
             finally:
+                task.stats.execute_ms += (
+                    time.perf_counter() - t_exec
+                ) * 1000.0
                 self.memory_pool.release(spec.query_id, staged)
         cols, n = pages_wire.page_to_wire_columns(out)
         _offer_chunked(task, cols, n)
@@ -538,6 +632,8 @@ def _emit_partitioned(task: "_Task", out) -> None:
         task.offer_page(
             pages_wire.serialize_page(cols, n), part=int(b)
         )
+        with task.cond:
+            task.stats.output_rows += n
 
 
 def _pull_partition(uri: str, src_task: str, part: int, session):
@@ -611,6 +707,8 @@ def _make_handler(worker: WorkerServer):
                         "state": t.state,
                         "error": t.error,
                         "num_pages": len(t.pages),
+                        "stats": t.stats.to_dict(),
+                        "spans": t.spans,
                     },
                 )
             if (
@@ -671,6 +769,13 @@ def _make_handler(worker: WorkerServer):
                     spec = FragmentSpec.from_json(
                         json.loads(self._read_body().decode())
                     )
+                    # honor the propagated trace context: a header on
+                    # the POST covers specs from span-unaware clients
+                    hdr = self.headers.get("traceparent", "")
+                    if hdr and not spec.traceparent:
+                        import dataclasses as _dc
+
+                        spec = _dc.replace(spec, traceparent=hdr)
                     tid = worker.create_task(spec)
                     return self._json(200, {"task_id": tid})
                 except Exception as e:

@@ -264,6 +264,8 @@ class NodeConfig:
         "query.max-memory-per-node": str,
         "exchange.max-buffer-size": str,
         "task.concurrency": int,
+        # query-completed JSONL sink (reference: event-listener.properties)
+        "event-listener.path": str,
     }
 
     def __init__(self, props: Optional[Dict[str, str]] = None):

@@ -4,17 +4,44 @@ Reference parity: airlift's ``@Managed`` JMX stats beans — CounterStat,
 TimeStat, DistributionStat — exported everywhere in presto and made
 SQL-able by the jmx connector (SURVEY.md §5.5). TPU equivalent: a plain
 registry exported as Prometheus text and as ``system.runtime.metrics``.
+
+Distributions keep a bounded reservoir (algorithm R) alongside the
+streaming moments, so ``snapshot()`` and the Prometheus rendering carry
+p50/p90/p99 estimates — the decaying-histogram quantiles of the
+reference's DistributionStat, minus the decay (documented
+simplification: a uniform all-time sample, not a sliding window).
 """
 
 from __future__ import annotations
 
+import itertools
 import math
+import random
+import re
 import threading
 import time
 from typing import Dict, List, Tuple
 
+#: bounded reservoir size per distribution (uniform sample; 1024 gives
+#: ~3% worst-case p99 error, a few KB per metric)
+RESERVOIR_SIZE = 1024
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: per-instance reservoir RNG seeds, in creation order
+_RESERVOIR_SEEDS = itertools.count(1)
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric name: [a-zA-Z_][a-zA-Z0-9_]*. The fixed
+    prefix keeps the first character legal whatever ``name`` is."""
+    return f"presto_tpu_{_NAME_SANITIZE.sub('_', name)}"
+
 
 class CounterStat:
+    #: Prometheus exposition type of this stat class
+    PROM_TYPE = "counter"
+
     def __init__(self):
         self._lock = threading.Lock()
         self.total = 0
@@ -26,10 +53,15 @@ class CounterStat:
     def values(self) -> Dict[str, float]:
         return {"total": float(self.total)}
 
+    def prometheus_lines(self, metric: str) -> List[str]:
+        return [f"{metric}_total {float(self.total)}"]
+
 
 class DistributionStat:
-    """Streaming count/sum/min/max/mean (reference keeps decaying
-    histograms; a round-1 simplification documented here)."""
+    """Streaming count/sum/min/max/mean + a bounded reservoir for
+    quantile estimates (p50/p90/p99)."""
+
+    PROM_TYPE = "summary"
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -37,6 +69,11 @@ class DistributionStat:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # creation-ordered seed: instances stay independent AND the
+        # sampling stream reproduces across runs of the same program
+        # (id(self) would differ per run)
+        self._rng = random.Random(0x5EED ^ next(_RESERVOIR_SEEDS))
+        self._reservoir: List[float] = []
 
     def add(self, v: float) -> None:
         with self._lock:
@@ -44,16 +81,49 @@ class DistributionStat:
             self.sum += v
             self.min = min(self.min, v)
             self.max = max(self.max, v)
+            # algorithm R: keep each of the n values with prob k/n
+            if len(self._reservoir) < RESERVOIR_SIZE:
+                self._reservoir.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < RESERVOIR_SIZE:
+                    self._reservoir[j] = v
+
+    def _quantiles(self) -> Dict[str, float]:
+        """p50/p90/p99 from the reservoir (nearest-rank); zeros when
+        empty so the field set is stable."""
+        if not self._reservoir:
+            return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+        s = sorted(self._reservoir)
+        n = len(s)
+        return {
+            "p50": s[min(n - 1, int(0.50 * n))],
+            "p90": s[min(n - 1, int(0.90 * n))],
+            "p99": s[min(n - 1, int(0.99 * n))],
+        }
 
     def values(self) -> Dict[str, float]:
-        mean = self.sum / self.count if self.count else 0.0
-        return {
-            "count": float(self.count),
-            "sum": self.sum,
-            "min": self.min if self.count else 0.0,
-            "max": self.max if self.count else 0.0,
-            "mean": mean,
-        }
+        with self._lock:
+            mean = self.sum / self.count if self.count else 0.0
+            out = {
+                "count": float(self.count),
+                "sum": self.sum,
+                "min": self.min if self.count else 0.0,
+                "max": self.max if self.count else 0.0,
+                "mean": mean,
+            }
+            out.update(self._quantiles())
+        return out
+
+    def prometheus_lines(self, metric: str) -> List[str]:
+        v = self.values()
+        return [
+            f'{metric}{{quantile="0.5"}} {v["p50"]}',
+            f'{metric}{{quantile="0.9"}} {v["p90"]}',
+            f'{metric}{{quantile="0.99"}} {v["p99"]}',
+            f"{metric}_sum {v['sum']}",
+            f"{metric}_count {v['count']}",
+        ]
 
 
 class TimeStat(DistributionStat):
@@ -78,6 +148,9 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        #: metric name -> sanitized Prometheus name, computed ONCE at
+        #: registration (the render path only joins strings)
+        self._prom_names: Dict[str, str] = {}
 
     def counter(self, name: str) -> CounterStat:
         return self._get(name, CounterStat)
@@ -94,6 +167,7 @@ class MetricsRegistry:
             if m is None:
                 m = cls()
                 self._metrics[name] = m
+                self._prom_names[name] = _sanitize(name)
             elif not isinstance(m, cls):
                 raise TypeError(f"metric {name} is {type(m).__name__}")
             return m
@@ -110,11 +184,24 @@ class MetricsRegistry:
         return sorted(out)
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition of every metric."""
-        lines = []
-        for name, _kind, v in self.snapshot():
-            metric = name.replace(".", "_").replace("-", "_")
-            lines.append(f"presto_tpu_{metric} {v}")
+        """Prometheus text exposition: one ``# HELP``/``# TYPE`` header
+        per metric family (counters as counters, distributions and
+        timers as summaries with quantile labels)."""
+        with self._lock:
+            items = [
+                (name, self._prom_names[name], m)
+                for name, m in self._metrics.items()
+            ]
+        lines: List[str] = []
+        for name, metric, m in sorted(items):
+            # classic text format: the family in HELP/TYPE must match
+            # the sample name, which for counters carries _total
+            fam = (
+                f"{metric}_total" if m.PROM_TYPE == "counter" else metric
+            )
+            lines.append(f"# HELP {fam} {name} ({type(m).__name__})")
+            lines.append(f"# TYPE {fam} {m.PROM_TYPE}")
+            lines.extend(m.prometheus_lines(metric))
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,492 @@
+"""Observability suite: query-lifecycle tracing, the distributed
+stats rollup (TaskStats -> StageStats -> QueryStats), the QueryInfo
+endpoint ``GET /v1/query/{id}``, /metrics exposition on both node
+roles, the query-event JSONL sink, and the metric-name lint.
+
+Reference parity: SURVEY.md §5.1 (QueryStats rollup + QueryInfo),
+§5.5 (metrics), and the EventListener SPI.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.server import CoordinatorServer, PrestoTpuClient, WorkerServer
+from presto_tpu.session import NodeConfig
+from presto_tpu.utils import tracing
+from presto_tpu.utils.metrics import (
+    CounterStat,
+    DistributionStat,
+    MetricsRegistry,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+@pytest.fixture(scope="module")
+def event_log(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("events") / "events.jsonl")
+
+
+@pytest.fixture(scope="module")
+def cluster(event_log):
+    coord = CoordinatorServer(
+        config=NodeConfig({"event-listener.path": event_log})
+    ).start()
+    workers = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    _wait_workers(coord, 2)
+    yield coord, workers
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    coord, _ = cluster
+    return PrestoTpuClient(coord.uri, timeout_s=600)
+
+
+@pytest.fixture(scope="module")
+def finished_query(client):
+    """One distributed query, executed once for the whole module."""
+    res = client.execute(
+        "select n_regionkey, count(*) c from tpch.tiny.nation "
+        "group by n_regionkey"
+    )
+    assert len(res.rows()) == 5
+    return res
+
+
+# ------------------------------------------------------ tracing primitives
+
+
+def test_traceparent_roundtrip():
+    tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+    header = tracing.format_traceparent(tid, sid)
+    assert tracing.parse_traceparent(header) == (tid, sid)
+    assert tracing.parse_traceparent(None) is None
+    assert tracing.parse_traceparent("junk") is None
+    assert tracing.parse_traceparent("00-short-short-01") is None
+
+
+def test_span_tree_nesting_and_cross_thread_parenting():
+    tr = tracing.Trace()
+    with tr.span("query") as root:
+        with tr.span("plan"):
+            pass
+
+        def other_thread():
+            with tr.span("schedule"):  # no stack here: parents to root
+                pass
+
+        t = threading.Thread(target=other_thread)
+        t.start()
+        t.join()
+    tree = tr.to_tree()
+    assert len(tree) == 1 and tree[0]["name"] == "query"
+    children = {c["name"] for c in tree[0]["children"]}
+    assert children == {"plan", "schedule"}
+    assert all(s.trace_id == tr.trace_id for s in tr.spans())
+    assert tr.traceparent().split("-")[1] == tr.trace_id
+    assert root.end > 0
+
+
+def test_trace_graft_rehomes_foreign_spans():
+    tr = tracing.Trace()
+    with tr.span("query"):
+        pass
+    foreign = tracing.synthesize_task_spans(
+        trace_id="f" * 32,
+        parent_span_id=tr.root.span_id,
+        task_id="t1",
+        node_id="w1",
+        start=time.time() - 1,
+        end=time.time(),
+        staging_ms=100.0,
+        execute_ms=200.0,
+    )
+    tr.graft(foreign)
+    tree = tr.to_tree()
+    task = [c for c in tree[0]["children"] if c["name"] == "task"]
+    assert len(task) == 1
+    assert {c["name"] for c in task[0]["children"]} == {
+        "staging", "execute",
+    }
+    assert all(s.trace_id == tr.trace_id for s in tr.spans())
+
+
+# -------------------------------------------------------- stats primitives
+
+
+def test_distribution_quantiles():
+    d = DistributionStat()
+    for v in range(1, 101):
+        d.add(float(v))
+    v = d.values()
+    assert v["count"] == 100.0
+    assert 45 <= v["p50"] <= 56
+    assert 85 <= v["p90"] <= 96
+    assert 95 <= v["p99"] <= 100
+    assert v["min"] == 1.0 and v["max"] == 100.0
+
+
+def test_distribution_reservoir_is_bounded():
+    from presto_tpu.utils.metrics import RESERVOIR_SIZE
+
+    d = DistributionStat()
+    for v in range(RESERVOIR_SIZE * 3):
+        d.add(float(v))
+    assert len(d._reservoir) == RESERVOIR_SIZE
+    assert d.count == RESERVOIR_SIZE * 3
+
+
+def test_stage_stats_rollup():
+    from presto_tpu.exec.stats import StageStats, TaskStats
+
+    st = StageStats(stage_id=0)
+    st.tasks.append(
+        TaskStats(
+            task_id="a", query_id="q", wall_ms=10.0,
+            input_rows=5, output_rows=2, retries=1,
+        )
+    )
+    st.tasks.append(
+        TaskStats(
+            task_id="b", query_id="q", wall_ms=30.0,
+            input_rows=7, output_rows=3, state="FAILED",
+        )
+    )
+    r = st.rollup()
+    assert r["tasks"] == 2
+    assert r["wall_ms"] == 30.0  # concurrent tasks: max, not sum
+    assert r["input_rows"] == 12
+    assert r["output_rows"] == 5
+    assert r["retries"] == 1
+    assert r["failed_tasks"] == 1
+    d = st.to_dict()
+    assert d["rollup"]["tasks"] == 2 and len(d["tasks"]) == 2
+
+
+def test_task_stats_dict_roundtrip():
+    from presto_tpu.exec.stats import TaskStats
+
+    t = TaskStats(
+        task_id="t", query_id="q", node_id="w", wall_ms=1.5,
+        input_rows=10,
+    )
+    d = t.to_dict()
+    d["unknown_future_field"] = 1  # forward-compat: ignored
+    t2 = TaskStats.from_dict(d)
+    assert t2 == t
+
+
+# -------------------------------------------------------- metrics registry
+
+
+def test_prometheus_exposition_has_type_and_help():
+    reg = MetricsRegistry()
+    reg.counter("obs.test-counter").update(3)
+    reg.distribution("obs.lat").add(1.0)
+    text = reg.render_prometheus()
+    assert "# TYPE presto_tpu_obs_test_counter_total counter" in text
+    assert "# HELP presto_tpu_obs_test_counter_total" in text
+    assert "presto_tpu_obs_test_counter_total 3.0" in text
+    assert "# TYPE presto_tpu_obs_lat summary" in text
+    assert 'presto_tpu_obs_lat{quantile="0.5"} 1.0' in text
+    assert "presto_tpu_obs_lat_count 1.0" in text
+
+
+def test_registry_concurrent_updates():
+    reg = MetricsRegistry()
+    n_threads, n_iter = 8, 500
+
+    def hammer(i):
+        for k in range(n_iter):
+            reg.counter("conc.counter").update()
+            reg.distribution("conc.dist").add(float(k))
+            with reg.timer("conc.time").time():
+                pass
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,))
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("conc.counter").total == n_threads * n_iter
+    assert reg.distribution("conc.dist").count == n_threads * n_iter
+    assert reg.timer("conc.time").count == n_threads * n_iter
+    # rendering under a fresh registration is still well-formed
+    assert "# TYPE presto_tpu_conc_counter_total counter" in (
+        reg.render_prometheus()
+    )
+
+
+def test_metric_name_lint_clean_on_repo():
+    import check_metric_names
+
+    assert check_metric_names.main([]) == 0
+
+
+def test_metric_name_lint_flags_conflicts(tmp_path):
+    import check_metric_names
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'REGISTRY.counter("dup.name").update()\n'
+        'REGISTRY.timer("dup.name").time()\n'
+    )
+    assert check_metric_names.main([str(tmp_path)]) == 1
+
+
+# --------------------------------------------------------- HTTP endpoints
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_metrics_endpoint_coordinator(cluster, finished_query):
+    coord, _ = cluster
+    status, text = _get(coord.uri + "/v1/metrics")
+    assert status == 200
+    assert "# TYPE presto_tpu_coordinator_query_time summary" in text
+    assert "# HELP presto_tpu_coordinator_query_time" in text
+    # compile-amortization + staging metrics recorded by the engine
+    # (worker.staging_bytes: the split-staging path every distributed
+    # scan takes; staging.bytes covers whole-table local loads)
+    assert "presto_tpu_compile_cache_miss_total" in text
+    assert "presto_tpu_worker_staging_bytes" in text
+
+
+def test_metrics_endpoint_worker(cluster, finished_query):
+    _, workers = cluster
+    status, text = _get(workers[0].uri + "/v1/metrics")
+    assert status == 200
+    assert "presto_tpu_worker_tasks_created_total" in text
+    assert "# TYPE presto_tpu_worker_task_time summary" in text
+
+
+def test_query_info_endpoint(client, finished_query):
+    info = client.query_info(finished_query.query_id)
+    assert info["state"] == "FINISHED"
+    assert info["query_id"] == finished_query.query_id
+    assert len(info["trace_id"]) == 32
+    # per-stage StageStats with task-level timings
+    assert info["stages"], "distributed query must produce stages"
+    stage = info["stages"][0]
+    assert stage["rollup"]["tasks"] >= 1
+    assert stage["rollup"]["input_rows"] == 25  # nation scanned in full
+    task = stage["tasks"][0]
+    assert task["state"] == "FINISHED"
+    assert task["wall_ms"] > 0
+    assert task["node_id"].startswith("worker-")
+    assert task["output_rows"] >= 1
+    # the span tree covers the lifecycle phases with ONE trace id
+    def walk(nodes):
+        for n in nodes:
+            yield n
+            yield from walk(n["children"])
+
+    spans = list(walk(info["trace"]))
+    names = {s["name"] for s in spans}
+    assert {"query", "plan", "schedule", "task", "gather"} <= names
+    assert {s["trace_id"] for s in spans} == {info["trace_id"]}
+    # worker-side task spans carry the originating node
+    task_spans = [s for s in spans if s["name"] == "task"]
+    assert all(
+        s["attrs"]["node_id"].startswith("worker-") for s in task_spans
+    )
+
+
+def test_query_listing_endpoint(client, finished_query):
+    listing = client.list_queries()
+    mine = [
+        s for s in listing if s["query_id"] == finished_query.query_id
+    ]
+    assert len(mine) == 1
+    assert mine[0]["state"] == "FINISHED"
+    assert mine[0]["trace_id"]
+
+
+def test_query_info_404(cluster):
+    coord, _ = cluster
+    req = urllib.request.Request(coord.uri + "/v1/query/nope")
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_query_history_eviction(monkeypatch):
+    """Completed queries age out of the coordinator's query map beyond
+    MAX_QUERY_HISTORY; running/queued ones are never evicted. Own
+    coordinator: eviction must not touch the shared cluster fixture."""
+    from presto_tpu.server import coordinator as coord_mod
+
+    monkeypatch.setattr(coord_mod, "MAX_QUERY_HISTORY", 2)
+    coord = CoordinatorServer()
+    try:
+        done_ids = []
+        for i in range(4):
+            q = coord_mod._Query(f"q_evict{i}", "select 1")
+            q.state = "FINISHED"
+            q._drained = True  # results fully served: evictable
+            q.done.set()
+            with coord._lock:
+                coord.queries[q.qid] = q
+            done_ids.append(q.qid)
+        undrained = coord_mod._Query("q_evict_undrained", "select 1")
+        undrained.state = "FINISHED"
+        undrained.stats.end_time = time.time()
+        undrained.done.set()  # done but client still paginating
+        running = coord_mod._Query("q_evict_run", "select 1")
+        running.state = "RUNNING"
+        with coord._lock:
+            coord.queries[undrained.qid] = undrained
+            coord.queries[running.qid] = running
+        q = coord.submit("set session tpu_offload = true")
+        assert q.done.wait(30)
+        with coord._lock:
+            kept = set(coord.queries)
+        assert "q_evict_run" in kept  # running: never evicted
+        # done-but-undrained inside the grace window: protected
+        assert "q_evict_undrained" in kept
+        # the oldest drained completed queries beyond the cap are gone
+        assert done_ids[0] not in kept and done_ids[1] not in kept
+    finally:
+        coord.shutdown()
+
+
+def test_system_runtime_tasks(client, finished_query):
+    rows = client.execute(
+        "select query_id, stage_id, task_id, node_id, state, wall_ms "
+        "from system.runtime.tasks where query_id = "
+        f"'{finished_query.query_id}'"
+    ).rows()
+    assert rows, "runtime.tasks must list the finished query's tasks"
+    assert all(r[4] == "FINISHED" for r in rows)
+    assert all(r[5] > 0 for r in rows)
+
+
+def test_system_runtime_queries_sees_distributed(client, finished_query):
+    rows = client.execute(
+        "select query_id, state, trace_id from system.runtime.queries "
+        f"where query_id = '{finished_query.query_id}'"
+    ).rows()
+    assert len(rows) == 1
+    assert rows[0][1] == "FINISHED"
+    assert len(rows[0][2]) == 32
+
+
+def test_distributed_explain_analyze(client):
+    res = client.execute(
+        "explain analyze select count(*) c from tpch.tiny.region"
+    )
+    text = "\n".join(r[0] for r in res.rows())
+    assert "Distributed EXPLAIN ANALYZE" in text
+    assert "Stage 0 [source]" in text
+    assert "Task " in text
+    assert "Span tree:" in text
+    assert "- schedule" in text
+    assert "trace " in text
+
+
+def test_query_event_jsonl_sink(client, event_log, finished_query):
+    client.execute("select count(*) c from tpch.tiny.region")
+    deadline = time.time() + 5
+    events = []
+    while time.time() < deadline:
+        if os.path.exists(event_log):
+            with open(event_log) as f:
+                events = [json.loads(line) for line in f]
+            if len(events) >= 2:
+                break
+        time.sleep(0.1)
+    assert events, "event sink must receive query_completed records"
+    ev = events[-1]
+    assert ev["event"] == "query_completed"
+    assert ev["state"] == "FINISHED"
+    assert len(ev["trace_id"]) == 32
+    assert "stages" in ev and "spans" in ev
+    span_names = {s["name"] for s in ev["spans"]}
+    assert "query" in span_names
+
+
+def test_worker_status_carries_task_stats(cluster):
+    """POST a task directly with a traceparent header: the status
+    response must carry TaskStats and trace-joined spans."""
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.connectors.spi import TableHandle
+    from presto_tpu.server.protocol import FragmentSpec
+
+    _, workers = cluster
+    w = workers[0]
+    handle = TableHandle("tpch", "tiny", "region")
+    schema = w.runner.catalogs.get("tpch").metadata().get_table_schema(
+        handle
+    )
+    scan = N.TableScanNode(
+        handle=handle,
+        columns=("r_regionkey",),
+        schema=(("r_regionkey", schema["r_regionkey"]),),
+    )
+    trace_id, span_id = tracing.new_trace_id(), tracing.new_span_id()
+    spec = FragmentSpec(
+        task_id="obs-test-task",
+        query_id="obs-test",
+        fragment=scan,
+        partition_scan=0,
+        split_start=0,
+        split_end=5,
+        traceparent=tracing.format_traceparent(trace_id, span_id),
+    )
+    body = json.dumps(spec.to_json()).encode()
+    req = urllib.request.Request(
+        w.uri + "/v1/task", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=30).read()
+    deadline = time.time() + 120  # generous: cold compile under load
+    st = {}
+    while time.time() < deadline:
+        _, raw = _get(w.uri + "/v1/task/obs-test-task/status")
+        st = json.loads(raw)
+        if st["state"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.05)
+    assert st["state"] == "FINISHED", st.get("error")
+    assert st["stats"]["input_rows"] == 5
+    assert st["stats"]["output_rows"] == 5
+    assert st["stats"]["wall_ms"] > 0
+    span_ids = {s["trace_id"] for s in st["spans"]}
+    assert span_ids == {trace_id}  # worker honored the propagated trace
+    parents = {s["parent_id"] for s in st["spans"]}
+    assert span_id in parents  # task span hangs off the coordinator span
+    req = urllib.request.Request(
+        w.uri + "/v1/task/obs-test-task", method="DELETE"
+    )
+    urllib.request.urlopen(req, timeout=30).read()

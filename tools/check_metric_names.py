@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Metric-name lint: every ``REGISTRY.<kind>("name")`` call site in the
+source must register each metric name with ONE kind — the registry
+raises TypeError at runtime on a conflict, but only on the code path
+that hits it; this lint fails the conflict at test time instead.
+
+Usage: ``python tools/check_metric_names.py [src_dir]`` — exits 0 when
+clean, 1 with a report when any name is registered under conflicting
+kinds (counter vs timer vs distribution).
+
+Wired into the test suite via tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from collections import defaultdict
+from typing import Dict, Set, Tuple
+
+#: start of a REGISTRY.counter( / .timer( / .distribution( call
+_CALL_START = re.compile(r"REGISTRY\.(counter|timer|distribution)\(")
+_STRING = re.compile(r"[\"']([^\"'\n]+)[\"']")
+
+#: timer IS a distribution (TimeStat subclasses DistributionStat), but
+#: the registry still type-checks exactly, so they conflict here too.
+
+
+def _call_names(src: str, open_paren: int):
+    """Every string literal inside the (balanced) call argument list
+    starting at ``open_paren`` — covers multi-line calls and
+    conditional-expression names like ``"a" if x else "b"``."""
+    depth = 0
+    for i in range(open_paren, len(src)):
+        if src[i] == "(":
+            depth += 1
+        elif src[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return [
+                    m.group(1)
+                    for m in _STRING.finditer(src[open_paren + 1: i])
+                ]
+    return []
+
+
+def scan(src_dir: str) -> Dict[str, Set[Tuple[str, str]]]:
+    """name -> {(kind, "file:line"), ...} over every .py under src_dir."""
+    sites: Dict[str, Set[Tuple[str, str]]] = defaultdict(set)
+    for root, _dirs, files in os.walk(src_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            for m in _CALL_START.finditer(src):
+                kind = m.group(1)
+                lineno = src.count("\n", 0, m.start()) + 1
+                for name in _call_names(src, m.end() - 1):
+                    sites[name].add((kind, f"{path}:{lineno}"))
+    return sites
+
+
+def find_conflicts(sites: Dict[str, Set[Tuple[str, str]]]):
+    out = []
+    for name, entries in sorted(sites.items()):
+        kinds = {k for k, _ in entries}
+        if len(kinds) > 1:
+            out.append((name, sorted(entries)))
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    src_dir = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "presto_tpu",
+    )
+    sites = scan(src_dir)
+    conflicts = find_conflicts(sites)
+    if not conflicts:
+        print(
+            f"check_metric_names: {len(sites)} metric name(s), "
+            "no kind conflicts"
+        )
+        return 0
+    for name, entries in conflicts:
+        print(f"CONFLICT: metric {name!r} registered as:")
+        for kind, where in entries:
+            print(f"  {kind:<12} at {where}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
