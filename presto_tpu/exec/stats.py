@@ -77,6 +77,9 @@ class TaskStats:
     compile_cache_hit: bool = True
     dynamic_filters: int = 0
     device_fragments: int = 0
+    #: this attempt was a speculative (backup) launch of a straggling
+    #: range — winners and losers both carry the flag in the rollup
+    speculative: bool = False
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

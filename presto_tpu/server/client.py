@@ -9,11 +9,10 @@ rows; surface server-side failures as exceptions.
 from __future__ import annotations
 
 import dataclasses
-import json
 import time
-import urllib.error
-import urllib.request
 from typing import List
+
+from presto_tpu.server import rpc
 
 
 class QueryFailed(RuntimeError):
@@ -40,10 +39,15 @@ class PrestoTpuClient:
         coordinator_uri: str,
         timeout_s: float = 120.0,
         user: str = "presto_tpu",
+        rpc_policy: rpc.RpcPolicy = rpc.DEFAULT_POLICY,
     ):
         self.uri = coordinator_uri.rstrip("/")
         self.timeout_s = timeout_s
         self.user = user  # sent as X-Presto-User (resource-group routing)
+        #: per-request policy: nextUri GETs are idempotent and retry
+        #: with backoff; the statement POST never retries (resubmitting
+        #: would start a second query)
+        self.rpc_policy = rpc_policy
 
     def execute(self, sql: str) -> ClientResult:
         first = self._post_json(
@@ -53,7 +57,7 @@ class PrestoTpuClient:
         columns: List[str] = []
         data: List[list] = []
         cur = first
-        deadline = time.time() + self.timeout_s
+        deadline = time.monotonic() + self.timeout_s
         while True:
             if "error" in cur:
                 raise QueryFailed(cur["error"])
@@ -63,7 +67,7 @@ class PrestoTpuClient:
             nxt = cur.get("nextUri")
             if not nxt:
                 return ClientResult(query_id=qid, columns=columns, data=data)
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"query {qid} did not finish in time")
             cur = self._get_json(nxt)
 
@@ -82,16 +86,14 @@ class PrestoTpuClient:
     # ------------------------------------------------------------ http
 
     def _post_json(self, url: str, body: bytes) -> dict:
-        req = urllib.request.Request(
-            url, data=body, method="POST",
+        return rpc.call(
+            "POST", url, body,
+            policy=self.rpc_policy,
             headers={
                 "Content-Type": "text/plain",
                 "X-Presto-User": self.user,
             },
-        )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return json.loads(resp.read())
+        ).json()
 
     def _get_json(self, url: str) -> dict:
-        with urllib.request.urlopen(url, timeout=30) as resp:
-            return json.loads(resp.read())
+        return rpc.call("GET", url, policy=self.rpc_policy).json()

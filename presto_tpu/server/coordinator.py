@@ -22,7 +22,6 @@ import threading
 import time
 import traceback
 import urllib.error
-import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -32,10 +31,11 @@ import numpy as np
 from presto_tpu.exec.staging import stage_page
 from presto_tpu.exec.stats import QueryStats, StageStats, TaskStats
 from presto_tpu.plan import nodes as N
-from presto_tpu.server import pages_wire
+from presto_tpu.server import pages_wire, rpc
 from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.scheduler import assign_ranges, plan_stage
-from presto_tpu.utils.metrics import REGISTRY
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY, DistributionStat
 from presto_tpu.utils.tracing import Trace
 
 log = logging.getLogger("presto_tpu.coordinator")
@@ -50,6 +50,11 @@ MAX_QUERY_HISTORY = 100
 #: a finished query whose client has NOT drained its results survives
 #: eviction this long past end_time
 DRAIN_GRACE_S = 900.0
+
+
+class NoLiveWorkers(RuntimeError):
+    """Every candidate worker is dead or circuit-open — the trigger
+    for coordinator-local fallback execution."""
 
 
 @dataclasses.dataclass
@@ -90,6 +95,11 @@ class _Query:
         #: the client consumed the last result page (or the error):
         #: history eviction must not drop a query mid-pagination
         self._drained = False
+        #: per-query task-retry budget (None until first use: the
+        #: session default is read lazily so SET SESSION applies)
+        self._retry_budget: Optional[int] = None
+        #: task ids of speculative (backup) attempts, for accounting
+        self._speculative: set = set()
 
     def fail(self, error: str) -> None:
         """Terminal rejection/kill close-out — one place for the
@@ -151,6 +161,22 @@ class CoordinatorServer:
             )
         self.workers: Dict[str, _WorkerNode] = {}
         self.queries: Dict[str, _Query] = {}
+        # fault-tolerance plane: one RPC policy for every
+        # coordinator->worker call, and per-worker circuit breakers
+        # (consecutive-failure scoring) folded into scheduling
+        self._rpc_policy = rpc.RpcPolicy.from_config(config)
+        self.breakers: Dict[str, rpc.CircuitBreaker] = {}
+        self._breaker_threshold = int(
+            config.get("failure-detector.threshold", 3) if config else 3
+        )
+        self._breaker_open_s = float(
+            config.get("failure-detector.open-s", 5.0) if config else 5.0
+        )
+        fault_spec = (
+            config.get("fault-injection.spec") if config else None
+        )
+        if fault_spec:
+            faults.configure(fault_spec)
         self._lock = threading.Lock()
         self._qid = itertools.count(1)
         self._shutting_down = False
@@ -235,7 +261,10 @@ class CoordinatorServer:
                 w.last_seen = time.time()
                 w.uri = uri
 
-    def active_workers(self) -> List[_WorkerNode]:
+    def _ttl_workers(self) -> List[_WorkerNode]:
+        """Workers announced within the discovery TTL (no breaker
+        filtering — callers that must not consume half-open probe
+        slots use this directly)."""
         now = time.time()
         with self._lock:
             return [
@@ -243,6 +272,80 @@ class CoordinatorServer:
                 for w in self.workers.values()
                 if now - w.last_seen <= NODE_TTL_S
             ]
+
+    def active_workers(self, exclude=()) -> List[_WorkerNode]:
+        """Schedulable workers: announced within the discovery TTL AND
+        not circuit-open (an OPEN breaker excludes the worker; after
+        its cool-off, ``allow()`` admits one half-open probe here).
+        ``exclude`` filters BEFORE the breaker check, so asking for a
+        spare never consumes an excluded worker's probe slot."""
+        return [
+            w
+            for w in self._ttl_workers()
+            if w.node_id not in exclude
+            and self._breaker(w.node_id).allow()
+        ]
+
+    # ------------------------------------------------- worker health
+
+    def _breaker(self, node_id: str) -> "rpc.CircuitBreaker":
+        with self._lock:
+            b = self.breakers.get(node_id)
+            if b is None:
+                b = rpc.CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    open_s=self._breaker_open_s,
+                )
+                self.breakers[node_id] = b
+            return b
+
+    def _worker_ok(self, w) -> None:
+        if self._breaker(w.node_id).record_success():
+            REGISTRY.counter("coordinator.circuit_closed").update()
+            log.info("circuit CLOSED for worker %s", w.node_id)
+
+    def _worker_failed(self, w) -> None:
+        REGISTRY.counter("coordinator.worker_failures").update()
+        if self._breaker(w.node_id).record_failure():
+            REGISTRY.counter("coordinator.circuit_opened").update()
+            log.warning("circuit OPEN for worker %s", w.node_id)
+
+    def _any_worker_alive(self) -> bool:
+        """Directly probe every TTL-fresh worker (``GET /v1/status``,
+        short timeout, no retries): the graceful-degradation gate must
+        distinguish 'the cluster is down' from 'one task hit a dead
+        socket before its breaker opened'. Iterates _ttl_workers, not
+        active_workers: a liveness sweep must not consume half-open
+        probe slots it may never resolve — each worker probed here
+        gets a real verdict recorded instead."""
+        probe = rpc.RpcPolicy(timeout_s=2.0, retries=0)
+        for w in self._ttl_workers():
+            try:
+                rpc.call_json(
+                    "GET", w.uri + "/v1/status", policy=probe
+                )
+                # the probe IS the verdict: a half-open slot consumed
+                # by active_workers() above must resolve, or the
+                # breaker stays wedged in HALF_OPEN
+                self._worker_ok(w)
+                return True
+            except Exception:
+                self._worker_failed(w)
+        return False
+
+    def _take_retry(self, q: _Query) -> bool:
+        """Consume one unit of the query's task-retry budget (the
+        generalization of the old retry-once: bounded per QUERY, not
+        per range)."""
+        with q._stats_lock:
+            if q._retry_budget is None:
+                q._retry_budget = int(
+                    self.local.session.get("task_retry_budget")
+                )
+            if q._retry_budget <= 0:
+                return False
+            q._retry_budget -= 1
+            return True
 
     def nodes(self) -> List[_WorkerNode]:
         """All nodes incl. self, for system.runtime.nodes."""
@@ -611,11 +714,17 @@ class CoordinatorServer:
         )
         ts.state = st.get("state", ts.state)
         terminal = ts.state in ("FINISHED", "FAILED", "ABORTED")
+        # a finished query's stats are closed: a straggling teardown
+        # thread (hung worker finally answering) must not fold a
+        # provisional RUNNING snapshot back into them
+        if not terminal and q.done.is_set():
+            return
         with q._stats_lock:
             if task_id in q._recorded:
                 return
             if terminal:
                 q._recorded.add(task_id)
+            ts.speculative = task_id in q._speculative
             stage = q._task_stage.get(task_id)
             if stage is not None:
                 ts.stage_id = stage.stage_id
@@ -626,30 +735,60 @@ class CoordinatorServer:
         q.trace.graft(st.get("spans"))
 
     def _finish_task(
-        self, q: _Query, w, task_id: str, traceparent: str = ""
+        self, q: _Query, w, task_id: str, traceparent: str = "",
+        presumed: str = "FAILED",
     ) -> None:
         """Collect a task's final stats, then DELETE it on the worker
         (the one task-teardown path: stats must be read BEFORE the
-        DELETE removes the task)."""
+        DELETE removes the task). ``presumed`` labels the attempt when
+        the worker can no longer answer the status GET: callers on a
+        success path (pages fully pulled) pass FINISHED — the rows ARE
+        in the result — while failure/abort paths keep FAILED, so
+        QueryInfo, system.runtime.tasks, and EXPLAIN ANALYZE account
+        for every scheduled attempt (speculated losers included)
+        without inventing phantom failures."""
         try:
-            st = self._http_json(
+            st = self._rpc_json(
                 "GET",
                 f"{w.uri}/v1/task/{task_id}/status",
-                None,
                 traceparent=traceparent,
             )
             self._record_task_status(q, task_id, st)
         except Exception:
-            pass  # a dead worker's stats are simply lost
+            # the worker is gone: synthesize the presumed terminal
+            # TaskStats for the lost attempt
+            self._record_task_status(
+                q,
+                task_id,
+                {
+                    "state": presumed,
+                    "stats": {
+                        "task_id": task_id,
+                        "query_id": q.qid,
+                        "node_id": w.node_id,
+                        "state": presumed,
+                    },
+                },
+            )
         try:
-            self._http_json(
+            self._rpc_json(
                 "DELETE",
                 f"{w.uri}/v1/task/{task_id}",
-                None,
                 traceparent=traceparent,
             )
         except Exception:
             pass
+
+    def _abort_task(self, q: _Query, w, spec: FragmentSpec) -> None:
+        """Tear a losing/failed attempt down OFF the calling thread:
+        the winner must not wait out status/DELETE timeouts against a
+        worker that may be hung (any still-open task state is closed
+        when the query finishes)."""
+        threading.Thread(
+            target=self._finish_task,
+            args=(q, w, spec.task_id, spec.traceparent),
+            daemon=True,
+        ).start()
 
     def query_info(self, q: _Query) -> dict:
         """Full QueryInfo (reference: ``GET /v1/query/{id}``): the
@@ -735,9 +874,16 @@ class CoordinatorServer:
                 stage.final_root, stage.worker_fragment
             )
             if bucket_root is not None:
-                return self._run_stage_shuffled(
-                    stage, workers, q, key_names, bucket_root, rest_root
-                )
+                try:
+                    return self._run_stage_shuffled(
+                        stage, workers, q, key_names, bucket_root,
+                        rest_root,
+                    )
+                except Exception as e:
+                    out = self._local_fallback(q, fragment_root, None, e)
+                    if out is None:
+                        raise
+                    return out
         # dynamic split placement (reference: SourcePartitionedScheduler
         # handing split batches to whichever task has capacity): cut the
         # scan into more ranges than workers and let each worker thread
@@ -778,23 +924,30 @@ class CoordinatorServer:
             except Exception:
                 # the failed attempt's stats/spans still fold into the
                 # rollup and its buffered pages get DELETEd — but OFF
-                # this thread: the recoverable-execution retry must
-                # not wait out status/DELETE timeouts against a worker
-                # that may be hung (any still-open task state is
-                # closed when the query finishes)
-                threading.Thread(
-                    target=self._finish_task,
-                    args=(q, w, spec.task_id, spec.traceparent),
-                    daemon=True,
-                ).start()
+                # this thread (see _abort_task)
+                self._abort_task(q, w, spec)
                 raise
-            self._finish_task(q, w, spec.task_id, spec.traceparent)
+            # success path: all pages pulled — if the worker dies
+            # before answering the status GET, the attempt still
+            # FINISHED (its rows are in the result)
+            self._finish_task(
+                q, w, spec.task_id, spec.traceparent,
+                presumed="FINISHED",
+            )
             return out
 
-        with q.trace.span("schedule", stage_id=stage_stats.stage_id):
-            results = self._ranged_tasks(
-                workers, ranges, make_spec, pull_and_delete
-            )
+        try:
+            with q.trace.span("schedule", stage_id=stage_stats.stage_id):
+                results = self._ranged_tasks(
+                    workers, ranges, make_spec, pull_and_delete,
+                    q=q, speculate=True,
+                )
+        except Exception as e:
+            out = self._local_fallback(q, fragment_root, order_by, e)
+            if out is None:
+                raise
+            stage_stats.state = "ABORTED"
+            return out
         stage_stats.state = "FINISHED"
         payloads = [p for out in results for p in out]
 
@@ -843,6 +996,32 @@ class CoordinatorServer:
             return self.local._run_with_pages(
                 stage.final_root, leaves, pages
             )
+
+    def _local_fallback(self, q: _Query, fragment_root, order_by, exc):
+        """Graceful degradation, last resort: when a distributed stage
+        died of connection-level failures and NO worker remains
+        alive/circuit-closed, execute the fragment on the coordinator's
+        local engine instead of failing the query. Returns None when
+        degradation does NOT apply — execution errors, or live workers
+        remaining — so the caller re-raises."""
+        degradable = rpc.is_retryable(exc) or isinstance(
+            exc, NoLiveWorkers
+        )
+        if not degradable or self._any_worker_alive():
+            return None
+        REGISTRY.counter("coordinator.local_fallbacks").update()
+        log.warning(
+            "query=%s: no live workers (%s: %s); falling back to "
+            "coordinator-local execution",
+            q.qid, type(exc).__name__, exc,
+        )
+        with q.trace.span("execute-local-fallback"):
+            out = self.local._run(fragment_root)
+            if order_by is not None:
+                from presto_tpu.exec.host_ops import apply_host_ops
+
+                out = apply_host_ops(out, [order_by])
+            return out
 
     def _run_join_partitioned(
         self, fragment_root, workers, q: _Query, auto: bool = False
@@ -1012,7 +1191,8 @@ class CoordinatorServer:
             # are non-recoverable (same semantics as the shuffled
             # agg path; the replicated gather path keeps range retry)
             res = self._ranged_tasks(
-                workers, ranges, make_spec, wait_producer, retry=False
+                workers, ranges, make_spec, wait_producer,
+                q=q, retry=False,
             )
             pstage.state = "FINISHED"
             return res
@@ -1055,7 +1235,7 @@ class CoordinatorServer:
                 ))
                 with clock:
                     created.append((w, spec.task_id))
-                self._http_json(
+                self._rpc_json(
                     "POST", w.uri + "/v1/task", spec.to_json(),
                     traceparent=spec.traceparent,
                 )
@@ -1153,7 +1333,7 @@ class CoordinatorServer:
             }
             for w, spec in merge_specs:
                 try:
-                    self._http_json(
+                    self._rpc_json(
                         "PUT",
                         f"{w.uri}/v1/task/{spec.task_id}/sources",
                         body,
@@ -1188,25 +1368,27 @@ class CoordinatorServer:
                         traceparent=q.trace.traceparent(),
                     ))
                     try:
-                        self._http_json(
+                        self._rpc_json(
                             "POST", w.uri + "/v1/task", spec.to_json(),
                             traceparent=spec.traceparent,
                         )
                     except (
                         urllib.error.URLError, ConnectionError, OSError
                     ):
+                        self._worker_failed(w)
                         continue
                     merge_specs.append((w, spec))
                     posted = True
                     break
                 if not posted:
-                    raise RuntimeError(
+                    raise NoLiveWorkers(
                         "no live worker accepts merge tasks"
                     )
 
             with q.trace.span("schedule", stage_id=prod_stage.stage_id):
                 producers = self._ranged_tasks(
-                    workers, ranges, make_spec, wait_producer, retry=False
+                    workers, ranges, make_spec, wait_producer,
+                    q=q, retry=False,
                 )
             sources = tuple((w.uri, tid) for w, tid in producers)
             # seal with the FULL list: add_sources dedups, so this
@@ -1229,7 +1411,7 @@ class CoordinatorServer:
                     traceparent=q.trace.traceparent(),
                 ))
                 try:
-                    self._http_json(
+                    self._rpc_json(
                         "POST", w.uri + "/v1/task", spec.to_json(),
                         traceparent=spec.traceparent,
                     )
@@ -1246,11 +1428,10 @@ class CoordinatorServer:
                 except (
                     urllib.error.URLError, ConnectionError, OSError
                 ):
-                    others = [
-                        a
-                        for a in self.active_workers()
-                        if a.node_id != w.node_id
-                    ]
+                    self._worker_failed(w)
+                    others = self.active_workers(
+                        exclude={w.node_id}
+                    )
                     if not others:
                         raise
                     REGISTRY.counter("coordinator.tasks_retried").update()
@@ -1295,40 +1476,230 @@ class CoordinatorServer:
             rest_root, rest_remote + local_scans, pages
         )
 
-    def _ranged_tasks(self, workers, ranges, make_spec, consume, retry=True):
+    def _ranged_tasks(
+        self, workers, ranges, make_spec, consume,
+        q: Optional[_Query] = None, retry=True, speculate=False,
+    ):
         """Dynamic split placement shared by the gather and shuffle
         paths: over-partitioned ranges in a queue, each worker's thread
-        pulls the next unclaimed range (work stealing by queue), a DEAD
-        worker's range is retried once on a live one (``retry=False``
-        disables that — the pipelined shuffle must NOT re-produce a
+        pulls the next unclaimed range (work stealing by queue).
+        ``consume(w, spec)`` runs after the task POST (pull pages, or
+        await FINISH); its results are collected in arbitrary order.
+
+        Fault tolerance (``retry=True``, the gather path): a DEAD
+        worker's range is re-POSTed to a live worker, bounded by the
+        query's ``task_retry_budget`` (generalizing the old
+        retry-once); every failure feeds the worker's circuit breaker,
+        and a range headed for a breaker-open worker re-routes without
+        consuming budget. ``speculate=True`` additionally launches ONE
+        backup attempt on another live worker when a range runs past
+        the straggler threshold — ``max(speculation_min_s,
+        speculation_multiplier x p50)`` of this stage's completed-range
+        durations (reservoir quantiles) — first result wins, the loser
+        is aborted and DELETEd. ``retry=False`` (shuffle producers)
+        disables both: the pipelined shuffle must NOT re-produce a
         range whose first task was already announced to merge tasks,
-        or its rows double-count). ``consume(w, spec)`` runs after the
-        task POST (pull pages, or await FINISH); its results are
-        collected in arbitrary order. Execution errors inside a healthy
-        worker are NOT retried — they would fail anywhere."""
+        or its rows double-count. Execution errors inside a healthy
+        worker are never retried — they would fail anywhere."""
         import queue as _queue
         from concurrent.futures import ThreadPoolExecutor
 
-        def run_range(w, lo, hi, retried=False):
-            spec = make_spec(lo, hi)
-            try:
-                self._http_json(
-                    "POST", w.uri + "/v1/task", spec.to_json(),
-                    traceparent=spec.traceparent,
-                )
-                return consume(w, spec)
-            except (urllib.error.URLError, ConnectionError, OSError):
-                if retried or not retry:
+        session = self.local.session
+        spec_on = (
+            speculate
+            and retry
+            and bool(session.get("speculation_enabled"))
+            and len(workers) > 1
+        )
+        spec_min = float(session.get("speculation_min_s"))
+        spec_mult = float(session.get("speculation_multiplier"))
+        # completed-range durations for THIS stage; the reservoir
+        # quantiles set the straggler threshold
+        durations = DistributionStat()
+
+        def straggler_threshold() -> Optional[float]:
+            v = durations.values()
+            if v["count"] < 3:
+                return None  # too few samples to call a straggler
+            return max(spec_min, spec_mult * v["p50"])
+
+        def spare_worker(tried_ids):
+            # exclude BEFORE the breaker check: asking for a spare
+            # must not consume an already-tried worker's probe slot
+            alive = self.active_workers(exclude=tried_ids)
+            return alive[0] if alive else None
+
+        def run_range(w, lo, hi):
+            if not retry:
+                # non-recoverable stage (shuffle producer): no retry,
+                # no speculation — run the single attempt inline
+                # instead of paying a monitor thread per range
+                spec = make_spec(lo, hi)
+                try:
+                    rpc.call_json(
+                        "POST", w.uri + "/v1/task", spec.to_json(),
+                        policy=self._rpc_policy,
+                        traceparent=spec.traceparent,
+                    )
+                    out = consume(w, spec)
+                    self._worker_ok(w)
+                    return out
+                except Exception as e:
+                    if rpc.is_retryable(e):
+                        self._worker_failed(w)
                     raise
-                alive = [
-                    a
-                    for a in self.active_workers()
-                    if a.node_id != w.node_id
-                ]
-                if not alive:
-                    raise
-                REGISTRY.counter("coordinator.tasks_retried").update()
-                return run_range(alive[0], lo, hi, retried=True)
+            cond = threading.Condition()
+            state = {
+                "attempts": [], "active": 0, "winner": None,
+                "result": None, "fatal": None, "conn_errors": [],
+            }
+
+            def attempt(worker, spec, backup):
+                try:
+                    rpc.call_json(
+                        "POST", worker.uri + "/v1/task", spec.to_json(),
+                        policy=self._rpc_policy,
+                        traceparent=spec.traceparent,
+                    )
+                    out = consume(worker, spec)
+                    self._worker_ok(worker)
+                    with cond:
+                        if state["winner"] is None:
+                            state["winner"] = spec.task_id
+                            state["result"] = out
+                            if backup:
+                                REGISTRY.counter(
+                                    "coordinator.speculation_wins"
+                                ).update()
+                except Exception as e:
+                    # a 404 on a task endpoint means the worker lost
+                    # the task (crash + restart under the same URI):
+                    # recoverable, like a dead socket. Other HTTP
+                    # errors (a FAILED task's 500) are execution
+                    # failures — they would fail anywhere.
+                    recoverable = rpc.is_retryable(e) or (
+                        isinstance(e, urllib.error.HTTPError)
+                        and e.code == 404
+                    )
+                    if recoverable:
+                        self._worker_failed(worker)
+                        with cond:
+                            state["conn_errors"].append(e)
+                    else:
+                        with cond:
+                            if state["fatal"] is None:
+                                state["fatal"] = e
+                finally:
+                    with cond:
+                        state["active"] -= 1
+                        cond.notify_all()
+
+            def launch(worker, backup=False):
+                # register synchronously: the monitor loop must never
+                # observe active == 0 for a launched-but-unstarted
+                # attempt
+                spec = make_spec(lo, hi)
+                if backup and q is not None:
+                    with q._stats_lock:
+                        q._speculative.add(spec.task_id)
+                with cond:
+                    state["attempts"].append((worker, spec))
+                    state["active"] += 1
+                threading.Thread(
+                    target=attempt, args=(worker, spec, backup),
+                    daemon=True,
+                ).start()
+
+            # a range headed for a breaker-OPEN worker re-routes for
+            # free (not a failure retry: the breaker already knows).
+            # peek(), not allow(): this worker was already admitted by
+            # active_workers() at scheduling — consuming a second
+            # half-open probe slot here would strand its own probe.
+            primary = w
+            if retry and self._breaker(w.node_id).peek() == "OPEN":
+                alt = spare_worker({w.node_id})
+                if alt is not None:
+                    primary = alt
+            launch(primary)
+            t0 = time.monotonic()
+            speculated = False
+            while True:
+                with cond:
+                    winner = state["winner"]
+                    fatal = state["fatal"]
+                    active = state["active"]
+                    last_err = (
+                        state["conn_errors"][-1]
+                        if state["conn_errors"]
+                        else None
+                    )
+                if winner is not None or fatal is not None:
+                    break
+                if active == 0:
+                    # every attempt died on a connection failure:
+                    # budget-bounded reassignment to a live worker
+                    tried = {
+                        wk.node_id for wk, _ in state["attempts"]
+                    }
+                    nxt = spare_worker(tried) if retry else None
+                    if nxt is None or q is None or not self._take_retry(q):
+                        raise last_err or NoLiveWorkers(
+                            "no live worker for range "
+                            f"[{lo}, {hi})"
+                        )
+                    REGISTRY.counter(
+                        "coordinator.tasks_retried"
+                    ).update()
+                    launch(nxt)
+                    continue
+                if spec_on and not speculated:
+                    th = straggler_threshold()
+                    if th is not None and time.monotonic() - t0 > th:
+                        tried = {
+                            wk.node_id for wk, _ in state["attempts"]
+                        }
+                        backup_w = spare_worker(tried)
+                        if backup_w is not None:
+                            speculated = True
+                            REGISTRY.counter(
+                                "coordinator.tasks_speculated"
+                            ).update()
+                            launch(backup_w, backup=True)
+                # wait for progress — re-checking the predicate under
+                # the lock first, so a completion that landed between
+                # the read above and this wait is never slept through.
+                # The periodic wakeup exists only for the straggler
+                # timer; without speculation armed, sleep until the
+                # attempt resolves (notify_all always fires).
+                with cond:
+                    if (
+                        state["winner"] is None
+                        and state["fatal"] is None
+                        and state["active"] > 0
+                    ):
+                        cond.wait(
+                            timeout=0.05
+                            if spec_on and not speculated
+                            else None
+                        )
+            if fatal is not None:
+                # execution failure: tear down every attempt of this
+                # range (an in-flight backup must not leak its task)
+                if q is not None:
+                    for wk, sp in state["attempts"]:
+                        self._abort_task(q, wk, sp)
+                raise fatal
+            # first result won: abort + DELETE the losing attempts
+            # (their stats fold in as provisional snapshots and are
+            # closed out with the query)
+            if q is not None:
+                for wk, sp in state["attempts"]:
+                    if sp.task_id != winner:
+                        self._abort_task(q, wk, sp)
+            dur = time.monotonic() - t0
+            durations.add(dur)
+            REGISTRY.distribution("coordinator.range_time_s").add(dur)
+            return state["result"]
 
         range_q: "_queue.Queue" = _queue.Queue()
         for r in ranges:
@@ -1349,15 +1720,17 @@ class CoordinatorServer:
 
     def _wait_task(self, w, spec) -> None:
         """Poll a producer task to completion (its pages stay buffered
-        for the merge stage; nothing is pulled here)."""
-        deadline = time.time() + float(
+        for the merge stage; nothing is pulled here). Monotonic-clock
+        deadline: a wall-clock jump can neither fire nor suppress the
+        task timeout."""
+        deadline = time.monotonic() + float(
             self.local.session.get("query_max_run_time_s")
         )
         while True:
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"task {spec.task_id} timed out")
-            st = self._http_json(
-                "GET", f"{w.uri}/v1/task/{spec.task_id}/status", None,
+            st = self._rpc_json(
+                "GET", f"{w.uri}/v1/task/{spec.task_id}/status",
                 traceparent=spec.traceparent,
             )
             state = st.get("state")
@@ -1370,58 +1743,56 @@ class CoordinatorServer:
             time.sleep(0.03)
 
     def _pull_task(self, w, spec) -> List[tuple]:
-        """Token-acked page pulls until X-Complete (exchange client)."""
-        token = 0
-        out = []
-        deadline = time.time() + float(
-            self.local.session.get("query_max_run_time_s")
-        )
-        while True:
-            if time.time() > deadline:
-                raise TimeoutError(f"task {spec.task_id} timed out")
-            url = f"{w.uri}/v1/task/{spec.task_id}/results/0/{token}"
-            req = urllib.request.Request(url)
-            if spec.traceparent:
-                req.add_header("traceparent", spec.traceparent)
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                complete = resp.headers.get("X-Complete") == "true"
-                nxt = int(resp.headers.get("X-Next-Token", token))
-                if resp.status == 200:
-                    out.append(pages_wire.deserialize_page(resp.read()))
-                if complete and nxt == token + (
-                    1 if resp.status == 200 else 0
-                ):
-                    return out
-                if nxt == token and resp.status != 200:
-                    # no page yet: check for failure, then poll again
-                    st = self._http_json(
-                        "GET",
-                        f"{w.uri}/v1/task/{spec.task_id}/status",
-                        None,
-                    )
-                    if st.get("state") == "FAILED":
-                        raise RuntimeError(
-                            f"task on {w.node_id} failed: {st.get('error')}"
-                        )
-                    time.sleep(0.05)
-                token = nxt
+        """Token-acked page pulls until X-Complete (exchange client):
+        the shared rpc.pull_pages loop, with a stall hook that polls
+        task status so a FAILED task surfaces its worker-side error
+        text. Monotonic-clock deadline (see _wait_task)."""
+
+        def stall():
+            st = self._rpc_json(
+                "GET", f"{w.uri}/v1/task/{spec.task_id}/status"
+            )
+            if st.get("state") == "FAILED":
+                raise RuntimeError(
+                    f"task on {w.node_id} failed: {st.get('error')}"
+                )
+            time.sleep(0.05)
+
+        try:
+            return rpc.pull_pages(
+                w.uri, spec.task_id, 0,
+                policy=self._rpc_policy,
+                deadline_s=float(
+                    self.local.session.get("query_max_run_time_s")
+                ),
+                traceparent=spec.traceparent,
+                stall=stall,
+                timeout_msg=f"task {spec.task_id} timed out",
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 500:
+                # the task FAILED: surface the worker's error text,
+                # not a bare HTTP status
+                st = self._rpc_json(
+                    "GET", f"{w.uri}/v1/task/{spec.task_id}/status"
+                )
+                raise RuntimeError(
+                    f"task on {w.node_id} failed: {st.get('error')}"
+                ) from e
+            raise
 
     # ------------------------------------------------------------ helpers
 
-    def _http_json(
-        self, method: str, url: str, body, traceparent: str = ""
+    def _rpc_json(
+        self, method: str, url: str, body=None, traceparent: str = ""
     ) -> dict:
-        data = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
-        if traceparent:
-            # trace propagation on every coordinator->worker call
-            headers["traceparent"] = traceparent
-        req = urllib.request.Request(
-            url, data=data, method=method, headers=headers
+        """Coordinator->worker JSON RPC under the coordinator's policy
+        (config-driven timeout, bounded backoff retries for idempotent
+        calls, trace propagation, fault-plane hooks)."""
+        return rpc.call_json(
+            method, url, body,
+            policy=self._rpc_policy, traceparent=traceparent,
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            raw = resp.read()
-        return json.loads(raw) if raw else {}
 
     def _store_result(self, q: _Query, res) -> None:
         q.columns = [

@@ -32,9 +32,9 @@ from presto_tpu.connectors.spi import ConnectorSplit
 from presto_tpu.exec.staging import stage_page
 from presto_tpu.exec.stats import TaskStats
 from presto_tpu.plan import nodes as N
-from presto_tpu.server import pages_wire
+from presto_tpu.server import pages_wire, rpc
 from presto_tpu.server.protocol import FragmentSpec
-from presto_tpu.utils import tracing
+from presto_tpu.utils import faults, tracing
 from presto_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger("presto_tpu.worker")
@@ -214,6 +214,20 @@ class WorkerServer:
         self._shutting_down = False
         self.coordinator_uri = coordinator_uri
         self._announcer: Optional[threading.Thread] = None
+        # fault-tolerance plane: one RPC policy for worker->worker
+        # shuffle pulls, config-driven announce cadence/timeout
+        self._rpc_policy = rpc.RpcPolicy.from_config(config)
+        self._announce_interval = float(
+            config.get("announcement.interval-s", 1.0) if config else 1.0
+        )
+        self._announce_timeout = float(
+            config.get("announcement.timeout-s", 5.0) if config else 5.0
+        )
+        fault_spec = (
+            config.get("fault-injection.spec") if config else None
+        )
+        if fault_spec:
+            faults.configure(fault_spec)
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -239,8 +253,8 @@ class WorkerServer:
         (reference: SHUTTING_DOWN protocol, SURVEY.md §5.3)."""
         self._shutting_down = True
         if graceful:
-            deadline = time.time() + 30
-            while time.time() < deadline:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
                 with self._lock:
                     busy = any(
                         t.state in ("QUEUED", "RUNNING")
@@ -255,24 +269,72 @@ class WorkerServer:
             self.httpd.shutdown()
         self.httpd.server_close()
 
-    def _announce_loop(self):
-        import urllib.request
+    #: announce backoff cap: a worker never goes quieter than this, so
+    #: a recovered coordinator re-discovers it within ~2 TTLs
+    ANNOUNCE_MAX_BACKOFF_S = 16.0
 
+    def _announce_backoff(self, fails: int) -> float:
+        """Delay before the next announcement: the healthy interval at
+        ``fails == 0``, else jittered exponential backoff over
+        [interval, min(interval * 2^fails, cap)] — never faster than
+        the healthy cadence, never synchronized across peers (full
+        jitter), never quieter than ANNOUNCE_MAX_BACKOFF_S."""
+        if fails <= 0:
+            return self._announce_interval
+        cap = min(
+            self._announce_interval * (2.0 ** min(fails, 6)),
+            self.ANNOUNCE_MAX_BACKOFF_S,
+        )
+        return self._announce_interval + rpc.backoff_rng().uniform(
+            0.0, max(cap - self._announce_interval, 0.0)
+        )
+
+    def _announce_loop(self):
+        """Heartbeat to discovery. A healthy loop announces every
+        ``announcement.interval-s``; after consecutive failures the
+        delay backs off exponentially (capped, resetting on success) —
+        a fleet of workers must not hammer a restarting coordinator in
+        lockstep (thundering herd)."""
+        fails = 0
         while not self._shutting_down:
             try:
-                body = json.dumps(
-                    {"node_id": self.node_id, "uri": self.uri}
-                ).encode()
-                req = urllib.request.Request(
+                # the loop IS the retry policy: no rpc-level retries,
+                # or backoff would stack on backoff
+                rpc.call_json(
+                    "PUT",
                     self.coordinator_uri + "/v1/announcement",
-                    data=body,
-                    method="PUT",
-                    headers={"Content-Type": "application/json"},
+                    {"node_id": self.node_id, "uri": self.uri},
+                    policy=rpc.RpcPolicy(
+                        timeout_s=self._announce_timeout, retries=0
+                    ),
                 )
-                urllib.request.urlopen(req, timeout=5).read()
+                fails = 0
             except Exception:
-                pass  # coordinator down: keep retrying (discovery TTL)
-            time.sleep(1.0)
+                fails += 1
+                REGISTRY.counter("worker.announce_failures").update()
+            delay = self._announce_backoff(fails)
+            # sleep in short slices so shutdown is prompt even when
+            # backed far off
+            deadline = time.monotonic() + delay
+            while (
+                not self._shutting_down
+                and time.monotonic() < deadline
+            ):
+                time.sleep(min(0.2, delay))
+
+    def _fault_kill(self) -> None:
+        """Abrupt crash for the fault plane's ``kill_worker`` action:
+        stop announcing and close the socket WITHOUT draining, so every
+        in-flight coordinator RPC sees a dead peer (connection refused)
+        — a real crash, not the graceful SHUTTING_DOWN protocol."""
+        self._shutting_down = True
+        try:
+            if self._serve_thread.is_alive():
+                self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+        log.warning("node=%s fault plane killed this worker", self.node_id)
 
     # ---------------------------------------------------------- task exec
 
@@ -351,6 +413,13 @@ class WorkerServer:
         residency to one batch (the grouped-execution memory shape).
         ``task_concurrency`` drivers overlap host staging with device
         execution."""
+        # chaos hook: an armed fault plane may delay this task, fail it
+        # (kill_task), or crash the whole worker (kill_worker) here —
+        # mid-execute from the coordinator's point of view, since the
+        # task POST was already acked
+        faults.maybe_inject_task(
+            self.node_id, task.spec.task_id, kill=self._fault_kill
+        )
         spec = task.spec
         if spec.sources or spec.partition_scan < 0:
             # merge task: static sources (barrier mode) or dynamically
@@ -477,7 +546,7 @@ class WorkerServer:
         # build); untagged sources are group 0.
         by_group: Dict[int, list] = {}
         pulled = set()
-        deadline = time.time() + float(
+        deadline = time.monotonic() + float(
             self.runner.session.get("query_max_run_time_s")
         )
         while True:
@@ -490,7 +559,7 @@ class WorkerServer:
                         break
                     if task.state == "ABORTED":
                         raise RuntimeError("merge task aborted")
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise TimeoutError(
                             "merge task timed out waiting for sources"
                         )
@@ -502,7 +571,7 @@ class WorkerServer:
                 t_pull = time.perf_counter()
                 got = _pull_partition(
                     uri, src_task, spec.partition,
-                    self.runner.session,
+                    self.runner.session, policy=self._rpc_policy,
                 )
                 by_group.setdefault(group, []).extend(got)
                 task.stats.staging_ms += (
@@ -636,33 +705,20 @@ def _emit_partitioned(task: "_Task", out) -> None:
             task.stats.output_rows += n
 
 
-def _pull_partition(uri: str, src_task: str, part: int, session):
-    """Token-acked pull of one output partition from a peer worker
-    (the exchange-client loop, worker side)."""
-    import urllib.request
-
-    token = 0
-    out = []
-    deadline = time.time() + float(session.get("query_max_run_time_s"))
-    while True:
-        if time.time() > deadline:
-            raise TimeoutError(
-                f"shuffle pull of {src_task}[{part}] timed out"
-            )
-        url = f"{uri}/v1/task/{src_task}/results/{part}/{token}"
-        req = urllib.request.Request(url)
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            complete = resp.headers.get("X-Complete") == "true"
-            nxt = int(resp.headers.get("X-Next-Token", token))
-            if resp.status == 200:
-                out.append(pages_wire.deserialize_page(resp.read()))
-            if complete and nxt == token + (
-                1 if resp.status == 200 else 0
-            ):
-                return out
-            if nxt == token and resp.status != 200:
-                time.sleep(0.02)
-            token = nxt
+def _pull_partition(
+    uri: str, src_task: str, part: int, session,
+    policy: rpc.RpcPolicy = rpc.DEFAULT_POLICY,
+):
+    """Token-acked pull of one output partition from a peer worker:
+    the shared rpc.pull_pages loop (exchange client, worker side).
+    Pulls are idempotent (token-acked), so transient peer failures
+    retry under the RPC policy."""
+    return rpc.pull_pages(
+        uri, src_task, part,
+        policy=policy,
+        deadline_s=float(session.get("query_max_run_time_s")),
+        timeout_msg=f"shuffle pull of {src_task}[{part}] timed out",
+    )
 
 
 def _make_handler(worker: WorkerServer):

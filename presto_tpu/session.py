@@ -206,6 +206,40 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             3600.0,
             _positive("query_max_run_time_s"),
         ),
+        PropertyMetadata(
+            "task_retry_budget",
+            "Max task reassignments per query after connection-level "
+            "worker failures (recoverable execution; generalizes the "
+            "old retry-once-per-range — 0 disables retry entirely)",
+            int,
+            16,
+            _non_negative("task_retry_budget"),
+        ),
+        PropertyMetadata(
+            "speculation_enabled",
+            "Straggler speculation on the gather path: re-launch a "
+            "range on a second live worker when its task runs past the "
+            "quantile-based threshold; first result wins, the loser is "
+            "aborted (reference: MapReduce backup tasks)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
+            "speculation_multiplier",
+            "Straggler threshold = max(speculation_min_s, multiplier x "
+            "p50 of this stage's completed-range durations)",
+            float,
+            4.0,
+            _positive("speculation_multiplier"),
+        ),
+        PropertyMetadata(
+            "speculation_min_s",
+            "Floor of the straggler threshold (seconds) — speculation "
+            "never fires on ranges faster than this",
+            float,
+            2.0,
+            _positive("speculation_min_s"),
+        ),
     ]
 }
 
@@ -266,6 +300,22 @@ class NodeConfig:
         "task.concurrency": int,
         # query-completed JSONL sink (reference: event-listener.properties)
         "event-listener.path": str,
+        # unified RPC plane (server.rpc): per-call timeout + bounded
+        # retries with exponential backoff + full jitter
+        "rpc.request-timeout-s": float,
+        "rpc.retries": int,
+        "rpc.backoff-base-s": float,
+        "rpc.backoff-max-s": float,
+        # worker->coordinator announce cadence (healthy interval; the
+        # failure backoff grows from it) and per-announce timeout
+        "announcement.interval-s": float,
+        "announcement.timeout-s": float,
+        # per-worker circuit breaker: consecutive connection failures
+        # to OPEN, and the OPEN cool-off before the half-open probe
+        "failure-detector.threshold": int,
+        "failure-detector.open-s": float,
+        # deterministic chaos: JSON FaultPlane spec (utils.faults)
+        "fault-injection.spec": str,
     }
 
     def __init__(self, props: Optional[Dict[str, str]] = None):

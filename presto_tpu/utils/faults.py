@@ -1,0 +1,211 @@
+"""Deterministic fault-injection plane (chaos hook for tests).
+
+Reference parity: production Presto proves its failure detector and
+recoverable execution under real node loss; this repo's tier-1 suite
+cannot kill processes, so the equivalent lever is a seedable in-process
+fault plane. Rules match RPCs (by method / URL substring) or worker
+task executions (by node / task id substring) and inject delays,
+connection-level errors, dropped connections, task kills, or whole-
+worker crashes — deterministically, so a chaos regression stays a
+regression test and not a flake.
+
+Disabled by default with zero hot-path cost: the hooks
+(:func:`maybe_inject_rpc`, :func:`maybe_inject_task`) read one module
+global and return immediately when no plane is configured. A plane is
+installed via :func:`configure` (tests, or the ``fault-injection.spec``
+node-config key) or the ``PRESTO_TPU_FAULTS`` environment variable
+(JSON, parsed at import).
+
+Rule spec (all match fields optional; empty matches everything)::
+
+    {"seed": 7,
+     "rules": [
+       {"action": "error",  "method": "GET", "url": ":8081", "count": 5},
+       {"action": "delay",  "url": "/results/", "delay_s": 2.0},
+       {"action": "drop",   "url": "/v1/task", "skip": 2, "count": 1},
+       {"action": "kill_task",   "node": "worker-ab"},
+       {"action": "kill_worker", "task": "q_c1."},
+     ]}
+
+``count`` bounds how many times a rule fires (default unlimited),
+``skip`` lets that many matches pass through first, and ``prob`` draws
+from the plane's seeded RNG. ``kill_worker`` additionally invokes the
+worker-supplied kill callback (abrupt socket close — a crash, not a
+drain) before raising.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+from typing import List, Optional
+
+from presto_tpu.utils.metrics import REGISTRY
+
+#: actions injected at the RPC hook (caller side of a call)
+RPC_ACTIONS = ("delay", "error", "drop")
+#: actions injected at the worker task-execute hook
+TASK_ACTIONS = ("delay", "kill_task", "kill_worker")
+
+
+class FaultInjectedError(ConnectionError):
+    """An injected connection-level failure. Subclasses
+    ``ConnectionError`` so retry/breaker classification treats it
+    exactly like a real dead socket."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One match->inject rule; firing state is guarded by the plane."""
+
+    action: str
+    method: str = ""  # exact HTTP method ("" = any)
+    url: str = ""  # URL substring ("" = any)
+    node: str = ""  # node-id substring (task hook)
+    task: str = ""  # task-id substring (task hook)
+    delay_s: float = 0.0
+    count: int = -1  # firings remaining (-1 = unlimited)
+    skip: int = 0  # matches to pass through before firing
+    prob: float = 1.0  # firing probability (plane-seeded RNG)
+
+    @staticmethod
+    def from_dict(d: dict) -> "FaultRule":
+        known = {f.name for f in dataclasses.fields(FaultRule)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown fault-rule keys: {sorted(unknown)}")
+        rule = FaultRule(**d)
+        if rule.action not in set(RPC_ACTIONS) | set(TASK_ACTIONS):
+            raise ValueError(f"unknown fault action: {rule.action!r}")
+        return rule
+
+
+class FaultPlane:
+    """A configured set of rules plus the seeded RNG that makes both
+    probabilistic firing and retry-backoff jitter reproducible."""
+
+    def __init__(self, spec):
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        self.seed = int(spec.get("seed", 0))
+        #: rule-probability stream. Kept SEPARATE from the backoff
+        #: stream so ``prob`` draws and retry jitter cannot perturb
+        #: each other's sequences. Determinism is per-stream: with
+        #: concurrent threads drawing, the interleaving (and so which
+        #: call gets which draw) still follows the scheduler — fully
+        #: deterministic chaos wants count/skip rules, not prob.
+        self.rng = random.Random(self.seed)
+        #: backoff-jitter stream (server.rpc draws from this while a
+        #: plane is active, making seeded single-threaded backoff
+        #: schedules reproducible)
+        self.backoff_rng = random.Random(self.seed ^ 0x5EEDBACC)
+        self.rules: List[FaultRule] = [
+            FaultRule.from_dict(dict(r)) for r in spec.get("rules", ())
+        ]
+        self._lock = threading.Lock()
+        self.injected = 0
+
+    def _fire(self, rule: FaultRule) -> bool:
+        """Skip/count/probability bookkeeping for one matched rule."""
+        with self._lock:
+            if rule.skip > 0:
+                rule.skip -= 1
+                return False
+            if rule.count == 0:
+                return False
+            if rule.prob < 1.0 and self.rng.random() >= rule.prob:
+                return False
+            if rule.count > 0:
+                rule.count -= 1
+            self.injected += 1
+        REGISTRY.counter("faults.injected").update()
+        return True
+
+    def on_rpc(self, method: str, url: str) -> None:
+        """RPC-site hook: may sleep (delay) or raise (error/drop)."""
+        for rule in self.rules:
+            if rule.action not in RPC_ACTIONS:
+                continue
+            if rule.node or rule.task:
+                continue  # a task-scoped rule stays task-scoped
+            if rule.method and rule.method != method:
+                continue
+            if rule.url and rule.url not in url:
+                continue
+            if not self._fire(rule):
+                continue
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.action == "error":
+                raise FaultInjectedError(
+                    f"injected RPC error: {method} {url}"
+                )
+            else:  # drop
+                raise FaultInjectedError(
+                    f"injected connection drop: {method} {url}"
+                )
+
+    def on_task(self, node_id: str, task_id: str, kill=None) -> None:
+        """Worker task-execute hook: may sleep, fail the task
+        (``kill_task``), or crash the whole worker (``kill_worker`` —
+        invokes ``kill`` to close the socket abruptly, then raises)."""
+        for rule in self.rules:
+            if rule.action not in TASK_ACTIONS:
+                continue
+            if rule.method or rule.url:
+                continue  # an RPC-scoped delay rule stays RPC-scoped
+            if rule.node and rule.node not in node_id:
+                continue
+            if rule.task and rule.task not in task_id:
+                continue
+            if not self._fire(rule):
+                continue
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.action == "kill_task":
+                raise FaultInjectedError(
+                    f"injected task kill: {task_id} on {node_id}"
+                )
+            else:  # kill_worker: crash, not drain
+                if kill is not None:
+                    kill()
+                raise FaultInjectedError(
+                    f"injected worker kill: {node_id} (task {task_id})"
+                )
+
+
+#: the active plane; None = disabled (the default, and the hot path)
+_PLANE: Optional[FaultPlane] = None
+
+
+def configure(spec) -> Optional[FaultPlane]:
+    """Install a fault plane from a spec dict / JSON string, or clear
+    it with a falsy spec. Returns the installed plane (or None)."""
+    global _PLANE
+    _PLANE = FaultPlane(spec) if spec else None
+    return _PLANE
+
+
+def active() -> Optional[FaultPlane]:
+    return _PLANE
+
+
+def maybe_inject_rpc(method: str, url: str) -> None:
+    plane = _PLANE
+    if plane is not None:
+        plane.on_rpc(method, url)
+
+
+def maybe_inject_task(node_id: str, task_id: str, kill=None) -> None:
+    plane = _PLANE
+    if plane is not None:
+        plane.on_task(node_id, task_id, kill=kill)
+
+
+_env_spec = os.environ.get("PRESTO_TPU_FAULTS")
+if _env_spec:
+    configure(_env_spec)

@@ -1,0 +1,469 @@
+"""Fault-tolerance chaos suite: deterministic fault injection
+(utils.faults), the unified RPC retry/backoff plane (server.rpc),
+per-worker circuit breaking, straggler speculation, task-retry
+budgets, announce backoff, and coordinator-local graceful degradation.
+
+Reference parity: node failure detection + recoverable execution as
+coordinator duties (SURVEY.md §5.3; Sethi et al. ICDE 2019) and
+speculative backup tasks (Dean & Ghemawat, OSDI 2004) — proven here
+under injected chaos, forever, in tier-1.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from presto_tpu.server import CoordinatorServer, PrestoTpuClient, WorkerServer
+from presto_tpu.server import rpc
+from presto_tpu.server.client import QueryFailed
+from presto_tpu.session import NodeConfig
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY
+from presto_tpu.verifier import SqliteOracle, verify_query
+
+from tpch_queries import QUERIES
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    """Every test leaves the process chaos-free."""
+    yield
+    faults.configure(None)
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """Healthy 2-worker cluster for the non-destructive tests."""
+    coord = CoordinatorServer().start()
+    workers = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    _wait_workers(coord, 2)
+    yield coord, workers
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return SqliteOracle("tiny")
+
+
+# -------------------------------------------------- fault plane (unit)
+
+
+def test_fault_plane_disabled_by_default():
+    assert faults.active() is None
+    # hooks are no-ops without a plane (the zero-cost hot path)
+    faults.maybe_inject_rpc("GET", "http://x/v1/status")
+    faults.maybe_inject_task("node", "task")
+
+
+def test_fault_rule_validation():
+    with pytest.raises(ValueError):
+        faults.configure({"rules": [{"action": "explode"}]})
+    faults.configure(None)
+    with pytest.raises(ValueError):
+        faults.configure({"rules": [{"action": "error", "nope": 1}]})
+
+
+def test_fault_rule_skip_count_and_match():
+    plane = faults.configure(
+        {
+            "seed": 1,
+            "rules": [
+                {
+                    "action": "error",
+                    "method": "GET",
+                    "url": "/v1/task",
+                    "skip": 1,
+                    "count": 2,
+                }
+            ],
+        }
+    )
+    # wrong method / url: never fires
+    plane.on_rpc("POST", "http://h/v1/task")
+    plane.on_rpc("GET", "http://h/v1/status")
+    # first match skipped, next two fire, then exhausted
+    plane.on_rpc("GET", "http://h/v1/task/t/results/0/0")
+    for _ in range(2):
+        with pytest.raises(faults.FaultInjectedError):
+            plane.on_rpc("GET", "http://h/v1/task/t/results/0/0")
+    plane.on_rpc("GET", "http://h/v1/task/t/results/0/0")
+    assert plane.injected == 2
+
+
+# ------------------------------------------------ backoff determinism
+
+
+def test_backoff_full_jitter_bounds():
+    pol = rpc.RpcPolicy(backoff_base_s=0.1, backoff_max_s=1.0)
+    for attempt in range(8):
+        d = rpc.compute_backoff(attempt, pol)
+        assert 0.0 <= d <= min(1.0, 0.1 * 2 ** attempt)
+
+
+def test_backoff_deterministic_under_seeded_plane():
+    pol = rpc.RpcPolicy(backoff_base_s=0.1, backoff_max_s=1.0)
+    faults.configure({"seed": 42, "rules": []})
+    a = [rpc.compute_backoff(i, pol) for i in range(6)]
+    faults.configure({"seed": 42, "rules": []})
+    b = [rpc.compute_backoff(i, pol) for i in range(6)]
+    assert a == b
+    assert len(set(a)) > 1  # jitter actually jitters
+
+
+def test_announce_backoff_schedule():
+    w = WorkerServer(coordinator_uri="http://127.0.0.1:9")
+    try:
+        w._announce_interval = 0.5
+        assert w._announce_backoff(0) == 0.5
+        faults.configure({"seed": 11, "rules": []})
+        a = [w._announce_backoff(i) for i in range(1, 9)]
+        faults.configure({"seed": 11, "rules": []})
+        assert a == [w._announce_backoff(i) for i in range(1, 9)]
+        for i, d in enumerate(a, 1):
+            cap = min(
+                0.5 * 2 ** min(i, 6), WorkerServer.ANNOUNCE_MAX_BACKOFF_S
+            )
+            assert 0.5 <= d <= cap + 1e-9
+    finally:
+        w.httpd.server_close()
+
+
+def test_announce_failures_counted_with_backoff():
+    """A worker facing a dead coordinator keeps retrying, counts each
+    failure, and backs off instead of hammering at the fixed cadence
+    (seeded plane makes the delay sequence deterministic)."""
+    faults.configure({"seed": 5, "rules": []})
+    before = REGISTRY.counter("worker.announce_failures").total
+    w = WorkerServer(
+        coordinator_uri="http://127.0.0.1:9",
+        config=NodeConfig(
+            {
+                "announcement.interval-s": "0.05",
+                "announcement.timeout-s": "0.2",
+            }
+        ),
+    )
+    w.start()
+    try:
+        time.sleep(1.2)
+    finally:
+        w.shutdown(graceful=False)
+    n = REGISTRY.counter("worker.announce_failures").total - before
+    assert n >= 2  # it kept retrying
+    assert n <= 15  # but backed off (fixed 0.05 s cadence would be ~24)
+
+
+# ---------------------------------------------- circuit breaker (unit)
+
+
+def test_circuit_breaker_cycle():
+    b = rpc.CircuitBreaker(threshold=2, open_s=0.05)
+    assert b.allow() and b.peek() == "CLOSED"
+    b.record_failure()
+    assert b.allow()  # below threshold
+    assert b.record_failure()  # OPENs
+    assert b.peek() == "OPEN" and not b.allow()
+    time.sleep(0.06)
+    assert b.allow()  # the half-open probe
+    assert b.peek() == "HALF_OPEN"
+    assert not b.allow()  # only ONE probe in flight
+    b.record_success()
+    assert b.peek() == "CLOSED" and b.allow()
+    assert b.transitions == ["OPEN", "HALF_OPEN", "CLOSED"]
+
+
+def test_circuit_breaker_probe_failure_reopens():
+    b = rpc.CircuitBreaker(threshold=1, open_s=0.05)
+    b.record_failure()
+    assert b.peek() == "OPEN"
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_failure()  # probe failed
+    assert b.peek() == "OPEN"
+    assert b.transitions == ["OPEN", "HALF_OPEN", "OPEN"]
+
+
+def test_circuit_breaker_success_resets_consecutive_count():
+    b = rpc.CircuitBreaker(threshold=2, open_s=1.0)
+    for _ in range(5):
+        b.record_failure()
+        b.record_success()
+    assert b.peek() == "CLOSED"  # never opened: failures not consecutive
+
+
+# ----------------------------------------------------- rpc-level retry
+
+
+def test_rpc_retries_heal_error_burst(cluster):
+    """Connection-level failures on idempotent calls retry with
+    backoff and heal once the burst passes."""
+    coord, _ = cluster
+    faults.configure(
+        {
+            "seed": 3,
+            "rules": [
+                {"action": "error", "url": "/v1/cluster", "count": 2}
+            ],
+        }
+    )
+    before = REGISTRY.counter("rpc.retries").total
+    out = rpc.call_json(
+        "GET",
+        coord.uri + "/v1/cluster",
+        policy=rpc.RpcPolicy(
+            retries=3, backoff_base_s=0.005, backoff_max_s=0.01
+        ),
+    )
+    assert "workers" in out
+    assert REGISTRY.counter("rpc.retries").total - before == 2
+
+
+def test_rpc_post_never_retries(cluster):
+    coord, _ = cluster
+    faults.configure(
+        {
+            "seed": 3,
+            "rules": [
+                {"action": "drop", "url": "/v1/statement", "count": 1}
+            ],
+        }
+    )
+    with pytest.raises(faults.FaultInjectedError):
+        rpc.call_json(
+            "POST",
+            coord.uri + "/v1/statement",
+            policy=rpc.RpcPolicy(retries=5),
+        )
+
+
+# ------------------------------------------------------- chaos: kills
+
+
+def test_chaos_kill_and_burst_with_breaker_cycle(oracle):
+    """The acceptance chaos regression: one worker killed mid-execute
+    plus an RPC error burst against a second worker; the TPC-H gather
+    query still answers correctly, the failed attempts' TaskStats are
+    visible in QueryInfo next to the successful retries, and the
+    bursted worker's breaker walks OPEN -> HALF_OPEN -> CLOSED."""
+    cfg = NodeConfig(
+        {
+            "rpc.retries": "1",
+            "rpc.backoff-base-s": "0.01",
+            "rpc.backoff-max-s": "0.05",
+            "failure-detector.threshold": "2",
+            "failure-detector.open-s": "0.3",
+        }
+    )
+    coord = CoordinatorServer(config=cfg).start()
+    ws = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(3)
+    ]
+    w_kill, w_burst = ws[1], ws[2]
+    try:
+        _wait_workers(coord, 3)
+        faults.configure(
+            {
+                "seed": 7,
+                "rules": [
+                    {
+                        "action": "kill_worker",
+                        "node": w_kill.node_id,
+                        "count": 1,
+                    },
+                    {
+                        "action": "error",
+                        "url": f":{w_burst.port}/",
+                        "count": 8,
+                    },
+                ],
+            }
+        )
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        before = REGISTRY.counter("coordinator.tasks_retried").total
+        diff = verify_query(client, oracle, QUERIES[6], rel_tol=1e-6)
+        assert diff is None, f"chaos Q6 mismatch: {diff}"
+        assert (
+            REGISTRY.counter("coordinator.tasks_retried").total > before
+        )
+        # every scheduled attempt is accounted for: the kills/bursts
+        # surface as FAILED TaskStats beside the successful retries
+        qid = client.list_queries()[-1]["query_id"]
+        info = client.query_info(qid)
+        states = [
+            t["state"] for st in info["stages"] for t in st["tasks"]
+        ]
+        breaker = coord.breakers[w_burst.node_id]
+        # the burst OPENed the circuit (it may already have walked on
+        # to HALF_OPEN — or even CLOSED, if the burst exhausted and a
+        # probe succeeded while query 1 was still running)
+        assert breaker.transitions[0] == "OPEN"
+        # burst over: the half-open probe must re-admit the worker
+        faults.configure(None)
+        time.sleep(0.35)
+        deadline = time.monotonic() + 20
+        while (
+            breaker.peek() != "CLOSED"
+            and time.monotonic() < deadline
+        ):
+            client.execute("select count(*) c from tpch.tiny.nation")
+            time.sleep(0.05)
+        # the recorded cycle ends OPEN -> ... -> HALF_OPEN -> CLOSED
+        assert breaker.transitions[-2:] == ["HALF_OPEN", "CLOSED"]
+    finally:
+        faults.configure(None)
+        for w in ws:
+            w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+def test_kill_task_is_an_execution_error_not_retried():
+    """A task that FAILS on a healthy worker is an execution error:
+    it would fail anywhere, so the query fails instead of retrying."""
+    coord = CoordinatorServer().start()
+    w = WorkerServer(coordinator_uri=coord.uri).start()
+    try:
+        _wait_workers(coord, 1)
+        faults.configure(
+            {"rules": [{"action": "kill_task", "count": 1}]}
+        )
+        before = REGISTRY.counter("coordinator.tasks_retried").total
+        client = PrestoTpuClient(coord.uri, timeout_s=60)
+        with pytest.raises(QueryFailed):
+            client.execute("select count(*) c from tpch.tiny.lineitem")
+        assert (
+            REGISTRY.counter("coordinator.tasks_retried").total == before
+        )
+    finally:
+        faults.configure(None)
+        w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+def test_retry_budget_exhaustion_fails_query():
+    """task_retry_budget=0 disables reassignment: a killed worker
+    fails the query even though a live spare exists (and local
+    fallback must NOT mask it — workers are alive)."""
+    coord = CoordinatorServer().start()
+    ws = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    try:
+        _wait_workers(coord, 2)
+        coord.local.session.set("task_retry_budget", 0)
+        faults.configure(
+            {
+                "rules": [
+                    {
+                        "action": "kill_worker",
+                        "node": ws[1].node_id,
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        client = PrestoTpuClient(coord.uri, timeout_s=60)
+        with pytest.raises(QueryFailed):
+            client.execute("select count(*) c from tpch.tiny.lineitem")
+    finally:
+        coord.local.session.reset("task_retry_budget")
+        faults.configure(None)
+        for w in ws:
+            w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+# ------------------------------------------------- straggler speculation
+
+
+def test_speculation_winner_loser_accounting(oracle):
+    """A range whose pull stalls past the quantile threshold gets a
+    backup attempt on another worker; the first result wins, the
+    duplicate is aborted, and the backup is flagged speculative in the
+    QueryInfo rollup."""
+    coord = CoordinatorServer().start()
+    ws = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    try:
+        _wait_workers(coord, 2)
+        coord.local.session.set("speculation_min_s", 0.3)
+        coord.local.session.set("speculation_multiplier", 2.0)
+        faults.configure(
+            {
+                "seed": 3,
+                "rules": [
+                    {
+                        "action": "delay",
+                        "method": "GET",
+                        "url": f":{ws[1].port}/v1/task",
+                        "delay_s": 3.0,
+                        "count": 1,
+                    }
+                ],
+            }
+        )
+        b_spec = REGISTRY.counter("coordinator.tasks_speculated").total
+        b_wins = REGISTRY.counter("coordinator.speculation_wins").total
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        res = client.execute(
+            "select count(*) as c from tpch.tiny.lineitem"
+        )
+        assert res.rows() == [(59997,)]
+        assert (
+            REGISTRY.counter("coordinator.tasks_speculated").total
+            > b_spec
+        )
+        assert (
+            REGISTRY.counter("coordinator.speculation_wins").total
+            > b_wins
+        )
+        info = client.query_info(res.query_id)
+        tasks = [t for st in info["stages"] for t in st["tasks"]]
+        assert any(t.get("speculative") for t in tasks)
+    finally:
+        coord.local.session.reset("speculation_min_s")
+        coord.local.session.reset("speculation_multiplier")
+        faults.configure(None)
+        for w in ws:
+            w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+# --------------------------------------------------------- rpc lint
+
+
+def test_rpc_call_sites_lint_clean():
+    import check_rpc_calls
+
+    assert check_rpc_calls.main([]) == 0
+
+
+def test_rpc_call_sites_lint_flags_raw_urlopen(tmp_path):
+    import check_rpc_calls
+
+    (tmp_path / "bad.py").write_text(
+        "import urllib.request\n"
+        "urllib.request.urlopen('http://example')\n"
+    )
+    assert check_rpc_calls.main([str(tmp_path)]) == 1

@@ -115,9 +115,12 @@ def test_worker_death_retries_on_live_worker(oracle):
         coord.shutdown()
 
 
-def test_all_workers_dead_fails_cleanly(oracle):
-    """No spare worker to retry on: the query fails cleanly (the
-    classic-Presto default failure unit stays covered)."""
+def test_all_workers_dead_falls_back_local(oracle):
+    """No spare worker to retry on: graceful degradation runs the
+    fragment on the coordinator's local engine instead of failing the
+    query (recoverable execution, last resort)."""
+    from presto_tpu.utils.metrics import REGISTRY
+
     coord = CoordinatorServer().start()
     w = WorkerServer(coordinator_uri=coord.uri).start()
     try:
@@ -126,10 +129,15 @@ def test_all_workers_dead_fails_cleanly(oracle):
         w.httpd.shutdown()
         w.httpd.server_close()
         client = PrestoTpuClient(coord.uri, timeout_s=60)
-        with pytest.raises(QueryFailed):
-            client.execute(
-                "select count(*) as c from tpch.tiny.lineitem"
-            )
+        before = REGISTRY.counter("coordinator.local_fallbacks").total
+        res = client.execute(
+            "select count(*) as c from tpch.tiny.lineitem"
+        )
+        assert res.rows() == [(59997,)]
+        assert (
+            REGISTRY.counter("coordinator.local_fallbacks").total
+            > before
+        )
     finally:
         coord.shutdown()
 

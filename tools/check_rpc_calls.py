@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""RPC-plane lint: every intra-cluster HTTP call must go through
+``presto_tpu/server/rpc.py`` — the one place with config-driven
+timeouts, bounded backoff retries, fault-plane hooks, and ``rpc.*``
+metrics. A raw ``urllib.request.urlopen`` anywhere else silently opts
+out of all of that, so this lint forbids it.
+
+Usage: ``python tools/check_rpc_calls.py [src_dir]`` — exits 0 when
+clean, 1 with a report listing every raw call site outside the
+allowed module.
+
+Wired into the test suite via tests/test_faults.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+#: raw opener spellings (module-qualified or bare after an import-from)
+_RAW_CALL = re.compile(r"\burlopen\s*\(")
+
+#: the one module allowed to open sockets (relative to src_dir root)
+ALLOWED = {os.path.join("server", "rpc.py")}
+
+
+def scan(src_dir: str) -> List[Tuple[str, int, str]]:
+    """(path, line, source-line) for every raw urlopen call site
+    outside the allowed modules."""
+    out: List[Tuple[str, int, str]] = []
+    for root, _dirs, files in os.walk(src_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, src_dir)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    stripped = line.strip()
+                    if stripped.startswith("#"):
+                        continue
+                    if _RAW_CALL.search(line):
+                        out.append((path, lineno, stripped))
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    src_dir = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "presto_tpu",
+    )
+    sites = scan(src_dir)
+    if not sites:
+        print(
+            "check_rpc_calls: no raw urlopen call sites outside "
+            "server/rpc.py"
+        )
+        return 0
+    for path, lineno, line in sites:
+        print(f"RAW RPC: {path}:{lineno}: {line}")
+    print(
+        f"{len(sites)} raw urlopen call site(s) — route them through "
+        "presto_tpu.server.rpc instead"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
