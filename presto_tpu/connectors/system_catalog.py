@@ -67,6 +67,15 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "kind": T.VARCHAR,
             "value": T.DOUBLE,
         },
+        "caches": {
+            "cache": T.VARCHAR,
+            "entries": T.BIGINT,
+            "bytes": T.BIGINT,
+            "budget_bytes": T.BIGINT,
+            "hits": T.BIGINT,
+            "misses": T.BIGINT,
+            "evictions": T.BIGINT,
+        },
     },
     "metadata": {
         "catalogs": {"catalog_name": T.VARCHAR, "connector_id": T.VARCHAR},
@@ -151,6 +160,8 @@ class SystemConnector(Connector):
                 {"name": n, "kind": k, "value": v}
                 for n, k, v in REGISTRY.snapshot()
             ]
+        if key == ("runtime", "caches"):
+            return self._cache_rows()
         if key == ("metadata", "catalogs"):
             names = self._runner.catalogs.names() if self._runner else []
             return [
@@ -195,6 +206,43 @@ class SystemConnector(Connector):
                         }
                     )
         return out
+
+    def _cache_rows(self):
+        """Live occupancy of the engine caches (reference: the jmx
+        cache-stats beans): the device-resident split cache (staged
+        pages, LRU byte budget) and the compiled-program cache."""
+        if self._runner is None:
+            return []
+        from presto_tpu.utils.metrics import REGISTRY
+
+        split = self._runner.split_cache.stats()
+        rows = [
+            {
+                "cache": "staging.split_cache",
+                "entries": split["entries"],
+                "bytes": split["bytes"],
+                "budget_bytes": split["budget_bytes"],
+                "hits": split["hits"],
+                "misses": split["misses"],
+                "evictions": split["evictions"],
+            },
+            {
+                "cache": "compile.programs",
+                "entries": len(self._runner._compiled),
+                "bytes": 0,  # XLA owns the executables; not accounted
+                "budget_bytes": 0,
+                # process-global counters (the bench's amortization
+                # signal), beside this runner's entry count
+                "hits": int(
+                    REGISTRY.counter("compile.cache_hit").total
+                ),
+                "misses": int(
+                    REGISTRY.counter("compile.cache_miss").total
+                ),
+                "evictions": 0,
+            },
+        ]
+        return rows
 
     def _node_rows(self):
         cluster = getattr(self._runner, "cluster", None)

@@ -33,7 +33,14 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu import expr as E
 from presto_tpu.connectors import create_connector
-from presto_tpu.exec.staging import CatalogManager, bucket_capacity, stage_page
+from presto_tpu.exec.staging import (
+    DEFAULT_CACHE_BYTES,
+    CatalogManager,
+    SplitCache,
+    bucket_capacity,
+    page_nbytes,
+    stage_page,
+)
 from presto_tpu.ops import (
     filter_project,
     hash_aggregate,
@@ -58,6 +65,10 @@ from presto_tpu.sql import ast
 
 class ExecutionError(RuntimeError):
     pass
+
+
+def _noop() -> None:
+    """No-op release handle (stage_split callers without an owner)."""
 
 
 class QueryResult:
@@ -88,6 +99,7 @@ class LocalQueryRunner:
         catalogs: Optional[CatalogManager] = None,
         session: Optional[Session] = None,
         memory_pool=None,
+        staging_cache_bytes: Optional[int] = None,
     ):
         from presto_tpu.exec.stats import QueryHistory
 
@@ -122,11 +134,17 @@ class LocalQueryRunner:
             self.history.add_listener(JsonlQueryEventListener(event_log))
         self._compiled: Dict[object, object] = {}
         self._prepared: Dict[str, object] = {}
-        self._table_cache: Dict[Tuple, Page] = {}
-        #: staged split-batch pages, keyed down to (lo, hi, capacity) —
-        #: the table cache at split granularity, gated by the
-        #: stream_split_cache session property (SURVEY.md §5.7)
-        self._split_cache: Dict[Tuple, Page] = {}
+        #: device-resident staged-page cache (exec.staging.SplitCache):
+        #: whole-table entries always (cacheable connectors), split-
+        #: batch entries when stream_split_cache is on — one LRU byte
+        #: budget (staging.cache-bytes) enforced through the memory
+        #: pool's shared "table-cache" owner
+        self.split_cache = SplitCache(
+            DEFAULT_CACHE_BYTES
+            if staging_cache_bytes is None
+            else staging_cache_bytes,
+            pool=memory_pool,
+        )
         # QueryStats while a query is in flight — THREAD-local: a
         # server embedding this runner executes admitted queries on
         # concurrent threads, and a shared slot races (one thread's
@@ -272,9 +290,11 @@ class LocalQueryRunner:
         except Exception as e:
             REGISTRY.counter("queries.failed").update()
             self.history.finish(qs, error=f"{type(e).__name__}: {e}")
+            self.release_pins(qs)
             if self.memory_pool is not None:
                 self.memory_pool.release(qs.query_id)
             raise
+        self.release_pins(qs)
         if self.memory_pool is not None:
             self.memory_pool.release(qs.query_id)
         self.history.finish(qs)
@@ -310,14 +330,9 @@ class LocalQueryRunner:
 
     def _invalidate_table_caches(self, handle) -> None:
         """Drop cached pages (whole-table AND split granularity) of a
-        written/deleted table, releasing their reservations."""
-        for cache in (self._table_cache, self._split_cache):
-            for k in [k for k in cache if k[0] == handle]:
-                stale = cache.pop(k)
-                if self.memory_pool is not None:
-                    self.memory_pool.release(
-                        "table-cache", _page_nbytes(stale)
-                    )
+        written/deleted table, releasing their reservations — the
+        writable-connector invalidation hook of the split cache."""
+        self.split_cache.invalidate(handle)
 
     def _resolve_write_handle(self, parts):
         from presto_tpu.connectors.spi import TableHandle
@@ -1049,17 +1064,64 @@ class LocalQueryRunner:
                     self._active_qs.retries += 1
             root = _scale_capacities(root, 4)
 
+    def _note_cache_hit(self) -> None:
+        """Attribute one split-cache hit to the active stats sink."""
+        if self._active_qs is not None:
+            with self._qs_mu:
+                self._active_qs.staging_cache_hits = (
+                    getattr(self._active_qs, "staging_cache_hits", 0) + 1
+                )
+
+    def _note_pinned_key(self, key) -> None:
+        """Record a cache key pinned on behalf of the active query so
+        :meth:`release_pins` can drop it when the query/task ends."""
+        qs = self._active_qs
+        if qs is None:
+            return
+        with self._qs_mu:
+            pins = getattr(qs, "_pinned_keys", None)
+            if pins is None:
+                pins = []
+                qs._pinned_keys = pins
+            pins.append(key)
+
+    def release_pins(self, qs) -> None:
+        """Unpin every whole-table cache entry ``qs`` pinned (the
+        query/task-end twin of the per-batch release in stage_split).
+        Idempotent; safe for stats sinks that never pinned."""
+        if qs is None:
+            return
+        with self._qs_mu:
+            keys = getattr(qs, "_pinned_keys", None) or []
+            if keys:
+                qs._pinned_keys = []
+        for k in keys:
+            self.split_cache.unpin(k)
+
     def _load_table(self, scan: N.TableScanNode) -> Page:
         # constraint is part of the identity: a partition-pruned page
         # must never serve an unconstrained (or differently-constrained)
-        # scan of the same table
+        # scan of the same table; the "table" tag keeps whole-table
+        # entries distinct from split-batch entries in the one cache
         key = (
             scan.handle,
             scan.columns,
             scan.constraint,
             self.session.get("tpu_offload"),
+            "table",
         )
-        page = self._table_cache.get(key)
+        cacheable = self.catalogs.get(scan.handle.catalog).cacheable()
+        # pin for the active query's lifetime: eviction must not drop
+        # the page's pool accounting while a plan is executing over it
+        # (released by release_pins at query/task end)
+        pin = cacheable and self._active_qs is not None
+        page = (
+            self.split_cache.get(key, pin=pin) if cacheable else None
+        )
+        if page is not None:
+            self._note_cache_hit()
+            if pin:
+                self._note_pinned_key(key)
         if page is None:
             from presto_tpu.utils.metrics import REGISTRY
 
@@ -1067,28 +1129,23 @@ class LocalQueryRunner:
             merged = self._load_merged_payload(scan)
             with self._device_scope():
                 page = stage_page(merged, dict(scan.schema))
-            REGISTRY.distribution("staging.bytes").add(
-                _page_nbytes(page)
+            nbytes = _page_nbytes(page)
+            REGISTRY.distribution("staging.bytes").add(nbytes)
+            cached = cacheable and self.split_cache.put(
+                key, page, nbytes, reserve_required=True, pin=pin
             )
-            if self.memory_pool is not None:
-                nbytes = _page_nbytes(page)
-                cacheable = self.catalogs.get(
-                    scan.handle.catalog
-                ).cacheable()
+            if cached and pin:
+                self._note_pinned_key(key)
+            if not cached and self.memory_pool is not None:
+                # not cache-owned (non-cacheable connector, or bigger
+                # than the cache budget): account under the query
                 override = getattr(self._owner_override, "value", None)
-                owner = (
-                    "table-cache"
-                    if cacheable
-                    else override
-                    or (
-                        self._active_qs.query_id
-                        if self._active_qs is not None
-                        else "adhoc"
-                    )
+                owner = override or (
+                    self._active_qs.query_id
+                    if self._active_qs is not None
+                    else "adhoc"
                 )
                 self.memory_pool.reserve(owner, nbytes)
-            if self.catalogs.get(scan.handle.catalog).cacheable():
-                self._table_cache[key] = page
             if self._active_qs is not None:
                 self._active_qs.staging_ms += (
                     time.perf_counter() - t0
@@ -1103,16 +1160,41 @@ class LocalQueryRunner:
     def _load_split(
         self, scan: N.TableScanNode, lo: int, hi: int, capacity: int
     ) -> Page:
+        """Stage ONE split batch (see :meth:`stage_split`), dropping
+        the residency bookkeeping callers without per-batch pool
+        accounting don't need."""
+        return self.stage_split(scan, lo, hi, capacity)[0]
+
+    def stage_split(
+        self,
+        scan: N.TableScanNode,
+        lo: int,
+        hi: int,
+        capacity: int,
+        owner: Optional[str] = None,
+        page_source=None,
+    ) -> Tuple[Page, object]:
         """Stage ONE split batch [lo, hi) of a scan to device at a
-        fixed capacity — with an optional device-resident cache across
-        queries (``stream_split_cache``), so repeated streamed passes
-        over the same splits pay the host->device transfer once
+        fixed capacity, through the device-resident split cache when
+        ``stream_split_cache`` is on — repeated passes over the same
+        splits skip the connector read AND the host->device transfer
         (SURVEY.md §5.7: the table cache at split granularity).
+
+        Returns ``(page, release)``: the caller invokes ``release()``
+        once the batch's device execution is done. With an ``owner``,
+        a cache-served (or freshly cached) page is PINNED for that
+        window — eviction must not drop its pool accounting while the
+        page is live on device — and release unpins it; an uncached
+        page reserves its bytes under ``owner`` and release returns
+        them. Without an owner, release is a no-op.
 
         The pushed constraint is deliberately NOT part of the identity:
         split page sources read raw split ranges (constraints act at
         enumeration/filter time), so the staged batch is
-        constraint-independent."""
+        constraint-independent.
+
+        ``page_source()`` overrides the connector read on a cache miss
+        (the worker routes it through its ``_load_range`` hook)."""
         from presto_tpu.connectors.spi import ConnectorSplit
         from presto_tpu.exec.staging import stage_page
 
@@ -1126,13 +1208,32 @@ class LocalQueryRunner:
             capacity,
             self.session.get("tpu_offload"),
         )
-        if cache_on:
-            page = self._split_cache.get(key)
+        # owner callers (worker drivers) release per batch; without an
+        # owner, an active query still pins — released wholesale at
+        # query end (release_pins) — so pressure eviction never
+        # un-accounts a page some plan is executing over
+        per_batch = owner is not None
+        pin = per_batch or self._active_qs is not None
+        unpin = (
+            (lambda: self.split_cache.unpin(key))
+            if per_batch
+            else _noop
+        )
+        if cache_on and conn.cacheable():
+            page = self.split_cache.get(key, pin=pin)
             if page is not None:
-                return page
+                self._note_cache_hit()
+                if pin and not per_batch:
+                    self._note_pinned_key(key)
+                return page, unpin
         t0 = time.perf_counter()
-        payload = conn.create_page_source(
-            ConnectorSplit(scan.handle, lo, hi), list(scan.columns)
+        payload = (
+            page_source()
+            if page_source is not None
+            else conn.create_page_source(
+                ConnectorSplit(scan.handle, lo, hi),
+                list(scan.columns),
+            )
         )
         with self._device_scope():
             page = stage_page(
@@ -1140,20 +1241,32 @@ class LocalQueryRunner:
             )
         from presto_tpu.utils.metrics import REGISTRY
 
-        REGISTRY.distribution("staging.bytes").add(_page_nbytes(page))
+        nbytes = _page_nbytes(page)
+        REGISTRY.distribution("staging.bytes").add(nbytes)
         if self._active_qs is not None:
-            self._active_qs.staging_ms += (
-                time.perf_counter() - t0
-            ) * 1000.0
-        if cache_on and conn.cacheable():
-            # the staged page still serves THIS batch either way; a
-            # full pool just means the split isn't cached (try_reserve
-            # never kills a query to make cache room)
-            if self.memory_pool is None or self.memory_pool.try_reserve(
-                "table-cache", _page_nbytes(page)
-            ):
-                self._split_cache[key] = page
-        return page
+            # locked: concurrent task drivers / the prefetch thread
+            # share one TaskStats sink (+= would drop updates)
+            with self._qs_mu:
+                self._active_qs.staging_ms += (
+                    time.perf_counter() - t0
+                ) * 1000.0
+        if cache_on and conn.cacheable() and self.split_cache.put(
+            key, page, nbytes, pin=pin
+        ):
+            # cache-owned: put() reserved the bytes under the shared
+            # owner via try_reserve (the staged page still serves THIS
+            # batch either way; a full pool just means the split isn't
+            # cached — a cache fill never kills a query to make room)
+            if pin and not per_batch:
+                self._note_pinned_key(key)
+            return page, unpin
+        if owner is not None and self.memory_pool is not None:
+            # live (uncached) batch residency accounts to the query
+            self.memory_pool.reserve(owner, nbytes)
+            return page, (
+                lambda: self.memory_pool.release(owner, nbytes)
+            )
+        return page, _noop
 
     def _load_merged_payload(self, scan: N.TableScanNode) -> Dict:
         """Fetch all splits of a scan and merge their column payloads.
@@ -1174,15 +1287,6 @@ class LocalQueryRunner:
         return _merge_split_payloads(datas, list(scan.columns))
 
 
-def _block_nbytes(b) -> int:
-    n = int(b.data.nbytes)
-    if b.valid is not None:
-        n += int(b.valid.nbytes)
-    if b.offsets is not None:
-        n += int(b.offsets.nbytes)
-    for child in b.children or ():
-        n += _block_nbytes(child)
-    return n
 
 
 def _count_param_markers(node) -> int:
@@ -1241,11 +1345,9 @@ def _bind_param_markers(node, params):
     return dataclasses.replace(node, **kwargs) if changed else node
 
 
-def _page_nbytes(page: Page) -> int:
-    """Device bytes a staged page holds (data/validity/offsets buffers,
-    recursing into array/map/row children) — the memory-pool
-    reservation unit for cached pages."""
-    return sum(_block_nbytes(b) for b in page.blocks)
+#: memory-pool reservation unit for staged/cached pages (ONE
+#: implementation: exec.staging.page_nbytes)
+_page_nbytes = page_nbytes
 
 
 def _page_from_prefix(page: Page, prefix_leaves, n: int) -> Page:

@@ -17,7 +17,10 @@ every split of similar size reuses the same compiled fragment
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import queue
+import threading
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
@@ -28,6 +31,14 @@ from presto_tpu.connectors.tpch import DictColumn
 from presto_tpu.page import Block, Dictionary, Page
 
 MIN_BUCKET = 1 << 10
+
+#: default device-resident split-cache budget (tier-1 key
+#: ``staging.cache-bytes`` overrides). 4GB: big enough that the SF10
+#: bench working sets (~2.4GB of pruned columns) stay resident across
+#: iterations — re-staging through a ~16MB/s tunnel costs minutes per
+#: pass — while staying well under v5e HBM (16GB) and the 8GB default
+#: memory pool, so cache fills never crowd out running queries
+DEFAULT_CACHE_BYTES = 4 << 30
 
 
 @dataclasses.dataclass
@@ -194,6 +205,338 @@ def merge_column_chunks(parts: List[object], dtype=None):
         {"c": dtype or T.BIGINT},
     )
     return merged["c"]
+
+
+def page_nbytes(page: Page) -> int:
+    """Device bytes a staged page holds (data/validity/offsets buffers,
+    recursing into array/map/row children) — the accounting unit for
+    the split cache and the memory pool."""
+
+    def block_nbytes(b) -> int:
+        n = int(b.data.nbytes)
+        if b.valid is not None:
+            n += int(b.valid.nbytes)
+        if b.offsets is not None:
+            n += int(b.offsets.nbytes)
+        for child in b.children or ():
+            n += block_nbytes(child)
+        return n
+
+    return sum(block_nbytes(b) for b in page.blocks)
+
+
+class SplitCache:
+    """Device-resident staged-``Page`` cache with an LRU byte budget.
+
+    Reference parity: the split-level half of the reference's
+    fragment-result / raw-data caching tier (Alluxio-style local cache
+    on the native worker, SURVEY.md §7 host->device staging as the
+    TPU-native analogue of disk I/O). Entries are whole staged pytrees
+    keyed by ``(table handle, columns, lo, hi, capacity bucket, ...)``;
+    a hit skips BOTH the connector read and the host->device transfer.
+
+    Budget discipline: entries charge the byte budget (LRU eviction at
+    the boundary) AND reserve against the node :class:`MemoryPool`
+    under the shared ``table-cache`` owner via ``try_reserve`` — a
+    cache fill must never kill a running query to make room; a full
+    pool just means the page is not cached. ``reserve_required=True``
+    (whole-table loads, the historical behavior) uses the raising
+    ``reserve`` instead, so a table that cannot fit fails the query
+    the same way it always has.
+
+    Metrics: ``staging.cache_hit`` / ``staging.cache_miss`` /
+    ``staging.cache_evict`` counters plus the ``staging.cache_bytes``
+    occupancy distribution; live occupancy is served by
+    ``system.runtime.caches``.
+    """
+
+    #: pool owner shared by every cached page (excluded from the
+    #: coordinator's kill-largest victim scan)
+    OWNER = "table-cache"
+
+    def __init__(self, budget_bytes: int = DEFAULT_CACHE_BYTES,
+                 pool=None):
+        self.budget = int(budget_bytes)
+        self.pool = pool
+        self._lock = threading.RLock()
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        #: key -> pin count: entries serving an EXECUTING batch are
+        #: pinned — eviction must not release their pool accounting
+        #: while the page is live on device (over-commit). Write
+        #: invalidation still drops pinned entries (correctness wins).
+        self._pins: Dict = {}
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        if pool is not None and hasattr(pool, "add_pressure_hook"):
+            # yield cached bytes to running queries on pool pressure:
+            # a query's raising reserve evicts LRU cache entries
+            # before the kill-largest policy fires — droppable cache
+            # must never cost a live query its reservation
+            pool.add_pressure_hook(self.evict_bytes)
+
+    # ------------------------------------------------------------ access
+
+    def get(self, key, pin: bool = False) -> Optional[Page]:
+        """Cached page for ``key`` (refreshes LRU order), or None.
+        Counts hit/miss metrics — call once per staging decision.
+        ``pin=True`` marks the entry in-use until :meth:`unpin`."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                if pin:
+                    self._pins[key] = self._pins.get(key, 0) + 1
+                self.hits += 1
+                REGISTRY.counter("staging.cache_hit").update()
+                return entry[0]
+            self.misses += 1
+            REGISTRY.counter("staging.cache_miss").update()
+            return None
+
+    def unpin(self, key) -> None:
+        """Drop one pin (no-op for unknown/already-invalidated keys)."""
+        with self._lock:
+            n = self._pins.get(key, 0) - 1
+            if n > 0:
+                self._pins[key] = n
+            else:
+                self._pins.pop(key, None)
+
+    def put(self, key, page: Page, nbytes: Optional[int] = None,
+            reserve_required: bool = False, pin: bool = False) -> bool:
+        """Insert a staged page, evicting LRU entries past the budget
+        (pinned entries are skipped — their pages are live on device).
+        Returns True when the page is now cache-owned (its bytes are
+        reserved under :attr:`OWNER`); False when it did not fit — the
+        page still serves the current caller either way. ``pin=True``
+        marks the fresh entry in-use until :meth:`unpin`."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        nbytes = page_nbytes(page) if nbytes is None else int(nbytes)
+        with self._lock:
+            if nbytes > self.budget:
+                return False
+            if self._pins.get(key):
+                # a concurrent duplicate staging of an entry that is
+                # EXECUTING on device: replacing it would release its
+                # pool accounting mid-flight — the caller keeps (and
+                # accounts) its own copy instead
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._release(old[1])
+            # reserve BEFORE the budget eviction: a failed pool
+            # reservation must not have emptied the cache for nothing
+            # (the pressure hook already lets query reservations
+            # reclaim cache bytes when THEY need the room)
+            if self.pool is not None:
+                if reserve_required:
+                    # raising reserve (pressure hook + kill-largest may
+                    # fire): a whole-table load that cannot fit is a
+                    # query failure, as it was before the cache existed
+                    self.pool.reserve(self.OWNER, nbytes)
+                elif not self.pool.try_reserve(self.OWNER, nbytes):
+                    return False
+            while self._bytes + nbytes > self.budget:
+                if not self._evict_one_unpinned():
+                    # every resident entry is pinned: the budget cannot
+                    # be met — undo the reservation and don't cache
+                    if self.pool is not None:
+                        self.pool.release(self.OWNER, nbytes)
+                    return False
+            self._entries[key] = (page, nbytes)
+            if pin:
+                self._pins[key] = self._pins.get(key, 0) + 1
+            self._bytes += nbytes
+            REGISTRY.distribution("staging.cache_bytes").add(self._bytes)
+            return True
+
+    # -------------------------------------------------------- maintenance
+
+    def _release(self, nbytes: int) -> None:
+        self._bytes -= nbytes
+        if self.pool is not None:
+            self.pool.release(self.OWNER, nbytes)
+
+    def _evict_one_unpinned(self) -> bool:
+        """Evict the least-recently-used UNPINNED entry (caller holds
+        the lock). Returns False when none is evictable."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        key = next(
+            (k for k in self._entries if not self._pins.get(k)), None
+        )
+        if key is None:
+            return False
+        _page, nbytes = self._entries.pop(key)
+        self._release(nbytes)
+        self.evictions += 1
+        REGISTRY.counter("staging.cache_evict").update()
+        return True
+
+    def evict_bytes(self, needed: int) -> int:
+        """Evict unpinned LRU entries until at least ``needed`` bytes
+        are freed (or none remain evictable) — the MemoryPool pressure
+        hook: cached pages are droppable, so a running query's
+        reservation reclaims them before any query gets killed.
+        Returns the bytes actually freed."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        freed = 0
+        evicted = 0
+        with self._lock:
+            while freed < needed:
+                key = next(
+                    (k for k in self._entries if not self._pins.get(k)),
+                    None,
+                )
+                if key is None:
+                    break
+                _page, nbytes = self._entries.pop(key)
+                self._release(nbytes)
+                freed += nbytes
+                evicted += 1
+            self.evictions += evicted
+        if evicted:
+            REGISTRY.counter("staging.cache_evict").update(evicted)
+            REGISTRY.distribution("staging.cache_bytes").add(
+                self._bytes
+            )
+        return freed
+
+    def invalidate(self, handle) -> int:
+        """Drop every entry of a written/dropped table (keys lead with
+        the table handle), releasing their reservations. Returns the
+        number of entries dropped."""
+        with self._lock:
+            stale = [k for k in self._entries if k[0] == handle]
+            for k in stale:
+                _page, nbytes = self._entries.pop(k)
+                self._release(nbytes)
+                self._pins.pop(k, None)
+            return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            for _page, nbytes in self._entries.values():
+                self._release(nbytes)
+            self._entries.clear()
+            self._pins.clear()
+
+    # ------------------------------------------------------------- stats
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "budget_bytes": self.budget,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+def prefetch_iter(items, load_fn, depth: int, on_drop=None):
+    """Pipelined prefetch staging: yield ``load_fn(item)`` for each
+    item IN ORDER, staging up to ``depth`` items ahead on one
+    background host thread — so the host converts/transfers split N+1
+    while the device executes the compiled fragment over split N
+    (SURVEY.md §7 "Hard parts: host->device staging", the
+    double-buffering half of the worker hot-path optimization).
+
+    ``depth <= 0`` is the exact serial path (stage, run, stage, run),
+    bit-identical by construction since the same ``load_fn`` runs in
+    the same order either way. The bounded queue caps staged-ahead
+    residency to ``depth`` pages on top of whatever pool accounting
+    ``load_fn`` itself performs; a staging error is re-raised at the
+    consuming iteration it would have hit serially.
+
+    Abandonment contract: closing the generator (loop exit or
+    ``.close()``) stops the producer, JOINS it, and passes every
+    staged-but-unconsumed result to ``on_drop`` — callers whose
+    ``load_fn`` acquires resources (memory-pool reservations) release
+    them there, and no ``load_fn`` call can outlive the iteration."""
+    items = list(items)
+    if depth <= 0 or len(items) <= 1:
+        for it in items:
+            yield load_fn(it)
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    _END = object()
+    stop = threading.Event()
+
+    def _put(entry) -> bool:
+        """Bounded put that gives up when the consumer went away (an
+        aborted task must not leave this thread parked forever)."""
+        while not stop.is_set():
+            try:
+                q.put(entry, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        for it in items:
+            if stop.is_set():
+                return
+            try:
+                entry = (load_fn(it), None)
+            except BaseException as e:  # re-raised consumer-side
+                _put((None, e))
+                return
+            if not _put(entry):
+                # consumer gone mid-flight: the staged result still
+                # owns its resources — surrender it, don't leak it
+                if on_drop is not None:
+                    on_drop(entry[0])
+                return
+        _put((_END, None))
+
+    t = threading.Thread(
+        target=producer, name="staging-prefetch", daemon=True
+    )
+    t.start()
+    try:
+        while True:
+            page, err = q.get()
+            if err is not None:
+                raise err
+            if page is _END:
+                return
+            yield page
+    finally:
+        stop.set()
+        # join before returning: an in-flight load_fn must not touch
+        # caller state (e.g. reserve pool bytes) after the driver
+        # loop has moved on to its cleanup
+        t.join()
+        while True:
+            try:
+                entry, err = q.get_nowait()
+            except queue.Empty:
+                break
+            if err is None and entry is not _END and on_drop is not None:
+                on_drop(entry)
+
+
+def stage_sharded(tables, sharding):
+    """Host pytrees -> device with an explicit sharding (the multi-chip
+    staging twin of :func:`stage_page`; parallel.distributed_runner's
+    scan placement). Lives here so every host->device transfer goes
+    through this module (tools/check_device_puts.py enforces that)."""
+    import jax
+
+    return [jax.device_put(t, sharding) for t in tables]
 
 
 class CatalogManager:

@@ -68,6 +68,9 @@ class TaskStats:
     end_time: float = 0.0
     wall_ms: float = 0.0
     staging_ms: float = 0.0
+    #: host time spent staging AHEAD of device execution (the
+    #: pipelined-prefetch overlap window; also in staging_ms)
+    prefetch_ms: float = 0.0
     execute_ms: float = 0.0
     input_rows: int = 0
     input_bytes: int = 0
@@ -75,6 +78,9 @@ class TaskStats:
     output_bytes: int = 0
     retries: int = 0
     compile_cache_hit: bool = True
+    #: splits this task served straight from the device-resident
+    #: split cache (no connector read, no host->device transfer)
+    staging_cache_hits: int = 0
     dynamic_filters: int = 0
     device_fragments: int = 0
     #: this attempt was a speculative (backup) launch of a straggling
@@ -114,6 +120,9 @@ class StageStats:
             "output_rows": sum(t.output_rows for t in self.tasks),
             "output_bytes": sum(t.output_bytes for t in self.tasks),
             "retries": sum(t.retries for t in self.tasks),
+            "staging_cache_hits": sum(
+                t.staging_cache_hits for t in self.tasks
+            ),
             "failed_tasks": sum(
                 1 for t in self.tasks if t.state == "FAILED"
             ),
@@ -143,6 +152,7 @@ class QueryStats:
     staging_ms: float = 0.0  # host->HBM page staging
     execution_ms: float = 0.0  # device program (incl. compile on miss)
     compile_cache_hit: bool = True
+    staging_cache_hits: int = 0  # pages served device-resident
     retries: int = 0  # capacity-overflow re-runs
     device_fragments: int = 0  # stage-at-a-time programs beyond the root
     dynamic_filters: int = 0  # build->probe runtime range filters applied
@@ -176,6 +186,9 @@ class QueryStats:
         self.staging_ms = sum(
             t.staging_ms for s in self.stages for t in s.tasks
         )
+        self.staging_cache_hits = sum(
+            t.staging_cache_hits for s in self.stages for t in s.tasks
+        )
         self.input_rows = sum(
             t.input_rows for s in self.stages for t in s.tasks
         )
@@ -197,6 +210,7 @@ class QueryStats:
             "staging_ms": self.staging_ms,
             "execution_ms": self.execution_ms,
             "compile_cache_hit": self.compile_cache_hit,
+            "staging_cache_hits": self.staging_cache_hits,
             "retries": self.retries,
             "device_fragments": self.device_fragments,
             "dynamic_filters": self.dynamic_filters,

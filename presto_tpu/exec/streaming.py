@@ -32,7 +32,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from presto_tpu.connectors.tpch import DictColumn
-from presto_tpu.exec.staging import MaskedColumn, stage_page
+from presto_tpu.exec.staging import MaskedColumn, prefetch_iter, stage_page
 from presto_tpu.plan import nodes as N
 from presto_tpu.parallel.fragmenter import insert_gathers
 from presto_tpu.server import pages_wire
@@ -45,6 +45,35 @@ from presto_tpu.server.scheduler import (
 
 class StreamingError(RuntimeError):
     pass
+
+
+def _prefetch_splits(runner, scan, ranges, capacity):
+    """Iterate staged split pages of ``ranges`` with pipelined
+    prefetch staging (exec.staging.prefetch_iter): a background host
+    thread stages batch N+1 while the caller's device program runs
+    batch N. Each prefetch-staged batch opens a ``stage:prefetch``
+    span on the query's trace, so EXPLAIN ANALYZE shows the staging
+    window overlapping the open ``execute`` span. Depth 0
+    (staging_prefetch_depth) degenerates to the exact serial loop."""
+    depth = int(runner.session.get("staging_prefetch_depth"))
+    qs = runner._active_qs
+    trace = getattr(qs, "trace", None) if qs is not None else None
+
+    def load(rng):
+        # prefetch thread: inherit the caller's stats sink (runner
+        # thread-locals don't cross threads)
+        runner._qs_local.value = qs
+        if trace is not None and depth > 0:
+            with trace.span(
+                "stage:prefetch", parent=trace.root,
+                lo=rng[0], hi=rng[1],
+            ):
+                return runner._load_split(
+                    scan, rng[0], rng[1], capacity
+                )
+        return runner._load_split(scan, rng[0], rng[1], capacity)
+
+    return prefetch_iter(ranges, load, depth)
 
 
 def _scan_rows(catalogs, scan: N.TableScanNode) -> int:
@@ -157,11 +186,16 @@ def _run_fragment(runner, frag_root: N.PlanNode, materialized: Dict):
             base_pages[id(n)] = runner._load_table(n)
 
     spill: List[List[tuple]] = [[] for _ in range(n_buckets)]
-    for lo in range(0, stage.partition_rows, batch):
-        hi = min(lo + batch, stage.partition_rows)
-        # fixed capacity: every batch (incl. the tail) reuses ONE
-        # compiled partial-fragment program
-        batch_page = runner._load_split(part_scan, lo, hi, batch_cap)
+    # fixed capacity: every batch (incl. the tail) reuses ONE compiled
+    # partial-fragment program; prefetch staging overlaps batch N+1's
+    # host->device transfer with batch N's device execution
+    ranges = [
+        (lo, min(lo + batch, stage.partition_rows))
+        for lo in range(0, stage.partition_rows, batch)
+    ]
+    for batch_page in _prefetch_splits(
+        runner, part_scan, ranges, batch_cap
+    ):
         pages = [
             batch_page if n is part_scan else base_pages[id(n)]
             for n in leaves
@@ -540,9 +574,12 @@ def _stream_side_to_buckets(
     batch = min(int(runner.session.get("page_capacity")), max_rows)
     batch_cap = bucket_capacity(batch)
     total = _scan_rows(runner.catalogs, big_scan)
-    for lo in range(0, total, batch):
-        hi = min(lo + batch, total)
-        batch_page = runner._load_split(big_scan, lo, hi, batch_cap)
+    ranges = [
+        (lo, min(lo + batch, total)) for lo in range(0, total, batch)
+    ]
+    for batch_page in _prefetch_splits(
+        runner, big_scan, ranges, batch_cap
+    ):
         spill_page(
             runner._run_with_pages(side_root, [big_scan], [batch_page])
         )

@@ -203,8 +203,10 @@ class DistributedQueryRunner(LocalQueryRunner):
             entry = (fn, meta)
             self._frag_compiled[key] = entry
         fn, meta = entry
+        from presto_tpu.exec.staging import stage_sharded
+
         sharding = NamedSharding(self.mesh, P(_AXIS))
-        pages_in = [jax.device_put(t, sharding) for t in tables]
+        pages_in = stage_sharded(tables, sharding)
         out, flags, err_flags = fn(pages_in)
         return out, flags, err_flags, meta
 

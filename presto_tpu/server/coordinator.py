@@ -144,10 +144,31 @@ class CoordinatorServer:
         self.memory_pool = MemoryPool(
             limit, kill_largest=self._kill_largest_query
         )
+        # gather-side staging knobs: the coordinator's embedded runner
+        # stages gathered pages and coordinator-local scans through the
+        # same device-resident split cache / prefetch pipeline the
+        # workers use (tier-1: staging.cache-bytes, staging.prefetch-depth)
+        from presto_tpu.exec.staging import DEFAULT_CACHE_BYTES
+
+        cache_raw = (
+            config.get("staging.cache-bytes") if config else None
+        )
         self.local = LocalQueryRunner(
             catalogs=catalogs, session=session,
             memory_pool=self.memory_pool,
+            staging_cache_bytes=(
+                parse_bytes(cache_raw)
+                if cache_raw is not None
+                else DEFAULT_CACHE_BYTES
+            ),
         )
+        prefetch = (
+            config.get("staging.prefetch-depth") if config else None
+        )
+        if prefetch is not None:
+            self.local.session.set(
+                "staging_prefetch_depth", int(prefetch)
+            )
         self.local.cluster = self  # system.runtime.nodes source
         # config-wired query-completed JSONL sink (the env-var hook in
         # LocalQueryRunner covers bench/embedded runs; add_listener
@@ -463,8 +484,12 @@ class CoordinatorServer:
                 "trace=%s query=%s state=RUNNING", q.trace.trace_id, q.qid
             )
             # pool reservations this thread makes are owned by THIS
-            # query id (one id space for holders, kills, and clients)
+            # query id (one id space for holders, kills, and clients);
+            # the stats sink makes coordinator-local staging (gather
+            # splices, local fallback) pin the cache entries it
+            # executes over — released in the finally below
             self.local._owner_override.value = q.qid
+            self.local._qs_local.value = q.stats
             try:
                 with REGISTRY.timer("coordinator.query_time").time():
                     with q.trace.span("query", query_id=q.qid):
@@ -482,6 +507,8 @@ class CoordinatorServer:
             finally:
                 self._finish_query_stats(q)
                 self.local._owner_override.value = None
+                self.local._qs_local.value = None
+                self.local.release_pins(q.stats)
                 self.memory_pool.release(q.qid)
                 with self._lock:
                     self._pending -= 1
@@ -569,7 +596,10 @@ class CoordinatorServer:
             t1 = time.perf_counter()
             try:
                 with q.trace.span("execute-local"):
-                    return self.local.execute_plan(plan)
+                    # qs keeps the thread's stats sink live inside
+                    # execute_plan (it swaps in its qs argument), so
+                    # coordinator-local staging pins + attributes
+                    return self.local.execute_plan(plan, qs=q.stats)
             finally:
                 q.stats.execution_ms = (
                     time.perf_counter() - t1
@@ -597,7 +627,7 @@ class CoordinatorServer:
         from presto_tpu.exec.host_ops import apply_host_ops
 
         if not remotes:
-            return self.local.execute_plan(plan)
+            return self.local.execute_plan(plan, qs=q.stats)
         # ordered MERGE exchange (reference: MergeOperator): when the
         # peeled root sort sits directly over a single no-cut fragment,
         # push the sort into the worker fragment (per-batch sorted runs)
@@ -910,6 +940,9 @@ class CoordinatorServer:
                 task_concurrency=int(
                     self.local.session.get("task_concurrency")
                 ),
+                prefetch_depth=int(
+                    self.local.session.get("staging_prefetch_depth")
+                ),
                 traceparent=q.trace.traceparent(),
             ))
 
@@ -1176,6 +1209,9 @@ class CoordinatorServer:
                     task_concurrency=int(
                         self.local.session.get("task_concurrency")
                     ),
+                    prefetch_depth=int(
+                        self.local.session.get("staging_prefetch_depth")
+                    ),
                     n_partitions=nparts,
                     partition_keys=tuple(keys),
                     traceparent=q.trace.traceparent(),
@@ -1298,6 +1334,9 @@ class CoordinatorServer:
                 ),
                 task_concurrency=int(
                     self.local.session.get("task_concurrency")
+                ),
+                prefetch_depth=int(
+                    self.local.session.get("staging_prefetch_depth")
                 ),
                 n_partitions=nparts,
                 partition_keys=tuple(key_names),

@@ -3,8 +3,11 @@
 Reference parity: ``PagesSerde`` — per-block typed encodings with LZ4
 compression and an xxhash checksum on the exchange wire (SURVEY.md §2.5
 "Serialization"). Here: raw little-endian typed buffers per column,
-zlib-compressed (stdlib zlib — numpy buffers in, C deflate underneath),
-crc32-checksummed per buffer, with a JSON header.
+adaptively zlib-compressed (stdlib zlib — numpy buffers in, C deflate
+underneath; buffers below a size floor or whose sample prefix
+compresses poorly ship raw, flagged by a per-buffer ``enc`` header
+field defaulting to ``"zlib"``), crc32-checksummed per buffer, with a
+JSON header.
 
 Frame layout::
 
@@ -34,10 +37,55 @@ from presto_tpu.server.protocol import encode as _encode_type
 
 _MAGIC = b"PTP1"
 
+#: adaptive compression floor: buffers below this ship raw — zlib
+#: setup costs more than it saves on tiny buffers
+MIN_COMPRESS_BYTES = 512
+
+#: sample prefix compressed to probe compressibility of large buffers
+COMPRESS_SAMPLE_BYTES = 4096
+
+#: sample compressed/raw ratio above which the whole buffer ships raw
+#: (already-compressed or high-entropy data: deflate would burn CPU on
+#: both ends to GROW the payload)
+COMPRESS_SAMPLE_RATIO = 0.9
+
 
 def _compress(raw: bytes) -> Tuple[bytes, int]:
     comp = zlib.compress(raw, level=1)
     return comp, zlib.crc32(raw)
+
+
+def _encode_buffer(raw: bytes) -> Tuple[bytes, int, str]:
+    """Adaptive wire encoding: ``(payload, crc32(raw), enc)`` where
+    ``enc`` is ``"zlib"`` or ``"raw"``. Small buffers and buffers whose
+    sample prefix compresses poorly skip zlib (metrics:
+    ``exchange.compress_skipped``); compressed buffers record the bytes
+    saved (``exchange.bytes_saved``). The header's per-buffer ``enc``
+    field defaults to ``"zlib"`` when absent, so old frames decode
+    unchanged (wire format stays PTP1)."""
+    from presto_tpu.utils.metrics import REGISTRY
+
+    crc = zlib.crc32(raw)
+    skip = len(raw) < MIN_COMPRESS_BYTES
+    if not skip and len(raw) > COMPRESS_SAMPLE_BYTES:
+        sample = raw[:COMPRESS_SAMPLE_BYTES]
+        ratio = len(zlib.compress(sample, 1)) / len(sample)
+        skip = ratio > COMPRESS_SAMPLE_RATIO
+    if not skip:
+        comp = zlib.compress(raw, 1)
+        if len(comp) < len(raw):
+            REGISTRY.counter("exchange.bytes_saved").update(
+                len(raw) - len(comp)
+            )
+            return comp, crc, "zlib"
+    REGISTRY.counter("exchange.compress_skipped").update()
+    return raw, crc, "raw"
+
+
+def _decode_buffer(payload: bytes, enc: str) -> bytes:
+    if enc == "raw":
+        return bytes(payload)
+    return zlib.decompress(payload)
 
 
 def serialize_page(
@@ -64,8 +112,8 @@ def serialize_page(
                 np.asarray(data.values)[: int(off[-1]) if len(off) else 0]
             )
             oraw, vraw_ = off.tobytes(), vals.tobytes()
-            ocomp, ocrc = _compress(oraw)
-            vcomp_, vcrc_ = _compress(vraw_)
+            ocomp, ocrc, oenc = _encode_buffer(oraw)
+            vcomp_, vcrc_, venc = _encode_buffer(vraw_)
             col = {
                 "name": name,
                 "type": _encode_type(dtype),
@@ -73,10 +121,12 @@ def serialize_page(
                 "off_comp_size": len(ocomp),
                 "off_raw_size": len(oraw),
                 "off_crc32": ocrc,
+                "off_enc": oenc,
                 "np_dtype": vals.dtype.str,
                 "comp_size": len(vcomp_),
                 "raw_size": len(vraw_),
                 "crc32": vcrc_,
+                "enc": venc,
             }
             buffers.append(ocomp)
             buffers.append(vcomp_)
@@ -84,10 +134,11 @@ def serialize_page(
                 vraw = np.packbits(
                     np.asarray(valid, dtype=bool)
                 ).tobytes()
-                vc, vcr = _compress(vraw)
+                vc, vcr, vvenc = _encode_buffer(vraw)
                 col["valid_comp_size"] = len(vc)
                 col["valid_raw_size"] = len(vraw)
                 col["valid_crc32"] = vcr
+                col["valid_enc"] = vvenc
                 buffers.append(vc)
             if dict_values is not None:
                 col["dictionary"] = list(dict_values)
@@ -95,7 +146,7 @@ def serialize_page(
             continue
         data = np.ascontiguousarray(data)
         raw = data.tobytes()
-        comp, crc = _compress(raw)
+        comp, crc, enc = _encode_buffer(raw)
         col: Dict = {
             "name": name,
             "type": _encode_type(dtype),
@@ -103,14 +154,16 @@ def serialize_page(
             "comp_size": len(comp),
             "raw_size": len(raw),
             "crc32": crc,
+            "enc": enc,
         }
         buffers.append(comp)
         if valid is not None:
             vraw = np.packbits(np.asarray(valid, dtype=bool)).tobytes()
-            vcomp, vcrc = _compress(vraw)
+            vcomp, vcrc, venc = _encode_buffer(vraw)
             col["valid_comp_size"] = len(vcomp)
             col["valid_raw_size"] = len(vraw)
             col["valid_crc32"] = vcrc
+            col["valid_enc"] = venc
             buffers.append(vcomp)
         if dict_values is not None:
             col["dictionary"] = list(dict_values)
@@ -138,7 +191,7 @@ def deserialize_page(buf: bytes):
 
             ocomp = buf[off : off + col["off_comp_size"]]
             off += col["off_comp_size"]
-            oraw = zlib.decompress(ocomp)
+            oraw = _decode_buffer(ocomp, col.get("off_enc", "zlib"))
             if zlib.crc32(oraw) != col["off_crc32"]:
                 raise ValueError(
                     f"offsets checksum mismatch on {col['name']}"
@@ -146,7 +199,7 @@ def deserialize_page(buf: bytes):
             offsets = np.frombuffer(oraw, np.int32).copy()
             vcomp2 = buf[off : off + col["comp_size"]]
             off += col["comp_size"]
-            vraw2 = zlib.decompress(vcomp2)
+            vraw2 = _decode_buffer(vcomp2, col.get("enc", "zlib"))
             if zlib.crc32(vraw2) != col["crc32"]:
                 raise ValueError(
                     f"values checksum mismatch on {col['name']}"
@@ -158,7 +211,7 @@ def deserialize_page(buf: bytes):
             if "valid_comp_size" in col:
                 vc = buf[off : off + col["valid_comp_size"]]
                 off += col["valid_comp_size"]
-                vr = zlib.decompress(vc)
+                vr = _decode_buffer(vc, col.get("valid_enc", "zlib"))
                 if zlib.crc32(vr) != col["valid_crc32"]:
                     raise ValueError(
                         f"validity checksum mismatch on {col['name']}"
@@ -181,7 +234,7 @@ def deserialize_page(buf: bytes):
             continue
         comp = buf[off : off + col["comp_size"]]
         off += col["comp_size"]
-        raw = zlib.decompress(comp)
+        raw = _decode_buffer(comp, col.get("enc", "zlib"))
         if len(raw) != col["raw_size"] or zlib.crc32(raw) != col["crc32"]:
             raise ValueError(f"page checksum mismatch on {col['name']}")
         data = np.frombuffer(raw, dtype=np.dtype(col["np_dtype"])).copy()
@@ -189,7 +242,7 @@ def deserialize_page(buf: bytes):
         if "valid_comp_size" in col:
             vcomp = buf[off : off + col["valid_comp_size"]]
             off += col["valid_comp_size"]
-            vraw = zlib.decompress(vcomp)
+            vraw = _decode_buffer(vcomp, col.get("valid_enc", "zlib"))
             if zlib.crc32(vraw) != col["valid_crc32"]:
                 raise ValueError(
                     f"validity checksum mismatch on {col['name']}"

@@ -149,6 +149,10 @@ class FragmentSpec:
     #: concurrent split-batch drivers per task (session
     #: ``task_concurrency``; reference: task.concurrency driver count)
     task_concurrency: int = 1
+    #: split batches prefetch-staged ahead of device execution
+    #: (session ``staging_prefetch_depth``; -1 = unset — the worker
+    #: falls back to its own session/config default)
+    prefetch_depth: int = -1
     #: partitioned output (reference: PartitionedOutputOperator +
     #: PartitionedOutputBuffer): producers hash-partition output rows by
     #: ``partition_keys`` into ``n_partitions`` buffers; downstream
@@ -179,6 +183,7 @@ class FragmentSpec:
             "split_end": self.split_end,
             "split_batch_rows": self.split_batch_rows,
             "task_concurrency": self.task_concurrency,
+            "prefetch_depth": self.prefetch_depth,
             "n_partitions": self.n_partitions,
             "partition_keys": list(self.partition_keys),
             "sources": [list(s) for s in self.sources],
@@ -197,6 +202,7 @@ class FragmentSpec:
             split_end=d["split_end"],
             split_batch_rows=d.get("split_batch_rows", 0),
             task_concurrency=d.get("task_concurrency", 1),
+            prefetch_depth=d.get("prefetch_depth", -1),
             n_partitions=d.get("n_partitions", 1),
             partition_keys=tuple(d.get("partition_keys", ())),
             sources=tuple(

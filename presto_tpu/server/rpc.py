@@ -72,6 +72,11 @@ class RpcPolicy:
     retries: int = 2
     backoff_base_s: float = 0.05
     backoff_max_s: float = 2.0
+    #: token-acked page-pull requests kept in flight per pull loop
+    #: (``rpc.pull-depth``): 1 = strict request->ack->request; 2+
+    #: overlaps the next page's network round trip with this page's
+    #: deserialization (see :func:`pull_pages`)
+    pull_depth: int = 2
 
     @staticmethod
     def from_config(config) -> "RpcPolicy":
@@ -84,10 +89,34 @@ class RpcPolicy:
             retries=int(config.get("rpc.retries", 2)),
             backoff_base_s=float(config.get("rpc.backoff-base-s", 0.05)),
             backoff_max_s=float(config.get("rpc.backoff-max-s", 2.0)),
+            pull_depth=int(config.get("rpc.pull-depth", 2)),
         )
 
 
 DEFAULT_POLICY = RpcPolicy()
+
+#: shared executor for pipelined page pulls: one process-wide pool
+#: instead of a fresh ThreadPoolExecutor per pull (no thread churn per
+#: task stream). Speculative fetches are plain bounded-timeout GETs —
+#: no inter-future dependencies, so a shared pool cannot deadlock;
+#: abandoned fetches finish within the rpc timeout and their results
+#: are dropped.
+_PULL_POOL = None
+_PULL_POOL_LOCK = threading.Lock()
+_PULL_POOL_WORKERS = 32
+
+
+def _pull_executor():
+    global _PULL_POOL
+    with _PULL_POOL_LOCK:
+        if _PULL_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _PULL_POOL = ThreadPoolExecutor(
+                max_workers=_PULL_POOL_WORKERS,
+                thread_name_prefix="page-pull",
+            )
+        return _PULL_POOL
 
 
 def compute_backoff(
@@ -183,47 +212,101 @@ def pull_pages(
     traceparent: str = "",
     stall=None,
     timeout_msg: str = "",
+    depth: Optional[int] = None,
 ) -> list:
     """The token-acked exchange pull loop (one implementation for the
     coordinator's gather and the worker's shuffle read): GET
     ``/v1/task/{id}/results/{buffer}/{token}`` until ``X-Complete``,
-    advancing the token per ``X-Next-Token`` (pulling token N acks
-    pages < N on the producer). Returns the deserialized pages.
+    advancing the token per ``X-Next-Token``. Returns the deserialized
+    pages.
+
+    Pipelining (``depth``, default ``policy.pull_depth``): up to
+    ``depth`` token requests stay in flight concurrently, so page
+    N+1's network round trip overlaps page N's decompress/deserialize
+    instead of strictly alternating. Every request carries an
+    ``X-Ack`` header with the CONSUMED floor — the producer frees only
+    pages the puller has actually received, so a speculative in-flight
+    request can never free an unconsumed page (with depth 1 the floor
+    equals the requested token, the historical ack-via-URL behavior).
 
     ``stall()`` runs when no page is ready yet (default: short sleep);
     callers use it to poll task status and surface failures. The
     deadline is monotonic."""
     from presto_tpu.server import pages_wire
 
-    token = 0
+    depth = max(1, policy.pull_depth if depth is None else int(depth))
     out: list = []
     deadline = time.monotonic() + deadline_s
-    while True:
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                timeout_msg
-                or f"pull of {task_id}[{buffer}] timed out"
-            )
-        resp = call(
+
+    def fetch(t: int, ack: int) -> RpcResponse:
+        return call(
             "GET",
-            f"{uri}/v1/task/{task_id}/results/{buffer}/{token}",
+            f"{uri}/v1/task/{task_id}/results/{buffer}/{t}",
             policy=policy,
             traceparent=traceparent,
+            headers={"X-Ack": str(ack)},
         )
-        complete = resp.headers.get("X-Complete") == "true"
-        nxt = int(resp.headers.get("X-Next-Token", token))
-        if resp.status == 200:
-            out.append(pages_wire.deserialize_page(resp.body))
-        if complete and nxt == token + (
-            1 if resp.status == 200 else 0
-        ):
-            return out
-        if nxt == token and resp.status != 200:
+
+    def timed_out() -> bool:
+        return time.monotonic() > deadline
+
+    def fail_timeout():
+        raise TimeoutError(
+            timeout_msg or f"pull of {task_id}[{buffer}] timed out"
+        )
+
+    token = 0
+    if depth == 1:
+        while True:
+            if timed_out():
+                fail_timeout()
+            resp = fetch(token, token)
+            complete = resp.headers.get("X-Complete") == "true"
+            nxt = int(resp.headers.get("X-Next-Token", token))
+            if resp.status == 200:
+                out.append(pages_wire.deserialize_page(resp.body))
+            if complete and nxt == token + (
+                1 if resp.status == 200 else 0
+            ):
+                return out
+            if nxt == token and resp.status != 200:
+                if stall is not None:
+                    stall()
+                else:
+                    time.sleep(0.02)
+            token = nxt
+        # not reached
+
+    inflight: dict = {}
+    executor = _pull_executor()
+    try:
+        while True:
+            if timed_out():
+                fail_timeout()
+            # keep the window full: tokens [consumed, consumed+depth)
+            for t in range(token, token + depth):
+                if t not in inflight:
+                    inflight[t] = executor.submit(fetch, t, token)
+            resp = inflight.pop(token).result()
+            complete = resp.headers.get("X-Complete") == "true"
+            if resp.status == 200:
+                out.append(pages_wire.deserialize_page(resp.body))
+                token += 1
+                if complete:
+                    # that was the final page
+                    return out
+                continue
+            # 204: no page at this token (a speculative response may
+            # be stale — re-request rather than trusting it)
+            if complete:
+                return out
             if stall is not None:
                 stall()
             else:
                 time.sleep(0.02)
-        token = nxt
+    finally:
+        for f in inflight.values():
+            f.cancel()
 
 
 class CircuitBreaker:

@@ -29,7 +29,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from presto_tpu.connectors.spi import ConnectorSplit
-from presto_tpu.exec.staging import stage_page
+from presto_tpu.exec.staging import (
+    bucket_capacity,
+    page_nbytes,
+    stage_page,
+)
 from presto_tpu.exec.stats import TaskStats
 from presto_tpu.plan import nodes as N
 from presto_tpu.server import pages_wire, rpc
@@ -198,6 +202,8 @@ class WorkerServer:
         from presto_tpu.exec.local_runner import LocalQueryRunner
         from presto_tpu.utils.memory import MemoryPool, parse_bytes
 
+        from presto_tpu.exec.staging import DEFAULT_CACHE_BYTES
+
         self.node_id = node_id or f"worker-{uuid.uuid4().hex[:8]}"
         # memory accounting is ALWAYS on (reference: MemoryPool wired
         # unconditionally in the worker; limit from tier-1 config)
@@ -206,9 +212,33 @@ class WorkerServer:
             or "8GB"
         )
         self.memory_pool = MemoryPool(limit)
-        self.runner = LocalQueryRunner(
-            catalogs=catalogs, memory_pool=self.memory_pool
+        # device-resident split cache (tier-1: staging.cache-bytes,
+        # 0 disables): the LRU byte budget + try_reserve discipline
+        # make always-on safe on the worker hot path — repeated
+        # queries over the same split ranges skip the connector read
+        # and the host->device transfer entirely
+        cache_raw = (
+            config.get("staging.cache-bytes") if config else None
         )
+        cache_bytes = (
+            parse_bytes(cache_raw)
+            if cache_raw is not None
+            else DEFAULT_CACHE_BYTES
+        )
+        self.runner = LocalQueryRunner(
+            catalogs=catalogs,
+            memory_pool=self.memory_pool,
+            staging_cache_bytes=cache_bytes,
+        )
+        if cache_bytes > 0:
+            self.runner.session.set("stream_split_cache", True)
+        prefetch = (
+            config.get("staging.prefetch-depth") if config else None
+        )
+        if prefetch is not None:
+            self.runner.session.set(
+                "staging_prefetch_depth", int(prefetch)
+            )
         self.tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._shutting_down = False
@@ -388,6 +418,7 @@ class WorkerServer:
                     end=task.stats.end_time,
                     staging_ms=task.stats.staging_ms,
                     execute_ms=task.stats.execute_ms,
+                    prefetch_ms=task.stats.prefetch_ms,
                 )
             # publish the terminal state LAST: it flips X-Complete on
             # the result stream, and the coordinator reads the final
@@ -401,7 +432,9 @@ class WorkerServer:
                 trace_id, task.spec.task_id, self.node_id,
                 task.state, task.stats.wall_ms,
             )
-            # free this query's batch-staging reservations
+            # unpin replicated/whole-table cache entries this task
+            # used, then free its batch-staging reservations
+            self.runner.release_pins(task.stats)
             self.memory_pool.release(task.spec.query_id)
 
     def _execute(self, task: _Task) -> None:
@@ -453,39 +486,49 @@ class WorkerServer:
             for lo in range(spec.split_start, spec.split_end, batch)
         ] or [(spec.split_start, spec.split_end)]
 
-        def run_batch(lo: int, hi: int):
-            # concurrent drivers run on pool threads: point each at the
-            # task's stats sink (thread-local on the runner)
+        def stage_batch(lo: int, hi: int):
+            """Stage the partitioned scan's [lo, hi) batch through the
+            device-resident split cache (LocalQueryRunner.stage_split:
+            one fixed capacity bucket per batch size, so every full
+            batch reuses one compiled program; uncached batches
+            reserve their live residency under the query, cached ones
+            are pinned against eviction until released)."""
+            # staging may run on a prefetch/pool thread: point it at
+            # the task's stats sink (thread-local on the runner)
             self.runner._qs_local.value = task.stats
-            pages = []
-            staged_bytes = 0
-            for s in scans:
-                if s is part_scan:
-                    t_stage = time.perf_counter()
-                    payload = self._load_range(s, lo, hi)
-                    # fixed capacity bucket: every full batch reuses one
-                    # compiled program
-                    page = stage_page(payload, dict(s.schema))
-                    # account the staged batch's live residency
-                    staged_bytes = sum(
-                        int(b.data.nbytes) for b in page.blocks
-                    )
-                    self.memory_pool.reserve(spec.query_id, staged_bytes)
-                    # task.cond guards the stats accumulators: with
-                    # task_concurrency > 1 concurrent drivers race the
-                    # read-modify-write (+=) and would drop updates
-                    with task.cond:
-                        task.stats.staging_ms += (
-                            time.perf_counter() - t_stage
-                        ) * 1000.0
-                        task.stats.input_rows += hi - lo
-                        task.stats.input_bytes += staged_bytes
-                    REGISTRY.distribution("worker.staging_bytes").add(
-                        staged_bytes
-                    )
-                    pages.append(page)
-                else:
-                    pages.append(repl_pages[id(s)])
+            fetched = []
+
+            def read_range():
+                fetched.append(True)
+                return self._load_range(part_scan, lo, hi)
+
+            page, release = self.runner.stage_split(
+                part_scan, lo, hi, bucket_capacity(hi - lo),
+                owner=spec.query_id,
+                page_source=read_range,
+            )
+            # one accounting unit (data + validity + offsets), same as
+            # the pool reservation stage_split made
+            staged_bytes = page_nbytes(page)
+            # task.cond guards the stats accumulators: with
+            # task_concurrency > 1 concurrent drivers race the
+            # read-modify-write (+=) and would drop updates
+            with task.cond:
+                task.stats.input_rows += hi - lo
+                task.stats.input_bytes += staged_bytes
+            if fetched:
+                # only REAL staging traffic counts — a cache hit moved
+                # zero bytes host->device
+                REGISTRY.distribution("worker.staging_bytes").add(
+                    staged_bytes
+                )
+            return page, release
+
+        def exec_batch(split_page, release):
+            pages = [
+                split_page if s is part_scan else repl_pages[id(s)]
+                for s in scans
+            ]
             t_exec = time.perf_counter()
             try:
                 out = self.runner._run_with_pages(root, scans, pages)
@@ -497,7 +540,12 @@ class WorkerServer:
                     task.stats.execute_ms += (
                         time.perf_counter() - t_exec
                     ) * 1000.0
-                self.memory_pool.release(spec.query_id, staged_bytes)
+                release()
+
+        def run_batch(lo: int, hi: int):
+            self.runner._qs_local.value = task.stats
+            page, release = stage_batch(lo, hi)
+            return exec_batch(page, release)
 
         def emit(out) -> None:
             if spec.n_partitions > 1:
@@ -506,8 +554,49 @@ class WorkerServer:
             _offer_chunked(task, cols, n)
 
         if spec.task_concurrency <= 1 or len(ranges) <= 1:
-            for lo, hi in ranges:
-                emit(run_batch(lo, hi))
+            # pipelined prefetch staging (staging_prefetch_depth /
+            # tier-1 staging.prefetch-depth): a background host thread
+            # stages split N+1 while the jitted fragment for split N
+            # runs on device — compute and transfer overlap instead of
+            # alternating. Depth 0 is the exact serial path. The
+            # coordinator ships the client session's value on the spec
+            # (like page_capacity / task_concurrency); -1 = unset
+            depth = (
+                spec.prefetch_depth
+                if spec.prefetch_depth >= 0
+                else int(
+                    self.runner.session.get("staging_prefetch_depth")
+                )
+            )
+            from presto_tpu.exec.staging import prefetch_iter
+
+            def staged_ahead(rng):
+                t0 = time.perf_counter()
+                page, release = stage_batch(*rng)
+                if depth > 0:
+                    with task.cond:
+                        task.stats.prefetch_ms += (
+                            time.perf_counter() - t0
+                        ) * 1000.0
+                return page, release
+
+            def drop_staged(entry):
+                # a prefetched-but-never-executed batch surrenders its
+                # residency (pool reservation or cache pin) — the task
+                # is failing/aborting and the task-end release-all has
+                # not run yet (prefetch_iter's abandonment contract)
+                entry[1]()
+
+            batches = prefetch_iter(
+                ranges, staged_ahead, depth, on_drop=drop_staged
+            )
+            try:
+                for page, release in batches:
+                    emit(exec_batch(page, release))
+            finally:
+                # deterministic close: joins the prefetch thread and
+                # drops queued batches BEFORE _run_task's release-all
+                batches.close()
             return
         from concurrent.futures import ThreadPoolExecutor
 
@@ -785,11 +874,29 @@ def _make_handler(worker: WorkerServer):
                         400, {"error": f"no output buffer {part}"}
                     )
                 # pulling token N acks pages < N (frees buffer slots and
-                # unblocks the producer — the reference's token-advance ack)
-                t.ack_below(token, part)
-                pages = t.parts[part]
-                if token < len(pages) and pages[token] is not None:
-                    body = pages[token]
+                # unblocks the producer — the reference's token-advance
+                # ack). A pipelined client sends an explicit X-Ack floor
+                # instead: its speculative in-flight request for token
+                # N+k must NOT free pages it hasn't consumed yet.
+                ack_hdr = self.headers.get("X-Ack")
+                t.ack_below(
+                    int(ack_hdr) if ack_hdr is not None else token,
+                    part,
+                )
+                # snapshot (page, count, state) ATOMICALLY: reading
+                # len(pages) then state unlocked races the producer's
+                # final append + FINISHED publish — a 204 with
+                # X-Complete=true would silently drop the last page
+                # (pipelined pulls keep a beyond-the-end token in
+                # flight, so the race window is hit on every pull)
+                with t.cond:
+                    pages = t.parts[part]
+                    body = (
+                        pages[token] if token < len(pages) else None
+                    )
+                    n_pages = len(pages)
+                    state = t.state
+                if body is not None:
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "application/x-presto-tpu-page"
@@ -799,8 +906,7 @@ def _make_handler(worker: WorkerServer):
                     self.send_header(
                         "X-Complete",
                         "true"
-                        if t.state == "FINISHED"
-                        and token + 1 >= len(pages)
+                        if state == "FINISHED" and token + 1 >= n_pages
                         else "false",
                     )
                     self.end_headers()
@@ -812,7 +918,9 @@ def _make_handler(worker: WorkerServer):
                 self.send_header("X-Next-Token", str(token))
                 self.send_header(
                     "X-Complete",
-                    "true" if t.state == "FINISHED" else "false",
+                    "true"
+                    if state == "FINISHED" and token >= n_pages
+                    else "false",
                 )
                 self.end_headers()
                 return

@@ -177,6 +177,18 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             False,
         ),
         PropertyMetadata(
+            "staging_prefetch_depth",
+            "Split batches staged ahead on a background host thread "
+            "while the device executes the current batch (pipelined "
+            "prefetch staging: compute/transfer overlap on the worker "
+            "hot path). 0 disables — the serial stage->run->stage "
+            "path, bit-identical results. Tier-1 twin: "
+            "staging.prefetch-depth",
+            int,
+            2,
+            _non_negative("staging_prefetch_depth"),
+        ),
+        PropertyMetadata(
             "max_fragment_weight",
             "Largest plan weight compiled as ONE XLA program; heavier "
             "plans execute stage-at-a-time with device-resident "
@@ -306,6 +318,14 @@ class NodeConfig:
         "rpc.retries": int,
         "rpc.backoff-base-s": float,
         "rpc.backoff-max-s": float,
+        # exchange pull pipelining: token-acked page-pull requests kept
+        # in flight per pull loop (1 = strict request->ack->request)
+        "rpc.pull-depth": int,
+        # device-resident split cache: LRU byte budget for staged pages
+        # kept across queries (0 disables), and the number of split
+        # batches prefetch-staged ahead of device execution
+        "staging.cache-bytes": str,
+        "staging.prefetch-depth": int,
         # worker->coordinator announce cadence (healthy interval; the
         # failure backoff grows from it) and per-announce timeout
         "announcement.interval-s": float,

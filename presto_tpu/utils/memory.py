@@ -53,6 +53,15 @@ class MemoryPool:
         self._lock = threading.Lock()
         self.kill_largest = kill_largest
         self._dead: set = set()
+        #: pressure hooks: callables ``(bytes_needed) -> bytes_freed``
+        #: tried BEFORE the kill-largest policy when a reservation
+        #: would exceed the limit — droppable holders (the split
+        #: cache) yield their bytes to running queries. Called with no
+        #: pool lock held.
+        self._pressure_hooks: list = []
+
+    def add_pressure_hook(self, hook) -> None:
+        self._pressure_hooks.append(hook)
 
     def mark_dead(self, query_id: str) -> None:
         """A killed query's next reservation fails immediately — the
@@ -62,7 +71,10 @@ class MemoryPool:
             self._dead.add(query_id)
 
     def reserve(self, query_id: str, nbytes: int) -> None:
-        for attempt in (0, 1):
+        # escalation ladder on exhaustion: (0) ask pressure hooks —
+        # droppable holders like the split cache — to free bytes,
+        # (1) invoke the kill-largest policy, (2) fail the reservation
+        for attempt in (0, 1, 2):
             with self._lock:
                 if query_id in self._dead:
                     raise MemoryLimitExceeded(
@@ -79,7 +91,15 @@ class MemoryPool:
                     self._used, key=self._used.get, default=None
                 )
                 holders = dict(self._used)
-            if attempt == 0 and self.kill_largest is not None:
+            if attempt == 0:
+                needed = total + nbytes - self.limit
+                freed = 0
+                for hook in list(self._pressure_hooks):
+                    freed += int(hook(needed - freed))
+                    if freed >= needed:
+                        break
+                continue  # re-check headroom (kill policy is next)
+            if attempt == 1 and self.kill_largest is not None:
                 victim = self.kill_largest(holders, query_id)
                 if victim is not None:
                     self.release(victim)

@@ -235,12 +235,17 @@ def synthesize_task_spans(
     end: float,
     staging_ms: float,
     execute_ms: float,
+    prefetch_ms: float = 0.0,
 ) -> List[dict]:
     """Worker-side span tree for one task, synthesized from its phase
     accumulators: a ``task`` span with ``staging`` and ``execute``
-    children. Batches interleave staging and execution, so the children
-    carry aggregate durations anchored at the task start rather than
-    one span per batch (bounded payload however many splits streamed).
+    children (plus a ``stage:prefetch`` child when pipelined prefetch
+    staging overlapped host transfers with device execution — its
+    duration co-anchored with ``execute`` makes the overlap visible in
+    EXPLAIN ANALYZE). Batches interleave staging and execution, so the
+    children carry aggregate durations anchored at the task start
+    rather than one span per batch (bounded payload however many
+    splits streamed).
     """
     task_span = Span(
         trace_id=trace_id,
@@ -252,7 +257,11 @@ def synthesize_task_spans(
         attrs={"task_id": task_id, "node_id": node_id},
     )
     out = [task_span]
-    for name, dur_ms in (("staging", staging_ms), ("execute", execute_ms)):
+    for name, dur_ms in (
+        ("staging", staging_ms),
+        ("stage:prefetch", prefetch_ms),
+        ("execute", execute_ms),
+    ):
         if dur_ms <= 0:
             continue
         out.append(

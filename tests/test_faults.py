@@ -418,7 +418,12 @@ def test_speculation_winner_loser_accounting(oracle):
                         "method": "GET",
                         "url": f":{ws[1].port}/v1/task",
                         "delay_s": 3.0,
-                        "count": 1,
+                        # pipelined pulls (rpc.pull-depth) keep 2
+                        # requests in flight and ride out ONE slow
+                        # response; a genuine straggler needs every
+                        # in-flight pull + the stall-path status poll
+                        # delayed
+                        "count": 4,
                     }
                 ],
             }

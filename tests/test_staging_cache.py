@@ -1,0 +1,507 @@
+"""Device-resident split cache + pipelined prefetch staging.
+
+Covers the worker hot-path optimization end to end: LRU/byte-budget
+semantics of :class:`presto_tpu.exec.staging.SplitCache` (enforced
+through the memory accountant), cache-hit correctness vs fresh
+staging, invalidation on writable-connector writes, prefetch-depth=0
+equivalence plus the ``stage:prefetch``/``execute`` span overlap,
+pipelined exchange pulls (``rpc.pull-depth``), adaptive exchange
+compression, and the ``tools/check_device_puts.py`` staging lint.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import (
+    SplitCache,
+    page_nbytes,
+    prefetch_iter,
+    stage_page,
+)
+from presto_tpu.session import NodeConfig, Session
+from presto_tpu.utils.memory import MemoryPool
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"),
+)
+
+
+def _page(n=1024, fill=1):
+    return stage_page(
+        {"x": np.full(n, fill, np.int64)}, {"x": T.BIGINT}
+    )
+
+
+def _h(table):
+    return TableHandle("tpch", "tiny", table)
+
+
+# ------------------------------------------------- SplitCache semantics
+
+
+def test_lru_eviction_respects_budget_and_pool():
+    pool = MemoryPool(1 << 20)
+    page = _page()
+    nbytes = page_nbytes(page)
+    cache = SplitCache(budget_bytes=int(nbytes * 2.5), pool=pool)
+    k1, k2, k3, k4 = [(_h("a"), i) for i in range(4)]
+    assert cache.put(k1, _page(fill=1))
+    assert cache.put(k2, _page(fill=2))
+    # both fit; the pool's shared owner carries exactly the cache bytes
+    assert pool.used_bytes(SplitCache.OWNER) == cache.used_bytes()
+    assert cache.put(k3, _page(fill=3))  # evicts k1 (LRU)
+    assert cache.evictions == 1
+    assert cache.used_bytes() <= cache.budget
+    assert pool.used_bytes(SplitCache.OWNER) == cache.used_bytes()
+    assert cache.get(k1) is None
+    assert cache.get(k2) is not None  # refreshes k2
+    assert cache.put(k4, _page(fill=4))  # now evicts k3, not k2
+    assert cache.get(k3) is None
+    assert cache.get(k2) is not None
+    stats = cache.stats()
+    assert stats["evictions"] == 2
+    assert stats["bytes"] == pool.used_bytes(SplitCache.OWNER)
+
+
+def test_oversized_entry_never_cached():
+    cache = SplitCache(budget_bytes=100)
+    assert not cache.put((_h("a"), 0), _page())
+    assert cache.used_bytes() == 0
+
+
+def test_cache_fill_never_kills_a_query():
+    """try_reserve discipline: a full pool means "not cached", not a
+    kill-largest eviction of a running query's reservation."""
+    pool = MemoryPool(10_000)
+    pool.reserve("q_running", 9_000)
+    cache = SplitCache(budget_bytes=1 << 20, pool=pool)
+    assert not cache.put((_h("a"), 0), _page())  # 8KB won't fit
+    assert pool.used_bytes("q_running") == 9_000
+    assert cache.used_bytes() == 0
+
+
+def test_query_reservation_reclaims_cache_under_pressure():
+    """A query's raising reserve evicts droppable cache bytes (the
+    MemoryPool pressure hook) instead of failing or killing a query
+    while gigabytes of cache sit idle."""
+    page = _page()
+    nbytes = page_nbytes(page)
+    pool = MemoryPool(int(nbytes * 3.5))
+    cache = SplitCache(budget_bytes=1 << 20, pool=pool)
+    for i in range(3):
+        assert cache.put((_h("a"), i), _page(fill=i))
+    # pool nearly full of cache; a query needs 2 pages' worth
+    pool.reserve("q_live", int(nbytes * 2))
+    assert pool.used_bytes("q_live") == int(nbytes * 2)
+    assert cache.stats()["entries"] <= 1  # LRU entries yielded
+    assert (
+        pool.used_bytes(SplitCache.OWNER) == cache.used_bytes()
+    )
+
+
+def test_pinned_entries_survive_pressure_eviction():
+    """An entry serving an EXECUTING batch is pinned: eviction must
+    not release its pool accounting while the page is live on device
+    (over-commit). Unpinning makes it evictable again."""
+    page = _page()
+    nbytes = page_nbytes(page)
+    pool = MemoryPool(int(nbytes * 4.5))
+    cache = SplitCache(budget_bytes=1 << 20, pool=pool)
+    keys = [(_h("a"), i) for i in range(3)]
+    for i, k in enumerate(keys):
+        assert cache.put(k, _page(fill=i))
+    assert cache.get(keys[0], pin=True) is not None
+    # pressure for ~2 pages: LRU order would take k0 first, but it is
+    # pinned — k1/k2 go instead
+    pool.reserve("q_live", int(nbytes * 3))
+    assert cache.get(keys[0]) is not None  # pinned entry survived
+    assert cache.get(keys[1]) is None and cache.get(keys[2]) is None
+    assert pool.used_bytes(SplitCache.OWNER) == cache.used_bytes()
+    # fully pinned cache cannot satisfy further pressure: reserve fails
+    with pytest.raises(Exception):
+        pool.reserve("q_more", int(nbytes * 2))
+    cache.unpin(keys[0])
+    pool.reserve("q_more", int(nbytes * 1.2))  # now evictable
+    assert cache.stats()["entries"] == 0
+
+
+def test_put_does_not_evict_when_pool_reservation_fails():
+    """try_reserve failure must not have emptied the cache first."""
+    page = _page()
+    nbytes = page_nbytes(page)
+    pool = MemoryPool(int(nbytes * 2.5))
+    cache = SplitCache(budget_bytes=int(nbytes * 1.5), pool=pool)
+    assert cache.put((_h("a"), 0), _page())
+    pool.reserve("q_live", int(nbytes * 1.2))  # pool now tight
+    assert not cache.put((_h("a"), 1), _page())
+    assert cache.stats()["entries"] == 1  # existing entry survived
+
+
+def test_invalidate_releases_reservations():
+    pool = MemoryPool(1 << 20)
+    cache = SplitCache(budget_bytes=1 << 20, pool=pool)
+    cache.put((_h("a"), 0), _page())
+    cache.put((_h("a"), 1), _page())
+    cache.put((_h("b"), 0), _page())
+    assert cache.invalidate(_h("a")) == 2
+    assert cache.stats()["entries"] == 1
+    assert pool.used_bytes(SplitCache.OWNER) == cache.used_bytes()
+
+
+# ------------------------------------------- runner integration (hits)
+
+
+def test_repeated_query_hits_cache_and_skips_connector():
+    r = LocalQueryRunner()
+    conn = r.catalogs.get("tpch")
+    calls = []
+    orig = conn.create_page_source
+
+    def spy(split, columns):
+        calls.append(split)
+        return orig(split, columns)
+
+    q = "select count(*) as c, sum(r_regionkey) as s from tpch.tiny.region"
+    conn.create_page_source = spy
+    try:
+        first = r.execute(q)
+        assert len(calls) > 0
+        calls.clear()
+        second = r.execute(q)
+        assert calls == [], "warm run must not touch the connector"
+    finally:
+        conn.create_page_source = orig
+    assert first.rows() == second.rows()
+    assert r.split_cache.hits >= 1
+    # per-query stats carry the hit count
+    warm_qs = r.history.snapshot()[-1]
+    assert warm_qs.staging_cache_hits >= 1
+
+
+def test_cache_budget_enforced_through_accountant_under_load():
+    """With a budget far below the working set, the cache never
+    exceeds staging.cache-bytes (asserted via the memory pool) and
+    eviction keeps queries correct."""
+    pool = MemoryPool(1 << 30)
+    budget = 200_000  # region+nation fit; lineitem columns do not
+    r = LocalQueryRunner(memory_pool=pool, staging_cache_bytes=budget)
+    queries = [
+        "select count(*) as c from tpch.tiny.region",
+        "select count(*) as c from tpch.tiny.nation",
+        "select count(*) as c from tpch.tiny.supplier",
+        "select sum(l_quantity) as s from tpch.tiny.lineitem",
+        "select count(*) as c from tpch.tiny.region",
+    ]
+    expect = [(5,)], [(25,)], [(100,)], None, [(5,)]
+    for q, exp in zip(queries * 2, list(expect) * 2):
+        res = r.execute(q)
+        if exp is not None:
+            assert res.rows() == exp
+        assert r.split_cache.used_bytes() <= budget
+        assert pool.used_bytes(SplitCache.OWNER) <= budget
+        assert (
+            pool.used_bytes(SplitCache.OWNER)
+            == r.split_cache.used_bytes()
+        )
+
+
+def test_memory_connector_write_invalidates_cache():
+    from presto_tpu.connectors import create_connector
+
+    r = LocalQueryRunner()
+    r.catalogs.register("mem", create_connector("memory"))
+    r.execute("create table mem.default.t (x bigint)")
+    r.execute("insert into mem.default.t values (1), (2)")
+    q = "select x from mem.default.t order by x"
+    assert r.execute(q).rows() == [(1,), (2,)]
+    handle = TableHandle("mem", "default", "t")
+    assert any(
+        k[0] == handle for k in r.split_cache._entries
+    ), "memory-connector page should be cached after a scan"
+    r.execute("insert into mem.default.t values (3)")
+    assert not any(
+        k[0] == handle for k in r.split_cache._entries
+    ), "a write must invalidate the table's cached pages"
+    assert r.execute(q).rows() == [(1,), (2,), (3,)]
+    r.execute("delete from mem.default.t where x = 2")
+    assert r.execute(q).rows() == [(1,), (3,)]
+
+
+# -------------------------------------------------- prefetch pipeline
+
+
+def test_prefetch_iter_orders_and_depth_zero_equivalence():
+    items = list(range(7))
+    serial = list(prefetch_iter(items, lambda x: x * x, 0))
+    piped = list(prefetch_iter(items, lambda x: x * x, 2))
+    assert serial == piped == [x * x for x in items]
+
+
+def test_prefetch_iter_propagates_errors():
+    def load(x):
+        if x == 3:
+            raise ValueError("boom")
+        return x
+
+    got = []
+    with pytest.raises(ValueError, match="boom"):
+        for v in prefetch_iter(range(6), load, 2):
+            got.append(v)
+    assert got == [0, 1, 2]
+
+
+def _streamed_runner(depth):
+    return LocalQueryRunner(
+        session=Session(
+            properties={
+                "max_device_rows": 16_384,
+                "page_capacity": 4_096,
+                "staging_prefetch_depth": depth,
+            }
+        )
+    )
+
+
+STREAMED_Q = (
+    "select l_returnflag, sum(l_quantity) as s, count(*) as c "
+    "from tpch.tiny.lineitem group by l_returnflag order by l_returnflag"
+)
+
+
+def test_prefetch_depth_zero_bit_identical():
+    rows0 = _streamed_runner(0).execute(STREAMED_Q).rows()
+    rows2 = _streamed_runner(2).execute(STREAMED_Q).rows()
+    assert rows0 == rows2
+
+
+def test_prefetch_spans_overlap_execute():
+    """The trace of a multi-split scan shows stage:prefetch spans
+    overlapping the open execute span (the compute/transfer overlap
+    EXPLAIN ANALYZE is supposed to make visible)."""
+    r = _streamed_runner(2)
+    r.execute(STREAMED_Q)
+    qs = r.history.snapshot()[-1]
+    spans = qs.trace.spans()
+    execute = next(s for s in spans if s.name == "execute")
+    prefetch = [s for s in spans if s.name == "stage:prefetch"]
+    assert prefetch, "prefetch staging must be traced"
+    overlapping = [
+        s
+        for s in prefetch
+        if s.start < execute.end and execute.start < s.end
+    ]
+    assert overlapping, "prefetch spans must overlap execution"
+
+
+# ------------------------------------- worker hot path (distributed)
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+def test_worker_warm_task_reports_cache_hits():
+    from presto_tpu.server import CoordinatorServer, WorkerServer
+    from presto_tpu.server.client import PrestoTpuClient
+
+    coord = CoordinatorServer().start()
+    w = WorkerServer(coordinator_uri=coord.uri).start()
+    try:
+        assert w.runner.session.get("stream_split_cache") is True
+        _wait_workers(coord, 1)
+        client = PrestoTpuClient(coord.uri, timeout_s=60)
+        q = "select count(*) as c from tpch.tiny.orders"
+        cold = client.execute(q)
+        assert cold.rows() == [(15000,)]
+        warm = client.execute(q)
+        assert warm.rows() == [(15000,)]
+        info = client.query_info(warm.query_id)
+        hits = sum(
+            t.get("staging_cache_hits", 0)
+            for st in info["stages"]
+            for t in st["tasks"]
+        )
+        assert hits > 0, "warm task must serve splits from the cache"
+        assert info.get("staging_cache_hits", 0) > 0  # query rollup
+    finally:
+        w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+def test_worker_cache_disabled_by_zero_budget():
+    from presto_tpu.server import WorkerServer
+
+    w = WorkerServer(
+        config=NodeConfig({"staging.cache-bytes": "0"})
+    )
+    try:
+        assert w.runner.session.get("stream_split_cache") is False
+        assert w.runner.split_cache.budget == 0
+    finally:
+        w.shutdown(graceful=False)
+
+
+# ----------------------------------------- pipelined exchange pulls
+
+
+@pytest.mark.parametrize("pull_depth", [1, 2, 3])
+def test_pull_depth_results_identical(monkeypatch, pull_depth):
+    """Multi-page pulls return every page exactly once at any depth
+    (the X-Ack floor keeps speculative requests from freeing
+    unconsumed pages)."""
+    from presto_tpu.server import CoordinatorServer, WorkerServer
+    from presto_tpu.server import worker as worker_mod
+    from presto_tpu.server.client import PrestoTpuClient
+
+    monkeypatch.setattr(worker_mod, "PAGE_ROWS", 512)
+    coord = CoordinatorServer(
+        config=NodeConfig({"rpc.pull-depth": str(pull_depth)})
+    ).start()
+    w = WorkerServer(coordinator_uri=coord.uri).start()
+    try:
+        _wait_workers(coord, 1)
+        client = PrestoTpuClient(coord.uri, timeout_s=60)
+        res = client.execute(
+            "select c_custkey from tpch.tiny.customer"
+        )
+        got = sorted(r[0] for r in res.rows())
+        assert len(got) == 1500
+        assert got == list(range(1, 1501))
+    finally:
+        w.shutdown(graceful=False)
+        coord.shutdown()
+
+
+# ------------------------------------------- adaptive compression
+
+
+def test_wire_small_buffer_ships_raw():
+    from presto_tpu.server import pages_wire
+
+    data = np.arange(4, dtype=np.int64)
+    buf = pages_wire.serialize_page([("x", data, None, T.BIGINT, None)], 4)
+    import json as _json
+    import struct
+
+    (hlen,) = struct.unpack_from("<I", buf, 4)
+    header = _json.loads(buf[8 : 8 + hlen].decode())
+    col = header["columns"][0]
+    assert col["enc"] == "raw"
+    assert col["comp_size"] == col["raw_size"]
+    payload, schema, n = pages_wire.deserialize_page(buf)
+    assert n == 4
+    np.testing.assert_array_equal(payload["x"], data)
+
+
+def test_wire_compressible_buffer_still_zlib():
+    from presto_tpu.server import pages_wire
+
+    data = np.zeros(100_000, dtype=np.int64)
+    buf = pages_wire.serialize_page(
+        [("x", data, None, T.BIGINT, None)], len(data)
+    )
+    import json as _json
+    import struct
+
+    (hlen,) = struct.unpack_from("<I", buf, 4)
+    col = _json.loads(buf[8 : 8 + hlen].decode())["columns"][0]
+    assert col["enc"] == "zlib"
+    assert col["comp_size"] < col["raw_size"]
+    payload, _schema, _n = pages_wire.deserialize_page(buf)
+    np.testing.assert_array_equal(payload["x"], data)
+
+
+def test_wire_incompressible_buffer_skips_zlib():
+    from presto_tpu.server import pages_wire
+
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 2**62, size=100_000, dtype=np.int64)
+    buf = pages_wire.serialize_page(
+        [("x", data, None, T.BIGINT, None)], len(data)
+    )
+    import json as _json
+    import struct
+
+    (hlen,) = struct.unpack_from("<I", buf, 4)
+    col = _json.loads(buf[8 : 8 + hlen].decode())["columns"][0]
+    assert col["enc"] == "raw"
+    payload, _schema, _n = pages_wire.deserialize_page(buf)
+    np.testing.assert_array_equal(payload["x"], data)
+
+
+def test_wire_legacy_frame_without_enc_decodes():
+    """Backward compat: a header with no enc fields reads as zlib."""
+    import json as _json
+    import struct
+    import zlib
+
+    from presto_tpu.server import pages_wire
+
+    data = np.arange(1000, dtype=np.int64)
+    raw = data.tobytes()
+    comp = zlib.compress(raw, 1)
+    header = {
+        "nrows": 1000,
+        "columns": [
+            {
+                "name": "x",
+                "type": "bigint",
+                "np_dtype": data.dtype.str,
+                "comp_size": len(comp),
+                "raw_size": len(raw),
+                "crc32": zlib.crc32(raw),
+            }
+        ],
+    }
+    hj = _json.dumps(header).encode()
+    buf = b"".join([b"PTP1", struct.pack("<I", len(hj)), hj, comp])
+    payload, schema, n = pages_wire.deserialize_page(buf)
+    assert n == 1000
+    np.testing.assert_array_equal(payload["x"], data)
+
+
+# ------------------------------------------------------ staging lint
+
+
+def test_device_put_lint_clean():
+    import check_device_puts
+
+    assert check_device_puts.main([]) == 0
+
+
+def test_device_put_lint_flags_raw_staging(tmp_path):
+    import check_device_puts
+
+    (tmp_path / "anywhere.py").write_text(
+        "import jax\njax.device_put([1, 2, 3])\n"
+    )
+    server_dir = tmp_path / "server"
+    server_dir.mkdir()
+    (server_dir / "boundary.py").write_text(
+        "import jax.numpy as jnp\njnp.asarray([1, 2, 3])\n"
+    )
+    assert check_device_puts.main([str(tmp_path)]) == 1
+
+
+def test_ops_trace_time_asarray_allowed(tmp_path):
+    import check_device_puts
+
+    ops_dir = tmp_path / "ops"
+    ops_dir.mkdir()
+    (ops_dir / "kernel.py").write_text(
+        "import jax.numpy as jnp\njnp.asarray([1, 2, 3])\n"
+    )
+    assert check_device_puts.main([str(tmp_path)]) == 0
