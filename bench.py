@@ -89,6 +89,18 @@ select o_orderkey, o_custkey,
 from tpch.SCHEMA.orders
 """
 
+# TPC-H Q17-style SELECTIVE star join (the dynamic-filtering headline
+# shape): the tiny filtered part build prunes the lineitem probe before
+# the join. Run with a small fragment budget so the stage-at-a-time
+# executor builds the runtime filter; the emitted line reports
+# dynamic_filter_rows_pruned alongside rows/s.
+_Q17SEL = """
+select sum(l_extendedprice) as total
+from tpch.SCHEMA.lineitem, tpch.SCHEMA.part
+where l_partkey = p_partkey
+  and p_brand = 'Brand#23' and p_container = 'MED BOX'
+"""
+
 _Q18 = """
 select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
   sum(l_quantity) as total_qty
@@ -123,29 +135,44 @@ def _bench_query(
         runner.execute_plan(plan)
         times.append(time.perf_counter() - t0)
     best = min(times)
-    return driving_rows / best, best
+    # n_runs = every plan execution above (warmup + verify + timed):
+    # the source of truth for per-iteration counter-delta metrics
+    return driving_rows / best, best, WARMUP + 1 + len(times)
 
 
 def _ensure_backend() -> str:
     """Backend-fallback probe (BENCH_r05 fix): the axon TPU plugin can
     be installed but unreachable ("Unable to initialize backend
-    'axon'"), which used to kill the whole run and report 0 rows/s.
-    Probe device init; on failure force the CPU backend (the config
-    update, not the env var — the plugin overrides JAX_PLATFORMS on
-    this image) and retry. Returns the platform actually used, so every
-    result line is tagged with the backend it measured."""
+    'axon'"), which used to kill the whole run and report 0 rows/s —
+    and a plugin that PASSES the device probe can still die at the
+    first real dispatch (tunnel half-up), so the probe runs an actual
+    tiny computation, not just device enumeration. On failure force
+    the CPU backend (the config update, not the env var — the plugin
+    overrides JAX_PLATFORMS on this image) and retry. Returns the
+    platform actually used, so every result line is tagged with the
+    backend it measured."""
     import jax
+    import jax.numpy as jnp
+
+    def probe() -> str:
+        platform = jax.devices()[0].platform
+        # first REAL call: trace + compile + execute + fetch — the
+        # full dispatch path a query exercises (an if, not an assert:
+        # python -O must not strip the probe)
+        if int(jnp.arange(3).sum()) != 3:
+            raise RuntimeError("backend computed a wrong result")
+        return platform
 
     try:
-        return jax.devices()[0].platform
-    except RuntimeError as e:
+        return probe()
+    except Exception as e:
         print(
             f"bench: backend init failed ({e}); falling back to CPU",
             file=sys.stderr,
             flush=True,
         )
         jax.config.update("jax_platforms", "cpu")
-        return jax.devices()[0].platform
+        return probe()
 
 
 def main() -> None:
@@ -180,7 +207,7 @@ def main() -> None:
         cold_s = time.perf_counter() - t0
         # warm: steady state on the same process — split cache serves
         # the staged pages device-resident, compile cache hits
-        rps, warm_s = _bench_query(runner, sql, nrows, expect_rows=4)
+        rps, warm_s, _ = _bench_query(runner, sql, nrows, expect_rows=4)
         vs = (
             rps / CPU_BASELINE_ROWS_PER_SEC
             if CPU_BASELINE_ROWS_PER_SEC
@@ -226,6 +253,12 @@ def main() -> None:
          None, None),
         ("tpch_q5_sf1_rows_per_sec", _Q5, "sf1", "lineitem", 5,
          None, None),
+        # selective star join under a small fragment budget: the
+        # stage-at-a-time executor builds the dynamic filter from the
+        # part build side and prunes lineitem probe rows pre-join; the
+        # line reports dynamic_filter_rows_pruned
+        ("tpch_q17_selective_sf1_rows_per_sec", _Q17SEL, "sf1",
+         "lineitem", 1, {"max_fragment_weight": "6"}, None),
         ("tpch_q3_sf10_rows_per_sec", _Q3, "sf10", "lineitem", 10,
          {"max_device_rows": str(1 << 27)}, 2),
         ("tpch_q5_sf10_rows_per_sec", _Q5, "sf10", "lineitem", 5,
@@ -276,9 +309,12 @@ def main() -> None:
             ):
                 continue
         try:
+            from presto_tpu.utils.metrics import REGISTRY as _REG
+
             saved = {
                 k: str(runner.session.get(k)) for k in (props or {})
             }
+            pruned0 = _REG.counter("dynamic_filter.rows_pruned").total
             try:
                 for k, v in (props or {}).items():
                     runner.session.set(k, v)
@@ -289,7 +325,7 @@ def main() -> None:
                 else:
                     nrows = _table_rows(runner, schema, driving)
                     q = sql.replace("SCHEMA", schema)
-                rps, best = _bench_query(
+                rps, best, n_runs = _bench_query(
                     runner,
                     q,
                     nrows,
@@ -299,18 +335,25 @@ def main() -> None:
             finally:
                 for k, v in saved.items():
                     runner.session.set(k, v)
-            print(
-                json.dumps(
-                    {
-                        "metric": metric,
-                        "value": round(rps),
-                        "unit": "rows/s",
-                        "seconds": round(best, 3),
-                        "backend": backend,
-                    }
-                ),
-                flush=True,
-            )
+            line = {
+                "metric": metric,
+                "value": round(rps),
+                "unit": "rows/s",
+                "seconds": round(best, 3),
+                "backend": backend,
+            }
+            if "q17_selective" in metric:
+                # per-iteration pruning (the counter accumulates over
+                # every plan execution of this config; n_runs is the
+                # count _bench_query actually performed)
+                total = (
+                    _REG.counter("dynamic_filter.rows_pruned").total
+                    - pruned0
+                )
+                line["dynamic_filter_rows_pruned"] = total // max(
+                    n_runs, 1
+                )
+            print(json.dumps(line), flush=True)
         except Exception as e:
             failed += 1
             print(

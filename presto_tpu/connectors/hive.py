@@ -47,6 +47,7 @@ from presto_tpu.connectors.spi import (
     Connector,
     ConnectorMetadata,
     ConnectorSplit,
+    RangeSet,
     SplitSource,
     TableHandle,
     TableStats,
@@ -268,16 +269,18 @@ class HiveConnector(Connector):
         excluded partitions (reference: TupleDomain reaching the hive
         split manager)."""
         files, part_types = self._layout(handle)
-        domains = {
-            col: set(vals)
+        # a column may carry SEVERAL domains (planner value set AND a
+        # dynamic-filter RangeSet): a file must satisfy all of them
+        domains: List[Tuple[str, object]] = [
+            (col, vals if isinstance(vals, RangeSet) else set(vals))
             for col, vals in constraint
             if col in part_types
-        }
+        ]
         splits: List[ConnectorSplit] = []
         for f in files:
             if not all(
                 _key_matches(f.keys[col], part_types[col], vals)
-                for col, vals in domains.items()
+                for col, vals in domains
             ):
                 continue
             lo = f.row_start
@@ -352,12 +355,22 @@ class HiveConnector(Connector):
     # hive partition values come from the PATH: one constant per file
 
 
-def _key_matches(raw: str, t: T.DataType, allowed: set) -> bool:
-    """Does a path key value satisfy a pushed value-set constraint?
-    BIGINT keys compare numerically — including string-carried integer
-    literals (the planner's IN-list coercion keeps '2024' as str);
-    anything unparseable keeps the file (over-retain, never
-    over-prune: the filter still applies)."""
+def _key_matches(raw: str, t: T.DataType, allowed) -> bool:
+    """Does a path key value satisfy a pushed constraint domain —
+    a value set, or a dynamic-filter :class:`RangeSet` (inclusive
+    numeric bounds)? BIGINT keys compare numerically — including
+    string-carried integer literals (the planner's IN-list coercion
+    keeps '2024' as str); anything unparseable keeps the file
+    (over-retain, never over-prune: the filter still applies)."""
+    if isinstance(allowed, RangeSet):
+        if t.name == "bigint":
+            try:
+                return allowed.lo <= int(raw) <= allowed.hi
+            except (TypeError, ValueError):
+                return True  # can't interpret: don't prune on it
+        # string/date/decimal path keys: no safe numeric ordering of
+        # the raw text — over-retain
+        return True
     if t.name == "bigint":
         out = False
         for v in allowed:

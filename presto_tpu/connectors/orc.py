@@ -86,6 +86,12 @@ class OrcConnector(Connector):
         self._metadata = _OrcMetadata(self)
         self._files: Dict[TableHandle, object] = {}
         self._offsets: Dict[TableHandle, List[int]] = {}
+        #: lazily-probed per-stripe (min, max) of numeric columns —
+        #: pyarrow exposes NO ORC column statistics, so the first
+        #: range-constrained enumeration reads the column once (the
+        #: same probe-and-cache discipline as _stripe_offsets) and
+        #: every later query prunes stripes for free
+        self._stripe_stats: Dict[tuple, List] = {}
 
     def metadata(self):
         return self._metadata
@@ -128,24 +134,79 @@ class OrcConnector(Connector):
             self._offsets[handle] = offs
         return offs
 
+    def _stripe_minmax(self, handle: TableHandle, col: str):
+        """Per-stripe (min, max) of one numeric column, probed once by
+        reading just that column per stripe and cached (ORC footers
+        carry these stats but pyarrow does not expose them). Entries
+        are None when a stripe has no non-null values."""
+        key = (handle, col)
+        cached = self._stripe_stats.get(key)
+        if cached is not None:
+            return cached
+        import numpy as np
+
+        f = self._file(handle)
+        out: List = []
+        for i in range(f.nstripes):
+            arr = f.read_stripe(i, columns=[col]).column(col)
+            vals = arr.to_numpy(zero_copy_only=False)
+            vals = vals[~_isnan_or_none(vals)]
+            if len(vals) == 0:
+                out.append(None)
+            else:
+                out.append((_pynum(np.min(vals)), _pynum(np.max(vals))))
+        self._stripe_stats[key] = out
+        return out
+
     def get_splits(
         self, handle: TableHandle, target_split_rows: int = 1 << 20,
         constraint=(),
     ) -> SplitSource:
         """Stripe-aligned splits (the reference's ORC split boundary),
         expressed as row ranges so the split protocol stays
-        format-agnostic."""
+        format-agnostic. Dynamic-filter :class:`RangeSet` constraints
+        on numeric columns prune whole stripes against the (lazily
+        probed, cached) per-stripe min/max — excluded stripes are
+        never decoded again."""
+        from presto_tpu.connectors.spi import RangeSet
+
         offs = self._stripe_offsets(handle)
         total = offs[-1]
-        splits: List[ConnectorSplit] = []
-        start = 0
-        for end in offs[1:]:
-            if end - start >= target_split_rows:
-                splits.append(ConnectorSplit(handle, start, end))
-                start = end
-        if total > start or not splits:
-            splits.append(ConnectorSplit(handle, start, total))
-        return SplitSource(splits)
+        n_stripes = len(offs) - 1
+        keep = [True] * n_stripes
+        schema = self._metadata.get_table_schema(handle)
+        for col, dom in constraint:
+            if not isinstance(dom, RangeSet) or n_stripes == 0:
+                continue
+            t = schema.get(col)
+            # plain numeric columns only: dates decode as datetime64
+            # and decimals as Decimal objects — neither compares with
+            # the RangeSet's native-repr ints (over-retain instead)
+            if (
+                t is None
+                or t.name not in ("bigint", "integer", "double", "real")
+                or not isinstance(dom.lo, (int, float))
+            ):
+                continue
+            try:
+                stats = self._stripe_minmax(handle, col)
+            except Exception:
+                continue  # unreadable probe: don't prune on it
+            for i, mm in enumerate(stats):
+                if mm is None:
+                    keep[i] = False  # all-null stripe: no key matches
+                elif mm[1] < dom.lo or mm[0] > dom.hi:
+                    keep[i] = False
+        from presto_tpu.connectors.spi import coalesce_kept_chunks
+
+        chunk_rows = [
+            offs[i + 1] - offs[i] for i in range(n_stripes)
+        ]
+        return SplitSource(
+            coalesce_kept_chunks(
+                handle, chunk_rows, keep, target_split_rows
+            )
+        )
 
     def create_page_source(
         self, split: ConnectorSplit, columns: Sequence[str]
@@ -187,6 +248,28 @@ class OrcConnector(Connector):
             arr = table.column(name)
             out[name] = arrow_column_to_payload(arr, schema[name])
         return out
+
+
+def _isnan_or_none(vals):
+    """Null mask of a to_numpy'd arrow column (object None / float NaN)."""
+    import numpy as np
+
+    if vals.dtype == object:
+        return np.asarray([v is None for v in vals], bool)
+    if vals.dtype.kind == "f":
+        return np.isnan(vals)
+    return np.zeros(len(vals), bool)
+
+
+def _pynum(v):
+    """numpy scalar -> exact python number (stats cache entries)."""
+    import numpy as np
+
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, (np.integer, int)):
+        return int(v)
+    raise ValueError(f"non-numeric stripe stat {type(v).__name__}")
 
 
 _WIDTHS = {

@@ -31,10 +31,43 @@ from presto_tpu.connectors.spi import (
     Connector,
     ConnectorMetadata,
     ConnectorSplit,
+    RangeSet,
     SplitSource,
     TableHandle,
     TableStats,
 )
+
+
+def rowgroup_matches(stats, domain) -> bool:
+    """May a row group with ``stats`` (pyarrow column statistics, or
+    None) contain rows satisfying ``domain`` (a value tuple or a
+    dynamic-filter :class:`RangeSet`)? Missing/non-numeric stats keep
+    the group — over-retain, never over-prune (the originating filter
+    still applies to every row read)."""
+    if isinstance(domain, RangeSet):
+        if (
+            stats is None
+            or not stats.has_min_max
+            or not isinstance(stats.min, (int, float))
+            or isinstance(stats.min, bool)
+            or not isinstance(domain.lo, (int, float))
+        ):
+            return True
+        return not (stats.max < domain.lo or stats.min > domain.hi)
+    # value set: an EMPTY set matches nothing (empty build side)
+    if not domain:
+        return False
+    if (
+        stats is None
+        or not stats.has_min_max
+        or not isinstance(stats.min, (int, float))
+        or isinstance(stats.min, bool)
+    ):
+        return True
+    vals = [v for v in domain if isinstance(v, (int, float))]
+    if len(vals) != len(domain):
+        return True  # non-numeric literals: don't prune on them
+    return any(stats.min <= v <= stats.max for v in vals)
 
 
 class _ParquetMetadata(ConnectorMetadata):
@@ -137,21 +170,51 @@ class ParquetConnector(Connector):
     ) -> SplitSource:
         """Row-group-aligned splits (the reference's parquet split
         boundary); expressed as row ranges so the engine's split
-        protocol stays format-agnostic."""
+        protocol stays format-agnostic. Row groups whose footer
+        min/max statistics cannot satisfy the pushed ``constraint``
+        (dynamic-filter RangeSets / value sets) produce no splits —
+        those rows are never read."""
         pf = self._file(handle)
         md = pf.metadata
-        splits: List[ConnectorSplit] = []
-        lo = 0
-        acc = 0
-        start = 0
-        for rg in range(md.num_row_groups):
-            acc += md.row_group(rg).num_rows
-            if acc - start >= target_split_rows:
-                splits.append(ConnectorSplit(handle, start, acc))
-                start = acc
-        if acc > start or not splits:
-            splits.append(ConnectorSplit(handle, start, acc))
-        return SplitSource(splits)
+        # constraint column -> row-group column index (once per call)
+        col_idx: Dict[str, int] = {}
+        if constraint and md.num_row_groups:
+            g0 = md.row_group(0)
+            names = {
+                g0.column(ci).path_in_schema: ci
+                for ci in range(g0.num_columns)
+            }
+            col_idx = {
+                col: names[col]
+                for col, _ in constraint
+                if col in names
+            }
+
+        def rg_matches(rg: int) -> bool:
+            if not col_idx:
+                return True
+            g = md.row_group(rg)
+            for col, dom in constraint:
+                ci = col_idx.get(col)
+                st = (
+                    g.column(ci).statistics if ci is not None else None
+                )
+                if not rowgroup_matches(st, dom):
+                    return False
+            return True
+
+        from presto_tpu.connectors.spi import coalesce_kept_chunks
+
+        chunk_rows = [
+            md.row_group(rg).num_rows
+            for rg in range(md.num_row_groups)
+        ]
+        keep = [rg_matches(rg) for rg in range(md.num_row_groups)]
+        return SplitSource(
+            coalesce_kept_chunks(
+                handle, chunk_rows, keep, target_split_rows
+            )
+        )
 
     def create_page_source(
         self, split: ConnectorSplit, columns: Sequence[str]

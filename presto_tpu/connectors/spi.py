@@ -51,6 +51,21 @@ class TableStats:
 
 
 @dataclasses.dataclass(frozen=True)
+class RangeSet:
+    """TupleDomain-lite RANGE domain for ``get_splits`` constraints:
+    the column's allowed values lie in the inclusive ``[lo, hi]``
+    interval (native engine representation — unscaled ints for
+    decimals, epoch days for dates). Connectors MAY use it to skip
+    splits whose min/max statistics fall wholly outside the range
+    (parquet row groups, ORC stripes, hive partition keys); ignoring
+    it is always correct — the originating filter still applies.
+    Produced by the dynamic-filter plane (``exec/dynfilter.py``)."""
+
+    lo: object
+    hi: object
+
+
+@dataclasses.dataclass(frozen=True)
 class ConnectorSplit:
     """One unit of scan parallelism (reference: ConnectorSplit).
 
@@ -65,6 +80,43 @@ class ConnectorSplit:
     @property
     def num_rows(self) -> int:
         return self.row_end - self.row_start
+
+
+def coalesce_kept_chunks(
+    handle: TableHandle,
+    chunk_rows: Sequence[int],
+    keep: Sequence[bool],
+    target_split_rows: int,
+) -> List[ConnectorSplit]:
+    """Build row-range splits from a table's physical chunks (parquet
+    row groups, ORC stripes) after constraint pruning: consecutive
+    KEPT chunks coalesce into one split, a pruned chunk closes the
+    open split (its rows are never covered), and splits close at
+    ``target_split_rows``. An all-pruned (or empty) table yields the
+    canonical zero-row sentinel split. The ONE coalescing loop both
+    file connectors share — its start-sentinel boundary logic is easy
+    to get subtly wrong twice."""
+    splits: List[ConnectorSplit] = []
+    start: Optional[int] = None
+    acc = 0
+    for n, kept in zip(chunk_rows, keep):
+        if not kept:
+            if start is not None and acc > start:
+                splits.append(ConnectorSplit(handle, start, acc))
+            start = None
+            acc += n
+            continue
+        if start is None:
+            start = acc
+        acc += n
+        if acc - start >= target_split_rows:
+            splits.append(ConnectorSplit(handle, start, acc))
+            start = acc
+    if start is not None and (acc > start or not splits):
+        splits.append(ConnectorSplit(handle, start, acc))
+    if not splits:
+        splits.append(ConnectorSplit(handle, 0, 0))
+    return splits
 
 
 class SplitSource:

@@ -1,0 +1,440 @@
+"""Dynamic-filter plane: build-side runtime filters for probe scans.
+
+Reference parity: dynamic filtering (Sethi et al., "Presto: SQL on
+Everything", ICDE 2019 §III-C): runtime filters collected from a join's
+BUILD side flow into the PROBE side's table scans at execution time,
+pruning rows — and, through the connector constraint, whole splits —
+that can never find a join partner.
+
+This module is the ONE audited home for filter summaries (enforced by
+``tools/check_dynfilter_sites.py``): what a summary contains, how
+partial summaries merge, how they cross the wire, and how a merged
+summary converts into
+
+- an :class:`presto_tpu.expr.Expr` predicate fused into the probe
+  fragment (a ``FilterNode(dynamic=True)`` whose pruned-row count is
+  traced out of the compiled program), and
+- a TupleDomain-lite ``constraint`` for ``Connector.get_splits`` (hive
+  partition pruning, parquet row-group / ORC stripe min-max pruning),
+  so excluded splits are never read at all.
+
+Summary contents per join key: min/max bounds in the key's NATIVE
+device dtype (never widened through ``astype`` — under x64-off that
+silently becomes float32/int32 and rounds/wraps the bounds, excluding
+genuinely matching probe rows), plus a small distinct-value set
+(IN-list) when the build side's NDV is at or below the configured
+limit — including dictionary-encoded string keys, whose distinct dict
+ids are resolved through the page's dictionary so VALUES (not ids,
+which differ across dictionaries) cross the wire.
+
+Two producers share the vocabulary:
+
+- :func:`summarize_page` — host-side, over a materialized result page
+  (workers summarizing build-task outputs batch by batch); partial
+  summaries :meth:`FilterSummary.merge` at the coordinator.
+- :func:`device_conjuncts` — device-side, over a device-resident build
+  page (the local stage-at-a-time executor), fetching all bounds and
+  dictionary LUTs in ONE device round trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu import expr as E
+from presto_tpu import types as T
+from presto_tpu.connectors.spi import RangeSet
+
+#: default NDV cap for the distinct-set (IN-list) summary form; above
+#: it only min/max bounds are kept (session ``dynamic_filtering_ndv_limit``)
+DEFAULT_NDV_LIMIT = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnFilter:
+    """Value-domain summary of ONE build-side join key column.
+
+    ``lo``/``hi`` are inclusive bounds in the column's native engine
+    representation (unscaled ints for decimals, epoch days for dates);
+    None = unbounded/unknown. ``values`` is the small distinct set when
+    build NDV was at or below the limit (strings as str, numerics in
+    native repr); None = NDV too high, bounds only. ``empty`` marks a
+    build side with zero (valid) rows — nothing can match."""
+
+    column: str
+    lo: Optional[object] = None
+    hi: Optional[object] = None
+    values: Optional[Tuple] = None
+    empty: bool = True
+
+    def merge(self, other: "ColumnFilter", ndv_limit: int) -> "ColumnFilter":
+        """Union of two partial summaries of the same column: bounds
+        widen, distinct sets union (dropped past the NDV limit), empty
+        only when both sides were empty."""
+        if self.empty:
+            return other
+        if other.empty:
+            return self
+        lo = hi = None
+        if self.lo is not None and other.lo is not None:
+            lo, hi = min(self.lo, other.lo), max(self.hi, other.hi)
+        values = None
+        if self.values is not None and other.values is not None:
+            u = set(self.values) | set(other.values)
+            if len(u) <= ndv_limit:
+                values = tuple(sorted(u))
+        return ColumnFilter(
+            column=self.column, lo=lo, hi=hi, values=values, empty=False
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "column": self.column,
+            "lo": self.lo,
+            "hi": self.hi,
+            "values": list(self.values) if self.values is not None else None,
+            "empty": self.empty,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "ColumnFilter":
+        vals = d.get("values")
+        return ColumnFilter(
+            column=d["column"],
+            lo=d.get("lo"),
+            hi=d.get("hi"),
+            values=tuple(vals) if vals is not None else None,
+            empty=bool(d.get("empty")),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterSummary:
+    """Per-key summaries of one build side (aligned with the join's
+    build-key list)."""
+
+    columns: Tuple[ColumnFilter, ...]
+
+    def merge(self, other: "FilterSummary", ndv_limit: int) -> "FilterSummary":
+        assert len(self.columns) == len(other.columns)
+        return FilterSummary(
+            columns=tuple(
+                a.merge(b, ndv_limit)
+                for a, b in zip(self.columns, other.columns)
+            )
+        )
+
+    @property
+    def empty_build(self) -> bool:
+        return all(c.empty for c in self.columns)
+
+    def to_json(self) -> dict:
+        return {"columns": [c.to_json() for c in self.columns]}
+
+    @staticmethod
+    def from_json(d: dict) -> "FilterSummary":
+        return FilterSummary(
+            columns=tuple(
+                ColumnFilter.from_json(c) for c in d["columns"]
+            )
+        )
+
+
+def empty_summary(keys) -> FilterSummary:
+    """Summary of a ZERO-ROW build range (a worker task whose split
+    range was empty): every key column is empty — merging with real
+    partials leaves the partner untouched."""
+    return FilterSummary(
+        columns=tuple(ColumnFilter(column=k) for k in keys)
+    )
+
+
+def subset_summary(columns) -> FilterSummary:
+    """Summary over a subset of an existing summary's columns (the
+    coordinator's constraint-eligible projection)."""
+    return FilterSummary(columns=tuple(columns))
+
+
+# ------------------------------------------------- host-side summarize
+
+
+def _native_bound(v) -> object:
+    """numpy scalar -> python value, exactly (ints stay ints; floats
+    round-trip through float64, which is a superset of every narrower
+    float dtype, so the bound re-stages bit-identically)."""
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return int(v)
+
+
+def summarize_page(page, keys, ndv_limit: int = DEFAULT_NDV_LIMIT) -> FilterSummary:
+    """Summarize the named key columns of a HOST page (numpy-backed —
+    a worker's materialized batch output). min/max and the distinct
+    set are computed in the column's native dtype; dictionary-encoded
+    string columns summarize as distinct VALUES resolved through the
+    page dictionary (ids never leave the process — they are meaningless
+    under another page's dictionary)."""
+    n = int(page.num_valid)
+    cols: List[ColumnFilter] = []
+    for key in keys:
+        blk = page.block(key)
+        if blk.offsets is not None or blk.dtype.is_map or blk.dtype.is_row:
+            cols.append(ColumnFilter(column=key, empty=False))
+            continue
+        data, valid = blk.to_numpy(n)
+        data = data[valid[: len(data)]] if n else data[:0]
+        if data.size == 0:
+            cols.append(ColumnFilter(column=key, empty=True))
+            continue
+        if blk.dtype.is_string:
+            ids = np.unique(data.astype(np.int64))
+            if blk.dictionary is None or len(ids) > ndv_limit:
+                cols.append(ColumnFilter(column=key, empty=False))
+                continue
+            values = tuple(
+                sorted(str(blk.dictionary.values[int(i)]) for i in ids)
+            )
+            cols.append(
+                ColumnFilter(column=key, values=values, empty=False)
+            )
+            continue
+        if blk.dtype.is_long_decimal:
+            # limb pairs: no safe scalar ordering here — pass-through
+            cols.append(ColumnFilter(column=key, empty=False))
+            continue
+        if data.dtype.kind == "f" and np.isnan(data).any():
+            data = data[~np.isnan(data)]
+            if data.size == 0:
+                cols.append(ColumnFilter(column=key, empty=True))
+                continue
+        lo = _native_bound(data.min())
+        hi = _native_bound(data.max())
+        values = None
+        if data.dtype.kind in "iu":
+            u = np.unique(data)
+            if len(u) <= ndv_limit:
+                values = tuple(_native_bound(v) for v in u)
+        cols.append(
+            ColumnFilter(
+                column=key, lo=lo, hi=hi, values=values, empty=False
+            )
+        )
+    return FilterSummary(columns=tuple(cols))
+
+
+# --------------------------------------------- apply: Expr / constraint
+
+
+def _applicable(cf: ColumnFilter, probe_type: T.DataType) -> bool:
+    """Can this summary column safely filter a probe column of
+    ``probe_type``? Strings need a distinct set; numerics need bounds.
+    (Type agreement between build and probe is the CALLER's check —
+    scales and id spaces must match before the summary is even built.)
+    """
+    if cf.empty:
+        return True
+    if probe_type.is_string:
+        return cf.values is not None
+    if probe_type.is_long_decimal or probe_type.is_array:
+        return False
+    return cf.lo is not None or cf.values is not None
+
+
+def applicable_count(
+    summary: FilterSummary,
+    probe_cols: List[Tuple[str, T.DataType]],
+) -> int:
+    """How many of the summary's columns actually yield a probe-side
+    conjunct (the honest value for ``dynamic_filter.applied`` — a
+    merged string summary whose union blew the NDV cap contributes
+    nothing and must not be counted)."""
+    return sum(
+        1
+        for cf, (_pn, pt) in zip(summary.columns, probe_cols)
+        if _applicable(cf, pt)
+    )
+
+
+def to_predicate(
+    summary: FilterSummary,
+    probe_cols: List[Tuple[str, T.DataType]],
+) -> Optional[E.Expr]:
+    """Merged summary -> probe-side predicate Expr (None when no key
+    admits a filter). ``probe_cols`` aligns with ``summary.columns``:
+    the PROBE column name/type each build-key summary applies to.
+    An empty build side collapses to a constant-false predicate —
+    inner/semi joins can match nothing."""
+    conjuncts: List[E.Expr] = []
+    for cf, (pname, ptype) in zip(summary.columns, probe_cols):
+        if not _applicable(cf, ptype):
+            continue
+        if cf.empty:
+            return E.Literal(False, T.BOOLEAN)
+        ref = E.ColumnRef(pname, ptype)
+        if cf.values is not None:
+            conjuncts.append(
+                E.InList(
+                    ref,
+                    tuple(E.Literal(v, ptype) for v in cf.values),
+                )
+            )
+        else:
+            conjuncts.append(
+                E.Between(
+                    ref, E.Literal(cf.lo, ptype), E.Literal(cf.hi, ptype)
+                )
+            )
+    if not conjuncts:
+        return None
+    return conjuncts[0] if len(conjuncts) == 1 else E.And(tuple(conjuncts))
+
+
+def to_constraint(
+    summary: FilterSummary,
+    probe_cols: List[Tuple[str, T.DataType]],
+) -> Tuple:
+    """Merged summary -> TupleDomain-lite ``constraint`` entries for
+    ``Connector.get_splits``: ``(column, values-tuple)`` for distinct
+    sets (hive partition pruning) and ``(column, RangeSet(lo, hi))``
+    for bounds (parquet row-group / ORC stripe min-max pruning).
+    Connectors that ignore the constraint stay correct — the fused
+    predicate still applies."""
+    out = []
+    for cf, (pname, ptype) in zip(summary.columns, probe_cols):
+        if not _applicable(cf, ptype):
+            continue
+        if cf.empty:
+            out.append((pname, ()))
+        elif cf.values is not None:
+            out.append((pname, tuple(cf.values)))
+        else:
+            out.append((pname, RangeSet(lo=cf.lo, hi=cf.hi)))
+    return tuple(sorted(out, key=lambda t: t[0]))
+
+
+def merge_constraints(base: Tuple, extra: Tuple) -> Tuple:
+    """Combine a scan's planner-pushed constraint with the dynamic one
+    (AND semantics: both must hold; entries keep their own columns —
+    a connector intersects per column as it understands them)."""
+    if not base:
+        return tuple(extra)
+    if not extra:
+        return tuple(base)
+    return tuple(sorted(tuple(base) + tuple(extra), key=lambda t: t[0]))
+
+
+# ----------------------------------------------- device-side (local path)
+
+
+def device_conjuncts(
+    build_page,
+    key_pairs: List[Tuple[str, str]],
+    probe_schema: Dict[str, T.DataType],
+    ndv_limit: int = DEFAULT_NDV_LIMIT,
+):
+    """Build-side summaries straight off a DEVICE-resident page (the
+    stage-at-a-time executor's path): per-key min/max computed in the
+    key's NATIVE device dtype — never ``astype`` to a wider type, which
+    under x64-off silently narrows to float32/int32 and rounds (or
+    wraps the iinfo fills), excluding matching probe rows — plus a
+    present-id LUT for dictionary string keys, all fetched in ONE
+    device round trip.
+
+    ``key_pairs`` is ``[(probe_col, build_col), ...]``;
+    returns ``(conjuncts, n_filters)`` where conjuncts are probe-side
+    Exprs (possibly a single constant-false for an empty build).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fetch: List = []
+    specs: List[tuple] = []
+    for lk, rk in key_pairs:
+        blk = build_page.block(rk)
+        lt = probe_schema.get(lk)
+        if (
+            lt is None
+            or lt != blk.dtype  # scales/id-spaces must agree
+            or lt.is_long_decimal
+            or blk.offsets is not None
+        ):
+            continue
+        mask = build_page.row_mask()
+        if blk.valid is not None:
+            mask = mask & blk.valid
+        if lt.is_string:
+            if blk.dictionary is None:
+                continue
+            nvals = len(blk.dictionary.values)
+            if nvals > ndv_limit:
+                continue
+            # present-id LUT over the (small) dictionary: ids of live
+            # rows scatter True; padding rows scatter to a spill slot
+            ids = jnp.where(mask, blk.data.astype(jnp.int32), nvals)
+            present = (
+                jnp.zeros((nvals + 1,), jnp.bool_).at[ids].set(True)
+            )
+            fetch.append(present[:nvals])
+            fetch.append(mask.any())
+            specs.append((lk, lt, "dict", blk.dictionary))
+            continue
+        d = blk.data  # NATIVE dtype: bounds are exactly representable
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            lo_fill = jnp.asarray(jnp.inf, d.dtype)
+            hi_fill = jnp.asarray(-jnp.inf, d.dtype)
+            kind = "float"
+            # NaN keys match nothing and must not poison the bounds
+            # (min/max would go NaN and read as an empty build,
+            # dropping REAL matches); mask them like the host path
+            mask = mask & ~jnp.isnan(d)
+        elif jnp.issubdtype(d.dtype, jnp.integer):
+            info = jnp.iinfo(d.dtype)
+            lo_fill = jnp.asarray(info.max, d.dtype)
+            hi_fill = jnp.asarray(info.min, d.dtype)
+            kind = "int"
+        else:
+            continue
+        fetch.append(jnp.min(jnp.where(mask, d, lo_fill)))
+        fetch.append(jnp.max(jnp.where(mask, d, hi_fill)))
+        specs.append((lk, lt, kind, None))
+    if not specs:
+        return [], 0
+    vals = jax.device_get(fetch)
+    conjuncts: List[E.Expr] = []
+    for i, (lk, lt, kind, dictionary) in enumerate(specs):
+        ref = E.ColumnRef(lk, lt)
+        if kind == "dict":
+            present = np.asarray(vals[2 * i])
+            any_live = bool(vals[2 * i + 1])
+            if not any_live:
+                return [E.Literal(False, T.BOOLEAN)], 1
+            values = [
+                str(dictionary.values[j])
+                for j in np.nonzero(present)[0]
+            ]
+            conjuncts.append(
+                E.InList(
+                    ref, tuple(E.Literal(v, lt) for v in values)
+                )
+            )
+            continue
+        if kind == "float":
+            lo, hi = float(vals[2 * i]), float(vals[2 * i + 1])
+            if math.isnan(lo) or math.isnan(hi) or not (lo <= hi):
+                # empty build (inf fills stayed) or all-NaN keys
+                return [E.Literal(False, T.BOOLEAN)], 1
+        else:
+            lo, hi = int(vals[2 * i]), int(vals[2 * i + 1])
+            if lo > hi:  # empty build: fills survived the reduction
+                return [E.Literal(False, T.BOOLEAN)], 1
+        # compare in the key's native repr (decimals unscaled)
+        conjuncts.append(
+            E.Between(ref, E.Literal(lo, lt), E.Literal(hi, lt))
+        )
+    return conjuncts, len(conjuncts)

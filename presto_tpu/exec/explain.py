@@ -21,7 +21,8 @@ def _describe(node: N.PlanNode) -> str:
             f"{node.handle.table} columns={list(node.columns)}]"
         )
     if isinstance(node, N.FilterNode):
-        return f"Filter[{node.predicate}]"
+        tag = "DynamicFilter" if node.dynamic else "Filter"
+        return f"{tag}[{node.predicate}]"
     if isinstance(node, N.ProjectNode):
         return f"Project[{[n for n, _ in node.projections]}]"
     if isinstance(node, N.AggregationNode):
@@ -144,6 +145,18 @@ def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
         f"execution {qstats.execution_ms:.1f} ms, "
         f"{len(qstats.stages)} stage(s)"
     )
+    if (
+        qstats.dynamic_filters
+        or qstats.dynamic_filter_wait_ms
+        or qstats.dynamic_filter_splits_pruned
+        or qstats.dynamic_filter_rows_pruned
+    ):
+        lines.append(
+            f"dynamic filtering: {qstats.dynamic_filters} filter(s), "
+            f"rows_pruned {qstats.dynamic_filter_rows_pruned}, "
+            f"splits_pruned {qstats.dynamic_filter_splits_pruned}, "
+            f"wait {qstats.dynamic_filter_wait_ms:.1f} ms"
+        )
     for st in qstats.stages:
         r = st.rollup()
         lines.append(

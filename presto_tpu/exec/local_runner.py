@@ -822,12 +822,15 @@ class LocalQueryRunner:
         """Dynamic filtering (reference: runtime dynamic filters flowing
         from the join build side into probe-side scans — SURVEY.md
         §3.2): when a stage-at-a-time JOIN's BUILD side has just
-        executed, fetch its join-key min/max (one round trip of two
-        scalars) and pre-filter the still-unexecuted probe side with
-        the resulting range — probe rows outside the build's key domain
-        cannot match, so inner/semi joins may drop them early (cuts
-        join out_capacity pressure and overflow retries on star
-        joins)."""
+        executed, fetch its join-key summary (min/max in the key's
+        native dtype, present-value LUT for small dictionary string
+        keys — one round trip; exec.dynfilter owns the construction)
+        and pre-filter the still-unexecuted probe side — probe rows
+        outside the build's key domain cannot match, so inner/semi
+        joins may drop them early (cuts join out_capacity pressure and
+        overflow retries on star joins). The filter node is marked
+        ``dynamic``: its pruned-row count is traced out of the program
+        (dynamic_filter.rows_pruned)."""
         if not self.session.get("enable_dynamic_filtering"):
             return node
         if not (
@@ -839,69 +842,33 @@ class LocalQueryRunner:
             )
         ):
             return node
+        from presto_tpu.exec import dynfilter
+        from presto_tpu.utils.metrics import REGISTRY
+
         build = pages_map[id(leaf)]
-        left_schema = node.left.output_schema()
-        conjuncts: List[E.Expr] = []
-        fetch: List = []
-        specs: List[Tuple[str, object]] = []
-        for lk, rk in zip(node.left_keys, node.right_keys):
-            blk = build.block(rk)
-            lt = left_schema.get(lk)
-            if (
-                lt is None
-                or lt != blk.dtype  # scales/id-spaces must agree
-                or lt.is_string
-                or lt.is_long_decimal
-                or blk.offsets is not None
-            ):
-                continue
-            mask = build.row_mask()
-            if blk.valid is not None:
-                mask = mask & blk.valid
-            is_float = lt.name in ("double", "real")
-            if is_float:
-                d = blk.data.astype(jnp.float64)
-                lo_fill, hi_fill = jnp.inf, -jnp.inf
-            else:
-                info = jnp.iinfo(jnp.int64)
-                d = blk.data.astype(jnp.int64)
-                lo_fill, hi_fill = info.max, info.min
-            fetch.append(jnp.min(jnp.where(mask, d, lo_fill)))
-            fetch.append(jnp.max(jnp.where(mask, d, hi_fill)))
-            specs.append((lk, lt, is_float))
-        if not specs:
+        conjuncts, n_filters = dynfilter.device_conjuncts(
+            build,
+            list(zip(node.left_keys, node.right_keys)),
+            node.left.output_schema(),
+            ndv_limit=int(
+                self.session.get("dynamic_filtering_ndv_limit")
+            ),
+        )
+        if not conjuncts:
             return node
-        vals = jax.device_get(fetch)
-        for i, (lk, lt, is_float) in enumerate(specs):
-            if is_float:
-                # exact float bounds (int truncation would exclude
-                # matching fractional keys)
-                lo, hi = float(vals[2 * i]), float(vals[2 * i + 1])
-                if not (lo <= hi):  # empty build (inf fills) / NaN
-                    lo, hi = 0.0, -1.0
-            else:
-                lo, hi = int(vals[2 * i]), int(vals[2 * i + 1])
-                if lo > hi:  # empty build: no key can match
-                    lo, hi = 0, -1
-            ref = E.ColumnRef(lk, lt)
-            # compare in the key's native repr (decimals unscaled)
-            conjuncts.append(
-                E.Between(
-                    ref,
-                    E.Literal(lo, lt),
-                    E.Literal(hi, lt),
-                )
-            )
-        if self._active_qs is not None:
-            with self._qs_mu:
-                self._active_qs.dynamic_filters += len(conjuncts)
+        REGISTRY.counter("dynamic_filter.built").update()
+        REGISTRY.counter("dynamic_filter.applied").update(n_filters)
+        self._fold_dyn_stat("dynamic_filters", n_filters)
         pred = (
             conjuncts[0]
             if len(conjuncts) == 1
             else E.And(tuple(conjuncts))
         )
         return dataclasses.replace(
-            node, left=N.FilterNode(source=node.left, predicate=pred)
+            node,
+            left=N.FilterNode(
+                source=node.left, predicate=pred, dynamic=True
+            ),
         )
 
     def _execute_to_leaf(
@@ -975,8 +942,10 @@ class LocalQueryRunner:
                     flags: List = []
                     errors: List = []
                     counters: Optional[List] = [] if analyzed else None
+                    dyn: List = []
                     out = _execute_node(
-                        _root, pages_in, _ids, flags, errors, counters
+                        _root, pages_in, _ids, flags, errors, counters,
+                        dyn,
                     )
                     # program boundary: host materialization / exchanges
                     # need prefix form (lazy selection masks stop here)
@@ -999,12 +968,14 @@ class LocalQueryRunner:
                         cnts = []
                     # stack control outputs: ONE device->host fetch per
                     # run (each separate scalar fetch costs a full relay
-                    # round trip, ~100ms on tunneled TPU)
+                    # round trip, ~100ms on tunneled TPU); dyn holds
+                    # per-dynamic-filter pruned-row counts
                     return (
                         out,
                         _stack_bools(flags),
                         _stack_bools([e for _, e in errors]),
                         _stack_i32(cnts),
+                        _stack_i32(dyn),
                     )
 
                 entry = (jax.jit(trace), msgs_cell, nodes_cell)
@@ -1013,7 +984,7 @@ class LocalQueryRunner:
                 ] = entry
             fn, msgs_cell, nodes_cell = entry
             with self._device_scope():
-                page, flags_arr, err_arr, cnt_arr = fn(pages)
+                page, flags_arr, err_arr, cnt_arr, dyn_arr = fn(pages)
             # Round-trip discipline (tunneled TPU: every separate fetch
             # pays ~65ms relay latency): ONE device_get for all control
             # outputs + the result row count + a SPECULATIVE prefix of
@@ -1028,11 +999,13 @@ class LocalQueryRunner:
             )
             if not fetch_result:
                 spec = 0
-            leaves: List = [flags_arr, err_arr, cnt_arr, page.num_valid]
+            leaves: List = [
+                flags_arr, err_arr, cnt_arr, dyn_arr, page.num_valid,
+            ]
             if spec > 0:
                 leaves.extend(page.prefix_leaves(spec))
             fetched = jax.device_get(leaves)
-            flags_np, err_np, cnt_np, n_out = fetched[:4]
+            flags_np, err_np, cnt_np, dyn_np, n_out = fetched[:5]
             for msg, flag in zip(msgs_cell, err_np):
                 if bool(flag):
                     raise ExecutionError(msg)
@@ -1045,13 +1018,26 @@ class LocalQueryRunner:
                             nodes_cell, cnt_np
                         )
                     )
+                if dyn_np.size:
+                    # attribute only on the SUCCESSFUL run: overflow
+                    # retries re-execute the filter over the same rows
+                    pruned = int(dyn_np.sum())
+                    if pruned:
+                        from presto_tpu.utils.metrics import REGISTRY
+
+                        REGISTRY.counter(
+                            "dynamic_filter.rows_pruned"
+                        ).update(pruned)
+                        self._fold_dyn_stat(
+                            "dynamic_filter_rows_pruned", pruned
+                        )
                 n = int(n_out)
                 if not fetch_result:
                     from presto_tpu.page import pad_capacity
 
                     return pad_capacity(page, bucket_capacity(n)), n
                 if 0 < spec and n <= spec:
-                    return _page_from_prefix(page, fetched[4:], n)
+                    return _page_from_prefix(page, fetched[5:], n)
                 return materialize_page(page, n)
             tries += 1
             if tries >= self.MAX_RETRIES:
@@ -1063,6 +1049,25 @@ class LocalQueryRunner:
                 with self._qs_mu:
                     self._active_qs.retries += 1
             root = _scale_capacities(root, 4)
+
+    def _fold_dyn_stat(self, attr: str, n: int) -> None:
+        """Add ``n`` to the active sink's dynamic-filter counter under
+        the right lock(s): ``_qs_mu`` serializes concurrent task
+        drivers, and a QueryStats sink ALSO folds worker-task deltas
+        into the same fields under its ``_roll_lock`` (stats.roll_up)
+        — both writers must serialize on it or an increment silently
+        vanishes. The ONE implementation for every runner-side
+        dynamic-filter stat write."""
+        qs = self._active_qs
+        if qs is None:
+            return
+        with self._qs_mu:
+            sink_lock = getattr(qs, "_roll_lock", None)
+            if sink_lock is not None:
+                with sink_lock:
+                    setattr(qs, attr, getattr(qs, attr) + n)
+            else:
+                setattr(qs, attr, getattr(qs, attr) + n)
 
     def _note_cache_hit(self) -> None:
         """Attribute one split-cache hit to the active stats sink."""
@@ -1505,22 +1510,26 @@ def _stack_i32(xs: List) -> jnp.ndarray:
 
 
 def _execute_node(
-    node, pages, scan_ids, flags, errors, counters=None
+    node, pages, scan_ids, flags, errors, counters=None, dyn=None
 ) -> Page:
     """Execute one plan node at trace time. ``counters``, when given,
     accumulates (node, traced num_valid, capacity) per node — the
-    EXPLAIN ANALYZE row-count instrumentation (stats.py)."""
-    out = _execute_node_inner(node, pages, scan_ids, flags, errors, counters)
+    EXPLAIN ANALYZE row-count instrumentation (stats.py). ``dyn``
+    accumulates the traced pruned-row count of every dynamic
+    FilterNode (dynamic_filter.rows_pruned observability)."""
+    out = _execute_node_inner(
+        node, pages, scan_ids, flags, errors, counters, dyn
+    )
     if counters is not None:
         counters.append((node, out.num_valid, out.capacity))
     return out
 
 
 def _execute_node_inner(
-    node, pages, scan_ids, flags, errors, counters=None
+    node, pages, scan_ids, flags, errors, counters=None, dyn=None
 ) -> Page:
     run = lambda n: _execute_node(  # noqa: E731
-        n, pages, scan_ids, flags, errors, counters
+        n, pages, scan_ids, flags, errors, counters, dyn
     )
 
     if isinstance(node, (N.TableScanNode, N.RemoteSourceNode)):
@@ -1539,7 +1548,10 @@ def _execute_node_inner(
         src = run(node.source)
         schema = node.source.output_schema()
         projs = [(n, E.ColumnRef(n, t)) for n, t in schema.items()]
-        return filter_project(src, node.predicate, projs)
+        out = filter_project(src, node.predicate, projs)
+        if dyn is not None and node.dynamic:
+            dyn.append(src.num_valid - out.num_valid)
+        return out
     if isinstance(node, N.ProjectNode):
         return project(run(node.source), node.projections)
     if isinstance(node, N.AggregationNode):

@@ -82,6 +82,9 @@ class TaskStats:
     #: split cache (no connector read, no host->device transfer)
     staging_cache_hits: int = 0
     dynamic_filters: int = 0
+    #: probe rows dropped by fused dynamic filters in THIS task's
+    #: programs (traced out of the compiled fragment)
+    dynamic_filter_rows_pruned: int = 0
     device_fragments: int = 0
     #: this attempt was a speculative (backup) launch of a straggling
     #: range — winners and losers both carry the flag in the rollup
@@ -123,6 +126,9 @@ class StageStats:
             "staging_cache_hits": sum(
                 t.staging_cache_hits for t in self.tasks
             ),
+            "dynamic_filter_rows_pruned": sum(
+                t.dynamic_filter_rows_pruned for t in self.tasks
+            ),
             "failed_tasks": sum(
                 1 for t in self.tasks if t.state == "FAILED"
             ),
@@ -156,6 +162,22 @@ class QueryStats:
     retries: int = 0  # capacity-overflow re-runs
     device_fragments: int = 0  # stage-at-a-time programs beyond the root
     dynamic_filters: int = 0  # build->probe runtime range filters applied
+    dynamic_filter_rows_pruned: int = 0  # probe rows dropped pre-join
+    dynamic_filter_splits_pruned: int = 0  # probe splits never read
+    dynamic_filter_wait_ms: float = 0.0  # probe wait on the build summary
+    #: task-side portions already folded into dynamic_filter_rows_pruned
+    #: / dynamic_filters (roll_up bookkeeping — keeps coordinator-local
+    #: additions from gather-splice / local-fallback executions intact;
+    #: not exported)
+    _df_rows_from_tasks: int = 0
+    _df_filters_from_tasks: int = 0
+    #: guards the delta fold above: roll_up runs concurrently from the
+    #: query thread and /v1/query status polls, and a racy
+    #: read-modify-write would double-count the delta (every other
+    #: rollup field is a from-scratch overwrite and tolerates races)
+    _roll_lock: object = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
     input_rows: int = 0
     input_bytes: int = 0
     output_rows: int = 0
@@ -195,6 +217,31 @@ class QueryStats:
         self.input_bytes = sum(
             t.input_bytes for s in self.stages for t in s.tasks
         )
+        # worker-side fused-filter pruning folds in as a DELTA (the
+        # field also accumulates coordinator-local pruning from
+        # gather-splice / local-fallback executions, which a from-
+        # scratch overwrite would discard); idempotent per poll.
+        # splits_pruned/wait_ms stay coordinator-local accumulators.
+        task_pruned = sum(
+            t.dynamic_filter_rows_pruned
+            for s in self.stages
+            for t in s.tasks
+        )
+        # worker-LOCAL dynamic filters (fragmented joins inside a
+        # task) surface on TaskStats.dynamic_filters: fold them so
+        # QueryInfo never reports rows_pruned > 0 with 0 filters
+        task_filters = sum(
+            t.dynamic_filters for s in self.stages for t in s.tasks
+        )
+        with self._roll_lock:
+            self.dynamic_filter_rows_pruned += (
+                task_pruned - self._df_rows_from_tasks
+            )
+            self._df_rows_from_tasks = task_pruned
+            self.dynamic_filters += (
+                task_filters - self._df_filters_from_tasks
+            )
+            self._df_filters_from_tasks = task_filters
 
     def to_dict(self, include_stages: bool = True) -> dict:
         out = {
@@ -214,6 +261,11 @@ class QueryStats:
             "retries": self.retries,
             "device_fragments": self.device_fragments,
             "dynamic_filters": self.dynamic_filters,
+            "dynamic_filter_rows_pruned": self.dynamic_filter_rows_pruned,
+            "dynamic_filter_splits_pruned": (
+                self.dynamic_filter_splits_pruned
+            ),
+            "dynamic_filter_wait_ms": self.dynamic_filter_wait_ms,
             "input_rows": self.input_rows,
             "input_bytes": self.input_bytes,
             "output_rows": self.output_rows,

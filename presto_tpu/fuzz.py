@@ -488,7 +488,10 @@ def session_draw(seed: int) -> dict:
     """Deterministic per-seed execution-path randomization: the SAME
     query text runs under a random fragment budget and with dynamic
     filtering on or off, so the fuzzer exercises the fragment executor
-    and the dynamic-filter pruning as first-class surfaces."""
+    and the dynamic-filter pruning as first-class surfaces. Applies to
+    BOTH the local runner and the distributed path
+    (:func:`run_fuzz_distributed`) — the dynamic-filter plane must be
+    answer-invariant wherever it engages."""
     rng = random.Random(seed ^ 0x5EED5)
     return {
         "max_fragment_weight": str(_pick(rng, _FRAGMENT_WEIGHTS)),
@@ -535,6 +538,20 @@ def run_fuzz(
     return failures
 
 
+def run_fuzz_distributed(
+    seeds, runner=None, oracle=None, rel_tol: float = 1e-6,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """Distributed fuzz path: the seeded corpus on a
+    DistributedQueryRunner (multi-device mesh fragments), with the
+    SAME per-seed session draw — so ``enable_dynamic_filtering``
+    toggles on the distributed tier too and every seed's answer is
+    oracle-diffed under whichever filter path it drew."""
+    from presto_tpu.parallel import DistributedQueryRunner
+
+    runner = runner or DistributedQueryRunner()
+    return run_fuzz(seeds, runner=runner, oracle=oracle, rel_tol=rel_tol)
+
+
 def _verify_dual_path(runner, sql: str, props: dict, rel_tol: float):
     """Engine-vs-engine: the current session draw vs the whole-plan
     path (max fragment budget, dynamic filtering off)."""
@@ -564,13 +581,21 @@ def main() -> None:  # pragma: no cover - CLI
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--start", type=int, default=0)
     ap.add_argument("--count", type=int, default=100)
+    ap.add_argument(
+        "--distributed", action="store_true",
+        help="run seeds on a DistributedQueryRunner mesh",
+    )
     args = ap.parse_args()
     seeds = (
         [args.seed]
         if args.seed is not None
         else range(args.start, args.start + args.count)
     )
-    fails = run_fuzz(seeds)
+    fails = (
+        run_fuzz_distributed(seeds)
+        if args.distributed
+        else run_fuzz(seeds)
+    )
     for seed, sql, diff in fails:
         print(f"seed {seed}: {sql}\n  -> {diff}\n")
     print(f"{len(fails)} failures / {len(list(seeds))} queries")

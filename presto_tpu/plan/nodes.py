@@ -62,6 +62,10 @@ class ValuesNode(PlanNode):
 class FilterNode(PlanNode):
     source: PlanNode
     predicate: Expr
+    #: runtime dynamic filter (build->probe, exec/dynfilter.py): the
+    #: executor traces this node's pruned-row count as a program
+    #: output (dynamic_filter.rows_pruned observability)
+    dynamic: bool = False
 
     def output_schema(self):
         return self.source.output_schema()

@@ -169,6 +169,22 @@ class CoordinatorServer:
             self.local.session.set(
                 "staging_prefetch_depth", int(prefetch)
             )
+        # distributed dynamic filtering (exec/dynfilter.py): tier-1
+        # keys seed the session defaults, like the staging knobs
+        df_wait = (
+            config.get("dynamic-filtering.wait-ms") if config else None
+        )
+        if df_wait is not None:
+            self.local.session.set(
+                "dynamic_filtering_wait_ms", float(df_wait)
+            )
+        df_ndv = (
+            config.get("dynamic-filtering.ndv-limit") if config else None
+        )
+        if df_ndv is not None:
+            self.local.session.set(
+                "dynamic_filtering_ndv_limit", int(df_ndv)
+            )
         self.local.cluster = self  # system.runtime.nodes source
         # config-wired query-completed JSONL sink (the env-var hook in
         # LocalQueryRunner covers bench/embedded runs; add_listener
@@ -844,6 +860,363 @@ class CoordinatorServer:
             "stages": len(q.stats.stages),
         }
 
+    # ------------------------------------------- dynamic filtering plane
+
+    def _stage_dynamic_filter(self, q: _Query, stage, workers):
+        """Distributed dynamic filtering (reference: runtime filters
+        flowing build->probe across the cluster, Sethi et al. ICDE'19
+        §III-C; exec/dynfilter.py owns the summary vocabulary).
+
+        When the stage's partitioned (probe) scan feeds the PROBE side
+        of an inner/semi join, schedule a build-side SUMMARY stage
+        first: workers execute the build subtree over split ranges and
+        report per-key summaries (min/max + NDV-capped distinct sets)
+        on the task-status plane; the coordinator merges the partials
+        and applies the completed filter twice —
+
+        1. a ``FilterNode(dynamic=True)`` fused into the probe fragment
+           (pre-join row pruning, pruned counts traced), and
+        2. a TupleDomain-lite constraint into ``Connector.get_splits``
+           so hive partition pruning and parquet/ORC min-max stats
+           skip whole splits before any byte is read.
+
+        The wait is BOUNDED by ``dynamic_filtering_wait_ms``: build
+        slowness, task failure, or worker death degrade to ``None`` —
+        the caller runs the exact unfiltered plan (never blocks, never
+        fails the query). Returns None or a
+        ``(fragment, partition_scan, ranges)`` override triple."""
+        from presto_tpu.exec import dynfilter
+        from presto_tpu.server.scheduler import _path_to, _replace_on_path
+
+        session = self.local.session
+        if not session.get("enable_dynamic_filtering"):
+            return None
+        frag = stage.worker_fragment
+        walk = list(N.walk(frag))
+        if not (0 <= stage.partition_scan < len(walk)):
+            return None
+        part_scan = walk[stage.partition_scan]
+        if not isinstance(part_scan, N.TableScanNode):
+            return None
+        path = _path_to(frag, part_scan)
+        if path is None:
+            return None
+        # nearest JoinNode ancestor decides: usable only when the probe
+        # (left) side of an inner/semi join holds the partitioned scan
+        J = None
+        probe_steps = None
+        for i in range(len(path) - 2, -1, -1):
+            n = path[i]
+            if isinstance(n, (N.JoinNode, N.CrossJoinNode)):
+                if (
+                    isinstance(n, N.JoinNode)
+                    and n.join_type in ("inner", "semi")
+                    and path[i + 1] is n.left
+                    and n.left_keys
+                ):
+                    J = n
+                    probe_steps = path[i + 1 : -1]  # J.left -> scan
+                break
+        if J is None:
+            return None
+        left_schema = J.left.output_schema()
+        build_schema = J.right.output_schema()
+        # keys a summary can act on: probe/build types must agree
+        # (scales and dictionary id spaces), no long decimals/arrays
+        pairs = []
+        for lk, rk in zip(J.left_keys, J.right_keys):
+            lt = left_schema.get(lk)
+            bt = build_schema.get(rk)
+            if (
+                lt is None
+                or bt is None
+                or lt != bt
+                or lt.is_long_decimal
+                or lt.is_array
+            ):
+                continue
+            pairs.append((lk, rk))
+        if not pairs:
+            return None
+        bstage = plan_stage(J.right, self.local.catalogs)
+        if bstage is None or not isinstance(
+            bstage.final_root, N.RemoteSourceNode
+        ):
+            # the build subtree has an aggregation cut (partial states
+            # would summarize aggregate VALUES, not key domains) or no
+            # partitionable scan: skip, keep today's plan
+            return None
+        ndv = int(session.get("dynamic_filtering_ndv_limit"))
+        wait_s = float(session.get("dynamic_filtering_wait_ms")) / 1000.0
+        if wait_s <= 0:
+            # "don't wait" knob: no budget to ever read a summary, so
+            # don't pay for posting + aborting a build stage either
+            REGISTRY.counter("dynamic_filter.wait_expired").update()
+            return None
+        t0 = time.monotonic()
+        with q.trace.span("dynfilter"):
+            summary = self._run_dynfilter_summary(
+                q, bstage, workers,
+                [rk for _, rk in pairs], ndv,
+                deadline=t0 + wait_s,
+            )
+        waited_ms = (time.monotonic() - t0) * 1000.0
+        REGISTRY.distribution("dynamic_filter.wait_ms").add(waited_ms)
+        with q._stats_lock:
+            q.stats.dynamic_filter_wait_ms += waited_ms
+        if summary is None:
+            REGISTRY.counter("dynamic_filter.wait_expired").update()
+            return None
+        REGISTRY.counter("dynamic_filter.built").update()
+        probe_cols = [(lk, left_schema[lk]) for lk, _ in pairs]
+        pred = dynfilter.to_predicate(summary, probe_cols)
+        if pred is None:
+            return None
+        # count the conjuncts actually fused (a merged summary column
+        # can lose its value set past the NDV cap and contribute none)
+        n_filters = dynfilter.applicable_count(summary, probe_cols)
+        REGISTRY.counter("dynamic_filter.applied").update(n_filters)
+        # _roll_lock, not _stats_lock: roll_up folds task-side filter
+        # counts into the same field under it (see stats.QueryStats)
+        with q.stats._roll_lock:
+            q.stats.dynamic_filters += n_filters
+        # 1. fuse the filter into the probe fragment, directly under
+        # the join (names are J.left's output schema there)
+        new_J = dataclasses.replace(
+            J,
+            left=N.FilterNode(
+                source=J.left, predicate=pred, dynamic=True
+            ),
+        )
+        jpath = _path_to(frag, J)
+        new_frag = _replace_on_path(jpath[:-1], J, new_J)
+        new_idx = next(
+            i
+            for i, n in enumerate(N.walk(new_frag))
+            if n is part_scan
+        )
+        # 2. connector-level split pruning: only keys that reach the
+        # probe SCAN unchanged (Filter/Project pass-through of the bare
+        # column) may constrain split enumeration
+        scan_schema = dict(part_scan.schema)
+        scan_pairs = []
+        for (lk, _rk), cf in zip(pairs, summary.columns):
+            if scan_schema.get(lk) != left_schema[lk]:
+                continue
+            if all(
+                _passes_through(step, lk) for step in (probe_steps or ())
+            ):
+                scan_pairs.append(((lk, left_schema[lk]), cf))
+        ranges = None
+        if scan_pairs:
+            con = dynfilter.to_constraint(
+                dynfilter.subset_summary(
+                    [cf for _, cf in scan_pairs]
+                ),
+                [pc for pc, _ in scan_pairs],
+            )
+            if con:
+                ranges = self._pruned_ranges(
+                    q, stage, part_scan, con,
+                    deadline=t0 + 2.0 * wait_s,
+                )
+        return new_frag, new_idx, ranges
+
+    def _run_dynfilter_summary(
+        self, q: _Query, bstage, workers, keys, ndv, deadline
+    ):
+        """Run the build-summary tasks (one range per worker) and merge
+        their reported summaries, all within ``deadline`` (monotonic).
+        ANY failure — POST/status errors, task failure, worker death,
+        deadline expiry — returns None: the probe proceeds unfiltered.
+        Posted tasks are always collected + DELETEd (off-thread)."""
+        from presto_tpu.exec import dynfilter
+
+        ranges = assign_ranges(bstage.partition_rows, len(workers))
+        ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
+        dstage = self._new_stage(q, "dynfilter")
+        posted: List[tuple] = []
+        merged = None
+        ok = False
+
+        def df_policy() -> rpc.RpcPolicy:
+            """Every summary-plane RPC is capped by the REMAINING wait
+            budget (no retries): a stalled — not cleanly dead — build
+            worker must not hold probe scheduling past the bound the
+            session promised (rpc.request-timeout-s x retries would)."""
+            return rpc.RpcPolicy(
+                timeout_s=max(deadline - time.monotonic(), 0.05),
+                retries=0,
+            )
+
+        try:
+            for i, (lo, hi) in enumerate(ranges):
+                if time.monotonic() > deadline:
+                    return None
+                w = workers[i % len(workers)]
+                spec = self._register_task(q, dstage, FragmentSpec(
+                    task_id=f"{q.qid}.df.{uuid.uuid4().hex[:8]}",
+                    query_id=q.qid,
+                    fragment=bstage.worker_fragment,
+                    partition_scan=bstage.partition_scan,
+                    split_start=lo,
+                    split_end=hi,
+                    split_batch_rows=int(
+                        self.local.session.get("page_capacity")
+                    ),
+                    dynfilter_keys=tuple(keys),
+                    dynfilter_ndv=ndv,
+                    traceparent=q.trace.traceparent(),
+                ))
+                rpc.call_json(
+                    "POST", w.uri + "/v1/task", spec.to_json(),
+                    policy=df_policy(),
+                    traceparent=spec.traceparent,
+                )
+                posted.append((w, spec))
+            for w, spec in posted:
+                while True:
+                    if time.monotonic() > deadline:
+                        return None
+                    st = rpc.call_json(
+                        "GET",
+                        f"{w.uri}/v1/task/{spec.task_id}/status",
+                        policy=df_policy(),
+                        traceparent=spec.traceparent,
+                    )
+                    state = st.get("state")
+                    if state == "FINISHED":
+                        d = st.get("dynamic_filter")
+                        if not d:
+                            return None
+                        s = dynfilter.FilterSummary.from_json(d)
+                        merged = (
+                            s if merged is None else merged.merge(s, ndv)
+                        )
+                        break
+                    if state in ("FAILED", "ABORTED"):
+                        return None
+                    time.sleep(0.02)
+            ok = merged is not None
+            return merged
+        except Exception:
+            # injected faults / dead workers / RPC timeouts: degrade
+            return None
+        finally:
+            dstage.state = "FINISHED" if ok else "ABORTED"
+            for w, spec in posted:
+                self._abort_task(q, w, spec)
+
+    def _pruned_ranges(
+        self, q: _Query, stage, part_scan, con, deadline=None
+    ):
+        """Enumerate the probe scan's splits WITH the dynamic-filter
+        constraint and turn the survivors into worker ranges; record
+        ``dynamic_filter.splits_pruned``. Returns None (nothing pruned
+        — keep the legacy uniform ranges) or the range list.
+
+        ``deadline`` (monotonic) bounds coordinator-side enumeration
+        WALL TIME: a constraint-aware connector may probe statistics
+        it has not cached yet (ORC decodes the join-key column once
+        per stripe), and split pruning is an OPTIMIZATION — so the
+        enumeration runs on a background thread and the query stops
+        waiting at the deadline, scanning the legacy uniform ranges
+        instead. The abandoned probe still completes and warms the
+        connector's stats cache, so later queries prune for free."""
+        from presto_tpu.exec import dynfilter as DF
+
+        if deadline is not None and time.monotonic() > deadline:
+            return None
+        conn = self.local.catalogs.get(part_scan.handle.catalog)
+        over = max(1, int(self.local.session.get("split_queue_factor")))
+        n_ranges = max(len(self.active_workers()) * over, 1)
+        chunk = -(-max(stage.partition_rows, 1) // n_ranges)
+        base = tuple(part_scan.constraint)
+
+        def collect(c):
+            src = conn.get_splits(
+                part_scan.handle,
+                target_split_rows=chunk,
+                constraint=c,
+            )
+            out = []
+            while not src.exhausted:
+                out.extend(src.next_batch(256))
+            return [s for s in out if s.row_end > s.row_start]
+
+        def enumerate_both():
+            return (
+                collect(base),
+                collect(DF.merge_constraints(base, con)),
+            )
+
+        if deadline is None:
+            try:
+                all_splits, kept = enumerate_both()
+            except Exception:
+                return None  # enumeration trouble: legacy ranges
+        else:
+            # timed: the connector's stats probe cannot be interrupted
+            # mid-read, so it runs detached — the query gives up at
+            # the deadline (unfiltered, correct) while the probe
+            # finishes and caches for the next query
+            import queue as _queue
+
+            cell: "_queue.Queue" = _queue.Queue()
+
+            def run():
+                try:
+                    cell.put(("ok", enumerate_both()))
+                except Exception as e:
+                    cell.put(("err", e))
+
+            threading.Thread(target=run, daemon=True).start()
+            try:
+                kind, payload = cell.get(
+                    timeout=max(deadline - time.monotonic(), 0.05)
+                )
+            except _queue.Empty:
+                REGISTRY.counter(
+                    "dynamic_filter.enumeration_timeouts"
+                ).update()
+                return None
+            if kind == "err":
+                return None
+            all_splits, kept = payload
+        # decide by COVERED ROWS, not split counts: pruning the middle
+        # of a coalesced split INCREASES the count while still saving
+        # reads (one [0,300) split can become [0,100)+[200,300))
+        rows_pruned = sum(
+            s.row_end - s.row_start for s in all_splits
+        ) - sum(s.row_end - s.row_start for s in kept)
+        if rows_pruned <= 0:
+            return None
+        # coalesce survivors into runs (also the overlap basis for the
+        # pruned-split count), then chop each run to the legacy chunk
+        # size so split placement stays dynamic
+        runs: List[List[int]] = []
+        for s in sorted(kept, key=lambda s: s.row_start):
+            if runs and s.row_start <= runs[-1][1]:
+                runs[-1][1] = max(runs[-1][1], s.row_end)
+            else:
+                runs.append([s.row_start, s.row_end])
+        pruned = sum(
+            1
+            for s in all_splits
+            if not any(
+                lo < s.row_end and hi > s.row_start for lo, hi in runs
+            )
+        )
+        REGISTRY.counter("dynamic_filter.splits_pruned").update(pruned)
+        with q._stats_lock:
+            q.stats.dynamic_filter_splits_pruned += pruned
+        ranges = []
+        for lo, hi in runs:
+            while lo < hi:
+                ranges.append((lo, min(lo + chunk, hi)))
+                lo += chunk
+        return ranges or [(0, 0)]
+
     # ------------------------------------------------------- stage runner
 
     def _run_stage(
@@ -880,7 +1253,33 @@ class CoordinatorServer:
             # no scan admits a semantics-preserving partitioning:
             # single-task fallback on the coordinator's local engine
             return self.local._run(fragment_root)
-        worker_fragment = stage.worker_fragment
+        # dynamic filtering: a build-summary stage may rewrite the
+        # probe fragment (fused filter) and override the split ranges
+        # (connector-level pruning); None = today's plan, exactly.
+        # FAIL-OPEN at the boundary: the filter is an optimization and
+        # must never fail a query that would succeed unfiltered
+        try:
+            dyn = self._stage_dynamic_filter(q, stage, workers)
+        except Exception:
+            REGISTRY.counter("dynamic_filter.plan_errors").update()
+            log.warning(
+                "query=%s dynamic-filter planning failed; running "
+                "unfiltered", q.qid, exc_info=True,
+            )
+            dyn = None
+        dyn_fragment, dyn_scan_idx, dyn_ranges = (
+            dyn if dyn is not None else (None, None, None)
+        )
+        worker_fragment = (
+            dyn_fragment
+            if dyn_fragment is not None
+            else stage.worker_fragment
+        )
+        partition_scan_idx = (
+            dyn_scan_idx
+            if dyn_scan_idx is not None
+            else stage.partition_scan
+        )
         if order_by is not None:
             worker_fragment = dataclasses.replace(
                 order_by, source=worker_fragment
@@ -908,6 +1307,9 @@ class CoordinatorServer:
                     return self._run_stage_shuffled(
                         stage, workers, q, key_names, bucket_root,
                         rest_root,
+                        worker_fragment=worker_fragment,
+                        partition_scan_idx=partition_scan_idx,
+                        ranges_override=dyn_ranges,
                     )
                 except Exception as e:
                     out = self._local_fallback(q, fragment_root, None, e)
@@ -920,8 +1322,12 @@ class CoordinatorServer:
         # pull the next unclaimed range when it finishes — a straggler
         # naturally processes fewer ranges (work stealing by queue)
         over = max(1, int(self.local.session.get("split_queue_factor")))
-        ranges = assign_ranges(
-            stage.partition_rows, max(len(workers) * over, 1)
+        ranges = (
+            dyn_ranges
+            if dyn_ranges is not None
+            else assign_ranges(
+                stage.partition_rows, max(len(workers) * over, 1)
+            )
         )
         ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
         stage_stats = self._new_stage(q, "source")
@@ -931,7 +1337,7 @@ class CoordinatorServer:
                 task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
                 query_id=q.qid,
                 fragment=worker_fragment,
-                partition_scan=stage.partition_scan,
+                partition_scan=partition_scan_idx,
                 split_start=lo,
                 split_end=hi,
                 split_batch_rows=int(
@@ -1297,7 +1703,9 @@ class CoordinatorServer:
         return stage_page(merged, schema)
 
     def _run_stage_shuffled(
-        self, stage, workers, q: _Query, key_names, bucket_root, rest_root
+        self, stage, workers, q: _Query, key_names, bucket_root,
+        rest_root, worker_fragment=None, partition_scan_idx=None,
+        ranges_override=None,
     ):
         """Two-stage execution with a worker<->worker data plane.
 
@@ -1312,9 +1720,18 @@ class CoordinatorServer:
         shuffle start yet — documented simplification vs the reference's
         incremental addExchangeLocations)."""
         REGISTRY.counter("coordinator.shuffled_stages").update()
+        # dynamic-filter overrides from _run_stage (None = legacy)
+        if worker_fragment is None:
+            worker_fragment = stage.worker_fragment
+        if partition_scan_idx is None:
+            partition_scan_idx = stage.partition_scan
         over = max(1, int(self.local.session.get("split_queue_factor")))
-        ranges = assign_ranges(
-            stage.partition_rows, max(len(workers) * over, 1)
+        ranges = (
+            ranges_override
+            if ranges_override is not None
+            else assign_ranges(
+                stage.partition_rows, max(len(workers) * over, 1)
+            )
         )
         ranges = [r for r in ranges if r[1] > r[0]] or [(0, 0)]
         nparts = len(workers)
@@ -1325,8 +1742,8 @@ class CoordinatorServer:
             return self._register_task(q, prod_stage, FragmentSpec(
                 task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
                 query_id=q.qid,
-                fragment=stage.worker_fragment,
-                partition_scan=stage.partition_scan,
+                fragment=worker_fragment,
+                partition_scan=partition_scan_idx,
                 split_start=lo,
                 split_end=hi,
                 split_batch_rows=int(
@@ -1838,6 +2255,24 @@ class CoordinatorServer:
             {"name": c} for c in res.columns
         ]
         q.rows = [list(r) for r in res.rows()]
+
+
+def _passes_through(node: N.PlanNode, col: str) -> bool:
+    """Does ``col`` pass this probe-side node unchanged (so a dynamic
+    filter on it may constrain the SCAN's split enumeration)? Filters
+    preserve every column; a projection must map it to its own bare
+    ColumnRef. Anything else (a lower join's renames, unnest, ...)
+    disqualifies the column — the fused predicate still applies."""
+    from presto_tpu import expr as E
+
+    if isinstance(node, N.FilterNode):
+        return True
+    if isinstance(node, N.ProjectNode):
+        for name, expr in node.projections:
+            if name == col:
+                return isinstance(expr, E.ColumnRef) and expr.name == col
+        return False
+    return False
 
 
 def _make_handler(coord: CoordinatorServer):

@@ -22,7 +22,11 @@ from typing import Any, Dict, List
 
 from presto_tpu import expr as E
 from presto_tpu import types as T
-from presto_tpu.connectors.spi import ConnectorSplit, TableHandle
+from presto_tpu.connectors.spi import (
+    ConnectorSplit,
+    RangeSet,
+    TableHandle,
+)
 from presto_tpu.ops.aggregation import AggCall
 from presto_tpu.ops.sort import SortKey
 from presto_tpu.ops.window import WindowCall
@@ -30,8 +34,8 @@ from presto_tpu.plan import nodes as N
 
 
 def _registry() -> Dict[str, type]:
-    classes: List[type] = [TableHandle, ConnectorSplit, AggCall, SortKey,
-                           WindowCall]
+    classes: List[type] = [TableHandle, ConnectorSplit, RangeSet,
+                           AggCall, SortKey, WindowCall]
     for mod in (E, T, N):
         for name in dir(mod):
             obj = getattr(mod, name)
@@ -167,6 +171,13 @@ class FragmentSpec:
     #: fed by the pulled pages instead of a table scan.
     sources: tuple = ()
     partition: int = 0
+    #: dynamic-filter SUMMARY task (exec/dynfilter.py): instead of
+    #: emitting result pages, the worker summarizes the named output
+    #: columns (the join's build keys) of every batch — min/max +
+    #: small distinct sets, NDV-capped at ``dynfilter_ndv`` — merges
+    #: them, and reports the summary on the task-status response
+    dynfilter_keys: tuple = ()
+    dynfilter_ndv: int = 0
     #: trace context (utils.tracing traceparent header value): the
     #: coordinator stamps every task with the query's trace so
     #: worker-side spans join the query's span tree; also sent as the
@@ -188,6 +199,8 @@ class FragmentSpec:
             "partition_keys": list(self.partition_keys),
             "sources": [list(s) for s in self.sources],
             "partition": self.partition,
+            "dynfilter_keys": list(self.dynfilter_keys),
+            "dynfilter_ndv": self.dynfilter_ndv,
             "traceparent": self.traceparent,
         }
 
@@ -209,5 +222,7 @@ class FragmentSpec:
                 tuple(s) for s in d.get("sources", ())
             ),
             partition=d.get("partition", 0),
+            dynfilter_keys=tuple(d.get("dynfilter_keys", ())),
+            dynfilter_ndv=d.get("dynfilter_ndv", 0),
             traceparent=d.get("traceparent", ""),
         )

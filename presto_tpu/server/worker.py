@@ -104,6 +104,9 @@ class _Task:
         # (reference: addExchangeLocations + noMoreExchangeLocations)
         self.sources: List[tuple] = [tuple(s) for s in spec.sources]
         self.sources_done: bool = bool(spec.sources)
+        #: dynamic-filter summary (JSON dict) of a dynfilter_keys task,
+        #: set when the task finishes; shipped on the status response
+        self.dynfilter: Optional[dict] = None
 
     def add_sources(self, sources, done: bool) -> None:
         with self.cond:
@@ -547,11 +550,45 @@ class WorkerServer:
             page, release = stage_batch(lo, hi)
             return exec_batch(page, release)
 
+        # dynamic-filter SUMMARY task: batch outputs fold into one
+        # per-key summary (exec/dynfilter.py — min/max + NDV-capped
+        # distinct sets, string keys resolved through the page
+        # dictionary) instead of crossing the wire as pages; the
+        # coordinator reads the merged summary off the status response
+        summary_cell: List = []
+
         def emit(out) -> None:
+            if spec.dynfilter_keys:
+                from presto_tpu.exec import dynfilter
+
+                s = dynfilter.summarize_page(
+                    out,
+                    list(spec.dynfilter_keys),
+                    ndv_limit=spec.dynfilter_ndv
+                    or dynfilter.DEFAULT_NDV_LIMIT,
+                )
+                with task.cond:
+                    summary_cell.append(s)
+                return
             if spec.n_partitions > 1:
                 return _emit_partitioned(task, out)
             cols, n = pages_wire.page_to_wire_columns(out)
             _offer_chunked(task, cols, n)
+
+        def finish_summary() -> None:
+            """Merge per-batch summaries into the task's one summary
+            (empty range = empty build: nothing can match)."""
+            if not spec.dynfilter_keys:
+                return
+            from presto_tpu.exec import dynfilter
+
+            ndv = spec.dynfilter_ndv or dynfilter.DEFAULT_NDV_LIMIT
+            merged = None
+            for s in summary_cell:
+                merged = s if merged is None else merged.merge(s, ndv)
+            if merged is None:
+                merged = dynfilter.empty_summary(spec.dynfilter_keys)
+            task.dynfilter = merged.to_json()
 
         if spec.task_concurrency <= 1 or len(ranges) <= 1:
             # pipelined prefetch staging (staging_prefetch_depth /
@@ -597,6 +634,7 @@ class WorkerServer:
                 # deterministic close: joins the prefetch thread and
                 # drops queued batches BEFORE _run_task's release-all
                 batches.close()
+            finish_summary()
             return
         from concurrent.futures import ThreadPoolExecutor
 
@@ -604,6 +642,7 @@ class WorkerServer:
             futs = [pool.submit(run_batch, lo, hi) for lo, hi in ranges]
             for f in futs:
                 emit(f.result())
+        finish_summary()
 
     def _load_range(self, scan: N.TableScanNode, lo: int, hi: int):
         conn = self.runner.catalogs.get(scan.handle.catalog)
@@ -854,6 +893,7 @@ def _make_handler(worker: WorkerServer):
                         "num_pages": len(t.pages),
                         "stats": t.stats.to_dict(),
                         "spans": t.spans,
+                        "dynamic_filter": t.dynfilter,
                     },
                 )
             if (

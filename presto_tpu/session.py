@@ -212,6 +212,27 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             True,
         ),
         PropertyMetadata(
+            "dynamic_filtering_wait_ms",
+            "Distributed dynamic filtering: how long probe split "
+            "scheduling waits for the build-side filter summary before "
+            "proceeding UNFILTERED (bounded — build-worker death or "
+            "slowness degrades to the exact unfiltered plan, never "
+            "blocks the query). Tier-1 twin: dynamic-filtering.wait-ms",
+            float,
+            2000.0,
+            _non_negative("dynamic_filtering_wait_ms"),
+        ),
+        PropertyMetadata(
+            "dynamic_filtering_ndv_limit",
+            "Largest build-side distinct-value count kept as an "
+            "IN-list summary (incl. dictionary string keys); above it "
+            "only min/max bounds flow to the probe side. Tier-1 twin: "
+            "dynamic-filtering.ndv-limit",
+            int,
+            64,
+            _positive("dynamic_filtering_ndv_limit"),
+        ),
+        PropertyMetadata(
             "query_max_run_time_s",
             "Per-query wall-clock limit (seconds)",
             float,
@@ -334,6 +355,11 @@ class NodeConfig:
         # to OPEN, and the OPEN cool-off before the half-open probe
         "failure-detector.threshold": int,
         "failure-detector.open-s": float,
+        # distributed dynamic filtering: bounded wait for the build
+        # summary before probe scheduling proceeds unfiltered, and the
+        # NDV cap for IN-list summaries (exec/dynfilter.py)
+        "dynamic-filtering.wait-ms": float,
+        "dynamic-filtering.ndv-limit": int,
         # deterministic chaos: JSON FaultPlane spec (utils.faults)
         "fault-injection.spec": str,
     }
