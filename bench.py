@@ -41,6 +41,21 @@ WARMUP = 1
 ITERS = 5
 
 
+def skip_line(metric: str, exc: BaseException, unit: str = "rows/s") -> dict:
+    """Result line for a config that could NOT be measured (backend
+    init failure, config crash). BENCH_r05 regression: a failed run
+    once emitted ``value: 0`` with the error beside it, and the zero
+    poisoned the metric trajectory as if the engine measured 0 rows/s.
+    A skipped config must carry NO value at all — just the skip flag
+    and the error."""
+    return {
+        "metric": metric,
+        "skipped": True,
+        "unit": unit,
+        "error": f"{type(exc).__name__}: {exc}"[:300],
+    }
+
+
 def _table_rows(runner, schema: str, table: str) -> int:
     """Driving-table cardinality from connector stats (the closed-form
     generator's counts differ slightly from upstream dbgen's, so rows/s
@@ -356,17 +371,7 @@ def main() -> None:
             print(json.dumps(line), flush=True)
         except Exception as e:
             failed += 1
-            print(
-                json.dumps(
-                    {
-                        "metric": metric,
-                        "value": 0,
-                        "unit": "rows/s",
-                        "error": f"{type(e).__name__}: {e}"[:300],
-                    }
-                ),
-                flush=True,
-            )
+            print(json.dumps(skip_line(metric, e)), flush=True)
     if failed:
         # honest exit status (VERDICT r3 weak 1): a crashed/errored
         # config must not read as rc=0 to the matrix wrapper
@@ -377,15 +382,7 @@ if __name__ == "__main__":
     try:
         main()
     except Exception as e:  # never leave the driver without a JSON line
-        print(
-            json.dumps(
-                {
-                    "metric": "tpch_q1_sf1_rows_per_sec",
-                    "value": 0,
-                    "unit": "rows/s",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(e).__name__}: {e}"[:300],
-                }
-            )
-        )
+        # skipped, NOT value: 0 — a backend-init failure is a missing
+        # measurement, not a measured zero (BENCH_r05)
+        print(json.dumps(skip_line("tpch_q1_sf1_rows_per_sec", e)))
         sys.exit(0)

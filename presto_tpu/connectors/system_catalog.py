@@ -242,6 +242,24 @@ class SystemConnector(Connector):
                 "evictions": 0,
             },
         ]
+        # durable-exchange spool occupancy (fault-tolerant execution):
+        # present when the embedding coordinator has exchange.spool-path
+        # configured (server.spool shares the directory with workers)
+        cluster = getattr(self._runner, "cluster", None)
+        spool = getattr(cluster, "spool", None) if cluster else None
+        if spool is not None:
+            s = spool.stats()
+            rows.append(
+                {
+                    "cache": "exchange.spool",
+                    "entries": s["entries"],
+                    "bytes": s["bytes"],
+                    "budget_bytes": s["budget_bytes"],
+                    "hits": s["hits"],
+                    "misses": s["misses"],
+                    "evictions": s["evictions"],
+                }
+            )
         return rows
 
     def _node_rows(self):

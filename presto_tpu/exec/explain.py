@@ -157,6 +157,13 @@ def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
             f"splits_pruned {qstats.dynamic_filter_splits_pruned}, "
             f"wait {qstats.dynamic_filter_wait_ms:.1f} ms"
         )
+    if qstats.retry_policy and qstats.retry_policy != "NONE":
+        lines.append(
+            f"fault tolerance: retry_policy={qstats.retry_policy}, "
+            f"task_recoveries {qstats.task_recoveries}, "
+            f"spool_pages_served {qstats.spool_pages_served}, "
+            f"query_restarts {qstats.query_restarts}"
+        )
     for st in qstats.stages:
         r = st.rollup()
         lines.append(

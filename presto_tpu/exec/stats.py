@@ -85,6 +85,9 @@ class TaskStats:
     #: probe rows dropped by fused dynamic filters in THIS task's
     #: programs (traced out of the compiled fragment)
     dynamic_filter_rows_pruned: int = 0
+    #: upstream exchange pages this task re-served from the durable
+    #: spool instead of a (dead) producer worker (server.spool)
+    spool_pages_served: int = 0
     device_fragments: int = 0
     #: this attempt was a speculative (backup) launch of a straggling
     #: range — winners and losers both carry the flag in the rollup
@@ -129,6 +132,9 @@ class StageStats:
             "dynamic_filter_rows_pruned": sum(
                 t.dynamic_filter_rows_pruned for t in self.tasks
             ),
+            "spool_pages_served": sum(
+                t.spool_pages_served for t in self.tasks
+            ),
             "failed_tasks": sum(
                 1 for t in self.tasks if t.state == "FAILED"
             ),
@@ -165,6 +171,11 @@ class QueryStats:
     dynamic_filter_rows_pruned: int = 0  # probe rows dropped pre-join
     dynamic_filter_splits_pruned: int = 0  # probe splits never read
     dynamic_filter_wait_ms: float = 0.0  # probe wait on the build summary
+    #: fault-tolerant execution (session retry_policy, server.spool)
+    retry_policy: str = ""  # NONE | TASK | QUERY ("" = untracked/local)
+    task_recoveries: int = 0  # lost tasks rescheduled mid-stage
+    query_restarts: int = 0  # bounded full restarts (retry_policy=QUERY)
+    spool_pages_served: int = 0  # upstream pages re-served from the spool
     #: task-side portions already folded into dynamic_filter_rows_pruned
     #: / dynamic_filters (roll_up bookkeeping — keeps coordinator-local
     #: additions from gather-splice / local-fallback executions intact;
@@ -217,6 +228,11 @@ class QueryStats:
         self.input_bytes = sum(
             t.input_bytes for s in self.stages for t in s.tasks
         )
+        # spool re-serves happen worker-side (merge tasks reading a
+        # dead producer's committed pages): overwrite-sum like staging
+        self.spool_pages_served = sum(
+            t.spool_pages_served for s in self.stages for t in s.tasks
+        )
         # worker-side fused-filter pruning folds in as a DELTA (the
         # field also accumulates coordinator-local pruning from
         # gather-splice / local-fallback executions, which a from-
@@ -266,6 +282,10 @@ class QueryStats:
                 self.dynamic_filter_splits_pruned
             ),
             "dynamic_filter_wait_ms": self.dynamic_filter_wait_ms,
+            "retry_policy": self.retry_policy,
+            "task_recoveries": self.task_recoveries,
+            "query_restarts": self.query_restarts,
+            "spool_pages_served": self.spool_pages_served,
             "input_rows": self.input_rows,
             "input_bytes": self.input_bytes,
             "output_rows": self.output_rows,

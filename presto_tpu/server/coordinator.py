@@ -31,9 +31,10 @@ import numpy as np
 from presto_tpu.exec.staging import stage_page
 from presto_tpu.exec.stats import QueryStats, StageStats, TaskStats
 from presto_tpu.plan import nodes as N
-from presto_tpu.server import pages_wire, rpc
+from presto_tpu.server import pages_wire, rpc, task_ids
 from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.scheduler import assign_ranges, plan_stage
+from presto_tpu.server.spool import ExchangeSpool
 from presto_tpu.utils import faults
 from presto_tpu.utils.metrics import REGISTRY, DistributionStat
 from presto_tpu.utils.tracing import Trace
@@ -55,6 +56,15 @@ DRAIN_GRACE_S = 900.0
 class NoLiveWorkers(RuntimeError):
     """Every candidate worker is dead or circuit-open — the trigger
     for coordinator-local fallback execution."""
+
+
+def _is_draining_503(exc) -> bool:
+    """A DRAINING worker's task rejection: recoverable AND free — the
+    task was never created, so re-routing it is not a recovery and
+    must neither charge the retry budget nor penalize the breaker."""
+    return (
+        isinstance(exc, urllib.error.HTTPError) and exc.code == 503
+    )
 
 
 @dataclasses.dataclass
@@ -85,6 +95,9 @@ class _Query:
         )
         self._stats_lock = threading.Lock()
         self._stage_seq = itertools.count(0)
+        #: logical-task sequence for deterministic attempt ids
+        #: (server.task_ids — the spool recovery key space)
+        self._task_seq = itertools.count(0)
         self._task_stage: Dict[str, StageStats] = {}
         self._recorded: set = set()
         self._adopted = False  # registered in the runner's QueryHistory
@@ -214,8 +227,23 @@ class CoordinatorServer:
         )
         if fault_spec:
             faults.configure(fault_spec)
+        # fault-tolerant execution: tier-1 retry-policy seeds the
+        # session default; the durable-exchange spool (shared dir with
+        # the workers) backs TASK-level recovery and the occupancy row
+        # in system.runtime.caches
+        rp = config.get("retry-policy") if config else None
+        if rp is not None:
+            self.local.session.set("retry_policy", rp)
+        self.spool = ExchangeSpool.from_config(config)
         self._lock = threading.Lock()
         self._qid = itertools.count(1)
+        #: per-boot nonce folded into every query id: deterministic
+        #: task-attempt ids must never COLLIDE across coordinator
+        #: restarts — a restarted coordinator's q_c1 minting the same
+        #: attempt ids as its previous incarnation would let the shared
+        #: spool serve (or interleave with) a dead run's pages inside
+        #: the TTL window
+        self._boot = uuid.uuid4().hex[:6]
         self._shutting_down = False
         self._admit = threading.Semaphore(max_concurrent_queries)
         self._max_queued = max_queued_queries
@@ -287,16 +315,20 @@ class CoordinatorServer:
 
     # ---------------------------------------------------------- discovery
 
-    def announce(self, node_id: str, uri: str) -> None:
+    def announce(
+        self, node_id: str, uri: str, state: str = "ACTIVE"
+    ) -> None:
         with self._lock:
             w = self.workers.get(node_id)
             if w is None:
                 self.workers[node_id] = _WorkerNode(
-                    node_id=node_id, uri=uri, last_seen=time.time()
+                    node_id=node_id, uri=uri, last_seen=time.time(),
+                    state=state,
                 )
             else:
                 w.last_seen = time.time()
                 w.uri = uri
+                w.state = state
 
     def _ttl_workers(self) -> List[_WorkerNode]:
         """Workers announced within the discovery TTL (no breaker
@@ -311,15 +343,18 @@ class CoordinatorServer:
             ]
 
     def active_workers(self, exclude=()) -> List[_WorkerNode]:
-        """Schedulable workers: announced within the discovery TTL AND
-        not circuit-open (an OPEN breaker excludes the worker; after
-        its cool-off, ``allow()`` admits one half-open probe here).
-        ``exclude`` filters BEFORE the breaker check, so asking for a
-        spare never consumes an excluded worker's probe slot."""
+        """Schedulable workers: announced within the discovery TTL,
+        not DRAINING (the drain protocol — a draining worker finishes
+        what it has but accepts nothing new), AND not circuit-open (an
+        OPEN breaker excludes the worker; after its cool-off,
+        ``allow()`` admits one half-open probe here). ``exclude``
+        filters BEFORE the breaker check, so asking for a spare never
+        consumes an excluded worker's probe slot."""
         return [
             w
             for w in self._ttl_workers()
-            if w.node_id not in exclude
+            if w.state == "ACTIVE"
+            and w.node_id not in exclude
             and self._breaker(w.node_id).allow()
         ]
 
@@ -357,6 +392,10 @@ class CoordinatorServer:
         gets a real verdict recorded instead."""
         probe = rpc.RpcPolicy(timeout_s=2.0, retries=0)
         for w in self._ttl_workers():
+            if w.state != "ACTIVE":
+                # a DRAINING worker answers /v1/status but accepts no
+                # work: it must not veto coordinator-local fallback
+                continue
             try:
                 rpc.call_json(
                     "GET", w.uri + "/v1/status", policy=probe
@@ -369,6 +408,46 @@ class CoordinatorServer:
             except Exception:
                 self._worker_failed(w)
         return False
+
+    # ------------------------------------------- fault-tolerant execution
+
+    def _retry_policy(self) -> str:
+        """Session ``retry_policy``, normalized (NONE | TASK | QUERY)."""
+        return str(self.local.session.get("retry_policy")).upper()
+
+    def _spooling(self) -> bool:
+        """Should task specs carry the spool flag? TASK/QUERY policy
+        with a configured shared spool directory; NONE never spools
+        (bit-for-bit legacy behavior)."""
+        return self.spool is not None and self._retry_policy() in (
+            "TASK",
+            "QUERY",
+        )
+
+    def _retry_spec(
+        self, q: Optional[_Query], prior: FragmentSpec, **overrides
+    ) -> FragmentSpec:
+        """Replacement attempt of a logical task: the SAME logical id
+        with attempt+1 (server.task_ids), so spool attempt-dedup and
+        the per-stage attempt counters line up, registered to the same
+        stage as the prior attempt."""
+        spec = dataclasses.replace(
+            prior,
+            task_id=task_ids.next_attempt(prior.task_id),
+            **overrides,
+        )
+        if q is not None:
+            with q._stats_lock:
+                st = q._task_stage.get(prior.task_id)
+                if st is not None:
+                    q._task_stage[spec.task_id] = st
+        return spec
+
+    def _record_recovery(self, q: Optional[_Query]) -> None:
+        REGISTRY.counter("coordinator.tasks_retried").update()
+        if q is not None:
+            with q._stats_lock:
+                q.stats.task_recoveries += 1
 
     def _take_retry(self, q: _Query) -> bool:
         """Consume one unit of the query's task-retry budget (the
@@ -398,7 +477,7 @@ class CoordinatorServer:
                 dataclasses.replace(
                     w,
                     state=(
-                        "ACTIVE"
+                        w.state
                         if now - w.last_seen <= NODE_TTL_S
                         else "GONE"
                     ),
@@ -425,8 +504,10 @@ class CoordinatorServer:
     def submit(self, sql: str, user: str = "presto_tpu") -> _Query:
         # "q_c" namespace: distributed queries join the runner's
         # QueryHistory (adopt), whose own ids are "q_N" — the two
-        # counters are independent and must not collide there
-        q = _Query(f"q_c{next(self._qid)}", sql)
+        # counters are independent and must not collide there. The
+        # boot nonce keeps ids (and the task-attempt ids minted from
+        # them) unique across coordinator restarts sharing one spool
+        q = _Query(f"q_c{next(self._qid)}_{self._boot}", sql)
         q.user = user
         q.resource_group = None
         with self._lock:
@@ -509,7 +590,7 @@ class CoordinatorServer:
             try:
                 with REGISTRY.timer("coordinator.query_time").time():
                     with q.trace.span("query", query_id=q.qid):
-                        self._run_sql(q)
+                        self._run_sql_with_restart(q)
                 if not q.done.is_set():  # a killed query stays FAILED
                     q.state = "FINISHED"
             except Exception as e:
@@ -536,6 +617,55 @@ class CoordinatorServer:
                     # frees the group slot and admits the next queued
                     # query by weighted fairness
                     self.resource_groups.finish(q.resource_group)
+
+    def _run_sql_with_restart(self, q: _Query) -> None:
+        """``retry_policy=QUERY``: a bounded full-query restart is the
+        LAST resort when task-level recovery could not save the query
+        (reference: Tardigrade's QUERY retry policy). Only failures
+        that mean "the cluster changed under us" (connection-level, a
+        draining/lost worker, no live workers) are restartable —
+        execution errors would fail again identically."""
+        budget = (
+            int(self.local.session.get("query_retry_count"))
+            if self._retry_policy() == "QUERY"
+            else 0
+        )
+        attempt = 0
+        while True:
+            try:
+                if attempt == 0:
+                    return self._run_sql(q)
+                with q.trace.span(
+                    "recovery", phase="query-restart", attempt=attempt
+                ):
+                    return self._run_sql(q)
+            except Exception as e:
+                restartable = rpc.is_task_recoverable(e) or isinstance(
+                    e, NoLiveWorkers
+                )
+                if (
+                    attempt >= budget
+                    or not restartable
+                    or q.done.is_set()
+                ):
+                    raise
+                attempt += 1
+                REGISTRY.counter("coordinator.query_restarts").update()
+                log.warning(
+                    "query=%s restarting (attempt %d/%d) after %s: %s",
+                    q.qid, attempt, budget, type(e).__name__, e,
+                )
+                # close out the failed attempt's partial state: stages
+                # left RUNNING become ABORTED, partial results dropped
+                with q._stats_lock:
+                    q.stats.query_restarts = attempt
+                    for st in q.stats.stages:
+                        if st.state == "RUNNING":
+                            st.state = "ABORTED"
+                        for t in st.tasks:
+                            if t.state in ("QUEUED", "RUNNING"):
+                                t.state = "FAILED"
+                q.columns, q.rows = [], []
 
     def _run_sql(self, q: _Query) -> None:
         from presto_tpu.sql import ast, parse_statement
@@ -593,6 +723,7 @@ class CoordinatorServer:
         # query-completed event through it
         self.local.history.adopt(q.stats)
         q._adopted = True
+        q.stats.retry_policy = self._retry_policy()
         t0 = time.perf_counter()
         with q.trace.span("plan"):
             plan = plan_statement(
@@ -1055,7 +1186,9 @@ class CoordinatorServer:
                     return None
                 w = workers[i % len(workers)]
                 spec = self._register_task(q, dstage, FragmentSpec(
-                    task_id=f"{q.qid}.df.{uuid.uuid4().hex[:8]}",
+                    task_id=task_ids.mint(
+                        q.qid, task_ids.DYNFILTER, next(q._task_seq)
+                    ),
                     query_id=q.qid,
                     fragment=bstage.worker_fragment,
                     partition_scan=bstage.partition_scan,
@@ -1334,7 +1467,9 @@ class CoordinatorServer:
 
         def make_spec(lo: int, hi: int) -> FragmentSpec:
             return self._register_task(q, stage_stats, FragmentSpec(
-                task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                task_id=task_ids.mint(
+                    q.qid, task_ids.SOURCE, next(q._task_seq)
+                ),
                 query_id=q.qid,
                 fragment=worker_fragment,
                 partition_scan=partition_scan_idx,
@@ -1443,7 +1578,7 @@ class CoordinatorServer:
         local engine instead of failing the query. Returns None when
         degradation does NOT apply — execution errors, or live workers
         remaining — so the caller re-raises."""
-        degradable = rpc.is_retryable(exc) or isinstance(
+        degradable = rpc.is_task_recoverable(exc) or isinstance(
             exc, NoLiveWorkers
         )
         if not degradable or self._any_worker_alive():
@@ -1603,7 +1738,9 @@ class CoordinatorServer:
 
             def make_spec(lo: int, hi: int) -> FragmentSpec:
                 return self._register_task(q, pstage, FragmentSpec(
-                    task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                    task_id=task_ids.mint(
+                        q.qid, task_ids.PRODUCER, next(q._task_seq)
+                    ),
                     query_id=q.qid,
                     fragment=stage.worker_fragment,
                     partition_scan=stage.partition_scan,
@@ -1620,6 +1757,7 @@ class CoordinatorServer:
                     ),
                     n_partitions=nparts,
                     partition_keys=tuple(keys),
+                    spool=self._spooling(),
                     traceparent=q.trace.traceparent(),
                 ))
 
@@ -1629,12 +1767,15 @@ class CoordinatorServer:
                 self._wait_task(w, spec)
                 return (w.uri, spec.task_id, group)
 
-            # producer death fails the query: partitioned exchanges
-            # are non-recoverable (same semantics as the shuffled
-            # agg path; the replicated gather path keeps range retry)
+            # legacy (retry_policy=NONE): producer death fails the
+            # query — partitioned exchanges are non-recoverable. Under
+            # TASK (and QUERY, its superset) the stage recovers: the
+            # sources list carries only winning attempts (barrier
+            # mode), and join tasks pulling a later-dead producer
+            # re-serve its committed partitions from the durable spool
             res = self._ranged_tasks(
                 workers, ranges, make_spec, wait_producer,
-                q=q, retry=False,
+                q=q, retry=self._retry_policy() in ("TASK", "QUERY"),
             )
             pstage.state = "FINISHED"
             return res
@@ -1665,7 +1806,9 @@ class CoordinatorServer:
             def run_join_task(i: int):
                 w = workers[i % len(workers)]
                 spec = self._register_task(q, jstage, FragmentSpec(
-                    task_id=f"{q.qid}.join.{uuid.uuid4().hex[:8]}",
+                    task_id=task_ids.mint(
+                        q.qid, task_ids.JOIN, next(q._task_seq)
+                    ),
                     query_id=q.qid,
                     fragment=join_frag,
                     partition_scan=-1,
@@ -1673,6 +1816,7 @@ class CoordinatorServer:
                     split_end=0,
                     sources=tuple(sources),
                     partition=i,
+                    spool=self._spooling(),
                     traceparent=q.trace.traceparent(),
                 ))
                 with clock:
@@ -1740,7 +1884,9 @@ class CoordinatorServer:
 
         def make_spec(lo: int, hi: int) -> FragmentSpec:
             return self._register_task(q, prod_stage, FragmentSpec(
-                task_id=f"{q.qid}.{uuid.uuid4().hex[:8]}",
+                task_id=task_ids.mint(
+                    q.qid, task_ids.PRODUCER, next(q._task_seq)
+                ),
                 query_id=q.qid,
                 fragment=worker_fragment,
                 partition_scan=partition_scan_idx,
@@ -1757,6 +1903,7 @@ class CoordinatorServer:
                 ),
                 n_partitions=nparts,
                 partition_keys=tuple(key_names),
+                spool=self._spooling(),
                 traceparent=q.trace.traceparent(),
             ))
 
@@ -1814,13 +1961,16 @@ class CoordinatorServer:
                 for k in range(len(candidates)):
                     w = candidates[(i + k) % len(candidates)]
                     spec = self._register_task(q, merge_stage, FragmentSpec(
-                        task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
+                        task_id=task_ids.mint(
+                            q.qid, task_ids.MERGE, next(q._task_seq)
+                        ),
                         query_id=q.qid,
                         fragment=bucket_root,
                         partition_scan=-1,
                         split_start=0,
                         split_end=0,
                         partition=i,
+                        spool=self._spooling(),
                         traceparent=q.trace.traceparent(),
                     ))
                     try:
@@ -1841,10 +1991,18 @@ class CoordinatorServer:
                         "no live worker accepts merge tasks"
                     )
 
+            # legacy (retry_policy=NONE): a producer dying after its
+            # announcement fails the query (classic non-recoverable
+            # exchange). With the spool (TASK, or QUERY before its
+            # last-resort restart) producers are retryable: every
+            # attempt spools under one logical key and merge tasks
+            # consume exactly ONE committed attempt per key, so a
+            # retried producer racing its announced original can
+            # never double-count
             with q.trace.span("schedule", stage_id=prod_stage.stage_id):
                 producers = self._ranged_tasks(
                     workers, ranges, make_spec, wait_producer,
-                    q=q, retry=False,
+                    q=q, retry=self._spooling(),
                 )
             sources = tuple((w.uri, tid) for w, tid in producers)
             # seal with the FULL list: add_sources dedups, so this
@@ -1853,19 +2011,15 @@ class CoordinatorServer:
 
             def run_merge_fallback(i: int, w):
                 # merge-worker death: re-run that partition's FINAL as
-                # a barrier-mode merge task (full source list known by
-                # now) on a live worker
-                spec = self._register_task(q, merge_stage, FragmentSpec(
-                    task_id=f"{q.qid}.merge.{uuid.uuid4().hex[:8]}",
-                    query_id=q.qid,
-                    fragment=bucket_root,
-                    partition_scan=-1,
-                    split_start=0,
-                    split_end=0,
-                    sources=sources,
-                    partition=i,
-                    traceparent=q.trace.traceparent(),
-                ))
+                # a barrier-mode merge task — the SAME logical task,
+                # next attempt — on a live worker (full source list
+                # known by now; dead producers' partitions re-serve
+                # from the durable spool when retry_policy spools)
+                spec = self._register_task(
+                    q,
+                    merge_stage,
+                    self._retry_spec(q, merge_specs[i][1], sources=sources),
+                )
                 try:
                     self._rpc_json(
                         "POST", w.uri + "/v1/task", spec.to_json(),
@@ -1890,8 +2044,14 @@ class CoordinatorServer:
                     )
                     if not others:
                         raise
-                    REGISTRY.counter("coordinator.tasks_retried").update()
-                    return run_merge_fallback(i, others[i % len(others)])
+                    self._record_recovery(q)
+                    with q.trace.span(
+                        "recovery", phase="merge-task",
+                        task_id=spec.task_id,
+                    ):
+                        return run_merge_fallback(
+                            i, others[i % len(others)]
+                        )
 
             with q.trace.span("gather", stage_id=merge_stage.stage_id):
                 with ThreadPoolExecutor(nparts) as pool:
@@ -1987,22 +2147,47 @@ class CoordinatorServer:
 
         def run_range(w, lo, hi):
             if not retry:
-                # non-recoverable stage (shuffle producer): no retry,
-                # no speculation — run the single attempt inline
-                # instead of paying a monitor thread per range
+                # non-recoverable stage (shuffle producer under
+                # retry_policy=NONE): no retry, no speculation — run
+                # the single attempt inline instead of paying a
+                # monitor thread per range. One exception: a DRAINING
+                # worker answers the POST with 503 and creates NO task,
+                # so re-routing the untouched spec to a spare is free
+                # and safe even for pipelined exchanges.
                 spec = make_spec(lo, hi)
+                target, rerouted = w, set()
+                while True:
+                    try:
+                        rpc.call_json(
+                            "POST",
+                            target.uri + "/v1/task",
+                            spec.to_json(),
+                            policy=self._rpc_policy,
+                            traceparent=spec.traceparent,
+                        )
+                        break
+                    except urllib.error.HTTPError as e:
+                        if e.code != 503:
+                            raise
+                        rerouted.add(target.node_id)
+                        alt = spare_worker(rerouted)
+                        if alt is None:
+                            raise
+                        target = alt
+                    except Exception as e:
+                        # connection-level POST failure: the breaker
+                        # must learn about the dead worker even though
+                        # this stage cannot retry
+                        if rpc.is_retryable(e):
+                            self._worker_failed(target)
+                        raise
                 try:
-                    rpc.call_json(
-                        "POST", w.uri + "/v1/task", spec.to_json(),
-                        policy=self._rpc_policy,
-                        traceparent=spec.traceparent,
-                    )
-                    out = consume(w, spec)
-                    self._worker_ok(w)
+                    out = consume(target, spec)
+                    self._worker_ok(target)
                     return out
                 except Exception as e:
                     if rpc.is_retryable(e):
-                        self._worker_failed(w)
+                        self._worker_failed(target)
                     raise
             cond = threading.Condition()
             state = {
@@ -2029,16 +2214,17 @@ class CoordinatorServer:
                                 ).update()
                 except Exception as e:
                     # a 404 on a task endpoint means the worker lost
-                    # the task (crash + restart under the same URI):
-                    # recoverable, like a dead socket. Other HTTP
+                    # the task (crash + restart under the same URI);
+                    # a 503 means it is DRAINING and created nothing:
+                    # both recoverable, like a dead socket. Other HTTP
                     # errors (a FAILED task's 500) are execution
                     # failures — they would fail anywhere.
-                    recoverable = rpc.is_retryable(e) or (
-                        isinstance(e, urllib.error.HTTPError)
-                        and e.code == 404
-                    )
+                    recoverable = rpc.is_task_recoverable(e)
                     if recoverable:
-                        self._worker_failed(worker)
+                        if not _is_draining_503(e):
+                            # a graceful drain is not a failure: no
+                            # breaker penalty for leaving politely
+                            self._worker_failed(worker)
                         with cond:
                             state["conn_errors"].append(e)
                     else:
@@ -2053,8 +2239,20 @@ class CoordinatorServer:
             def launch(worker, backup=False):
                 # register synchronously: the monitor loop must never
                 # observe active == 0 for a launched-but-unstarted
-                # attempt
-                spec = make_spec(lo, hi)
+                # attempt. Re-launches of this range keep the logical
+                # task id and bump only the attempt (server.task_ids):
+                # spool dedup and per-stage attempt counters key on it
+                with cond:
+                    prior = (
+                        state["attempts"][-1][1]
+                        if state["attempts"]
+                        else None
+                    )
+                spec = (
+                    make_spec(lo, hi)
+                    if prior is None
+                    else self._retry_spec(q, prior)
+                )
                 if backup and q is not None:
                     with q._stats_lock:
                         q._speculative.add(spec.task_id)
@@ -2098,15 +2296,30 @@ class CoordinatorServer:
                         wk.node_id for wk, _ in state["attempts"]
                     }
                     nxt = spare_worker(tried) if retry else None
-                    if nxt is None or q is None or not self._take_retry(q):
+                    # a drain rejection re-routes for FREE: the task
+                    # was never created, nothing was lost — charging
+                    # the retry budget would let task_retry_budget=0
+                    # break the drain protocol's zero-failure promise
+                    free = _is_draining_503(last_err)
+                    if nxt is None or q is None or (
+                        not free and not self._take_retry(q)
+                    ):
                         raise last_err or NoLiveWorkers(
                             "no live worker for range "
                             f"[{lo}, {hi})"
                         )
-                    REGISTRY.counter(
-                        "coordinator.tasks_retried"
-                    ).update()
-                    launch(nxt)
+                    if free:
+                        REGISTRY.counter(
+                            "coordinator.drain_reroutes"
+                        ).update()
+                        launch(nxt)
+                        continue
+                    self._record_recovery(q)
+                    with q.trace.span(
+                        "recovery", phase="task-retry",
+                        range=f"[{lo}, {hi})",
+                    ):
+                        launch(nxt)
                     continue
                 if spec_on and not speculated:
                     th = straggler_threshold()
@@ -2315,7 +2528,9 @@ def _make_handler(coord: CoordinatorServer):
             parts = [p for p in self.path.split("/") if p]
             if parts == ["v1", "announcement"]:
                 d = json.loads(self._read_body().decode())
-                coord.announce(d["node_id"], d["uri"])
+                coord.announce(
+                    d["node_id"], d["uri"], d.get("state", "ACTIVE")
+                )
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
 

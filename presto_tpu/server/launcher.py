@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
+import sys
 from typing import Dict, Optional, Tuple
 
 from presto_tpu.connectors import create_connector
@@ -124,6 +126,39 @@ def launch(etc_dir: str):
     return server
 
 
+def install_signal_handlers(server, exit=sys.exit):
+    """SIGTERM/SIGINT -> graceful drain (rolling-restart protocol).
+
+    A worker drains: it stops accepting tasks, announces ``DRAINING``
+    (the coordinator stops scheduling to it), finishes + serves/spools
+    its running outputs, then exits clean — a rolling restart under
+    live load loses zero queries. A coordinator (no ``drain``) falls
+    back to its ordinary shutdown. Returns the installed handler so
+    tests can invoke and assert it directly."""
+
+    def handler(signum, frame):
+        name = signal.Signals(signum).name
+        print(f"{name}: draining before exit", flush=True)
+        drain = getattr(server, "drain", None)
+        try:
+            if drain is not None:
+                drain()
+            else:
+                server.shutdown()
+        finally:
+            exit(0)
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, handler)
+    except ValueError:
+        # signal handlers only install from the main thread; an
+        # embedded (threaded) launch still gets the drain-aware main
+        # loop, just not signal wiring
+        pass
+    return handler
+
+
 def main(argv: Optional[list] = None) -> None:
     ap = argparse.ArgumentParser(
         description="presto-tpu node launcher (config-file bootstrap)"
@@ -133,14 +168,17 @@ def main(argv: Optional[list] = None) -> None:
     server = launch(args.etc_dir)
     kind = type(server).__name__
     print(f"{kind} listening on {server.uri}", flush=True)
-    try:
-        import time
+    # SIGTERM (rolling restarts) and SIGINT (Ctrl-C during tests) both
+    # drain gracefully instead of leaving workers undrained
+    install_signal_handlers(server)
+    import time
 
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        shutdown = getattr(server, "shutdown")
-        shutdown()
+    # exit when the server shuts down, signal or not: a worker drained
+    # over HTTP (PUT /v1/state/drain) must end the PROCESS — a rolling
+    # restart waits on exactly that, and a sleeping zombie would hang it
+    while not getattr(server, "_shutting_down", False):
+        time.sleep(0.5)
+    print(f"{kind} exited", flush=True)
 
 
 if __name__ == "__main__":

@@ -178,6 +178,13 @@ class FragmentSpec:
     #: them, and reports the summary on the task-status response
     dynfilter_keys: tuple = ()
     dynfilter_ndv: int = 0
+    #: fault-tolerant execution (session ``retry_policy`` TASK/QUERY
+    #: with ``exchange.spool-path`` configured): the worker tees this
+    #: task's partitioned output-buffer pages into the durable exchange
+    #: spool (committed on FINISH), and a merge/join task whose
+    #: upstream peer died re-serves that source's partition from the
+    #: spool instead of failing (server.spool)
+    spool: bool = False
     #: trace context (utils.tracing traceparent header value): the
     #: coordinator stamps every task with the query's trace so
     #: worker-side spans join the query's span tree; also sent as the
@@ -201,6 +208,7 @@ class FragmentSpec:
             "partition": self.partition,
             "dynfilter_keys": list(self.dynfilter_keys),
             "dynfilter_ndv": self.dynfilter_ndv,
+            "spool": self.spool,
             "traceparent": self.traceparent,
         }
 
@@ -224,5 +232,6 @@ class FragmentSpec:
             partition=d.get("partition", 0),
             dynfilter_keys=tuple(d.get("dynfilter_keys", ())),
             dynfilter_ndv=d.get("dynfilter_ndv", 0),
+            spool=bool(d.get("spool", False)),
             traceparent=d.get("traceparent", ""),
         )

@@ -63,6 +63,21 @@ def is_retryable(exc: BaseException) -> bool:
     )
 
 
+def is_task_recoverable(exc: BaseException) -> bool:
+    """A failure that means the PEER cannot own the task rather than
+    the task itself failing: any connection-level failure, a 404 on a
+    task endpoint (the worker crashed + restarted under the same URI
+    and lost the task), or a 503 (the worker is DRAINING and rejects
+    new tasks). Recoverable by rescheduling on another worker; every
+    other HTTP error is an execution failure that would fail anywhere."""
+    if is_retryable(exc):
+        return True
+    return isinstance(exc, urllib.error.HTTPError) and exc.code in (
+        404,
+        503,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class RpcPolicy:
     """Per-call knobs, config-driven (reference: airlift HttpClient

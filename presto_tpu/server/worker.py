@@ -36,12 +36,19 @@ from presto_tpu.exec.staging import (
 )
 from presto_tpu.exec.stats import TaskStats
 from presto_tpu.plan import nodes as N
-from presto_tpu.server import pages_wire, rpc
+from presto_tpu.server import pages_wire, rpc, task_ids
 from presto_tpu.server.protocol import FragmentSpec
+from presto_tpu.server.spool import ExchangeSpool
 from presto_tpu.utils import faults, tracing
 from presto_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger("presto_tpu.worker")
+
+
+class WorkerDraining(RuntimeError):
+    """New-task rejection while the worker drains (or shuts down):
+    surfaced to the coordinator as HTTP 503, which reschedules the
+    task on another worker instead of failing the query."""
 
 #: rows per exchange page (the reference pages its exchange similarly)
 PAGE_ROWS = 1 << 16
@@ -68,7 +75,10 @@ def _offer_chunked(task: "_Task", cols, n: int) -> None:
 
 
 class _Task:
-    def __init__(self, spec: FragmentSpec, pool=None, node_id: str = ""):
+    def __init__(
+        self, spec: FragmentSpec, pool=None, node_id: str = "",
+        spool: "ExchangeSpool" = None,
+    ):
         self.spec = spec
         self.state = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|ABORTED
         self.error: Optional[str] = None
@@ -92,6 +102,15 @@ class _Task:
             [] for _ in range(nparts)
         ]
         self.part_acked: List[int] = [0] * nparts
+        #: durable-exchange spool (fault-tolerant execution): tee this
+        #: task's PARTITIONED output pages so a consumer can re-serve
+        #: them after this worker dies; committed at FINISH
+        self._spool = spool if spec.spool and nparts > 1 else None
+        self.spooled = False  # committed to the spool
+        #: per-partition "consumer saw X-Complete" flags — the drain
+        #: protocol waits on these (a draining worker must not exit
+        #: under a consumer still pulling)
+        self.complete_served: List[bool] = [False] * nparts
         self.cond = threading.Condition()
         self.created = time.time()
         # buffered output bytes are accounted against the worker's
@@ -159,6 +178,14 @@ class _Task:
                 self.pool.reserve(self.buf_key, len(page))
             self.parts[part].append(page)
             self.stats.output_bytes += len(page)
+        # the spool tee runs OUTSIDE task.cond: disk I/O under the
+        # condition would block the result-serving handler threads
+        # behind every spooled page. Safe because pages are immutable
+        # once buffered, the producer thread is the only appender per
+        # (task, part), and commit (in _run_task's finally) cannot run
+        # until every offer_page call has returned
+        if self._spool is not None:
+            self._spool.append(self.spec.task_id, part, page)
 
     def ack_below(self, token: int, part: int = 0) -> None:
         """Consumer side: pulling token N acks pages < N.
@@ -261,6 +288,14 @@ class WorkerServer:
         )
         if fault_spec:
             faults.configure(fault_spec)
+        # durable-exchange spool (fault-tolerant execution): a shared
+        # directory every node mounts (exchange.spool-path); None when
+        # unconfigured — retry_policy=NONE never touches it
+        self.spool = ExchangeSpool.from_config(config)
+        self._draining = False
+        self._drain_grace_s = float(
+            config.get("drain.grace-s", 30.0) if config else 30.0
+        )
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -302,6 +337,81 @@ class WorkerServer:
             self.httpd.shutdown()
         self.httpd.server_close()
 
+    # ------------------------------------------------------------- drain
+
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Graceful drain (``PUT /v1/state/drain``; SIGTERM in the
+        launcher): stop accepting tasks (503 to new POSTs — the
+        coordinator reschedules them), announce ``DRAINING`` so the
+        coordinator stops scheduling here, keep serving result pulls
+        until every finished task's buffers are consumed or spooled,
+        then exit clean — a rolling restart under live load loses zero
+        queries (reference: the SHUTTING_DOWN protocol, upgraded with
+        the durable-exchange spool)."""
+        with self._lock:
+            if self._draining or self._shutting_down:
+                return
+            self._draining = True
+        REGISTRY.counter("worker.drains").update()
+        log.info("node=%s draining", self.node_id)
+        # flip discovery NOW instead of waiting out the announce cadence
+        self._announce_once()
+        # chaos hook: kill_worker_draining crashes us mid-drain (the
+        # protocol must stay recoverable — consumers fall back to the
+        # spool / task retry)
+        faults.maybe_inject_drain(self.node_id, kill=self._fault_kill)
+        grace = self._drain_grace_s if grace_s is None else grace_s
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and not self._shutting_down:
+            if not self._drain_busy():
+                break
+            time.sleep(0.05)
+        log.info("node=%s drain complete, exiting", self.node_id)
+        self.shutdown(graceful=False)
+
+    def _drain_busy(self) -> bool:
+        """Anything left that exiting now would lose? Running/queued
+        tasks; a FINISHED task whose buffers a consumer is still
+        pulling (unless the spool holds a committed copy). FAILED and
+        ABORTED buffers die with the worker by design."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        for t in tasks:
+            if t.state in ("QUEUED", "RUNNING"):
+                return True
+            if t.state != "FINISHED":
+                continue
+            with t.cond:
+                if t.spooled:
+                    continue  # durable copy outlives this worker
+                if not all(t.complete_served):
+                    return True
+        return False
+
+    def _announce_state(self) -> str:
+        return "DRAINING" if self._draining else "ACTIVE"
+
+    def _announce_once(self) -> None:
+        """One best-effort, no-retry announcement (drain flips state
+        immediately; failures fall back to the regular loop)."""
+        if not self.coordinator_uri:
+            return
+        try:
+            rpc.call_json(
+                "PUT",
+                self.coordinator_uri + "/v1/announcement",
+                {
+                    "node_id": self.node_id,
+                    "uri": self.uri,
+                    "state": self._announce_state(),
+                },
+                policy=rpc.RpcPolicy(
+                    timeout_s=self._announce_timeout, retries=0
+                ),
+            )
+        except Exception:
+            pass
+
     #: announce backoff cap: a worker never goes quieter than this, so
     #: a recovered coordinator re-discovers it within ~2 TTLs
     ANNOUNCE_MAX_BACKOFF_S = 16.0
@@ -336,7 +446,11 @@ class WorkerServer:
                 rpc.call_json(
                     "PUT",
                     self.coordinator_uri + "/v1/announcement",
-                    {"node_id": self.node_id, "uri": self.uri},
+                    {
+                        "node_id": self.node_id,
+                        "uri": self.uri,
+                        "state": self._announce_state(),
+                    },
                     policy=rpc.RpcPolicy(
                         timeout_s=self._announce_timeout, retries=0
                     ),
@@ -372,9 +486,12 @@ class WorkerServer:
     # ---------------------------------------------------------- task exec
 
     def create_task(self, spec: FragmentSpec) -> str:
-        if self._shutting_down:
-            raise RuntimeError("worker is shutting down")
-        task = _Task(spec, pool=self.memory_pool, node_id=self.node_id)
+        if self._draining or self._shutting_down:
+            raise WorkerDraining("worker is draining")
+        task = _Task(
+            spec, pool=self.memory_pool, node_id=self.node_id,
+            spool=self.spool,
+        )
         with self._lock:
             self.tasks[spec.task_id] = task
         threading.Thread(
@@ -423,6 +540,23 @@ class WorkerServer:
                     execute_ms=task.stats.execute_ms,
                     prefetch_ms=task.stats.prefetch_ms,
                 )
+            # seal the spooled attempt BEFORE the terminal state is
+            # visible: FINISHED must imply the durable copy is complete
+            # (consumers that see FINISHED may rely on the spool the
+            # instant this worker dies); failed/aborted partial pages
+            # must never serve
+            if task._spool is not None:
+                try:
+                    if outcome == "FINISHED" and task.state != "ABORTED":
+                        task._spool.commit(task.spec.task_id)
+                        task.spooled = True
+                    else:
+                        task._spool.discard(task.spec.task_id)
+                except Exception:
+                    log.warning(
+                        "node=%s spool seal failed for %s",
+                        self.node_id, task.spec.task_id, exc_info=True,
+                    )
             # publish the terminal state LAST: it flips X-Complete on
             # the result stream, and the coordinator reads the final
             # status (stats + spans above) as soon as it sees it
@@ -644,6 +778,26 @@ class WorkerServer:
                 emit(f.result())
         finish_summary()
 
+    def _spool_partition(self, task: "_Task", logical_key: str):
+        """Recovery read: one committed attempt's pages for this merge
+        task's partition out of the durable spool (None = nothing
+        recoverable). The spool serves raw wire frames; deserialization
+        and stats attribution happen here, mirroring the HTTP pull."""
+        if self.spool is None:
+            return None
+        raw = self.spool.serve(logical_key, task.spec.partition)
+        if raw is None:
+            return None
+        pages = [pages_wire.deserialize_page(b) for b in raw]
+        with task.cond:
+            task.stats.spool_pages_served += len(pages)
+        log.info(
+            "node=%s task=%s re-served %d page(s) of %s[%d] from spool",
+            self.node_id, task.spec.task_id, len(pages), logical_key,
+            task.spec.partition,
+        )
+        return pages
+
     def _load_range(self, scan: N.TableScanNode, lo: int, hi: int):
         conn = self.runner.catalogs.get(scan.handle.catalog)
         split = ConnectorSplit(scan.handle, lo, hi)
@@ -674,6 +828,15 @@ class WorkerServer:
         # build); untagged sources are group 0.
         by_group: Dict[int, list] = {}
         pulled = set()
+        # attempt-id dedup (fault-tolerant execution): every attempt of
+        # one logical upstream task shares a logical key, and exactly
+        # ONE attempt's pages may be consumed — a retried producer and
+        # its zombie original must never both contribute rows
+        pulled_logical = set()
+        #: logical keys whose announced attempt died unreachable with
+        #: no spooled copy — a replacement announcement may still heal
+        #: them; anything left at loop end is a hard loss
+        abandoned: Dict[str, Exception] = {}
         deadline = time.monotonic() + float(
             self.runner.session.get("query_max_run_time_s")
         )
@@ -696,17 +859,47 @@ class WorkerServer:
             for src in pending:
                 uri, src_task = src[0], src[1]
                 group = int(src[2]) if len(src) > 2 else 0
+                lk = task_ids.logical_key(src_task)
+                if lk in pulled_logical:
+                    pulled.add(tuple(src))
+                    continue
                 t_pull = time.perf_counter()
-                got = _pull_partition(
-                    uri, src_task, spec.partition,
-                    self.runner.session, policy=self._rpc_policy,
-                )
+                try:
+                    got = _pull_partition(
+                        uri, src_task, spec.partition,
+                        self.runner.session, policy=self._rpc_policy,
+                    )
+                except Exception as e:
+                    got = (
+                        self._spool_partition(task, lk)
+                        if spec.spool
+                        else None
+                    )
+                    if got is None:
+                        if spec.spool:
+                            # recoverable exchange: the coordinator may
+                            # announce a replacement attempt of this
+                            # logical task — consume that instead
+                            abandoned[lk] = e
+                            pulled.add(tuple(src))
+                            continue
+                        raise
+                abandoned.pop(lk, None)
                 by_group.setdefault(group, []).extend(got)
                 task.stats.staging_ms += (
                     time.perf_counter() - t_pull
                 ) * 1000.0
                 task.stats.input_rows += sum(p[2] for p in got)
                 pulled.add(tuple(src))
+                pulled_logical.add(lk)
+        lost = [lk for lk in abandoned if lk not in pulled_logical]
+        if lost:
+            # every attempt of these upstream tasks is gone and nothing
+            # was spooled/committed: the merge cannot be correct
+            raise RuntimeError(
+                f"merge task lost upstream partition(s) {lost}: "
+                f"{abandoned[lost[0]]}"
+            )
         root = spec.fragment
         remotes = [
             n for n in N.walk(root) if isinstance(n, N.RemoteSourceNode)
@@ -794,9 +987,15 @@ class WorkerServer:
 
     def status(self) -> dict:
         with self._lock:
+            if self._shutting_down:
+                state = "SHUTTING_DOWN"
+            elif self._draining:
+                state = "DRAINING"
+            else:
+                state = "ACTIVE"
             return {
                 "node_id": self.node_id,
-                "state": "SHUTTING_DOWN" if self._shutting_down else "ACTIVE",
+                "state": state,
                 "uri": self.uri,
                 "tasks": {
                     tid: t.state for tid, t in self.tasks.items()
@@ -936,6 +1135,15 @@ def _make_handler(worker: WorkerServer):
                     )
                     n_pages = len(pages)
                     state = t.state
+                    complete = state == "FINISHED" and (
+                        token + (1 if body is not None else 0)
+                        >= n_pages
+                    )
+                    if complete:
+                        # drain protocol: this consumer has seen the
+                        # whole stream — the buffer no longer pins a
+                        # draining worker alive
+                        t.complete_served[part] = True
                 if body is not None:
                     self.send_response(200)
                     self.send_header(
@@ -944,10 +1152,7 @@ def _make_handler(worker: WorkerServer):
                     self.send_header("Content-Length", str(len(body)))
                     self.send_header("X-Next-Token", str(token + 1))
                     self.send_header(
-                        "X-Complete",
-                        "true"
-                        if state == "FINISHED" and token + 1 >= n_pages
-                        else "false",
+                        "X-Complete", "true" if complete else "false"
                     )
                     self.end_headers()
                     self.wfile.write(body)
@@ -957,10 +1162,7 @@ def _make_handler(worker: WorkerServer):
                 self.send_header("Content-Length", "0")
                 self.send_header("X-Next-Token", str(token))
                 self.send_header(
-                    "X-Complete",
-                    "true"
-                    if state == "FINISHED" and token >= n_pages
-                    else "false",
+                    "X-Complete", "true" if complete else "false"
                 )
                 self.end_headers()
                 return
@@ -969,6 +1171,13 @@ def _make_handler(worker: WorkerServer):
         def do_POST(self):
             parts = [p for p in self.path.split("/") if p]
             if parts == ["v1", "task"]:
+                if worker._draining or worker._shutting_down:
+                    # reject BEFORE parsing: 503 tells the coordinator
+                    # to reschedule on another worker (no task was
+                    # created here)
+                    return self._json(
+                        503, {"error": "worker is draining"}
+                    )
                 try:
                     spec = FragmentSpec.from_json(
                         json.loads(self._read_body().decode())
@@ -982,6 +1191,8 @@ def _make_handler(worker: WorkerServer):
                         spec = _dc.replace(spec, traceparent=hdr)
                     tid = worker.create_task(spec)
                     return self._json(200, {"task_id": tid})
+                except WorkerDraining as e:
+                    return self._json(503, {"error": str(e)})
                 except Exception as e:
                     return self._json(400, {"error": str(e)})
             self._json(404, {"error": f"no route {self.path}"})
@@ -1004,6 +1215,13 @@ def _make_handler(worker: WorkerServer):
                     target=worker.shutdown, daemon=True
                 ).start()
                 return self._json(200, {"ok": True})
+            if parts == ["v1", "state", "drain"]:
+                # graceful drain: stop accepting, finish + serve/spool
+                # running outputs, announce DRAINING, exit clean
+                threading.Thread(
+                    target=worker.drain, daemon=True
+                ).start()
+                return self._json(200, {"ok": True, "state": "DRAINING"})
             if (
                 len(parts) == 4
                 and parts[:2] == ["v1", "task"]

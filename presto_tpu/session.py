@@ -59,6 +59,13 @@ def _non_negative(name):
     return check
 
 
+def _retry_policy_value(v):
+    if str(v).upper() not in ("NONE", "TASK", "QUERY"):
+        raise ValueError(
+            f"retry_policy must be NONE | TASK | QUERY, got {v!r}"
+        )
+
+
 #: Engine-wide session properties (reference: SystemSessionProperties).
 SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
     p.name: p
@@ -273,6 +280,27 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             2.0,
             _positive("speculation_min_s"),
         ),
+        PropertyMetadata(
+            "retry_policy",
+            "Fault-tolerant execution mode (reference: Trino Project "
+            "Tardigrade's retry-policy). NONE = bit-for-bit legacy "
+            "behavior; TASK = spool exchange pages (exchange.spool-path) "
+            "and recover a dead worker mid-stage by rescheduling only "
+            "the lost tasks, re-serving upstream inputs from the spool; "
+            "QUERY = additionally allow a bounded full query restart as "
+            "the last resort",
+            str,
+            "NONE",
+            _retry_policy_value,
+        ),
+        PropertyMetadata(
+            "query_retry_count",
+            "Bounded full-query restarts under retry_policy=QUERY "
+            "(0 disables query-level restart)",
+            int,
+            1,
+            _non_negative("query_retry_count"),
+        ),
     ]
 }
 
@@ -360,6 +388,19 @@ class NodeConfig:
         # NDV cap for IN-list summaries (exec/dynfilter.py)
         "dynamic-filtering.wait-ms": float,
         "dynamic-filtering.ndv-limit": int,
+        # durable-exchange spool (server.spool): shared directory the
+        # workers tee partitioned exchange pages into under
+        # retry_policy=TASK/QUERY, its byte budget, and the TTL after
+        # which committed attempts are garbage-collected
+        "exchange.spool-path": str,
+        "exchange.spool-bytes": str,
+        "exchange.spool-ttl-s": float,
+        # seeds the session retry_policy default (NONE | TASK | QUERY)
+        "retry-policy": str,
+        # worker drain: how long a draining worker waits for running
+        # tasks to finish and buffered output to be pulled/spooled
+        # before exiting
+        "drain.grace-s": float,
         # deterministic chaos: JSON FaultPlane spec (utils.faults)
         "fault-injection.spec": str,
     }

@@ -24,7 +24,9 @@ Rule spec (all match fields optional; empty matches everything)::
        {"action": "delay",  "url": "/results/", "delay_s": 2.0},
        {"action": "drop",   "url": "/v1/task", "skip": 2, "count": 1},
        {"action": "kill_task",   "node": "worker-ab"},
-       {"action": "kill_worker", "task": "q_c1."},
+       {"action": "kill_worker", "task": "q_c1_"},
+       {"action": "spool_corrupt", "task": ".prod."},
+       {"action": "kill_worker_draining", "node": "worker-ab"},
      ]}
 
 ``count`` bounds how many times a rule fires (default unlimited),
@@ -50,6 +52,13 @@ from presto_tpu.utils.metrics import REGISTRY
 RPC_ACTIONS = ("delay", "error", "drop")
 #: actions injected at the worker task-execute hook
 TASK_ACTIONS = ("delay", "kill_task", "kill_worker")
+#: actions injected at the exchange-spool read hook (server.spool):
+#: flips a spooled payload byte so the checksum framing must catch it
+SPOOL_ACTIONS = ("spool_corrupt",)
+#: actions injected at the worker drain hook (server.worker.drain):
+#: crashes a worker WHILE it is draining — the drain protocol must
+#: stay recoverable mid-handshake
+DRAIN_ACTIONS = ("kill_worker_draining",)
 
 
 class FaultInjectedError(ConnectionError):
@@ -79,7 +88,13 @@ class FaultRule:
         if unknown:
             raise ValueError(f"unknown fault-rule keys: {sorted(unknown)}")
         rule = FaultRule(**d)
-        if rule.action not in set(RPC_ACTIONS) | set(TASK_ACTIONS):
+        known_actions = (
+            set(RPC_ACTIONS)
+            | set(TASK_ACTIONS)
+            | set(SPOOL_ACTIONS)
+            | set(DRAIN_ACTIONS)
+        )
+        if rule.action not in known_actions:
             raise ValueError(f"unknown fault action: {rule.action!r}")
         return rule
 
@@ -177,6 +192,37 @@ class FaultPlane:
                     f"injected worker kill: {node_id} (task {task_id})"
                 )
 
+    def on_spool(self, task_id: str) -> bool:
+        """Spool-read hook: True when a ``spool_corrupt`` rule fires —
+        the reader flips a payload byte BEFORE checksum verification,
+        so the corruption-detection path itself is what gets tested."""
+        for rule in self.rules:
+            if rule.action not in SPOOL_ACTIONS:
+                continue
+            if rule.task and rule.task not in task_id:
+                continue
+            if self._fire(rule):
+                return True
+        return False
+
+    def on_drain(self, node_id: str, kill=None) -> None:
+        """Worker drain hook: a ``kill_worker_draining`` rule crashes
+        the worker mid-drain (abrupt socket close via ``kill``, then
+        raises) — rolling restarts must survive a node dying during
+        its own drain handshake."""
+        for rule in self.rules:
+            if rule.action not in DRAIN_ACTIONS:
+                continue
+            if rule.node and rule.node not in node_id:
+                continue
+            if not self._fire(rule):
+                continue
+            if kill is not None:
+                kill()
+            raise FaultInjectedError(
+                f"injected kill while draining: {node_id}"
+            )
+
 
 #: the active plane; None = disabled (the default, and the hot path)
 _PLANE: Optional[FaultPlane] = None
@@ -204,6 +250,17 @@ def maybe_inject_task(node_id: str, task_id: str, kill=None) -> None:
     plane = _PLANE
     if plane is not None:
         plane.on_task(node_id, task_id, kill=kill)
+
+
+def maybe_inject_spool(task_id: str) -> bool:
+    plane = _PLANE
+    return plane is not None and plane.on_spool(task_id)
+
+
+def maybe_inject_drain(node_id: str, kill=None) -> None:
+    plane = _PLANE
+    if plane is not None:
+        plane.on_drain(node_id, kill=kill)
 
 
 _env_spec = os.environ.get("PRESTO_TPU_FAULTS")

@@ -1,0 +1,37 @@
+"""bench.py failure lines (BENCH_r05 regression): a config that could
+not be measured — backend-init failure included — must emit a
+``"skipped": true`` line with NO value, never ``value: 0`` (a zero
+reads as a measured 0 rows/s and poisons the metric trajectory)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import bench  # noqa: E402
+
+
+def test_skip_line_has_no_value():
+    line = bench.skip_line(
+        "tpch_q1_sf1_rows_per_sec",
+        RuntimeError("Unable to initialize backend 'axon'"),
+    )
+    assert line["skipped"] is True
+    assert "value" not in line
+    assert line["metric"] == "tpch_q1_sf1_rows_per_sec"
+    assert "Unable to initialize backend" in line["error"]
+    json.dumps(line)  # driver contract: one JSON-able line
+
+
+def test_skip_line_truncates_long_errors():
+    line = bench.skip_line("m", RuntimeError("x" * 1000))
+    assert len(line["error"]) <= 300
+
+
+def test_bench_source_never_emits_zero_value_error_lines():
+    """Every failure path in the driver must route through skip_line:
+    no hand-built '"value": 0 + error' dict may reappear."""
+    src = open(bench.__file__, encoding="utf-8").read()
+    assert '"value": 0' not in src
+    assert src.count("skip_line(") >= 3  # def + both failure paths

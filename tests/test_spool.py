@@ -1,0 +1,717 @@
+"""Spooled exchange + stage-level recovery + worker drain (the
+fault-tolerant execution mode).
+
+Reference parity: Presto/Trino fault-tolerant execution ("Project
+Tardigrade") — exchange data spooled to shared storage so recovery
+restarts only LOST tasks, with upstream stages re-served from the
+spool; plus the graceful-drain half of rolling restarts (a draining
+worker stops accepting work, announces itself, finishes + serves its
+buffers, and exits without failing a single query).
+
+Chaos tests assert via per-stage ATTEMPT counters (deterministic
+task-attempt ids, server.task_ids) that killing a worker mid
+multi-stage TPC-H join re-runs only the lost stage's tasks — upstream
+producer attempts stay at one — and that draining a worker mid-query
+loses zero queries.
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from presto_tpu.server import CoordinatorServer, PrestoTpuClient, WorkerServer
+from presto_tpu.server import rpc, task_ids
+from presto_tpu.server.spool import ExchangeSpool
+from presto_tpu.session import NodeConfig, Session
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+#: multi-stage TPC-H join: scan+join+partial-agg producer stage that
+#: hash-partitions into per-worker buffers, merge stage running the
+#: FINAL agg on workers (the shuffle path both chaos tests target)
+JOIN_SQL = (
+    "select o_orderpriority, count(*) as n "
+    "from tpch.tiny.orders, tpch.tiny.lineitem "
+    "where o_orderkey = l_orderkey "
+    "group by o_orderpriority order by o_orderpriority"
+)
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    yield
+    faults.configure(None)
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+def _mk_cluster(tmp_path, n=2, policy="TASK", extra=None):
+    cfg = {
+        "exchange.spool-path": str(tmp_path / "spool"),
+        "exchange.spool-bytes": "64MB",
+    }
+    cfg.update(extra or {})
+    coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
+    coord.local.session.set("retry_policy", policy)
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri, config=NodeConfig(dict(cfg))
+        ).start()
+        for _ in range(n)
+    ]
+    _wait_workers(coord, n)
+    return coord, workers
+
+
+def _teardown(coord, workers):
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def _expected_rows(coord, sql):
+    """Oracle for the chaos runs: the coordinator's local engine on the
+    same catalogs (computed healthy, before any chaos)."""
+    return [tuple(r) for r in coord.local.execute(sql).rows()]
+
+
+def _attempts_by_logical(stage: dict):
+    by = {}
+    for t in stage["tasks"]:
+        by.setdefault(task_ids.logical_key(t["task_id"]), []).append(t)
+    return by
+
+
+# ------------------------------------------------- task-attempt ids
+
+
+def test_task_id_mint_parse_roundtrip():
+    tid = task_ids.mint("q_c7", task_ids.PRODUCER, 3)
+    assert tid == "q_c7.prod.3.a0"
+    t = task_ids.parse(tid)
+    assert (t.query_id, t.kind, t.seq, t.attempt) == ("q_c7", "prod", 3, 0)
+    assert str(t) == tid
+    assert task_ids.logical_key(tid) == "q_c7.prod.3"
+    nxt = task_ids.next_attempt(tid)
+    assert nxt == "q_c7.prod.3.a1"
+    assert task_ids.logical_key(nxt) == task_ids.logical_key(tid)
+    assert task_ids.attempt_of(nxt) == 1
+
+
+def test_task_id_legacy_ids_are_their_own_key():
+    # hand-written test specs ("t") never gain phantom attempt structure
+    assert task_ids.try_parse("t") is None
+    assert task_ids.logical_key("t") == "t"
+    assert task_ids.attempt_of("t") == 0
+    with pytest.raises(ValueError):
+        task_ids.next_attempt("t")
+    with pytest.raises(ValueError):
+        task_ids.mint("q.1", "t", 0)  # dotted query id would break parse
+
+
+def test_query_ids_unique_across_coordinator_restarts():
+    """A restarted coordinator must never re-mint a previous
+    incarnation's attempt ids — the shared spool would serve the dead
+    run's pages inside the TTL window (review finding)."""
+    a = CoordinatorServer()
+    b = CoordinatorServer()
+    try:
+        qa = a.submit("select 1")
+        qb = b.submit("select 1")
+        qa.done.wait(30)
+        qb.done.wait(30)
+        assert qa.qid != qb.qid
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+# ------------------------------------------------- spool unit tests
+
+
+def test_spool_roundtrip_and_attempt_dedup(tmp_path):
+    sp = ExchangeSpool(str(tmp_path))
+    a0 = "q_c1.prod.0.a0"
+    sp.append(a0, 0, b"page-zero")
+    sp.append(a0, 0, b"page-one")
+    sp.append(a0, 1, b"other-part")
+    # uncommitted attempts never serve (a crash mid-spool must not
+    # expose partial output)
+    assert sp.serve("q_c1.prod.0", 0) is None
+    sp.commit(a0)
+    assert sp.serve("q_c1.prod.0", 0) == [b"page-zero", b"page-one"]
+    assert sp.serve("q_c1.prod.0", 1) == [b"other-part"]
+    # committed attempt, empty partition: recoverable as zero pages
+    assert sp.serve("q_c1.prod.0", 2) == []
+    # a second committed attempt does not double-serve: exactly one
+    # attempt's pages per call, lowest attempt wins deterministically
+    a1 = "q_c1.prod.0.a1"
+    sp.append(a1, 0, b"dup-zero")
+    sp.commit(a1)
+    assert sp.serve("q_c1.prod.0", 0) == [b"page-zero", b"page-one"]
+    # discard drops an attempt entirely
+    sp.discard(a0)
+    assert sp.serve("q_c1.prod.0", 0) == [b"dup-zero"]
+
+
+def test_spool_checksum_detects_on_disk_corruption(tmp_path):
+    sp = ExchangeSpool(str(tmp_path))
+    tid = "q_c1.prod.1.a0"
+    sp.append(tid, 0, b"x" * 100)
+    sp.commit(tid)
+    fn = tmp_path / f"{tid}.0.pages"
+    raw = bytearray(fn.read_bytes())
+    raw[20] ^= 0xFF  # flip a payload byte
+    fn.write_bytes(bytes(raw))
+    before = REGISTRY.counter("spool.corrupt").total
+    assert sp.serve("q_c1.prod.1", 0) is None
+    assert REGISTRY.counter("spool.corrupt").total == before + 1
+
+
+def test_spool_corrupt_fault_rule_falls_back_to_next_attempt(tmp_path):
+    sp = ExchangeSpool(str(tmp_path))
+    for a, payload in (("a0", b"first"), ("a1", b"second")):
+        tid = f"q_c1.prod.2.{a}"
+        sp.append(tid, 0, payload)
+        sp.commit(tid)
+    faults.configure(
+        {"rules": [{"action": "spool_corrupt", "task": ".a0", "count": 1}]}
+    )
+    before = REGISTRY.counter("spool.corrupt").total
+    # a0 reads corrupt (injected), recovery falls to the a1 attempt
+    assert sp.serve("q_c1.prod.2", 0) == [b"second"]
+    assert REGISTRY.counter("spool.corrupt").total == before + 1
+
+
+def test_spool_ttl_and_budget_gc(tmp_path):
+    sp = ExchangeSpool(str(tmp_path), budget_bytes=64, ttl_s=0.2)
+    sp.append("q_c1.prod.3.a0", 0, b"y" * 40)
+    sp.commit("q_c1.prod.3.a0")
+    time.sleep(0.25)
+    sp.gc(force=True)
+    assert os.listdir(str(tmp_path)) == []  # TTL expired the attempt
+    # byte budget: oldest committed attempt evicted when over budget
+    sp2 = ExchangeSpool(str(tmp_path), budget_bytes=64, ttl_s=600.0)
+    sp2.append("q_c1.prod.4.a0", 0, b"a" * 48)
+    sp2.commit("q_c1.prod.4.a0")
+    time.sleep(0.02)
+    sp2.append("q_c1.prod.5.a0", 0, b"b" * 48)
+    sp2.commit("q_c1.prod.5.a0")
+    sp2.gc(force=True)
+    assert sp2.serve("q_c1.prod.4", 0) is None  # evicted (oldest)
+    assert sp2.serve("q_c1.prod.5", 0) == [b"b" * 48]
+    st = sp2.stats()
+    assert st["entries"] == 1 and st["budget_bytes"] == 64
+
+
+def test_retry_policy_session_validation():
+    s = Session()
+    assert s.get("retry_policy") == "NONE"
+    s.set("retry_policy", "task")  # case-insensitive
+    with pytest.raises(ValueError):
+        s.set("retry_policy", "SOMETIMES")
+
+
+# ------------------------------------------------- chaos: recovery
+
+
+def test_retry_policy_none_never_touches_spool(tmp_path):
+    """NONE is bit-for-bit legacy: spool configured but cold — no spec
+    carries the flag, no file is written, no recovery stat moves."""
+    coord, ws = _mk_cluster(tmp_path, policy="NONE")
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        res = client.execute(JOIN_SQL)
+        assert [tuple(r) for r in res.rows()] == _expected_rows(
+            coord, JOIN_SQL
+        )
+        assert os.listdir(str(tmp_path / "spool")) == []
+        info = client.query_info(res.query_id)
+        assert info["retry_policy"] == "NONE"
+        assert info["task_recoveries"] == 0
+        assert info["spool_pages_served"] == 0
+    finally:
+        _teardown(coord, ws)
+
+
+def _seal_observed(workers):
+    """The coordinator's source-seal broadcast reached a merge task:
+    every producer range completed FROM THE COORDINATOR'S PERSPECTIVE,
+    so no producer can legitimately be re-attempted past this point —
+    the exact boundary the 'upstream not re-run' assertion needs."""
+    for w in workers:
+        with w._lock:
+            tasks = list(w.tasks.values())
+        for t in tasks:
+            if t.spec.partition_scan < 0 and t.sources_done:
+                return True
+    return False
+
+
+def test_chaos_kill_worker_mid_join_recovers_from_spool(tmp_path):
+    """THE acceptance chaos test: kill a worker mid multi-stage TPC-H
+    join under retry_policy=TASK, after the producer (upstream) stage
+    finished. The query completes, re-running ONLY the dead worker's
+    merge task — asserted via per-stage attempt counters: every
+    producer logical task keeps exactly one attempt, while the lost
+    merge partition gains an a1 attempt whose upstream inputs are
+    re-served from the durable spool."""
+    coord, ws = _mk_cluster(tmp_path, policy="TASK")
+    try:
+        expected = _expected_rows(coord, JOIN_SQL)
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        # hold the merge stage's start back so the kill (armed on the
+        # coordinator's seal broadcast) always lands BEFORE the merge
+        # gather completes
+        faults.configure(
+            {
+                "seed": 2,
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.05},
+                    {"action": "delay", "task": ".merge.", "delay_s": 0.8},
+                ],
+            }
+        )
+        out, errs = {}, []
+
+        def run():
+            try:
+                out["res"] = client.execute(JOIN_SQL)
+            except Exception as e:  # surfaced by the assert below
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not _seal_observed(ws):
+            time.sleep(0.002)
+        assert _seal_observed(ws), "producer stage never sealed"
+        served_before = REGISTRY.counter("spool.pages_served").total
+        victim = ws[0]
+        victim._fault_kill()  # abrupt crash: dead sockets, no drain
+        t.join(120)
+        assert not errs, f"query failed despite TASK recovery: {errs}"
+        assert [tuple(r) for r in out["res"].rows()] == expected
+
+        info = client.query_info(out["res"].query_id)
+        stages = {st["stage_id"]: st for st in info["stages"]}
+        prod = next(
+            st for st in stages.values() if st["kind"] == "producer"
+        )
+        merge = next(
+            st for st in stages.values() if st["kind"] == "merge"
+        )
+        # upstream stage NOT re-run: one attempt per producer logical
+        for lk, attempts in _attempts_by_logical(prod).items():
+            assert len(attempts) == 1, (
+                f"upstream producer {lk} was re-run: "
+                f"{[a['task_id'] for a in attempts]}"
+            )
+        # the lost merge partition WAS re-run (a0 lost, a1 recovered)
+        merge_attempts = _attempts_by_logical(merge)
+        recovered = [a for a in merge_attempts.values() if len(a) > 1]
+        assert recovered, f"no merge recovery recorded: {merge_attempts}"
+        # and the replacement re-served the dead worker's partitions
+        # from the spool instead of re-running the upstream stage
+        assert info["spool_pages_served"] > 0
+        assert (
+            REGISTRY.counter("spool.pages_served").total > served_before
+        )
+        assert info["task_recoveries"] >= 1
+        assert info["retry_policy"] == "TASK"
+    finally:
+        _teardown(coord, ws)
+
+
+def test_chaos_kill_worker_mid_producer_stage_no_double_count(tmp_path):
+    """Kill a worker while the upstream stage is still RUNNING: lost
+    producer ranges re-run as a1 attempts of the SAME logical tasks,
+    and attempt-id dedup guarantees merge consumers fold exactly one
+    attempt per logical task — the result is exact, never doubled."""
+    coord, ws = _mk_cluster(tmp_path, policy="TASK")
+    try:
+        expected = _expected_rows(coord, JOIN_SQL)
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        faults.configure(
+            {
+                "seed": 3,
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.2}
+                ],
+            }
+        )
+        out, errs = {}, []
+
+        def run():
+            try:
+                out["res"] = client.execute(JOIN_SQL)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        victim = ws[0]
+
+        def victim_committed():
+            with victim._lock:
+                tasks = list(victim.tasks.values())
+            return any(
+                x.state == "FINISHED" and len(x.parts) > 1 and x.spooled
+                for x in tasks
+            )
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not victim_committed():
+            time.sleep(0.002)
+        assert victim_committed(), "victim never committed a producer"
+        victim._fault_kill()
+        t.join(120)
+        assert not errs, f"query failed despite TASK recovery: {errs}"
+        # double-counting is the failure mode this guards: a retried
+        # producer racing its announced original must contribute once
+        assert [tuple(r) for r in out["res"].rows()] == expected
+        info = client.query_info(out["res"].query_id)
+        assert info["task_recoveries"] >= 1
+    finally:
+        _teardown(coord, ws)
+
+
+def test_query_retry_policy_full_restart(tmp_path):
+    """retry_policy=QUERY, task retry disabled: losing a worker fails
+    the attempt, and the bounded full-query restart completes it on
+    the surviving cluster (the last-resort path)."""
+    coord, ws = _mk_cluster(
+        tmp_path,
+        policy="QUERY",
+        extra={"failure-detector.threshold": "1"},
+    )
+    try:
+        coord.local.session.set("task_retry_budget", 0)
+        faults.configure(
+            {
+                "rules": [
+                    {
+                        "action": "kill_worker",
+                        "node": ws[1].node_id,
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        before = REGISTRY.counter("coordinator.query_restarts").total
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        res = client.execute(
+            "select count(*) as c from tpch.tiny.lineitem"
+        )
+        assert [tuple(r) for r in res.rows()] == [(59997,)]
+        assert (
+            REGISTRY.counter("coordinator.query_restarts").total > before
+        )
+        info = client.query_info(res.query_id)
+        assert info["query_restarts"] >= 1
+        assert info["retry_policy"] == "QUERY"
+    finally:
+        coord.local.session.reset("task_retry_budget")
+        _teardown(coord, ws)
+
+
+# ------------------------------------------------- drain protocol
+
+
+def test_drain_under_load_zero_query_failures(tmp_path):
+    """Rolling-restart half of the acceptance test: drain a worker mid
+    multi-stage query — the query (and followers) complete with ZERO
+    failures, the coordinator stops scheduling to the draining worker,
+    and the worker exits clean once its buffers are consumed."""
+    coord, ws = _mk_cluster(tmp_path, policy="TASK")
+    try:
+        expected = _expected_rows(coord, JOIN_SQL)
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        faults.configure(
+            {
+                "seed": 5,
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.1}
+                ],
+            }
+        )
+        results, errs = [], []
+
+        def run():
+            try:
+                results.append(client.execute(JOIN_SQL).rows())
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # drain mid-query, over the real endpoint
+        time.sleep(0.15)
+        rpc.call_json("PUT", ws[0].uri + "/v1/state/drain")
+        t.join(120)
+        assert not errs, f"drain lost a query: {errs}"
+        assert [tuple(r) for r in results[0]] == expected
+        faults.configure(None)
+        # discovery: the drained worker left scheduling...
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ids = {w.node_id for w in coord.active_workers()}
+            if ws[0].node_id not in ids:
+                break
+            time.sleep(0.05)
+        assert ws[0].node_id not in {
+            w.node_id for w in coord.active_workers()
+        }
+        # ...and exits clean once consumers are done
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not ws[0]._shutting_down:
+            time.sleep(0.05)
+        assert ws[0]._shutting_down, "drained worker did not exit"
+        # the cluster keeps serving on the survivor, zero loss
+        res = client.execute("select count(*) as c from tpch.tiny.orders")
+        assert [tuple(r) for r in res.rows()] == [(15000,)]
+    finally:
+        _teardown(coord, ws)
+
+
+def test_drain_reroute_is_free_even_with_zero_retry_budget(tmp_path):
+    """A drain rejection re-routes without charging task_retry_budget
+    or the circuit breaker (the task was never created): draining must
+    keep its zero-failure promise even with retry disabled (review
+    finding)."""
+    coord, ws = _mk_cluster(tmp_path, policy="NONE")
+    try:
+        coord.local.session.set("task_retry_budget", 0)
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        # drain first, THEN query: every range the drained worker's
+        # thread claims is rejected with 503 and must re-route free
+        ws[0]._draining = True  # flag only: the server stays up
+        res = client.execute(
+            "select count(*) as c from tpch.tiny.lineitem"
+        )
+        assert [tuple(r) for r in res.rows()] == [(59997,)]
+        assert coord.breakers.get(ws[0].node_id) is None or (
+            coord.breakers[ws[0].node_id].peek() == "CLOSED"
+        ), "drain rejection penalized the breaker"
+        info = client.query_info(res.query_id)
+        assert info["task_recoveries"] == 0
+    finally:
+        coord.local.session.reset("task_retry_budget")
+        _teardown(coord, ws)
+
+
+def test_launcher_main_exits_after_http_drain(tmp_path, monkeypatch):
+    """A launcher-run worker drained over HTTP must end main() — a
+    rolling restart waits on process exit (review finding)."""
+    from presto_tpu.server import launcher
+
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "coordinator=false\n"
+        "discovery.uri=http://127.0.0.1:9\n"  # coordinator not needed
+        "drain.grace-s=5\n"
+    )
+    (etc / "catalog" / "tpch.properties").write_text(
+        "connector.name=tpch\n"
+    )
+    captured = {}
+    orig_launch = launcher.launch
+
+    def spy(etc_dir):
+        captured["server"] = orig_launch(etc_dir)
+        return captured["server"]
+
+    monkeypatch.setattr(launcher, "launch", spy)
+    done = threading.Event()
+
+    def run_main():
+        try:
+            launcher.main(["--etc-dir", str(etc)])
+        finally:
+            done.set()
+
+    threading.Thread(target=run_main, daemon=True).start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and "server" not in captured:
+        time.sleep(0.05)
+    srv = captured["server"]
+    rpc.call_json("PUT", srv.uri + "/v1/state/drain")
+    assert done.wait(20), "main() kept sleeping after the drain"
+
+
+def test_draining_worker_rejects_new_tasks_with_503(tmp_path):
+    coord, ws = _mk_cluster(tmp_path, n=1, policy="NONE")
+    try:
+        w = ws[0]
+        w._draining = True  # flag only: keep the server up to probe it
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            rpc.call_json("POST", w.uri + "/v1/task", {"x": 1})
+        assert ei.value.code == 503
+        assert rpc.is_task_recoverable(ei.value)
+        # status reports the drain state to pollers
+        st = rpc.call_json("GET", w.uri + "/v1/status")
+        assert st["state"] == "DRAINING"
+    finally:
+        _teardown(coord, ws)
+
+
+def test_chaos_kill_worker_while_draining(tmp_path):
+    """The drain protocol must stay recoverable mid-handshake: a
+    kill_worker_draining rule crashes the worker the moment it starts
+    draining, and TASK-level recovery still completes the query."""
+    coord, ws = _mk_cluster(tmp_path, policy="TASK")
+    try:
+        expected = _expected_rows(coord, JOIN_SQL)
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        faults.configure(
+            {
+                "seed": 7,
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.1},
+                    {
+                        "action": "kill_worker_draining",
+                        "node": ws[0].node_id,
+                    },
+                ],
+            }
+        )
+        out, errs = {}, []
+
+        def run():
+            try:
+                out["res"] = client.execute(JOIN_SQL)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.15)
+        try:
+            rpc.call_json("PUT", ws[0].uri + "/v1/state/drain")
+        except Exception:
+            pass  # the injected crash may race the response
+        t.join(120)
+        assert not errs, f"query failed despite TASK recovery: {errs}"
+        assert [tuple(r) for r in out["res"].rows()] == expected
+    finally:
+        _teardown(coord, ws)
+
+
+def test_launcher_signal_handlers_drain():
+    """SIGTERM/SIGINT install a drain-first handler (satellite: Ctrl-C
+    during tests used to leave workers undrained)."""
+    from presto_tpu.server import launcher
+
+    class FakeServer:
+        drained = False
+
+        def drain(self):
+            self.drained = True
+
+    srv = FakeServer()
+    exits = []
+    saved = {
+        s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)
+    }
+    try:
+        handler = launcher.install_signal_handlers(srv, exit=exits.append)
+        assert signal.getsignal(signal.SIGTERM) is handler
+        assert signal.getsignal(signal.SIGINT) is handler
+        handler(signal.SIGTERM, None)
+        assert srv.drained
+        assert exits == [0]
+    finally:
+        for s, h in saved.items():
+            signal.signal(s, h)
+
+
+# ------------------------------------ observability + config surface
+
+
+def test_spool_occupancy_in_runtime_caches_and_explain(tmp_path):
+    coord, ws = _mk_cluster(tmp_path, policy="TASK")
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        client.execute(JOIN_SQL)
+        rows = client.execute(
+            "select cache, entries, bytes, budget_bytes "
+            "from system.runtime.caches order by cache"
+        ).rows()
+        spool_rows = [r for r in rows if r[0] == "exchange.spool"]
+        assert spool_rows, rows
+        assert spool_rows[0][1] > 0  # committed attempts present
+        assert spool_rows[0][2] > 0  # occupancy bytes
+        assert spool_rows[0][3] == 64 << 20
+        # the EXPLAIN ANALYZE recovery line renders under TASK policy
+        text = "\n".join(
+            r[0]
+            for r in client.execute(
+                "explain analyze " + JOIN_SQL
+            ).rows()
+        )
+        assert "fault tolerance: retry_policy=TASK" in text
+        assert "task_recoveries" in text
+    finally:
+        _teardown(coord, ws)
+
+
+def test_launcher_boots_spool_and_drain_config(tmp_path):
+    from presto_tpu.server.launcher import load_etc
+
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "coordinator=true\n"
+        f"exchange.spool-path={tmp_path}/sp\n"
+        "exchange.spool-bytes=1MB\n"
+        "exchange.spool-ttl-s=60\n"
+        "retry-policy=TASK\n"
+        "drain.grace-s=5\n"
+    )
+    (etc / "catalog" / "tpch.properties").write_text(
+        "connector.name=tpch\n"
+    )
+    config, _catalogs = load_etc(str(etc))
+    assert config.get("retry-policy") == "TASK"
+    assert config.get("drain.grace-s") == 5.0
+    sp = ExchangeSpool.from_config(config)
+    assert sp is not None and sp.budget_bytes == 1 << 20
+    assert sp.ttl_s == 60.0
+
+
+# --------------------------------------------------------- lint
+
+
+def test_attempt_id_sites_lint_clean():
+    import check_attempt_ids
+
+    assert check_attempt_ids.main([]) == 0
+
+
+def test_attempt_id_lint_flags_adhoc_sites(tmp_path):
+    import check_attempt_ids
+
+    (tmp_path / "bad.py").write_text(
+        'task_id = f"{qid}.{uuid.uuid4().hex[:8]}"\n'
+        'stage = task_id.split(".")[1]\n'
+    )
+    assert check_attempt_ids.main([str(tmp_path)]) == 1
