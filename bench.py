@@ -155,6 +155,85 @@ def _bench_query(
     return driving_rows / best, best, WARMUP + 1 + len(times)
 
 
+def _serving_line(runner, backend: str) -> dict:
+    """Serving-latency line (the plan-cache headline measurement): N
+    concurrent clients replay ONE query shape with varying literals
+    through PREPARE/EXECUTE, so every request after the first is a
+    plan-cache + compile-cache hit. Reports end-to-end p50/p99 latency
+    and queries/sec, plus cold (first execution: plan + XLA compile +
+    run) vs warm, and the plan.cache_hit count for the run — the
+    speedup is the compile amortization, honestly tagged with the
+    backend that measured it."""
+    import threading
+
+    from presto_tpu.utils.metrics import REGISTRY
+
+    # 4 clients: enough to overlap requests, few enough that a 1-CPU
+    # fallback host measures query latency rather than queue depth
+    clients, per_client = 4, 12
+    # the serving workload is POINT lookups (ROADMAP item 2), not
+    # analytic scans: a selective single-row probe whose per-query
+    # device work is small enough that the plan+compile amortization
+    # is what the line actually measures
+    runner.execute(
+        "prepare bench_serve from select c_name, c_acctbal, "
+        "c_mktsegment from tpch.sf1.customer where c_custkey = ?"
+    )
+    hits0 = int(REGISTRY.counter("plan.cache_hit").total)
+    t0 = time.perf_counter()
+    runner.execute("execute bench_serve using 7")
+    cold_s = time.perf_counter() - t0
+
+    lat: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def one_client(ci: int) -> None:
+        for i in range(per_client):
+            v = 1 + 4 * (ci * per_client + i)  # fresh literals
+            t = time.perf_counter()
+            try:
+                runner.execute(f"execute bench_serve using {v}")
+            except Exception as e:  # pragma: no cover - report, don't hang
+                with lock:
+                    errors.append(e)
+                return
+            dt = time.perf_counter() - t
+            with lock:
+                lat.append(dt)
+
+    threads = [
+        threading.Thread(target=one_client, args=(ci,))
+        for ci in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    return {
+        "metric": "serving_point_lookup_sf1_qps",
+        "value": round(len(lat) / wall, 2),
+        "unit": "queries/s",
+        "clients": clients,
+        "queries": len(lat),
+        "p50_ms": round(p50 * 1000.0, 2),
+        "p99_ms": round(p99 * 1000.0, 2),
+        "cold_ms": round(cold_s * 1000.0, 1),
+        "warm_speedup_cold_over_p50": round(cold_s / max(p50, 1e-9), 1),
+        "plan_cache_hits": int(
+            REGISTRY.counter("plan.cache_hit").total
+        ) - hits0,
+        "backend": backend,
+    }
+
+
 def _ensure_backend() -> str:
     """Backend-fallback probe (BENCH_r05 fix): the axon TPU plugin can
     be installed but unreachable ("Unable to initialize backend
@@ -245,6 +324,18 @@ def main() -> None:
             ),
             flush=True,
         )
+        # serving plane: concurrent literal-variant EXECUTEs over one
+        # prepared shape — the plan-cache p50/p99/QPS line (a failed
+        # serving measurement must not poison the Q1 line above)
+        try:
+            print(json.dumps(_serving_line(runner, backend)), flush=True)
+        except Exception as e:
+            print(
+                json.dumps(
+                    skip_line("serving_point_lookup_sf1_qps", e, "queries/s")
+                ),
+                flush=True,
+            )
     if not run_all:
         return
 

@@ -146,6 +146,9 @@ class _HiveMetadata(ConnectorMetadata):
 class HiveConnector(Connector):
     """Catalog over hive-layout directories of parquet files."""
 
+    def prunes_splits(self) -> bool:
+        return True  # partition-key constraints skip directories
+
     def __init__(self, root: str = ".", **config):
         self.root = root
         self._metadata = _HiveMetadata(self)

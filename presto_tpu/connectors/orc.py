@@ -81,6 +81,9 @@ class _OrcMetadata(ConnectorMetadata):
 class OrcConnector(Connector):
     """Catalog over ``root/<schema>/<table>.orc`` files."""
 
+    def prunes_splits(self) -> bool:
+        return True  # per-stripe min/max prune splits
+
     def __init__(self, root: str = ".", **config):
         self.root = root
         self._metadata = _OrcMetadata(self)

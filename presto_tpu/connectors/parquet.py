@@ -139,6 +139,9 @@ class _ParquetMetadata(ConnectorMetadata):
 class ParquetConnector(Connector):
     """Catalog over ``root/<schema>/<table>.parquet`` files."""
 
+    def prunes_splits(self) -> bool:
+        return True  # row-group footer min/max prune splits
+
     def __init__(self, root: str = ".", **config):
         self.root = root
         self._metadata = _ParquetMetadata(self)

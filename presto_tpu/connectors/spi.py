@@ -174,6 +174,16 @@ class Connector:
         scans to workers, whose copies of the tables are empty."""
         return False
 
+    def prunes_splits(self) -> bool:
+        """True when this connector USES scan constraints to skip
+        splits (hive partition pruning, parquet row-group / ORC stripe
+        stats). Statements over such catalogs bypass the statement-
+        level plan cache: a cached parameterized plan blocks constraint
+        extraction from equality/IN literals, which would silently cost
+        these connectors their pruning. Connectors that ignore
+        constraints (the default) keep full plan-cache sharing."""
+        return False
+
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError
 

@@ -242,6 +242,23 @@ class SystemConnector(Connector):
                 "evictions": 0,
             },
         ]
+        # statement-level parameterized plan cache (plan/canonical.py):
+        # occupancy + this runner's hit/miss/evict tallies beside the
+        # staging and compile rows
+        pc = getattr(self._runner, "plan_cache", None)
+        if pc is not None:
+            s = pc.stats()
+            rows.append(
+                {
+                    "cache": "plan.cache",
+                    "entries": s["entries"],
+                    "bytes": 0,  # plans are small host objects
+                    "budget_bytes": 0,
+                    "hits": s["hits"],
+                    "misses": s["misses"],
+                    "evictions": s["evictions"],
+                }
+            )
         # durable-exchange spool occupancy (fault-tolerant execution):
         # present when the embedding coordinator has exchange.spool-path
         # configured (server.spool shares the directory with workers)

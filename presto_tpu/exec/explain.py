@@ -145,6 +145,12 @@ def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
         f"execution {qstats.execution_ms:.1f} ms, "
         f"{len(qstats.stages)} stage(s)"
     )
+    lines.append(
+        "plan cache: "
+        + ("HIT" if qstats.plan_cache_hit else "MISS")
+        + ", compile cache: "
+        + ("HIT" if qstats.compile_cache_hit else "MISS")
+    )
     if (
         qstats.dynamic_filters
         or qstats.dynamic_filter_wait_ms

@@ -100,6 +100,7 @@ class LocalQueryRunner:
         session: Optional[Session] = None,
         memory_pool=None,
         staging_cache_bytes: Optional[int] = None,
+        plan_cache_entries: int = 256,
     ):
         from presto_tpu.exec.stats import QueryHistory
 
@@ -133,6 +134,24 @@ class LocalQueryRunner:
 
             self.history.add_listener(JsonlQueryEventListener(event_log))
         self._compiled: Dict[object, object] = {}
+        # one entry-creation lock: 50 concurrent literal-variants of one
+        # shape must produce ONE jitted closure (and so one XLA
+        # compile), not a thundering herd of per-thread traces
+        self._compile_mu = threading.Lock()
+        # canonical fingerprints whose PARAMETERIZED form failed to
+        # trace (a hoisted literal fed a structure-demanding kernel):
+        # those shapes recompile in classic literal form, forever
+        self._no_hoist: set = set()
+        # statement-level parameterized plan cache (plan/canonical.py):
+        # canonical AST -> planned+optimized plan; warm EXECUTE /
+        # repeated query shapes skip parse-analysis, planning and
+        # optimization entirely (tier-1 plan.cache-entries)
+        from presto_tpu.plan.canonical import PlanCache
+
+        self.plan_cache = PlanCache(plan_cache_entries)
+        # per-execution RuntimeParam ordinal -> E.Literal bound values
+        # (thread-local: concurrent server queries each carry their own)
+        self._bound_local = threading.local()
         self._prepared: Dict[str, object] = {}
         #: device-resident staged-page cache (exec.staging.SplitCache):
         #: whole-table entries always (cacheable connectors), split-
@@ -280,9 +299,12 @@ class LocalQueryRunner:
                 "query", query_id=qs.query_id
             ):
                 with trace.span("plan"):
-                    plan = plan_statement(
-                        stmt, self.catalogs, self.session
-                    )
+                    if isinstance(stmt, ast.Select):
+                        plan, qs.plan_cache_hit = self.plan_cached(stmt)
+                    else:
+                        plan = plan_statement(
+                            stmt, self.catalogs, self.session
+                        )
                 qs.planning_ms = (time.perf_counter() - t0) * 1000.0
                 qs.state = "RUNNING"
                 with trace.span("execute"):
@@ -331,8 +353,13 @@ class LocalQueryRunner:
     def _invalidate_table_caches(self, handle) -> None:
         """Drop cached pages (whole-table AND split granularity) of a
         written/deleted table, releasing their reservations — the
-        writable-connector invalidation hook of the split cache."""
+        writable-connector invalidation hook of the split cache. The
+        statement-level plan cache invalidates on the same hook: a
+        DROP/recreate can change the schema a cached plan resolved
+        against (plain INSERTs keep plans valid, but the hook is the
+        one audited write-path seam and a replan costs microseconds)."""
         self.split_cache.invalidate(handle)
+        self.plan_cache.invalidate(handle)
 
     def _resolve_write_handle(self, parts):
         from presto_tpu.connectors.spi import TableHandle
@@ -515,8 +542,12 @@ class LocalQueryRunner:
     def _execute_prepared(self, stmt) -> QueryResult:
         """EXECUTE name [USING v, ...]: substitute ? markers in the
         prepared AST with the literal arguments, then run the
-        statement through the normal path (reference: prepared
-        statements carried per-session)."""
+        statement through the plan-cached path (reference: prepared
+        statements carried per-session). A warm EXECUTE — the
+        statement's canonical shape already planned — does zero
+        parsing of the prepared text, zero planning, and (the argument
+        literals binding straight into the cached program's parameter
+        vector) zero compilation."""
         inner = self._prepared.get(stmt.name)
         if inner is None:
             raise ExecutionError(
@@ -529,14 +560,144 @@ class LocalQueryRunner:
                 f"parameter(s), {len(stmt.params)} given"
             )
         bound = _bind_param_markers(inner, stmt.params)
+        return self.execute_bound(bound)
+
+    def execute_bound(self, bound) -> QueryResult:
+        """Run an already-bound statement AST (EXECUTE after marker
+        substitution — also the coordinator's prepared-statement entry
+        point, so the HTTP fast lane and the embedded one share one
+        dispatch)."""
         if isinstance(bound, (ast.Insert, ast.CreateTableAs)):
             return self._execute_write(bound)
         if isinstance(bound, ast.Delete):
             return self._execute_delete(bound)
         if isinstance(bound, ast.Update):
             return self._execute_update(bound)
-        plan = plan_statement(bound, self.catalogs, self.session)
+        if isinstance(bound, ast.Select):
+            plan, _hit = self.plan_cached(bound)
+        else:
+            plan = plan_statement(bound, self.catalogs, self.session)
         return self.execute_plan(plan)
+
+    def plan_cached(self, stmt) -> Tuple[Plan, bool]:
+        plan, hit = self._plan_cached(stmt)
+        if hit:
+            # a server embedding this runner installs its QueryStats as
+            # the thread-local sink before planning: attribute the hit
+            qs = self._active_qs
+            if qs is not None:
+                with self._qs_mu:
+                    qs.plan_cache_hit = True
+        return plan, hit
+
+    def _plan_cached(self, stmt) -> Tuple[Plan, bool]:
+        """Statement-level parameterized plan cache -> (plan, hit).
+
+        The statement canonicalizes (comparison-operand literals become
+        BoundParam placeholders — plan/canonical.py); the canonical
+        AST keys a bounded LRU of planned + pre-optimized plans whose
+        RuntimeParam slots the current literal values bind into. A
+        shape whose canonical form cannot plan (a hoisted literal in a
+        structural position) is marked BYPASS and planned with literals
+        in place from then on — the cache degrades to classic planning,
+        never to a failed query."""
+        from presto_tpu.plan import canonical
+        from presto_tpu.utils.metrics import REGISTRY
+
+        if not self.session.get("enable_plan_cache"):
+            return (
+                plan_statement(stmt, self.catalogs, self.session),
+                False,
+            )
+        t0 = time.perf_counter()
+        try:
+            key, canon, lits = canonical.canonicalize_statement(
+                stmt, self.session
+            )
+        except Exception:
+            # canonicalization must never fail a query
+            return (
+                plan_statement(stmt, self.catalogs, self.session),
+                False,
+            )
+        finally:
+            REGISTRY.distribution("plan.canonicalize_ms").add(
+                (time.perf_counter() - t0) * 1000.0
+            )
+        bound = {i: lit for i, lit in enumerate(lits)}
+        entry = self.plan_cache.get(key)
+        if isinstance(entry, canonical.PlanCacheEntry):
+            return (
+                Plan(
+                    root=entry.root,
+                    params=entry.params,
+                    output_names=entry.output_names,
+                    bound_values=bound,
+                    preoptimized=entry.preoptimized,
+                ),
+                True,
+            )
+        if entry is canonical.BYPASS:
+            return (
+                plan_statement(stmt, self.catalogs, self.session),
+                False,
+            )
+        try:
+            plan = plan_statement(canon, self.catalogs, self.session)
+        except Exception:
+            # parameterized planning failed (hoisted literal in a
+            # structural position): permanent literal-form lane
+            self.plan_cache.put(key, canonical.BYPASS)
+            return (
+                plan_statement(stmt, self.catalogs, self.session),
+                False,
+            )
+        handles = canonical.plan_handles(plan)
+        if any(
+            self.catalogs.get(h.catalog).prunes_splits()
+            for h in handles
+        ):
+            # split-pruning connectors (hive partitions, parquet row
+            # groups, ORC stripes) read equality/IN literals as scan
+            # constraints; a parameterized plan blocks that extraction
+            # and would silently cost them their pruning — those
+            # statements keep classic literal planning (the compile-
+            # level canonicalizer still shares programs where the
+            # constraints agree)
+            self.plan_cache.put(key, canonical.BYPASS)
+            return (
+                plan_statement(stmt, self.catalogs, self.session),
+                False,
+            )
+        root, preopt = plan.root, False
+        if not plan.params:
+            # value-independent over a canonical root: optimize ONCE at
+            # store time so cache hits skip it (plans with scalar-
+            # subquery params keep the execute-time prune+push order —
+            # binding substitutes Params first)
+            root = push_scan_constraints(prune_columns(root))
+            preopt = True
+        self.plan_cache.put(
+            key,
+            canonical.PlanCacheEntry(
+                root=root,
+                params=plan.params,
+                output_names=plan.output_names,
+                preoptimized=preopt,
+                handles=handles,
+                n_slots=len(lits),
+            ),
+        )
+        return (
+            Plan(
+                root=root,
+                params=plan.params,
+                output_names=plan.output_names,
+                bound_values=bound,
+                preoptimized=preopt,
+            ),
+            False,
+        )
 
     def _execute_write(self, stmt) -> QueryResult:
         """Table writer (reference: TableWriterOperator + the SPI's
@@ -616,9 +777,16 @@ class LocalQueryRunner:
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
 
         prev, self._active_qs = self._active_qs, qs
+        prev_bound = getattr(self._bound_local, "value", None)
+        if plan.bound_values is not None:
+            # cached canonical plan: the execution's literal values ride
+            # thread-local to _run_with_pages, where they bind into the
+            # compiled program's parameter vector
+            self._bound_local.value = plan.bound_values
         try:
             root = self._bind_params(plan)
-            root = push_scan_constraints(prune_columns(root))
+            if not plan.preoptimized:
+                root = push_scan_constraints(prune_columns(root))
             host_ops: List[N.PlanNode] = []
             if self.session.get("host_root_stage"):
                 root, host_ops = peel_host_ops(root)
@@ -631,6 +799,7 @@ class LocalQueryRunner:
                 qs.output_rows = int(page.num_valid)
         finally:
             self._active_qs = prev
+            self._bound_local.value = prev_bound
         return QueryResult(plan.output_names, page)
 
     def execute_plan_analyzed(self, plan: Plan):
@@ -908,83 +1077,162 @@ class LocalQueryRunner:
         ``(device_page_rebucketed, n)`` instead of a host page."""
         scan_ids = {id(s): i for i, s in enumerate(scans)}
         analyzed = stats_out is not None
+        from presto_tpu.plan import canonical
 
         tries = 0
         while True:
             # key by structural fingerprint, not object identity: every
             # execute_plan rebuilds the tree (prune/bind), and a retrace
-            # per call would redo XLA cache lookups costing seconds
+            # per call would redo XLA cache lookups costing seconds.
+            # The fingerprint is taken over the CANONICAL root —
+            # literals hoisted into RuntimeParam slots whose values ride
+            # in as the program's parameter vector — so literal-variant
+            # plans of one shape share ONE compiled program
+            # (plan/canonical.py; enable_plan_cache=false keeps the
+            # pre-cache literal fingerprints bit-for-bit).
             offload = self.session.get("tpu_offload")
             from presto_tpu.utils.metrics import REGISTRY
 
-            entry = self._compiled.get(
-                (root.fingerprint(), analyzed, offload)
+            bound = getattr(self._bound_local, "value", None)
+            # analyzed (EXPLAIN ANALYZE) keeps literals in place: node
+            # labels print the predicate exprs, and those must show the
+            # query's actual values
+            hoist = (
+                bool(self.session.get("enable_plan_cache"))
+                and not analyzed
             )
+            croot, params = canonical.hoist_params(
+                root, bound=bound, hoist_literals=hoist
+            )
+            # fingerprint() is a full-tree repr: compute it ONCE per
+            # iteration (it keys the compile cache, the no-hoist check,
+            # and the failure handler below)
+            cfp = croot.fingerprint()
+            if croot is not root and cfp in self._no_hoist:
+                # this shape's parameterized form failed to trace once:
+                # permanent classic literal-form lane
+                croot, params = canonical.bind_literal_root(
+                    root, bound
+                ), ()
+                cfp = croot.fingerprint()
+            if croot is root:
+                cscan_ids = scan_ids
+            else:
+                # the canonical tree is a rebuilt copy: its leaves are
+                # NEW objects wherever an ancestor/field changed, but
+                # the rewrite preserves tree shape, so leaves correspond
+                # 1:1 by walk position — remap the identity-keyed page
+                # indices onto the canonical leaves
+                leaf_types = (N.TableScanNode, N.RemoteSourceNode)
+                orig_leaves = [
+                    n for n in N.walk(root) if isinstance(n, leaf_types)
+                ]
+                new_leaves = [
+                    n
+                    for n in N.walk(croot)
+                    if isinstance(n, leaf_types)
+                ]
+                cscan_ids = dict(scan_ids)
+                for o, nn in zip(orig_leaves, new_leaves):
+                    if id(o) in scan_ids:
+                        cscan_ids[id(nn)] = scan_ids[id(o)]
+            key = (cfp, analyzed, offload)
+            with self._compile_mu:
+                entry = self._compiled.get(key)
+                fresh = entry is None
+                if fresh:
+                    msgs_cell: List[str] = []
+                    nodes_cell: List = []
+
+                    def trace(
+                        pages_in,
+                        params_in,
+                        _root=croot,
+                        _ids=cscan_ids,
+                        _m=msgs_cell,
+                        _n=nodes_cell,
+                    ):
+                        flags: List = []
+                        errors: List = []
+                        counters: Optional[List] = (
+                            [] if analyzed else None
+                        )
+                        dyn: List = []
+                        with canonical.active_params(params_in):
+                            out = _execute_node(
+                                _root, pages_in, _ids, flags, errors,
+                                counters, dyn,
+                            )
+                            # program boundary: host materialization /
+                            # exchanges need prefix form (lazy selection
+                            # masks stop here)
+                            out = compact_page(out)
+                        _m.clear()
+                        _m.extend(m for m, _ in errors)
+                        _n.clear()
+                        if counters is not None:
+                            from presto_tpu.exec.stats import node_label
+
+                            walk_ids = {
+                                id(n): i
+                                for i, n in enumerate(N.walk(_root))
+                            }
+                            _n.extend(
+                                (
+                                    walk_ids.get(id(node), -1),
+                                    node_label(node),
+                                    cap,
+                                )
+                                for node, _, cap in counters
+                            )
+                            cnts = [c for _, c, _ in counters]
+                        else:
+                            cnts = []
+                        # stack control outputs: ONE device->host fetch
+                        # per run (each separate scalar fetch costs a
+                        # full relay round trip, ~100ms on tunneled
+                        # TPU); dyn holds per-dynamic-filter pruned-row
+                        # counts
+                        return (
+                            out,
+                            _stack_bools(flags),
+                            _stack_bools([e for _, e in errors]),
+                            _stack_i32(cnts),
+                            _stack_i32(dyn),
+                        )
+
+                    entry = (jax.jit(trace), msgs_cell, nodes_cell)
+                    self._compiled[key] = entry
             # compile-amortization counters (bench.py runs read these):
             # a miss pays trace + XLA compile; steady state is all hits
             REGISTRY.counter(
-                "compile.cache_miss" if entry is None else
-                "compile.cache_hit"
+                "compile.cache_miss" if fresh else "compile.cache_hit"
             ).update()
-            if entry is None:
-                if self._active_qs is not None:
-                    self._active_qs.compile_cache_hit = False
-                msgs_cell: List[str] = []
-                nodes_cell: List = []
-
-                def trace(
-                    pages_in,
-                    _root=root,
-                    _ids=scan_ids,
-                    _m=msgs_cell,
-                    _n=nodes_cell,
-                ):
-                    flags: List = []
-                    errors: List = []
-                    counters: Optional[List] = [] if analyzed else None
-                    dyn: List = []
-                    out = _execute_node(
-                        _root, pages_in, _ids, flags, errors, counters,
-                        dyn,
-                    )
-                    # program boundary: host materialization / exchanges
-                    # need prefix form (lazy selection masks stop here)
-                    out = compact_page(out)
-                    _m.clear()
-                    _m.extend(m for m, _ in errors)
-                    _n.clear()
-                    if counters is not None:
-                        from presto_tpu.exec.stats import node_label
-
-                        walk_ids = {
-                            id(n): i for i, n in enumerate(N.walk(_root))
-                        }
-                        _n.extend(
-                            (walk_ids.get(id(node), -1), node_label(node), cap)
-                            for node, _, cap in counters
-                        )
-                        cnts = [c for _, c, _ in counters]
-                    else:
-                        cnts = []
-                    # stack control outputs: ONE device->host fetch per
-                    # run (each separate scalar fetch costs a full relay
-                    # round trip, ~100ms on tunneled TPU); dyn holds
-                    # per-dynamic-filter pruned-row counts
-                    return (
-                        out,
-                        _stack_bools(flags),
-                        _stack_bools([e for _, e in errors]),
-                        _stack_i32(cnts),
-                        _stack_i32(dyn),
-                    )
-
-                entry = (jax.jit(trace), msgs_cell, nodes_cell)
-                self._compiled[
-                    (root.fingerprint(), analyzed, offload)
-                ] = entry
+            if fresh and self._active_qs is not None:
+                self._active_qs.compile_cache_hit = False
             fn, msgs_cell, nodes_cell = entry
-            with self._device_scope():
-                page, flags_arr, err_arr, cnt_arr, dyn_arr = fn(pages)
+            try:
+                with self._device_scope():
+                    page, flags_arr, err_arr, cnt_arr, dyn_arr = fn(
+                        pages, params
+                    )
+            except Exception:
+                if params:
+                    # the canonical form failed (usually a hoisted
+                    # literal feeding a structure-demanding kernel at
+                    # trace time): retire it and recompile this shape
+                    # in literal form — a query the literal path can
+                    # run must never fail because of hoisting. Guarded
+                    # on params alone (not _no_hoist membership): a
+                    # CONCURRENT thread that fetched the same entry
+                    # before the first failure retired it must also
+                    # fall back, not re-raise. The literal lane always
+                    # has params=(), so this cannot loop.
+                    self._no_hoist.add(key[0])
+                    with self._compile_mu:
+                        self._compiled.pop(key, None)
+                    continue
+                raise
             # Round-trip discipline (tunneled TPU: every separate fetch
             # pays ~65ms relay latency): ONE device_get for all control
             # outputs + the result row count + a SPECULATIVE prefix of

@@ -164,6 +164,10 @@ class QueryStats:
     staging_ms: float = 0.0  # host->HBM page staging
     execution_ms: float = 0.0  # device program (incl. compile on miss)
     compile_cache_hit: bool = True
+    #: statement-level parameterized plan cache (plan/canonical.py):
+    #: True = planning was skipped, the canonical form was already
+    #: planned and this execution only bound fresh literal values
+    plan_cache_hit: bool = False
     staging_cache_hits: int = 0  # pages served device-resident
     retries: int = 0  # capacity-overflow re-runs
     device_fragments: int = 0  # stage-at-a-time programs beyond the root
@@ -216,6 +220,15 @@ class QueryStats:
         self.retries = sum(
             t.retries for s in self.stages for t in s.tasks
         )
+        # a query compiled fresh ANYWHERE (coordinator splice or any
+        # worker task) is not a compile-cache hit; sticky AND so a
+        # coordinator-local miss survives later polls
+        if any(
+            not t.compile_cache_hit
+            for s in self.stages
+            for t in s.tasks
+        ):
+            self.compile_cache_hit = False
         self.staging_ms = sum(
             t.staging_ms for s in self.stages for t in s.tasks
         )
@@ -273,6 +286,7 @@ class QueryStats:
             "staging_ms": self.staging_ms,
             "execution_ms": self.execution_ms,
             "compile_cache_hit": self.compile_cache_hit,
+            "plan_cache_hit": self.plan_cache_hit,
             "staging_cache_hits": self.staging_cache_hits,
             "retries": self.retries,
             "device_fragments": self.device_fragments,

@@ -385,6 +385,33 @@ class Param(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class RuntimeParam(Expr):
+    """A hoisted literal that enters the compiled program as a RUNTIME
+    argument (device input) instead of a trace-time constant — the
+    parameterized-plan-cache leaf (plan/canonical.py). Two structurally
+    identical plans whose literals differ only in value normalize to
+    one canonical form over RuntimeParams, so they share ONE jitted
+    program; the values ride in as a parameter vector per execution.
+
+    ``index`` is the slot in that vector. Construction is owned by
+    plan/canonical.py (and the planner's one BoundParam lowering site)
+    — enforced by tools/check_plan_params.py: an ad-hoc RuntimeParam
+    bypasses the dtype/structure eligibility rules (strings resolve
+    against trace-time dictionaries, long decimals take the
+    literal-introspection fast path) and silently miscompiles."""
+
+    index: int
+    _dtype: T.DataType
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __str__(self):
+        return f"?p{self.index}"
+
+
+@dataclasses.dataclass(frozen=True)
 class DictTransform(Expr):
     """String-valued function of a dictionary column, evaluated host-side
     over the dictionary entries (substring, lower, ...). On device it is
@@ -1574,9 +1601,22 @@ class ExprLowerer:
                 res = jnp.isin(data, jnp.asarray(ids, jnp.int32))
             return (~res if e.negate else res), valid
         d, v = self.eval(e.arg)
-        vals = jnp.asarray(
-            [lit.value for lit in e.values], dtype=e.arg.dtype.jnp_dtype
-        )
+        if all(isinstance(lit, Literal) for lit in e.values):
+            vals = jnp.asarray(
+                [lit.value for lit in e.values],
+                dtype=e.arg.dtype.jnp_dtype,
+            )
+        else:
+            # hoisted members (RuntimeParam): each evaluates to a traced
+            # scalar already planner-coerced into the arg's type domain
+            vals = jnp.stack(
+                [
+                    jnp.asarray(
+                        self.eval(lit)[0], e.arg.dtype.jnp_dtype
+                    ).reshape(())
+                    for lit in e.values
+                ]
+            )
         res = jnp.isin(d, vals)
         return (~res if e.negate else res), v
 
@@ -1602,6 +1642,17 @@ class ExprLowerer:
             f"unbound scalar-subquery parameter ${e.param_id}: the executor "
             "must substitute Params before fragment compilation"
         )
+
+    def _eval_runtimeparam(self, e: RuntimeParam):
+        # the value is a traced scalar from the program's parameter
+        # vector (plan/canonical.py installs it around _execute_node);
+        # like a Literal it broadcasts against column arrays, and it is
+        # non-null by eligibility (NULL literals stay constants — their
+        # validity lane is program structure)
+        from presto_tpu.plan import canonical
+
+        d = canonical.active_param(e.index)
+        return jnp.asarray(d, e.dtype.jnp_dtype), None
 
     def _eval_dictpredicate(self, e: DictPredicate):
         assert e.arg.dtype.is_string

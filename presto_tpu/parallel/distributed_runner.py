@@ -116,6 +116,18 @@ class DistributedQueryRunner(LocalQueryRunner):
 
     # ---------------------------------------------------------------- run
 
+    def execute_plan(self, plan, qs=None):
+        # the mesh fragment executor (_exec_dist inside shard_map) has
+        # no parameter-vector plumbing: materialize statement-cache
+        # plans back to literal form first — the statement cache still
+        # skips planning, and _run_with_pages re-hoists the non-mesh
+        # parts; _frag_compiled keeps literal keys (documented limit)
+        from presto_tpu.plan import canonical
+
+        return super().execute_plan(
+            canonical.materialize_plan(plan), qs=qs
+        )
+
     def _run(self, root: N.PlanNode) -> Page:
         if self.n == 1:
             return super()._run(root)

@@ -48,11 +48,21 @@ class PlanningError(ValueError):
 
 @dataclasses.dataclass
 class Plan:
-    """Root plan + scalar-subquery subplans to bind (param_id -> plan)."""
+    """Root plan + scalar-subquery subplans to bind (param_id -> plan).
+
+    A plan served from the statement-level plan cache
+    (plan/canonical.py) additionally carries ``bound_values`` — the
+    current execution's literal values by RuntimeParam ordinal — and
+    ``preoptimized`` marks a cached root that already went through
+    prune_columns + push_scan_constraints (both are value-independent
+    over a canonical root, so re-running them per execution would be
+    planning work the cache exists to skip)."""
 
     root: N.PlanNode
     params: List[Tuple[int, "Plan"]]
     output_names: Tuple[str, ...]
+    bound_values: Optional[Dict[int, "E.Literal"]] = None
+    preoptimized: bool = False
 
 
 _AMBIGUOUS = object()
@@ -2656,6 +2666,14 @@ class _Planner:
                     "decorrelation pattern"
                 )
             return E.ColumnRef(name, dtype)
+        if isinstance(e, ast.BoundParam):
+            # canonicalized literal (plan/canonical.py): lower the
+            # carried literal for its TYPE only — the value enters the
+            # compiled program as a runtime parameter, never a constant,
+            # which is exactly what makes the planned form reusable
+            # across literal variants
+            base = lower(e.lit)
+            return E.RuntimeParam(e.ordinal, base.dtype)
         if isinstance(e, ast.NumberLit):
             return _number_literal(e.text)
         if isinstance(e, ast.StringLit):

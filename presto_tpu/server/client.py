@@ -10,9 +10,9 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List
+from typing import Dict, List
 
-from presto_tpu.server import rpc
+from presto_tpu.server import protocol, rpc
 
 
 class QueryFailed(RuntimeError):
@@ -48,6 +48,13 @@ class PrestoTpuClient:
         #: with backoff; the statement POST never retries (resubmitting
         #: would start a second query)
         self.rpc_policy = rpc_policy
+        #: prepared statements this client session owns (reference: the
+        #: client protocol's prepared-statement session headers). The
+        #: map replays on every request as X-Presto-Prepared-Statement
+        #: headers and updates from the server's added/deallocated
+        #: response headers — the coordinator stays stateless, and
+        #: EXECUTE reaches its zero-recompile plan-cache fast lane.
+        self.prepared: Dict[str, str] = {}
 
     def execute(self, sql: str) -> ClientResult:
         first = self._post_json(
@@ -69,7 +76,17 @@ class PrestoTpuClient:
                 return ClientResult(query_id=qid, columns=columns, data=data)
             if time.monotonic() > deadline:
                 raise TimeoutError(f"query {qid} did not finish in time")
-            cur = self._get_json(nxt)
+            resp = rpc.call("GET", nxt, policy=self.rpc_policy)
+            self._absorb_prepared_headers(resp.headers)
+            cur = resp.json()
+
+    def _absorb_prepared_headers(self, headers) -> None:
+        added = headers.get_all(protocol.ADDED_PREPARE_HEADER)
+        if added:
+            self.prepared.update(protocol.decode_prepared(added))
+        dropped = headers.get(protocol.DEALLOCATED_PREPARE_HEADER)
+        if dropped:
+            self.prepared.pop(dropped, None)
 
     # ----------------------------------------------------- observability
 
@@ -86,13 +103,19 @@ class PrestoTpuClient:
     # ------------------------------------------------------------ http
 
     def _post_json(self, url: str, body: bytes) -> dict:
+        headers = {
+            "Content-Type": "text/plain",
+            "X-Presto-User": self.user,
+        }
+        if self.prepared:
+            headers[protocol.PREPARED_STATEMENT_HEADER] = ",".join(
+                protocol.encode_prepared(n, s)
+                for n, s in self.prepared.items()
+            )
         return rpc.call(
             "POST", url, body,
             policy=self.rpc_policy,
-            headers={
-                "Content-Type": "text/plain",
-                "X-Presto-User": self.user,
-            },
+            headers=headers,
         ).json()
 
     def _get_json(self, url: str) -> dict:

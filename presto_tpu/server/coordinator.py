@@ -23,8 +23,9 @@ import time
 import traceback
 import urllib.error
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +57,22 @@ DRAIN_GRACE_S = 900.0
 class NoLiveWorkers(RuntimeError):
     """Every candidate worker is dead or circuit-open — the trigger
     for coordinator-local fallback execution."""
+
+
+def _prepare_text(sql: str, name: str) -> str:
+    """The inner statement TEXT of ``PREPARE name FROM <statement>`` —
+    what the added-prepare response header carries (the parse tree has
+    already validated it; the client replays the text verbatim)."""
+    import re
+
+    m = re.match(
+        r"\s*prepare\s+" + re.escape(name) + r"\s+from\s+(.*)$",
+        sql,
+        re.IGNORECASE | re.DOTALL,
+    )
+    if not m:
+        raise RuntimeError(f"malformed PREPARE statement: {sql!r}")
+    return m.group(1).strip().rstrip(";")
 
 
 def _is_draining_503(exc) -> bool:
@@ -113,6 +130,14 @@ class _Query:
         self._retry_budget: Optional[int] = None
         #: task ids of speculative (backup) attempts, for accounting
         self._speculative: set = set()
+        #: prepared statements supplied by the CLIENT on this request
+        #: (X-Presto-Prepared-Statement headers — the client owns the
+        #: map; see server.protocol)
+        self.prepared: Dict[str, str] = {}
+        #: response-header payloads: (name, sql) registered by a
+        #: PREPARE in this query / name dropped by a DEALLOCATE
+        self.added_prepare: Optional[Tuple[str, str]] = None
+        self.deallocated_prepare: Optional[str] = None
 
     def fail(self, error: str) -> None:
         """Terminal rejection/kill close-out — one place for the
@@ -234,6 +259,20 @@ class CoordinatorServer:
         rp = config.get("retry-policy") if config else None
         if rp is not None:
             self.local.session.set("retry_policy", rp)
+        # parameterized plan cache (plan/canonical.py): tier-1 keys
+        # bound the statement-level LRU and seed the session default
+        pce = config.get("plan.cache-entries") if config else None
+        if pce is not None:
+            self.local.plan_cache.resize(int(pce))
+        pcen = config.get("plan.cache-enabled") if config else None
+        if pcen is not None:
+            self.local.session.set("enable_plan_cache", bool(pcen))
+        #: coordinator-global prepared statements (PREPARE over plain
+        #: HTTP without a header-aware client); header-supplied maps on
+        #: the request take precedence. Bounded: a serving fleet cycles
+        #: thousands of ad-hoc names
+        self._prepared_sql: "OrderedDict[str, str]" = OrderedDict()
+        self._prepared_mu = threading.Lock()
         self.spool = ExchangeSpool.from_config(config)
         self._lock = threading.Lock()
         self._qid = itertools.count(1)
@@ -501,7 +540,12 @@ class CoordinatorServer:
             ]
         return sum(self.memory_pool.used_bytes(qid) for qid in qids)
 
-    def submit(self, sql: str, user: str = "presto_tpu") -> _Query:
+    def submit(
+        self,
+        sql: str,
+        user: str = "presto_tpu",
+        prepared: Optional[Dict[str, str]] = None,
+    ) -> _Query:
         # "q_c" namespace: distributed queries join the runner's
         # QueryHistory (adopt), whose own ids are "q_N" — the two
         # counters are independent and must not collide there. The
@@ -509,6 +553,7 @@ class CoordinatorServer:
         # them) unique across coordinator restarts sharing one spool
         q = _Query(f"q_c{next(self._qid)}_{self._boot}", sql)
         q.user = user
+        q.prepared = dict(prepared or {})
         q.resource_group = None
         with self._lock:
             self.queries[q.qid] = q
@@ -671,6 +716,8 @@ class CoordinatorServer:
         from presto_tpu.sql import ast, parse_statement
 
         stmt = parse_statement(q.sql)
+        if isinstance(stmt, (ast.Prepare, ast.Execute, ast.Deallocate)):
+            return self._run_prepared_stmt(q, stmt)
         workers = self.active_workers()
         if (
             isinstance(stmt, ast.Explain)
@@ -708,6 +755,101 @@ class CoordinatorServer:
         res = self._run_select(q, stmt, workers)
         self._store_result(q, res)
 
+    #: coordinator-global prepared registry bound (names cycle on a
+    #: serving fleet; the client-header path carries its own map)
+    MAX_PREPARED = 256
+
+    def _run_prepared_stmt(self, q: _Query, stmt) -> None:
+        """PREPARE / EXECUTE / DEALLOCATE over HTTP (server.protocol
+        prepared-statement headers). PREPARE registers the statement
+        TEXT (response header ``X-Presto-Added-Prepare`` hands it to
+        the client, which replays it per request); EXECUTE parses the
+        registered text through a bounded AST cache, binds the
+        arguments, and runs the bound statement through the normal
+        distributed/local path — whose plan cache makes a warm EXECUTE
+        zero-planning, zero-compilation."""
+        from presto_tpu.exec.local_runner import (
+            _bind_param_markers,
+            _count_param_markers,
+        )
+        from presto_tpu.sql import ast
+
+        if isinstance(stmt, ast.Prepare):
+            text = _prepare_text(q.sql, stmt.name)
+            with self._prepared_mu:
+                self._prepared_sql[stmt.name] = text
+                self._prepared_sql.move_to_end(stmt.name)
+                while len(self._prepared_sql) > self.MAX_PREPARED:
+                    evicted, _ = self._prepared_sql.popitem(last=False)
+                    # keep the runner-side mirror bounded too: an
+                    # LRU-evicted name must not pin its parsed AST
+                    self.local._prepared.pop(evicted, None)
+            # the embedded runner serves the non-distributed EXECUTE
+            # path: keep its per-runner registry in step
+            self.local._prepared[stmt.name] = stmt.statement
+            q.added_prepare = (stmt.name, text)
+            q.columns = [{"name": "result"}]
+            q.rows = [["PREPARE"]]
+            return
+        if isinstance(stmt, ast.Deallocate):
+            with self._prepared_mu:
+                self._prepared_sql.pop(stmt.name, None)
+            self.local._prepared.pop(stmt.name, None)
+            q.deallocated_prepare = stmt.name
+            q.columns = [{"name": "result"}]
+            q.rows = [["DEALLOCATE"]]
+            return
+        # EXECUTE: client-supplied statements take precedence (the
+        # client owns its session's prepared map)
+        text = q.prepared.get(stmt.name)
+        if text is None:
+            with self._prepared_mu:
+                text = self._prepared_sql.get(stmt.name)
+        if text is None:
+            raise RuntimeError(
+                f"prepared statement {stmt.name!r} not found"
+            )
+        inner = self._parse_prepared(text)
+        n_markers = _count_param_markers(inner)
+        if n_markers != len(stmt.params):
+            raise RuntimeError(
+                f"EXECUTE {stmt.name}: statement has {n_markers} "
+                f"parameter(s), {len(stmt.params)} given"
+            )
+        from presto_tpu.sql import ast as A
+
+        bound = _bind_param_markers(inner, stmt.params)
+        workers = self.active_workers()
+        if isinstance(bound, A.Select) and workers:
+            res = self._run_select(q, bound, workers)
+        else:
+            # plan_cached marks q.stats.plan_cache_hit through the
+            # thread-local stats sink _execute_query installed
+            with q.trace.span("execute-local"):
+                res = self.local.execute_bound(bound)
+        self._store_result(q, res)
+
+    def _parse_prepared(self, text: str):
+        """Parse a prepared statement's text through a bounded AST
+        cache: a warm EXECUTE re-parses nothing."""
+        from presto_tpu.sql import parse_statement
+
+        cache = getattr(self, "_ast_cache", None)
+        if cache is None:
+            cache = self._ast_cache = OrderedDict()
+        with self._prepared_mu:
+            got = cache.get(text)
+            if got is not None:
+                cache.move_to_end(text)
+                return got
+        parsed = parse_statement(text)
+        with self._prepared_mu:
+            cache[text] = parsed
+            cache.move_to_end(text)
+            while len(cache) > self.MAX_PREPARED:
+                cache.popitem(last=False)
+        return parsed
+
     def _run_select(self, q: _Query, stmt, workers):
         """Distributed SELECT: plan -> fragment -> schedule stages ->
         gather, each phase a span on the query's trace; returns the
@@ -716,7 +858,6 @@ class CoordinatorServer:
         from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
         from presto_tpu.parallel.fragmenter import insert_gathers
         from presto_tpu.plan.optimizer import prune_columns
-        from presto_tpu.plan.planner import plan_statement
 
         # distributed queries share the runner's QueryHistory (one
         # system.runtime.queries across both tiers) and fire the
@@ -726,9 +867,17 @@ class CoordinatorServer:
         q.stats.retry_policy = self._retry_policy()
         t0 = time.perf_counter()
         with q.trace.span("plan"):
-            plan = plan_statement(
-                stmt, self.local.catalogs, self.local.session
-            )
+            # statement-level plan cache: a warm shape skips planning
+            # and optimization; the execution's literal values then
+            # substitute back in (materialize) so fragments ship plain
+            # literals — wire protocol and workers unchanged, and each
+            # worker re-hoists locally, so literal-variant fragments
+            # hit the WORKER compile caches too
+            plan, q.stats.plan_cache_hit = self.local.plan_cached(stmt)
+            if plan.bound_values:
+                from presto_tpu.plan import canonical
+
+                plan = canonical.materialize_plan(plan)
             root = prune_columns(self.local._bind_params(plan))
         q.stats.planning_ms = (time.perf_counter() - t0) * 1000.0
         scans = [
@@ -2495,13 +2644,15 @@ def _make_handler(coord: CoordinatorServer):
         def log_message(self, *a):
             pass
 
-        def _json(self, code: int, obj) -> None:
+        def _json(self, code: int, obj, extra_headers=None) -> None:
             # default=str: result rows may carry dates/decimals; the
             # oracle-compatible wire form is their string rendering
             body = json.dumps(obj, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -2512,9 +2663,19 @@ def _make_handler(coord: CoordinatorServer):
         def do_POST(self):
             parts = [p for p in self.path.split("/") if p]
             if parts == ["v1", "statement"]:
+                from presto_tpu.server import protocol
+
                 sql = self._read_body().decode()
                 user = self.headers.get("X-Presto-User", "presto_tpu")
-                q = coord.submit(sql, user=user)
+                # client-owned prepared statements ride per-request
+                # headers (server.protocol): EXECUTE resolves against
+                # this map first
+                prepared = protocol.decode_prepared(
+                    self.headers.get_all(
+                        protocol.PREPARED_STATEMENT_HEADER
+                    )
+                )
+                q = coord.submit(sql, user=user, prepared=prepared)
                 return self._json(
                     200,
                     {
@@ -2610,7 +2771,23 @@ def _make_handler(coord: CoordinatorServer):
                     )
                 else:
                     q._drained = True  # last page served
-                return self._json(200, out)
+                # prepared-statement session updates ride the result
+                # response (server.protocol): the client folds them
+                # into the map it replays on future requests
+                extra = {}
+                if q.added_prepare is not None:
+                    from presto_tpu.server import protocol
+
+                    extra[protocol.ADDED_PREPARE_HEADER] = (
+                        protocol.encode_prepared(*q.added_prepare)
+                    )
+                if q.deallocated_prepare is not None:
+                    from presto_tpu.server import protocol
+
+                    extra[protocol.DEALLOCATED_PREPARE_HEADER] = (
+                        q.deallocated_prepare
+                    )
+                return self._json(200, out, extra_headers=extra)
             self._json(404, {"error": f"no route {self.path}"})
 
     return Handler

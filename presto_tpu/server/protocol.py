@@ -126,6 +126,47 @@ def _coerce(value: Any, annot: Any, cls: type) -> Any:
     return value
 
 
+# ----------------------------------------- prepared-statement headers
+#
+# Reference parity: the client protocol's prepared-statement session
+# headers — the CLIENT owns the prepared map and replays it on every
+# request (the coordinator is stateless across requests):
+#
+#   request:  X-Presto-Prepared-Statement: name=<urlencoded sql>[, ...]
+#   response: X-Presto-Added-Prepare: name=<urlencoded sql>  (PREPARE)
+#             X-Presto-Deallocated-Prepare: name           (DEALLOCATE)
+#
+# EXECUTE then reaches the coordinator's plan-cache fast lane with the
+# statement text supplied by the header — zero server-side session
+# state, warm shapes skip planning and compilation entirely.
+
+PREPARED_STATEMENT_HEADER = "X-Presto-Prepared-Statement"
+ADDED_PREPARE_HEADER = "X-Presto-Added-Prepare"
+DEALLOCATED_PREPARE_HEADER = "X-Presto-Deallocated-Prepare"
+
+
+def encode_prepared(name: str, sql: str) -> str:
+    import urllib.parse
+
+    return f"{name}={urllib.parse.quote(sql, safe='')}"
+
+
+def decode_prepared(header_values) -> Dict[str, str]:
+    """Parse one or more ``name=<urlencoded sql>`` header values
+    (comma-separated within a value; quoting escapes commas)."""
+    import urllib.parse
+
+    out: Dict[str, str] = {}
+    for value in header_values or ():
+        for part in value.split(","):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            name, enc = part.split("=", 1)
+            out[name.strip()] = urllib.parse.unquote(enc.strip())
+    return out
+
+
 # ------------------------------------------------------------ task spec
 
 

@@ -269,6 +269,16 @@ class WorkerServer:
             self.runner.session.set(
                 "staging_prefetch_depth", int(prefetch)
             )
+        # parameterized plan cache (plan/canonical.py): the worker's
+        # share is fragment CANONICALIZATION — literal-variant fragments
+        # of one shape hit this runner's compile cache — gated by the
+        # same tier-1 keys as the coordinator
+        pcen = config.get("plan.cache-enabled") if config else None
+        if pcen is not None:
+            self.runner.session.set("enable_plan_cache", bool(pcen))
+        pce = config.get("plan.cache-entries") if config else None
+        if pce is not None:
+            self.runner.plan_cache.resize(int(pce))
         self.tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._shutting_down = False

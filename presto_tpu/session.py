@@ -240,6 +240,20 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _positive("dynamic_filtering_ndv_limit"),
         ),
         PropertyMetadata(
+            "enable_plan_cache",
+            "Parameterized plan cache + compiled-fragment reuse "
+            "(plan/canonical.py): comparison/filter/projection literals "
+            "hoist out of plans into runtime device inputs, so "
+            "structurally identical statements reuse one planned and "
+            "ONE compiled program, and warm PREPARE/EXECUTE does zero "
+            "planning and zero compilation. False = pre-cache "
+            "behavior: every literal variant plans and compiles its "
+            "own program (bit-exact legacy path). Tier-1 twins: "
+            "plan.cache-enabled, plan.cache-entries",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "query_max_run_time_s",
             "Per-query wall-clock limit (seconds)",
             float,
@@ -395,6 +409,11 @@ class NodeConfig:
         "exchange.spool-path": str,
         "exchange.spool-bytes": str,
         "exchange.spool-ttl-s": float,
+        # parameterized plan cache (plan/canonical.py): LRU entry bound
+        # of the statement-level cache, and the enable_plan_cache
+        # session default seed
+        "plan.cache-entries": int,
+        "plan.cache-enabled": bool,
         # seeds the session retry_policy default (NONE | TASK | QUERY)
         "retry-policy": str,
         # worker drain: how long a draining worker waits for running

@@ -338,6 +338,26 @@ class ParamMarker(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class BoundParam(Node):
+    """A canonicalized literal (plan/canonical.py): a comparison-operand
+    NumberLit/DateLit hoisted out of the statement so structurally
+    identical queries share one parse->plan->compile artifact. The
+    analyzer lowers it to an ``expr.RuntimeParam`` — a device input of
+    the compiled program — never to a constant.
+
+    ``lit`` (the original literal node) is excluded from repr/compare on
+    purpose: two statements differing only in hoisted literal VALUES
+    must produce equal — and equally-printed — canonical ASTs, which is
+    what the plan-cache key hashes. ``dtype_name`` keeps the value's
+    TYPE in the key (int vs double vs decimal(p,s) literals plan
+    differently, so they must not share an entry)."""
+
+    ordinal: int
+    dtype_name: str
+    lit: Node = dataclasses.field(repr=False, compare=False, default=None)
+
+
+@dataclasses.dataclass(frozen=True)
 class Insert(Node):
     """INSERT INTO target (SELECT ... | VALUES (...), ...). ``values``
     rows hold literal expression nodes."""

@@ -1,0 +1,477 @@
+"""Parameterized plan cache + compiled-fragment reuse
+(plan/canonical.py): canonical-form equality across literal variants,
+on/off bit-exactness, dtype bucketing, PREPARE/EXECUTE zero-recompile,
+write-path invalidation, distributed fragment reuse, concurrency, and
+the tools/check_plan_params.py lint wiring."""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import create_connector
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager
+from presto_tpu.plan import canonical
+from presto_tpu.plan.planner import plan_statement
+from presto_tpu.sql import parse_statement
+from presto_tpu.utils.metrics import REGISTRY
+
+
+def _misses() -> int:
+    return int(REGISTRY.counter("compile.cache_miss").total)
+
+
+def _plan_hits() -> int:
+    return int(REGISTRY.counter("plan.cache_hit").total)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def runner_off():
+    r = LocalQueryRunner()
+    r.session.set("enable_plan_cache", "false")
+    return r
+
+
+# ------------------------------------------------------- canonical form
+
+
+def test_canonical_fingerprint_equal_across_literals(runner):
+    q = (
+        "select l_returnflag, count(*) c from tpch.tiny.lineitem "
+        "where l_quantity < {} group by l_returnflag"
+    )
+    roots = []
+    vals = []
+    for v in (24, 30):
+        plan = plan_statement(
+            parse_statement(q.format(v)), runner.catalogs, runner.session
+        )
+        croot, pvals = canonical.hoist_params(plan.root)
+        roots.append(croot)
+        vals.append(pvals)
+    assert roots[0].fingerprint() == roots[1].fingerprint()
+    assert [int(v) for v in vals[0]] != [int(v) for v in vals[1]]
+
+
+def test_dtype_boundary_params_bucket_separately(runner):
+    # int64 vs decimal literals are DIFFERENT canonical forms — a
+    # param's dtype is program structure, never a silent cast
+    q = "select count(*) c from tpch.tiny.orders where o_totalprice < {}"
+    fps = []
+    for v in ("100000", "100000.5"):
+        plan = plan_statement(
+            parse_statement(q.format(v)), runner.catalogs, runner.session
+        )
+        croot, _ = canonical.hoist_params(plan.root)
+        fps.append(croot.fingerprint())
+    assert fps[0] != fps[1]
+    # and the statement-level keys differ the same way
+    k1, _, _ = canonical.canonicalize_statement(
+        parse_statement(q.format("100000")), runner.session
+    )
+    k2, _, _ = canonical.canonicalize_statement(
+        parse_statement(q.format("100000.5")), runner.session
+    )
+    k3, _, _ = canonical.canonicalize_statement(
+        parse_statement(q.format("200000")), runner.session
+    )
+    assert k1 != k2
+    assert k1 == k3
+
+
+def test_statement_key_string_literals_stay_distinct(runner):
+    q = (
+        "select count(*) c from tpch.tiny.orders "
+        "where o_orderpriority = '{}'"
+    )
+    k1, _, v1 = canonical.canonicalize_statement(
+        parse_statement(q.format("1-URGENT")), runner.session
+    )
+    k2, _, _ = canonical.canonicalize_statement(
+        parse_statement(q.format("2-HIGH")), runner.session
+    )
+    # strings are not parameterized: distinct values key distinct
+    # entries (correct, just less sharing) and hoist no values
+    assert k1 != k2
+    assert v1 == []
+
+
+def test_compile_cache_hit_across_literal_variants(runner):
+    q = (
+        "select l_returnflag, count(*) c, sum(l_extendedprice) s "
+        "from tpch.tiny.lineitem where l_quantity < {} "
+        "group by l_returnflag order by l_returnflag"
+    )
+    runner.execute(q.format(24))
+    m0 = _misses()
+    res = runner.execute(q.format(30))
+    assert _misses() == m0, "literal variant must not recompile"
+    assert res.rows()  # and it really ran
+
+
+# ------------------------------------------------------ on/off equality
+
+_EQUIV_QUERIES = [
+    # range filter + aggregation + decimal projection arithmetic
+    "select l_returnflag, count(*) c, sum(l_extendedprice * (1 - "
+    "l_discount)) rev from tpch.tiny.lineitem where l_quantity < 24 "
+    "group by l_returnflag order by l_returnflag",
+    # BETWEEN over decimals + date comparison
+    "select count(*) c from tpch.tiny.lineitem where l_discount "
+    "between 0.05 and 0.07 and l_shipdate < date '1996-01-01'",
+    # IN list over integers, negated IN, negative literal
+    "select count(*) c from tpch.tiny.lineitem where l_linenumber in "
+    "(1, 2, 3) and l_suppkey not in (5, 7) and l_quantity > -5",
+    # string equality + LIKE stay constants beside hoisted numerics
+    "select count(*) c from tpch.tiny.orders where o_orderpriority = "
+    "'1-URGENT' and o_comment like '%special%' and o_totalprice < "
+    "150000.5",
+    # join + HAVING (the Q18 shape, scaled down)
+    "select o_orderkey, sum(l_quantity) q from tpch.tiny.orders, "
+    "tpch.tiny.lineitem where o_orderkey = l_orderkey and "
+    "o_totalprice > 400000 group by o_orderkey having "
+    "sum(l_quantity) > 250 order by q desc limit 5",
+    # scalar subquery (hoisting inside the subquery's WHERE too)
+    "select count(*) c from tpch.tiny.part where p_retailprice > "
+    "(select avg(p_retailprice) from tpch.tiny.part where p_size < 25)",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(_EQUIV_QUERIES)))
+def test_on_off_equivalence(runner, runner_off, qi):
+    q = _EQUIV_QUERIES[qi]
+    assert runner.execute(q).rows() == runner_off.execute(q).rows()
+
+
+def test_null_literal_not_parameterized(runner, runner_off):
+    # NULL comparisons keep their structure (validity lanes differ)
+    q = (
+        "select count(*) c from tpch.tiny.orders "
+        "where o_custkey = null or o_totalprice < 100000"
+    )
+    assert runner.execute(q).rows() == runner_off.execute(q).rows()
+
+
+# --------------------------------------------- PREPARE/EXECUTE fast lane
+
+
+def test_execute_warm_is_zero_recompile(runner):
+    # acceptance criterion: EXECUTE of a prepared statement with FRESH
+    # literals is a plan.cache_hit + compile.cache_hit — zero recompile
+    runner.execute(
+        "prepare pc_t1 from select count(*) c from tpch.tiny.orders "
+        "where o_totalprice < ?"
+    )
+    runner.execute("execute pc_t1 using 100000")  # cold: plan + compile
+    m0, h0 = _misses(), _plan_hits()
+    res = runner.execute("execute pc_t1 using 150000")
+    assert _misses() == m0, "warm EXECUTE must not compile"
+    assert _plan_hits() > h0, "warm EXECUTE must hit the plan cache"
+    # the fresh literal really applied (not a stale cached value)
+    off = LocalQueryRunner()
+    off.session.set("enable_plan_cache", "false")
+    expect = off.execute(
+        "select count(*) c from tpch.tiny.orders "
+        "where o_totalprice < 150000"
+    ).rows()
+    assert res.rows() == expect
+
+
+def test_execute_argument_validation(runner):
+    runner.execute(
+        "prepare pc_t2 from select count(*) c from tpch.tiny.region "
+        "where r_regionkey < ?"
+    )
+    with pytest.raises(Exception, match="parameter"):
+        runner.execute("execute pc_t2 using 1, 2")
+    runner.execute("deallocate prepare pc_t2")
+    with pytest.raises(Exception, match="not found"):
+        runner.execute("execute pc_t2 using 1")
+
+
+# ----------------------------------------------------- write invalidation
+
+
+@pytest.fixture()
+def mem_runner():
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    mem = create_connector("memory")
+    from presto_tpu.connectors.spi import TableHandle
+
+    mem.create_table(
+        TableHandle("mem", "default", "kv"),
+        {"k": T.BIGINT, "v": T.VARCHAR},
+    )
+    catalogs.register("mem", mem)
+    return LocalQueryRunner(catalogs=catalogs)
+
+
+def test_insert_visible_through_cached_plan(mem_runner):
+    r = mem_runner
+    r.execute("insert into mem.default.kv values (1, 'one'), (2, 'two')")
+    q = "select count(*) c from mem.default.kv where k < {}"
+    assert r.execute(q.format(10)).rows() == [(2,)]
+    r.execute("insert into mem.default.kv values (3, 'three')")
+    # same canonical shape, fresh data: the plan cache entry survives
+    # (schema unchanged) but the split cache invalidated, so the new
+    # row is visible
+    assert r.execute(q.format(10)).rows() == [(3,)]
+    assert r.execute(q.format(3)).rows() == [(2,)]
+
+
+def test_drop_recreate_invalidates_plan_cache(mem_runner):
+    r = mem_runner
+    r.execute("insert into mem.default.kv values (1, 'one')")
+    q = "select k from mem.default.kv where k < {} order by k"
+    assert r.execute(q.format(5)).rows() == [(1,)]
+    entries0 = r.plan_cache.stats()["entries"]
+    assert entries0 >= 1
+    r.execute("drop table mem.default.kv")
+    # every entry over the dropped table is gone
+    assert r.plan_cache.stats()["entries"] < entries0
+    # recreate with a DIFFERENT schema: the same query text must plan
+    # against the new table, not a stale cached plan
+    r.execute("create table mem.default.kv (k double, x bigint)")
+    r.execute("insert into mem.default.kv values (0.5, 7)")
+    assert r.execute(q.format(5)).rows() == [(0.5,)]
+
+
+# ------------------------------------------------------------ LRU bounds
+
+
+def test_lru_eviction_bounded_entries():
+    r = LocalQueryRunner(plan_cache_entries=2)
+    ev0 = int(REGISTRY.counter("plan.cache_evict").total)
+    qs = [
+        "select count(*) c from tpch.tiny.region where r_regionkey < 3",
+        "select count(*) c from tpch.tiny.nation where n_nationkey < 7",
+        "select r_name from tpch.tiny.region where r_regionkey = 1",
+    ]
+    for q in qs:
+        r.execute(q)
+    assert r.plan_cache.stats()["entries"] <= 2
+    assert int(REGISTRY.counter("plan.cache_evict").total) > ev0
+    # evicted shapes still execute correctly (they just replan)
+    assert r.execute(qs[0]).rows() == [(3,)]
+
+
+# ----------------------------------------------------------- concurrency
+
+
+def test_concurrent_literal_variants_compile_once():
+    r = LocalQueryRunner()
+    r.execute(
+        "prepare pc_cc from select count(*) c from tpch.tiny.region "
+        "where r_regionkey < ?"
+    )
+    m0 = _misses()
+    results = {}
+    errors = []
+    barrier = threading.Barrier(10)
+
+    def client(i):
+        try:
+            barrier.wait(timeout=30)
+            for j in range(5):
+                v = (i * 5 + j) % 50
+                rows = r.execute(f"execute pc_cc using {v}").rows()
+                results[(i, j)] = (v, rows)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(10)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    # 50 literal-variants of one shape: exactly ONE compile
+    assert _misses() - m0 == 1
+    for (i, j), (v, rows) in results.items():
+        assert rows == [(min(v, 5),)], (i, j, v)
+
+
+# ----------------------------------------------- observability surfaces
+
+
+def test_plan_cache_hit_in_history_and_caches_view(runner):
+    q = "select count(*) c from tpch.tiny.nation where n_regionkey < {}"
+    runner.execute(q.format(2))
+    runner.execute(q.format(4))
+    hist = {s.sql: s for s in runner.history.snapshot()}
+    assert hist[q.format(4)].plan_cache_hit is True
+    assert hist[q.format(4)].to_dict()["plan_cache_hit"] is True
+    rows = runner.execute(
+        "select cache, entries, hits from system.runtime.caches"
+    ).rows()
+    caches = {r[0]: r for r in rows}
+    assert "plan.cache" in caches
+    assert caches["plan.cache"][1] >= 1  # entries
+    assert caches["plan.cache"][2] >= 1  # hits
+
+
+def test_explain_analyze_keeps_literals(runner):
+    text = "\n".join(
+        r[0]
+        for r in runner.execute(
+            "explain analyze select count(*) c from tpch.tiny.region "
+            "where r_regionkey < 3"
+        ).rows()
+    )
+    # analyzed plans keep literals in place: the rendered predicate
+    # shows the query's actual value, never a parameter slot
+    assert "3" in text
+    assert "?p" not in text
+
+
+def test_canonicalize_ms_metric_recorded(runner):
+    runner.execute("select count(*) c from tpch.tiny.region")
+    names = [n for n, _k, _v in REGISTRY.snapshot()]
+    assert any(n.startswith("plan.canonicalize_ms") for n in names)
+
+
+# ------------------------------------------------------ session off = legacy
+
+
+def test_cache_off_compiles_per_variant():
+    r = LocalQueryRunner()
+    r.session.set("enable_plan_cache", "false")
+    q = "select count(*) c from tpch.tiny.nation where n_nationkey < {}"
+    r.execute(q.format(5))
+    m0 = _misses()
+    r.execute(q.format(9))
+    # legacy behavior: every literal variant is its own program
+    assert _misses() > m0
+    assert r.plan_cache.stats()["entries"] == 0
+
+
+def test_split_pruning_connectors_bypass_statement_cache(tmp_path):
+    # hive/parquet/orc read equality/IN literals as scan constraints
+    # (partition / row-group / stripe pruning); their statements must
+    # keep literal planning — see test_hive.py's pruning assertions
+    from presto_tpu.connectors.hive import HiveConnector
+    from presto_tpu.connectors.orc import OrcConnector
+    from presto_tpu.connectors.parquet import ParquetConnector
+
+    assert create_connector("tpch").prunes_splits() is False
+    assert create_connector("memory").prunes_splits() is False
+    assert HiveConnector(str(tmp_path)).prunes_splits() is True
+    assert ParquetConnector(str(tmp_path)).prunes_splits() is True
+    assert OrcConnector(str(tmp_path)).prunes_splits() is True
+
+
+# ------------------------------------------------------------ distributed
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+
+    coord = CoordinatorServer().start()
+    workers = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    deadline = time.time() + 15
+    while time.time() < deadline and len(coord.active_workers()) < 2:
+        time.sleep(0.05)
+    assert len(coord.active_workers()) >= 2
+    client = PrestoTpuClient(coord.uri, timeout_s=300)
+    yield coord, workers, client
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def test_distributed_fragment_reuse(cluster):
+    coord, workers, client = cluster
+    q = "select count(*) c from tpch.tiny.lineitem where l_quantity < {}"
+    r1 = client.execute(q.format(24))
+    m0 = _misses()
+    r2 = client.execute(q.format(30))
+    # coordinator planned from cache AND every worker hit its compile
+    # cache on the literal-variant fragment: zero compiles anywhere
+    assert _misses() == m0
+    assert client.query_info(r2.query_id)["plan_cache_hit"] is True
+    assert r1.rows() == [(27628,)]
+    assert r2.rows() == [(34706,)]
+
+
+def test_prepared_statements_over_http(cluster):
+    coord, workers, client = cluster
+    res = client.execute(
+        "prepare pc_http from select count(*) c from tpch.tiny.orders "
+        "where o_totalprice < ?"
+    )
+    assert res.rows() == [("PREPARE",)]
+    assert "pc_http" in client.prepared  # added-prepare header absorbed
+    a = client.execute("execute pc_http using 100000")
+    m0 = _misses()
+    b = client.execute("execute pc_http using 150000")
+    assert _misses() == m0  # warm HTTP EXECUTE: zero recompile
+    assert client.query_info(b.query_id)["plan_cache_hit"] is True
+    assert a.rows() == [(2614,)]
+    assert b.rows() == [(4060,)]
+    res = client.execute("deallocate prepare pc_http")
+    assert res.rows() == [("DEALLOCATE",)]
+    assert "pc_http" not in client.prepared
+
+
+def test_prepared_header_rides_fresh_client(cluster):
+    # a SECOND client sharing nothing server-side can EXECUTE a
+    # statement it PREPAREd itself — the map rides its own headers
+    coord, workers, _ = cluster
+    from presto_tpu.server import PrestoTpuClient
+
+    c2 = PrestoTpuClient(coord.uri, timeout_s=300)
+    c2.execute(
+        "prepare pc_own from select count(*) c from tpch.tiny.nation "
+        "where n_nationkey < ?"
+    )
+    assert c2.execute("execute pc_own using 10").rows() == [(10,)]
+
+
+# ------------------------------------------------------------------ lint
+
+
+def test_check_plan_params_clean():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    import check_plan_params
+
+    assert check_plan_params.main([]) == 0
+
+
+def test_check_plan_params_flags_violations(tmp_path):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    import check_plan_params
+
+    bad = tmp_path / "rogue.py"
+    bad.write_text(
+        "from presto_tpu import expr as E\n"
+        "p = E.RuntimeParam(0, None)\n"
+        "cache = {}\n"
+    )
+    assert check_plan_params.main([str(tmp_path)]) == 1
