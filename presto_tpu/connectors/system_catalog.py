@@ -29,8 +29,10 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "state": T.VARCHAR,
             "query": T.VARCHAR,
             "trace_id": T.VARCHAR,
+            "plan_fingerprint": T.VARCHAR,
             "elapsed_ms": T.DOUBLE,
             "planning_ms": T.DOUBLE,
+            "optimization_ms": T.DOUBLE,
             "staging_ms": T.DOUBLE,
             "execution_ms": T.DOUBLE,
             "compile_cache_hit": T.BOOLEAN,
@@ -39,6 +41,13 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "input_bytes": T.BIGINT,
             "output_rows": T.BIGINT,
             "error": T.VARCHAR,
+        },
+        "query_history": {
+            "fingerprint": T.VARCHAR,
+            "query": T.VARCHAR,
+            "node_count": T.BIGINT,
+            "total_rows": T.BIGINT,
+            "updated": T.DOUBLE,
         },
         "nodes": {
             "node_id": T.VARCHAR,
@@ -136,8 +145,10 @@ class SystemConnector(Connector):
                     "state": q.state,
                     "query": q.sql.strip(),
                     "trace_id": q.trace_id,
+                    "plan_fingerprint": q.plan_fingerprint,
                     "elapsed_ms": q.elapsed_ms,
                     "planning_ms": q.planning_ms,
+                    "optimization_ms": q.optimization_ms,
                     "staging_ms": q.staging_ms,
                     "execution_ms": q.execution_ms,
                     "compile_cache_hit": q.compile_cache_hit,
@@ -162,6 +173,9 @@ class SystemConnector(Connector):
             ]
         if key == ("runtime", "caches"):
             return self._cache_rows()
+        if key == ("runtime", "query_history"):
+            store = getattr(self._runner, "history_store", None)
+            return store.snapshot() if store is not None else []
         if key == ("metadata", "catalogs"):
             names = self._runner.catalogs.names() if self._runner else []
             return [

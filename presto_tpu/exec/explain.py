@@ -1,15 +1,24 @@
 """EXPLAIN / EXPLAIN ANALYZE rendering.
 
 Reference parity: presto's EXPLAIN plan rendering and EXPLAIN ANALYZE
-stats-in-plan output (SURVEY.md §5.1).
+stats-in-plan output (SURVEY.md §5.1), extended with history-based
+statistics (PAPER.md L2): every estimate prints its provenance
+(``history`` — learned from a prior run of the same canonical shape,
+``stats`` — connector row counts, ``heuristic``), and EXPLAIN ANALYZE
+renders ``est -> actual (error ×N)`` per operator beside wall/device
+time.
 """
 
 from __future__ import annotations
 
 import time
+from typing import Dict, Optional, Tuple
 
 from presto_tpu.plan import nodes as N
-from presto_tpu.plan.optimizer import prune_columns
+from presto_tpu.plan.optimizer import (
+    estimate_rows_with_source,
+    prune_columns,
+)
 from presto_tpu.plan.planner import plan_statement
 from presto_tpu.sql import ast
 
@@ -63,32 +72,84 @@ def _describe(node: N.PlanNode) -> str:
     return type(node).__name__
 
 
-def render_plan(node: N.PlanNode, indent: int = 0, annot=None) -> str:
+def _error_factor(est: float, actual: float) -> float:
+    """Symmetric estimate-error ratio (>= 1; 1 = exact)."""
+    lo = max(min(est, actual), 1.0)
+    hi = max(max(est, actual), 1.0)
+    return hi / lo
+
+
+def render_plan(
+    node: N.PlanNode,
+    indent: int = 0,
+    annot=None,
+    est: Optional[Dict[int, Tuple[float, str]]] = None,
+) -> str:
+    """Indented plan tree. ``annot`` maps id(node) -> (actual rows,
+    capacity|None) from an instrumented run; ``est`` maps id(node) ->
+    (estimated rows, provenance). With both, lines render
+    ``rows: actual, est: N (provenance, error ×E)``; with only ``est``
+    (plain EXPLAIN), ``est rows: N (provenance)``."""
     desc = _describe(node)
+    e = est.get(id(node)) if est else None
     if annot is not None and id(node) in annot:
         rows, cap = annot[id(node)]
         if cap is None:
             desc += f"  [rows: {rows}, host root stage]"
         else:
-            desc += f"  [rows: {rows}, capacity: {cap}]"
+            extra = ""
+            if e is not None:
+                er, src = e
+                extra = (
+                    f", est: {er:.0f} ({src}, error "
+                    f"×{_error_factor(er, rows):.1f})"
+                )
+            desc += f"  [rows: {rows}{extra}, capacity: {cap}]"
+    elif e is not None:
+        er, src = e
+        desc += f"  [est rows: {er:.0f} ({src})]"
     lines = ["    " * indent + "- " + desc]
     for c in node.children():
-        lines.append(render_plan(c, indent + 1, annot))
+        lines.append(render_plan(c, indent + 1, annot, est))
     return "\n".join(lines)
 
 
-def explain_text(runner, stmt: ast.Explain) -> str:
-    plan = plan_statement(stmt.statement, runner.catalogs, runner.session)
+def _estimate_map(
+    root: N.PlanNode, catalogs
+) -> Dict[int, Tuple[float, str]]:
+    """id(node) -> (estimate, provenance) over a plan tree. Caller
+    installs the history scope; a failing estimator never fails
+    EXPLAIN."""
+    out: Dict[int, Tuple[float, str]] = {}
+    stats_memo: dict = {}
+    for n in N.walk(root):
+        try:
+            out[id(n)] = estimate_rows_with_source(
+                n, catalogs, stats_memo
+            )
+        except Exception:
+            pass
+    return out
+
+
+def explain_text(runner, stmt: ast.Explain, sql: str = "") -> str:
+    with runner._history_scope():
+        plan = plan_statement(
+            stmt.statement, runner.catalogs, runner.session
+        )
     if not stmt.analyze:
-        return render_plan(prune_columns(plan.root))
+        root = prune_columns(plan.root)
+        with runner._history_scope():
+            est = _estimate_map(root, runner.catalogs)
+        return render_plan(root, est=est)
     # EXPLAIN ANALYZE: re-run with per-node row counters traced as extra
     # program outputs (stats.py); render rows inline like the reference.
     # The runner returns the exact trees it executed (param binding may
     # rewrite the plan, so re-deriving them here could annotate the
     # wrong nodes).
     t0 = time.perf_counter()
-    result, node_stats, host_rows, root, droot, host_ops = (
-        runner.execute_plan_analyzed(plan)
+    result, node_stats, host_rows, root, droot, host_ops, est = (
+        runner.execute_plan_analyzed(plan, sql)
     )
     elapsed = time.perf_counter() - t0
     executed_order = {s.node_id: s for s in node_stats}
@@ -99,7 +160,11 @@ def explain_text(runner, stmt: ast.Explain) -> str:
             annot[id(n)] = (s.output_rows, s.output_capacity)
     for node, rows in zip(reversed(host_ops), host_rows):
         annot[id(node)] = (rows, None)
-    text = render_plan(root, annot=annot)
+    # est-vs-actual: the runner captured planning-time estimates BEFORE
+    # the instrumented run wrote its actuals to the history store — a
+    # warm run's history-fed estimates shrink the printed error factor
+    # (history-based optimization), a cold run's show the real miss
+    text = render_plan(root, annot=annot, est=est)
     n_rows = len(result.rows())
     text += (
         f"\n\nEXPLAIN ANALYZE: {n_rows} rows in {elapsed * 1000:.1f} ms "
@@ -128,12 +193,65 @@ def render_span_tree(trace, indent: int = 0) -> str:
     return "\n".join(out)
 
 
-def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
+def _operator_lines(qstats, est_by_fp=None) -> list:
+    """Per-operator rollup lines: ``est -> actual (error ×N)`` beside
+    wall/device time and the peak page footprint, from the query's
+    merged OperatorStats (canonical-fingerprint keyed, so split tasks
+    of one stage sum into full totals)."""
+    ops = (
+        qstats.all_operator_stats()
+        if hasattr(qstats, "all_operator_stats")
+        else []
+    )
+    if not ops:
+        return []
+    lines = ["", "Operators (est -> actual, canonical rollup):"]
+    for op in ops:
+        e = (est_by_fp or {}).get(op.fingerprint)
+        if e is not None:
+            er, src = e
+            est_part = (
+                f"est {er:.0f} rows ({src}) -> actual "
+                f"{op.output_rows} rows (error "
+                f"×{_error_factor(er, op.output_rows):.1f})"
+            )
+        else:
+            est_part = f"actual {op.output_rows} rows"
+        lines.append(
+            "  " + "  " * op.depth + f"{op.label}: {est_part}, "
+            f"wall {op.wall_ms:.1f} ms, device {op.device_ms:.1f} ms, "
+            f"peak {op.peak_page_bytes} B, batches {op.batches}"
+        )
+    return lines
+
+
+def render_distributed_analyze(
+    root, qstats, trace, n_rows: int, runner=None
+) -> str:
     """Distributed EXPLAIN ANALYZE: the fragment-less plan tree plus
-    the per-stage/per-task stats rollup and the query's span tree —
-    the same data ``GET /v1/query/{id}`` serves, rendered as text
-    (reference: EXPLAIN ANALYZE's stats-in-plan output applied to the
-    distributed tier)."""
+    the per-stage/per-task stats rollup, the per-operator est-vs-actual
+    rollup, and the query's span tree — the same data
+    ``GET /v1/query/{id}`` serves, rendered as text (reference: EXPLAIN
+    ANALYZE's stats-in-plan output applied to the distributed tier)."""
+    est_by_fp: Dict[str, Tuple[float, str]] = {}
+    if root is not None and runner is not None:
+        try:
+            from presto_tpu.plan import history as plan_history
+
+            stats_memo: dict = {}
+            with runner._history_scope():
+                fps = plan_history.node_fingerprints(root)
+                for n in N.walk(root):
+                    fp = fps.get(id(n), "")
+                    if fp and fp not in est_by_fp:
+                        try:
+                            est_by_fp[fp] = estimate_rows_with_source(
+                                n, runner.catalogs, stats_memo
+                            )
+                        except Exception:
+                            pass  # keep the nodes that DID estimate
+        except Exception:
+            pass  # fingerprinting failed wholesale: render without est
     lines = [render_plan(root)] if root is not None else []
     lines.append("")
     lines.append(
@@ -141,7 +259,8 @@ def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
         f"trace {qstats.trace_id}"
     )
     lines.append(
-        f"planning {qstats.planning_ms:.1f} ms, "
+        f"planning {qstats.planning_ms:.1f} ms "
+        f"(optimization {qstats.optimization_ms:.1f} ms), "
         f"execution {qstats.execution_ms:.1f} ms, "
         f"{len(qstats.stages)} stage(s)"
     )
@@ -150,6 +269,11 @@ def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
         + ("HIT" if qstats.plan_cache_hit else "MISS")
         + ", compile cache: "
         + ("HIT" if qstats.compile_cache_hit else "MISS")
+        + (
+            f", plan fingerprint: {qstats.plan_fingerprint}"
+            if qstats.plan_fingerprint
+            else ""
+        )
     )
     if (
         qstats.dynamic_filters
@@ -186,7 +310,18 @@ def render_distributed_analyze(root, qstats, trace, n_rows: int) -> str:
                 f"{t.input_rows} -> {t.output_rows}, "
                 f"bytes {t.input_bytes} -> {t.output_bytes}"
             )
-    lines.append("")
-    lines.append("Span tree:")
-    lines.append(render_span_tree(trace))
+    lines.extend(_operator_lines(qstats, est_by_fp))
+    if trace is not None:
+        lines.append("")
+        lines.append("Span tree:")
+        lines.append(render_span_tree(trace))
     return "\n".join(lines)
+
+
+def render_query_analyze(qstats) -> str:
+    """EXPLAIN-ANALYZE-style text rendered purely from a completed
+    query's OWN collected stats — no re-run (the slow-query log's
+    record body; exec/stats.SlowQueryLog)."""
+    return render_distributed_analyze(
+        None, qstats, getattr(qstats, "trace", None), qstats.output_rows
+    )

@@ -101,6 +101,8 @@ class LocalQueryRunner:
         memory_pool=None,
         staging_cache_bytes: Optional[int] = None,
         plan_cache_entries: int = 256,
+        history_path: Optional[str] = None,
+        history_max_entries: int = 256,
     ):
         from presto_tpu.exec.stats import QueryHistory
 
@@ -133,6 +135,52 @@ class LocalQueryRunner:
             from presto_tpu.exec.stats import JsonlQueryEventListener
 
             self.history.add_listener(JsonlQueryEventListener(event_log))
+        # history-based statistics store (plan/history.py): crash-safe
+        # on-disk per-operator actuals keyed by canonical plan
+        # fingerprints, registered on the SAME query-completed path as
+        # the event sink; estimate_rows consults it before connector
+        # stats (session enable_history_stats). Unconfigured = None:
+        # planning is bit-exact pre-history
+        self.history_store = None
+        hist_path = history_path or os.environ.get(
+            "PRESTO_TPU_HISTORY_PATH"
+        )
+        if hist_path:
+            from presto_tpu.plan.history import QueryHistoryStore
+
+            self.history_store = QueryHistoryStore(
+                hist_path, history_max_entries
+            )
+            self.history.add_listener(self.history_store)
+        # slow-query JSONL sidecar (exec/stats.SlowQueryLog): env hook
+        # for embedded/bench runs; servers additionally wire it from
+        # config (slow-query.threshold-ms / slow-query.path)
+        slow_path = os.environ.get("PRESTO_TPU_SLOW_QUERY_LOG")
+        if slow_path:
+            try:
+                slow_ms = float(
+                    os.environ.get("PRESTO_TPU_SLOW_QUERY_MS", "0")
+                )
+            except ValueError:
+                slow_ms = 0.0
+            if slow_ms > 0:
+                from presto_tpu.exec.stats import SlowQueryLog
+
+                self.history.add_listener(
+                    SlowQueryLog(slow_path, slow_ms)
+                )
+            else:
+                # a path without a positive threshold would register a
+                # listener that can never fire — refuse loudly, like
+                # the server config path does
+                import warnings
+
+                warnings.warn(
+                    "PRESTO_TPU_SLOW_QUERY_LOG is set but "
+                    "PRESTO_TPU_SLOW_QUERY_MS is missing or <= 0; "
+                    "the slow-query log is disabled",
+                    stacklevel=2,
+                )
         self._compiled: Dict[object, object] = {}
         # one entry-creation lock: 50 concurrent literal-variants of one
         # shape must produce ONE jitted closure (and so one XLA
@@ -226,7 +274,7 @@ class LocalQueryRunner:
         if isinstance(stmt, ast.Explain):
             from presto_tpu.exec.explain import explain_text
 
-            text = explain_text(self, stmt)
+            text = explain_text(self, stmt, sql)
             return QueryResult(("Query Plan",), _lines_page(text))
         if isinstance(stmt, ast.ShowSession):
             from presto_tpu.session import SYSTEM_SESSION_PROPERTIES
@@ -302,10 +350,11 @@ class LocalQueryRunner:
                     if isinstance(stmt, ast.Select):
                         plan, qs.plan_cache_hit = self.plan_cached(stmt)
                     else:
-                        plan = plan_statement(
-                            stmt, self.catalogs, self.session
-                        )
+                        plan = self._plan_statement(stmt)
                 qs.planning_ms = (time.perf_counter() - t0) * 1000.0
+                REGISTRY.distribution("plan.planning_ms").add(
+                    qs.planning_ms
+                )
                 qs.state = "RUNNING"
                 with trace.span("execute"):
                     result = self.execute_plan(plan, qs=qs)
@@ -576,8 +625,30 @@ class LocalQueryRunner:
         if isinstance(bound, ast.Select):
             plan, _hit = self.plan_cached(bound)
         else:
-            plan = plan_statement(bound, self.catalogs, self.session)
+            plan = self._plan_statement(bound)
         return self.execute_plan(plan)
+
+    def _history_scope(self):
+        """History-based-statistics planning scope: installs the
+        configured store as the thread-local provider estimate_rows
+        consults (plan/history.py), gated on session
+        ``enable_history_stats``. No store / flag off = null scope —
+        planning math bit-exact pre-history."""
+        import contextlib
+
+        from presto_tpu.plan import history as plan_history
+
+        if self.history_store is None or not self.session.get(
+            "enable_history_stats"
+        ):
+            return contextlib.nullcontext()
+        return plan_history.using(self.history_store)
+
+    def _plan_statement(self, stmt) -> Plan:
+        """plan_statement under the history scope — the one audited
+        planning entry for runner-owned statements."""
+        with self._history_scope():
+            return plan_statement(stmt, self.catalogs, self.session)
 
     def plan_cached(self, stmt) -> Tuple[Plan, bool]:
         plan, hit = self._plan_cached(stmt)
@@ -606,7 +677,7 @@ class LocalQueryRunner:
 
         if not self.session.get("enable_plan_cache"):
             return (
-                plan_statement(stmt, self.catalogs, self.session),
+                self._plan_statement(stmt),
                 False,
             )
         t0 = time.perf_counter()
@@ -617,7 +688,7 @@ class LocalQueryRunner:
         except Exception:
             # canonicalization must never fail a query
             return (
-                plan_statement(stmt, self.catalogs, self.session),
+                self._plan_statement(stmt),
                 False,
             )
         finally:
@@ -639,17 +710,17 @@ class LocalQueryRunner:
             )
         if entry is canonical.BYPASS:
             return (
-                plan_statement(stmt, self.catalogs, self.session),
+                self._plan_statement(stmt),
                 False,
             )
         try:
-            plan = plan_statement(canon, self.catalogs, self.session)
+            plan = self._plan_statement(canon)
         except Exception:
             # parameterized planning failed (hoisted literal in a
             # structural position): permanent literal-form lane
             self.plan_cache.put(key, canonical.BYPASS)
             return (
-                plan_statement(stmt, self.catalogs, self.session),
+                self._plan_statement(stmt),
                 False,
             )
         handles = canonical.plan_handles(plan)
@@ -666,7 +737,7 @@ class LocalQueryRunner:
             # constraints agree)
             self.plan_cache.put(key, canonical.BYPASS)
             return (
-                plan_statement(stmt, self.catalogs, self.session),
+                self._plan_statement(stmt),
                 False,
             )
         root, preopt = plan.root, False
@@ -786,7 +857,29 @@ class LocalQueryRunner:
         try:
             root = self._bind_params(plan)
             if not plan.preoptimized:
-                root = push_scan_constraints(prune_columns(root))
+                t_opt = time.perf_counter()
+                with self._history_scope():
+                    root = push_scan_constraints(prune_columns(root))
+                if qs is not None and hasattr(qs, "optimization_ms"):
+                    qs.optimization_ms += (
+                        time.perf_counter() - t_opt
+                    ) * 1000.0
+            if (
+                qs is not None
+                and hasattr(qs, "plan_fingerprint")
+                and not qs.plan_fingerprint
+                and self.session.get("enable_operator_stats")
+            ):
+                # canonical statement identity: keys the history-store
+                # record and enriches the query-completed event
+                try:
+                    from presto_tpu.plan import history as plan_history
+
+                    qs.plan_fingerprint = plan_history.plan_fingerprint(
+                        root
+                    )
+                except Exception:
+                    pass
             host_ops: List[N.PlanNode] = []
             if self.session.get("host_root_stage"):
                 root, host_ops = peel_host_ops(root)
@@ -802,13 +895,14 @@ class LocalQueryRunner:
             self._bound_local.value = prev_bound
         return QueryResult(plan.output_names, page)
 
-    def execute_plan_analyzed(self, plan: Plan):
+    def execute_plan_analyzed(self, plan: Plan, sql: str = ""):
         """EXPLAIN ANALYZE support: run the plan exactly as execute_plan
         does (including the host root stage peel) with per-node row
         counters traced as extra program outputs. Returns
         (QueryResult, List[PlanNodeStats] for the device tree,
         List[int] rows-after-each-host-op innermost-first,
-        bound pre-peel root, device root executed, host ops peeled) —
+        bound pre-peel root, device root executed, host ops peeled,
+        id(node) -> (planning-time estimate, provenance) map) —
         the trees are returned so EXPLAIN ANALYZE annotates the exact
         nodes that ran (param binding may rewrite the plan, so
         re-deriving them can diverge; peel preserves node identity, so
@@ -826,6 +920,15 @@ class LocalQueryRunner:
         if self.session.get("host_root_stage"):
             root, host_ops = peel_host_ops(root)
         scans = [n for n in N.walk(root) if isinstance(n, N.TableScanNode)]
+        # PLANNING-time estimates, captured BEFORE the instrumented run
+        # (and before its actuals reach the history store): the
+        # est-vs-actual error EXPLAIN ANALYZE prints must reflect what
+        # the optimizer believed going in — a warm run's history-fed
+        # estimates shrink that error, a cold run's do not
+        from presto_tpu.exec.explain import _estimate_map
+
+        with self._history_scope():
+            est_map = _estimate_map(root, self.catalogs)
         pages = [self._load_table(s) for s in scans]
         stats_cell: List = []
         page = LocalQueryRunner._run_with_pages(
@@ -835,6 +938,7 @@ class LocalQueryRunner:
         if host_ops:
             page = apply_host_ops(page, host_ops, rows_out=host_rows)
         stats = collect_node_stats(stats_cell)
+        self._record_history(root, stats, stmt_root=bound_root, sql=sql)
         return (
             QueryResult(plan.output_names, page),
             stats,
@@ -842,7 +946,51 @@ class LocalQueryRunner:
             bound_root,
             root,
             host_ops,
+            est_map,
         )
+
+    def _record_history(
+        self,
+        droot: N.PlanNode,
+        stats,
+        stmt_root: Optional[N.PlanNode] = None,
+        sql: str = "",
+    ) -> None:
+        """Persist an analyzed run's per-node actuals to the history
+        store — the EXPLAIN ANALYZE twin of the query-completed write
+        path (the explain branch never creates a QueryStats, but its
+        instrumented run measured the same truth). The statement key
+        comes from ``stmt_root`` — the PRE-peel bound root, the same
+        tree execute_plan fingerprints — so an analyzed run updates
+        the normal run's index entry instead of forking a second one
+        when host ops were peeled."""
+        if self.history_store is None:
+            return
+        try:
+            from presto_tpu.plan import history as plan_history
+
+            fps = plan_history.node_fingerprints(droot)
+            by_walk = {i: n for i, n in enumerate(N.walk(droot))}
+            nodes = {}
+            for s in stats:
+                n = by_walk.get(s.node_id)
+                if n is None or s.output_rows < 0:
+                    continue
+                fp = fps.get(id(n), "")
+                if fp:
+                    nodes[fp] = {
+                        "rows": int(s.output_rows),
+                        "label": s.label,
+                    }
+            self.history_store.record_query(
+                plan_history.plan_fingerprint(
+                    droot if stmt_root is None else stmt_root
+                ),
+                sql,
+                nodes,
+            )
+        except Exception:
+            pass  # a broken store must never fail EXPLAIN ANALYZE
 
     # ------------------------------------------------- params (subqueries)
 
@@ -1077,8 +1225,22 @@ class LocalQueryRunner:
         ``(device_page_rebucketed, n)`` instead of a host page."""
         scan_ids = {id(s): i for i, s in enumerate(scans)}
         analyzed = stats_out is not None
+        # per-operator observability (exec/stats.OperatorStats): trace
+        # the per-node row counters on EVERY run, not just EXPLAIN
+        # ANALYZE — the history store and QueryInfo read them. Part of
+        # the compile key: flipping enable_operator_stats compiles the
+        # exact pre-PR program (no counter outputs)
+        counted = analyzed or bool(
+            self.session.get("enable_operator_stats")
+        )
         from presto_tpu.plan import canonical
 
+        # program-instance token for operator-stats folding: streamed
+        # batches re-enter with the SAME root object (their folds sum),
+        # while distinct programs of one query — scalar-subquery
+        # pre-passes, sibling fragments — are different objects even
+        # when their shapes (and walk positions) coincide
+        prog_root = root
         tries = 0
         while True:
             # key by structural fingerprint, not object identity: every
@@ -1136,7 +1298,7 @@ class LocalQueryRunner:
                 for o, nn in zip(orig_leaves, new_leaves):
                     if id(o) in scan_ids:
                         cscan_ids[id(nn)] = scan_ids[id(o)]
-            key = (cfp, analyzed, offload)
+            key = (cfp, analyzed, counted, offload)
             with self._compile_mu:
                 entry = self._compiled.get(key)
                 fresh = entry is None
@@ -1155,13 +1317,13 @@ class LocalQueryRunner:
                         flags: List = []
                         errors: List = []
                         counters: Optional[List] = (
-                            [] if analyzed else None
+                            [] if counted else None
                         )
                         dyn: List = []
                         with canonical.active_params(params_in):
                             out = _execute_node(
                                 _root, pages_in, _ids, flags, errors,
-                                counters, dyn,
+                                counters, dyn, count_all=analyzed,
                             )
                             # program boundary: host materialization /
                             # exchanges need prefix form (lazy selection
@@ -1172,20 +1334,57 @@ class LocalQueryRunner:
                         _n.clear()
                         if counters is not None:
                             from presto_tpu.exec.stats import node_label
+                            from presto_tpu.plan import (
+                                history as plan_history,
+                            )
 
                             walk_ids = {
                                 id(n): i
                                 for i, n in enumerate(N.walk(_root))
                             }
+                            depths = _node_depths(_root)
+                            try:
+                                # canonical sub-fingerprints: the
+                                # history keys of these operators
+                                # (computed ONCE per compile)
+                                fps = plan_history.node_fingerprints(
+                                    _root
+                                )
+                            except Exception:
+                                fps = {}
+                            counted_ids = {
+                                id(node) for node, _, _, _ in counters
+                            }
+
+                            def child_walks(n):
+                                # nearest COUNTED descendants: with
+                                # cardinality-preserving nodes skipped
+                                # on the always-on path, a join's
+                                # input_rows still sums its sides'
+                                # real row sources
+                                out_ids = []
+                                for c in n.children():
+                                    if id(c) in counted_ids:
+                                        out_ids.append(
+                                            walk_ids.get(id(c), -1)
+                                        )
+                                    else:
+                                        out_ids.extend(child_walks(c))
+                                return out_ids
+
                             _n.extend(
                                 (
                                     walk_ids.get(id(node), -1),
                                     node_label(node),
                                     cap,
+                                    nbytes,
+                                    depths.get(id(node), 0),
+                                    fps.get(id(node), ""),
+                                    tuple(child_walks(node)),
                                 )
-                                for node, _, cap in counters
+                                for node, _, cap, nbytes in counters
                             )
-                            cnts = [c for _, c, _ in counters]
+                            cnts = [c for _, c, _, _ in counters]
                         else:
                             cnts = []
                         # stack control outputs: ONE device->host fetch
@@ -1211,6 +1410,7 @@ class LocalQueryRunner:
             if fresh and self._active_qs is not None:
                 self._active_qs.compile_cache_hit = False
             fn, msgs_cell, nodes_cell = entry
+            t_disp = time.perf_counter()
             try:
                 with self._device_scope():
                     page, flags_arr, err_arr, cnt_arr, dyn_arr = fn(
@@ -1252,7 +1452,9 @@ class LocalQueryRunner:
             ]
             if spec > 0:
                 leaves.extend(page.prefix_leaves(spec))
+            t_disped = time.perf_counter()
             fetched = jax.device_get(leaves)
+            t_fetched = time.perf_counter()
             flags_np, err_np, cnt_np, dyn_np, n_out = fetched[:5]
             for msg, flag in zip(msgs_cell, err_np):
                 if bool(flag):
@@ -1262,9 +1464,21 @@ class LocalQueryRunner:
                     stats_out.clear()
                     stats_out.extend(
                         (walk_id, label, int(c), cap)
-                        for (walk_id, label, cap), c in zip(
-                            nodes_cell, cnt_np
-                        )
+                        for (
+                            walk_id, label, cap, _nb, _dp, _fp, _ch
+                        ), c in zip(nodes_cell, cnt_np)
+                    )
+                if counted and nodes_cell:
+                    # fold per-operator actuals into the active stats
+                    # sink (TaskStats on workers, QueryStats locally);
+                    # only the SUCCESSFUL run counts — overflow retries
+                    # re-execute the same rows
+                    self._fold_operator_stats(
+                        nodes_cell,
+                        cnt_np,
+                        wall_ms=(t_fetched - t_disp) * 1000.0,
+                        device_ms=(t_fetched - t_disped) * 1000.0,
+                        prog=prog_root,
                     )
                 if dyn_np.size:
                     # attribute only on the SUCCESSFUL run: overflow
@@ -1316,6 +1530,78 @@ class LocalQueryRunner:
                     setattr(qs, attr, getattr(qs, attr) + n)
             else:
                 setattr(qs, attr, getattr(qs, attr) + n)
+
+    def _fold_operator_stats(
+        self,
+        cells,
+        counts,
+        wall_ms: float,
+        device_ms: float,
+        prog=None,
+    ) -> None:
+        """Merge one program execution's per-node actuals into the
+        active stats sink's ``operators`` list, keyed by node instance
+        (program identity + walk position + canonical sub-fingerprint)
+        — streamed/worker batches of one program SUM into the same
+        OperatorStats, while same-shape nodes in DIFFERENT programs of
+        one query (scalar-subquery pre-passes reuse walk positions)
+        stay separate instead of teaching the history store multiplied
+        rows. ``prog`` is pinned on the sink so its id can't be reused
+        by a later program's tree within the query. The whole program's dispatch->
+        fetch window is attributed to the program ROOT operator (XLA
+        fuses across operator boundaries; there is no per-operator
+        device clock). Locked like every other shared-sink fold."""
+        from presto_tpu.exec.stats import OperatorStats
+
+        qs = self._active_qs
+        if qs is None or not hasattr(qs, "operators"):
+            return
+        rows_by_walk = {
+            cell[0]: int(c) for cell, c in zip(cells, counts)
+        }
+        root_walk = min(rows_by_walk)
+        with self._qs_mu:
+            index = qs.__dict__.get("_op_index")
+            if index is None:
+                index = {}
+                qs.__dict__["_op_index"] = index
+            if prog is not None:
+                qs.__dict__.setdefault("_op_pins", {})[
+                    id(prog)
+                ] = prog
+            for (
+                walk_id, label, cap, nbytes, depth, fp, child_ids
+            ), c in zip(cells, counts):
+                # instance key: batches of ONE program sum (same
+                # program + walk position), while two distinct
+                # same-shape nodes — a self-join's two scans in one
+                # program, or the same subtree across sibling
+                # programs — stay separate; summing them would teach
+                # the history store a multiple of the true cardinality
+                key = (id(prog), walk_id, fp or label)
+                op = index.get(key)
+                if op is None:
+                    op = OperatorStats(
+                        node_id=walk_id,
+                        label=label,
+                        fingerprint=fp,
+                        depth=depth,
+                    )
+                    index[key] = op
+                    qs.operators.append(op)
+                rows = int(c)
+                op.output_rows += rows
+                op.batches += 1
+                op.output_capacity = max(op.output_capacity, cap)
+                op.peak_page_bytes = max(op.peak_page_bytes, nbytes)
+                op.input_rows += (
+                    sum(rows_by_walk.get(ci, 0) for ci in child_ids)
+                    if child_ids
+                    else rows  # leaves read what they emit
+                )
+                if walk_id == root_walk:
+                    op.wall_ms += wall_ms
+                    op.device_ms += device_ms
 
     def _note_cache_hit(self) -> None:
         """Attribute one split-cache hit to the active stats sink."""
@@ -1745,6 +2031,48 @@ def _plan_weight(root: N.PlanNode) -> int:
 # ---------------------------------------------------------- trace helpers
 
 
+def _node_depths(root: N.PlanNode) -> Dict[int, int]:
+    """id(node) -> tree depth under ``root`` (operator-stats
+    rendering)."""
+    out: Dict[int, int] = {}
+
+    def rec(n: N.PlanNode, d: int) -> None:
+        out[id(n)] = d
+        for c in n.children():
+            rec(c, d + 1)
+
+    rec(root, 0)
+    return out
+
+
+def _static_page_nbytes(page: Page) -> int:
+    """Static device footprint of a (possibly traced) page: shapes and
+    dtypes are fixed at trace time, so this is exact without touching
+    any tracer value — the per-operator ``peak_page_bytes``."""
+
+    def arr(a) -> int:
+        try:
+            n = 1
+            for s in a.shape:
+                n *= int(s)
+            return n * np.dtype(a.dtype).itemsize
+        except Exception:
+            return 0
+
+    total = 0
+    for b in page.blocks:
+        total += arr(b.data)
+        if b.valid is not None:
+            total += arr(b.valid)
+        if getattr(b, "offsets", None) is not None:
+            total += arr(b.offsets)
+        for ch in getattr(b, "children", None) or ():
+            total += arr(ch.data)
+            if ch.valid is not None:
+                total += arr(ch.valid)
+    return total
+
+
 def _stack_bools(xs: List) -> jnp.ndarray:
     if not xs:
         return jnp.zeros((0,), jnp.bool_)
@@ -1757,27 +2085,58 @@ def _stack_i32(xs: List) -> jnp.ndarray:
     return jnp.stack([jnp.asarray(x, jnp.int32).reshape(()) for x in xs])
 
 
+#: nodes whose output rows carry cardinality SIGNAL (the history
+#: store's value: scan sizes, filter selectivity, join fan-out, group
+#: counts). Cardinality-preserving / structurally-bounded nodes
+#: (Project, Output, Window, Sort, Limit) are skipped on the always-on
+#: path — each traced counter keeps one more live scalar in the XLA
+#: program, and counting every node measured ~1.5x compile time on
+#: TPC-H plans. EXPLAIN ANALYZE (analyzed mode) still counts ALL nodes.
+_COUNTED_NODES = (
+    N.TableScanNode,
+    N.RemoteSourceNode,
+    N.FilterNode,
+    N.JoinNode,
+    N.CrossJoinNode,
+    N.AggregationNode,
+    N.DistinctNode,
+    N.UnnestNode,
+    N.UnionAllNode,
+)
+
+
 def _execute_node(
-    node, pages, scan_ids, flags, errors, counters=None, dyn=None
+    node, pages, scan_ids, flags, errors, counters=None, dyn=None,
+    count_all=True,
 ) -> Page:
     """Execute one plan node at trace time. ``counters``, when given,
-    accumulates (node, traced num_valid, capacity) per node — the
-    EXPLAIN ANALYZE row-count instrumentation (stats.py). ``dyn``
+    accumulates (node, traced num_valid, capacity, static bytes) per
+    counted node — the EXPLAIN ANALYZE / OperatorStats row-count
+    instrumentation (stats.py); ``count_all=False`` restricts it to
+    the cardinality-determining ``_COUNTED_NODES``. ``dyn``
     accumulates the traced pruned-row count of every dynamic
     FilterNode (dynamic_filter.rows_pruned observability)."""
     out = _execute_node_inner(
-        node, pages, scan_ids, flags, errors, counters, dyn
+        node, pages, scan_ids, flags, errors, counters, dyn, count_all
     )
-    if counters is not None:
-        counters.append((node, out.num_valid, out.capacity))
+    if counters is not None and (
+        count_all or isinstance(node, _COUNTED_NODES)
+    ):
+        # capacity and page bytes are STATIC at trace time (shapes are
+        # fixed); only the row count rides out as a program output
+        counters.append(
+            (node, out.num_valid, out.capacity,
+             _static_page_nbytes(out))
+        )
     return out
 
 
 def _execute_node_inner(
-    node, pages, scan_ids, flags, errors, counters=None, dyn=None
+    node, pages, scan_ids, flags, errors, counters=None, dyn=None,
+    count_all=True,
 ) -> Page:
     run = lambda n: _execute_node(  # noqa: E731
-        n, pages, scan_ids, flags, errors, counters, dyn
+        n, pages, scan_ids, flags, errors, counters, dyn, count_all
     )
 
     if isinstance(node, (N.TableScanNode, N.RemoteSourceNode)):

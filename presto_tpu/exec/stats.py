@@ -49,6 +49,58 @@ class PlanNodeStats:
 
 
 @dataclasses.dataclass
+class OperatorStats:
+    """One plan operator's runtime actuals (reference: OperatorStats),
+    keyed by the node's canonical sub-fingerprint (plan/history.py —
+    literal- and pruning-invariant), populated on EVERY executor tier
+    from the per-node row counters traced out of compiled programs
+    (session ``enable_operator_stats``, default on).
+
+    XLA fuses across operator boundaries on purpose, so there is no
+    per-operator device clock: ``wall_ms``/``device_ms`` carry the
+    whole program's dispatch->fetch window, attributed to the program
+    ROOT operator (interior operators report 0 — their cost is fused
+    into the root's program). Rows/bytes are exact per node."""
+
+    node_id: int  # walk index within the compiled program's root
+    label: str
+    fingerprint: str = ""  # canonical sub-fingerprint (history key)
+    depth: int = 0  # tree depth within the program root (rendering)
+    input_rows: int = 0  # sum of child operators' output rows
+    output_rows: int = 0
+    output_capacity: int = 0  # largest static bucket the rows sat in
+    wall_ms: float = 0.0  # program dispatch -> control fetch (root only)
+    device_ms: float = 0.0  # post-dispatch device wait (root only)
+    peak_page_bytes: int = 0  # largest static output-page footprint
+    batches: int = 0  # program executions folded in (streamed splits)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "OperatorStats":
+        known = {f.name for f in dataclasses.fields(OperatorStats)}
+        return OperatorStats(
+            **{k: v for k, v in d.items() if k in known}
+        )
+
+    def merge(self, other: "OperatorStats") -> None:
+        """Fold another observation of the SAME operator (a later
+        batch, or the same canonical subtree in a sibling task)."""
+        self.input_rows += other.input_rows
+        self.output_rows += other.output_rows
+        self.wall_ms += other.wall_ms
+        self.device_ms += other.device_ms
+        self.batches += other.batches
+        self.output_capacity = max(
+            self.output_capacity, other.output_capacity
+        )
+        self.peak_page_bytes = max(
+            self.peak_page_bytes, other.peak_page_bytes
+        )
+
+
+@dataclasses.dataclass
 class TaskStats:
     """One task's stats (reference: TaskStats), populated worker-side
     and shipped back in the task-status response.
@@ -92,6 +144,12 @@ class TaskStats:
     #: this attempt was a speculative (backup) launch of a straggling
     #: range — winners and losers both carry the flag in the rollup
     speculative: bool = False
+    #: per-operator actuals of this task's compiled programs, keyed by
+    #: canonical sub-fingerprint (exec/local_runner folds them in;
+    #: shipped on the status response, rolled into QueryInfo)
+    operators: List[OperatorStats] = dataclasses.field(
+        default_factory=list
+    )
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -99,9 +157,14 @@ class TaskStats:
     @staticmethod
     def from_dict(d: dict) -> "TaskStats":
         known = {f.name for f in dataclasses.fields(TaskStats)}
-        return TaskStats(
-            **{k: v for k, v in d.items() if k in known}
-        )
+        kw = {k: v for k, v in d.items() if k in known}
+        ops = kw.pop("operators", None) or []
+        ts = TaskStats(**kw)
+        ts.operators = [
+            OperatorStats.from_dict(o) if isinstance(o, dict) else o
+            for o in ops
+        ]
+        return ts
 
 
 @dataclasses.dataclass
@@ -161,6 +224,9 @@ class QueryStats:
     create_time: float = 0.0
     end_time: float = 0.0
     planning_ms: float = 0.0
+    #: optimize-pass share of planning (prune + constraint push) —
+    #: visible separately because the plan cache exists to eliminate it
+    optimization_ms: float = 0.0
     staging_ms: float = 0.0  # host->HBM page staging
     execution_ms: float = 0.0  # device program (incl. compile on miss)
     compile_cache_hit: bool = True
@@ -197,7 +263,16 @@ class QueryStats:
     input_bytes: int = 0
     output_rows: int = 0
     trace_id: str = ""
+    #: canonical plan fingerprint (plan/history.py) — the history
+    #: store's statement key, also enriching the event-sink JSONL
+    plan_fingerprint: str = ""
     node_stats: List[PlanNodeStats] = dataclasses.field(default_factory=list)
+    #: per-operator actuals attributed LOCALLY (coordinator splice /
+    #: local-runner execution); worker-task operators live on their
+    #: TaskStats and merge in via all_operator_stats()
+    operators: List[OperatorStats] = dataclasses.field(
+        default_factory=list
+    )
     stages: List[StageStats] = dataclasses.field(default_factory=list)
     #: the query's utils.tracing.Trace (None on untraced paths)
     trace: Optional[object] = None
@@ -212,6 +287,8 @@ class QueryStats:
         QueryStats summing its StageStats). Idempotent: totals are
         recomputed from scratch on top of the coordinator-local
         accumulators, so it is safe to call per status poll."""
+        # fresh task stats may change the operator rollup
+        self.__dict__.pop("_ops_dict_cache", None)
         if not self.stages:
             return
         # input/staging/retry attribution lives worker-side for
@@ -272,6 +349,68 @@ class QueryStats:
             )
             self._df_filters_from_tasks = task_filters
 
+    def all_operator_stats(self) -> List[OperatorStats]:
+        """Merged per-operator actuals across the whole query: locally
+        attributed operators plus every FINISHED worker task's. Fold
+        key is the node INSTANCE — (stage, node ordinal, fingerprint)
+        — so split tasks of one stage sum into the full scan/filter
+        totals while two distinct same-shape nodes (a self-join's two
+        scans) stay separate instead of doubling the rows the history
+        store learns. Exactly one FINISHED attempt counts per logical
+        task: failed/aborted attempts are excluded by state, and a
+        speculative loser (or a retried-but-actually-completed
+        attempt) also reports FINISHED but measured the same split
+        ranges as the winner."""
+        from presto_tpu.server.task_ids import logical_key
+
+        merged: Dict[object, OperatorStats] = {}
+        order: List[OperatorStats] = []
+
+        def fold(key: object, op: OperatorStats) -> None:
+            got = merged.get(key)
+            if got is None:
+                got = dataclasses.replace(op)
+                merged[key] = got
+                order.append(got)
+            else:
+                got.merge(op)
+
+        for i, op in enumerate(self.operators):
+            # already instance-folded by the runner (_fold_operator_
+            # stats) — never merge two local entries with one another
+            fold(("local", i), op)
+        # query-wide: logical task seqs are unique per query, so a
+        # restarted query whose retry re-mints the same ids never
+        # counts the failed attempt's FINISHED tasks a second time
+        counted = set()
+        for s in self.stages:
+            for t in s.tasks:
+                if t.state != "FINISHED":
+                    continue
+                lk = logical_key(t.task_id)
+                if lk in counted:
+                    continue
+                counted.add(lk)
+                for op in t.operators:
+                    fold(
+                        (s.stage_id, op.node_id, op.fingerprint), op
+                    )
+        return order
+
+    def _operators_dicts(self) -> List[dict]:
+        """Serialized operator rollup. The merge walks every stage/
+        task/operator, and ``to_dict`` runs on EVERY client status
+        poll — so once the query is terminal (stats final: the
+        coordinator's last ``roll_up`` happens BEFORE the terminal
+        state is stamped, and ``roll_up`` invalidates this cache) the
+        result is computed once and reused by drain polls."""
+        ops = self.__dict__.get("_ops_dict_cache")
+        if ops is None:
+            ops = [op.to_dict() for op in self.all_operator_stats()]
+            if self.state in ("FINISHED", "FAILED"):
+                self.__dict__["_ops_dict_cache"] = ops
+        return ops
+
     def to_dict(self, include_stages: bool = True) -> dict:
         out = {
             "query_id": self.query_id,
@@ -279,10 +418,12 @@ class QueryStats:
             "state": self.state,
             "error": self.error,
             "trace_id": self.trace_id,
+            "plan_fingerprint": self.plan_fingerprint,
             "create_time": self.create_time,
             "end_time": self.end_time,
             "elapsed_ms": self.elapsed_ms,
             "planning_ms": self.planning_ms,
+            "optimization_ms": self.optimization_ms,
             "staging_ms": self.staging_ms,
             "execution_ms": self.execution_ms,
             "compile_cache_hit": self.compile_cache_hit,
@@ -303,6 +444,9 @@ class QueryStats:
             "input_rows": self.input_rows,
             "input_bytes": self.input_bytes,
             "output_rows": self.output_rows,
+            # per-operator actuals (merged local + worker tasks): the
+            # history store's write path reads this same record
+            "operators": self._operators_dicts(),
         }
         if include_stages:
             out["stages"] = [s.to_dict() for s in self.stages]
@@ -338,6 +482,51 @@ class JsonlQueryEventListener:
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
         line = json.dumps(event.to_dict(), default=str)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+
+class SlowQueryLog:
+    """Query-completed listener that appends queries exceeding
+    ``threshold_ms`` wall time to a JSONL sidecar, each record carrying
+    the canonical plan fingerprint and the full EXPLAIN-ANALYZE-style
+    text rendered from the query's own collected stats (no re-run —
+    the per-operator actuals were traced out of the real execution).
+    Config: ``slow-query.threshold-ms`` / ``slow-query.path``
+    (threshold <= 0 = off). Counter: ``query.slow``."""
+
+    def __init__(self, path: str, threshold_ms: float):
+        self.path = path
+        self.threshold_ms = float(threshold_ms)
+        self._lock = threading.Lock()
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        if self.threshold_ms <= 0:
+            return
+        qs = event.stats
+        if qs.elapsed_ms < self.threshold_ms:
+            return
+        from presto_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter("query.slow").update()
+        try:
+            from presto_tpu.exec.explain import render_query_analyze
+
+            text = render_query_analyze(qs)
+        except Exception:
+            text = ""  # rendering must never fail the query
+        rec = {
+            "event": "slow_query",
+            "query_id": qs.query_id,
+            "query": qs.sql,
+            "state": qs.state,
+            "plan_fingerprint": qs.plan_fingerprint,
+            "elapsed_ms": qs.elapsed_ms,
+            "threshold_ms": self.threshold_ms,
+            "explain_analyze": text,
+        }
+        line = json.dumps(rec, default=str)
         with self._lock:
             with open(self.path, "a") as f:
                 f.write(line + "\n")

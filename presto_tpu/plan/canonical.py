@@ -300,6 +300,36 @@ class _Bind(_Hoist):
         return e
 
 
+class _Normalize(_Hoist):
+    """Literal-invariant normalization over the SAME walker as hoisting:
+    every hoistable literal AND every RuntimeParam slot collapses to the
+    ONE placeholder ``RuntimeParam(0, dtype)``, so a literal-form tree
+    and its hoisted canonical form normalize identically. This is the
+    value-erasing image history fingerprints digest (plan/history.py) —
+    NOT an executable tree (slot 0 is deliberately shared)."""
+
+    def __init__(self):
+        super().__init__({}, True)
+
+    def on_runtime_param(self, e: E.RuntimeParam) -> E.Expr:
+        return E.RuntimeParam(0, e.dtype)
+
+    def on_literal(self, e: E.Literal) -> E.Expr:
+        if _hoistable(e):
+            return E.RuntimeParam(0, e.dtype)
+        return e
+
+
+def normalize_expr(e: E.Expr) -> E.Expr:
+    """Value-erased image of one expression for history fingerprinting
+    (plan/history.py is the only intended consumer): hoistable literals
+    and RuntimeParams become index-0 placeholders; everything the
+    hoisting pass would keep constant (strings, NULLs, long decimals,
+    booleans, long-decimal-arithmetic operands) stays in place — the
+    SAME eligibility rules, via the same walker."""
+    return _Normalize().expr(e)
+
+
 def bind_literal_root(
     root: N.PlanNode, bound: Optional[Dict[int, E.Literal]]
 ) -> N.PlanNode:

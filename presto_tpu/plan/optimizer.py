@@ -150,6 +150,78 @@ def predicate_selectivity(
 
 
 def estimate_rows(node: N.PlanNode, catalogs) -> float:
+    """Cardinality estimate for ``node``. Consults history-based
+    statistics FIRST (plan/history.py — observed actuals keyed by the
+    node's canonical sub-fingerprint, active only when the runner
+    installed a store under session ``enable_history_stats``), then
+    connector stats / heuristics. With no active store the lookup is
+    one thread-local read and the math is bit-exact pre-history."""
+    from presto_tpu.plan import history
+
+    got = history.lookup_rows(node)
+    if got is not None:
+        return max(float(got), 1.0)
+    return _estimate_rows_classic(node, catalogs)
+
+
+def estimate_rows_with_source(
+    node: N.PlanNode, catalogs, stats_memo: Optional[dict] = None
+):
+    """-> (rows, provenance) where provenance is ``history`` (learned
+    from a prior execution of this canonical shape), ``stats`` (every
+    scan under the node has connector row counts), or ``heuristic``.
+    EXPLAIN renders the provenance beside each estimate — render-time
+    only; the hot planning path uses :func:`estimate_rows`, which
+    skips the provenance walk. Callers estimating a whole tree pass
+    one ``stats_memo`` dict so each table's connector stats are
+    fetched once, not once per ancestor node."""
+    from presto_tpu.plan import history
+
+    got = history.lookup_rows(node)
+    if got is not None:
+        return max(float(got), 1.0), "history"
+    rows = _estimate_rows_classic(node, catalogs)
+    return rows, (
+        "stats"
+        if _subtree_has_stats(node, catalogs, stats_memo)
+        else "heuristic"
+    )
+
+
+def _subtree_has_stats(
+    node: N.PlanNode, catalogs, memo: Optional[dict] = None
+) -> bool:
+    """Coarse provenance check: every scan under ``node`` reports a
+    connector row count (the estimate is grounded in stats, not in
+    shape defaults). ``memo`` caches per-table verdicts across calls
+    — a connector whose get_table_stats does real I/O must not pay
+    depth-many fetches per scan when a whole tree is estimated."""
+    scans = [
+        n for n in N.walk(node) if isinstance(n, N.TableScanNode)
+    ]
+    if not scans:
+        return False
+    for s in scans:
+        key = (s.handle.catalog, s.handle.schema, s.handle.table)
+        ok = memo.get(key) if memo is not None else None
+        if ok is None:
+            try:
+                st = (
+                    catalogs.get(s.handle.catalog)
+                    .metadata()
+                    .get_table_stats(s.handle)
+                )
+                ok = bool(st.row_count)
+            except Exception:
+                ok = False
+            if memo is not None:
+                memo[key] = ok
+        if not ok:
+            return False
+    return True
+
+
+def _estimate_rows_classic(node: N.PlanNode, catalogs) -> float:
     if isinstance(node, N.TableScanNode):
         stats = catalogs.get(node.handle.catalog).metadata().get_table_stats(
             node.handle

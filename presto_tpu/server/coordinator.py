@@ -199,6 +199,15 @@ class CoordinatorServer:
                 if cache_raw is not None
                 else DEFAULT_CACHE_BYTES
             ),
+            # history-based statistics (plan/history.py): the
+            # coordinator owns the store — queries complete here, and
+            # estimate_rows reads it during planning
+            history_path=(
+                config.get("history.path") if config else None
+            ),
+            history_max_entries=int(
+                config.get("history.max-entries", 256) if config else 256
+            ),
         )
         prefetch = (
             config.get("staging.prefetch-depth") if config else None
@@ -233,6 +242,31 @@ class CoordinatorServer:
 
             self.local.history.add_listener(
                 JsonlQueryEventListener(event_log)
+            )
+        # slow-query JSONL sidecar: queries over the threshold append
+        # their EXPLAIN ANALYZE text + canonical plan fingerprint
+        # (exec/stats.SlowQueryLog; default off)
+        slow_ms = (
+            config.get("slow-query.threshold-ms") if config else None
+        )
+        if slow_ms is not None and float(slow_ms) > 0:
+            from presto_tpu.exec.stats import SlowQueryLog
+
+            slow_path = (config.get("slow-query.path") if config else None) or (
+                (event_log + ".slow") if event_log else None
+            )
+            if slow_path:
+                self.local.history.add_listener(
+                    SlowQueryLog(slow_path, float(slow_ms))
+                )
+        # per-operator observability gate (exec/stats.OperatorStats):
+        # tier-1 seed for the enable_operator_stats session default
+        opstats = (
+            config.get("operator-stats.enabled") if config else None
+        )
+        if opstats is not None:
+            self.local.session.set(
+                "enable_operator_stats", bool(opstats)
             )
         self.workers: Dict[str, _WorkerNode] = {}
         self.queries: Dict[str, _Query] = {}
@@ -710,6 +744,13 @@ class CoordinatorServer:
                         for t in st.tasks:
                             if t.state in ("QUEUED", "RUNNING"):
                                 t.state = "FAILED"
+                    # drop the failed attempt's coordinator-local
+                    # operator folds: the retry re-executes the same
+                    # local programs, and keeping both would teach the
+                    # history store doubled cardinalities
+                    q.stats.operators = []
+                    q.stats.__dict__.pop("_op_index", None)
+                    q.stats.__dict__.pop("_op_pins", None)
                 q.columns, q.rows = [], []
 
     def _run_sql(self, q: _Query) -> None:
@@ -740,7 +781,8 @@ class CoordinatorServer:
             if q.trace.root is not None and not q.trace.root.end:
                 q.trace.root.end = time.time()
             text = render_distributed_analyze(
-                q._plan_root, q.stats, q.trace, int(res.page.num_valid)
+                q._plan_root, q.stats, q.trace, int(res.page.num_valid),
+                runner=self.local,
             )
             q.columns = [{"name": "Query Plan"}]
             q.rows = [[line] for line in text.split("\n")]
@@ -878,8 +920,27 @@ class CoordinatorServer:
                 from presto_tpu.plan import canonical
 
                 plan = canonical.materialize_plan(plan)
-            root = prune_columns(self.local._bind_params(plan))
+            t_opt = time.perf_counter()
+            with self.local._history_scope():
+                root = prune_columns(self.local._bind_params(plan))
+            q.stats.optimization_ms += (
+                time.perf_counter() - t_opt
+            ) * 1000.0
         q.stats.planning_ms = (time.perf_counter() - t0) * 1000.0
+        REGISTRY.distribution("plan.planning_ms").add(
+            q.stats.planning_ms
+        )
+        if not q.stats.plan_fingerprint:
+            # canonical statement identity for the history store and
+            # the event-sink enrichment
+            try:
+                from presto_tpu.plan import history as plan_history
+
+                q.stats.plan_fingerprint = (
+                    plan_history.plan_fingerprint(root)
+                )
+            except Exception:
+                pass
         scans = [
             n for n in N.walk(root) if isinstance(n, N.TableScanNode)
         ]

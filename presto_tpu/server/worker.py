@@ -279,6 +279,19 @@ class WorkerServer:
         pce = config.get("plan.cache-entries") if config else None
         if pce is not None:
             self.runner.plan_cache.resize(int(pce))
+        # per-operator observability (exec/stats.OperatorStats): worker
+        # programs trace per-node row counters into TaskStats.operators,
+        # shipped on the status response and rolled into QueryInfo —
+        # the same tier-1 gate as the coordinator. The history STORE
+        # stays coordinator-side (queries complete there); workers only
+        # measure.
+        opstats = (
+            config.get("operator-stats.enabled") if config else None
+        )
+        if opstats is not None:
+            self.runner.session.set(
+                "enable_operator_stats", bool(opstats)
+            )
         self.tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._shutting_down = False

@@ -254,6 +254,28 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             True,
         ),
         PropertyMetadata(
+            "enable_operator_stats",
+            "Trace per-operator output-row counters (plus static "
+            "capacity/page-bytes) out of every compiled program and "
+            "fold them into TaskStats/QueryStats as OperatorStats — "
+            "the observability substrate history-based optimization "
+            "reads. False = pre-PR programs with no counter outputs "
+            "(one fewer traced scalar per operator)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
+            "enable_history_stats",
+            "Let optimizer.estimate_rows consult the query-history "
+            "store (history.path) BEFORE connector stats: estimates "
+            "for a previously-executed canonical plan shape come from "
+            "observed actuals (Presto's history-based optimization). "
+            "False — or no configured store — plans bit-exactly "
+            "pre-history",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "query_max_run_time_s",
             "Per-query wall-clock limit (seconds)",
             float,
@@ -414,6 +436,20 @@ class NodeConfig:
         # session default seed
         "plan.cache-entries": int,
         "plan.cache-enabled": bool,
+        # history-based statistics (plan/history.py): directory of the
+        # crash-safe JSONL history store and its entry bound; the
+        # optimizer consults observed per-operator actuals keyed by
+        # canonical plan fingerprints before connector stats
+        "history.path": str,
+        "history.max-entries": int,
+        # per-operator observability (exec/stats.OperatorStats): seeds
+        # the enable_operator_stats session default
+        "operator-stats.enabled": bool,
+        # slow-query log: queries over the threshold append their
+        # EXPLAIN ANALYZE text + plan fingerprint to the JSONL sidecar
+        # (threshold absent/<=0 = off)
+        "slow-query.threshold-ms": float,
+        "slow-query.path": str,
         # seeds the session retry_policy default (NONE | TASK | QUERY)
         "retry-policy": str,
         # worker drain: how long a draining worker waits for running

@@ -1,0 +1,604 @@
+"""History-based statistics plane (reference: Presto's history-based
+optimization, PAPER.md L2): per-operator OperatorStats populated on
+every executor tier, the crash-safe QueryHistoryStore
+(plan/history.py), est-vs-actual + provenance in EXPLAIN / EXPLAIN
+ANALYZE, the ``estimate_rows`` history read path, runtime view +
+metrics, the slow-query log, and the check_history_sites lint.
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+import pytest
+
+from presto_tpu.connectors import create_connector
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager
+from presto_tpu.exec.stats import (
+    JsonlQueryEventListener,
+    OperatorStats,
+    SlowQueryLog,
+    TaskStats,
+)
+from presto_tpu.utils.metrics import REGISTRY
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+
+def _runner(tmp_path=None, **kw):
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    catalogs.register("memory", create_connector("memory"))
+    if tmp_path is not None:
+        kw.setdefault("history_path", str(tmp_path / "hist"))
+    return LocalQueryRunner(catalogs=catalogs, **kw)
+
+
+SKEW_SQL = (
+    "select count(*) c from memory.default.probe "
+    "join memory.default.build on probe.k = build.k"
+)
+
+
+def _skew_tables(r):
+    """A skewed join the classic estimator badly under-estimates:
+    100 probe rows x 50 build rows, ALL on one key -> 5000 join rows
+    while est = max(probe, build) = 100 (the memory connector reports
+    row counts but no NDVs)."""
+    r.execute("create table memory.default.probe (k bigint, v bigint)")
+    r.execute(
+        "insert into memory.default.probe values "
+        + ", ".join(f"(1, {i})" for i in range(100))
+    )
+    r.execute("create table memory.default.build (k bigint, w bigint)")
+    r.execute(
+        "insert into memory.default.build values "
+        + ", ".join(f"(1, {i})" for i in range(50))
+    )
+
+
+def _join_line(text):
+    return next(l for l in text.splitlines() if "InnerJoin" in l)
+
+
+def _max_error(text):
+    """Largest ``error ×N`` factor printed in an EXPLAIN ANALYZE."""
+    errs = [float(m) for m in re.findall(r"error ×([0-9.]+)", text)]
+    assert errs, text
+    return max(errs)
+
+
+# ------------------------------------------------ operator stats: tiers
+
+
+def test_operator_stats_local(tmp_path):
+    r = _runner(tmp_path)
+    r.execute(
+        "select l_returnflag, count(*) c from tpch.tiny.lineitem "
+        "group by l_returnflag"
+    )
+    qs = r.history.snapshot()[-1]
+    assert qs.plan_fingerprint  # canonical statement identity stamped
+    ops = qs.all_operator_stats()
+    labels = " ".join(op.label for op in ops)
+    assert "TableScan" in labels and "Aggregate" in labels
+    scan = next(op for op in ops if "TableScan" in op.label)
+    agg = next(op for op in ops if "Aggregate" in op.label)
+    assert scan.output_rows == 59997
+    assert agg.output_rows == 3
+    assert agg.input_rows >= 59997  # child rows fold into input_rows
+    assert all(op.fingerprint for op in ops)
+    assert all(op.output_capacity > 0 for op in ops)
+    assert all(op.peak_page_bytes > 0 for op in ops)
+    # whole-program wall/device time is attributed to the program root
+    assert any(op.wall_ms > 0 for op in ops)
+    assert any(op.device_ms > 0 for op in ops)
+
+
+def test_operator_stats_disabled_is_empty(tmp_path):
+    r = _runner(tmp_path)
+    r.session.set("enable_operator_stats", "false")
+    res = r.execute("select count(*) c from tpch.tiny.region")
+    assert res.rows() == [(5,)]
+    qs = r.history.snapshot()[-1]
+    assert qs.all_operator_stats() == []
+
+
+def test_operator_stats_streamed_tier(tmp_path):
+    """Split-streamed execution (exec/streaming.py): every batch runs
+    the ONE compiled partial program; its operator stats must SUM
+    across batches, not report one batch."""
+    r = _runner(tmp_path)
+    r.session.set("max_device_rows", 4096)
+    res = r.execute(
+        "select l_returnflag, count(*) c from tpch.tiny.lineitem "
+        "group by l_returnflag order by l_returnflag"
+    )
+    assert sum(row[1] for row in res.rows()) == 59997
+    qs = r.history.snapshot()[-1]
+    ops = qs.all_operator_stats()
+    scan = next(op for op in ops if "TableScan" in op.label)
+    assert scan.batches > 1  # streamed split batches folded in
+    assert scan.output_rows == 59997  # summed across the stream
+
+
+# ----------------------------------- est vs actual in EXPLAIN (ANALYZE)
+
+
+def test_explain_labels_estimate_provenance(tmp_path):
+    r = _runner(tmp_path)
+    text = "\n".join(
+        row[0]
+        for row in r.execute(
+            "explain select count(*) c from tpch.tiny.region"
+        ).rows()
+    )
+    assert "est rows:" in text
+    assert "(stats)" in text or "(heuristic)" in text
+
+
+def test_explain_analyze_est_actual_error(tmp_path):
+    r = _runner(tmp_path)
+    _skew_tables(r)
+    text = "\n".join(
+        row[0] for row in r.execute("explain analyze " + SKEW_SQL).rows()
+    )
+    line = _join_line(text)
+    assert "est:" in line and "error ×" in line
+    assert "[rows: 5000" in line  # actual beside the estimate
+
+
+def test_warm_run_shrinks_estimate_error(tmp_path):
+    """THE acceptance loop: the same skewed join twice — the cold run
+    records per-operator actuals under canonical fingerprints; the warm
+    run's estimates come from history (``history.hit > 0``) and its max
+    per-operator error is STRICTLY smaller."""
+    r = _runner(tmp_path)
+    _skew_tables(r)
+    cold = "\n".join(
+        row[0] for row in r.execute("explain analyze " + SKEW_SQL).rows()
+    )
+    h0 = REGISTRY.counter("history.hit").total
+    warm = "\n".join(
+        row[0] for row in r.execute("explain analyze " + SKEW_SQL).rows()
+    )
+    assert REGISTRY.counter("history.hit").total > h0
+    assert "(history" in warm
+    cold_err, warm_err = _max_error(cold), _max_error(warm)
+    assert cold_err >= 50.0  # the classic estimator misses the skew
+    assert warm_err < cold_err  # strictly smaller on the warm run
+    assert warm_err < 1.5  # history is the observed truth
+
+
+def test_enable_history_stats_false_is_bit_exact(tmp_path):
+    """``enable_history_stats=false`` must plan exactly as a runner
+    with NO store ever configured — history can steer estimates only
+    when asked."""
+    r = _runner(tmp_path)
+    _skew_tables(r)
+    r.execute("explain analyze " + SKEW_SQL)  # populate the store
+    r.session.set("enable_history_stats", "false")
+    off = "\n".join(
+        row[0] for row in r.execute("explain " + SKEW_SQL).rows()
+    )
+    fresh = _runner(None)  # no store at all
+    _skew_tables(fresh)
+    base = "\n".join(
+        row[0] for row in fresh.execute("explain " + SKEW_SQL).rows()
+    )
+    assert off == base
+    assert "(history" not in off
+
+
+# ------------------------------------------------------------ the store
+
+
+def test_history_store_round_trip(tmp_path):
+    from presto_tpu.plan.history import QueryHistoryStore
+
+    p = str(tmp_path / "store")
+    s1 = QueryHistoryStore(p, max_entries=16)
+    s1.record_query(
+        "stmt1", "select 1", {"nodeA": {"rows": 42, "label": "Scan"}}
+    )
+    assert s1.lookup("nodeA") == 42.0
+    # crash-safe reload: a fresh instance over the same directory
+    s2 = QueryHistoryStore(p, max_entries=16)
+    assert s2.lookup("nodeA") == 42.0
+    assert s2.lookup("unknown") is None
+    assert s1.stats()["writes"] == 1
+
+
+def test_history_store_eviction_bounded(tmp_path):
+    from presto_tpu.plan.history import QueryHistoryStore
+
+    s = QueryHistoryStore(str(tmp_path / "store"), max_entries=4)
+    e0 = REGISTRY.counter("history.evict").total
+    for i in range(10):
+        s.record_query(
+            f"stmt{i}", "q", {f"n{i}": {"rows": i, "label": "x"}}
+        )
+    assert s.stats()["entries"] <= 4
+    assert s.evictions > 0
+    assert REGISTRY.counter("history.evict").total > e0
+    # evicted statements' nodes left the derived index too
+    assert s.lookup("n0") is None
+    assert s.lookup("n9") == 9.0
+
+
+def test_history_store_tolerates_corrupt_lines(tmp_path):
+    from presto_tpu.plan.history import QueryHistoryStore
+
+    p = str(tmp_path / "store")
+    s = QueryHistoryStore(p, max_entries=16)
+    s.record_query("stmtA", "q", {"nA": {"rows": 7, "label": "x"}})
+    s.record_query("stmtB", "q", {"nB": {"rows": 9, "label": "x"}})
+    seg = sorted(
+        f for f in os.listdir(p) if f.endswith(".jsonl")
+    )[-1]
+    with open(os.path.join(p, seg), "a") as f:
+        f.write("{torn json line without a clos\n")
+        f.write("not json at all\n")
+    s2 = QueryHistoryStore(p, max_entries=16)
+    assert s2.lookup("nA") == 7.0
+    assert s2.lookup("nB") == 9.0
+
+
+def test_history_store_segment_gc(tmp_path):
+    from presto_tpu.plan.history import QueryHistoryStore
+
+    p = str(tmp_path / "store")
+    s = QueryHistoryStore(p, max_entries=8)
+    for i in range(100):
+        s.record_query(
+            f"stmt{i}", "q", {f"n{i}": {"rows": i, "label": "x"}}
+        )
+    segs = [f for f in os.listdir(p) if f.endswith(".jsonl")]
+    # bounded on disk: ceil(8 / seg_entries) + 1 segments survive
+    assert len(segs) <= 3
+    s2 = QueryHistoryStore(p, max_entries=8)
+    assert s2.lookup("n99") == 99.0
+
+
+def test_history_write_metric_and_view(tmp_path):
+    r = _runner(tmp_path)
+    w0 = REGISTRY.counter("history.write").total
+    r.execute("select count(*) c from tpch.tiny.nation")
+    assert REGISTRY.counter("history.write").total > w0
+    rows = r.execute(
+        "select fingerprint, node_count, total_rows "
+        "from system.runtime.query_history"
+    ).rows()
+    assert rows
+    fp, node_count, total_rows = rows[-1]
+    assert len(fp) == 16
+    assert node_count >= 1
+    assert total_rows >= 1
+
+
+# ---------------------------------------------------- satellite: events
+
+
+def test_event_jsonl_enriched_with_fingerprint_and_operators(tmp_path):
+    r = _runner(tmp_path)
+    path = str(tmp_path / "events.jsonl")
+    r.history.add_listener(JsonlQueryEventListener(path))
+    r.execute("select count(*) c from tpch.tiny.region")
+    with open(path) as f:
+        ev = json.loads(f.readlines()[-1])
+    # old consumers keep their fields
+    assert ev["event"] == "query_completed"
+    assert ev["state"] == "FINISHED"
+    assert "stages" in ev and "elapsed_ms" in ev
+    # new: the canonical fingerprint + per-operator actuals
+    assert len(ev["plan_fingerprint"]) == 16
+    assert ev["operators"]
+    op = ev["operators"][0]
+    assert {"label", "fingerprint", "output_rows"} <= set(op)
+
+
+def test_task_stats_operators_roundtrip():
+    ts = TaskStats(task_id="t1", query_id="q1")
+    ts.operators.append(
+        OperatorStats(
+            node_id=0, label="Scan", fingerprint="abc", output_rows=5
+        )
+    )
+    back = TaskStats.from_dict(ts.to_dict())
+    assert back.operators == ts.operators
+    assert isinstance(back.operators[0], OperatorStats)
+
+
+# ----------------------------------------- satellite: planning visibility
+
+
+def test_planning_and_optimization_ms(tmp_path):
+    r = _runner(tmp_path)
+    r.execute(
+        "select n_name from tpch.tiny.nation where n_regionkey = 1"
+    )
+    qs = r.history.snapshot()[-1]
+    assert qs.planning_ms > 0
+    assert qs.optimization_ms >= 0
+    d = qs.to_dict()
+    assert "optimization_ms" in d and "plan_fingerprint" in d
+    vals = REGISTRY.distribution("plan.planning_ms").values()
+    assert vals.get("count", 0) >= 1
+
+
+# ------------------------------------------- satellite: slow-query log
+
+
+def test_slow_query_log(tmp_path):
+    r = _runner(tmp_path)
+    path = str(tmp_path / "slow.jsonl")
+    r.history.add_listener(SlowQueryLog(path, threshold_ms=0.001))
+    s0 = REGISTRY.counter("query.slow").total
+    r.execute("select count(*) c from tpch.tiny.region")
+    assert REGISTRY.counter("query.slow").total > s0
+    with open(path) as f:
+        rec = json.loads(f.readlines()[-1])
+    assert rec["event"] == "slow_query"
+    assert len(rec["plan_fingerprint"]) == 16
+    assert rec["elapsed_ms"] >= rec["threshold_ms"]
+    # the full EXPLAIN-ANALYZE text, rendered with NO re-run
+    assert "Operators (est -> actual" in rec["explain_analyze"]
+    assert "actual" in rec["explain_analyze"]
+
+
+def test_slow_query_log_off_by_default(tmp_path):
+    r = _runner(tmp_path)
+    path = str(tmp_path / "slow_off.jsonl")
+    r.history.add_listener(SlowQueryLog(path, threshold_ms=0.0))
+    r.execute("select count(*) c from tpch.tiny.region")
+    assert not os.path.exists(path)  # threshold <= 0 = disabled
+
+
+# -------------------------------------------------- distributed tier
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+    from presto_tpu.session import NodeConfig
+
+    hist = str(tmp_path_factory.mktemp("hist") / "store")
+    coord = CoordinatorServer(
+        config=NodeConfig({"history.path": hist})
+    ).start()
+    workers = [
+        WorkerServer(coordinator_uri=coord.uri).start() for _ in range(2)
+    ]
+    _wait_workers(coord, 2)
+    client = PrestoTpuClient(coord.uri, timeout_s=600)
+    yield coord, workers, client
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def test_distributed_operator_stats_and_rollup(cluster):
+    coord, _workers, client = cluster
+    res = client.execute(
+        "select o_orderpriority, count(*) c from tpch.tiny.orders "
+        "group by o_orderpriority"
+    )
+    assert len(res.rows()) == 5
+    q = coord.queries[res.query_id]
+    ops = q.stats.all_operator_stats()
+    assert ops, "distributed query must carry operator stats"
+    scan = next(op for op in ops if "TableScan" in op.label)
+    # split tasks of the stage SUM into the full scan count
+    assert scan.output_rows == 15000
+    assert scan.fingerprint
+    # worker TaskStats shipped them over the status wire
+    assert any(
+        t.operators for s in q.stats.stages for t in s.tasks
+    )
+
+
+def test_distributed_explain_analyze_est_actual(cluster):
+    _coord, _workers, client = cluster
+    sql = (
+        "explain analyze select o_orderpriority, count(*) c "
+        "from tpch.tiny.orders group by o_orderpriority"
+    )
+    text = "\n".join(r[0] for r in client.execute(sql).rows())
+    assert "Distributed EXPLAIN ANALYZE" in text
+    assert "Operators (est -> actual" in text
+    assert "error ×" in text
+    assert "wall" in text and "device" in text
+    assert "plan fingerprint: " in text
+
+
+def test_distributed_query_history_view(cluster):
+    _coord, _workers, client = cluster
+    client.execute("select count(*) c from tpch.tiny.region")
+    rows = client.execute(
+        "select fingerprint, node_count from system.runtime.query_history"
+    ).rows()
+    assert rows  # the coordinator-side store received the actuals
+
+
+# --------------------------------------------------------------- lint
+
+
+def test_check_history_sites_clean_on_repo():
+    import check_history_sites
+
+    assert check_history_sites.main([]) == 0
+
+
+def test_check_history_sites_flags_violations(tmp_path):
+    import check_history_sites
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "store = QueryHistoryStore('/tmp/x')\n"
+        "rows = lookup_rows(node)\n"
+        "fp = node_fingerprint(node)\n"
+        # an exempt READ on the same line must not hide the call
+        "ts.plan_fingerprint = plan_history.plan_fingerprint(root)\n"
+    )
+    assert check_history_sites.main([str(tmp_path)]) == 1
+    assert len(check_history_sites.scan(str(tmp_path))) == 4
+
+
+# ------------------------------------------- rollup/dedup regressions
+
+
+def _finished_task(task_id, fp, rows, node_id=0, speculative=False):
+    t = TaskStats(task_id=task_id, query_id="q", state="FINISHED")
+    t.speculative = speculative
+    t.operators = [
+        OperatorStats(
+            node_id=node_id,
+            label="TableScan",
+            fingerprint=fp,
+            output_rows=rows,
+            batches=1,
+        )
+    ]
+    return t
+
+
+def test_all_operator_stats_counts_one_attempt_per_logical_task():
+    """A speculative loser (or a retried-but-completed attempt) also
+    reports FINISHED — only one attempt per logical task may count, or
+    the history store learns doubled cardinalities."""
+    from presto_tpu.exec.stats import QueryStats, StageStats
+
+    qs = QueryStats(query_id="q", sql="s")
+    qs.stages = [
+        StageStats(
+            stage_id=0,
+            tasks=[
+                _finished_task("q.scan.0.a0", "fpX", 100),
+                # backup attempt of the SAME logical task, also done
+                _finished_task(
+                    "q.scan.0.a1", "fpX", 100, speculative=True
+                ),
+                # a DIFFERENT logical task of the stage still sums
+                _finished_task("q.scan.1.a0", "fpX", 40),
+            ],
+        )
+    ]
+    ops = qs.all_operator_stats()
+    assert sum(op.output_rows for op in ops) == 140
+
+
+def test_all_operator_stats_keeps_same_shape_nodes_separate():
+    """Two distinct plan nodes sharing a canonical fingerprint (a
+    self-join's two scans) must not fold into one summed entry."""
+    from presto_tpu.exec.stats import QueryStats, StageStats
+
+    qs = QueryStats(query_id="q", sql="s")
+    t = TaskStats(task_id="q.scan.0.a0", query_id="q", state="FINISHED")
+    t.operators = [
+        OperatorStats(
+            node_id=3, label="TableScan", fingerprint="fpT",
+            output_rows=25, batches=1,
+        ),
+        OperatorStats(
+            node_id=7, label="TableScan", fingerprint="fpT",
+            output_rows=25, batches=1,
+        ),
+    ]
+    qs.stages = [StageStats(stage_id=0, tasks=[t])]
+    ops = [o for o in qs.all_operator_stats() if o.fingerprint == "fpT"]
+    assert [o.output_rows for o in ops] == [25, 25]
+
+
+def test_self_join_history_learns_per_node_rows(tmp_path):
+    """End-to-end: a self-join's two same-fingerprint scans must teach
+    the store |t| rows, not 2|t|."""
+    r = _runner(tmp_path)
+    r.execute(
+        "select count(*) c from tpch.tiny.nation a "
+        "join tpch.tiny.nation b on a.n_nationkey = b.n_nationkey"
+    )
+    qs = r.history.snapshot()[-1]
+    scans = [
+        op for op in qs.all_operator_stats() if "TableScan" in op.label
+    ]
+    assert len(scans) == 2  # instance-level entries
+    assert all(op.output_rows == 25 for op in scans)
+    # and the store learned the per-node cardinality
+    assert r.history_store.lookup(scans[0].fingerprint) == 25.0
+
+
+def test_history_store_gc_keeps_cold_entries_replayable(tmp_path):
+    """Segment GC is checkpoint-based: a hot statement re-recording
+    hundreds of times must not push the only on-disk copy of colder
+    live entries out of the replayable window."""
+    from presto_tpu.plan.history import QueryHistoryStore
+
+    p = str(tmp_path / "store")
+    s = QueryHistoryStore(p, max_entries=8)
+    for i in range(8):
+        s.record_query(
+            f"stmt{i}", "q", {f"n{i}": {"rows": i + 1, "label": "x"}}
+        )
+    for _ in range(60):  # duplicate-heavy: one hot statement
+        s.record_query("stmt7", "q", {"n7": {"rows": 8, "label": "x"}})
+    assert len(
+        [f for f in os.listdir(p) if f.endswith(".jsonl")]
+    ) <= 3
+    s2 = QueryHistoryStore(p, max_entries=8)
+    for i in range(8):  # every live entry survived the restart
+        assert s2.lookup(f"n{i}") == float(i + 1), i
+
+
+def test_analyzed_run_updates_same_statement_entry(tmp_path):
+    """EXPLAIN ANALYZE records under the SAME statement fingerprint as
+    the normal run (pre-peel root) with a real query text — no forked
+    blank-query twin entry."""
+    r = _runner(tmp_path)
+    sql = "select n_name from tpch.tiny.nation order by n_name"
+    r.execute(sql)  # host root stage peels the Sort/Output chain
+    store = r.history_store
+    before = {rec["fingerprint"] for rec in store.snapshot()}
+    r.execute("explain analyze " + sql)
+    snap = store.snapshot()
+    assert {rec["fingerprint"] for rec in snap} == before
+    assert all(rec["query"] for rec in snap)
+
+
+def test_subquery_programs_do_not_inflate_history(tmp_path):
+    """Scalar-subquery pre-passes run as separate programs that reuse
+    walk positions — their same-shape scans must not sum with the main
+    program's (the store would learn a multiple of the true rows)."""
+    r = _runner(tmp_path)
+    r.execute(
+        "select count(*) c from tpch.tiny.nation where "
+        "n_nationkey < (select count(*) from tpch.tiny.nation) and "
+        "n_regionkey < (select count(*) from tpch.tiny.nation)"
+    )
+    qs = r.history.snapshot()[-1]
+    scans = [
+        op
+        for op in qs.all_operator_stats()
+        if "TableScan" in op.label and "nation" in op.label
+    ]
+    assert scans and all(op.output_rows == 25 for op in scans), [
+        (op.label, op.output_rows, op.batches) for op in scans
+    ]
+    assert r.history_store.lookup(scans[0].fingerprint) == 25.0
