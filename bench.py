@@ -234,6 +234,102 @@ def _serving_line(runner, backend: str) -> dict:
     }
 
 
+def _elasticity_line(backend: str) -> dict:
+    """Elasticity measurement (ROADMAP item 3 / the elastic-pool PR):
+    queries completed during a scripted POOL-HALVING window. An
+    in-process 4-worker cluster under retry_policy=TASK serves
+    concurrent clients while half the pool drains mid-window and fresh
+    capacity replaces it — the line reports throughput across the
+    disruption and the failure count, whose contract is ZERO (the drain
+    protocol + spool recovery make shrink lossless). Backend-tagged
+    like every other line; failures to even run the cluster emit a
+    ``skipped`` line, never a fake zero."""
+    import tempfile
+    import threading
+
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+    from presto_tpu.session import NodeConfig
+
+    window_s = 4.0
+    sql = "select count(*) as c from tpch.tiny.orders"
+    with tempfile.TemporaryDirectory() as td:
+        cfg = NodeConfig(
+            {
+                "exchange.spool-path": td + "/spool",
+                "retry-policy": "TASK",
+            }
+        )
+        coord = CoordinatorServer(config=cfg).start()
+        workers = [
+            WorkerServer(coordinator_uri=coord.uri, config=cfg).start()
+            for _ in range(4)
+        ]
+        try:
+            deadline = time.monotonic() + 15
+            while (
+                time.monotonic() < deadline
+                and len(coord.active_workers()) < 4
+            ):
+                time.sleep(0.05)
+            expected = [tuple(r) for r in coord.local.execute(sql).rows()]
+            done = {"completed": 0, "failed": 0}
+            lock = threading.Lock()
+            stop = time.monotonic() + window_s
+
+            def client_loop():
+                client = PrestoTpuClient(coord.uri, timeout_s=60)
+                while time.monotonic() < stop:
+                    try:
+                        rows = [tuple(r) for r in client.execute(sql).rows()]
+                        ok = rows == expected
+                    except Exception:
+                        ok = False
+                    with lock:
+                        done["completed" if ok else "failed"] += 1
+
+            threads = [
+                threading.Thread(target=client_loop) for _ in range(4)
+            ]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            # the scripted halving: drain 2 of 4 mid-window, restore
+            time.sleep(window_s * 0.25)
+            from presto_tpu.server import rpc as _rpc
+
+            for w in workers[:2]:
+                _rpc.call_json("PUT", w.uri + "/v1/state/drain")
+            time.sleep(window_s * 0.35)
+            workers += [
+                WorkerServer(
+                    coordinator_uri=coord.uri, config=cfg
+                ).start()
+                for _ in range(2)
+            ]
+            for t in threads:
+                t.join(120)
+            wall = time.monotonic() - t0
+        finally:
+            for w in workers:
+                w.shutdown(graceful=False)
+            coord.shutdown()
+    return {
+        "metric": "elastic_pool_halving_queries_completed",
+        "value": done["completed"],
+        "unit": "queries",
+        "window_s": round(wall, 2),
+        "qps": round(done["completed"] / max(wall, 1e-9), 2),
+        "failed": done["failed"],
+        "clients": 4,
+        "workers": "4 -> 2 -> 4 (drain protocol)",
+        "backend": backend,
+    }
+
+
 def _ensure_backend() -> str:
     """Backend-fallback probe (BENCH_r05 fix): the axon TPU plugin can
     be installed but unreachable ("Unable to initialize backend
@@ -333,6 +429,22 @@ def main() -> None:
             print(
                 json.dumps(
                     skip_line("serving_point_lookup_sf1_qps", e, "queries/s")
+                ),
+                flush=True,
+            )
+        # elasticity: queries completed while the worker pool halves
+        # and recovers mid-window (zero failures is the contract; a
+        # cluster that cannot even boot emits skipped, not value 0)
+        try:
+            print(json.dumps(_elasticity_line(backend)), flush=True)
+        except Exception as e:
+            print(
+                json.dumps(
+                    skip_line(
+                        "elastic_pool_halving_queries_completed",
+                        e,
+                        "queries",
+                    )
                 ),
                 flush=True,
             )

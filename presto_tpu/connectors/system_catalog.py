@@ -55,6 +55,12 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "node_version": T.VARCHAR,
             "coordinator": T.BOOLEAN,
             "state": T.VARCHAR,
+            # elastic pools: preemptible capacity flag, the node's pool
+            # lifecycle state, and (coordinator row) the autoscaler's
+            # last decision
+            "preemptible": T.BOOLEAN,
+            "pool_state": T.VARCHAR,
+            "last_decision": T.VARCHAR,
         },
         "tasks": {
             "query_id": T.VARCHAR,
@@ -296,6 +302,8 @@ class SystemConnector(Connector):
     def _node_rows(self):
         cluster = getattr(self._runner, "cluster", None)
         if cluster is not None:
+            pool_state = getattr(cluster, "pool_state", None)
+            decision = getattr(cluster, "pool_decision", "")
             return [
                 {
                     "node_id": w.node_id,
@@ -303,6 +311,19 @@ class SystemConnector(Connector):
                     "node_version": w.version,
                     "coordinator": w.coordinator,
                     "state": w.state,
+                    "preemptible": bool(
+                        getattr(w, "preemptible", False)
+                    ),
+                    "pool_state": (
+                        pool_state(w)
+                        if pool_state is not None
+                        else "STABLE"
+                    ),
+                    # the autoscaler is a coordinator duty: its last
+                    # decision renders on the coordinator row only
+                    "last_decision": (
+                        decision if w.coordinator else ""
+                    ),
                 }
                 for w in cluster.nodes()
             ]
@@ -315,5 +336,8 @@ class SystemConnector(Connector):
                 "node_version": "presto-tpu-0.1",
                 "coordinator": True,
                 "state": f"ACTIVE ({len(jax.devices())} devices)",
+                "preemptible": False,
+                "pool_state": "STABLE",
+                "last_decision": "",
             }
         ]

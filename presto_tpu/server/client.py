@@ -13,6 +13,7 @@ import time
 from typing import Dict, List
 
 from presto_tpu.server import protocol, rpc
+from presto_tpu.utils.metrics import REGISTRY
 
 
 class QueryFailed(RuntimeError):
@@ -40,6 +41,7 @@ class PrestoTpuClient:
         timeout_s: float = 120.0,
         user: str = "presto_tpu",
         rpc_policy: rpc.RpcPolicy = rpc.DEFAULT_POLICY,
+        reconnect_attempts: int = 8,
     ):
         self.uri = coordinator_uri.rstrip("/")
         self.timeout_s = timeout_s
@@ -48,6 +50,14 @@ class PrestoTpuClient:
         #: with backoff; the statement POST never retries (resubmitting
         #: would start a second query)
         self.rpc_policy = rpc_policy
+        #: transparent-reconnect budget across a coordinator BOUNCE:
+        #: connection-level failures on nextUri GETs retry this many
+        #: times with jittered backoff (on top of the rpc policy's own
+        #: short retries) before surfacing — a restarted coordinator
+        #: resumes journaled queries under the same statement URIs, so
+        #: mid-pagination clients ride out the restart instead of dying
+        #: on the first connection reset
+        self.reconnect_attempts = max(int(reconnect_attempts), 0)
         #: prepared statements this client session owns (reference: the
         #: client protocol's prepared-statement session headers). The
         #: map replays on every request as X-Presto-Prepared-Statement
@@ -76,9 +86,35 @@ class PrestoTpuClient:
                 return ClientResult(query_id=qid, columns=columns, data=data)
             if time.monotonic() > deadline:
                 raise TimeoutError(f"query {qid} did not finish in time")
-            resp = rpc.call("GET", nxt, policy=self.rpc_policy)
+            resp = self._get_with_reconnect(nxt, deadline)
             self._absorb_prepared_headers(resp.headers)
             cur = resp.json()
+
+    def _get_with_reconnect(self, url: str, deadline: float):
+        """One nextUri GET with transparent reconnect: a coordinator
+        bounce mid-pagination presents as connection resets/refusals,
+        and the restarted coordinator serves the SAME statement URIs
+        for journal-resumed queries — so connection-level failures
+        retry with full-jitter backoff up to the reconnect budget. An
+        HTTP error response (the server answered) and the query's own
+        ``error`` payload surface immediately, as before."""
+        attempt = 0
+        while True:
+            try:
+                return rpc.call("GET", url, policy=self.rpc_policy)
+            except Exception as e:
+                if not rpc.is_retryable(e):
+                    raise
+                attempt += 1
+                if (
+                    attempt > self.reconnect_attempts
+                    or time.monotonic() > deadline
+                ):
+                    raise
+                REGISTRY.counter("client.reconnects").update()
+                time.sleep(
+                    rpc.compute_backoff(attempt - 1, self.rpc_policy)
+                )
 
     def _absorb_prepared_headers(self, headers) -> None:
         added = headers.get_all(protocol.ADDED_PREPARE_HEADER)

@@ -33,8 +33,13 @@ from presto_tpu.exec.staging import stage_page
 from presto_tpu.exec.stats import QueryStats, StageStats, TaskStats
 from presto_tpu.plan import nodes as N
 from presto_tpu.server import pages_wire, rpc, task_ids
+from presto_tpu.server.journal import CoordinatorJournal
 from presto_tpu.server.protocol import FragmentSpec
-from presto_tpu.server.scheduler import assign_ranges, plan_stage
+from presto_tpu.server.scheduler import (
+    assign_ranges,
+    plan_stage,
+    stable_workers,
+)
 from presto_tpu.server.spool import ExchangeSpool
 from presto_tpu.utils import faults
 from presto_tpu.utils.metrics import REGISTRY, DistributionStat
@@ -92,6 +97,9 @@ class _WorkerNode:
     version: str = "presto-tpu-0.1"
     coordinator: bool = False
     state: str = "ACTIVE"
+    #: preemptible capacity (elastic pools): gather/merge stages are
+    #: placed on stable nodes when any exist (scheduler.stable_workers)
+    preemptible: bool = False
 
 
 class _Query:
@@ -308,6 +316,44 @@ class CoordinatorServer:
         self._prepared_sql: "OrderedDict[str, str]" = OrderedDict()
         self._prepared_mu = threading.Lock()
         self.spool = ExchangeSpool.from_config(config)
+        # durable coordinator state (server.journal): admitted/queued/
+        # running queries + the prepared registry survive a bounce —
+        # start() replays the journal and re-admits open queries
+        jp = config.get("coordinator.journal-path") if config else None
+        self.journal = CoordinatorJournal(jp) if jp else None
+        #: queries re-admitted from the journal at this boot
+        self.resumed_queries = 0
+        #: old-boot qid -> this boot's qid: statement/query-info URLs
+        #: minted by a dead incarnation stay routable after a restart
+        self._qid_alias: Dict[str, str] = {}
+        # elastic worker pool (server.pool): bounds + control cadence
+        # from tier-1 config; attach_pool() supplies the provider and
+        # starts the autoscaler
+        self._pool_cfg = {
+            "min_workers": int(
+                config.get("pool.min-workers", 0) if config else 0
+            ),
+            "max_workers": int(
+                config.get("pool.max-workers", 0) if config else 0
+            ),
+            "interval_s": float(
+                config.get("pool.scale-interval-s", 1.0) if config else 1.0
+            ),
+            "scale_down_ticks": int(
+                config.get("pool.scale-down-ticks", 3) if config else 3
+            ),
+            "cooldown_s": (
+                float(config.get("pool.cooldown-s"))
+                if config and config.get("pool.cooldown-s") is not None
+                else None
+            ),
+        }
+        self.autoscaler = None
+        #: node ids spawned by the autoscaler that have not announced
+        #: yet (the SCALING_UP pool state in system.runtime.nodes)
+        self._pool_scaling: set = set()
+        #: the autoscaler's last decision (nodes view)
+        self.pool_decision = ""
         self._lock = threading.Lock()
         self._qid = itertools.count(1)
         #: per-boot nonce folded into every query id: deterministic
@@ -347,17 +393,167 @@ class CoordinatorServer:
         )
 
     def start(self) -> "CoordinatorServer":
+        # journal recovery BEFORE the server accepts requests: a client
+        # reconnecting mid-pagination must never observe the window
+        # between serving and alias registration (its old statement id
+        # would 404 instead of resolving to the resumed run)
+        if self.journal is not None:
+            self._recover_from_journal()
         self._serve_thread.start()
         return self
 
     def shutdown(self) -> None:
         self._shutting_down = True
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         # httpd.shutdown() handshakes with the serve_forever loop and
         # blocks forever if that loop never ran (server constructed but
         # not .start()ed, e.g. in-process submit()-only tests).
         if self._serve_thread.is_alive():
             self.httpd.shutdown()
         self.httpd.server_close()
+
+    # ------------------------------------------- coordinator HA (journal)
+
+    def _recover_from_journal(self) -> None:
+        """Replay the admission journal: re-register the prepared
+        registry, then re-admit every query that never reached a
+        terminal state — under THIS boot's query ids (the per-boot qid
+        nonce keeps the re-run's task-attempt ids collision-free
+        against the dead incarnation's spooled pages), with the old id
+        aliased so clients paginating across the bounce reconnect
+        transparently. The replacement's submit frame is written (by
+        ``submit``) BEFORE the old id's RESUMED close-out: a crash
+        between the two can only duplicate a resume, never lose the
+        query — at-least-once, the right failure for a query plane."""
+        state = self.journal.replay()
+        for name, text in state.prepared.items():
+            with self._prepared_mu:
+                self._prepared_sql[name] = text
+                self._prepared_sql.move_to_end(name)
+            try:
+                from presto_tpu.sql import parse_statement
+
+                self.local._prepared[name] = parse_statement(text)
+            except Exception:
+                pass  # EXECUTE re-parses from the registry text
+        resumed: Dict[str, str] = {}
+        # recovery re-admission must not lose to the queued-queries
+        # gate: every replayed query was ALREADY admitted by the dead
+        # incarnation under the same cap (replay runs before serving,
+        # so nothing external races the temporary headroom)
+        prev_max = self._max_queued
+        self._max_queued = prev_max + len(state.open)
+        try:
+            for rec in state.open:
+                old_qid = rec.get("qid", "")
+                q = self.submit(
+                    rec.get("sql", ""),
+                    user=rec.get("user") or "presto_tpu",
+                    prepared=rec.get("prepared") or {},
+                )
+                if q.done.is_set() and q.state == "FAILED" and (
+                    q.error or ""
+                ).startswith("Query rejected"):
+                    # re-admission lost after all (no submit frame was
+                    # written): close the old id out HONESTLY so the
+                    # journal never claims a resume that is not running
+                    self.journal.record_finish(old_qid, "FAILED")
+                    log.warning(
+                        "journal recovery: re-admission of %s rejected",
+                        old_qid,
+                    )
+                    continue
+                self.journal.record_finish(
+                    old_qid, "RESUMED", resumed_as=q.qid
+                )
+                resumed[old_qid] = q.qid
+                with self._lock:
+                    self._qid_alias[old_qid] = q.qid
+                q.resumed_from = old_qid
+                self.resumed_queries += 1
+                REGISTRY.counter("coordinator.resumed_queries").update()
+                REGISTRY.counter("pool.resumed_queries").update()
+                log.info(
+                    "journal recovery: resumed %s as %s", old_qid, q.qid
+                )
+        finally:
+            self._max_queued = prev_max
+        # transitive restart aliases: ids minted N bounces ago chain
+        # through every intermediate resume (the journal collapses the
+        # chain to its open tip; map that tip to THIS boot's run)
+        with self._lock:
+            for old, tip in state.aliases.items():
+                if tip in resumed:
+                    self._qid_alias[old] = resumed[tip]
+        if state.open:
+            log.info(
+                "journal recovery: re-admitted %d quer%s",
+                len(state.open),
+                "y" if len(state.open) == 1 else "ies",
+            )
+
+    def lookup_query(self, qid: str) -> Optional[_Query]:
+        """Query by id, following restart aliases (a nextUri minted by
+        a dead coordinator incarnation resolves to the resumed run)."""
+        q = self.queries.get(qid)
+        if q is None:
+            new = self._qid_alias.get(qid)
+            if new:
+                q = self.queries.get(new)
+        return q
+
+    # ------------------------------------------------ elastic worker pool
+
+    def attach_pool(self, provider, **overrides) -> "object":
+        """Wire a WorkerPoolProvider and start the autoscaler
+        (``pool.min/max-workers`` bounds, ``pool.scale-interval-s``
+        cadence; see server.pool). Keyword overrides replace the
+        config-derived knobs — the test/bench hook."""
+        from presto_tpu.server.pool import Autoscaler
+
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        cfg = dict(self._pool_cfg)
+        cfg.update(overrides)
+        self.autoscaler = Autoscaler(self, provider, **cfg).start()
+        return self.autoscaler
+
+    def load_snapshot(self) -> dict:
+        """The autoscaler's control signals, read off the existing
+        stats plane: admission queue depth, running-query count, and
+        stage backlog (QUEUED/RUNNING tasks of live queries)."""
+        with self._lock:
+            qs = list(self.queries.values())
+        queued = running = backlog = 0
+        seen: set = set()
+        for q in qs:
+            if id(q) in seen:  # restart aliases map to one query
+                continue
+            seen.add(id(q))
+            if q.done.is_set():
+                continue
+            if q.state == "QUEUED":
+                queued += 1
+            elif q.state == "RUNNING":
+                running += 1
+            with q._stats_lock:
+                for st in q.stats.stages:
+                    for t in st.tasks:
+                        if t.state in ("QUEUED", "RUNNING"):
+                            backlog += 1
+        return {"queued": queued, "running": running, "backlog": backlog}
+
+    def pool_state(self, w: _WorkerNode) -> str:
+        """Pool lifecycle state of one node for system.runtime.nodes:
+        DRAINING (scale-down/preemption in flight), SCALING_UP (spawned
+        by the autoscaler, not yet announced-and-acknowledged), else
+        STABLE."""
+        if w.state == "DRAINING":
+            return "DRAINING"
+        if w.node_id in self._pool_scaling:
+            return "SCALING_UP"
+        return "STABLE"
 
     def _kill_largest_query(self, holders, requester):
         """ClusterMemoryManager policy: on pool exhaustion, abort the
@@ -389,19 +585,24 @@ class CoordinatorServer:
     # ---------------------------------------------------------- discovery
 
     def announce(
-        self, node_id: str, uri: str, state: str = "ACTIVE"
+        self,
+        node_id: str,
+        uri: str,
+        state: str = "ACTIVE",
+        preemptible: bool = False,
     ) -> None:
         with self._lock:
             w = self.workers.get(node_id)
             if w is None:
                 self.workers[node_id] = _WorkerNode(
                     node_id=node_id, uri=uri, last_seen=time.time(),
-                    state=state,
+                    state=state, preemptible=bool(preemptible),
                 )
             else:
                 w.last_seen = time.time()
                 w.uri = uri
                 w.state = state
+                w.preemptible = bool(preemptible)
 
     def _ttl_workers(self) -> List[_WorkerNode]:
         """Workers announced within the discovery TTL (no breaker
@@ -609,6 +810,13 @@ class CoordinatorServer:
             ]
             for qid in done[: max(0, len(done) - MAX_QUERY_HISTORY)]:
                 del self.queries[qid]
+            if self._qid_alias:
+                # restart aliases die with their resumed target
+                self._qid_alias = {
+                    a: t
+                    for a, t in self._qid_alias.items()
+                    if t in self.queries
+                }
             if self._pending >= self._max_queued:
                 q.fail(
                     "Query rejected: too many queued queries "
@@ -619,6 +827,12 @@ class CoordinatorServer:
                 return q
             self._pending += 1
         if self.resource_groups is None:
+            # journal BEFORE the execution thread can start: finish
+            # must never precede submit on disk
+            if self.journal is not None:
+                self.journal.record_submit(
+                    q.qid, sql, user, q.prepared, None
+                )
             threading.Thread(
                 target=self._execute_query, args=(q,), daemon=True
             ).start()
@@ -632,6 +846,12 @@ class CoordinatorServer:
         # group assignment is deterministic: record it before the
         # thread can race to the finish hook
         q.resource_group = self.resource_groups.group_of(user).name
+        if self.journal is not None:
+            # before resource_groups.submit — a run-now admission
+            # starts the thread synchronously inside it
+            self.journal.record_submit(
+                q.qid, sql, user, q.prepared, q.resource_group
+            )
         state, info = self.resource_groups.submit(user, start)
         if state == "rejected":
             with self._lock:
@@ -639,6 +859,8 @@ class CoordinatorServer:
             q.fail(info)
             REGISTRY.counter("coordinator.queries_rejected").update()
             q.done.set()
+            if self.journal is not None:
+                self.journal.record_finish(q.qid, "FAILED")
             return q
         q.resource_group = info
         return q
@@ -653,6 +875,8 @@ class CoordinatorServer:
                     and getattr(q, "resource_group", None) is not None
                 ):
                     self.resource_groups.finish(q.resource_group)
+                if self.journal is not None:
+                    self.journal.record_finish(q.qid, q.state)
                 return
             q.state = "RUNNING"
             q.stats.state = "RUNNING"
@@ -688,6 +912,11 @@ class CoordinatorServer:
                 self.memory_pool.release(q.qid)
                 with self._lock:
                     self._pending -= 1
+                if self.journal is not None:
+                    # terminal close-out BEFORE done is observable: a
+                    # restart must never re-admit a query whose client
+                    # already saw the outcome
+                    self.journal.record_finish(q.qid, q.state)
                 q.done.set()
                 if (
                     self.resource_groups is not None
@@ -829,6 +1058,11 @@ class CoordinatorServer:
             # the embedded runner serves the non-distributed EXECUTE
             # path: keep its per-runner registry in step
             self.local._prepared[stmt.name] = stmt.statement
+            if self.journal is not None:
+                # the coordinator-GLOBAL registry is coordinator state
+                # and survives a bounce (client-header-owned maps are
+                # the client's to replay)
+                self.journal.record_prepare(stmt.name, text)
             q.added_prepare = (stmt.name, text)
             q.columns = [{"name": "result"}]
             q.rows = [["PREPARE"]]
@@ -837,6 +1071,8 @@ class CoordinatorServer:
             with self._prepared_mu:
                 self._prepared_sql.pop(stmt.name, None)
             self.local._prepared.pop(stmt.name, None)
+            if self.journal is not None:
+                self.journal.record_deallocate(stmt.name)
             q.deallocated_prepare = stmt.name
             q.columns = [{"name": "result"}]
             q.rows = [["DEALLOCATE"]]
@@ -2012,9 +2248,12 @@ class CoordinatorServer:
                 right=N.RemoteSourceNode(fragment_root=J.right),
             )
             jstage = self._new_stage(q, "join")
+            # join tasks pull both sides' partitions and hold the only
+            # merged copy: stable nodes first (preemptible-aware)
+            jworkers = stable_workers(workers)
 
             def run_join_task(i: int):
-                w = workers[i % len(workers)]
+                w = jworkers[i % len(jworkers)]
                 spec = self._register_task(q, jstage, FragmentSpec(
                     task_id=task_ids.mint(
                         q.qid, task_ids.JOIN, next(q._task_seq)
@@ -2164,8 +2403,11 @@ class CoordinatorServer:
 
         try:
             # merge tasks first, placed on live workers (a worker that
-            # died since discovery is skipped, not fatal)
-            candidates = list(workers)
+            # died since discovery is skipped, not fatal). Preemptible-
+            # aware placement: merge state is the only copy of its
+            # partition's FINAL, so merges go to stable nodes when any
+            # exist — preemptibles keep the spool-backed producer work
+            candidates = stable_workers(workers)
             for i in range(nparts):
                 posted = False
                 for k in range(len(candidates)):
@@ -2249,8 +2491,8 @@ class CoordinatorServer:
                     urllib.error.URLError, ConnectionError, OSError
                 ):
                     self._worker_failed(w)
-                    others = self.active_workers(
-                        exclude={w.node_id}
+                    others = stable_workers(
+                        self.active_workers(exclude={w.node_id})
                     )
                     if not others:
                         raise
@@ -2751,7 +2993,8 @@ def _make_handler(coord: CoordinatorServer):
             if parts == ["v1", "announcement"]:
                 d = json.loads(self._read_body().decode())
                 coord.announce(
-                    d["node_id"], d["uri"], d.get("state", "ACTIVE")
+                    d["node_id"], d["uri"], d.get("state", "ACTIVE"),
+                    preemptible=bool(d.get("preemptible", False)),
                 )
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
@@ -2785,14 +3028,17 @@ def _make_handler(coord: CoordinatorServer):
                 )
             if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                 # full QueryInfo incl. stage/task stats + span tree
-                # (reference: GET /v1/query/{id}); works mid-flight
-                x = coord.queries.get(parts[2])
+                # (reference: GET /v1/query/{id}); works mid-flight.
+                # lookup_query follows restart aliases: ids minted by
+                # a dead coordinator incarnation resolve to their
+                # journal-resumed runs
+                x = coord.lookup_query(parts[2])
                 if x is None:
                     return self._json(404, {"error": "no such query"})
                 return self._json(200, coord.query_info(x))
             if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
                 qid, token = parts[2], int(parts[3])
-                q = coord.queries.get(qid)
+                q = coord.lookup_query(qid)
                 if q is None:
                     return self._json(404, {"error": "no such query"})
                 # long-poll up to 1s for progress (reference: long-poll)

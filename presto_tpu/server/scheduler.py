@@ -208,6 +208,22 @@ def _replace_on_path(
     return new
 
 
+def stable_workers(workers) -> list:
+    """Placement set for gather/merge/join stages under preemptible-
+    aware scheduling: these stages hold the only copy of merged state
+    (their buffers are NOT spool-backed the way producer partitions
+    are), so they belong on stable nodes — preemptibles keep the
+    spool-backed shuffle-producer work, where a preemption costs one
+    re-servable partition, not a stage re-run. Returns the
+    non-preemptible subset when any exists; an all-preemptible pool
+    still schedules (recovery, not placement, is the safety net
+    there)."""
+    stable = [
+        w for w in workers if not getattr(w, "preemptible", False)
+    ]
+    return stable if stable else list(workers)
+
+
 def assign_ranges(total_rows: int, n_ranges: int) -> List[Tuple[int, int]]:
     """Contiguous row ranges of the partitioned scan. The coordinator
     over-partitions (n_ranges = workers x split_queue_factor) and lets

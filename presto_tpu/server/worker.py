@@ -228,6 +228,7 @@ class WorkerServer:
         catalogs=None,
         coordinator_uri: Optional[str] = None,
         config=None,
+        preemptible: Optional[bool] = None,
     ):
         from presto_tpu.exec.local_runner import LocalQueryRunner
         from presto_tpu.utils.memory import MemoryPool, parse_bytes
@@ -319,6 +320,17 @@ class WorkerServer:
         self._drain_grace_s = float(
             config.get("drain.grace-s", 30.0) if config else 30.0
         )
+        # preemptible capacity (elastic pools): announced to discovery
+        # so the scheduler places gather/merge stages on stable nodes;
+        # a preemption notice drains with this SHORT grace window
+        self.preemptible = bool(
+            preemptible
+            if preemptible is not None
+            else (config.get("node.preemptible", False) if config else False)
+        )
+        self._preempt_grace_s = float(
+            config.get("pool.preempt-grace-s", 10.0) if config else 10.0
+        )
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -392,6 +404,35 @@ class WorkerServer:
         log.info("node=%s drain complete, exiting", self.node_id)
         self.shutdown(graceful=False)
 
+    def preempt(self, grace_s: Optional[float] = None) -> None:
+        """Preemption notice (the cloud's SIGTERM-with-short-grace on
+        preemptible capacity): an IMMEDIATE graceful drain bounded by
+        ``pool.preempt-grace-s`` — announce DRAINING now (the
+        coordinator reschedules everything new), finish what fits in
+        the grace window, serve/spool finished buffers, exit. Running
+        producers that spooled stay recoverable even when the grace
+        expires mid-task (retry_policy=TASK re-runs only the lost
+        work)."""
+        with self._lock:
+            if self._draining or self._shutting_down:
+                return
+        REGISTRY.counter("pool.preemptions").update()
+        log.warning(
+            "node=%s preemption notice: draining (grace %.1fs)",
+            self.node_id,
+            self._preempt_grace_s if grace_s is None else grace_s,
+        )
+        self.drain(
+            grace_s=self._preempt_grace_s if grace_s is None else grace_s
+        )
+
+    def _fault_preempt(self) -> None:
+        """Background preemption for the ``kill_worker_preempt`` fault
+        rule: the notice arrives WHILE a task runs (the hook fires at
+        task execute), so the drain must not block that task's
+        thread."""
+        threading.Thread(target=self.preempt, daemon=True).start()
+
     def _drain_busy(self) -> bool:
         """Anything left that exiting now would lose? Running/queued
         tasks; a FINISHED task whose buffers a consumer is still
@@ -414,6 +455,14 @@ class WorkerServer:
     def _announce_state(self) -> str:
         return "DRAINING" if self._draining else "ACTIVE"
 
+    def _announce_body(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "uri": self.uri,
+            "state": self._announce_state(),
+            "preemptible": self.preemptible,
+        }
+
     def _announce_once(self) -> None:
         """One best-effort, no-retry announcement (drain flips state
         immediately; failures fall back to the regular loop)."""
@@ -423,11 +472,7 @@ class WorkerServer:
             rpc.call_json(
                 "PUT",
                 self.coordinator_uri + "/v1/announcement",
-                {
-                    "node_id": self.node_id,
-                    "uri": self.uri,
-                    "state": self._announce_state(),
-                },
+                self._announce_body(),
                 policy=rpc.RpcPolicy(
                     timeout_s=self._announce_timeout, retries=0
                 ),
@@ -469,11 +514,7 @@ class WorkerServer:
                 rpc.call_json(
                     "PUT",
                     self.coordinator_uri + "/v1/announcement",
-                    {
-                        "node_id": self.node_id,
-                        "uri": self.uri,
-                        "state": self._announce_state(),
-                    },
+                    self._announce_body(),
                     policy=rpc.RpcPolicy(
                         timeout_s=self._announce_timeout, retries=0
                     ),
@@ -611,7 +652,8 @@ class WorkerServer:
         # mid-execute from the coordinator's point of view, since the
         # task POST was already acked
         faults.maybe_inject_task(
-            self.node_id, task.spec.task_id, kill=self._fault_kill
+            self.node_id, task.spec.task_id, kill=self._fault_kill,
+            preempt=self._fault_preempt,
         )
         spec = task.spec
         if spec.sources or spec.partition_scan < 0:
@@ -1020,6 +1062,7 @@ class WorkerServer:
                 "node_id": self.node_id,
                 "state": state,
                 "uri": self.uri,
+                "preemptible": self.preemptible,
                 "tasks": {
                     tid: t.state for tid, t in self.tasks.items()
                 },

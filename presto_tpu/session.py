@@ -456,6 +456,23 @@ class NodeConfig:
         # tasks to finish and buffered output to be pulled/spooled
         # before exiting
         "drain.grace-s": float,
+        # durable coordinator state (server.journal): directory of the
+        # crash-safe admission journal; a restarted coordinator replays
+        # it and re-admits every non-terminal query
+        "coordinator.journal-path": str,
+        # elastic worker pool (server.pool): autoscaler bounds, control
+        # cadence, and hysteresis (consecutive idle ticks before a
+        # scale-down, cooldown after any scaling action)
+        "pool.min-workers": int,
+        "pool.max-workers": int,
+        "pool.scale-interval-s": float,
+        "pool.scale-down-ticks": int,
+        "pool.cooldown-s": float,
+        # preemptible capacity: marks this worker preemptible (announced
+        # to discovery; gather/merge stages prefer stable nodes) and the
+        # short drain grace a preemption notice gets
+        "node.preemptible": bool,
+        "pool.preempt-grace-s": float,
         # deterministic chaos: JSON FaultPlane spec (utils.faults)
         "fault-injection.spec": str,
     }

@@ -25,6 +25,7 @@ Rule spec (all match fields optional; empty matches everything)::
        {"action": "drop",   "url": "/v1/task", "skip": 2, "count": 1},
        {"action": "kill_task",   "node": "worker-ab"},
        {"action": "kill_worker", "task": "q_c1_"},
+       {"action": "kill_worker_preempt", "node": "worker-ab"},
        {"action": "spool_corrupt", "task": ".prod."},
        {"action": "kill_worker_draining", "node": "worker-ab"},
      ]}
@@ -50,8 +51,12 @@ from presto_tpu.utils.metrics import REGISTRY
 
 #: actions injected at the RPC hook (caller side of a call)
 RPC_ACTIONS = ("delay", "error", "drop")
-#: actions injected at the worker task-execute hook
-TASK_ACTIONS = ("delay", "kill_task", "kill_worker")
+#: actions injected at the worker task-execute hook.
+#: ``kill_worker_preempt`` models a cloud preemption notice: the worker
+#: starts an immediate graceful drain (short grace) while the current
+#: task keeps running — new tasks 503-reschedule, finished buffers
+#: serve/spool, then the worker exits
+TASK_ACTIONS = ("delay", "kill_task", "kill_worker", "kill_worker_preempt")
 #: actions injected at the exchange-spool read hook (server.spool):
 #: flips a spooled payload byte so the checksum framing must catch it
 SPOOL_ACTIONS = ("spool_corrupt",)
@@ -164,10 +169,16 @@ class FaultPlane:
                     f"injected connection drop: {method} {url}"
                 )
 
-    def on_task(self, node_id: str, task_id: str, kill=None) -> None:
+    def on_task(
+        self, node_id: str, task_id: str, kill=None, preempt=None
+    ) -> None:
         """Worker task-execute hook: may sleep, fail the task
-        (``kill_task``), or crash the whole worker (``kill_worker`` —
-        invokes ``kill`` to close the socket abruptly, then raises)."""
+        (``kill_task``), crash the whole worker (``kill_worker`` —
+        invokes ``kill`` to close the socket abruptly, then raises), or
+        deliver a preemption notice (``kill_worker_preempt`` — invokes
+        ``preempt``, which starts the worker's drain-with-short-grace
+        in the background; the current task keeps running and the rule
+        does NOT raise, exactly like a real SIGTERM-with-grace)."""
         for rule in self.rules:
             if rule.action not in TASK_ACTIONS:
                 continue
@@ -185,6 +196,9 @@ class FaultPlane:
                 raise FaultInjectedError(
                     f"injected task kill: {task_id} on {node_id}"
                 )
+            elif rule.action == "kill_worker_preempt":
+                if preempt is not None:
+                    preempt()
             else:  # kill_worker: crash, not drain
                 if kill is not None:
                     kill()
@@ -246,10 +260,12 @@ def maybe_inject_rpc(method: str, url: str) -> None:
         plane.on_rpc(method, url)
 
 
-def maybe_inject_task(node_id: str, task_id: str, kill=None) -> None:
+def maybe_inject_task(
+    node_id: str, task_id: str, kill=None, preempt=None
+) -> None:
     plane = _PLANE
     if plane is not None:
-        plane.on_task(node_id, task_id, kill=kill)
+        plane.on_task(node_id, task_id, kill=kill, preempt=preempt)
 
 
 def maybe_inject_spool(task_id: str) -> bool:

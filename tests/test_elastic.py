@@ -1,0 +1,734 @@
+"""Elastic worker pools + durable coordinator: autoscaling on
+preemptible capacity with crash-resumable query state.
+
+Reference parity: Presto's disaggregated-coordinator direction (elastic
+membership, recoverable coordinator state — PAPER.md L3) on top of the
+PR 5 substrate (spooled exchange, drain protocol, retry policies).
+Chaos acceptance: under concurrent TPC-H load, (a) draining half the
+worker pool and restoring it and (b) killing and restarting the
+coordinator with queries queued both complete with ZERO failed queries;
+the restarted coordinator resumes journaled queued queries without
+client resubmission (``coordinator.resumed_queries`` asserted), and the
+autoscaler scales up on queue depth and drains back down with no
+flapping.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from presto_tpu.server import (
+    CoordinatorServer,
+    PrestoTpuClient,
+    WorkerServer,
+)
+from presto_tpu.server import rpc
+from presto_tpu.server.journal import CoordinatorJournal
+from presto_tpu.server.launcher import LocalWorkerPoolProvider
+from presto_tpu.server.pool import Autoscaler, WorkerPoolProvider
+from presto_tpu.session import NodeConfig
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+#: the multi-stage shuffle shape (producer + merge stages) the
+#: placement and pool-halving tests exercise
+JOIN_SQL = (
+    "select o_orderpriority, count(*) as n "
+    "from tpch.tiny.orders, tpch.tiny.lineitem "
+    "where o_orderkey = l_orderkey "
+    "group by o_orderpriority order by o_orderpriority"
+)
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    yield
+    faults.configure(None)
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _mk_cluster(tmp_path, n=2, policy="TASK", extra=None, preemptible=()):
+    cfg = {
+        "exchange.spool-path": str(tmp_path / "spool"),
+        "exchange.spool-bytes": "64MB",
+    }
+    cfg.update(extra or {})
+    coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
+    coord.local.session.set("retry_policy", policy)
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri,
+            config=NodeConfig(dict(cfg)),
+            preemptible=(i in preemptible),
+        ).start()
+        for i in range(n)
+    ]
+    _wait(
+        lambda: len(coord.active_workers()) >= n,
+        msg="worker discovery",
+    )
+    return coord, workers
+
+
+def _teardown(coord, workers):
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def _expected_rows(coord, sql):
+    return [tuple(r) for r in coord.local.execute(sql).rows()]
+
+
+# ------------------------------------------------------- journal unit
+
+
+def test_journal_roundtrip_and_replay(tmp_path):
+    j = CoordinatorJournal(str(tmp_path / "j"))
+    j.record_submit("q_c1_aaa", "select 1", "alice", {"p": "select ?"})
+    j.record_submit("q_c2_aaa", "select 2", "bob")
+    j.record_prepare("s1", "select c from t where x = ?")
+    j.record_finish("q_c1_aaa", "FINISHED")
+    j.record_deallocate("nope")  # unknown: no-op
+    # a fresh instance replays only the open query + live registry
+    j2 = CoordinatorJournal(str(tmp_path / "j"))
+    state = j2.replay()
+    assert [r["qid"] for r in state.open] == ["q_c2_aaa"]
+    assert state.open[0]["sql"] == "select 2"
+    assert state.open[0]["user"] == "bob"
+    assert state.prepared == {"s1": "select c from t where x = ?"}
+    # closing the survivor empties the next replay
+    j2.record_finish("q_c2_aaa", "RESUMED")
+    assert CoordinatorJournal(str(tmp_path / "j")).replay().open == []
+
+
+def test_journal_torn_and_corrupt_line_tolerance(tmp_path):
+    path = tmp_path / "j"
+    j = CoordinatorJournal(str(path))
+    j.record_submit("q_c1_aaa", "select 1")
+    j.record_submit("q_c2_aaa", "select 2")
+    seg = sorted(path.glob("journal-*.jsonl"))[-1]
+    raw = seg.read_text().splitlines()
+    # torn tail (crash mid-append), a bit-flipped frame, and foreign
+    # garbage must all be skipped at replay — never a crash
+    flipped = raw[1][:12] + ("X" if raw[1][12] != "X" else "Y") + raw[1][13:]
+    seg.write_text(
+        "\n".join([raw[0], flipped, "not a frame", raw[1][: len(raw[1]) // 2]])
+        + "\n"
+    )
+    before = REGISTRY.counter("journal.corrupt_lines").total
+    state = CoordinatorJournal(str(path)).replay()
+    assert [r["qid"] for r in state.open] == ["q_c1_aaa"]
+    assert REGISTRY.counter("journal.corrupt_lines").total >= before + 3
+
+
+def test_journal_checkpoint_compaction_bounds_segments(tmp_path):
+    path = tmp_path / "j"
+    j = CoordinatorJournal(str(path), segment_lines=4)
+    # a long-running coordinator: many queries come and go, one stays
+    j.record_submit("q_keep", "select 'keep'")
+    for i in range(40):
+        j.record_submit(f"q_{i}", f"select {i}")
+        j.record_finish(f"q_{i}")
+    segs = sorted(path.glob("journal-*.jsonl"))
+    assert len(segs) <= 2, [s.name for s in segs]
+    # the checkpoint kept the long-lived open query replayable even
+    # though its submit frame's segment was GC'd long ago
+    state = CoordinatorJournal(str(path)).replay()
+    assert [r["qid"] for r in state.open] == ["q_keep"]
+
+
+# --------------------------------------------- coordinator HA (restart)
+
+
+def test_coordinator_restart_resumes_queued_queries(tmp_path):
+    """THE coordinator-HA acceptance: kill a coordinator with queries
+    QUEUED; the restarted coordinator (same journal, same port) resumes
+    them from the journal without client resubmission — asserted via
+    coordinator.resumed_queries — and the old statement ids stay
+    routable through the restart alias."""
+    cfg = NodeConfig({"coordinator.journal-path": str(tmp_path / "jr")})
+    c1 = CoordinatorServer(config=cfg).start()
+    # hold every admission slot: submissions stay QUEUED
+    for _ in range(4):
+        c1._admit.acquire()
+    qs = [
+        c1.submit("select count(*) as c from tpch.tiny.region")
+        for _ in range(3)
+    ]
+    assert all(q.state == "QUEUED" for q in qs)
+    port = c1.port
+    before = REGISTRY.counter("coordinator.resumed_queries").total
+    pool_before = REGISTRY.counter("pool.resumed_queries").total
+    c1.shutdown()  # the bounce: queued queries would be forgotten
+
+    c2 = CoordinatorServer(port=port, config=cfg).start()
+    try:
+        assert c2.resumed_queries == 3
+        assert (
+            REGISTRY.counter("coordinator.resumed_queries").total
+            == before + 3
+        )
+        assert (
+            REGISTRY.counter("pool.resumed_queries").total
+            == pool_before + 3
+        )
+        for q in qs:
+            rq = c2.lookup_query(q.qid)  # old id -> resumed run
+            assert rq is not None
+            assert rq.done.wait(60)
+            assert rq.state == "FINISHED", rq.error
+            assert rq.rows == [[5]]
+        # every resumed query finished: a THIRD boot resumes nothing
+        c3 = CoordinatorServer(config=cfg).start()
+        assert c3.resumed_queries == 0
+        c3.shutdown()
+    finally:
+        c2.shutdown()
+
+
+def test_statement_ids_survive_a_second_bounce(tmp_path):
+    """Review finding: the restart alias must be DURABLE — a client URI
+    minted two coordinator incarnations ago still resolves after the
+    second bounce (the RESUMED frame journals its replacement qid and
+    replay collapses the chain)."""
+    cfg = NodeConfig({"coordinator.journal-path": str(tmp_path / "jr")})
+    c1 = CoordinatorServer(config=cfg).start()
+    for _ in range(4):
+        c1._admit.acquire()
+    q = c1.submit("select count(*) as c from tpch.tiny.region")
+    port = c1.port
+    c1.shutdown()
+    # boot 2 resumes the query but we hold ITS admission too, so the
+    # resumed run is still open when boot 2 dies
+    c2 = CoordinatorServer(port=port, config=cfg)
+    for _ in range(4):
+        c2._admit.acquire()
+    c2.start()
+    assert c2.resumed_queries == 1
+    assert c2.lookup_query(q.qid) is not None
+    c2.shutdown()
+    c3 = CoordinatorServer(port=port, config=cfg).start()
+    try:
+        assert c3.resumed_queries == 1
+        # the ORIGINAL boot-1 qid still routes, two bounces later
+        q3 = c3.lookup_query(q.qid)
+        assert q3 is not None, "boot-1 qid lost after the second bounce"
+        assert q3.done.wait(60)
+        assert q3.state == "FINISHED", q3.error
+        assert q3.rows == [[5]]
+    finally:
+        c3.shutdown()
+
+
+def test_recovery_readmission_bypasses_queue_cap(tmp_path):
+    """Review finding: replayed queries were admitted by the dead
+    incarnation under the same cap — recovery must re-admit ALL of
+    them even when their count reaches max_queued_queries, never
+    journal a RESUMED that is really a rejection."""
+    jdir = tmp_path / "jr"
+    j = CoordinatorJournal(str(jdir))
+    for i in range(3):
+        j.record_submit(f"q_c{i}_dead", "select count(*) as c from tpch.tiny.region")
+    c = CoordinatorServer(
+        max_queued_queries=2,
+        config=NodeConfig({"coordinator.journal-path": str(jdir)}),
+    ).start()
+    try:
+        assert c.resumed_queries == 3
+        for i in range(3):
+            rq = c.lookup_query(f"q_c{i}_dead")
+            assert rq is not None
+            assert rq.done.wait(60)
+            assert rq.state == "FINISHED", rq.error
+    finally:
+        c.shutdown()
+
+
+def test_client_reconnects_across_coordinator_bounce(tmp_path):
+    """A paginating client must ride out the bounce: connection resets
+    during the restart window retry with jittered backoff (satellite —
+    a coordinator restart used to kill every paginating client on the
+    first reset), and the resumed query delivers its result without
+    resubmission."""
+    cfg = NodeConfig({"coordinator.journal-path": str(tmp_path / "jr")})
+    c1 = CoordinatorServer(config=cfg).start()
+    for _ in range(4):
+        c1._admit.acquire()  # keep the query QUEUED across the bounce
+    port = c1.port
+    client = PrestoTpuClient(
+        f"http://127.0.0.1:{port}", timeout_s=90, reconnect_attempts=40
+    )
+    out, errs = {}, []
+
+    def run():
+        try:
+            out["res"] = client.execute(
+                "select count(*) as c from tpch.tiny.nation"
+            )
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    _wait(
+        lambda: any(
+            q.state == "QUEUED" for q in c1.queries.values()
+        ),
+        msg="query queued",
+    )
+    reconnects = REGISTRY.counter("client.reconnects").total
+    c1.shutdown()
+    # a real outage window: in-flight long-polls finish, then every
+    # poll hits a dead port (connection refused) until the restart —
+    # long enough that the client MUST ride it on the reconnect path
+    _wait(
+        lambda: REGISTRY.counter("client.reconnects").total
+        > reconnects,
+        timeout=20,
+        msg="client entered the reconnect path",
+    )
+    c2 = CoordinatorServer(port=port, config=cfg).start()
+    try:
+        t.join(90)
+        assert not errs, f"client died across the bounce: {errs}"
+        assert [tuple(r) for r in out["res"].rows()] == [(25,)]
+        assert REGISTRY.counter("client.reconnects").total > reconnects
+        assert c2.resumed_queries >= 1
+    finally:
+        c2.shutdown()
+
+
+def test_prepared_registry_survives_bounce(tmp_path):
+    cfg = NodeConfig({"coordinator.journal-path": str(tmp_path / "jr")})
+    c1 = CoordinatorServer(config=cfg).start()
+    q = c1.submit(
+        "prepare pj from select count(*) as c from tpch.tiny.region"
+    )
+    assert q.done.wait(60) and q.state == "FINISHED", q.error
+    c1.shutdown()
+    c2 = CoordinatorServer(config=cfg).start()
+    try:
+        # no client-side prepared headers: the registry itself survived
+        q2 = c2.submit("execute pj")
+        assert q2.done.wait(60)
+        assert q2.state == "FINISHED", q2.error
+        assert q2.rows == [[5]]
+    finally:
+        c2.shutdown()
+
+
+# --------------------------------------------- chaos: pool halving
+
+
+def test_pool_halving_under_load_zero_failures(tmp_path):
+    """Chaos acceptance (a): drain HALF the pool under sustained
+    concurrent load, then restore it — zero failed queries, exact
+    results throughout."""
+    coord, ws = _mk_cluster(tmp_path, n=4, policy="TASK")
+    spawned = []
+    try:
+        expected = _expected_rows(coord, JOIN_SQL)
+        faults.configure(
+            {
+                "seed": 11,
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.05}
+                ],
+            }
+        )
+        results, errs = [], []
+
+        def client_loop(ci):
+            client = PrestoTpuClient(coord.uri, timeout_s=120)
+            for _ in range(2):
+                try:
+                    results.append(client.execute(JOIN_SQL).rows())
+                except Exception as e:
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=client_loop, args=(ci,))
+            for ci in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        # halve the pool mid-load, through the real drain protocol
+        for w in ws[:2]:
+            rpc.call_json("PUT", w.uri + "/v1/state/drain")
+        time.sleep(0.5)
+        # ...and restore it with fresh capacity
+        cfg = NodeConfig(
+            {
+                "exchange.spool-path": str(tmp_path / "spool"),
+                "exchange.spool-bytes": "64MB",
+            }
+        )
+        spawned = [
+            WorkerServer(coordinator_uri=coord.uri, config=cfg).start()
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.join(180)
+        assert not errs, f"pool halving lost queries: {errs}"
+        assert len(results) == 6
+        for rows in results:
+            assert [tuple(r) for r in rows] == expected
+        # the drained half left discovery; the pool recovered to 4
+        _wait(
+            lambda: len(coord.active_workers()) == 4
+            and not any(
+                w.node_id in {x.node_id for x in coord.active_workers()}
+                for w in ws[:2]
+            ),
+            timeout=20,
+            msg="pool recovery",
+        )
+    finally:
+        _teardown(coord, ws + spawned)
+
+
+# ------------------------------------------- preemptible scheduling
+
+
+def test_merge_stage_placed_on_stable_nodes(tmp_path):
+    """Preemptible-aware placement: merge tasks (the only copy of their
+    partition's FINAL state) go to stable nodes; preemptibles keep the
+    spool-backed producer work."""
+    coord, ws = _mk_cluster(tmp_path, n=2, policy="TASK", preemptible={1})
+    try:
+        _wait(
+            lambda: any(
+                w.preemptible for w in coord.active_workers()
+            ),
+            msg="preemptible flag announced",
+        )
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        res = client.execute(JOIN_SQL)
+        info = client.query_info(res.query_id)
+        merge = [st for st in info["stages"] if st["kind"] == "merge"]
+        assert merge, info["stages"]
+        stable_id = ws[0].node_id
+        for st in merge:
+            for t in st["tasks"]:
+                assert t["node_id"] == stable_id, (
+                    f"merge task {t['task_id']} landed on a "
+                    f"preemptible node {t['node_id']}"
+                )
+        # producers used the whole pool, preemptible included
+        prod_nodes = {
+            t["node_id"]
+            for st in info["stages"]
+            if st["kind"] == "producer"
+            for t in st["tasks"]
+        }
+        assert ws[1].node_id in prod_nodes
+    finally:
+        _teardown(coord, ws)
+
+
+def test_preemption_notice_drains_and_reschedules(tmp_path):
+    """kill_worker_preempt: the preemption notice lands mid-task on the
+    preemptible worker — it drains immediately (new work reschedules on
+    the stable node), the query completes exactly, and the preempted
+    worker exits clean."""
+    coord, ws = _mk_cluster(tmp_path, n=2, policy="TASK", preemptible={1})
+    try:
+        expected = _expected_rows(coord, JOIN_SQL)
+        before = REGISTRY.counter("pool.preemptions").total
+        faults.configure(
+            {
+                "seed": 13,
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.05},
+                    {
+                        "action": "kill_worker_preempt",
+                        "node": ws[1].node_id,
+                        "count": 1,
+                    },
+                ],
+            }
+        )
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        res = client.execute(JOIN_SQL)
+        assert [tuple(r) for r in res.rows()] == expected
+        assert (
+            REGISTRY.counter("pool.preemptions").total == before + 1
+        )
+        # the preempted worker drained out of discovery and exited
+        _wait(
+            lambda: ws[1].node_id
+            not in {w.node_id for w in coord.active_workers()},
+            msg="preempted worker left discovery",
+        )
+        _wait(
+            lambda: ws[1]._shutting_down,
+            timeout=20,
+            msg="preempted worker exit",
+        )
+        # follow-up queries keep completing on the survivor
+        res2 = client.execute(
+            "select count(*) as c from tpch.tiny.orders"
+        )
+        assert [tuple(r) for r in res2.rows()] == [(15000,)]
+    finally:
+        _teardown(coord, ws)
+
+
+# -------------------------------------------------------- autoscaler
+
+
+class _FakeProvider(WorkerPoolProvider):
+    def __init__(self):
+        self.spawned, self.drained = [], []
+
+    def spawn(self):
+        nid = f"fake-{len(self.spawned)}"
+        self.spawned.append(nid)
+        return nid
+
+    def drain(self, node_id):
+        self.drained.append(node_id)
+
+
+def test_autoscaler_hysteresis_no_flapping():
+    """Oscillating load must RATCHET capacity up and hold it — never
+    up-down-up (scale-down needs consecutive idle ticks + cooldown);
+    sustained idle then drains exactly back to the floor, once."""
+    prov = _FakeProvider()
+    a = Autoscaler(
+        None, prov, min_workers=1, max_workers=3,
+        interval_s=1.0, scale_down_ticks=3, cooldown_s=2.0,
+    )
+    now, n = 0.0, 1
+
+    def tick(queued):
+        nonlocal now, n
+        now += 1.0
+        a.step(queued=queued, running=0, backlog=0, n_workers=n, now=now)
+        n = 1 + len(prov.spawned) - len(prov.drained)
+
+    for i in range(20):  # oscillating: busy, idle, busy, idle, ...
+        tick(i % 2)
+    assert len(prov.spawned) == 2  # ratcheted to max_workers
+    assert len(prov.drained) == 0, "flapped down under oscillation"
+    for _ in range(20):  # sustained idle: drain to the floor
+        tick(0)
+    assert n == 1
+    assert len(prov.drained) == 2
+    s0, d0 = len(prov.spawned), len(prov.drained)
+    for _ in range(10):  # stability: no further actions
+        tick(0)
+    assert (len(prov.spawned), len(prov.drained)) == (s0, d0)
+    assert a.last_decision == "hold"
+
+
+def test_autoscaler_keeps_ttl_flapped_workers_owned():
+    """Review finding: a live worker whose announcement lapses the
+    discovery TTL (slow announce, flaky link) must stay OWNED — only a
+    provider-disowned node (really dead) is forgotten; otherwise the
+    pool can never drain back below the flapped node's capacity."""
+
+    class _StubCoord:
+        def __init__(self):
+            self._pool_scaling = set()
+            self.pool_decision = ""
+
+        def load_snapshot(self):
+            return {"queued": 0, "running": 0, "backlog": 0}
+
+        def _ttl_workers(self):
+            return []  # the flap: nothing announced right now
+
+    class _OwningProvider(_FakeProvider):
+        def __init__(self):
+            super().__init__()
+            self.dead = set()
+
+        def owns(self, node_id):
+            return node_id not in self.dead
+
+    prov = _OwningProvider()
+    a = Autoscaler(
+        _StubCoord(), prov, min_workers=0, max_workers=2,
+        interval_s=1.0,
+    )
+    a.owned = ["fake-alive", "fake-dead"]
+    prov.dead.add("fake-dead")
+    a._tick()
+    assert a.owned == ["fake-alive"], a.owned
+
+
+def test_autoscaler_live_scale_up_and_down():
+    """Queue depth scales the live pool up through the provider;
+    drained-back capacity routes through the drain protocol; decisions
+    surface on coordinator.pool_decision."""
+    coord = CoordinatorServer().start()
+    prov = LocalWorkerPoolProvider(coord.uri)
+    up0 = REGISTRY.counter("pool.scale_up").total
+    down0 = REGISTRY.counter("pool.scale_down").total
+    coord.attach_pool(
+        prov, min_workers=1, max_workers=3, interval_s=0.05,
+        scale_down_ticks=2, cooldown_s=0.1,
+    )
+    try:
+        _wait(
+            lambda: len(coord.active_workers()) >= 1,
+            msg="floor spawn",
+        )
+        # queue pressure: hold admission so submissions stay QUEUED
+        for _ in range(4):
+            coord._admit.acquire()
+        qs = [
+            coord.submit("select count(*) as c from tpch.tiny.region")
+            for _ in range(4)
+        ]
+        _wait(
+            lambda: len(coord.active_workers()) >= 3,
+            timeout=30,
+            msg="scale-up on queue depth",
+        )
+        assert REGISTRY.counter("pool.scale_up").total >= up0 + 3
+        for _ in range(4):
+            coord._admit.release()
+        for q in qs:
+            assert q.done.wait(60)
+            assert q.state == "FINISHED", q.error
+        _wait(
+            lambda: len(coord.active_workers()) <= 1,
+            timeout=30,
+            msg="scale-down to the floor",
+        )
+        assert REGISTRY.counter("pool.scale_down").total >= down0 + 2
+        # stability after the drain-down: the decision settles on hold
+        time.sleep(0.5)
+        assert coord.pool_decision == "hold"
+        assert len(coord.active_workers()) == 1
+    finally:
+        coord.shutdown()
+        for w in list(prov.workers.values()):
+            w.shutdown(graceful=False)
+
+
+def test_nodes_view_preemptible_and_pool_state(tmp_path):
+    coord, ws = _mk_cluster(tmp_path, n=2, policy="NONE", preemptible={1})
+    try:
+        _wait(
+            lambda: any(
+                w.preemptible for w in coord.active_workers()
+            ),
+            msg="preemptible flag announced",
+        )
+        coord.pool_decision = "scale_up(queued=2): worker-test"
+        rows = coord.local.execute(
+            "select node_id, coordinator, preemptible, pool_state, "
+            "last_decision from system.runtime.nodes"
+        ).rows()
+        by_id = {r[0]: r for r in rows}
+        assert by_id[ws[0].node_id][2] is False
+        assert by_id[ws[1].node_id][2] is True
+        assert by_id[ws[1].node_id][3] == "STABLE"
+        assert (
+            by_id["coordinator"][4]
+            == "scale_up(queued=2): worker-test"
+        )
+        assert by_id[ws[0].node_id][4] == ""  # decision: coord row only
+        # a draining node reports DRAINING pool state
+        ws[1]._draining = True
+        ws[1]._announce_once()
+        _wait(
+            lambda: any(
+                w.state == "DRAINING"
+                for w in coord.nodes()
+                if w.node_id == ws[1].node_id
+            ),
+            msg="drain announced",
+        )
+        rows = coord.local.execute(
+            "select node_id, pool_state from system.runtime.nodes"
+        ).rows()
+        assert dict(rows)[ws[1].node_id] == "DRAINING"
+        # SCALING_UP: spawned by the autoscaler, not yet announced
+        coord._pool_scaling.add("worker-booting")
+        coord.announce("worker-booting", "http://127.0.0.1:9", "ACTIVE")
+        fake = next(
+            w for w in coord.nodes() if w.node_id == "worker-booting"
+        )
+        assert coord.pool_state(fake) == "SCALING_UP"
+    finally:
+        _teardown(coord, ws)
+
+
+# ---------------------------------------------------- config + lint
+
+
+def test_launcher_parses_pool_and_journal_config(tmp_path):
+    from presto_tpu.server.launcher import load_etc
+
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "coordinator=true\n"
+        f"coordinator.journal-path={tmp_path}/journal\n"
+        "pool.min-workers=1\n"
+        "pool.max-workers=8\n"
+        "pool.scale-interval-s=0.5\n"
+        "pool.scale-down-ticks=4\n"
+        "pool.preempt-grace-s=5\n"
+        "node.preemptible=true\n"
+    )
+    (etc / "catalog" / "tpch.properties").write_text(
+        "connector.name=tpch\n"
+    )
+    config, _catalogs = load_etc(str(etc))
+    assert config.get("pool.min-workers") == 1
+    assert config.get("pool.max-workers") == 8
+    assert config.get("pool.scale-interval-s") == 0.5
+    assert config.get("coordinator.journal-path") == f"{tmp_path}/journal"
+    assert config.get("node.preemptible") is True
+
+
+def test_kill_worker_preempt_rule_validates():
+    plane = faults.configure(
+        {"rules": [{"action": "kill_worker_preempt", "node": "w1"}]}
+    )
+    fired = []
+    plane.on_task("w1-abc", "q.t.0.a0", preempt=lambda: fired.append(1))
+    assert fired == [1]
+    faults.configure(None)
+    with pytest.raises(ValueError):
+        faults.FaultRule.from_dict({"action": "preempt_everything"})
+
+
+def test_journal_sites_lint_clean():
+    import check_journal_sites
+
+    assert check_journal_sites.main([]) == 0
+
+
+def test_journal_sites_lint_flags_adhoc(tmp_path):
+    import check_journal_sites
+
+    (tmp_path / "bad.py").write_text(
+        'seg = open(path + "/journal-000001.jsonl", "a")\n'
+        "j = CoordinatorJournal(path)\n"
+        'j.record_submit("q", "select 1")\n'
+    )
+    assert check_journal_sites.main([str(tmp_path)]) == 1
