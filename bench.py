@@ -330,6 +330,109 @@ def _elasticity_line(backend: str) -> dict:
     }
 
 
+def _memory_pressure_line(backend: str) -> dict:
+    """Memory-governance measurement (the cluster-memory PR): a
+    concurrent over-budget query mix on a deliberately capped per-node
+    budget, under the arbiter + low-memory killer + host-spill lane.
+    The line reports completed/killed/spilled_bytes with the contract
+    ``completed + killed == submitted`` and ZERO wedged queries — over-
+    capacity work either finishes (spill/degrade) or dies loudly with
+    MEMORY_PRESSURE; nothing hangs. Backend-tagged; a cluster that
+    cannot boot emits a ``skipped`` line, never a fake zero."""
+    import threading
+
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+    from presto_tpu.server.client import QueryFailed
+    from presto_tpu.session import NodeConfig
+    from presto_tpu.utils.metrics import REGISTRY
+
+    spilled0 = int(REGISTRY.counter("spill.bytes_spilled").total)
+    cfg = NodeConfig(
+        {
+            "memory.governance-enabled": "true",
+            "memory.blocked-timeout-s": "0.3",
+            "memory.reserve-block-max-s": "15",
+            "memory.host-spill-bytes": "64MB",
+            "announcement.interval-s": "0.1",
+            "staging.cache-bytes": "49152",
+            "query.max-memory-per-node": "49152",
+        }
+    )
+    hungry = "select sum(l_quantity) s from tpch.tiny.lineitem"
+    small = "select count(*) c from tpch.tiny.region"
+    coord = CoordinatorServer(config=cfg).start()
+    workers = [
+        WorkerServer(coordinator_uri=coord.uri, config=cfg).start()
+        for _ in range(2)
+    ]
+    try:
+        deadline = time.monotonic() + 15
+        while (
+            time.monotonic() < deadline
+            and len(coord.active_workers()) < 2
+        ):
+            time.sleep(0.05)
+        expected = [
+            tuple(r) for r in coord.local.execute(small).rows()
+        ]
+        mix = [hungry, small, small, hungry, small, small] * 2
+        out = {"completed": 0, "killed": 0, "wedged": 0}
+        lock = threading.Lock()
+
+        def one(sql):
+            client = PrestoTpuClient(coord.uri, timeout_s=60)
+            try:
+                rows = [tuple(r) for r in client.execute(sql).rows()]
+                ok = sql == hungry or rows == expected
+                key = "completed" if ok else "wedged"
+            except QueryFailed as e:
+                key = (
+                    "killed"
+                    if "MEMORY_PRESSURE" in str(e)
+                    else "wedged"
+                )
+            except Exception:
+                key = "wedged"
+            with lock:
+                out[key] += 1
+
+        threads = [
+            threading.Thread(target=one, args=(sql,)) for sql in mix
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        wall = time.monotonic() - t0
+    finally:
+        for w in workers:
+            w.shutdown(graceful=False)
+        coord.shutdown()
+    return {
+        "metric": "memory_pressure_survivors",
+        "value": out["completed"],
+        "unit": "queries",
+        "submitted": len(mix),
+        "killed": out["killed"],
+        "wedged": out["wedged"],
+        "contract_ok": (
+            out["completed"] + out["killed"] == len(mix)
+            and out["wedged"] == 0
+        ),
+        "spilled_bytes": int(
+            REGISTRY.counter("spill.bytes_spilled").total
+        )
+        - spilled0,
+        "window_s": round(wall, 2),
+        "backend": backend,
+    }
+
+
 def _ensure_backend() -> str:
     """Backend-fallback probe (BENCH_r05 fix): the axon TPU plugin can
     be installed but unreachable ("Unable to initialize backend
@@ -445,6 +548,19 @@ def main() -> None:
                         e,
                         "queries",
                     )
+                ),
+                flush=True,
+            )
+        # memory governance: concurrent over-budget mix on a capped
+        # budget — completed + killed == submitted, zero wedged
+        try:
+            print(
+                json.dumps(_memory_pressure_line(backend)), flush=True
+            )
+        except Exception as e:
+            print(
+                json.dumps(
+                    skip_line("memory_pressure_survivors", e, "queries")
                 ),
                 flush=True,
             )

@@ -91,6 +91,19 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "misses": T.BIGINT,
             "evictions": T.BIGINT,
         },
+        # cluster memory governance (server/memory_arbiter.py): one
+        # row per node (query_id '') + one per (node, query) holder,
+        # plus KILLED rows for the arbiter's victim decisions
+        "memory": {
+            "node_id": T.VARCHAR,
+            "query_id": T.VARCHAR,
+            "state": T.VARCHAR,
+            "reserved_bytes": T.BIGINT,
+            "peak_bytes": T.BIGINT,
+            "blocked_bytes": T.BIGINT,
+            "spilled_bytes": T.BIGINT,
+            "limit_bytes": T.BIGINT,
+        },
     },
     "metadata": {
         "catalogs": {"catalog_name": T.VARCHAR, "connector_id": T.VARCHAR},
@@ -179,6 +192,8 @@ class SystemConnector(Connector):
             ]
         if key == ("runtime", "caches"):
             return self._cache_rows()
+        if key == ("runtime", "memory"):
+            return self._memory_rows()
         if key == ("runtime", "query_history"):
             store = getattr(self._runner, "history_store", None)
             return store.snapshot() if store is not None else []
@@ -279,6 +294,19 @@ class SystemConnector(Connector):
                     "evictions": s["evictions"],
                 }
             )
+        # host-spill pool (cluster memory governance): device pages
+        # offloaded to host RAM under HBM pressure; hits = restages
+        rows.append(
+            {
+                "cache": "staging.host_spill",
+                "entries": split.get("spill_entries", 0),
+                "bytes": split.get("spill_bytes", 0),
+                "budget_bytes": split.get("spill_budget_bytes", 0),
+                "hits": split.get("restages", 0),
+                "misses": 0,
+                "evictions": split.get("spills", 0),
+            }
+        )
         # durable-exchange spool occupancy (fault-tolerant execution):
         # present when the embedding coordinator has exchange.spool-path
         # configured (server.spool shares the directory with workers)
@@ -295,6 +323,52 @@ class SystemConnector(Connector):
                     "hits": s["hits"],
                     "misses": s["misses"],
                     "evictions": s["evictions"],
+                }
+            )
+        return rows
+
+    def _memory_rows(self):
+        """Cluster memory plane (reference: system.memory — per-node
+        pool occupancy): the coordinator's arbiter serves the folded
+        per-node/per-query view plus its kill decisions; a plain local
+        runner serves its own pool's snapshot."""
+        cluster = getattr(self._runner, "cluster", None)
+        arbiter = getattr(cluster, "arbiter", None) if cluster else None
+        if arbiter is not None:
+            return arbiter.view_rows()
+        pool = getattr(self._runner, "memory_pool", None)
+        if pool is None:
+            return []
+        snap = pool.snapshot()
+        cache = getattr(self._runner, "split_cache", None)
+        spilled = cache.spill_used_bytes() if cache is not None else 0
+        rows = [
+            {
+                "node_id": "local",
+                "query_id": "",
+                "state": "BLOCKED" if snap["blocked"] else "OK",
+                "reserved_bytes": snap["reserved"],
+                "peak_bytes": max(
+                    snap["peak"].values(), default=0
+                ),
+                "blocked_bytes": sum(
+                    b["bytes"] for b in snap["blocked"]
+                ),
+                "spilled_bytes": spilled,
+                "limit_bytes": snap["limit"],
+            }
+        ]
+        for owner, nbytes in sorted(snap["used"].items()):
+            rows.append(
+                {
+                    "node_id": "local",
+                    "query_id": owner,
+                    "state": "RESERVED",
+                    "reserved_bytes": nbytes,
+                    "peak_bytes": snap["peak"].get(owner, nbytes),
+                    "blocked_bytes": 0,
+                    "spilled_bytes": 0,
+                    "limit_bytes": snap["limit"],
                 }
             )
         return rows

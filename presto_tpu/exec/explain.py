@@ -294,6 +294,13 @@ def render_distributed_analyze(
             f"spool_pages_served {qstats.spool_pages_served}, "
             f"query_restarts {qstats.query_restarts}"
         )
+    # cluster memory governance rollup (server/memory_arbiter.py):
+    # the query's cluster-wide reservation view + host-spill traffic
+    lines.append(
+        f"memory: peak {qstats.peak_memory_bytes}B, "
+        f"current {qstats.current_memory_bytes}B, "
+        f"spilled {qstats.spilled_bytes}B"
+    )
     for st in qstats.stages:
         r = st.rollup()
         lines.append(

@@ -212,6 +212,10 @@ class LocalQueryRunner:
             else staging_cache_bytes,
             pool=memory_pool,
         )
+        # host-spill attribution: restage traffic a query pays (its
+        # scan hit a spilled-to-host page) lands on its stats sink —
+        # the per-query spilled_bytes QueryInfo/EXPLAIN ANALYZE report
+        self.split_cache.on_restage = self._note_spilled
         # QueryStats while a query is in flight — THREAD-local: a
         # server embedding this runner executes admitted queries on
         # concurrent threads, and a shared slot races (one thread's
@@ -1602,6 +1606,17 @@ class LocalQueryRunner:
                 if walk_id == root_walk:
                     op.wall_ms += wall_ms
                     op.device_ms += device_ms
+
+    def _note_spilled(self, nbytes: int) -> None:
+        """Attribute host-spill restage bytes to the active stats sink
+        (the split cache's ``on_restage`` hook)."""
+        qs = self._active_qs
+        if qs is None:
+            return
+        with self._qs_mu:
+            qs.spilled_bytes = (
+                getattr(qs, "spilled_bytes", 0) + int(nbytes)
+            )
 
     def _note_cache_hit(self) -> None:
         """Attribute one split-cache hit to the active stats sink."""

@@ -207,6 +207,25 @@ def merge_column_chunks(parts: List[object], dtype=None):
     return merged["c"]
 
 
+def page_to_host(page: Page):
+    """Pull a staged page's device buffers back to host RAM (the spill
+    write of the host-spill lane). Pages are pytrees, so the transfer
+    is one generic device_get over data/validity/offsets/children —
+    static aux (dtype, dictionary, names) rides along untouched."""
+    import jax
+
+    return jax.device_get(page)
+
+
+def host_to_page(host) -> Page:
+    """Restage a spilled host pytree back onto the device (the staged
+    twin of :func:`page_to_host`; lives HERE so every host->device
+    transfer stays in this module — tools/check_device_puts.py)."""
+    import jax
+
+    return jax.tree_util.tree_map(jnp.asarray, host)
+
+
 def page_nbytes(page: Page) -> int:
     """Device bytes a staged page holds (data/validity/offsets buffers,
     recursing into array/map/row children) — the accounting unit for
@@ -248,6 +267,17 @@ class SplitCache:
     ``staging.cache_evict`` counters plus the ``staging.cache_bytes``
     occupancy distribution; live occupancy is served by
     ``system.runtime.caches``.
+
+    Host-spill lane (cluster memory governance): with a non-zero
+    ``spill_bytes`` budget, an evicted entry — LRU budget pressure or
+    a running query's pool-pressure reclaim — moves its page to a
+    host-RAM spill store (``page_to_host``) instead of being dropped:
+    its HBM reservation is released immediately, but a later ``get``
+    restages the host copy (``host_to_page``) and re-admits it under
+    the normal budget/pool discipline — the data gets slower, not
+    dead. Spilled bytes are accounted (``spill_*`` stats fields),
+    metered (``spill.*`` metrics), and visible in
+    ``system.runtime.caches`` / ``system.runtime.memory``.
     """
 
     #: pool owner shared by every cached page (excluded from the
@@ -255,7 +285,7 @@ class SplitCache:
     OWNER = "table-cache"
 
     def __init__(self, budget_bytes: int = DEFAULT_CACHE_BYTES,
-                 pool=None):
+                 pool=None, spill_bytes: int = 0):
         self.budget = int(budget_bytes)
         self.pool = pool
         self._lock = threading.RLock()
@@ -269,12 +299,33 @@ class SplitCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: host-RAM spill store: key -> (host pytree, nbytes). 0
+        #: budget = the lane is off and eviction drops pages exactly
+        #: as before (tier-1: memory.host-spill-bytes)
+        self.spill_budget = int(spill_bytes)
+        self._spill: "collections.OrderedDict" = collections.OrderedDict()
+        self._spill_bytes = 0
+        self.spills = 0
+        self.restages = 0
+        #: optional ``(nbytes) -> None`` hook: attributes restage
+        #: traffic to the active query/task stats sink (the runner
+        #: wires it so per-query spilled bytes surface in QueryInfo)
+        self.on_restage = None
         if pool is not None and hasattr(pool, "add_pressure_hook"):
             # yield cached bytes to running queries on pool pressure:
             # a query's raising reserve evicts LRU cache entries
             # before the kill-largest policy fires — droppable cache
             # must never cost a live query its reservation
             pool.add_pressure_hook(self.evict_bytes)
+
+    def set_spill_budget(self, nbytes: int) -> None:
+        """(Re)size the host-spill budget (the worker wires the tier-1
+        ``memory.host-spill-bytes`` key here after construction)."""
+        with self._lock:
+            self.spill_budget = int(nbytes)
+            while self._spill_bytes > self.spill_budget:
+                if not self._drop_one_spilled():
+                    break
 
     # ------------------------------------------------------------ access
 
@@ -293,9 +344,94 @@ class SplitCache:
                 self.hits += 1
                 REGISTRY.counter("staging.cache_hit").update()
                 return entry[0]
+            page = self._restage_spilled(key, pin)
+            if page is not None:
+                # the host copy saved the connector read AND is back on
+                # device: a (slower) hit, not a miss
+                self.hits += 1
+                REGISTRY.counter("staging.cache_hit").update()
+                return page
             self.misses += 1
             REGISTRY.counter("staging.cache_miss").update()
             return None
+
+    def _restage_spilled(self, key, pin: bool) -> Optional[Page]:
+        """Spill-store lookup (caller holds the lock): restage the host
+        copy to device and re-admit it under the normal budget/pool
+        discipline. Returns None when nothing is spilled under ``key``
+        or re-admission does not fit (the host copy stays spilled and
+        the caller falls back to a plain miss — correct, just slower)."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        got = self._spill.get(key)
+        if got is None:
+            return None
+        host, nbytes = got
+        page = host_to_page(host)
+        # remove from the spill store BEFORE re-admission: put() may
+        # evict (and re-spill) other entries to make room, and its
+        # _drop_one_spilled must never pop THIS key out from under the
+        # accounting below (a double subtraction)
+        self._spill.pop(key, None)
+        self._spill_bytes -= nbytes
+        if not self.put(key, page, nbytes, pin=pin):
+            # no device room: the host copy stays spilled (re-inserted
+            # as newest; trim back under budget if re-admission's
+            # eviction traffic overfilled the store meanwhile)
+            self._spill[key] = (host, nbytes)
+            self._spill_bytes += nbytes
+            while self._spill_bytes > self.spill_budget:
+                if not self._drop_one_spilled():
+                    break
+            return None
+        self.restages += 1
+        REGISTRY.counter("spill.pages_restaged").update()
+        REGISTRY.counter("spill.bytes_restaged").update(nbytes)
+        REGISTRY.distribution("spill.pool_bytes").add(self._spill_bytes)
+        if self.on_restage is not None:
+            try:
+                self.on_restage(nbytes)
+            except Exception:
+                pass  # attribution must never fail the staging path
+        return page
+
+    def _spill_out(self, key, page: Page, nbytes: int) -> bool:
+        """Move an evicted entry's page to the host spill store (caller
+        holds the lock). False when the lane is off or the page cannot
+        fit even after dropping older spilled entries — the caller
+        drops the page, exactly the pre-spill behavior."""
+        if self.spill_budget <= 0 or nbytes > self.spill_budget:
+            return False
+        return self._spill_insert(key, page_to_host(page), nbytes)
+
+    def _spill_insert(self, key, host, nbytes: int) -> bool:
+        """Admit an already-copied host tree into the spill store,
+        trimming older entries under the budget (caller holds the
+        lock; the device->host copy happened in the caller)."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        while self._spill_bytes + nbytes > self.spill_budget:
+            if not self._drop_one_spilled():
+                return False
+        self._spill.pop(key, None)
+        self._spill[key] = (host, nbytes)
+        self._spill_bytes += nbytes
+        self.spills += 1
+        REGISTRY.counter("spill.pages_spilled").update()
+        REGISTRY.counter("spill.bytes_spilled").update(nbytes)
+        REGISTRY.distribution("spill.pool_bytes").add(self._spill_bytes)
+        return True
+
+    def _drop_one_spilled(self) -> bool:
+        """Drop the oldest spilled entry (caller holds the lock)."""
+        from presto_tpu.utils.metrics import REGISTRY
+
+        if not self._spill:
+            return False
+        _key, (_host, nbytes) = self._spill.popitem(last=False)
+        self._spill_bytes -= nbytes
+        REGISTRY.counter("spill.pages_dropped").update()
+        return True
 
     def unpin(self, key) -> None:
         """Drop one pin (no-op for unknown/already-invalidated keys)."""
@@ -372,7 +508,11 @@ class SplitCache:
         )
         if key is None:
             return False
-        _page, nbytes = self._entries.pop(key)
+        page, nbytes = self._entries.pop(key)
+        # degrade before you drop: offload the page to the host spill
+        # store (lane off / full = plain drop, the legacy behavior);
+        # either way the DEVICE bytes free right now
+        self._spill_out(key, page, nbytes)
         self._release(nbytes)
         self.evictions += 1
         REGISTRY.counter("staging.cache_evict").update()
@@ -388,6 +528,7 @@ class SplitCache:
 
         freed = 0
         evicted = 0
+        dropped = []
         with self._lock:
             while freed < needed:
                 key = next(
@@ -396,11 +537,25 @@ class SplitCache:
                 )
                 if key is None:
                     break
-                _page, nbytes = self._entries.pop(key)
+                page, nbytes = self._entries.pop(key)
+                dropped.append((key, page, nbytes))
                 self._release(nbytes)
                 freed += nbytes
                 evicted += 1
             self.evictions += evicted
+        # host-spill lane: a blocked query's reservation reclaims the
+        # DEVICE bytes above while the pages survive in host RAM —
+        # over-capacity work gets slower, not dead. The device->host
+        # copies run OUTSIDE the cache lock: this hook fires on the
+        # memory-pressure hot path, and concurrent scans must not
+        # stall behind multi-MB DMA transfers (the page objects stay
+        # alive here, so the copy is safe after the accounting freed)
+        for key, page, nbytes in dropped:
+            if self.spill_budget <= 0 or nbytes > self.spill_budget:
+                continue
+            host = page_to_host(page)  # DMA, no lock held
+            with self._lock:
+                self._spill_insert(key, host, nbytes)
         if evicted:
             REGISTRY.counter("staging.cache_evict").update(evicted)
             REGISTRY.distribution("staging.cache_bytes").add(
@@ -418,6 +573,10 @@ class SplitCache:
                 _page, nbytes = self._entries.pop(k)
                 self._release(nbytes)
                 self._pins.pop(k, None)
+            # spilled copies of a written/dropped table are stale too
+            for k in [k for k in self._spill if k[0] == handle]:
+                _host, nbytes = self._spill.pop(k)
+                self._spill_bytes -= nbytes
             return len(stale)
 
     def clear(self) -> None:
@@ -426,12 +585,20 @@ class SplitCache:
                 self._release(nbytes)
             self._entries.clear()
             self._pins.clear()
+            self._spill.clear()
+            self._spill_bytes = 0
 
     # ------------------------------------------------------------- stats
 
     def used_bytes(self) -> int:
         with self._lock:
             return self._bytes
+
+    def spill_used_bytes(self) -> int:
+        """Live host-RAM occupancy of the spill store (the heartbeat
+        report's ``spilled_bytes``)."""
+        with self._lock:
+            return self._spill_bytes
 
     def stats(self) -> dict:
         with self._lock:
@@ -442,6 +609,11 @@ class SplitCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "spill_entries": len(self._spill),
+                "spill_bytes": self._spill_bytes,
+                "spill_budget_bytes": self.spill_budget,
+                "spills": self.spills,
+                "restages": self.restages,
             }
 
 

@@ -140,6 +140,10 @@ class TaskStats:
     #: upstream exchange pages this task re-served from the durable
     #: spool instead of a (dead) producer worker (server.spool)
     spool_pages_served: int = 0
+    #: host-spill restage bytes this task paid (its scans hit pages
+    #: that had been offloaded to the host-RAM spill pool under HBM
+    #: pressure — cluster memory governance)
+    spilled_bytes: int = 0
     device_fragments: int = 0
     #: this attempt was a speculative (backup) launch of a straggling
     #: range — winners and losers both carry the flag in the rollup
@@ -198,6 +202,7 @@ class StageStats:
             "spool_pages_served": sum(
                 t.spool_pages_served for t in self.tasks
             ),
+            "spilled_bytes": sum(t.spilled_bytes for t in self.tasks),
             "failed_tasks": sum(
                 1 for t in self.tasks if t.state == "FAILED"
             ),
@@ -246,6 +251,16 @@ class QueryStats:
     task_recoveries: int = 0  # lost tasks rescheduled mid-stage
     query_restarts: int = 0  # bounded full restarts (retry_policy=QUERY)
     spool_pages_served: int = 0  # upstream pages re-served from the spool
+    #: cluster memory governance (server/memory_arbiter.py): this
+    #: query's cluster-wide reservation view (coordinator pool +
+    #: worker-reported bytes) and the host-spill restage traffic it
+    #: paid — rolled into QueryInfo and the EXPLAIN ANALYZE memory line
+    current_memory_bytes: int = 0
+    peak_memory_bytes: int = 0
+    spilled_bytes: int = 0
+    #: task-side spill bytes already folded into spilled_bytes
+    #: (roll_up delta bookkeeping, like the dynamic-filter fields)
+    _spill_from_tasks: int = 0
     #: task-side portions already folded into dynamic_filter_rows_pruned
     #: / dynamic_filters (roll_up bookkeeping — keeps coordinator-local
     #: additions from gather-splice / local-fallback executions intact;
@@ -339,6 +354,12 @@ class QueryStats:
         task_filters = sum(
             t.dynamic_filters for s in self.stages for t in s.tasks
         )
+        # worker-side host-spill restage traffic folds in as a delta
+        # too (coordinator-local restages accumulate on this field
+        # directly via the runner's on_restage hook)
+        task_spilled = sum(
+            t.spilled_bytes for s in self.stages for t in s.tasks
+        )
         with self._roll_lock:
             self.dynamic_filter_rows_pruned += (
                 task_pruned - self._df_rows_from_tasks
@@ -348,6 +369,8 @@ class QueryStats:
                 task_filters - self._df_filters_from_tasks
             )
             self._df_filters_from_tasks = task_filters
+            self.spilled_bytes += task_spilled - self._spill_from_tasks
+            self._spill_from_tasks = task_spilled
 
     def all_operator_stats(self) -> List[OperatorStats]:
         """Merged per-operator actuals across the whole query: locally
@@ -441,6 +464,9 @@ class QueryStats:
             "task_recoveries": self.task_recoveries,
             "query_restarts": self.query_restarts,
             "spool_pages_served": self.spool_pages_served,
+            "current_memory_bytes": self.current_memory_bytes,
+            "peak_memory_bytes": self.peak_memory_bytes,
+            "spilled_bytes": self.spilled_bytes,
             "input_rows": self.input_rows,
             "input_bytes": self.input_bytes,
             "output_rows": self.output_rows,

@@ -64,6 +64,11 @@ class NoLiveWorkers(RuntimeError):
     for coordinator-local fallback execution."""
 
 
+class MemoryPressureKilled(RuntimeError):
+    """The cluster memory manager killed this query (victim + policy
+    in the message) and no re-admission budget remained."""
+
+
 def _prepare_text(sql: str, name: str) -> str:
     """The inner statement TEXT of ``PREPARE name FROM <statement>`` —
     what the added-prepare response header carries (the parse tree has
@@ -138,6 +143,10 @@ class _Query:
         self._retry_budget: Optional[int] = None
         #: task ids of speculative (backup) attempts, for accounting
         self._speculative: set = set()
+        #: cluster memory manager kill notice (the MEMORY_PRESSURE
+        #: message): set by _apply_memory_kill when the victim may
+        #: re-admit; consumed by the restart loop's re-admission lane
+        self._mem_kill: Optional[str] = None
         #: prepared statements supplied by the CLIENT on this request
         #: (X-Presto-Prepared-Statement headers — the client owns the
         #: map; see server.protocol)
@@ -190,6 +199,7 @@ class CoordinatorServer:
         self.memory_pool = MemoryPool(
             limit, kill_largest=self._kill_largest_query
         )
+        self.memory_pool.node_id = "coordinator"
         # gather-side staging knobs: the coordinator's embedded runner
         # stages gathered pages and coordinator-local scans through the
         # same device-resident split cache / prefetch pipeline the
@@ -383,6 +393,32 @@ class CoordinatorServer:
                 else ResourceGroupManager(resource_groups)
             )
             self.resource_groups.memory_usage_fn = self._group_memory
+        # governance wiring for the coordinator's OWN pool: with the
+        # gate on, over-budget local reservations (gather splices,
+        # local fallback) join the blocked lane — visible to the
+        # arbiter, resolvable by the killer, cancellable on readmit —
+        # and the local split cache gets the host-spill budget, like
+        # any worker. (Enforcement rides worker heartbeats; a
+        # worker-less coordinator still bounds blocked waits by
+        # memory.reserve-block-max-s.)
+        if config and config.get("memory.governance-enabled", False):
+            self.memory_pool.block_timeout_s = float(
+                config.get("memory.reserve-block-max-s", 30.0)
+            )
+            spill_raw = config.get("memory.host-spill-bytes")
+            if spill_raw is not None:
+                self.local.split_cache.set_spill_budget(
+                    parse_bytes(spill_raw)
+                )
+        # cluster memory arbiter (server/memory_arbiter.py): folds the
+        # workers' heartbeat memory reports into one cluster view.
+        # Accounting is ALWAYS on (resource-group quotas and
+        # system.runtime.memory read it); enforcement — admission
+        # high-water, per-query quotas, the low-memory killer — only
+        # under memory.governance-enabled
+        from presto_tpu.server.memory_arbiter import ClusterMemoryArbiter
+
+        self.arbiter = ClusterMemoryArbiter(self, config)
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -582,6 +618,105 @@ class CoordinatorServer:
         REGISTRY.counter("coordinator.queries_killed_oom").update()
         return victim
 
+    # -------------------------------------- cluster memory manager (kills)
+
+    def _apply_memory_kill(
+        self, victim: str, policy: str, reason: str
+    ) -> None:
+        """Apply one arbiter kill decision: journal it, cancel the
+        victim cluster-wide through the workers' task-DELETE path with
+        a MEMORY_PRESSURE error naming victim and policy, and — under
+        ``retry_policy=QUERY`` with restart budget left — leave the
+        query alive for its own execution thread to re-admit once
+        pressure subsides."""
+        q = self.queries.get(victim)
+        if q is None or q.done.is_set():
+            self.arbiter.forget_query(victim)
+            return
+        cur, _peak = self.arbiter.query_bytes(victim)
+        cur += self.memory_pool.used_bytes(victim)
+        msg = (
+            f"Query {victim} killed by the cluster memory manager: "
+            f"MEMORY_PRESSURE (victim {victim}, policy {policy}): "
+            f"{reason}"
+        )
+        readmit = (
+            self._retry_policy() == "QUERY"
+            and int(self.local.session.get("query_retry_count")) > 0
+        )
+        log.warning(
+            "memory kill: %s (readmit=%s)", msg, readmit
+        )
+        if self.journal is not None:
+            self.journal.record_kill(victim, policy, reason, cur)
+        self.arbiter.record_kill(victim, policy, reason, cur)
+        # the flag gates task-retry/speculation/local-fallback in both
+        # modes: a killed attempt's DELETEd tasks look like lost
+        # workers, and resurrecting them would re-consume the memory
+        # the kill just freed
+        q._mem_kill = msg
+        if readmit:
+            # in-thread re-admission: _run_sql_with_restart waits out
+            # the pressure and re-runs within query_retry_count
+            self.memory_pool.cancel_blocked(victim)
+        else:
+            q.fail(msg)
+            q.done.set()
+            # cooperative cancel, exactly like the local kill-largest
+            # policy: the victim cannot grow, its thread fails at the
+            # next reservation
+            self.memory_pool.mark_dead(victim)
+            self.memory_pool.cancel_blocked(victim)
+        self._cancel_query_on_workers(victim)
+
+    def _cancel_query_on_workers(self, qid: str) -> None:
+        """Tear the victim's tasks down on every discovered worker
+        (each worker routes the abort through its task-DELETE path and
+        fails the victim's blocked reservations). Best-effort and
+        off-thread: a hung worker must not stall the kill."""
+
+        def run():
+            policy = rpc.RpcPolicy(timeout_s=5.0, retries=0)
+            for w in self._ttl_workers():
+                try:
+                    rpc.call_json(
+                        "PUT",
+                        w.uri + "/v1/memory/abort",
+                        {"query_id": qid},
+                        policy=policy,
+                    )
+                except Exception:
+                    pass
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _await_memory_calm(self, q: _Query) -> None:
+        """Hold a killed-but-re-admittable victim until cluster
+        pressure subsides (below low-water, nothing blocked), bounded
+        by the query's own run-time limit."""
+        deadline = time.monotonic() + float(
+            self.local.session.get("query_max_run_time_s")
+        )
+        while (
+            not q.done.is_set()
+            and not self._shutting_down
+            and time.monotonic() < deadline
+        ):
+            if self.arbiter.pressure_subsided():
+                return
+            time.sleep(0.05)
+
+    def _fold_memory_stats(self, q: _Query) -> None:
+        """Roll the query's cluster-wide memory view (coordinator pool
+        + worker-reported bytes) into its stats — the QueryInfo /
+        EXPLAIN ANALYZE "memory:" numbers."""
+        cur, peak = self.arbiter.query_bytes(q.qid)
+        cur += self.memory_pool.used_bytes(q.qid)
+        peak += self.memory_pool.peak_bytes(q.qid)
+        q.stats.current_memory_bytes = cur
+        if peak > q.stats.peak_memory_bytes:
+            q.stats.peak_memory_bytes = peak
+
     # ---------------------------------------------------------- discovery
 
     def announce(
@@ -590,6 +725,7 @@ class CoordinatorServer:
         uri: str,
         state: str = "ACTIVE",
         preemptible: bool = False,
+        memory: Optional[dict] = None,
     ) -> None:
         with self._lock:
             w = self.workers.get(node_id)
@@ -603,6 +739,10 @@ class CoordinatorServer:
                 w.uri = uri
                 w.state = state
                 w.preemptible = bool(preemptible)
+        # fold the heartbeat's memory report into the cluster view —
+        # OUTSIDE the discovery lock (enforcement may scan queries)
+        if memory is not None:
+            self.arbiter.observe(node_id, memory)
 
     def _ttl_workers(self) -> List[_WorkerNode]:
         """Workers announced within the discovery TTL (no breaker
@@ -727,6 +867,12 @@ class CoordinatorServer:
         """Consume one unit of the query's task-retry budget (the
         generalization of the old retry-once: bounded per QUERY, not
         per range)."""
+        # a memory-pressure-killed query must not resurrect through
+        # task-level recovery: its DELETEd tasks look like lost
+        # workers, but re-running them would re-consume the memory the
+        # kill just freed
+        if getattr(q, "_mem_kill", None) is not None:
+            return False
         with q._stats_lock:
             if q._retry_budget is None:
                 q._retry_budget = int(
@@ -764,7 +910,11 @@ class CoordinatorServer:
 
     def _group_memory(self, group_name: str) -> int:
         """Bytes reserved by running queries of one resource group (the
-        manager's softMemoryLimit eligibility hook)."""
+        manager's softMemoryLimit eligibility hook): coordinator-local
+        reservations PLUS the worker-reported bytes the arbiter folds
+        from heartbeats — a distributed memory hog trips its group
+        quota even when every byte lives worker-side (the historical
+        under-accounting counted only coordinator-local bytes)."""
         with self._lock:
             # live queries only: finished queries hold no reservations
             qids = [
@@ -773,7 +923,8 @@ class CoordinatorServer:
                 if not q.done.is_set()
                 and getattr(q, "resource_group", None) == group_name
             ]
-        return sum(self.memory_pool.used_bytes(qid) for qid in qids)
+        local = sum(self.memory_pool.used_bytes(qid) for qid in qids)
+        return local + self.arbiter.queries_bytes(qids)
 
     def submit(
         self,
@@ -867,6 +1018,16 @@ class CoordinatorServer:
 
     def _execute_query(self, q: _Query) -> None:
         with self._admit:  # admission gate: bounded concurrency
+            # admission high-water (cluster memory governance): while
+            # the cluster's query-attributed usage is over
+            # memory.admission-high-water, QUEUED queries are HELD —
+            # never failed — and release on the low-water hysteresis
+            while (
+                not q.done.is_set()
+                and not self._shutting_down
+                and self.arbiter.admission_held()
+            ):
+                time.sleep(0.05)
             if q.done.is_set():  # killed while queued (memory manager)
                 with self._lock:
                     self._pending -= 1
@@ -948,21 +1109,48 @@ class CoordinatorServer:
                 ):
                     return self._run_sql(q)
             except Exception as e:
+                mem_kill = getattr(q, "_mem_kill", None)
                 restartable = rpc.is_task_recoverable(e) or isinstance(
                     e, NoLiveWorkers
                 )
-                if (
+                if mem_kill is not None:
+                    # cluster memory manager kill: re-admit the victim
+                    # after pressure subsides — within the SAME bounded
+                    # query_retry_count budget as connection restarts
+                    if attempt >= budget or q.done.is_set():
+                        raise MemoryPressureKilled(mem_kill) from e
+                    attempt += 1
+                    REGISTRY.counter(
+                        "memory.victims_readmitted"
+                    ).update()
+                    log.warning(
+                        "query=%s re-admitting memory-pressure victim "
+                        "(attempt %d/%d)", q.qid, attempt, budget,
+                    )
+                    # surrender this attempt's residency before the
+                    # wait: the victim must not hold bytes while the
+                    # cluster drains
+                    self.local.release_pins(q.stats)
+                    self.memory_pool.release(q.qid)
+                    self._await_memory_calm(q)
+                    q._mem_kill = None
+                    self.arbiter.forget_query(q.qid)
+                elif (
                     attempt >= budget
                     or not restartable
                     or q.done.is_set()
                 ):
                     raise
-                attempt += 1
-                REGISTRY.counter("coordinator.query_restarts").update()
-                log.warning(
-                    "query=%s restarting (attempt %d/%d) after %s: %s",
-                    q.qid, attempt, budget, type(e).__name__, e,
-                )
+                else:
+                    attempt += 1
+                    REGISTRY.counter(
+                        "coordinator.query_restarts"
+                    ).update()
+                    log.warning(
+                        "query=%s restarting (attempt %d/%d) after "
+                        "%s: %s",
+                        q.qid, attempt, budget, type(e).__name__, e,
+                    )
                 # close out the failed attempt's partial state: stages
                 # left RUNNING become ABORTED, partial results dropped
                 with q._stats_lock:
@@ -1003,6 +1191,7 @@ class CoordinatorServer:
             res = self._run_select(q, stmt.statement, workers)
             q.stats.output_rows = int(res.page.num_valid)
             q._output_rows_final = True
+            self._fold_memory_stats(q)
             q.stats.roll_up()
             # provisionally close the root span for the rendering (the
             # context manager records the real end on exit), so the
@@ -1282,6 +1471,9 @@ class CoordinatorServer:
         # real output count; q.rows there holds plan-text lines
         if not q._output_rows_final:
             q.stats.output_rows = len(q.rows)
+        # final memory rollup while the reservations are still live
+        # (the pool releases right after this in _execute_query)
+        self._fold_memory_stats(q)
         # close any stage a failed (or early-exited) path left open:
         # a finished query must not report RUNNING stages — and no
         # task may stay RUNNING either (a timed-out pull records a
@@ -1417,6 +1609,8 @@ class CoordinatorServer:
         """Full QueryInfo (reference: ``GET /v1/query/{id}``): the
         stats rollup, per-stage task stats, and the span tree —
         servable while the query is RUNNING."""
+        if not q.done.is_set():
+            self._fold_memory_stats(q)
         q.stats.roll_up()
         info = q.stats.to_dict(include_stages=True)
         info["state"] = q.state  # _Query.state is authoritative
@@ -2027,6 +2221,10 @@ class CoordinatorServer:
         degradable = rpc.is_task_recoverable(exc) or isinstance(
             exc, NoLiveWorkers
         )
+        # a memory-pressure kill DELETEs the victim's tasks — that
+        # must surface as the kill, not trigger a local resurrection
+        if getattr(q, "_mem_kill", None) is not None:
+            return None
         if not degradable or self._any_worker_alive():
             return None
         REGISTRY.counter("coordinator.local_fallbacks").update()
@@ -2490,7 +2688,8 @@ class CoordinatorServer:
                 except (
                     urllib.error.URLError, ConnectionError, OSError
                 ):
-                    self._worker_failed(w)
+                    if getattr(q, "_mem_kill", None) is None:
+                        self._worker_failed(w)
                     others = stable_workers(
                         self.active_workers(exclude={w.node_id})
                     )
@@ -2673,9 +2872,15 @@ class CoordinatorServer:
                     # failures — they would fail anywhere.
                     recoverable = rpc.is_task_recoverable(e)
                     if recoverable:
-                        if not _is_draining_503(e):
-                            # a graceful drain is not a failure: no
-                            # breaker penalty for leaving politely
+                        if not _is_draining_503(e) and (
+                            q is None
+                            or getattr(q, "_mem_kill", None) is None
+                        ):
+                            # a graceful drain is not a failure, and
+                            # neither is a memory-pressure kill (the
+                            # 404s on the victim's DELETEd tasks come
+                            # from the kill, not worker health): no
+                            # breaker penalty for either
                             self._worker_failed(worker)
                         with cond:
                             state["conn_errors"].append(e)
@@ -2995,6 +3200,7 @@ def _make_handler(coord: CoordinatorServer):
                 coord.announce(
                     d["node_id"], d["uri"], d.get("state", "ACTIVE"),
                     preemptible=bool(d.get("preemptible", False)),
+                    memory=d.get("memory"),
                 )
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})

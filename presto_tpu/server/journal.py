@@ -314,6 +314,23 @@ class CoordinatorJournal:
             rec["resumed_as"] = resumed_as
         self._append(rec)
 
+    def record_kill(
+        self, qid: str, policy: str, reason: str, nbytes: int = 0
+    ) -> None:
+        """One cluster-memory-manager kill decision (server/
+        memory_arbiter.py): pure audit trail — replay ignores it (the
+        victim's terminal finish frame, or its re-admission's submit
+        frame, carries the state the journal enforces)."""
+        self._append(
+            {
+                "ev": "kill",
+                "qid": qid,
+                "policy": policy,
+                "reason": reason,
+                "bytes": int(nbytes),
+            }
+        )
+
     def record_prepare(self, name: str, sql: str) -> None:
         self._append({"ev": "prepare", "name": name, "sql": sql})
 

@@ -243,6 +243,21 @@ class WorkerServer:
             or "8GB"
         )
         self.memory_pool = MemoryPool(limit)
+        self.memory_pool.node_id = self.node_id
+        # cluster memory governance (server/memory_arbiter.py): with
+        # the gate ON, an over-budget reservation BLOCKS (visible on
+        # the heartbeat report, resolvable by the coordinator's
+        # low-memory killer) instead of failing outright; OFF is the
+        # bit-exact fail-fast legacy path
+        self._governance = bool(
+            config.get("memory.governance-enabled", False)
+            if config
+            else False
+        )
+        if self._governance:
+            self.memory_pool.block_timeout_s = float(
+                config.get("memory.reserve-block-max-s", 30.0)
+            )
         # device-resident split cache (tier-1: staging.cache-bytes,
         # 0 disables): the LRU byte budget + try_reserve discipline
         # make always-on safe on the worker hot path — repeated
@@ -263,6 +278,18 @@ class WorkerServer:
         )
         if cache_bytes > 0:
             self.runner.session.set("stream_split_cache", True)
+        # host-spill lane (degrade before you kill): under HBM
+        # pressure, evicted split-cache pages offload to a host-RAM
+        # pool of this budget and restage on demand — gated with the
+        # governance plane so the default stays bit-exact pre-PR
+        if self._governance:
+            spill_raw = (
+                config.get("memory.host-spill-bytes") if config else None
+            )
+            if spill_raw is not None:
+                self.runner.split_cache.set_spill_budget(
+                    parse_bytes(spill_raw)
+                )
         prefetch = (
             config.get("staging.prefetch-depth") if config else None
         )
@@ -455,12 +482,28 @@ class WorkerServer:
     def _announce_state(self) -> str:
         return "DRAINING" if self._draining else "ACTIVE"
 
+    def _memory_report(self) -> dict:
+        """Per-query/per-owner memory accounting for the heartbeat
+        (cluster memory governance: the coordinator's arbiter folds
+        these into its cluster view) — the shared
+        ``rollup_query_report`` fold over this node's pool snapshot
+        plus the host-spill occupancy."""
+        from presto_tpu.exec.staging import SplitCache
+        from presto_tpu.utils.memory import rollup_query_report
+
+        return rollup_query_report(
+            self.memory_pool.snapshot(),
+            SplitCache.OWNER,
+            self.runner.split_cache.spill_used_bytes(),
+        )
+
     def _announce_body(self) -> dict:
         return {
             "node_id": self.node_id,
             "uri": self.uri,
             "state": self._announce_state(),
             "preemptible": self.preemptible,
+            "memory": self._memory_report(),
         }
 
     def _announce_once(self) -> None:
@@ -1058,15 +1101,51 @@ class WorkerServer:
                 state = "DRAINING"
             else:
                 state = "ACTIVE"
-            return {
-                "node_id": self.node_id,
-                "state": state,
-                "uri": self.uri,
-                "preemptible": self.preemptible,
-                "tasks": {
-                    tid: t.state for tid, t in self.tasks.items()
-                },
-            }
+            tasks = {tid: t.state for tid, t in self.tasks.items()}
+        return {
+            "node_id": self.node_id,
+            "state": state,
+            "uri": self.uri,
+            "preemptible": self.preemptible,
+            "tasks": tasks,
+            "memory": self._memory_report(),
+        }
+
+    def delete_task(self, task_id: str) -> bool:
+        """The one task-teardown primitive (the DELETE route and the
+        cluster memory manager's abort both ride it): drop the task,
+        abort its execution, free its buffered bytes."""
+        with self._lock:
+            t = self.tasks.pop(task_id, None)
+        if t is None:
+            return False
+        t.abort()
+        t.drop_buffers()
+        return True
+
+    def abort_query(self, query_id: str) -> int:
+        """Cluster-wide cancellation, worker side (the low-memory
+        killer's ``PUT /v1/memory/abort``): tear down every task of
+        the victim through the task-DELETE path and fail its blocked
+        reservations — WITHOUT poisoning the query id, so a
+        ``retry_policy=QUERY`` re-admission can reserve again."""
+        with self._lock:
+            doomed = [
+                tid
+                for tid, t in self.tasks.items()
+                if t.spec.query_id == query_id
+            ]
+        n = 0
+        for tid in doomed:
+            if self.delete_task(tid):
+                n += 1
+        self.memory_pool.cancel_blocked(query_id)
+        if n:
+            log.warning(
+                "node=%s memory manager aborted %d task(s) of %s",
+                self.node_id, n, query_id,
+            )
+        return n
 
 
 def _emit_partitioned(task: "_Task", out) -> None:
@@ -1266,11 +1345,7 @@ def _make_handler(worker: WorkerServer):
         def do_DELETE(self):
             parts = [p for p in self.path.split("/") if p]
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-                with worker._lock:
-                    t = worker.tasks.pop(parts[2], None)
-                if t is not None:
-                    t.abort()
-                    t.drop_buffers()
+                worker.delete_task(parts[2])
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -1281,6 +1356,17 @@ def _make_handler(worker: WorkerServer):
                     target=worker.shutdown, daemon=True
                 ).start()
                 return self._json(200, {"ok": True})
+            if parts == ["v1", "memory", "abort"]:
+                # cluster memory manager kill, worker side: tear down
+                # the victim's tasks (task-DELETE path) and fail its
+                # blocked reservations
+                body = json.loads(self._read_body() or b"{}")
+                qid = body.get("query_id", "")
+                if not qid:
+                    return self._json(400, {"error": "query_id required"})
+                return self._json(
+                    200, {"ok": True, "aborted": worker.abort_query(qid)}
+                )
             if parts == ["v1", "state", "drain"]:
                 # graceful drain: stop accepting, finish + serve/spool
                 # running outputs, announce DRAINING, exit clean

@@ -393,6 +393,24 @@ class NodeConfig:
         "http-server.port": int,
         "discovery.uri": str,
         "query.max-memory-per-node": str,
+        # cluster memory governance (server/memory_arbiter.py): the
+        # master gate (false = bit-exact pre-governance behavior), the
+        # cluster-wide per-query cap, the admission high/low water
+        # marks (fractions of the cluster's query-attributed capacity;
+        # QUEUED queries are HELD, never failed, while over high
+        # water), the blocked-reservation age that triggers the
+        # low-memory killer, the longest a worker reservation may
+        # block before failing, the victim policy
+        # (total-reservation | last-admitted), and the host-RAM spill
+        # budget for the degrade-before-kill lane
+        "memory.governance-enabled": bool,
+        "query.max-memory": str,
+        "memory.admission-high-water": float,
+        "memory.admission-low-water": float,
+        "memory.blocked-timeout-s": float,
+        "memory.reserve-block-max-s": float,
+        "memory.kill-policy": str,
+        "memory.host-spill-bytes": str,
         "exchange.max-buffer-size": str,
         "task.concurrency": int,
         # query-completed JSONL sink (reference: event-listener.properties)

@@ -28,6 +28,10 @@ Rule spec (all match fields optional; empty matches everything)::
        {"action": "kill_worker_preempt", "node": "worker-ab"},
        {"action": "spool_corrupt", "task": ".prod."},
        {"action": "kill_worker_draining", "node": "worker-ab"},
+       {"action": "reserve_fail", "owner": "q_c1_", "skip": 2,
+        "count": 1},
+       {"action": "mem_pressure", "node": "worker-ab",
+        "budget": 65536},
      ]}
 
 ``count`` bounds how many times a rule fires (default unlimited),
@@ -64,6 +68,13 @@ SPOOL_ACTIONS = ("spool_corrupt",)
 #: crashes a worker WHILE it is draining — the drain protocol must
 #: stay recoverable mid-handshake
 DRAIN_ACTIONS = ("kill_worker_draining",)
+#: actions injected at the MemoryPool reserve hook (utils.memory):
+#: ``reserve_fail`` forces a pool reservation failure at the Nth
+#: matched reserve (skip/count bound it); ``mem_pressure`` shrinks the
+#: pool's effective budget to ``budget`` bytes mid-query — both make
+#: the low-memory killer and host-spill paths chaos-testable without
+#: real HBM exhaustion
+MEM_ACTIONS = ("reserve_fail", "mem_pressure")
 
 
 class FaultInjectedError(ConnectionError):
@@ -79,12 +90,14 @@ class FaultRule:
     action: str
     method: str = ""  # exact HTTP method ("" = any)
     url: str = ""  # URL substring ("" = any)
-    node: str = ""  # node-id substring (task hook)
+    node: str = ""  # node-id substring (task + reserve hooks)
     task: str = ""  # task-id substring (task hook)
+    owner: str = ""  # pool-owner/query-id substring (reserve hook)
     delay_s: float = 0.0
     count: int = -1  # firings remaining (-1 = unlimited)
     skip: int = 0  # matches to pass through before firing
     prob: float = 1.0  # firing probability (plane-seeded RNG)
+    budget: int = 0  # mem_pressure: shrink the pool to this many bytes
 
     @staticmethod
     def from_dict(d: dict) -> "FaultRule":
@@ -98,6 +111,7 @@ class FaultRule:
             | set(TASK_ACTIONS)
             | set(SPOOL_ACTIONS)
             | set(DRAIN_ACTIONS)
+            | set(MEM_ACTIONS)
         )
         if rule.action not in known_actions:
             raise ValueError(f"unknown fault action: {rule.action!r}")
@@ -219,6 +233,28 @@ class FaultPlane:
                 return True
         return False
 
+    def on_reserve(self, node_id: str, owner: str):
+        """MemoryPool reserve hook: returns ``("reserve_fail", None)``
+        when a reserve_fail rule fires (the pool raises its own
+        MemoryLimitExceeded — this module must not import utils.memory)
+        or ``("mem_pressure", budget)`` when a mem_pressure rule fires
+        (the pool shrinks its effective budget); None otherwise."""
+        for rule in self.rules:
+            if rule.action not in MEM_ACTIONS:
+                continue
+            if rule.method or rule.url or rule.task:
+                continue  # RPC-/task-scoped rules stay out of the pool
+            if rule.node and rule.node not in node_id:
+                continue
+            if rule.owner and rule.owner not in owner:
+                continue
+            if not self._fire(rule):
+                continue
+            if rule.action == "mem_pressure":
+                return ("mem_pressure", int(rule.budget))
+            return ("reserve_fail", None)
+        return None
+
     def on_drain(self, node_id: str, kill=None) -> None:
         """Worker drain hook: a ``kill_worker_draining`` rule crashes
         the worker mid-drain (abrupt socket close via ``kill``, then
@@ -277,6 +313,15 @@ def maybe_inject_drain(node_id: str, kill=None) -> None:
     plane = _PLANE
     if plane is not None:
         plane.on_drain(node_id, kill=kill)
+
+
+def maybe_inject_reserve(node_id: str, owner: str):
+    """Pool-reserve hook (utils.memory): None, or an action tuple —
+    ``("reserve_fail", None)`` / ``("mem_pressure", budget_bytes)``."""
+    plane = _PLANE
+    if plane is None:
+        return None
+    return plane.on_reserve(node_id, owner)
 
 
 _env_spec = os.environ.get("PRESTO_TPU_FAULTS")
