@@ -22,8 +22,27 @@ protocol.
 """
 
 import json
+import os
 import sys
 import time
+
+
+def _analysis_clean():
+    """True when the static-analysis gate (tools/analyze.py) is clean
+    on this tree at measurement time — recorded on the report header
+    line so BENCH_* records carry the lint state of what was measured.
+    None (json null) when the framework cannot run; never an error."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        tools = os.path.join(here, "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import analysis
+
+        findings = analysis.run_passes(os.path.join(here, "presto_tpu"))
+        return not any(f.active for f in findings)
+    except Exception:
+        return None
 
 # Measured CPU baseline (BASELINE.md "Measured baselines" table):
 # this engine, Q1@SF1, same protocol (warmup 1 + best of 5), on the
@@ -514,6 +533,7 @@ def main() -> None:
                     "unit": "rows/s",
                     "vs_baseline": round(vs, 3),
                     "backend": backend,
+                    "analysis_clean": _analysis_clean(),
                     "cold_s": round(cold_s, 3),
                     "warm_s": round(warm_s, 3),
                     "staging_cache_hits": int(

@@ -305,6 +305,10 @@ class SplitCache:
         self.spill_budget = int(spill_bytes)
         self._spill: "collections.OrderedDict" = collections.OrderedDict()
         self._spill_bytes = 0
+        #: bumped by invalidate()/clear(): a restage that started
+        #: before a write must not re-admit (or re-spill) its pre-write
+        #: copy after the invalidation — the DMA runs outside the lock
+        self._epoch = 0
         self.spills = 0
         self.restages = 0
         #: optional ``(nbytes) -> None`` hook: attributes restage
@@ -344,65 +348,81 @@ class SplitCache:
                 self.hits += 1
                 REGISTRY.counter("staging.cache_hit").update()
                 return entry[0]
-            page = self._restage_spilled(key, pin)
-            if page is not None:
-                # the host copy saved the connector read AND is back on
-                # device: a (slower) hit, not a miss
+            # remove from the spill store BEFORE re-admission: put()
+            # may evict (and re-spill) other entries to make room, and
+            # its spill traffic must never pop THIS key out from under
+            # the accounting below (a double subtraction). A racing
+            # get() for the same key sees a plain miss and re-stages
+            # its own copy — the documented duplicate-staging shape.
+            got = self._spill.pop(key, None)
+            if got is not None:
+                self._spill_bytes -= got[1]
+                epoch = self._epoch
+            else:
+                self.misses += 1
+                REGISTRY.counter("staging.cache_miss").update()
+                return None
+        page = self._restage_spilled(key, got, pin, epoch)
+        if page is not None:
+            # the host copy saved the connector read AND is back on
+            # device: a (slower) hit, not a miss
+            with self._lock:
                 self.hits += 1
-                REGISTRY.counter("staging.cache_hit").update()
-                return page
+            REGISTRY.counter("staging.cache_hit").update()
+            return page
+        with self._lock:
             self.misses += 1
-            REGISTRY.counter("staging.cache_miss").update()
-            return None
+        REGISTRY.counter("staging.cache_miss").update()
+        return None
 
-    def _restage_spilled(self, key, pin: bool) -> Optional[Page]:
-        """Spill-store lookup (caller holds the lock): restage the host
-        copy to device and re-admit it under the normal budget/pool
-        discipline. Returns None when nothing is spilled under ``key``
-        or re-admission does not fit (the host copy stays spilled and
-        the caller falls back to a plain miss — correct, just slower)."""
+    def _restage_spilled(self, key, got, pin: bool,
+                         epoch: int) -> Optional[Page]:
+        """Restage a popped spill entry to device and re-admit it under
+        the normal budget/pool discipline. Runs with NO cache lock held
+        — the host->device copy is a multi-MB DMA and must not stall
+        concurrent scans (the same discipline as :meth:`evict_bytes`'s
+        spill copies). Returns None when re-admission does not fit (the
+        host copy goes back to the spill store and the caller falls
+        back to a plain miss — correct, just slower) or when a write
+        invalidated the table mid-restage (``epoch`` guard: the stale
+        pre-write copy is dropped and the miss re-stages fresh data)."""
         from presto_tpu.utils.metrics import REGISTRY
 
-        got = self._spill.get(key)
-        if got is None:
-            return None
         host, nbytes = got
-        page = host_to_page(host)
-        # remove from the spill store BEFORE re-admission: put() may
-        # evict (and re-spill) other entries to make room, and its
-        # _drop_one_spilled must never pop THIS key out from under the
-        # accounting below (a double subtraction)
-        self._spill.pop(key, None)
-        self._spill_bytes -= nbytes
-        if not self.put(key, page, nbytes, pin=pin):
-            # no device room: the host copy stays spilled (re-inserted
-            # as newest; trim back under budget if re-admission's
-            # eviction traffic overfilled the store meanwhile)
-            self._spill[key] = (host, nbytes)
-            self._spill_bytes += nbytes
-            while self._spill_bytes > self.spill_budget:
-                if not self._drop_one_spilled():
-                    break
+        page = host_to_page(host)  # DMA, no lock held
+        if not self.put(key, page, nbytes, pin=pin, expect_epoch=epoch):
+            with self._lock:
+                if self._epoch != epoch:
+                    # invalidated mid-restage: nothing of the
+                    # pre-write copy may survive, in cache OR spill
+                    return None
+                # no device room: the host copy stays spilled
+                # (re-inserted as newest; trim back under budget if
+                # re-admission's eviction traffic overfilled the
+                # store meanwhile). Pop-subtract any copy that landed
+                # under this key while the lock was dropped — a plain
+                # assignment would leak its bytes into _spill_bytes
+                prev = self._spill.pop(key, None)
+                if prev is not None:
+                    self._spill_bytes -= prev[1]
+                self._spill[key] = (host, nbytes)
+                self._spill_bytes += nbytes
+                while self._spill_bytes > self.spill_budget:
+                    if not self._drop_one_spilled():
+                        break
             return None
-        self.restages += 1
+        with self._lock:
+            self.restages += 1
+            spill_now = self._spill_bytes
         REGISTRY.counter("spill.pages_restaged").update()
         REGISTRY.counter("spill.bytes_restaged").update(nbytes)
-        REGISTRY.distribution("spill.pool_bytes").add(self._spill_bytes)
+        REGISTRY.distribution("spill.pool_bytes").add(spill_now)
         if self.on_restage is not None:
             try:
                 self.on_restage(nbytes)
             except Exception:
                 pass  # attribution must never fail the staging path
         return page
-
-    def _spill_out(self, key, page: Page, nbytes: int) -> bool:
-        """Move an evicted entry's page to the host spill store (caller
-        holds the lock). False when the lane is off or the page cannot
-        fit even after dropping older spilled entries — the caller
-        drops the page, exactly the pre-spill behavior."""
-        if self.spill_budget <= 0 or nbytes > self.spill_budget:
-            return False
-        return self._spill_insert(key, page_to_host(page), nbytes)
 
     def _spill_insert(self, key, host, nbytes: int) -> bool:
         """Admit an already-copied host tree into the spill store,
@@ -413,7 +433,11 @@ class SplitCache:
         while self._spill_bytes + nbytes > self.spill_budget:
             if not self._drop_one_spilled():
                 return False
-        self._spill.pop(key, None)
+        old = self._spill.pop(key, None)
+        if old is not None:
+            # replacing a copy under the same key: its bytes leave the
+            # store with it (or _spill_bytes inflates forever)
+            self._spill_bytes -= old[1]
         self._spill[key] = (host, nbytes)
         self._spill_bytes += nbytes
         self.spills += 1
@@ -443,13 +467,16 @@ class SplitCache:
                 self._pins.pop(key, None)
 
     def put(self, key, page: Page, nbytes: Optional[int] = None,
-            reserve_required: bool = False, pin: bool = False) -> bool:
+            reserve_required: bool = False, pin: bool = False,
+            expect_epoch: Optional[int] = None) -> bool:
         """Insert a staged page, evicting LRU entries past the budget
         (pinned entries are skipped — their pages are live on device).
         Returns True when the page is now cache-owned (its bytes are
         reserved under :attr:`OWNER`); False when it did not fit — the
         page still serves the current caller either way. ``pin=True``
-        marks the fresh entry in-use until :meth:`unpin`."""
+        marks the fresh entry in-use until :meth:`unpin`.
+        ``expect_epoch`` (the restage path) refuses the insert when an
+        invalidation landed since the caller snapshotted the epoch."""
         from presto_tpu.utils.metrics import REGISTRY
 
         nbytes = page_nbytes(page) if nbytes is None else int(nbytes)
@@ -462,34 +489,65 @@ class SplitCache:
                 # pool accounting mid-flight — the caller keeps (and
                 # accounts) its own copy instead
                 return False
-            old = self._entries.pop(key, None)
-            if old is not None:
-                self._release(old[1])
-            # reserve BEFORE the budget eviction: a failed pool
-            # reservation must not have emptied the cache for nothing
-            # (the pressure hook already lets query reservations
-            # reclaim cache bytes when THEY need the room)
-            if self.pool is not None:
-                if reserve_required:
-                    # raising reserve (pressure hook + kill-largest may
-                    # fire): a whole-table load that cannot fit is a
-                    # query failure, as it was before the cache existed
-                    self.pool.reserve(self.OWNER, nbytes)
-                elif not self.pool.try_reserve(self.OWNER, nbytes):
-                    return False
-            while self._bytes + nbytes > self.budget:
-                if not self._evict_one_unpinned():
-                    # every resident entry is pinned: the budget cannot
-                    # be met — undo the reservation and don't cache
+        # reserve OUTSIDE the cache lock (and BEFORE the budget
+        # eviction — a failed pool reservation must not have emptied
+        # the cache for nothing): a raising reserve can run pressure
+        # hooks (including this cache's own evict_bytes) or block on
+        # the governance lane, and neither may stall concurrent scans
+        # behind the cache lock
+        if self.pool is not None:
+            if reserve_required:
+                # raising reserve (pressure hook + kill-largest may
+                # fire): a whole-table load that cannot fit is a
+                # query failure, as it was before the cache existed
+                self.pool.reserve(self.OWNER, nbytes)
+            elif not self.pool.try_reserve(self.OWNER, nbytes):
+                return False
+        dropped: list = []
+        epoch = -1
+        try:
+            with self._lock:
+                epoch = self._epoch
+                if (
+                    expect_epoch is not None
+                    and self._epoch != expect_epoch
+                ):
+                    # a write invalidated this table while the caller
+                    # was copying: the page is pre-write — don't cache
                     if self.pool is not None:
                         self.pool.release(self.OWNER, nbytes)
                     return False
-            self._entries[key] = (page, nbytes)
-            if pin:
-                self._pins[key] = self._pins.get(key, 0) + 1
-            self._bytes += nbytes
-            REGISTRY.distribution("staging.cache_bytes").add(self._bytes)
-            return True
+                if self._pins.get(key):
+                    # pinned by a racing duplicate staging since the
+                    # pre-check: undo the reservation, keep their copy
+                    if self.pool is not None:
+                        self.pool.release(self.OWNER, nbytes)
+                    return False
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self._release(old[1])
+                while self._bytes + nbytes > self.budget:
+                    if not self._evict_one_unpinned(dropped):
+                        # every resident entry is pinned: the budget
+                        # cannot be met — undo the reservation and
+                        # don't cache
+                        if self.pool is not None:
+                            self.pool.release(self.OWNER, nbytes)
+                        return False
+                self._entries[key] = (page, nbytes)
+                if pin:
+                    self._pins[key] = self._pins.get(key, 0) + 1
+                self._bytes += nbytes
+                REGISTRY.distribution("staging.cache_bytes").add(
+                    self._bytes
+                )
+                return True
+        finally:
+            # evicted pages offload to the host spill store with no
+            # lock held (device->host DMA) — on success AND on the
+            # all-pinned failure path (their device bytes are gone
+            # either way)
+            self._spill_dropped(dropped, epoch)
 
     # -------------------------------------------------------- maintenance
 
@@ -498,9 +556,13 @@ class SplitCache:
         if self.pool is not None:
             self.pool.release(self.OWNER, nbytes)
 
-    def _evict_one_unpinned(self) -> bool:
+    def _evict_one_unpinned(self, dropped: list) -> bool:
         """Evict the least-recently-used UNPINNED entry (caller holds
-        the lock). Returns False when none is evictable."""
+        the lock). Returns False when none is evictable. The evicted
+        (key, page, nbytes) is appended to ``dropped`` — the caller
+        hands the batch to :meth:`_spill_dropped` AFTER releasing the
+        lock (degrade before you drop, but never DMA under the lock);
+        the DEVICE bytes free right now either way."""
         from presto_tpu.utils.metrics import REGISTRY
 
         key = next(
@@ -509,14 +571,30 @@ class SplitCache:
         if key is None:
             return False
         page, nbytes = self._entries.pop(key)
-        # degrade before you drop: offload the page to the host spill
-        # store (lane off / full = plain drop, the legacy behavior);
-        # either way the DEVICE bytes free right now
-        self._spill_out(key, page, nbytes)
+        dropped.append((key, page, nbytes))
         self._release(nbytes)
         self.evictions += 1
         REGISTRY.counter("staging.cache_evict").update()
         return True
+
+    def _spill_dropped(self, dropped: list, epoch: int) -> None:
+        """Offload evicted pages to the host spill store. Called with
+        NO cache lock held: the device->host copies are multi-MB DMA
+        transfers and concurrent scans must not stall behind them (the
+        page objects stay alive in ``dropped``, so the copy is safe
+        after the accounting already freed). Lane off / page too big =
+        plain drop, the legacy behavior. ``epoch`` was snapshotted by
+        the caller while it held the lock popping these entries: a
+        write that invalidates mid-copy must not find its table's
+        pre-write pages re-admitted to the spill store afterwards."""
+        for key, page, nbytes in dropped:
+            if self.spill_budget <= 0 or nbytes > self.spill_budget:
+                continue
+            host = page_to_host(page)  # DMA, no lock held
+            with self._lock:
+                if self._epoch != epoch:
+                    return  # invalidated mid-copy: drop, don't re-admit
+                self._spill_insert(key, host, nbytes)
 
     def evict_bytes(self, needed: int) -> int:
         """Evict unpinned LRU entries until at least ``needed`` bytes
@@ -530,6 +608,7 @@ class SplitCache:
         evicted = 0
         dropped = []
         with self._lock:
+            epoch = self._epoch
             while freed < needed:
                 key = next(
                     (k for k in self._entries if not self._pins.get(k)),
@@ -548,14 +627,8 @@ class SplitCache:
         # over-capacity work gets slower, not dead. The device->host
         # copies run OUTSIDE the cache lock: this hook fires on the
         # memory-pressure hot path, and concurrent scans must not
-        # stall behind multi-MB DMA transfers (the page objects stay
-        # alive here, so the copy is safe after the accounting freed)
-        for key, page, nbytes in dropped:
-            if self.spill_budget <= 0 or nbytes > self.spill_budget:
-                continue
-            host = page_to_host(page)  # DMA, no lock held
-            with self._lock:
-                self._spill_insert(key, host, nbytes)
+        # stall behind multi-MB DMA transfers
+        self._spill_dropped(dropped, epoch)
         if evicted:
             REGISTRY.counter("staging.cache_evict").update(evicted)
             REGISTRY.distribution("staging.cache_bytes").add(
@@ -568,6 +641,7 @@ class SplitCache:
         the table handle), releasing their reservations. Returns the
         number of entries dropped."""
         with self._lock:
+            self._epoch += 1
             stale = [k for k in self._entries if k[0] == handle]
             for k in stale:
                 _page, nbytes = self._entries.pop(k)
@@ -587,6 +661,7 @@ class SplitCache:
             self._pins.clear()
             self._spill.clear()
             self._spill_bytes = 0
+            self._epoch += 1
 
     # ------------------------------------------------------------- stats
 

@@ -162,22 +162,37 @@ class _Task:
         against the MemoryPool — a too-big shuffle fails on accounting,
         not OOM. The bounded-buffer backpressure applies to the
         unpartitioned streaming path."""
-        with self.cond:
-            while (
-                len(self.parts) == 1
-                and len(self.parts[part]) - self.part_acked[part]
-                >= MAX_BUFFERED_PAGES
-                and self.state == "RUNNING"
-            ):
-                self.cond.wait(timeout=0.1)
-            if self.state == "ABORTED":
-                raise RuntimeError("task aborted")
+        if self.pool is not None:
+            # too-big shuffle output fails on ACCOUNTING
+            # (MemoryLimitExceeded -> task FAILED), not on OOM. The
+            # reserve runs BEFORE taking task.cond: a governance-lane
+            # reserve may block waiting for headroom (and pressure
+            # hooks may run spill DMA), and the condition guards the
+            # result-serving handler threads — the same discipline as
+            # the spool tee below. Only the producer thread appends
+            # per (task, part), so nothing races the buffered bytes
+            # between the reserve and the append; the abort path below
+            # returns the reservation.
+            self.pool.reserve(self.buf_key, len(page))
+        try:
+            with self.cond:
+                while (
+                    len(self.parts) == 1
+                    and len(self.parts[part]) - self.part_acked[part]
+                    >= MAX_BUFFERED_PAGES
+                    and self.state == "RUNNING"
+                ):
+                    self.cond.wait(timeout=0.1)
+                if self.state == "ABORTED":
+                    raise RuntimeError("task aborted")
+                self.parts[part].append(page)
+                self.stats.output_bytes += len(page)
+        except BaseException:
+            # the page never reached the buffer: its reservation must
+            # not leak into the task's release-all at teardown
             if self.pool is not None:
-                # too-big shuffle output fails on ACCOUNTING
-                # (MemoryLimitExceeded -> task FAILED), not on OOM
-                self.pool.reserve(self.buf_key, len(page))
-            self.parts[part].append(page)
-            self.stats.output_bytes += len(page)
+                self.pool.release(self.buf_key, len(page))
+            raise
         # the spool tee runs OUTSIDE task.cond: disk I/O under the
         # condition would block the result-serving handler threads
         # behind every spooled page. Safe because pages are immutable

@@ -12,7 +12,6 @@ pruning, the distributed fuzz toggle, and the summary-site lint.
 """
 
 import os
-import sys
 import time
 
 import numpy as np
@@ -32,10 +31,6 @@ from presto_tpu.exec.local_runner import LocalQueryRunner  # noqa: E402
 from presto_tpu.exec.staging import CatalogManager  # noqa: E402
 from presto_tpu.utils import faults  # noqa: E402
 from presto_tpu.utils.metrics import REGISTRY  # noqa: E402
-
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
-)
 
 
 @pytest.fixture(autouse=True)
@@ -629,18 +624,6 @@ def test_fuzz_distributed_toggles_dynamic_filtering():
     assert not failures, f"{len(failures)} fuzz failures:\n{msg}"
 
 
-# ------------------------------------------------------------- linting
-
-
-def test_no_adhoc_summary_sites():
-    """All build-side summary construction lives in exec/dynfilter.py
-    (tools/check_dynfilter_sites.py, wired like the rpc lint)."""
-    import check_dynfilter_sites
-
-    src = os.path.join(
-        os.path.dirname(os.path.dirname(__file__)), "presto_tpu"
-    )
-    sites = check_dynfilter_sites.scan(src)
-    assert not sites, "\n".join(
-        f"{p}:{ln}: {line}" for p, ln, line in sites
-    )
+# The lint wiring that lived here moved to tests/test_static_analysis.py
+# (the one gate running every tools/analysis pass; the tools/check_*.py CLI
+# this suite used to invoke is now a shim over the same framework).

@@ -3,13 +3,12 @@ optimization, PAPER.md L2): per-operator OperatorStats populated on
 every executor tier, the crash-safe QueryHistoryStore
 (plan/history.py), est-vs-actual + provenance in EXPLAIN / EXPLAIN
 ANALYZE, the ``estimate_rows`` history read path, runtime view +
-metrics, the slow-query log, and the check_history_sites lint.
+metrics, and the slow-query log.
 """
 
 import json
 import os
 import re
-import sys
 import time
 
 import pytest
@@ -24,10 +23,6 @@ from presto_tpu.exec.stats import (
     TaskStats,
 )
 from presto_tpu.utils.metrics import REGISTRY
-
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
-)
 
 
 def _runner(tmp_path=None, **kw):
@@ -438,28 +433,9 @@ def test_distributed_query_history_view(cluster):
     assert rows  # the coordinator-side store received the actuals
 
 
-# --------------------------------------------------------------- lint
-
-
-def test_check_history_sites_clean_on_repo():
-    import check_history_sites
-
-    assert check_history_sites.main([]) == 0
-
-
-def test_check_history_sites_flags_violations(tmp_path):
-    import check_history_sites
-
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        "store = QueryHistoryStore('/tmp/x')\n"
-        "rows = lookup_rows(node)\n"
-        "fp = node_fingerprint(node)\n"
-        # an exempt READ on the same line must not hide the call
-        "ts.plan_fingerprint = plan_history.plan_fingerprint(root)\n"
-    )
-    assert check_history_sites.main([str(tmp_path)]) == 1
-    assert len(check_history_sites.scan(str(tmp_path))) == 4
+# The lint wiring that lived here moved to tests/test_static_analysis.py
+# (the one gate running every tools/analysis pass; the tools/check_*.py CLI
+# this suite used to invoke is now a shim over the same framework).
 
 
 # ------------------------------------------- rollup/dedup regressions

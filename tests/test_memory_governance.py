@@ -2,10 +2,9 @@
 low-memory killer + spilling, SURVEY.md §2.1 "Memory manager"):
 distributed accounting on the heartbeats, the cluster arbiter's
 quotas/admission/killer, the host-spill degradation lane, and the
-check_reserve_sites lint wiring."""
+memory fault rules."""
 
 import os
-import sys
 import threading
 import time
 
@@ -20,10 +19,6 @@ from presto_tpu.session import NodeConfig
 from presto_tpu.utils import faults
 from presto_tpu.utils.memory import MemoryLimitExceeded, MemoryPool
 from presto_tpu.utils.metrics import REGISTRY
-
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
-)
 
 
 # ------------------------------------------------------------ pool lanes
@@ -597,25 +592,6 @@ def test_worker_heartbeat_carries_memory_report(tmp_path):
         _teardown(coord, ws)
 
 
-# ------------------------------------------------------------- the lint
-
-
-def test_check_reserve_sites_clean_on_repo():
-    import check_reserve_sites
-
-    assert check_reserve_sites.main([]) == 0
-
-
-def test_check_reserve_sites_flags_violations(tmp_path):
-    import check_reserve_sites
-
-    bad = tmp_path / "rogue.py"
-    bad.write_text(
-        "from presto_tpu.utils.memory import MemoryPool\n"
-        "pool = MemoryPool(100)\n"
-        "pool.reserve('q', 10)\n"
-        "pool.try_reserve('q', 10)\n"
-        "# pool.reserve('commented', 1)\n"
-    )
-    assert check_reserve_sites.main([str(tmp_path)]) == 1
-    assert len(check_reserve_sites.scan(str(tmp_path))) == 3
+# The lint wiring that lived here moved to tests/test_static_analysis.py
+# (the one gate running every tools/analysis pass; the tools/check_*.py CLI
+# this suite used to invoke is now a shim over the same framework).

@@ -9,7 +9,6 @@ Reference parity: SURVEY.md §5.1 (QueryStats rollup + QueryInfo),
 
 import json
 import os
-import sys
 import threading
 import time
 import urllib.request
@@ -23,10 +22,7 @@ from presto_tpu.utils.metrics import (
     CounterStat,
     DistributionStat,
     MetricsRegistry,
-)
 
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
 )
 
 
@@ -243,21 +239,9 @@ def test_registry_concurrent_updates():
     )
 
 
-def test_metric_name_lint_clean_on_repo():
-    import check_metric_names
-
-    assert check_metric_names.main([]) == 0
-
-
-def test_metric_name_lint_flags_conflicts(tmp_path):
-    import check_metric_names
-
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        'REGISTRY.counter("dup.name").update()\n'
-        'REGISTRY.timer("dup.name").time()\n'
-    )
-    assert check_metric_names.main([str(tmp_path)]) == 1
+# The lint wiring that lived here moved to tests/test_static_analysis.py
+# (the one gate running every tools/analysis pass; the tools/check_*.py CLI
+# this suite used to invoke is now a shim over the same framework).
 
 
 # --------------------------------------------------------- HTTP endpoints

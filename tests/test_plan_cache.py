@@ -1,8 +1,8 @@
 """Parameterized plan cache + compiled-fragment reuse
 (plan/canonical.py): canonical-form equality across literal variants,
 on/off bit-exactness, dtype bucketing, PREPARE/EXECUTE zero-recompile,
-write-path invalidation, distributed fragment reuse, concurrency, and
-the tools/check_plan_params.py lint wiring."""
+write-path invalidation, concurrency, and distributed fragment
+reuse."""
 
 import threading
 import time
@@ -444,34 +444,6 @@ def test_prepared_header_rides_fresh_client(cluster):
     assert c2.execute("execute pc_own using 10").rows() == [(10,)]
 
 
-# ------------------------------------------------------------------ lint
-
-
-def test_check_plan_params_clean():
-    import sys
-    from pathlib import Path
-
-    sys.path.insert(
-        0, str(Path(__file__).resolve().parent.parent / "tools")
-    )
-    import check_plan_params
-
-    assert check_plan_params.main([]) == 0
-
-
-def test_check_plan_params_flags_violations(tmp_path):
-    import sys
-    from pathlib import Path
-
-    sys.path.insert(
-        0, str(Path(__file__).resolve().parent.parent / "tools")
-    )
-    import check_plan_params
-
-    bad = tmp_path / "rogue.py"
-    bad.write_text(
-        "from presto_tpu import expr as E\n"
-        "p = E.RuntimeParam(0, None)\n"
-        "cache = {}\n"
-    )
-    assert check_plan_params.main([str(tmp_path)]) == 1
+# The lint wiring that lived here moved to tests/test_static_analysis.py
+# (the one gate running every tools/analysis pass; the tools/check_*.py CLI
+# this suite used to invoke is now a shim over the same framework).

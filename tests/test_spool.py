@@ -17,7 +17,6 @@ loses zero queries.
 
 import os
 import signal
-import sys
 import threading
 import time
 
@@ -30,9 +29,6 @@ from presto_tpu.session import NodeConfig, Session
 from presto_tpu.utils import faults
 from presto_tpu.utils.metrics import REGISTRY
 
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
-)
 
 #: multi-stage TPC-H join: scan+join+partial-agg producer stage that
 #: hash-partitions into per-worker buffers, merge stage running the
@@ -698,20 +694,6 @@ def test_launcher_boots_spool_and_drain_config(tmp_path):
     assert sp.ttl_s == 60.0
 
 
-# --------------------------------------------------------- lint
-
-
-def test_attempt_id_sites_lint_clean():
-    import check_attempt_ids
-
-    assert check_attempt_ids.main([]) == 0
-
-
-def test_attempt_id_lint_flags_adhoc_sites(tmp_path):
-    import check_attempt_ids
-
-    (tmp_path / "bad.py").write_text(
-        'task_id = f"{qid}.{uuid.uuid4().hex[:8]}"\n'
-        'stage = task_id.split(".")[1]\n'
-    )
-    assert check_attempt_ids.main([str(tmp_path)]) == 1
+# The lint wiring that lived here moved to tests/test_static_analysis.py
+# (the one gate running every tools/analysis pass; the tools/check_*.py CLI
+# this suite used to invoke is now a shim over the same framework).

@@ -1,0 +1,659 @@
+"""The ONE static-analysis gate: every framework pass runs over
+``presto_tpu/`` and must report zero unsuppressed findings, plus
+synthetic positive/negative fixtures for the concurrency detectors
+and the legacy-shim contracts.
+
+This file replaces the per-suite lint wiring that used to live in
+test_faults / test_staging_cache / test_dynfilter / test_spool /
+test_elastic / test_history_stats / test_memory_governance /
+test_observability / test_plan_cache — the nine ``tools/check_*.py``
+CLIs still exit 0/1 exactly as before (proven here), but the rules
+run once, inside ``tools/analysis``.
+
+Reference parity: Presto gates merges with error-prone/checkstyle
+custom bug patterns (concurrency ones included); the TPU-first
+analogue is an AST framework that knows THIS engine's invariants —
+lock order, blocking-under-lock, and plane confinement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "presto_tpu")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import analysis  # noqa: E402
+import analyze  # noqa: E402
+
+
+# ------------------------------------------------------------ the gate
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    """One full-framework run over presto_tpu, shared by every
+    assertion below."""
+    return analysis.run_passes(SRC)
+
+
+def test_all_passes_clean_on_repo(repo_findings):
+    active = [f for f in repo_findings if f.active]
+    assert not active, "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in active
+    )
+
+
+def test_blocking_allowlist_entries_all_live(repo_findings):
+    """Every allowlist entry matches a real finding — a stale entry
+    (site fixed or moved) must be deleted, not hoarded."""
+    from analysis.allowlist import BLOCKING_ALLOWLIST
+
+    hit = {
+        (f.rel, f.line)
+        for f in repo_findings
+        if f.allowlisted
+    }
+    assert len(hit) == len(BLOCKING_ALLOWLIST), (
+        "allowlist has stale entries: "
+        f"{len(BLOCKING_ALLOWLIST)} entries, {len(hit)} live findings"
+    )
+    for f in repo_findings:
+        if f.allowlisted:
+            assert f.justification  # every exception carries its why
+
+
+def test_every_rule_registered(repo_findings):
+    rules = analysis.all_rules()
+    for expected in (
+        "lock-order",
+        "blocking-under-lock",
+        "plan-params",
+        "history-sites",
+        "rpc-confinement",
+        "staging-confinement",
+        "dynfilter-confinement",
+        "attempt-ids",
+        "journal-sites",
+        "reserve-sites",
+        "metric-names",
+    ):
+        assert expected in rules
+
+
+# ------------------------------------------- lock-order fixtures
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+def test_lock_order_reports_ab_ba_cycle(tmp_path):
+    _write(
+        tmp_path,
+        "cycle.py",
+        """\
+        import threading
+
+
+        class Pair:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.lock_b = threading.Lock()
+
+            def forward(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        return 1
+
+            def backward(self):
+                with self.lock_b:
+                    with self.lock_a:
+                        return 2
+        """,
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["lock-order"])
+    assert len(found) == 1
+    msg = found[0].message
+    # both witness paths are printed
+    assert "lock_a -> cycle.Pair.lock_b" in msg
+    assert "lock_b -> cycle.Pair.lock_a" in msg
+    assert "Pair.forward" in msg and "Pair.backward" in msg
+
+
+def test_lock_order_fixed_ordering_is_clean(tmp_path):
+    _write(
+        tmp_path,
+        "ordered.py",
+        """\
+        import threading
+
+
+        class Pair:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.lock_b = threading.Lock()
+
+            def forward(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        return 1
+
+            def backward(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        return 2
+        """,
+    )
+    assert not analysis.run_passes(str(tmp_path), rules=["lock-order"])
+
+
+def test_lock_order_sees_edits_between_runs(tmp_path):
+    """The shared concurrency model is keyed by CONTENT: fixing a
+    reported cycle and re-running the same process must go clean (a
+    stale model would keep reporting the old parse)."""
+    body = """\
+        import threading
+
+
+        class Pair:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.lock_b = threading.Lock()
+
+            def forward(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        return 1
+
+            def backward(self):
+                with self.{first}:
+                    with self.{second}:
+                        return 2
+        """
+    _write(tmp_path, "c.py", body.format(first="lock_b", second="lock_a"))
+    assert analysis.run_passes(str(tmp_path), rules=["lock-order"])
+    _write(tmp_path, "c.py", body.format(first="lock_a", second="lock_b"))
+    assert not analysis.run_passes(str(tmp_path), rules=["lock-order"])
+
+
+def test_lock_order_cycle_through_call_edge(tmp_path):
+    """A->B by nesting in one method, B->A through a method CALL while
+    holding B — the interprocedural half of the detector."""
+    _write(
+        tmp_path,
+        "callcycle.py",
+        """\
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self.meta_lock = threading.Lock()
+                self.data_lock = threading.Lock()
+
+            def read(self):
+                with self.meta_lock:
+                    with self.data_lock:
+                        return 1
+
+            def _refresh_meta(self):
+                with self.meta_lock:
+                    return 2
+
+            def write(self):
+                with self.data_lock:
+                    return self._refresh_meta()
+        """,
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["lock-order"])
+    assert len(found) == 1
+    assert "via call" in found[0].message
+
+
+# --------------------------------------- blocking-under-lock fixtures
+
+
+def test_blocking_reports_reintroduced_pr9_pattern(tmp_path):
+    """The PR 9 review finding — device->host DMA under the
+    split-cache lock — must be caught if anyone reintroduces it."""
+    _write(
+        tmp_path,
+        "pr9.py",
+        """\
+        import threading
+
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def evict(self, page):
+                with self._lock:
+                    host = page_to_host(page)
+                    return host
+        """,
+    )
+    found = analysis.run_passes(
+        str(tmp_path), rules=["blocking-under-lock"]
+    )
+    assert len(found) == 1
+    assert "page_to_host" in found[0].message
+    assert "device->host DMA" in found[0].message
+
+
+def test_blocking_reports_rpc_sleep_and_file_io_under_lock(tmp_path):
+    _write(
+        tmp_path,
+        "mixed.py",
+        """\
+        import threading
+        import time
+
+        from presto_tpu.server import rpc
+
+        _mu = threading.Lock()
+
+
+        def heartbeat(url):
+            with _mu:
+                rpc.call_json("GET", url)
+
+
+        def backoff():
+            with _mu:
+                time.sleep(0.5)
+
+
+        def journal(rec):
+            with _mu:
+                with open("/tmp/x", "a") as f:
+                    f.write(rec)
+        """,
+    )
+    found = analysis.run_passes(
+        str(tmp_path), rules=["blocking-under-lock"]
+    )
+    whys = sorted(f.message for f in found)
+    assert len(found) == 3
+    assert any("rpc.call_json" in m for m in whys)
+    assert any("time.sleep" in m for m in whys)
+    assert any("open" in m for m in whys)
+
+
+def test_blocking_dma_outside_lock_is_clean(tmp_path):
+    """The FIXED shape (copy outside the critical section) passes —
+    exactly what exec/staging.py does now."""
+    _write(
+        tmp_path,
+        "fixed.py",
+        """\
+        import threading
+
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._entries = {}
+
+            def evict(self, key):
+                with self._lock:
+                    page = self._entries.pop(key)
+                host = page_to_host(page)
+                return host
+        """,
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["blocking-under-lock"]
+    )
+
+
+def test_blocking_wait_on_own_condition_is_exempt(tmp_path):
+    _write(
+        tmp_path,
+        "waits.py",
+        """\
+        import threading
+
+
+        class Q:
+            def __init__(self):
+                self.cond = threading.Condition()
+                self.aux = threading.Lock()
+
+            def take(self):
+                with self.cond:
+                    self.cond.wait(timeout=0.1)
+
+            def bad_take(self):
+                with self.aux:
+                    with self.cond:
+                        self.cond.wait(timeout=0.1)
+        """,
+    )
+    found = analysis.run_passes(
+        str(tmp_path), rules=["blocking-under-lock"]
+    )
+    assert len(found) == 1  # only bad_take: aux held across the wait
+    assert "bad_take" in found[0].message
+
+
+def test_blocking_wait_propagates_through_call(tmp_path):
+    """The offer_page shape: holding lock A, call a helper whose wait
+    releases only ITS OWN condition — A stays wedged for the whole
+    wait and must flag at the caller."""
+    _write(
+        tmp_path,
+        "prop.py",
+        """\
+        import threading
+
+
+        class Pool:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def reserve(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
+
+
+        class Task:
+            def __init__(self):
+                self.cond = threading.Condition()
+                self.pool = Pool()
+
+            def offer(self):
+                with self.cond:
+                    self.pool.reserve()
+        """,
+    )
+    found = analysis.run_passes(
+        str(tmp_path), rules=["blocking-under-lock"]
+    )
+    assert len(found) == 1
+    assert "Task.offer" in found[0].message
+    assert "Pool.reserve" in found[0].message
+
+
+# --------------------------------------- suppressions, JSON, baseline
+
+
+def test_inline_suppression_quiets_a_finding(tmp_path):
+    _write(
+        tmp_path,
+        "s.py",
+        """\
+        import threading
+
+        _mu = threading.Lock()
+
+
+        def snooze():
+            with _mu:
+                time.sleep(1)  # lint: disable=blocking-under-lock
+        """,
+    )
+    found = analysis.run_passes(
+        str(tmp_path), rules=["blocking-under-lock"]
+    )
+    assert len(found) == 1
+    assert found[0].suppressed and not found[0].active
+    assert analyze.main([str(tmp_path)]) == 0
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    _write(tmp_path, "broken.py", "def f(:\n")
+    found = analysis.run_passes(str(tmp_path), rules=["rpc-confinement"])
+    assert [f.rule for f in found] == ["parse-error"]
+    assert analyze.main([str(tmp_path)]) == 1
+
+
+def test_json_output_stable_and_diffable(tmp_path, capsys):
+    _write(
+        tmp_path,
+        "j.py",
+        """\
+        import threading
+
+        _mu = threading.Lock()
+
+
+        def f():
+            with _mu:
+                time.sleep(1)
+        """,
+    )
+    assert analyze.main([str(tmp_path), "--json"]) == 1
+    first = capsys.readouterr().out
+    assert analyze.main([str(tmp_path), "--json"]) == 1
+    second = capsys.readouterr().out
+    assert first == second  # byte-stable across runs
+    doc = json.loads(first)
+    assert doc["version"] == 1
+    assert doc["counts"]["active"] == 1
+    f0 = doc["findings"][0]
+    assert f0["rule"] == "blocking-under-lock"
+    assert f0["path"] == "j.py" and f0["line"] == 8
+
+
+def test_baseline_demotes_known_findings(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    _write(
+        src,
+        "old.py",
+        """\
+        import threading
+
+        _mu = threading.Lock()
+
+
+        def f():
+            with _mu:
+                time.sleep(1)
+        """,
+    )
+    base = str(tmp_path / "baseline.json")
+    # introduce warn-only: write the baseline, then the gate passes
+    assert analyze.main([str(src), "--write-baseline", base]) == 1
+    assert analyze.main([str(src), "--baseline", base]) == 0
+    # a NEW finding is not covered by the old baseline
+    _write(
+        src,
+        "new.py",
+        """\
+        import threading
+
+        _mu = threading.Lock()
+
+
+        def g():
+            with _mu:
+                time.sleep(2)
+        """,
+    )
+    assert analyze.main([str(src), "--baseline", base]) == 1
+
+
+def test_cli_runs_from_subprocess():
+    """The acceptance-criteria spelling: ``python tools/analyze.py
+    presto_tpu`` exits 0 on this tree."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "analyze.py"), SRC],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------------- legacy CLI shims
+
+
+def test_rpc_shim_clean_and_flags(tmp_path):
+    import check_rpc_calls
+
+    (tmp_path / "bad.py").write_text(
+        "import urllib.request\n"
+        "urllib.request.urlopen('http://example')\n"
+    )
+    assert check_rpc_calls.main([str(tmp_path)]) == 1
+
+
+def test_device_put_shim_clean_and_flags(tmp_path):
+    import check_device_puts
+
+    (tmp_path / "anywhere.py").write_text(
+        "import jax\njax.device_put([1, 2, 3])\n"
+    )
+    server_dir = tmp_path / "server"
+    server_dir.mkdir()
+    (server_dir / "boundary.py").write_text(
+        "import jax.numpy as jnp\njnp.asarray([1, 2, 3])\n"
+    )
+    assert check_device_puts.main([str(tmp_path)]) == 1
+    assert len(check_device_puts.scan(str(tmp_path))) == 2
+
+
+def test_device_put_shim_allows_trace_time_asarray(tmp_path):
+    import check_device_puts
+
+    ops_dir = tmp_path / "ops"
+    ops_dir.mkdir()
+    (ops_dir / "kernel.py").write_text(
+        "import jax.numpy as jnp\njnp.asarray([1, 2, 3])\n"
+    )
+    assert check_device_puts.main([str(tmp_path)]) == 0
+
+
+def test_dynfilter_shim_clean_and_flags(tmp_path):
+    import check_dynfilter_sites
+
+    (tmp_path / "bad.py").write_text(
+        "import jax.numpy as jnp\n"
+        "lo = jnp.min(jnp.where(mask, keys, fill))\n"
+        "s = FilterSummary(cols)\n"
+    )
+    assert check_dynfilter_sites.main([str(tmp_path)]) == 1
+    assert len(check_dynfilter_sites.scan(str(tmp_path))) == 2
+
+
+def test_attempt_id_shim_clean_and_flags(tmp_path):
+    import check_attempt_ids
+
+    (tmp_path / "bad.py").write_text(
+        'task_id = f"{qid}.{uuid.uuid4().hex[:8]}"\n'
+        'stage = task_id.split(".")[1]\n'
+    )
+    assert check_attempt_ids.main([str(tmp_path)]) == 1
+    assert len(check_attempt_ids.scan(str(tmp_path))) == 2
+
+
+def test_journal_shim_clean_and_flags(tmp_path):
+    import check_journal_sites
+
+    (tmp_path / "bad.py").write_text(
+        "j = CoordinatorJournal(path)\n"
+        'j.record_submit("q", "select 1")\n'
+        'seg = open("journal-000001.jsonl", "a")\n'
+    )
+    assert check_journal_sites.main([str(tmp_path)]) == 1
+    kinds = {k for _p, _l, k, _s in check_journal_sites.scan(
+        str(tmp_path)
+    )}
+    assert kinds == {"frame", "consumer"}
+
+
+def test_reserve_shim_clean_and_flags(tmp_path):
+    import check_reserve_sites
+
+    (tmp_path / "rogue.py").write_text(
+        "from presto_tpu.utils.memory import MemoryPool\n"
+        "pool = MemoryPool(100)\n"
+        "pool.reserve('q', 10)\n"
+        "pool.try_reserve('q', 10)\n"
+        "# pool.reserve('commented', 1)\n"
+    )
+    assert check_reserve_sites.main([str(tmp_path)]) == 1
+    assert len(check_reserve_sites.scan(str(tmp_path))) == 3
+
+
+def test_plan_params_shim_clean_and_flags(tmp_path):
+    import check_plan_params
+
+    (tmp_path / "rogue.py").write_text(
+        "from presto_tpu import expr as E\n"
+        "p = E.RuntimeParam(0, None)\n"
+        "cache = {}\n"
+    )
+    assert check_plan_params.main([str(tmp_path)]) == 1
+
+
+def test_history_shim_clean_and_flags(tmp_path):
+    import check_history_sites
+
+    (tmp_path / "bad.py").write_text(
+        "store = QueryHistoryStore('/tmp/x')\n"
+        "rows = lookup_rows(node)\n"
+        "fp = node_fingerprint(node)\n"
+        # an exempt READ on the same line must not hide the call
+        "ts.plan_fingerprint = plan_history.plan_fingerprint(root)\n"
+    )
+    assert check_history_sites.main([str(tmp_path)]) == 1
+    assert len(check_history_sites.scan(str(tmp_path))) == 4
+
+
+def test_metric_shim_clean_and_flags(tmp_path):
+    import check_metric_names
+
+    (tmp_path / "bad.py").write_text(
+        'REGISTRY.counter("dup.name").update()\n'
+        'REGISTRY.timer("dup.name").time()\n'
+    )
+    assert check_metric_names.main([str(tmp_path)]) == 1
+
+
+def test_metric_names_resolve_loop_registration(tmp_path):
+    """The PR 7-9 coverage gap: families registered through a loop
+    variable (the Autoscaler pattern) now participate in conflict
+    detection — the regex predecessor skipped them entirely."""
+    import check_metric_names
+
+    (tmp_path / "fam.py").write_text(
+        "for m in (\n"
+        '    "pool.scale_up",\n'
+        '    "pool.scale_down",\n'
+        "):\n"
+        "    REGISTRY.counter(m)\n"
+        'REGISTRY.distribution("pool.scale_up").add(1)\n'
+    )
+    assert check_metric_names.main([str(tmp_path)]) == 1
+    sites = check_metric_names.scan(str(tmp_path))
+    assert "pool.scale_down" in sites  # loop names resolved
+    conflicts = check_metric_names.find_conflicts(sites)
+    assert [name for name, _ in conflicts] == ["pool.scale_up"]
+
+
+def test_loop_registered_families_visible_on_repo():
+    """The live Autoscaler families are actually in the scanned set."""
+    import check_metric_names
+
+    sites = check_metric_names.scan(SRC)
+    for fam in (
+        "pool.scale_up",
+        "pool.scale_down",
+        "pool.preemptions",
+        "history.hit",
+        "journal.writes",
+        "memory.queries_killed",
+        "spill.pages_spilled",
+    ):
+        assert fam in sites, fam

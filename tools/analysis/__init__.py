@@ -1,0 +1,46 @@
+"""Unified AST analysis framework for the presto-tpu tree.
+
+One loader, one pass registry, one finding type, one CLI
+(``tools/analyze.py``). The passes:
+
+====================  =================================================
+rule id               enforces
+====================  =================================================
+lock-order            held-while-acquiring lock graph stays acyclic
+                      (static deadlock detection)
+blocking-under-lock   no RPC / DMA / file I/O / sleep / unbounded join
+                      / journal-spool write while a lock is held
+plan-params           compile-plane constructs confined to
+                      plan/canonical.py + audited consumers
+history-sites         history-plane constructs confined to
+                      plan/history.py + audited consumers
+rpc-confinement       raw urlopen confined to server/rpc.py
+staging-confinement   device_put / boundary jnp conversions confined
+                      to exec/staging.py
+dynfilter-confinement filter summaries confined to exec/dynfilter.py
+attempt-ids           task-id mint/parse confined to server/task_ids.py
+journal-sites         journal frames/API confined to server/journal.py
+                      + audited consumers
+reserve-sites         pool reservations confined to utils/memory.py +
+                      audited consumers
+metric-names          one kind per metric name
+====================  =================================================
+
+Suppression: a trailing ``# lint: disable=<rule>[,<rule>]`` on the
+finding's line. Blocking-under-lock additionally honors the audited
+allowlist in :mod:`analysis.allowlist` (one-line justification per
+entry). ``parse-error`` findings (unparseable files) always fail.
+"""
+
+from analysis.core import (  # noqa: F401
+    Finding,
+    Module,
+    PASSES,
+    all_rules,
+    load_baseline,
+    load_modules,
+    register,
+    run_passes,
+    to_json,
+    write_baseline,
+)

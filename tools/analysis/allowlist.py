@@ -1,0 +1,96 @@
+"""Audited exceptions for the ``blocking-under-lock`` pass.
+
+Every entry is a deliberate, reviewed decision to hold a lock across a
+blocking call, with a one-line justification. Adding an entry is a
+code-review event: the justification must say why the blocking work
+cannot move outside the critical section (or why the lock is private
+to exactly that work). Prefer restructuring (copy outside the lock,
+snapshot-then-release) — the split-cache spill path and the arbiter
+kill path were both restructured rather than allowlisted.
+
+Match shape: (path relative to the analyzed root, enclosing function
+qualname, blocking callee name as reported by the pass).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    path: str  #: module rel path, e.g. "server/journal.py"
+    func: str  #: enclosing function qualname, e.g. "Journal._append"
+    call: str  #: blocking callee as reported, e.g. "open"
+    why: str  #: one-line justification (this IS the audit record)
+
+
+BLOCKING_ALLOWLIST = [
+    Allow(
+        "server/journal.py",
+        "CoordinatorJournal._append",
+        "open",
+        "the journal lock exists to serialize exactly this append: "
+        "on-disk frame order must equal in-memory apply order or "
+        "replay diverges (submit-before-finish), and rotation + "
+        "checkpoint must be atomic against concurrent appends",
+    ),
+    Allow(
+        "plan/history.py",
+        "QueryHistoryStore.record_query",
+        "open",
+        "segment append + rotation + checkpoint snapshot must be "
+        "atomic against concurrent records or GC could drop the only "
+        "on-disk copy of live entries (same invariant as the "
+        "coordinator journal); the store lock guards exactly this",
+    ),
+    Allow(
+        "exec/stats.py",
+        "JsonlQueryEventListener.query_completed",
+        "open",
+        "the listener lock exists to serialize exactly this append: "
+        "concurrent query completions must not interleave partial "
+        "JSONL lines (consumers tail this file)",
+    ),
+    Allow(
+        "exec/stats.py",
+        "SlowQueryLog.query_completed",
+        "open",
+        "the log lock exists to serialize exactly this append: a "
+        "multi-line EXPLAIN ANALYZE block must land contiguously or "
+        "the log is unreadable",
+    ),
+    Allow(
+        "native.py",
+        "_load",
+        "os.replace",
+        "one-time lazy native build: the module lock guarantees a "
+        "single compiler invocation + atomic .so swap; every later "
+        "call takes the fast already-loaded path",
+    ),
+    Allow(
+        "native.py",
+        "_load_gen",
+        "os.replace",
+        "one-time lazy native build (generator twin of _load): single "
+        "compiler invocation + atomic .so swap under the module lock",
+    ),
+    Allow(
+        "server/spool.py",
+        "ExchangeSpool.commit",
+        "open",
+        "the commit-marker write must serialize with GC's "
+        "marker-first removal under the same lock — commit-vs-GC "
+        "ordering is the recovery correctness invariant (a marker "
+        "written after GC unlinked the pages would resurrect a "
+        "half-deleted attempt)",
+    ),
+    Allow(
+        "server/spool.py",
+        "ExchangeSpool._read_frames",
+        "open",
+        "recovery reads hold the lock so GC cannot unlink the pages "
+        "file mid-read; recovery is rare and the frames are small "
+        "(the hot exchange path never touches the spool reader)",
+    ),
+]

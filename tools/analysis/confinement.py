@@ -1,0 +1,359 @@
+"""Module-confinement passes: privileged constructs stay inside their
+one audited module (plus explicitly audited consumers).
+
+These are the AST migrations of the legacy regex lints
+(``tools/check_*.py`` — the CLIs survive as shims over these passes):
+
+- ``rpc-confinement``       raw ``urlopen`` outside server/rpc.py
+- ``staging-confinement``   ``device_put`` anywhere / ``jnp.asarray``
+                            at host-boundary layers, outside
+                            exec/staging.py
+- ``dynfilter-confinement`` filter-summary construction outside
+                            exec/dynfilter.py
+- ``attempt-ids``           task-id f-strings / string-parsing outside
+                            server/task_ids.py
+- ``journal-sites``         journal frames outside server/journal.py;
+                            record/replay outside journal+coordinator
+                            (+ memory_arbiter for kill frames)
+- ``reserve-sites``         pool construction / reservations outside
+                            utils/memory.py + audited consumers
+
+Being AST-level, they see calls (not lines): comments, docstrings,
+``isinstance`` checks and attribute reads no longer need scrub
+patterns, and a disallowed call on a line that also carries an exempt
+read still flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from analysis import core
+
+
+def _walk_calls(mod: core.Module):
+    for node in mod.nodes:
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _receiver(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return core.terminal_name(call.func.value)
+    return None
+
+
+# ---------------------------------------------------------------- rpc
+
+
+@core.register(
+    "rpc-confinement",
+    "every intra-cluster HTTP call goes through server/rpc.py "
+    "(timeouts, retries, breakers, fault hooks, rpc.* metrics)",
+)
+def rpc_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        if mod.rel == "server/rpc.py":
+            continue
+        for call in _walk_calls(mod):
+            if core.terminal_name(call.func) == "urlopen":
+                findings.append(
+                    mod.finding(
+                        "rpc-confinement",
+                        call.lineno,
+                        "raw urlopen — route through "
+                        "presto_tpu.server.rpc (config-driven "
+                        "timeouts, retries, circuit breakers)",
+                    )
+                )
+    return findings
+
+
+# ------------------------------------------------------------- staging
+
+_HOST_BOUNDARY = ("server", "connectors", "parallel")
+
+
+@core.register(
+    "staging-confinement",
+    "host->device transfers go through exec/staging.py (split cache, "
+    "memory accounting, staging.* metrics)",
+)
+def staging_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        if mod.rel == "exec/staging.py":
+            continue
+        top = mod.rel.split("/")[0]
+        boundary = top in _HOST_BOUNDARY
+        for call in _walk_calls(mod):
+            term = core.terminal_name(call.func)
+            if term == "device_put":
+                findings.append(
+                    mod.finding(
+                        "staging-confinement",
+                        call.lineno,
+                        "raw device_put — stage through "
+                        "presto_tpu.exec.staging instead",
+                    )
+                )
+            elif boundary and isinstance(call.func, ast.Attribute):
+                name = core.call_name(call)
+                if name in ("jnp.asarray", "jnp.array"):
+                    findings.append(
+                        mod.finding(
+                            "staging-confinement",
+                            call.lineno,
+                            f"{name} at a host-boundary layer is a "
+                            "staging act — route through "
+                            "presto_tpu.exec.staging",
+                        )
+                    )
+    return findings
+
+
+# ----------------------------------------------------------- dynfilter
+
+
+@core.register(
+    "dynfilter-confinement",
+    "build-side filter summaries are constructed only in "
+    "exec/dynfilter.py (native-dtype bounds, NDV caps, merge/wire)",
+)
+def dynfilter_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        if mod.rel == "exec/dynfilter.py":
+            continue
+        for call in _walk_calls(mod):
+            term = core.terminal_name(call.func)
+            msg = None
+            if term in ("ColumnFilter", "FilterSummary"):
+                msg = f"ad-hoc {term} construction"
+            elif term == "RangeSet" and any(
+                kw.arg == "lo" for kw in call.keywords
+            ):
+                msg = "ad-hoc RangeSet constraint assembly"
+            elif (
+                core.call_name(call) in ("jnp.min", "jnp.max")
+                and call.args
+                and isinstance(call.args[0], ast.Call)
+                and core.call_name(call.args[0]) == "jnp.where"
+            ):
+                msg = (
+                    "ad-hoc build-side min/max-over-where reduction "
+                    "(32-bit truncation hazard)"
+                )
+            if msg:
+                findings.append(
+                    mod.finding(
+                        "dynfilter-confinement",
+                        call.lineno,
+                        msg + " — build through "
+                        "presto_tpu.exec.dynfilter",
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------- attempt ids
+
+_TASK_ID_NAMES = {"task_id", "src_task", "tid"}
+_SPLIT_METHS = {"split", "rsplit", "partition", "rpartition"}
+
+
+@core.register(
+    "attempt-ids",
+    "task/attempt-id construction and parsing confined to "
+    "server/task_ids.py (spool dedup correctness)",
+)
+def attempt_ids_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        if mod.rel == "server/task_ids.py":
+            continue
+        for node in mod.nodes:
+            # task_id = f"..."  (assignment or keyword argument)
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.JoinedStr
+            ):
+                for t in node.targets:
+                    if core.terminal_name(t) == "task_id":
+                        findings.append(
+                            mod.finding(
+                                "attempt-ids",
+                                node.lineno,
+                                "f-string task id — mint through "
+                                "presto_tpu.server.task_ids",
+                            )
+                        )
+            elif isinstance(node, ast.keyword) and (
+                node.arg == "task_id"
+                and isinstance(node.value, ast.JoinedStr)
+            ):
+                findings.append(
+                    mod.finding(
+                        "attempt-ids",
+                        node.value.lineno,
+                        "f-string task id — mint through "
+                        "presto_tpu.server.task_ids",
+                    )
+                )
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if (
+                    node.func.attr in _SPLIT_METHS
+                    and core.terminal_name(node.func.value)
+                    in _TASK_ID_NAMES
+                ):
+                    findings.append(
+                        mod.finding(
+                            "attempt-ids",
+                            node.lineno,
+                            "string-parsing a task id — parse "
+                            "through presto_tpu.server.task_ids",
+                        )
+                    )
+    return findings
+
+
+# ------------------------------------------------------------- journal
+
+_JOURNAL = "server/journal.py"
+_JOURNAL_CONSUMERS = {_JOURNAL, "server/coordinator.py"}
+#: kill frames are journaled from the arbiter's decision point
+_KILL_CONSUMERS = _JOURNAL_CONSUMERS | {"server/memory_arbiter.py"}
+_RECORD_METHS = {
+    "record_submit",
+    "record_finish",
+    "record_prepare",
+    "record_deallocate",
+}
+
+
+@core.register(
+    "journal-sites",
+    "journal frames confined to server/journal.py; record/replay to "
+    "its audited consumers (coordinator; arbiter for kill frames)",
+)
+def journal_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        frame_ok = mod.rel == _JOURNAL
+        for node in mod.nodes:
+            if isinstance(node, ast.Call):
+                term = core.terminal_name(node.func)
+                if not frame_ok and term in (
+                    "_frame_line",
+                    "_parse_line",
+                ):
+                    findings.append(
+                        mod.finding(
+                            "journal-sites",
+                            node.lineno,
+                            f"journal frame internal {term}() outside "
+                            "server/journal.py",
+                        )
+                    )
+                elif (
+                    term == "CoordinatorJournal"
+                    or term in _RECORD_METHS
+                ) and mod.rel not in _JOURNAL_CONSUMERS:
+                    findings.append(
+                        mod.finding(
+                            "journal-sites",
+                            node.lineno,
+                            f"journal API {term}() outside the "
+                            "audited consumers (server/journal.py, "
+                            "server/coordinator.py)",
+                        )
+                    )
+                elif (
+                    term == "record_kill"
+                    and mod.rel not in _KILL_CONSUMERS
+                ):
+                    findings.append(
+                        mod.finding(
+                            "journal-sites",
+                            node.lineno,
+                            "journal API record_kill() outside the "
+                            "audited consumers",
+                        )
+                    )
+                elif (
+                    term == "replay"
+                    and isinstance(node.func, ast.Attribute)
+                    and mod.rel not in _JOURNAL_CONSUMERS
+                ):
+                    findings.append(
+                        mod.finding(
+                            "journal-sites",
+                            node.lineno,
+                            ".replay() outside the audited consumers",
+                        )
+                    )
+            elif (
+                not frame_ok
+                and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith("journal-")
+            ):
+                findings.append(
+                    mod.finding(
+                        "journal-sites",
+                        node.lineno,
+                        "journal segment-name prefix outside "
+                        "server/journal.py",
+                    )
+                )
+    return findings
+
+
+# ------------------------------------------------------------- reserve
+
+_RESERVE_ALLOWED = {
+    "utils/memory.py",
+    "exec/staging.py",
+    "exec/local_runner.py",
+    "server/worker.py",
+    "server/coordinator.py",
+}
+
+
+@core.register(
+    "reserve-sites",
+    "memory-pool construction and reservations confined to "
+    "utils/memory.py + audited consumers (cluster accounting must be "
+    "complete)",
+)
+def reserve_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        if mod.rel in _RESERVE_ALLOWED:
+            continue
+        for call in _walk_calls(mod):
+            term = core.terminal_name(call.func)
+            if term == "MemoryPool":
+                findings.append(
+                    mod.finding(
+                        "reserve-sites",
+                        call.lineno,
+                        "side-channel MemoryPool construction — the "
+                        "cluster view cannot see it",
+                    )
+                )
+            elif term in ("reserve", "try_reserve") and isinstance(
+                call.func, ast.Attribute
+            ):
+                findings.append(
+                    mod.finding(
+                        "reserve-sites",
+                        call.lineno,
+                        f"ad-hoc .{term}() outside the audited "
+                        "consumers",
+                    )
+                )
+    return findings

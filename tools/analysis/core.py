@@ -1,0 +1,336 @@
+"""Shared AST-analysis framework: the loader, the pass registry, the
+finding type, inline suppressions, and the JSON/baseline plumbing that
+``tools/analyze.py`` and every ``tools/check_*.py`` shim sit on.
+
+Design:
+
+- every pass parses, never imports — the framework must run without
+  jax (and without executing any engine code) so it can gate merges
+  from any environment;
+- a *pass* is a function ``(modules, src_dir) -> [Finding]`` registered
+  under a stable rule id via :func:`register`;
+- suppression is per line: a trailing ``# lint: disable=<rule>[,rule]``
+  on the finding's line keeps the finding in the JSON (marked
+  ``suppressed``) but out of the exit code;
+- a *baseline* file (``tools/analyze.py --baseline``) demotes exact
+  known findings to warn-only so a new pass can be introduced before
+  the tree is clean under it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: inline suppression: ``# lint: disable=rule-a,rule-b`` on the line
+SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation anchored to a source line."""
+
+    rule: str
+    path: str  #: path as reported (src_dir-joined, like the legacy lints)
+    rel: str  #: path relative to the analyzed root (stable across hosts)
+    line: int
+    message: str
+    snippet: str = ""
+    #: set by the framework when the line carries a matching disable
+    suppressed: bool = False
+    #: set by a pass when an audited allowlist entry covers the site
+    allowlisted: bool = False
+    justification: str = ""
+    #: set by the driver when a baseline file covers the finding
+    baselined: bool = False
+
+    @property
+    def active(self) -> bool:
+        """True when the finding should fail the run."""
+        return not (self.suppressed or self.allowlisted or self.baselined)
+
+    def key(self) -> str:
+        """Stable identity used by baseline files."""
+        return f"{self.rule}|{self.rel}|{self.line}"
+
+    def to_json(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "path": self.rel,
+            "line": self.line,
+            "message": self.message,
+        }
+        if self.snippet:
+            d["snippet"] = self.snippet
+        if self.suppressed:
+            d["suppressed"] = True
+        if self.allowlisted:
+            d["allowlisted"] = True
+            d["justification"] = self.justification
+        if self.baselined:
+            d["baselined"] = True
+        return d
+
+
+class Module:
+    """One parsed source file plus its raw lines and suppressions."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self._nodes = None  # lazy flat node list shared by passes
+        #: line -> set of suppressed rule ids
+        self.suppressions: Dict[int, set] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(ln)
+            if m:
+                self.suppressions[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+
+    @property
+    def nodes(self):
+        """Every AST node of the module, flattened once — passes that
+        just scan for node shapes iterate this instead of re-walking
+        the tree (the walk dominated analysis time otherwise)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, line: int, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.path,
+            rel=self.rel,
+            line=line,
+            message=message,
+            snippet=self.snippet(line),
+        )
+
+
+def load_modules(src_dir: str) -> Tuple[List[Module], List[Finding]]:
+    """Parse every ``.py`` under ``src_dir``. Unparseable files become
+    ``parse-error`` findings — nothing is silently skipped."""
+    modules: List[Module] = []
+    errors: List[Finding] = []
+    for root, dirs, files in os.walk(src_dir):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, src_dir).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError as e:
+                errors.append(
+                    Finding("parse-error", path, rel, 0, f"unreadable: {e}")
+                )
+                continue
+            try:
+                modules.append(Module(path, rel, source))
+            except SyntaxError as e:
+                errors.append(
+                    Finding(
+                        "parse-error",
+                        path,
+                        rel,
+                        int(e.lineno or 0),
+                        f"syntax error: {e.msg}",
+                    )
+                )
+    return modules, errors
+
+
+# --------------------------------------------------------------- registry
+
+
+@dataclasses.dataclass
+class Pass:
+    rule: str
+    run: Callable[[List[Module], str], List[Finding]]
+    doc: str = ""
+
+
+#: rule id -> Pass, in registration order (stable CLI/report order)
+PASSES: "Dict[str, Pass]" = {}
+
+
+def register(rule: str, doc: str = ""):
+    """Decorator: publish a pass under a stable rule id."""
+
+    def deco(fn):
+        PASSES[rule] = Pass(rule=rule, run=fn, doc=doc)
+        return fn
+
+    return deco
+
+
+def _ensure_passes_loaded() -> None:
+    """Import every pass module exactly once (registration side
+    effect). Local imports avoid a cycle with the pass modules, which
+    import :mod:`core` themselves."""
+    from analysis import confinement  # noqa: F401
+    from analysis import locks  # noqa: F401
+    from analysis import metric_names  # noqa: F401
+    from analysis import plane  # noqa: F401
+
+
+def all_rules() -> List[str]:
+    _ensure_passes_loaded()
+    return list(PASSES)
+
+
+def run_passes(
+    src_dir: str,
+    rules: Optional[Sequence[str]] = None,
+    baseline: Optional[set] = None,
+) -> List[Finding]:
+    """Run the selected passes (default: all) over ``src_dir`` and
+    return every finding, suppression/baseline flags applied, sorted
+    by (path, line, rule)."""
+    _ensure_passes_loaded()
+    modules, findings = load_modules(src_dir)
+    by_rel = {m.rel: m for m in modules}
+    selected = list(rules) if rules else list(PASSES)
+    for rule in selected:
+        if rule not in PASSES:
+            raise KeyError(
+                f"unknown rule {rule!r} (known: {', '.join(PASSES)})"
+            )
+        findings.extend(PASSES[rule].run(modules, src_dir))
+    for f in findings:
+        mod = by_rel.get(f.rel)
+        if mod is not None and f.rule in mod.suppressions.get(f.line, ()):
+            f.suppressed = True
+        if baseline and f.key() in baseline:
+            f.baselined = True
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule, f.message))
+    return findings
+
+
+# --------------------------------------------------------------- reports
+
+
+def to_json(findings: List[Finding], src_dir: str) -> str:
+    """Stable (diffable) JSON: sorted findings, no timestamps."""
+    doc = {
+        "version": 1,
+        "rules": all_rules(),
+        "findings": [f.to_json() for f in findings],
+        "counts": {
+            "total": len(findings),
+            "active": sum(1 for f in findings if f.active),
+            "suppressed": sum(1 for f in findings if f.suppressed),
+            "allowlisted": sum(1 for f in findings if f.allowlisted),
+            "baselined": sum(1 for f in findings if f.baselined),
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def load_baseline(path: str) -> set:
+    """Baseline file: the ``baseline`` list written by
+    ``analyze.py --write-baseline`` (finding keys, one per entry)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return set(doc.get("baseline", ()))
+    return set(doc)
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    keys = sorted(
+        f.key()
+        for f in findings
+        if not (f.suppressed or f.allowlisted)
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "baseline": keys}, f, indent=2)
+        f.write("\n")
+
+
+# ----------------------------------------------------- AST conveniences
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee (None for computed callees)."""
+    return dotted_name(call.func)
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last identifier of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def str_constants(node: ast.AST) -> List[str]:
+    """Every string literal anywhere under ``node``."""
+    return [
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the class/function qualname stack.
+
+    Subclasses read ``self.class_stack`` / ``self.func_stack`` /
+    :meth:`qualname` and may override ``visit_*`` as usual; they must
+    call ``self.generic_visit(node)`` to descend."""
+
+    def __init__(self):
+        self.class_stack: List[str] = []
+        self.func_stack: List[str] = []
+
+    @property
+    def current_class(self) -> Optional[str]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    def qualname(self) -> str:
+        return ".".join(self.class_stack + self.func_stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_func(node)

@@ -1,0 +1,39 @@
+"""Legacy-shim support: the nine ``tools/check_*.py`` CLIs keep their
+exact command-line contract (exit 0 clean / 1 with a report, same
+``scan()`` tuple shapes) but every rule now runs exactly once, inside
+the framework — no duplicated logic left behind the shims."""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from analysis import core
+
+
+def default_src() -> str:
+    """The repo's presto_tpu package (the legacy lints' default)."""
+    return os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "presto_tpu",
+    )
+
+
+def shim_findings(rule: str, src_dir: str) -> List[core.Finding]:
+    """Active findings of one rule over ``src_dir`` (suppressed and
+    allowlisted sites stay out of a shim's report, exactly like the
+    one CLI)."""
+    return [
+        f
+        for f in core.run_passes(src_dir, rules=[rule])
+        if f.rule == rule and f.active
+    ]
+
+
+def shim_scan(rule: str, src_dir: str):
+    """Legacy ``scan()`` shape: (path, lineno, stripped-source-line)."""
+    return [
+        (f.path, f.line, f.snippet) for f in shim_findings(rule, src_dir)
+    ]

@@ -1,0 +1,985 @@
+"""Concurrency-discipline passes: ``lock-order`` and
+``blocking-under-lock``.
+
+Both sit on one shared model built per run:
+
+1. **Lock identities.** Every ``threading.Lock/RLock/Condition``
+   creation site is collected into a registry — class attributes
+   (``self._lock = threading.Lock()`` and dataclass
+   ``field(default_factory=threading.Lock)``), module-level names, and
+   function-local names. A ``Condition(self._lock)`` aliases the
+   underlying lock (one identity, not two). Identities are per
+   (module, class, attr) — instance-distinct locks of one class share
+   an identity, which over-approximates; suppress deliberate cases
+   inline.
+
+2. **Held-set tracking.** Each function body is walked with the stack
+   of currently-held locks (``with`` nesting; ``.acquire()`` emits an
+   acquisition event without extending the held set — releases are
+   not tracked). ``with`` expressions that *name a known lock
+   attribute* but cannot be pinned to one class still count as held
+   (they gate blocking findings) without feeding graph edges.
+
+3. **Call edges.** Calls are resolved intra-module (bare names,
+   ``self.method``, ``Class.method``), through ``presto_tpu`` import
+   aliases (``rpc.call_json`` -> server/rpc.py), and through a
+   globally-unique-method fallback (skipped for common container verbs
+   — see ``_METHOD_DENYLIST``). Per-function summaries of
+   *may-acquire* and *may-block* propagate through the resolved call
+   graph to a fixpoint.
+
+**lock-order** builds the held-while-acquiring digraph (direct nesting
+plus call edges) and fails on every strongly-connected component,
+printing a witness site for each edge of one representative cycle.
+
+**blocking-under-lock** flags calls from the configurable
+``BLOCKING_CALLS`` set made (directly or through resolved callees)
+while any lock is held. ``Condition.wait`` on the *only* held lock is
+exempt (wait releases it); audited exceptions live in
+``analysis/allowlist.py`` with one-line justifications.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from analysis import core
+from analysis.allowlist import BLOCKING_ALLOWLIST
+
+LOCK_ORDER = "lock-order"
+BLOCKING = "blocking-under-lock"
+
+# ------------------------------------------------------- blocking config
+
+#: dotted callee names that always block (module-qualified spellings)
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "os.replace": "file I/O",
+    "os.fsync": "file I/O",
+    "rpc.call": "intra-cluster RPC",
+    "rpc.call_json": "intra-cluster RPC",
+    "rpc.pull_pages": "intra-cluster RPC",
+    "jax.device_get": "device DMA",
+}
+
+#: terminal (last-component) callee names that always block
+BLOCKING_TERMINAL = {
+    "urlopen": "raw HTTP",
+    "device_put": "device DMA",
+    "device_get": "device DMA",
+    "page_to_host": "device->host DMA",
+    "host_to_page": "host->device DMA",
+    "stage_split": "staging DMA + connector read",
+    "stage_sharded": "staging DMA",
+    "block_until_ready": "device sync",
+    "call_json": "intra-cluster RPC",
+    "pull_pages": "intra-cluster RPC",
+    "record_submit": "journal write",
+    "record_finish": "journal write",
+    "record_prepare": "journal write",
+    "record_deallocate": "journal write",
+    "record_kill": "journal write",
+}
+
+#: bare names (no attribute) that block — the builtin open
+BLOCKING_BARE = {
+    "open": "file I/O",
+    "sleep": "time.sleep",
+    "urlopen": "raw HTTP",
+}
+
+#: spool write/read API: blocking when called on a spool-named receiver
+SPOOL_METHODS = {"append", "commit", "discard", "serve", "gc"}
+
+#: common container/stdlib verbs excluded from the unique-method
+#: call-resolution fallback (list.append must never bind to
+#: ExchangeSpool.append just because the spool defines the only
+#: ``append`` in the tree)
+_METHOD_DENYLIST = {
+    "append", "add", "get", "put", "pop", "update", "items", "keys",
+    "values", "join", "close", "open", "read", "write", "run", "start",
+    "stop", "send", "result", "done", "set", "clear", "copy", "count",
+    "index", "remove", "insert", "extend", "split", "strip", "encode",
+    "decode", "flush", "acquire", "release", "wait", "notify",
+    "notify_all", "submit", "shutdown", "commit", "rollback", "cursor",
+    "execute", "fetchone", "fetchall", "time", "total", "stats", "name",
+    "sort", "discard", "serve", "gc", "main", "scan",
+}
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+
+# ------------------------------------------------------------ lock model
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    ident: str
+    kind: str  # Lock | RLock | Condition
+    rel: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HeldLock:
+    """A lock on the held stack. ``ident`` is None for ambiguous
+    attribute locks (held for blocking purposes, no graph edges)."""
+
+    ident: Optional[str]
+    attr: str
+    line: int
+
+    def label(self) -> str:
+        return self.ident or f"?.{self.attr}"
+
+
+def _mod_ident(rel: str) -> str:
+    return rel[:-3] if rel.endswith(".py") else rel
+
+
+def _ctor_kind(node: ast.AST) -> Optional[Tuple[str, Optional[ast.AST]]]:
+    """(kind, condition-wrapped-lock-expr) when ``node`` constructs a
+    lock, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = core.call_name(node)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last in _LOCK_CTORS and (
+        name == last or name.startswith("threading.")
+    ):
+        wrapped = None
+        if last == "Condition" and node.args:
+            wrapped = node.args[0]
+        return _LOCK_CTORS[last], wrapped
+    # dataclasses.field(default_factory=threading.Lock)
+    if last == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                fac = core.dotted_name(kw.value)
+                if fac:
+                    fl = fac.rsplit(".", 1)[-1]
+                    if fl in _LOCK_CTORS and (
+                        fac == fl or fac.startswith("threading.")
+                    ):
+                        return _LOCK_CTORS[fl], None
+    return None
+
+
+class LockRegistry:
+    def __init__(self):
+        #: attr name -> [(rel, class, LockDef)]
+        self.attr_defs: Dict[str, List[Tuple[str, str, LockDef]]] = {}
+        #: (rel, class) -> {attr: LockDef}
+        self.class_attrs: Dict[Tuple[str, str], Dict[str, LockDef]] = {}
+        #: (rel, name) -> LockDef   (module-level)
+        self.module_names: Dict[Tuple[str, str], LockDef] = {}
+        #: (rel, funcqual, name) -> LockDef   (function-local)
+        self.local_names: Dict[Tuple[str, str, str], LockDef] = {}
+        #: Condition ident -> underlying lock ident
+        self.alias: Dict[str, str] = {}
+
+    def canon(self, ident: str) -> str:
+        seen = set()
+        while ident in self.alias and ident not in seen:
+            seen.add(ident)
+            ident = self.alias[ident]
+        return ident
+
+    def add_attr(self, rel: str, cls: str, attr: str, kind: str, line: int):
+        ident = f"{_mod_ident(rel)}.{cls}.{attr}"
+        d = LockDef(ident, kind, rel, line)
+        self.attr_defs.setdefault(attr, []).append((rel, cls, d))
+        self.class_attrs.setdefault((rel, cls), {})[attr] = d
+        return d
+
+    def add_module(self, rel: str, name: str, kind: str, line: int):
+        d = LockDef(f"{_mod_ident(rel)}.{name}", kind, rel, line)
+        self.module_names[(rel, name)] = d
+        return d
+
+    def add_local(self, rel: str, funcqual: str, name: str, kind: str,
+                  line: int):
+        d = LockDef(
+            f"{_mod_ident(rel)}.{funcqual}.{name}", kind, rel, line
+        )
+        self.local_names[(rel, funcqual, name)] = d
+        return d
+
+
+class _LockCollector(core.ScopedVisitor):
+    """First pass over one module: find every lock creation site."""
+
+    def __init__(self, mod: core.Module, reg: LockRegistry):
+        super().__init__()
+        self.mod = mod
+        self.reg = reg
+
+    def _alias_target(self, wrapped: ast.AST) -> Optional[str]:
+        """Identity of the lock a Condition wraps, when resolvable."""
+        if (
+            isinstance(wrapped, ast.Attribute)
+            and isinstance(wrapped.value, ast.Name)
+            and wrapped.value.id == "self"
+            and self.current_class
+        ):
+            return (
+                f"{_mod_ident(self.mod.rel)}."
+                f"{self.current_class}.{wrapped.attr}"
+            )
+        if isinstance(wrapped, ast.Name):
+            d = self.reg.module_names.get((self.mod.rel, wrapped.id))
+            if d:
+                return d.ident
+        return None
+
+    def _record(self, target: ast.AST, kind: str, wrapped, line: int):
+        d = None
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self.current_class
+        ):
+            d = self.reg.add_attr(
+                self.mod.rel, self.current_class, target.attr, kind, line
+            )
+        elif isinstance(target, ast.Name):
+            if self.func_stack:
+                d = self.reg.add_local(
+                    self.mod.rel, self.qualname(), target.id, kind, line
+                )
+            elif self.current_class:
+                d = self.reg.add_attr(
+                    self.mod.rel, self.current_class, target.id, kind,
+                    line,
+                )
+            else:
+                d = self.reg.add_module(
+                    self.mod.rel, target.id, kind, line
+                )
+        if d is not None and wrapped is not None:
+            tgt = self._alias_target(wrapped)
+            if tgt:
+                self.reg.alias[d.ident] = tgt
+
+    def visit_Assign(self, node: ast.Assign):
+        got = _ctor_kind(node.value)
+        if got:
+            kind, wrapped = got
+            for t in node.targets:
+                self._record(t, kind, wrapped, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            got = _ctor_kind(node.value)
+            if got:
+                kind, wrapped = got
+                self._record(node.target, kind, wrapped, node.lineno)
+        self.generic_visit(node)
+
+
+# -------------------------------------------------------- function model
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    fq: str  # "server/worker.py::Worker._execute"
+    rel: str
+    qual: str
+    cls: Optional[str]
+    node: ast.AST
+    #: direct lock acquisitions: (ident, line)
+    acquires: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list
+    )
+    #: resolved call sites: (callee fq, line, held snapshot)
+    calls: List[Tuple[str, int, Tuple[HeldLock, ...]]] = (
+        dataclasses.field(default_factory=list)
+    )
+    #: direct blocking events:
+    #: (callname, why, line, held snapshot, wait_lock_ident)
+    blocking: List[
+        Tuple[str, str, int, Tuple[HeldLock, ...], Optional[str]]
+    ] = dataclasses.field(default_factory=list)
+    #: direct nesting edges: (held ident, acquired ident, line)
+    edges: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class _FuncCollector(core.ScopedVisitor):
+    """Enumerate every function (incl. nested) of one module."""
+
+    def __init__(self, mod: core.Module, out: Dict[str, FuncInfo]):
+        super().__init__()
+        self.mod = mod
+        self.out = out
+
+    def _visit_func(self, node):
+        self.func_stack.append(node.name)
+        qual = self.qualname()
+        fq = f"{self.mod.rel}::{qual}"
+        self.out[fq] = FuncInfo(
+            fq=fq,
+            rel=self.mod.rel,
+            qual=qual,
+            cls=self.current_class,
+            node=node,
+        )
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+
+def _import_aliases(mod: core.Module, pkg: str):
+    """(module aliases, function aliases) for presto_tpu-internal
+    imports. ``pkg`` is the analyzed package name (src_dir basename).
+    Returns name -> module rel  /  name -> (module rel, func name)."""
+    mod_alias: Dict[str, str] = {}
+    func_alias: Dict[str, Tuple[str, str]] = {}
+
+    def to_rel(dotted: str) -> Optional[str]:
+        parts = dotted.split(".")
+        if parts[0] == pkg:
+            parts = parts[1:]
+        elif parts[0] == "presto_tpu":
+            parts = parts[1:]
+        else:
+            return None
+        if not parts:
+            return None
+        return "/".join(parts) + ".py"
+
+    pkg_dir = "/".join(mod.rel.split("/")[:-1])
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                rel = to_rel(a.name)
+                if rel:
+                    mod_alias[a.asname or a.name.rsplit(".", 1)[-1]] = rel
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_dir
+                for _ in range(node.level - 1):
+                    base = "/".join(base.split("/")[:-1])
+                dotted_base = base.replace("/", ".")
+                dotted = (
+                    f"{pkg}.{dotted_base}.{node.module}"
+                    if node.module and dotted_base
+                    else f"{pkg}.{node.module or dotted_base}"
+                ).rstrip(".")
+            else:
+                dotted = node.module or ""
+            base_rel = to_rel(dotted) if dotted else None
+            for a in node.names:
+                name = a.asname or a.name
+                # ``from presto_tpu.exec import staging`` -> module
+                sub = to_rel(f"{dotted}.{a.name}") if dotted else None
+                if sub:
+                    mod_alias[name] = sub
+                if base_rel:
+                    func_alias[name] = (base_rel, a.name)
+    # a name that is really a submodule wins over the func form
+    for k in mod_alias:
+        func_alias.pop(k, None)
+    return mod_alias, func_alias
+
+
+class _Model:
+    """The shared concurrency model for one analysis run."""
+
+    def __init__(self, modules: List[core.Module], src_dir: str):
+        import os
+
+        self.modules = modules
+        self.pkg = os.path.basename(os.path.abspath(src_dir))
+        self.reg = LockRegistry()
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.by_mod: Dict[str, core.Module] = {m.rel: m for m in modules}
+        for m in modules:
+            _LockCollector(m, self.reg).visit(m.tree)
+        for m in modules:
+            _FuncCollector(m, self.funcs).visit(m.tree)
+        #: method name -> [fq] (class methods only, for the
+        #: unique-definition fallback)
+        self.methods: Dict[str, List[str]] = {}
+        for fq, fi in self.funcs.items():
+            if fi.cls:
+                self.methods.setdefault(
+                    fi.qual.rsplit(".", 1)[-1], []
+                ).append(fq)
+        #: per-module top-level function index: (rel, name) -> fq
+        self.top_funcs: Dict[Tuple[str, str], str] = {}
+        for fq, fi in self.funcs.items():
+            if "." not in fi.qual:
+                self.top_funcs[(fi.rel, fi.qual)] = fq
+        self.imports = {
+            m.rel: _import_aliases(m, self.pkg) for m in modules
+        }
+        for fi in self.funcs.values():
+            _FuncWalk(self, fi).run()
+        self._may_acquire: Dict[str, Dict[str, List[Tuple[str, int]]]] = {}
+        self._may_block: Dict[
+            str, Dict[str, Tuple[str, int, List[Tuple[str, int]]]]
+        ] = {}
+
+    # ------------------------------------------------ lock resolution
+
+    def resolve_lock(
+        self, expr: ast.AST, rel: str, cls: Optional[str], qual: str
+    ) -> Optional[HeldLock]:
+        """HeldLock for a with-expression / acquire receiver, or None
+        when the expression is not a known lock."""
+        line = getattr(expr, "lineno", 0)
+        if isinstance(expr, ast.Name):
+            # lexical lookup: innermost enclosing function first
+            parts = qual.split(".")
+            for i in range(len(parts), 0, -1):
+                d = self.reg.local_names.get(
+                    (rel, ".".join(parts[:i]), expr.id)
+                )
+                if d:
+                    return HeldLock(
+                        self.reg.canon(d.ident), expr.id, line
+                    )
+            d = self.reg.module_names.get((rel, expr.id))
+            if d:
+                return HeldLock(self.reg.canon(d.ident), expr.id, line)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        defs = self.reg.attr_defs.get(attr)
+        if not defs:
+            return None
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            if cls:
+                own = self.reg.class_attrs.get((rel, cls), {}).get(attr)
+                if own:
+                    return HeldLock(
+                        self.reg.canon(own.ident), attr, line
+                    )
+        if len(defs) == 1:
+            return HeldLock(
+                self.reg.canon(defs[0][2].ident), attr, line
+            )
+        # receiver-name hint: `arbiter._lock` -> ClusterMemoryArbiter
+        recv = core.terminal_name(expr.value)
+        if recv:
+            r = recv.lower().lstrip("_")
+            hits = [
+                d
+                for (_rel, c, d) in defs
+                if c.lower().startswith(r) or r in c.lower()
+            ]
+            if len(hits) == 1:
+                return HeldLock(self.reg.canon(hits[0].ident), attr, line)
+        return HeldLock(None, attr, line)  # known lock attr, ambiguous
+
+    # ------------------------------------------------ call resolution
+
+    def resolve_call(
+        self, call: ast.Call, rel: str, cls: Optional[str]
+    ) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            fq = self.top_funcs.get((rel, func.id))
+            if fq:
+                return fq
+            _mods, funcs = self.imports.get(rel, ({}, {}))
+            tgt = funcs.get(func.id)
+            if tgt:
+                return self.top_funcs.get(tgt)
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        meth = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and cls:
+                fq = f"{rel}::{cls}.{meth}"
+                if fq in self.funcs:
+                    return fq
+            # Class.method in the same module
+            fq = f"{rel}::{recv.id}.{meth}"
+            if fq in self.funcs:
+                return fq
+            # imported presto_tpu module: rpc.call_json(...)
+            mods, _funcs = self.imports.get(rel, ({}, {}))
+            target_rel = mods.get(recv.id)
+            if target_rel:
+                return self.top_funcs.get((target_rel, meth)) or (
+                    None
+                )
+        # globally-unique method name (common verbs excluded); when
+        # several classes define it, a receiver-name hint may still
+        # pin one (`self.pool.reserve` -> MemoryPool.reserve)
+        if meth not in _METHOD_DENYLIST:
+            cands = self.methods.get(meth, ())
+            if len(cands) == 1:
+                return cands[0]
+            if len(cands) > 1:
+                recv = core.terminal_name(func.value)
+                if recv:
+                    r = recv.lower().lstrip("_")
+                    hits = [
+                        fq
+                        for fq in cands
+                        if r
+                        and r in self.funcs[fq].qual.split(".")[0].lower()
+                    ]
+                    if len(hits) == 1:
+                        return hits[0]
+        return None
+
+    # -------------------------------------------------- summaries
+
+    def may_acquire(
+        self, fq: str, _stack: Optional[Set[str]] = None
+    ) -> Dict[str, List[Tuple[str, int]]]:
+        """ident -> call chain [(fq, line), ...] ending at the
+        acquisition site, through resolved calls (fixpoint)."""
+        if fq in self._may_acquire:
+            return self._may_acquire[fq]
+        stack = _stack if _stack is not None else set()
+        if fq in stack:
+            return {}
+        stack.add(fq)
+        fi = self.funcs.get(fq)
+        out: Dict[str, List[Tuple[str, int]]] = {}
+        if fi is not None:
+            for ident, line in fi.acquires:
+                out.setdefault(ident, [(fq, line)])
+            for callee, line, _held in fi.calls:
+                for ident, chain in self.may_acquire(
+                    callee, stack
+                ).items():
+                    out.setdefault(ident, [(fq, line)] + chain)
+        stack.discard(fq)
+        # memoized even when computed under a recursion cut: the cut
+        # under-approximates propagation THROUGH a call cycle, which
+        # is acceptable (and keeps the fixpoint linear)
+        self._may_acquire[fq] = out
+        return out
+
+    def may_block(
+        self, fq: str, _stack: Optional[Set[str]] = None
+    ) -> Dict[str, Tuple[str, int, List[Tuple[str, int]], Optional[str]]]:
+        """blocking call name ->
+        (why, line, chain [(fq, line), ...], wait_lock_ident).
+
+        Condition-waits propagate WITH the identity of the lock the
+        wait releases: whether they block a caller depends on the
+        caller's held set (holding only that same lock is fine — wait
+        releases it; holding anything else wedges that lock for the
+        whole wait)."""
+        if fq in self._may_block:
+            return self._may_block[fq]
+        stack = _stack if _stack is not None else set()
+        if fq in stack:
+            return {}
+        stack.add(fq)
+        fi = self.funcs.get(fq)
+        out: Dict[
+            str, Tuple[str, int, List[Tuple[str, int]], Optional[str]]
+        ] = {}
+        if fi is not None:
+            for name, why, line, _held, wait_ident in fi.blocking:
+                out.setdefault(
+                    name, (why, line, [(fq, line)], wait_ident)
+                )
+            for callee, line, _held in fi.calls:
+                for name, (why, bline, chain, wid) in self.may_block(
+                    callee, stack
+                ).items():
+                    out.setdefault(
+                        name, (why, bline, [(fq, line)] + chain, wid)
+                    )
+        stack.discard(fq)
+        self._may_block[fq] = out  # see may_acquire on recursion cuts
+        return out
+
+
+class _FuncWalk:
+    """Held-set walk of one function body: fills FuncInfo events."""
+
+    def __init__(self, model: _Model, fi: FuncInfo):
+        self.model = model
+        self.fi = fi
+        self.held: List[HeldLock] = []
+
+    def run(self):
+        for stmt in self.fi.node.body:
+            self._visit(stmt)
+
+    # ---- traversal
+
+    def _visit(self, node: ast.AST):
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+             ast.Lambda),
+        ):
+            return  # separate execution context (walked as its own unit)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            self._with(node)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _with(self, node):
+        pushed = 0
+        for item in node.items:
+            # the context expression evaluates BEFORE acquisition
+            self._visit(item.context_expr)
+            ref = self.model.resolve_lock(
+                item.context_expr, self.fi.rel, self.fi.cls, self.fi.qual
+            )
+            if ref is not None:
+                self._acquire(ref)
+                self.held.append(ref)
+                pushed += 1
+        for stmt in node.body:
+            self._visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    # ---- events
+
+    def _acquire(self, ref: HeldLock):
+        if ref.ident is not None:
+            self.fi.acquires.append((ref.ident, ref.line))
+            for h in self.held:
+                if h.ident is not None and h.ident != ref.ident:
+                    self.fi.edges.append((h.ident, ref.ident, ref.line))
+
+    def _held_snapshot(self) -> Tuple[HeldLock, ...]:
+        return tuple(self.held)
+
+    def _call(self, call: ast.Call):
+        name = core.call_name(call)
+        term = core.terminal_name(call.func)
+        line = call.lineno
+        # explicit .acquire() on a known lock
+        if term == "acquire" and isinstance(call.func, ast.Attribute):
+            ref = self.model.resolve_lock(
+                call.func.value, self.fi.rel, self.fi.cls, self.fi.qual
+            )
+            if ref is not None:
+                self._acquire(
+                    HeldLock(ref.ident, ref.attr, line)
+                )
+                return
+        # Condition.wait while holding OTHER locks
+        if term in ("wait", "wait_for") and isinstance(
+            call.func, ast.Attribute
+        ):
+            ref = self.model.resolve_lock(
+                call.func.value, self.fi.rel, self.fi.cls, self.fi.qual
+            )
+            if ref is not None:
+                self.fi.blocking.append(
+                    (
+                        f"{ref.label()}.{term}",
+                        "condition wait",
+                        line,
+                        self._held_snapshot(),
+                        ref.ident or f"?.{ref.attr}",
+                    )
+                )
+                return
+        why = self._blocking_why(call, name, term)
+        if why is not None:
+            self.fi.blocking.append(
+                (
+                    name or term or "<call>",
+                    why,
+                    line,
+                    self._held_snapshot(),
+                    None,
+                )
+            )
+        callee = self.model.resolve_call(call, self.fi.rel, self.fi.cls)
+        if callee is not None:
+            self.fi.calls.append((callee, line, self._held_snapshot()))
+
+    def _blocking_why(
+        self, call: ast.Call, name: Optional[str], term: Optional[str]
+    ) -> Optional[str]:
+        if name in BLOCKING_DOTTED:
+            return BLOCKING_DOTTED[name]
+        if isinstance(call.func, ast.Name):
+            if call.func.id in BLOCKING_BARE:
+                return BLOCKING_BARE[call.func.id]
+            # imported-from spellings: `from ..staging import
+            # page_to_host; page_to_host(x)`
+            return BLOCKING_TERMINAL.get(call.func.id)
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        if term in BLOCKING_TERMINAL:
+            return BLOCKING_TERMINAL[term]
+        # unbounded thread join: zero-argument .join() (str.join
+        # always takes the iterable argument)
+        if term == "join" and not call.args and not call.keywords:
+            return "unbounded thread join"
+        # spool writes: spool-named receiver
+        if term in SPOOL_METHODS:
+            recv = core.terminal_name(call.func.value)
+            if recv and "spool" in recv.lower():
+                return "spool I/O"
+        return None
+
+
+# --------------------------------------------------------------- passes
+
+#: size-1 model cache: both concurrency passes run over the SAME
+#: loaded module list within one run_passes() call — build the model
+#: once. Keyed by CONTENT (per-module source hashes), never object
+#: identity: a second run over re-parsed (possibly edited) sources
+#: must rebuild, and CPython recycles list ids across runs.
+_MODEL_CACHE: dict = {}
+
+
+def _model_for(modules, src_dir) -> _Model:
+    key = (
+        src_dir,
+        tuple((m.rel, hash(m.source)) for m in modules),
+    )
+    if _MODEL_CACHE.get("key") != key:
+        _MODEL_CACHE["key"] = key
+        _MODEL_CACHE["model"] = _Model(modules, src_dir)
+    return _MODEL_CACHE["model"]
+
+
+def _fmt_held(held: Tuple[HeldLock, ...]) -> str:
+    return ", ".join(h.label() for h in held)
+
+
+def _fmt_chain(chain: List[Tuple[str, int]]) -> str:
+    hops = [
+        f"{fq.split('::', 1)[1]} (line {line})" for fq, line in chain
+    ]
+    return " -> ".join(hops[:4])
+
+
+@core.register(
+    LOCK_ORDER,
+    "static deadlock detection: the held-while-acquiring lock graph "
+    "must stay acyclic",
+)
+def lock_order_pass(modules, src_dir):
+    model = _model_for(modules, src_dir)
+    # edge (A, B) -> witness (rel, line, funcqual, description)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str, str]] = {}
+    for fi in model.funcs.values():
+        for a, b, line in fi.edges:
+            edges.setdefault(
+                (a, b), (fi.rel, line, fi.qual, "nested acquisition")
+            )
+        for callee, line, held in fi.calls:
+            if not held:
+                continue
+            for ident, chain in model.may_acquire(callee).items():
+                for h in held:
+                    if h.ident is None or h.ident == ident:
+                        continue
+                    edges.setdefault(
+                        (h.ident, ident),
+                        (
+                            fi.rel,
+                            line,
+                            fi.qual,
+                            f"via call {_fmt_chain(chain)}",
+                        ),
+                    )
+    findings = []
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    for cycle in _cycles(adj):
+        steps = []
+        anchor = None
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            rel, line, qual, how = edges[(a, b)]
+            if anchor is None:
+                anchor = (rel, line)
+            steps.append(
+                f"{a} -> {b} [{rel}:{line} in {qual}, {how}]"
+            )
+        rel, line = anchor
+        mod = next(m for m in modules if m.rel == rel)
+        findings.append(
+            mod.finding(
+                LOCK_ORDER,
+                line,
+                "lock-order cycle (potential deadlock): "
+                + "; ".join(steps),
+            )
+        )
+    return findings
+
+
+def _cycles(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """One representative cycle per strongly-connected component
+    (Tarjan), deterministic order."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str):
+        # iterative Tarjan (analysis trees can be deep)
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or (
+                    node in adj.get(node, ())
+                ):
+                    sccs.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in index:
+            strong(v)
+    # extract one concrete cycle per SCC by DFS inside the component
+    cycles = []
+    for comp in sccs:
+        compset = set(comp)
+        start = comp[0]
+        path = [start]
+        seen = {start}
+
+        def dfs(v) -> Optional[List[str]]:
+            for w in sorted(adj.get(v, ())):
+                if w == start and len(path) > 0:
+                    return list(path)
+                if w in compset and w not in seen:
+                    seen.add(w)
+                    path.append(w)
+                    got = dfs(w)
+                    if got:
+                        return got
+                    path.pop()
+            return None
+
+        got = dfs(start)
+        if got:
+            cycles.append(got)
+    return cycles
+
+
+@core.register(
+    BLOCKING,
+    "no blocking call (RPC, DMA, file I/O, sleep, unbounded join, "
+    "journal/spool writes) while a lock is held",
+)
+def blocking_under_lock_pass(modules, src_dir):
+    model = _model_for(modules, src_dir)
+    findings = []
+    seen: Set[Tuple[str, int, str]] = set()
+
+    def emit(fi: FuncInfo, line: int, callname: str, why: str,
+             held, chain_desc: str = ""):
+        key = (fi.rel, line, callname)
+        if key in seen:
+            return
+        seen.add(key)
+        mod = model.by_mod[fi.rel]
+        msg = (
+            f"blocking call {callname} ({why}) while holding "
+            f"{_fmt_held(held)} in {fi.qual}"
+        )
+        if chain_desc:
+            msg += f" [{chain_desc}]"
+        f = mod.finding(BLOCKING, line, msg)
+        for entry in BLOCKING_ALLOWLIST:
+            if (
+                entry.path == fi.rel
+                and entry.func == fi.qual
+                and entry.call == callname
+            ):
+                f.allowlisted = True
+                f.justification = entry.why
+                break
+        findings.append(f)
+
+    for fi in model.funcs.values():
+        for name, why, line, held, wait_ident in fi.blocking:
+            if not held:
+                continue
+            if wait_ident is not None:
+                # Condition.wait releases ITS OWN lock; flag only when
+                # other locks stay held across the wait
+                others = [
+                    h for h in held if h.label() != wait_ident
+                ]
+                if others:
+                    emit(
+                        fi, line, name,
+                        "condition wait holding unrelated lock(s)",
+                        tuple(others),
+                    )
+                continue
+            emit(fi, line, name, why, held)
+        for callee, line, held in fi.calls:
+            if not held:
+                continue
+            for name, (why, _bline, chain, wid) in model.may_block(
+                callee
+            ).items():
+                if wid is not None:
+                    others = tuple(
+                        h for h in held if h.label() != wid
+                    )
+                    if not others:
+                        continue
+                    emit(
+                        fi, line, name,
+                        "condition wait holding unrelated lock(s)",
+                        others,
+                        chain_desc=f"via {_fmt_chain(chain)}",
+                    )
+                    continue
+                emit(
+                    fi, line, name, why, held,
+                    chain_desc=f"via {_fmt_chain(chain)}",
+                )
+    return findings

@@ -1,0 +1,109 @@
+"""``metric-names`` pass: every metric name registers under exactly
+one kind (counter vs timer vs distribution) across the whole tree.
+
+The registry raises TypeError at runtime on a kind conflict, but only
+on the code path that hits it; this pass fails the conflict at
+analysis time instead. AST successor of ``check_metric_names.py``,
+with one real upgrade: registration through a *loop variable* over a
+literal tuple/list resolves to the literal names —
+
+    for m in ("pool.scale_up", "pool.scale_down"):
+        REGISTRY.counter(m)
+
+registers both names (the regex predecessor saw no string literal in
+the call and silently skipped the PR 7-9 counter families registered
+this way: history.*, journal.*, pool.*, memory.*, spill.*).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from analysis import core
+
+_KINDS = {"counter", "timer", "distribution"}
+
+
+def collect_sites(
+    modules: List[core.Module],
+) -> Dict[str, Set[Tuple[str, str, int]]]:
+    """metric name -> {(kind, rel, line), ...} over every
+    ``REGISTRY.<kind>(...)`` site, resolving literal arguments,
+    literals anywhere inside the argument expressions (conditional
+    names), and loop variables bound over literal sequences."""
+    sites: Dict[str, Set[Tuple[str, str, int]]] = {}
+    for mod in modules:
+        #: Name id -> literal strings it loops over (innermost wins is
+        #: unnecessary: names are merged — a conflict is a conflict)
+        loop_bindings: Dict[str, List[str]] = {}
+        for node in mod.nodes:
+            if isinstance(node, ast.For) and isinstance(
+                node.target, ast.Name
+            ):
+                if isinstance(node.iter, (ast.Tuple, ast.List)):
+                    vals = [
+                        e.value
+                        for e in node.iter.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+                    if vals:
+                        loop_bindings.setdefault(
+                            node.target.id, []
+                        ).extend(vals)
+        for node in mod.nodes:
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _KINDS
+                and core.terminal_name(node.func.value) == "REGISTRY"
+            ):
+                continue
+            kind = node.func.attr
+            names: List[str] = []
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                names.extend(core.str_constants(arg))
+                if isinstance(arg, ast.Name):
+                    names.extend(loop_bindings.get(arg.id, ()))
+            for name in names:
+                sites.setdefault(name, set()).add(
+                    (kind, mod.rel, node.lineno)
+                )
+    return sites
+
+
+def find_conflicts(sites):
+    out = []
+    for name, entries in sorted(sites.items()):
+        kinds = {k for k, _rel, _line in entries}
+        if len(kinds) > 1:
+            out.append((name, sorted(entries)))
+    return out
+
+
+@core.register(
+    "metric-names",
+    "every metric name registers under ONE kind "
+    "(counter/timer/distribution), loop-registered families included",
+)
+def metric_names_pass(modules: List[core.Module], src_dir: str):
+    by_rel = {m.rel: m for m in modules}
+    findings = []
+    for name, entries in find_conflicts(collect_sites(modules)):
+        kind0, rel0, line0 = entries[0]
+        mod = by_rel[rel0]
+        where = ", ".join(
+            f"{k} at {rel}:{line}" for k, rel, line in entries
+        )
+        findings.append(
+            mod.finding(
+                "metric-names",
+                line0,
+                f"metric {name!r} registered under conflicting kinds: "
+                f"{where}",
+            )
+        )
+    return findings

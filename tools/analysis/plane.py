@@ -1,0 +1,157 @@
+"""Compile-plane invariant checker: ``plan-params`` and
+``history-sites``.
+
+The zero-recompile serving plane (plan/canonical.py) and the
+history-based statistics plane (plan/history.py) are only correct
+while their privileged constructs stay confined:
+
+- a ``RuntimeParam`` minted outside the canonicalizer bypasses the
+  dtype/structure eligibility rules and miscompiles;
+- a ``BoundParam`` minted outside it breaks the ordinal<->value
+  correspondence the statement cache binds by;
+- a compile-cache (``_compiled``) key assembled elsewhere can bake
+  literals back in and re-open the compile-per-literal-variant hole;
+- a history record, fingerprint, or ``lookup_rows`` call outside the
+  store forks the canonical identity and the estimate provenance.
+
+This is the AST successor of ``check_plan_params.py`` +
+``check_history_sites.py``: calls are matched as calls (an
+``isinstance(x, RuntimeParam)`` or a ``qs.plan_fingerprint`` attribute
+read never needed an exemption to begin with), and the two legacy
+read-only exemptions for ``_compiled`` (``len(self._compiled)``,
+``self._runner._compiled``) are expressed structurally instead of by
+line-scrubbing — a disallowed call sharing a line with an exempt read
+still flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from analysis import core
+
+_CANONICAL = "plan/canonical.py"
+_RUNNER = "exec/local_runner.py"
+_HISTORY = "plan/history.py"
+
+#: call-confinement rules: terminal callee name -> allowed modules
+_PLAN_CALLS = {
+    "RuntimeParam": {_CANONICAL, "plan/planner.py", "expr.py"},
+    "BoundParam": {_CANONICAL, "sql/ast.py"},
+    "hoist_params": {_CANONICAL, _RUNNER},
+}
+
+_HISTORY_CALLS = {
+    "QueryHistoryStore": {_HISTORY, _RUNNER},
+    "record_query": {_HISTORY, _RUNNER},
+    "lookup_rows": {_HISTORY, "plan/optimizer.py"},
+    "node_fingerprint": {
+        _HISTORY,
+        _RUNNER,
+        "exec/explain.py",
+        "server/coordinator.py",
+    },
+    "node_fingerprints": {
+        _HISTORY,
+        _RUNNER,
+        "exec/explain.py",
+        "server/coordinator.py",
+    },
+    "plan_fingerprint": {
+        _HISTORY,
+        _RUNNER,
+        "exec/explain.py",
+        "server/coordinator.py",
+    },
+}
+
+
+def _exempt_compiled_reads(mod: core.Module) -> set:
+    """ids of ``_compiled`` Attribute nodes that are read-only by
+    structure: the direct argument of ``len()``, or reached through
+    ``self._runner`` (a test/debug peek at the runner's cache)."""
+    exempt = set()
+    for node in mod.nodes:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Attribute)
+            and node.args[0].attr == "_compiled"
+        ):
+            exempt.add(id(node.args[0]))
+        elif isinstance(node, ast.Attribute) and node.attr == "_compiled":
+            chain = core.dotted_name(node)
+            if chain and chain.startswith("self._runner."):
+                exempt.add(id(node))
+    return exempt
+
+
+def _confined_calls(modules, rules, rule_id, route_hint):
+    findings = []
+    for mod in modules:
+        for node in mod.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            term = core.terminal_name(node.func)
+            allowed = rules.get(term)
+            if allowed is None or mod.rel in allowed:
+                continue
+            findings.append(
+                mod.finding(
+                    rule_id,
+                    node.lineno,
+                    f"{term}() outside its audited modules "
+                    f"({', '.join(sorted(allowed))}) — route through "
+                    f"{route_hint}",
+                )
+            )
+    return findings
+
+
+@core.register(
+    "plan-params",
+    "literal hoisting, RuntimeParam/BoundParam construction, and "
+    "compile-cache keying confined to plan/canonical.py + audited "
+    "consumers",
+)
+def plan_params_pass(modules: List[core.Module], src_dir: str):
+    findings = _confined_calls(
+        modules, _PLAN_CALLS, "plan-params", "presto_tpu.plan.canonical"
+    )
+    for mod in modules:
+        if mod.rel == _RUNNER:
+            continue
+        exempt = _exempt_compiled_reads(mod)
+        for node in mod.nodes:
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "_compiled"
+                and id(node) not in exempt
+            ):
+                findings.append(
+                    mod.finding(
+                        "plan-params",
+                        node.lineno,
+                        "_compiled store access outside "
+                        "exec/local_runner.py — compile-cache keys "
+                        "are built in exactly one place",
+                    )
+                )
+    return findings
+
+
+@core.register(
+    "history-sites",
+    "history records, canonical fingerprints, and estimate-time "
+    "lookups confined to plan/history.py + audited consumers",
+)
+def history_sites_pass(modules: List[core.Module], src_dir: str):
+    return _confined_calls(
+        modules,
+        _HISTORY_CALLS,
+        "history-sites",
+        "presto_tpu.plan.history",
+    )

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""One CLI for the unified AST analysis framework.
+
+Usage::
+
+    python tools/analyze.py [src_dir] [options]
+
+    --rules a,b,c         run only these passes (default: all)
+    --list-rules          print every rule id + description and exit
+    --json                stable, diffable JSON report on stdout
+    --baseline FILE       demote findings listed in FILE to warn-only
+    --write-baseline FILE write the current unsuppressed findings as a
+                          baseline (introduce a new pass warn-only,
+                          enforce once the tree is clean)
+
+Exit code 0 when every finding is suppressed (inline
+``# lint: disable=<rule>``), allowlisted (analysis/allowlist.py), or
+baselined; 1 otherwise. ``src_dir`` defaults to the repo's
+``presto_tpu`` package.
+
+Wired into the test suite via tests/test_static_analysis.py — the one
+entrypoint that replaced the per-suite lint wiring.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import analysis  # noqa: E402
+
+
+def _default_src() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "presto_tpu",
+    )
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    src_dir = _default_src()
+    rules = None
+    as_json = False
+    baseline_path = None
+    write_baseline_path = None
+    i = 0
+    positional = []
+    while i < len(args):
+        a = args[i]
+        if a == "--json":
+            as_json = True
+        elif a == "--list-rules":
+            for rule in analysis.all_rules():
+                print(f"{rule:<22} {analysis.PASSES[rule].doc}")
+            return 0
+        elif a == "--rules":
+            i += 1
+            rules = [r.strip() for r in args[i].split(",") if r.strip()]
+        elif a == "--baseline":
+            i += 1
+            baseline_path = args[i]
+        elif a == "--write-baseline":
+            i += 1
+            write_baseline_path = args[i]
+        elif a.startswith("--"):
+            print(f"unknown option {a}", file=sys.stderr)
+            return 2
+        else:
+            positional.append(a)
+        i += 1
+    if positional:
+        src_dir = positional[0]
+
+    baseline = None
+    if baseline_path and os.path.exists(baseline_path):
+        baseline = analysis.load_baseline(baseline_path)
+
+    findings = analysis.run_passes(src_dir, rules=rules, baseline=baseline)
+
+    if write_baseline_path:
+        analysis.write_baseline(write_baseline_path, findings)
+
+    active = [f for f in findings if f.active]
+    if as_json:
+        print(analysis.to_json(findings, src_dir))
+        return 1 if active else 0
+
+    for f in findings:
+        tag = ""
+        if f.suppressed:
+            tag = " [suppressed]"
+        elif f.allowlisted:
+            tag = f" [allowlisted: {f.justification}]"
+        elif f.baselined:
+            tag = " [baselined]"
+        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}{tag}")
+        if f.snippet:
+            print(f"    {f.snippet}")
+    ran = rules or analysis.all_rules()
+    if not active:
+        quiet = len(findings) - len(active)
+        extra = f" ({quiet} suppressed/allowlisted/baselined)" if (
+            quiet
+        ) else ""
+        print(
+            f"analyze: {len(ran)} pass(es) clean over {src_dir}{extra}"
+        )
+        return 0
+    print(
+        f"analyze: {len(active)} finding(s) across "
+        f"{len({f.rel for f in active})} file(s)"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,74 +1,37 @@
 #!/usr/bin/env python
 """Attempt-id lint: task/attempt-id construction and parsing must be
 confined to ``presto_tpu/server/task_ids.py`` — the one audited module.
+Recovery is only correct when exactly one attempt's pages are consumed
+per logical task; an ad-hoc f-string task id or a bare
+``task_id.split(...)`` elsewhere silently breaks that dedup.
 
-Fault-tolerant execution keys the durable exchange spool by
-deterministic task-attempt ids, and recovery is only correct when
-exactly one attempt's pages are consumed per logical task. An ad-hoc
-f-string task id or a bare ``task_id.split(...)`` elsewhere would
-silently break that dedup (a replacement attempt would stop sharing its
-original's logical key, or a parser would mis-read the attempt field).
-
-Forbidden outside the audited module:
-
-- constructing a task id from an f-string  (``task_id=f"..."``)
-- string-parsing a task id                 (``task_id.split(...)``,
-  ``src_task.rsplit(...)``, partition/rpartition likewise)
-
-Usage: ``python tools/check_attempt_ids.py [src_dir]`` — exits 0 when
-clean, 1 with a report. Wired into the test suite via
-tests/test_spool.py (like check_rpc_calls / check_dynfilter_sites).
+Shim over the unified AST framework (``tools/analysis``, rule
+``attempt-ids``) — exits 0 when clean, 1 with a report. Run every
+pass at once with ``tools/analyze.py``; wired into the test suite via
+tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: ad-hoc construction: any f-string assigned to a task_id variable or
-#: keyword argument
-_CONSTRUCT = re.compile(r"\btask_id\s*=\s*f[\"']")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: ad-hoc parsing: string-splitting a task id (by any spelling the
-#: codebase uses for one)
-_PARSE = re.compile(
-    r"\b(task_id|src_task|tid)\s*\.\s*(r?split|r?partition)\s*\("
-)
+from analysis import legacy  # noqa: E402
 
-#: the one module allowed to construct/parse (relative to src_dir root)
-ALLOWED = {os.path.join("server", "task_ids.py")}
+RULE = "attempt-ids"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
+def scan(src_dir):
     """(path, line, source-line) for every ad-hoc task-id construction
     or parse site outside the audited module."""
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            if rel in ALLOWED:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if _CONSTRUCT.search(line) or _PARSE.search(line):
-                        out.append((path, lineno, stripped))
-    return out
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

@@ -2,79 +2,43 @@
 """Staging-plane lint: every host->device transfer must go through
 ``presto_tpu/exec/staging.py`` — the one place with capacity
 bucketing, split-cache lookup, memory-pool accounting, and
-``staging.*`` metrics. A raw ``jax.device_put`` (or an
-``jnp.asarray``/``jnp.array`` conversion of host data at the
-host-boundary layers) anywhere else silently bypasses the cache and
-the accountant, so this lint forbids it (mirrors
-``tools/check_rpc_calls.py`` for the RPC plane).
+``staging.*`` metrics.
 
-Rules:
-- ``jax.device_put(`` / bare ``device_put(`` is forbidden everywhere
-  outside the allowed module — it is ALWAYS a host->device transfer.
+Rules (unchanged):
+
+- ``device_put(`` is forbidden everywhere outside the allowed module;
 - ``jnp.asarray(`` / ``jnp.array(`` is forbidden only under the
   host-boundary packages (``server/``, ``connectors/``,
-  ``parallel/``), where arrays hold host payloads and the conversion
-  IS staging. Trace-time uses inside ``ops/``/``exec/`` compile into
-  device programs and are fine.
+  ``parallel/``); trace-time uses inside ``ops/``/``exec/`` compile
+  into device programs and are fine.
 
-Usage: ``python tools/check_device_puts.py [src_dir]`` — exits 0 when
-clean, 1 with a report listing every raw staging call site.
-
-Wired into the test suite via tests/test_staging_cache.py.
+Shim over the unified AST framework (``tools/analysis``, rule
+``staging-confinement``) — exits 0 when clean, 1 with a report. Run
+every pass at once with ``tools/analyze.py``; wired into the test
+suite via tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: explicit device placement (module-qualified or bare after import-from)
-_DEVICE_PUT = re.compile(r"\bdevice_put\s*\(")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: host->device array conversion at the host-boundary layers
-_JNP_CONVERT = re.compile(r"\bjnp\.(?:asarray|array)\s*\(")
+from analysis import legacy  # noqa: E402
 
-#: the one module allowed to stage (relative to src_dir root)
-ALLOWED = {os.path.join("exec", "staging.py")}
-
-#: packages where ANY jnp array conversion is a staging act
-HOST_BOUNDARY_DIRS = ("server", "connectors", "parallel")
+RULE = "staging-confinement"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
+def scan(src_dir):
     """(path, line, source-line) for every raw staging call site
     outside the allowed module."""
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            if rel in ALLOWED:
-                continue
-            top = rel.split(os.sep)[0]
-            check_convert = top in HOST_BOUNDARY_DIRS
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if _DEVICE_PUT.search(line) or (
-                        check_convert and _JNP_CONVERT.search(line)
-                    ):
-                        out.append((path, lineno, stripped))
-    return out
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

@@ -4,70 +4,33 @@ constructed in ``presto_tpu/exec/dynfilter.py`` — the one audited
 module that owns bounds-in-native-dtype discipline, NDV caps,
 dictionary-id remapping, merge semantics, and the wire form.
 
-An ad-hoc ``jnp.min(jnp.where(mask, keys, fill))`` build-side bound, a
-hand-rolled ``ColumnFilter``/``FilterSummary`` construction, or a
-bare ``RangeSet`` constraint assembled outside the module silently
-re-opens the exact bug class this plane closed (32-bit-truncated
-bounds excluding matching probe rows), so this lint forbids them
-everywhere else in the engine.
-
-Usage: ``python tools/check_dynfilter_sites.py [src_dir]`` — exits 0
-when clean, 1 with a report listing every offending site.
-
-Wired into the test suite via tests/test_dynfilter.py (the same
-pattern as tools/check_rpc_calls.py in tests/test_faults.py).
+Shim over the unified AST framework (``tools/analysis``, rule
+``dynfilter-confinement``) — exits 0 when clean, 1 with a report. Run
+every pass at once with ``tools/analyze.py``; wired into the test
+suite via tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: forbidden spellings outside the audited module:
-#: - the build-summary reduction idiom (min/max over a where-filled
-#:   key column — the shape that used to live in local_runner)
-#: - direct summary-object construction
-#: - RangeSet constraint assembly (the split-pruning vocabulary)
-_PATTERNS = [
-    re.compile(r"\bjnp\.(?:min|max)\s*\(\s*jnp\.where\s*\("),
-    re.compile(r"\b(?:ColumnFilter|FilterSummary)\s*\("),
-    re.compile(r"\bRangeSet\s*\(\s*lo\s*="),
-]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: the one module allowed to build summaries (relative to src_dir root)
-ALLOWED = {os.path.join("exec", "dynfilter.py")}
+from analysis import legacy  # noqa: E402
+
+RULE = "dynfilter-confinement"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
+def scan(src_dir):
     """(path, line, source-line) for every forbidden summary-
     construction site outside the allowed module."""
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            if rel in ALLOWED:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if any(p.search(line) for p in _PATTERNS):
-                        out.append((path, lineno, stripped))
-    return out
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

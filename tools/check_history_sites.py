@@ -1,113 +1,37 @@
 #!/usr/bin/env python
 """History-based-statistics lint: history-record construction/parsing,
 canonical node fingerprints, and the ``estimate_rows`` history lookup
-are owned by ``presto_tpu/plan/history.py`` (plus the audited consumers
-noted below).
+are owned by ``presto_tpu/plan/history.py`` plus the audited consumers
+(plan/optimizer.py, exec/local_runner.py, exec/explain.py,
+server/coordinator.py).
 
-Why this matters: a history record written outside the store bypasses
-the crash-safe segment discipline (torn-line tolerance, rotation, the
-bounded index) and the hit/miss/write/evict metrics; a node fingerprint
-computed ad hoc forks the canonical identity (the store's keys are
-literal- AND pruning-invariant — plan/history._signature is the one
-place that knows which fields are cardinality-determining); and a
-history lookup outside ``optimizer.estimate_rows`` silently re-opens
-the estimate-provenance hole EXPLAIN labels were built to close.
-
-Allowed sites:
-- ``plan/history.py`` — the store + fingerprints (everything);
-- ``plan/optimizer.py`` — the ONE estimate-time lookup;
-- ``exec/local_runner.py`` — store construction (config/env wiring),
-  per-compile fingerprint batches, the analyzed-run record write;
-- ``exec/explain.py`` — est-vs-actual rendering fingerprints;
-- ``server/coordinator.py`` — the statement-fingerprint stamp.
-
-Usage: ``python tools/check_history_sites.py [src_dir]`` — exits 0 when
-clean, 1 with a report listing every offending site. Wired into the
-test suite via tests/test_history_stats.py (the same pattern as
-tools/check_plan_params.py in tests/test_plan_cache.py).
+Shim over the unified AST framework (``tools/analysis``, rule
+``history-sites`` — the compile-plane invariant checker's history
+half: calls are matched as calls, so attribute reads and keyword
+assignments never needed scrub patterns). Exits 0 when clean, 1 with
+a report. Run every pass at once with ``tools/analyze.py``; wired
+into the test suite via tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-_HISTORY = os.path.join("plan", "history.py")
-_RUNNER = os.path.join("exec", "local_runner.py")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: (pattern, allowed relative paths)
-_RULES = [
-    # store construction: config/env wiring lives on the runner
-    (
-        re.compile(r"\bQueryHistoryStore\s*\("),
-        {_HISTORY, _RUNNER},
-    ),
-    # record write (construct + persist): the store itself, plus the
-    # runner's analyzed-run twin of the query-completed path
-    (
-        re.compile(r"\brecord_query\s*\("),
-        {_HISTORY, _RUNNER},
-    ),
-    # the estimate-time read path: exactly optimizer.estimate_rows
-    (
-        re.compile(r"\blookup_rows\s*\("),
-        {_HISTORY, os.path.join("plan", "optimizer.py")},
-    ),
-    # canonical node fingerprints: the store's key space
-    (
-        re.compile(r"\bnode_fingerprints?\s*\(|\bplan_fingerprint\s*\("),
-        {
-            _HISTORY,
-            _RUNNER,
-            os.path.join("exec", "explain.py"),
-            os.path.join("server", "coordinator.py"),
-        },
-    ),
-]
+from analysis import legacy  # noqa: E402
 
-#: read-only mentions that are NOT construction/lookup (attribute reads
-#: of the stamped QueryStats field, keyword/assignment targets, string
-#: keys, isinstance checks). These are SCRUBBED from the line before
-#: the rules run — a blanket line-level exemption would also swallow a
-#: disallowed call on the same line (``x.plan_fingerprint =
-#: plan_history.plan_fingerprint(root)`` must still flag).
-_EXEMPT_SUB = re.compile(
-    r"isinstance\s*\(|\.plan_fingerprint\b(?!\s*\()|"
-    r"\bplan_fingerprint\s*=(?!=)|\"plan_fingerprint\""
-)
+RULE = "history-sites"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    scrubbed = _EXEMPT_SUB.sub(" ", line)
-                    for pat, allowed in _RULES:
-                        if rel in allowed:
-                            continue
-                        if pat.search(scrubbed):
-                            out.append((path, lineno, stripped))
-                            break  # one report per line
-    return out
+def scan(src_dir):
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

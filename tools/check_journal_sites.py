@@ -1,87 +1,44 @@
 #!/usr/bin/env python
 """Journal-site lint: coordinator-journal frame construction and replay
 must be confined to ``presto_tpu/server/journal.py`` (the one audited
-module), with ``server/coordinator.py`` as the one audited CONSUMER of
-its record/replay API.
+module), with ``server/coordinator.py`` as the audited CONSUMER of its
+record/replay API (and ``server/memory_arbiter.py`` for kill frames).
 
-Coordinator HA hangs on the journal's replayability: a restarted
-coordinator re-admits exactly the queries whose submit frame has no
-finish frame. An ad-hoc frame writer elsewhere (hand-rolled crc line, a
-segment file opened under the journal directory, a duplicate replay
-loop) would silently fork that truth — resumed-twice queries or
-forgotten ones, invisible until a restart under load.
-
-Forbidden OUTSIDE ``server/journal.py``:
-
-- journal frame construction/parsing (``_frame_line`` / ``_parse_line``)
-- journal segment naming (the ``"journal-"`` file prefix)
-
-Forbidden outside ``server/journal.py`` + ``server/coordinator.py``:
-
-- constructing the journal       (``CoordinatorJournal(...)``)
-- writing records                (``record_submit/finish/prepare/
-  deallocate``)
-- replaying                      (``.replay(``)
-
-Usage: ``python tools/check_journal_sites.py [src_dir]`` — exits 0 when
-clean, 1 with a report. Wired into the test suite via
-tests/test_elastic.py (like check_attempt_ids / check_history_sites).
+Shim over the unified AST framework (``tools/analysis``, rule
+``journal-sites``) — exits 0 when clean, 1 with a report. Run every
+pass at once with ``tools/analyze.py``; wired into the test suite via
+tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: frame-level internals: only the journal module itself
-_FRAME = re.compile(r"\b_(frame|parse)_line\s*\(|[\"']journal-")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: the record/replay API: journal module + the audited consumer
-_CONSUMER = re.compile(
-    r"\bCoordinatorJournal\s*\("
-    r"|\brecord_(submit|finish|prepare|deallocate)\s*\("
-    r"|\.replay\s*\("
-)
+from analysis import legacy  # noqa: E402
 
-FRAME_ALLOWED = {os.path.join("server", "journal.py")}
-CONSUMER_ALLOWED = FRAME_ALLOWED | {
-    os.path.join("server", "coordinator.py")
-}
+RULE = "journal-sites"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str, str]]:
+def scan(src_dir):
     """(path, line, kind, source-line) for every journal site outside
     its audited module(s)."""
-    out: List[Tuple[str, int, str, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if rel not in FRAME_ALLOWED and _FRAME.search(line):
-                        out.append((path, lineno, "frame", stripped))
-                        continue
-                    if rel not in CONSUMER_ALLOWED and _CONSUMER.search(
-                        line
-                    ):
-                        out.append((path, lineno, "consumer", stripped))
+    out = []
+    for f in legacy.shim_findings(RULE, src_dir):
+        kind = (
+            "frame"
+            if ("frame internal" in f.message or "segment-name" in f.message)
+            else "consumer"
+        )
+        out.append((f.path, f.line, kind, f.snippet))
     return out
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

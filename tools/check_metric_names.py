@@ -2,65 +2,41 @@
 """Metric-name lint: every ``REGISTRY.<kind>("name")`` call site in the
 source must register each metric name with ONE kind — the registry
 raises TypeError at runtime on a conflict, but only on the code path
-that hits it; this lint fails the conflict at test time instead.
+that hits it; this lint fails the conflict at analysis time instead.
 
-Usage: ``python tools/check_metric_names.py [src_dir]`` — exits 0 when
-clean, 1 with a report when any name is registered under conflicting
-kinds (counter vs timer vs distribution).
-
-Wired into the test suite via tests/test_observability.py.
+Shim over the unified AST framework (``tools/analysis``, rule
+``metric-names``). The AST pass also resolves names registered through
+a loop variable over a literal tuple (the PR 7-9 counter families —
+history.*, journal.*, pool.*, memory.*, spill.* — register that way),
+which the regex predecessor silently skipped. Exits 0 when clean, 1
+with a report. Run every pass at once with ``tools/analyze.py``;
+wired into the test suite via tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from collections import defaultdict
 from typing import Dict, Set, Tuple
 
-#: start of a REGISTRY.counter( / .timer( / .distribution( call
-_CALL_START = re.compile(r"REGISTRY\.(counter|timer|distribution)\(")
-_STRING = re.compile(r"[\"']([^\"'\n]+)[\"']")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: timer IS a distribution (TimeStat subclasses DistributionStat), but
-#: the registry still type-checks exactly, so they conflict here too.
-
-
-def _call_names(src: str, open_paren: int):
-    """Every string literal inside the (balanced) call argument list
-    starting at ``open_paren`` — covers multi-line calls and
-    conditional-expression names like ``"a" if x else "b"``."""
-    depth = 0
-    for i in range(open_paren, len(src)):
-        if src[i] == "(":
-            depth += 1
-        elif src[i] == ")":
-            depth -= 1
-            if depth == 0:
-                return [
-                    m.group(1)
-                    for m in _STRING.finditer(src[open_paren + 1: i])
-                ]
-    return []
+from analysis import core, legacy  # noqa: E402
+from analysis import metric_names as _pass  # noqa: E402
 
 
 def scan(src_dir: str) -> Dict[str, Set[Tuple[str, str]]]:
-    """name -> {(kind, "file:line"), ...} over every .py under src_dir."""
-    sites: Dict[str, Set[Tuple[str, str]]] = defaultdict(set)
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            for m in _CALL_START.finditer(src):
-                kind = m.group(1)
-                lineno = src.count("\n", 0, m.start()) + 1
-                for name in _call_names(src, m.end() - 1):
-                    sites[name].add((kind, f"{path}:{lineno}"))
-    return sites
+    """name -> {(kind, "file:line"), ...} over every .py under
+    src_dir (the legacy shape)."""
+    modules, _errs = core.load_modules(src_dir)
+    sites = _pass.collect_sites(modules)
+    return {
+        name: {
+            (kind, f"{os.path.join(src_dir, rel)}:{line}")
+            for kind, rel, line in entries
+        }
+        for name, entries in sites.items()
+    }
 
 
 def find_conflicts(sites: Dict[str, Set[Tuple[str, str]]]):
@@ -74,10 +50,7 @@ def find_conflicts(sites: Dict[str, Set[Tuple[str, str]]]):
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     conflicts = find_conflicts(sites)
     if not conflicts:

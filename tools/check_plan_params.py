@@ -1,109 +1,35 @@
 #!/usr/bin/env python
-"""Plan-parameterization lint: literal hoisting, RuntimeParam
-construction, and compile-cache keying are owned by
-``presto_tpu/plan/canonical.py`` (plus the two audited consumers noted
-below) — the one module that knows the eligibility rules.
+"""Plan-parameterization lint: literal hoisting, RuntimeParam /
+BoundParam construction, and compile-cache (``_compiled``) keying are
+owned by ``presto_tpu/plan/canonical.py`` plus the audited consumers
+(plan/planner.py, expr.py, sql/ast.py, exec/local_runner.py).
 
-Why this matters: a RuntimeParam constructed ad hoc bypasses the
-dtype/structure bucketing (strings resolve literal ids against
-trace-time dictionaries, long decimals take literal-introspection fast
-paths, NULLs are program structure) and silently miscompiles; a
-compile-cache key assembled outside ``LocalQueryRunner._run_with_pages``
-can bake literals back into the key and quietly re-open the
-compile-per-literal-variant hole this plane closed; and an
-``ast.BoundParam`` minted outside the canonicalizer breaks the
-ordinal <-> value correspondence the statement cache binds by.
-
-Allowed sites:
-- ``plan/canonical.py`` — the canonicalizer (everything);
-- ``plan/planner.py`` — the ONE BoundParam -> RuntimeParam lowering;
-- ``expr.py`` — the RuntimeParam class definition + its lowering;
-- ``exec/local_runner.py`` — the ``_compiled`` cache itself.
-
-Usage: ``python tools/check_plan_params.py [src_dir]`` — exits 0 when
-clean, 1 with a report listing every offending site. Wired into the
-test suite via tests/test_plan_cache.py (the same pattern as
-tools/check_device_puts.py in tests/test_staging_cache.py).
+Shim over the unified AST framework (``tools/analysis``, rule
+``plan-params`` — the compile-plane invariant checker, which resolves
+calls structurally instead of line-scrubbing). Exits 0 when clean, 1
+with a report. Run every pass at once with ``tools/analyze.py``;
+wired into the test suite via tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: (pattern, allowed relative paths)
-_RULES = [
-    # RuntimeParam construction (reading isinstance(...) is fine:
-    # match only call-shaped spellings)
-    (
-        re.compile(r"\bRuntimeParam\s*\("),
-        {
-            os.path.join("plan", "canonical.py"),
-            os.path.join("plan", "planner.py"),
-            "expr.py",
-        },
-    ),
-    # BoundParam construction outside the AST canonicalizer
-    (
-        re.compile(r"\bBoundParam\s*\("),
-        {os.path.join("plan", "canonical.py"), os.path.join("sql", "ast.py")},
-    ),
-    # compile-cache key construction / direct store access (exactly
-    # the runner's ``_compiled`` store; the mesh path's _frag_compiled
-    # is a different cache with its own keying)
-    (
-        re.compile(r"(?<![A-Za-z0-9_])_compiled\s*[\[\.]"),
-        {os.path.join("exec", "local_runner.py")},
-    ),
-    # the hoisting pass itself (its output feeds the compile-cache key;
-    # calling it elsewhere forks the canonical form)
-    (
-        re.compile(r"\bhoist_params\s*\("),
-        {
-            os.path.join("plan", "canonical.py"),
-            os.path.join("exec", "local_runner.py"),
-        },
-    ),
-]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: read-only mentions that are NOT construction/keying
-_EXEMPT_LINE = re.compile(
-    r"isinstance\s*\(|len\s*\(\s*self\._compiled\s*\)|"
-    r"self\._runner\._compiled"
-)
+from analysis import legacy  # noqa: E402
+
+RULE = "plan-params"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if _EXEMPT_LINE.search(line):
-                        continue
-                    for pat, allowed in _RULES:
-                        if rel in allowed:
-                            continue
-                        if pat.search(line):
-                            out.append((path, lineno, stripped))
-    return out
+def scan(src_dir):
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

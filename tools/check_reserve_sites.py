@@ -1,83 +1,37 @@
 #!/usr/bin/env python
 """Reserve-site lint: memory-pool reservations must be confined to
 ``presto_tpu/utils/memory.py`` (the one audited module) plus the
-audited consumers below.
+audited consumers (exec/staging.py, exec/local_runner.py,
+server/worker.py, server/coordinator.py). An ad-hoc reserve elsewhere
+holds device bytes the cluster view cannot see.
 
-Cluster memory governance hangs on the accounting being COMPLETE: the
-workers' heartbeat reports, the arbiter's quotas, the low-memory
-killer's victim selection, and the "pools drain to zero" invariant all
-read ``MemoryPool`` state. An ad-hoc ``reserve`` call (or a second
-pool constructed on the side) elsewhere would hold device bytes the
-cluster view cannot see — invisible residency that breaks victim
-selection and leak detection exactly when memory is scarcest.
-
-Forbidden OUTSIDE ``utils/memory.py`` + the audited consumers:
-
-- pool construction            (``MemoryPool(...)``)
-- reserving                    (``.reserve(`` / ``.try_reserve(``)
-
-Audited consumers:
-
-- ``exec/staging.py``      — the split cache's try_reserve discipline
-- ``exec/local_runner.py`` — staged-page residency accounting
-- ``server/worker.py``     — task buffers + merge-build staging
-- ``server/coordinator.py``— pool construction (kill-largest policy)
-
-Usage: ``python tools/check_reserve_sites.py [src_dir]`` — exits 0
-when clean, 1 with a report. Wired into the test suite via
-tests/test_memory_governance.py (the same confinement pattern as
-check_rpc_calls / check_journal_sites).
+Shim over the unified AST framework (``tools/analysis``, rule
+``reserve-sites``) — exits 0 when clean, 1 with a report. Run every
+pass at once with ``tools/analyze.py``; wired into the test suite via
+tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: a reservation call or a pool construction
-_RESERVE = re.compile(
-    r"\.(?:try_)?reserve\s*\(|\bMemoryPool\s*\("
-)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-ALLOWED = {
-    os.path.join("utils", "memory.py"),
-    os.path.join("exec", "staging.py"),
-    os.path.join("exec", "local_runner.py"),
-    os.path.join("server", "worker.py"),
-    os.path.join("server", "coordinator.py"),
-}
+from analysis import legacy  # noqa: E402
+
+RULE = "reserve-sites"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
+def scan(src_dir):
     """(path, line, source-line) for every reserve site outside the
     audited modules."""
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            if rel in ALLOWED:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if _RESERVE.search(line):
-                        out.append((path, lineno, stripped))
-    return out
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(

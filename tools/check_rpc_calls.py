@@ -5,55 +5,33 @@ timeouts, bounded backoff retries, fault-plane hooks, and ``rpc.*``
 metrics. A raw ``urllib.request.urlopen`` anywhere else silently opts
 out of all of that, so this lint forbids it.
 
-Usage: ``python tools/check_rpc_calls.py [src_dir]`` — exits 0 when
-clean, 1 with a report listing every raw call site outside the
-allowed module.
-
-Wired into the test suite via tests/test_faults.py.
+Shim over the unified AST framework (``tools/analysis``, rule
+``rpc-confinement``) — same CLI contract as ever: exits 0 when clean,
+1 with a report. Run every pass at once with ``tools/analyze.py``;
+wired into the test suite via tests/test_static_analysis.py.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-#: raw opener spellings (module-qualified or bare after an import-from)
-_RAW_CALL = re.compile(r"\burlopen\s*\(")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: the one module allowed to open sockets (relative to src_dir root)
-ALLOWED = {os.path.join("server", "rpc.py")}
+from analysis import legacy  # noqa: E402
+
+RULE = "rpc-confinement"
 
 
-def scan(src_dir: str) -> List[Tuple[str, int, str]]:
+def scan(src_dir):
     """(path, line, source-line) for every raw urlopen call site
     outside the allowed modules."""
-    out: List[Tuple[str, int, str]] = []
-    for root, _dirs, files in os.walk(src_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, src_dir)
-            if rel in ALLOWED:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.strip()
-                    if stripped.startswith("#"):
-                        continue
-                    if _RAW_CALL.search(line):
-                        out.append((path, lineno, stripped))
-    return out
+    return legacy.shim_scan(RULE, src_dir)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    src_dir = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu",
-    )
+    src_dir = args[0] if args else legacy.default_src()
     sites = scan(src_dir)
     if not sites:
         print(
