@@ -174,81 +174,140 @@ def _bench_query(
     return driving_rows / best, best, WARMUP + 1 + len(times)
 
 
-def _serving_line(runner, backend: str) -> dict:
-    """Serving-latency line (the plan-cache headline measurement): N
-    concurrent clients replay ONE query shape with varying literals
-    through PREPARE/EXECUTE, so every request after the first is a
-    plan-cache + compile-cache hit. Reports end-to-end p50/p99 latency
-    and queries/sec, plus cold (first execution: plan + XLA compile +
-    run) vs warm, and the plan.cache_hit count for the run — the
-    speedup is the compile amortization, honestly tagged with the
-    backend that measured it."""
+def _serving_line(backend: str) -> dict:
+    """Serving-latency line, extended for micro-batched serving
+    (ROADMAP item 1): 100+ concurrent clients replay ONE point-lookup
+    shape with fresh literals through PREPARE/EXECUTE against an
+    in-process coordinator (the batch queue fronts coordinator
+    dispatch), measured TWICE on the same backend — first with
+    serving.microbatch-wait-ms=0 (unbatched: the PR 6 plan-cache
+    path), then with the batch queue on — reporting batched vs
+    unbatched warm QPS/p50/p99, the device-dispatch count
+    (serving.batches), and mean batch occupancy. The contract of the
+    batched round is dispatches STRICTLY fewer than statements served
+    (mean occupancy > 1)."""
     import threading
 
+    from presto_tpu.server.coordinator import CoordinatorServer
     from presto_tpu.utils.metrics import REGISTRY
 
-    # 4 clients: enough to overlap requests, few enough that a 1-CPU
-    # fallback host measures query latency rather than queue depth
-    clients, per_client = 4, 12
-    # the serving workload is POINT lookups (ROADMAP item 2), not
-    # analytic scans: a selective single-row probe whose per-query
-    # device work is small enough that the plan+compile amortization
-    # is what the line actually measures
-    runner.execute(
-        "prepare bench_serve from select c_name, c_acctbal, "
-        "c_mktsegment from tpch.sf1.customer where c_custkey = ?"
-    )
-    hits0 = int(REGISTRY.counter("plan.cache_hit").total)
-    t0 = time.perf_counter()
-    runner.execute("execute bench_serve using 7")
-    cold_s = time.perf_counter() - t0
+    clients, per_client = 100, 5
+    prepared = {
+        "bench_serve": (
+            "select c_name, c_acctbal, c_mktsegment "
+            "from tpch.sf1.customer where c_custkey = ?"
+        )
+    }
+    coord = CoordinatorServer(max_concurrent_queries=clients + 8)
 
-    lat: list = []
-    errors: list = []
-    lock = threading.Lock()
+    def run_round(wait_ms: float, seed: int) -> dict:
+        coord.local.session.set("microbatch_wait_ms", wait_ms)
+        lat: list = []
+        errors: list = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(clients)
 
-    def one_client(ci: int) -> None:
-        for i in range(per_client):
-            v = 1 + 4 * (ci * per_client + i)  # fresh literals
-            t = time.perf_counter()
+        def one_client(ci: int) -> None:
             try:
-                runner.execute(f"execute bench_serve using {v}")
-            except Exception as e:  # pragma: no cover - report, don't hang
+                barrier.wait(60)
+                for i in range(per_client):
+                    # fresh literals, always within the key range
+                    v = 1 + ((seed + ci * per_client + i) * 37) % (
+                        nkeys - 1
+                    )
+                    t = time.perf_counter()
+                    q = coord.submit(
+                        f"execute bench_serve using {v}",
+                        prepared=prepared,
+                    )
+                    q.done.wait(120)
+                    dt = time.perf_counter() - t
+                    with lock:
+                        if q.state != "FINISHED":
+                            errors.append(
+                                RuntimeError(q.error or q.state)
+                            )
+                        else:
+                            lat.append(dt)
+            except Exception as e:  # report, don't hang
                 with lock:
                     errors.append(e)
-                return
-            dt = time.perf_counter() - t
-            with lock:
-                lat.append(dt)
 
-    threads = [
-        threading.Thread(target=one_client, args=(ci,))
-        for ci in range(clients)
-    ]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    if errors:
-        raise errors[0]
-    lat.sort()
-    p50 = lat[len(lat) // 2]
-    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+        threads = [
+            threading.Thread(target=one_client, args=(ci,))
+            for ci in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        lat.sort()
+        return {
+            "qps": len(lat) / wall,
+            "p50": lat[len(lat) // 2],
+            "p99": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+            "queries": len(lat),
+        }
+
+    try:
+        nkeys = _table_rows(coord.local, "sf1", "customer")
+        # cold: plan + XLA compile + staging, once
+        t0 = time.perf_counter()
+        q = coord.submit("execute bench_serve using 7", prepared=prepared)
+        q.done.wait(600)
+        if q.state != "FINISHED":
+            raise RuntimeError(q.error or q.state)
+        cold_s = time.perf_counter() - t0
+        unbatched = run_round(0.0, seed=0)
+        # batched warmup round: pay the per-lane-bucket vmap compiles
+        # outside the timed window (a warm batch compiles nothing)
+        coord.local.session.set("microbatch_max", 32)
+        run_round(10.0, seed=1 << 16)
+        b0 = int(REGISTRY.counter("serving.batches").total)
+        s0 = int(REGISTRY.counter("serving.batched_statements").total)
+        occ0 = REGISTRY.distribution("serving.batch_occupancy").values()
+        hits0 = int(REGISTRY.counter("plan.cache_hit").total)
+        batched = run_round(10.0, seed=1 << 17)
+        batches = int(REGISTRY.counter("serving.batches").total) - b0
+        stmts = (
+            int(REGISTRY.counter("serving.batched_statements").total)
+            - s0
+        )
+        occ1 = REGISTRY.distribution("serving.batch_occupancy").values()
+        d_count = occ1["count"] - occ0["count"]
+        occupancy = (
+            (occ1["sum"] - occ0["sum"]) / d_count if d_count else 0.0
+        )
+        plan_hits = (
+            int(REGISTRY.counter("plan.cache_hit").total) - hits0
+        )
+    finally:
+        coord.shutdown()
     return {
         "metric": "serving_point_lookup_sf1_qps",
-        "value": round(len(lat) / wall, 2),
+        "value": round(batched["qps"], 2),
         "unit": "queries/s",
         "clients": clients,
-        "queries": len(lat),
-        "p50_ms": round(p50 * 1000.0, 2),
-        "p99_ms": round(p99 * 1000.0, 2),
+        "queries": batched["queries"],
+        "p50_ms": round(batched["p50"] * 1000.0, 2),
+        "p99_ms": round(batched["p99"] * 1000.0, 2),
+        "unbatched_qps": round(unbatched["qps"], 2),
+        "unbatched_p50_ms": round(unbatched["p50"] * 1000.0, 2),
+        "unbatched_p99_ms": round(unbatched["p99"] * 1000.0, 2),
         "cold_ms": round(cold_s * 1000.0, 1),
-        "warm_speedup_cold_over_p50": round(cold_s / max(p50, 1e-9), 1),
-        "plan_cache_hits": int(
-            REGISTRY.counter("plan.cache_hit").total
-        ) - hits0,
+        # the micro-batch contract: one device dispatch answers many
+        # statements — dispatches strictly fewer than statements
+        "batches": batches,
+        "batched_statements": stmts,
+        "mean_batch_occupancy": round(occupancy, 2),
+        "batched_beats_unbatched": bool(
+            batched["qps"] > unbatched["qps"]
+        ),
+        "plan_cache_hits": plan_hits,
         "backend": backend,
     }
 
@@ -452,6 +511,33 @@ def _memory_pressure_line(backend: str) -> dict:
     }
 
 
+def _probe_backend() -> str:
+    """Run a real tiny computation — trace + compile + execute + fetch,
+    the full dispatch path a query exercises (an if, not an assert:
+    python -O must not strip the probe) — and return the platform."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    if int(jnp.arange(3).sum()) != 3:
+        raise RuntimeError("backend computed a wrong result")
+    return platform
+
+
+def _force_cpu(reason: BaseException) -> str:
+    """Force the CPU backend (the config update, not the env var — the
+    axon plugin overrides JAX_PLATFORMS on this image) and re-probe."""
+    import jax
+
+    print(
+        f"bench: backend failed ({reason}); falling back to CPU",
+        file=sys.stderr,
+        flush=True,
+    )
+    jax.config.update("jax_platforms", "cpu")
+    return _probe_backend()
+
+
 def _ensure_backend() -> str:
     """Backend-fallback probe (BENCH_r05 fix): the axon TPU plugin can
     be installed but unreachable ("Unable to initialize backend
@@ -459,37 +545,62 @@ def _ensure_backend() -> str:
     and a plugin that PASSES the device probe can still die at the
     first real dispatch (tunnel half-up), so the probe runs an actual
     tiny computation, not just device enumeration. On failure force
-    the CPU backend (the config update, not the env var — the plugin
-    overrides JAX_PLATFORMS on this image) and retry. Returns the
-    platform actually used, so every result line is tagged with the
-    backend it measured."""
-    import jax
-    import jax.numpy as jnp
-
-    def probe() -> str:
-        platform = jax.devices()[0].platform
-        # first REAL call: trace + compile + execute + fetch — the
-        # full dispatch path a query exercises (an if, not an assert:
-        # python -O must not strip the probe)
-        if int(jnp.arange(3).sum()) != 3:
-            raise RuntimeError("backend computed a wrong result")
-        return platform
-
+    the CPU backend and retry. Returns the platform actually used, so
+    every result line is tagged with the backend it measured."""
     try:
-        return probe()
+        return _probe_backend()
     except Exception as e:
-        print(
-            f"bench: backend init failed ({e}); falling back to CPU",
-            file=sys.stderr,
-            flush=True,
-        )
-        jax.config.update("jax_platforms", "cpu")
-        return probe()
+        return _force_cpu(e)
+
+
+def _q1_line(runner, backend: str) -> dict:
+    """The headline TPC-H Q1 @ SF1 measurement (cold + steady-state
+    rows/s). Raises on backend death mid-measurement — the caller owns
+    the CPU-fallback / skip_line decision."""
+    import __graft_entry__ as G
+    from presto_tpu.plan.planner import plan_statement
+    from presto_tpu.sql import parse_statement
+    from presto_tpu.utils.metrics import REGISTRY
+
+    sql = G._Q1.replace("tiny", "sf1")
+    nrows = _table_rows(runner, "sf1", "lineitem")
+    # delta, not the process total: a failed first attempt (TPU died
+    # mid-measurement) must not leak its cache hits into the CPU
+    # fallback line
+    hits0 = int(REGISTRY.counter("staging.cache_hit").total)
+    plan = plan_statement(
+        parse_statement(sql), runner.catalogs, runner.session
+    )
+    # cold: first end-to-end execution in this process — connector
+    # read + host->device staging + XLA compile + execute
+    t0 = time.perf_counter()
+    runner.execute_plan(plan)
+    cold_s = time.perf_counter() - t0
+    # warm: steady state on the same process — split cache serves
+    # the staged pages device-resident, compile cache hits
+    rps, warm_s, _ = _bench_query(runner, sql, nrows, expect_rows=4)
+    vs = (
+        rps / CPU_BASELINE_ROWS_PER_SEC
+        if CPU_BASELINE_ROWS_PER_SEC
+        else 1.0
+    )
+    return {
+        "metric": "tpch_q1_sf1_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+        "backend": backend,
+        "analysis_clean": _analysis_clean(),
+        "cold_s": round(cold_s, 3),
+        "warm_s": round(warm_s, 3),
+        "staging_cache_hits": int(
+            REGISTRY.counter("staging.cache_hit").total
+        ) - hits0,
+    }
 
 
 def main() -> None:
     from presto_tpu.exec.local_runner import LocalQueryRunner
-    import __graft_entry__ as G
 
     run_all = "--all" in sys.argv
     # --only SUBSTR: run matching extra configs in isolation (one
@@ -503,51 +614,41 @@ def main() -> None:
     backend = _ensure_backend()
     runner = LocalQueryRunner()
     if only is None:
-        from presto_tpu.plan.planner import plan_statement
-        from presto_tpu.sql import parse_statement
-        from presto_tpu.utils.metrics import REGISTRY
-
-        sql = G._Q1.replace("tiny", "sf1")
-        nrows = _table_rows(runner, "sf1", "lineitem")
-        plan = plan_statement(
-            parse_statement(sql), runner.catalogs, runner.session
-        )
-        # cold: first end-to-end execution in this process — connector
-        # read + host->device staging + XLA compile + execute
-        t0 = time.perf_counter()
-        runner.execute_plan(plan)
-        cold_s = time.perf_counter() - t0
-        # warm: steady state on the same process — split cache serves
-        # the staged pages device-resident, compile cache hits
-        rps, warm_s, _ = _bench_query(runner, sql, nrows, expect_rows=4)
-        vs = (
-            rps / CPU_BASELINE_ROWS_PER_SEC
-            if CPU_BASELINE_ROWS_PER_SEC
-            else 1.0
-        )
-        print(
-            json.dumps(
-                {
-                    "metric": "tpch_q1_sf1_rows_per_sec",
-                    "value": round(rps),
-                    "unit": "rows/s",
-                    "vs_baseline": round(vs, 3),
-                    "backend": backend,
-                    "analysis_clean": _analysis_clean(),
-                    "cold_s": round(cold_s, 3),
-                    "warm_s": round(warm_s, 3),
-                    "staging_cache_hits": int(
-                        REGISTRY.counter("staging.cache_hit").total
-                    ),
-                }
-            ),
-            flush=True,
-        )
-        # serving plane: concurrent literal-variant EXECUTEs over one
-        # prepared shape — the plan-cache p50/p99/QPS line (a failed
-        # serving measurement must not poison the Q1 line above)
         try:
-            print(json.dumps(_serving_line(runner, backend)), flush=True)
+            line = _q1_line(runner, backend)
+        except Exception as e:
+            # the probe passed but the REAL measurement died (tunnel
+            # half-up at the first heavy dispatch — BENCH_r04/r05):
+            # fall back to a backend-tagged CPU measurement; a skipped
+            # line (no value key) only when even CPU fails
+            line = None
+            if backend != "cpu":
+                try:
+                    backend = _force_cpu(e)
+                    runner = LocalQueryRunner()
+                    line = _q1_line(runner, backend)
+                except Exception as e2:
+                    print(
+                        json.dumps(
+                            skip_line("tpch_q1_sf1_rows_per_sec", e2)
+                        ),
+                        flush=True,
+                    )
+            else:
+                print(
+                    json.dumps(
+                        skip_line("tpch_q1_sf1_rows_per_sec", e)
+                    ),
+                    flush=True,
+                )
+        if line is not None:
+            print(json.dumps(line), flush=True)
+        # serving plane: 100+ concurrent literal-variant EXECUTEs over
+        # one prepared shape through the coordinator's micro-batch
+        # queue — batched vs unbatched QPS/p50/p99 (a failed serving
+        # measurement must not poison the Q1 line above)
+        try:
+            print(json.dumps(_serving_line(backend)), flush=True)
         except Exception as e:
             print(
                 json.dumps(

@@ -36,6 +36,9 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "staging_ms": T.DOUBLE,
             "execution_ms": T.DOUBLE,
             "compile_cache_hit": T.BOOLEAN,
+            # micro-batched serving: answered by a shared vmapped
+            # dispatch (QueryStats.batched)
+            "batched": T.BOOLEAN,
             "retries": T.BIGINT,
             "input_rows": T.BIGINT,
             "input_bytes": T.BIGINT,
@@ -171,6 +174,7 @@ class SystemConnector(Connector):
                     "staging_ms": q.staging_ms,
                     "execution_ms": q.execution_ms,
                     "compile_cache_hit": q.compile_cache_hit,
+                    "batched": q.batched,
                     "retries": q.retries,
                     "input_rows": q.input_rows,
                     "input_bytes": q.input_bytes,

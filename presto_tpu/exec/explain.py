@@ -275,6 +275,13 @@ def render_distributed_analyze(
             else ""
         )
     )
+    if getattr(qstats, "batched", False):
+        # micro-batched serving: this statement's answer came off a
+        # shared vmapped dispatch (coordinator batch queue)
+        lines.append(
+            f"micro-batch: {qstats.batch_size}-way "
+            "(one device dispatch served the group)"
+        )
     if (
         qstats.dynamic_filters
         or qstats.dynamic_filter_wait_ms

@@ -51,7 +51,12 @@ from presto_tpu.ops import (
     unnest as unnest_op,
     window as window_op,
 )
-from presto_tpu.page import Block, Page, compact_page
+from presto_tpu.page import (
+    Block,
+    Page,
+    compact_page,
+    compact_page_window,
+)
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.optimizer import (
     prune_columns,
@@ -190,6 +195,10 @@ class LocalQueryRunner:
         # trace (a hoisted literal fed a structure-demanding kernel):
         # those shapes recompile in classic literal form, forever
         self._no_hoist: set = set()
+        # canonical fingerprints whose BATCHED (vmapped) form failed to
+        # trace or execute: those shapes serve scalar-only, forever —
+        # a micro-batch must never fail a query the scalar path can run
+        self._no_batch: set = set()
         # statement-level parameterized plan cache (plan/canonical.py):
         # canonical AST -> planned+optimized plan; warm EXECUTE /
         # repeated query shapes skip parse-analysis, planning and
@@ -655,7 +664,14 @@ class LocalQueryRunner:
             return plan_statement(stmt, self.catalogs, self.session)
 
     def plan_cached(self, stmt) -> Tuple[Plan, bool]:
-        plan, hit = self._plan_cached(stmt)
+        plan, hit, _key = self.plan_cached_keyed(stmt)
+        return plan, hit
+
+    def plan_cached_keyed(self, stmt) -> Tuple[Plan, bool, Optional[str]]:
+        """plan_cached plus the canonical statement cache key (None
+        when the statement bypassed the cache) — the coordinator's
+        micro-batch queue groups concurrent same-key statements."""
+        plan, hit, key = self._plan_cached(stmt)
         if hit:
             # a server embedding this runner installs its QueryStats as
             # the thread-local sink before planning: attribute the hit
@@ -663,9 +679,9 @@ class LocalQueryRunner:
             if qs is not None:
                 with self._qs_mu:
                     qs.plan_cache_hit = True
-        return plan, hit
+        return plan, hit, key
 
-    def _plan_cached(self, stmt) -> Tuple[Plan, bool]:
+    def _plan_cached(self, stmt) -> Tuple[Plan, bool, Optional[str]]:
         """Statement-level parameterized plan cache -> (plan, hit).
 
         The statement canonicalizes (comparison-operand literals become
@@ -683,6 +699,7 @@ class LocalQueryRunner:
             return (
                 self._plan_statement(stmt),
                 False,
+                None,
             )
         t0 = time.perf_counter()
         try:
@@ -694,6 +711,7 @@ class LocalQueryRunner:
             return (
                 self._plan_statement(stmt),
                 False,
+                None,
             )
         finally:
             REGISTRY.distribution("plan.canonicalize_ms").add(
@@ -711,11 +729,13 @@ class LocalQueryRunner:
                     preoptimized=entry.preoptimized,
                 ),
                 True,
+                key,
             )
         if entry is canonical.BYPASS:
             return (
                 self._plan_statement(stmt),
                 False,
+                None,
             )
         try:
             plan = self._plan_statement(canon)
@@ -726,6 +746,7 @@ class LocalQueryRunner:
             return (
                 self._plan_statement(stmt),
                 False,
+                None,
             )
         handles = canonical.plan_handles(plan)
         if any(
@@ -743,6 +764,7 @@ class LocalQueryRunner:
             return (
                 self._plan_statement(stmt),
                 False,
+                None,
             )
         root, preopt = plan.root, False
         if not plan.params:
@@ -772,6 +794,7 @@ class LocalQueryRunner:
                 preoptimized=preopt,
             ),
             False,
+            key,
         )
 
     def _execute_write(self, stmt) -> QueryResult:
@@ -1210,6 +1233,378 @@ class LocalQueryRunner:
         pages_map[id(remote)] = page
         return remote
 
+    def _make_trace(
+        self, croot, cscan_ids, counted, analyzed, out_capacity=None
+    ):
+        """Build the scalar trace closure for one canonical root — the
+        ONE program constructor. The scalar compile entry jits it
+        directly; the micro-batch entry wraps it in the canonical
+        vmap-over-params form (plan/canonical.vmap_program), so both
+        lanes execute the same per-member operator composition.
+
+        ``out_capacity`` (micro-batch entries only): compact the
+        program output to this window instead of the full capacity
+        bucket — the batch demux fetches at most the speculative
+        window per lane, so gathering the full bucket per lane would
+        multiply the dominant memory traffic by the batch width for
+        rows nobody reads. The UNCLAMPED live count rides out as a
+        sixth output; lanes whose true count exceeds the window fall
+        out of the batch at demux (scalar re-run) — never a truncated
+        answer. ``None`` = the exact scalar program, 5-tuple, with
+        bit-identical full-capacity output."""
+        from presto_tpu.plan import canonical
+
+        msgs_cell: List[str] = []
+        nodes_cell: List = []
+
+        def trace(
+            pages_in,
+            params_in,
+            _root=croot,
+            _ids=cscan_ids,
+            _m=msgs_cell,
+            _n=nodes_cell,
+        ):
+            flags: List = []
+            errors: List = []
+            counters: Optional[List] = (
+                [] if counted else None
+            )
+            dyn: List = []
+            with canonical.active_params(params_in):
+                out = _execute_node(
+                    _root, pages_in, _ids, flags, errors,
+                    counters, dyn, count_all=analyzed,
+                )
+                # program boundary: host materialization /
+                # exchanges need prefix form (lazy selection
+                # masks stop here). num_valid is the TRUE live
+                # count in both page forms — captured before a
+                # windowed compaction clamps it
+                true_n = out.num_valid
+                if out_capacity is None:
+                    out = compact_page(out)
+                else:
+                    out = compact_page_window(out, out_capacity)
+            _m.clear()
+            _m.extend(m for m, _ in errors)
+            _n.clear()
+            if counters is not None:
+                from presto_tpu.exec.stats import node_label
+                from presto_tpu.plan import (
+                    history as plan_history,
+                )
+
+                walk_ids = {
+                    id(n): i
+                    for i, n in enumerate(N.walk(_root))
+                }
+                depths = _node_depths(_root)
+                try:
+                    # canonical sub-fingerprints: the
+                    # history keys of these operators
+                    # (computed ONCE per compile)
+                    fps = plan_history.node_fingerprints(
+                        _root
+                    )
+                except Exception:
+                    fps = {}
+                counted_ids = {
+                    id(node) for node, _, _, _ in counters
+                }
+
+                def child_walks(n):
+                    # nearest COUNTED descendants: with
+                    # cardinality-preserving nodes skipped
+                    # on the always-on path, a join's
+                    # input_rows still sums its sides'
+                    # real row sources
+                    out_ids = []
+                    for c in n.children():
+                        if id(c) in counted_ids:
+                            out_ids.append(
+                                walk_ids.get(id(c), -1)
+                            )
+                        else:
+                            out_ids.extend(child_walks(c))
+                    return out_ids
+
+                _n.extend(
+                    (
+                        walk_ids.get(id(node), -1),
+                        node_label(node),
+                        cap,
+                        nbytes,
+                        depths.get(id(node), 0),
+                        fps.get(id(node), ""),
+                        tuple(child_walks(node)),
+                    )
+                    for node, _, cap, nbytes in counters
+                )
+                cnts = [c for _, c, _, _ in counters]
+            else:
+                cnts = []
+            # stack control outputs: ONE device->host fetch
+            # per run (each separate scalar fetch costs a
+            # full relay round trip, ~100ms on tunneled
+            # TPU); dyn holds per-dynamic-filter pruned-row
+            # counts
+            base = (
+                out,
+                _stack_bools(flags),
+                _stack_bools([e for _, e in errors]),
+                _stack_i32(cnts),
+                _stack_i32(dyn),
+            )
+            if out_capacity is None:
+                return base
+            return base + (jnp.asarray(true_n, jnp.int32),)
+
+        return trace, msgs_cell, nodes_cell
+
+    # ------------------------------------------------ micro-batched serving
+
+    def microbatch_plan_eligible(self, plan) -> bool:
+        """Cheap structural screen before a statement may join a
+        micro-batch: a cached canonical plan (bound values present),
+        no scalar-subquery pre-passes, already pre-optimized, small
+        enough to compile whole, and not a streamed scan. Everything
+        else keeps the scalar path — batching can cost a wait, never
+        a wrong answer or a failed query."""
+        from presto_tpu.exec import streaming
+
+        if (
+            plan.bound_values is None
+            or plan.params
+            or not plan.preoptimized
+        ):
+            return False
+        root = plan.root
+        if streaming.needs_streaming(root, self.catalogs, self.session):
+            return False
+        budget = int(self.session.get("max_fragment_weight"))
+        if budget > 0 and _plan_weight(root) > budget:
+            return False
+        return True
+
+    def execute_plan_microbatch(self, plans, qs_list):
+        """Answer N same-canonical-shape plans (one plan-cache entry,
+        N bound-value vectors) with ONE device dispatch: the members'
+        hoisted parameter vectors stack along a new leading batch axis
+        and the scalar program runs vmapped with the staged pages
+        broadcast (plan/canonical owns the batch-axis constructs).
+
+        Returns a list aligned with ``plans``: a QueryResult for every
+        lane the batch served, ``None`` for members that fall out —
+        trace failure, non-hoistable shape, capacity overflow, error
+        lanes, over-window output — which the caller re-runs on the
+        existing scalar path. All-None means the shape itself is
+        batch-ineligible."""
+        from presto_tpu.exec.host_ops import apply_host_ops, peel_host_ops
+        from presto_tpu.plan import canonical
+        from presto_tpu.utils.metrics import REGISTRY
+
+        n = len(plans)
+        none: List = [None] * n
+        if n < 2:
+            return none
+        plan0 = plans[0]
+        root = plan0.root
+        host_ops: List[N.PlanNode] = []
+        if self.session.get("host_root_stage"):
+            root, host_ops = peel_host_ops(root)
+        # the demux slices flat (scalar/dictionary) blocks; nested
+        # output shapes keep the scalar path
+        try:
+            if any(
+                t.is_nested for t in root.output_schema().values()
+            ):
+                return none
+        except Exception:
+            return none
+        spec = int(self.session.get("speculative_result_rows"))
+        if spec <= 0:
+            return none
+        counted = bool(self.session.get("enable_operator_stats"))
+        offload = self.session.get("tpu_offload")
+        # per-member hoist over the SHARED root object: canonical
+        # fingerprints agree by construction, values differ only in
+        # the parameter vectors
+        vectors: List[tuple] = []
+        croot = None
+        for p in plans:
+            cr, params = canonical.hoist_params(
+                root, bound=p.bound_values, hoist_literals=True
+            )
+            if cr is root or not params:
+                return none  # nothing hoisted: no batch axis to stack
+            if croot is None:
+                croot = cr
+            vectors.append(params)
+        cfp = croot.fingerprint()
+        if cfp in self._no_hoist or cfp in self._no_batch:
+            return none
+        # stage the shared scan pages under the LEADER's sink (pins +
+        # staging attribution); served followers fold their own
+        # input-rows share below
+        scans = [
+            s for s in N.walk(root) if isinstance(s, N.TableScanNode)
+        ]
+        prev_qs = self._active_qs
+        self._active_qs = qs_list[0]
+        try:
+            pages = [self._load_table(s) for s in scans]
+        finally:
+            self._active_qs = prev_qs
+        in_rows = sum(int(p.num_valid) for p in pages)
+        in_bytes = sum(
+            int(b.data.nbytes) for p in pages for b in p.blocks
+        )
+        if qs_list[0] is not None:
+            # undo _load_table's input fold on the leader NOW, on
+            # every exit path: only lanes the batch actually SERVES
+            # re-attribute the scan below — a member that falls out
+            # (or a batch that fails wholesale) re-runs scalar, where
+            # _load_table attributes it again
+            with self._qs_mu:
+                qs_list[0].input_rows -= in_rows
+                qs_list[0].input_bytes -= in_bytes
+        scan_ids = {id(s): i for i, s in enumerate(scans)}
+        # canonical leaves correspond 1:1 by walk position (the same
+        # remap discipline as the scalar path)
+        leaf_types = (N.TableScanNode, N.RemoteSourceNode)
+        orig_leaves = [
+            x for x in N.walk(root) if isinstance(x, leaf_types)
+        ]
+        new_leaves = [
+            x for x in N.walk(croot) if isinstance(x, leaf_types)
+        ]
+        cscan_ids = dict(scan_ids)
+        for o, nn in zip(orig_leaves, new_leaves):
+            if id(o) in scan_ids:
+                cscan_ids[id(nn)] = scan_ids[id(o)]
+        try:
+            lanes = canonical.batch_lanes(n)
+            stacked = canonical.stack_param_vectors(vectors, lanes)
+        except ValueError:
+            return none
+        # the batched program compacts each lane to the speculative
+        # WINDOW, not the full capacity bucket: the demux fetches at
+        # most ``spec`` rows per lane, and a full-bucket gather per
+        # lane would multiply the dominant memory traffic by the batch
+        # width for rows nobody reads. The window is part of the
+        # compile key (a session change recompiles, same as capacity
+        # bucketing everywhere else).
+        key = canonical.batch_entry_key(
+            cfp, counted, offload, lanes, spec
+        )
+        with self._compile_mu:
+            entry = self._compiled.get(key)
+            fresh = entry is None
+            if fresh:
+                trace, msgs_cell, nodes_cell = self._make_trace(
+                    croot, cscan_ids, counted, False,
+                    out_capacity=spec,
+                )
+                entry = (
+                    jax.jit(canonical.vmap_program(trace)),
+                    msgs_cell,
+                    nodes_cell,
+                )
+                self._compiled[key] = entry
+        REGISTRY.counter(
+            "compile.cache_miss" if fresh else "compile.cache_hit"
+        ).update()
+        fn, msgs_cell, nodes_cell = entry
+        t_disp = time.perf_counter()
+        try:
+            with self._device_scope():
+                (
+                    page, flags_arr, err_arr, cnt_arr, dyn_arr,
+                    true_n_arr,
+                ) = fn(pages, stacked)
+        except Exception:
+            # the batched form failed to trace/execute (a kernel with
+            # no batching rule): retire the SHAPE from batching —
+            # scalar serving still works, so this must never raise
+            self._no_batch.add(cfp)
+            with self._compile_mu:
+                self._compiled.pop(key, None)
+            return none
+        k = int(page.blocks[0].data.shape[1]) if page.blocks else 0
+        # ONE device->host fetch for every lane: control outputs +
+        # per-lane TRUE counts + the windowed k-row prefix per block
+        leaves: List = [
+            flags_arr, err_arr, cnt_arr, dyn_arr, true_n_arr,
+        ]
+        for blk in page.blocks:
+            leaves.append(blk.data[:, :k])
+            if blk.valid is not None:
+                leaves.append(blk.valid[:, :k])
+        t_disped = time.perf_counter()
+        fetched = jax.device_get(leaves)
+        t_fetched = time.perf_counter()
+        flags_np, err_np, cnt_np, dyn_np, nv_np = fetched[:5]
+        prefix = fetched[5:]
+        wall_ms = (t_fetched - t_disp) * 1000.0
+        device_ms = (t_fetched - t_disped) * 1000.0
+        results: List = [None] * n
+        served = 0
+        for i in range(n):
+            if err_np.size and err_np[i].any():
+                continue  # scalar path raises the member's real error
+            if flags_np.size and flags_np[i].any():
+                continue  # capacity overflow: scalar path retries
+            n_i = int(nv_np[i])
+            if n_i > k:
+                continue  # over-window output: scalar materialization
+            lane_page = _page_from_prefix(
+                page, [leaf[i] for leaf in prefix], n_i
+            )
+            if host_ops:
+                lane_page = apply_host_ops(lane_page, host_ops)
+            results[i] = QueryResult(plan0.output_names, lane_page)
+            served += 1
+            qs = qs_list[i]
+            if qs is None:
+                continue
+            with self._qs_mu:
+                qs.batched = True
+                qs.batch_size = n
+                qs.output_rows = int(lane_page.num_valid)
+                qs.execution_ms += wall_ms / n
+                if fresh:
+                    qs.compile_cache_hit = False
+                # every SERVED lane scanned the shared pages (the
+                # leader's staging-time fold was undone above)
+                qs.input_rows += in_rows
+                qs.input_bytes += in_bytes
+            if counted and nodes_cell:
+                self._active_qs = qs
+                try:
+                    self._fold_operator_stats(
+                        nodes_cell,
+                        cnt_np[i],
+                        wall_ms=wall_ms / n,
+                        device_ms=device_ms / n,
+                        prog=croot,
+                    )
+                    if dyn_np.size:
+                        pruned = int(dyn_np[i].sum())
+                        if pruned:
+                            REGISTRY.counter(
+                                "dynamic_filter.rows_pruned"
+                            ).update(pruned)
+                            self._fold_dyn_stat(
+                                "dynamic_filter_rows_pruned", pruned
+                            )
+                finally:
+                    self._active_qs = prev_qs
+        REGISTRY.counter("serving.batches").update()
+        REGISTRY.counter("serving.batched_statements").update(served)
+        REGISTRY.distribution("serving.batch_occupancy").add(served)
+        return results
+
     def _run_with_pages(
         self,
         root: N.PlanNode,
@@ -1307,103 +1702,9 @@ class LocalQueryRunner:
                 entry = self._compiled.get(key)
                 fresh = entry is None
                 if fresh:
-                    msgs_cell: List[str] = []
-                    nodes_cell: List = []
-
-                    def trace(
-                        pages_in,
-                        params_in,
-                        _root=croot,
-                        _ids=cscan_ids,
-                        _m=msgs_cell,
-                        _n=nodes_cell,
-                    ):
-                        flags: List = []
-                        errors: List = []
-                        counters: Optional[List] = (
-                            [] if counted else None
-                        )
-                        dyn: List = []
-                        with canonical.active_params(params_in):
-                            out = _execute_node(
-                                _root, pages_in, _ids, flags, errors,
-                                counters, dyn, count_all=analyzed,
-                            )
-                            # program boundary: host materialization /
-                            # exchanges need prefix form (lazy selection
-                            # masks stop here)
-                            out = compact_page(out)
-                        _m.clear()
-                        _m.extend(m for m, _ in errors)
-                        _n.clear()
-                        if counters is not None:
-                            from presto_tpu.exec.stats import node_label
-                            from presto_tpu.plan import (
-                                history as plan_history,
-                            )
-
-                            walk_ids = {
-                                id(n): i
-                                for i, n in enumerate(N.walk(_root))
-                            }
-                            depths = _node_depths(_root)
-                            try:
-                                # canonical sub-fingerprints: the
-                                # history keys of these operators
-                                # (computed ONCE per compile)
-                                fps = plan_history.node_fingerprints(
-                                    _root
-                                )
-                            except Exception:
-                                fps = {}
-                            counted_ids = {
-                                id(node) for node, _, _, _ in counters
-                            }
-
-                            def child_walks(n):
-                                # nearest COUNTED descendants: with
-                                # cardinality-preserving nodes skipped
-                                # on the always-on path, a join's
-                                # input_rows still sums its sides'
-                                # real row sources
-                                out_ids = []
-                                for c in n.children():
-                                    if id(c) in counted_ids:
-                                        out_ids.append(
-                                            walk_ids.get(id(c), -1)
-                                        )
-                                    else:
-                                        out_ids.extend(child_walks(c))
-                                return out_ids
-
-                            _n.extend(
-                                (
-                                    walk_ids.get(id(node), -1),
-                                    node_label(node),
-                                    cap,
-                                    nbytes,
-                                    depths.get(id(node), 0),
-                                    fps.get(id(node), ""),
-                                    tuple(child_walks(node)),
-                                )
-                                for node, _, cap, nbytes in counters
-                            )
-                            cnts = [c for _, c, _, _ in counters]
-                        else:
-                            cnts = []
-                        # stack control outputs: ONE device->host fetch
-                        # per run (each separate scalar fetch costs a
-                        # full relay round trip, ~100ms on tunneled
-                        # TPU); dyn holds per-dynamic-filter pruned-row
-                        # counts
-                        return (
-                            out,
-                            _stack_bools(flags),
-                            _stack_bools([e for _, e in errors]),
-                            _stack_i32(cnts),
-                            _stack_i32(dyn),
-                        )
-
+                    trace, msgs_cell, nodes_cell = self._make_trace(
+                        croot, cscan_ids, counted, analyzed
+                    )
                     entry = (jax.jit(trace), msgs_cell, nodes_cell)
                     self._compiled[key] = entry
             # compile-amortization counters (bench.py runs read these):

@@ -239,6 +239,12 @@ class QueryStats:
     #: True = planning was skipped, the canonical form was already
     #: planned and this execution only bound fresh literal values
     plan_cache_hit: bool = False
+    #: micro-batched serving (coordinator batch queue + the vmapped
+    #: compile entry in plan/canonical.py): True = this statement was
+    #: answered by a shared batched dispatch; batch_size = how many
+    #: same-fingerprint members rode that one dispatch
+    batched: bool = False
+    batch_size: int = 0
     staging_cache_hits: int = 0  # pages served device-resident
     retries: int = 0  # capacity-overflow re-runs
     device_fragments: int = 0  # stage-at-a-time programs beyond the root
@@ -451,6 +457,8 @@ class QueryStats:
             "execution_ms": self.execution_ms,
             "compile_cache_hit": self.compile_cache_hit,
             "plan_cache_hit": self.plan_cache_hit,
+            "batched": self.batched,
+            "batch_size": self.batch_size,
             "staging_cache_hits": self.staging_cache_hits,
             "retries": self.retries,
             "device_fragments": self.device_fragments,

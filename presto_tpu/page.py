@@ -674,6 +674,49 @@ def compact_page(page: Page, out_capacity: Optional[int] = None) -> Page:
     )
 
 
+def compact_page_window(page: Page, window: int) -> Page:
+    """Masked/prefix form -> a prefix-form page of AT MOST ``window``
+    rows: the first ``window`` live rows in order, ``num_valid``
+    clamped to the window.
+
+    The micro-batch program boundary (exec/local_runner batched
+    entries): ``compact_page``'s full-capacity ``nonzero`` + gather is
+    the dominant cost of a selective program — ~100x an elementwise
+    pass on CPU — and a batched dispatch would pay it PER LANE for
+    rows the demux never reads (the demux fetches at most the
+    speculative window; a lane whose true count exceeds the window
+    falls out of the batch and re-runs scalar). One cumsum + a
+    window-sized searchsorted/gather instead: rows beyond the live
+    count hold junk (masked by num_valid), exactly like compact_page's
+    fill rows. Nested blocks keep the general compaction path."""
+    if page.live is None:
+        return pad_capacity(page, window)
+    if any(
+        b.offsets is not None or b.children for b in page.blocks
+    ):
+        return compact_page(page, window)
+    cs = jnp.cumsum(page.live.astype(jnp.int32))
+    sel = jnp.searchsorted(
+        cs, jnp.arange(1, window + 1, dtype=jnp.int32)
+    )
+    sel = jnp.minimum(sel, page.capacity - 1).astype(jnp.int32)
+    blocks = [
+        dataclasses.replace(
+            blk,
+            data=blk.data[sel],
+            valid=None if blk.valid is None else blk.valid[sel],
+        )
+        for blk in page.blocks
+    ]
+    return Page(
+        blocks=tuple(blocks),
+        num_valid=jnp.minimum(page.num_valid, window).astype(
+            jnp.int32
+        ),
+        names=page.names,
+    )
+
+
 def _gather_array_block(
     blk: Block, sel: jnp.ndarray, num_live
 ) -> Block:

@@ -377,6 +377,90 @@ def _with_bound(plan, bound):
     )
 
 
+# ------------------------------------------------- micro-batch compile plane
+#
+# Many concurrent statements of ONE canonical fingerprint differ only in
+# their RuntimeParam vectors (that is the whole point of hoisting). The
+# micro-batch serving plane answers N of them with ONE device dispatch:
+# the members' parameter vectors stack along a new leading batch axis
+# and the existing scalar trace runs under ``jax.vmap`` with the staged
+# pages broadcast. Everything batch-axis-shaped is constructed HERE —
+# like the other compile-plane invariants (tools/analyze.py
+# ``serving-batch`` rule): a stacking or vmap entry built elsewhere
+# could silently disagree with the eligibility/dtype rules above and
+# cross members' answers.
+
+#: lane-count buckets for batched compile entries: a warm batch of any
+#: size up to the bucket reuses the bucket's ONE compiled program
+#: (padded lanes repeat a member's params; their outputs are dropped at
+#: demux) — without bucketing every distinct group size would pay its
+#: own XLA compile
+_BATCH_LANE_BUCKETS = (2, 4, 8, 16, 32, 64, 128)
+
+
+def batch_lanes(n: int) -> int:
+    """Smallest lane bucket holding ``n`` members (n > the largest
+    bucket is the caller's error: serving.microbatch-max caps groups)."""
+    for b in _BATCH_LANE_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(
+        f"micro-batch of {n} exceeds the largest lane bucket "
+        f"{_BATCH_LANE_BUCKETS[-1]}"
+    )
+
+
+def stack_param_vectors(
+    vectors: List[Tuple[np.ndarray, ...]], lanes: int
+) -> Tuple[np.ndarray, ...]:
+    """Stack N members' parameter vectors along a NEW leading batch
+    axis, padded to ``lanes`` by repeating the last member (padding
+    lanes compute a real member's answer; demux drops them). Every
+    member must carry the same arity and per-slot dtype — guaranteed
+    when the members share one canonical fingerprint (dtype bucketing
+    is part of the canonical form), re-checked here because a mismatch
+    would cross members' answers, not just miss a cache."""
+    if not vectors or lanes < len(vectors):
+        raise ValueError("stack_param_vectors: bad lane count")
+    arity = len(vectors[0])
+    for v in vectors:
+        if len(v) != arity:
+            raise ValueError(
+                "micro-batch members disagree on parameter arity"
+            )
+        for a, b in zip(v, vectors[0]):
+            if a.dtype != b.dtype or a.shape != b.shape:
+                raise ValueError(
+                    "micro-batch members disagree on parameter dtype"
+                )
+    padded = list(vectors) + [vectors[-1]] * (lanes - len(vectors))
+    return tuple(
+        np.stack([v[i] for v in padded]) for i in range(arity)
+    )
+
+
+def vmap_program(trace_fn):
+    """The ONE batched-entry constructor: vmap the scalar trace over
+    the parameter axis with the staged pages broadcast. The jitted
+    result is cached beside the scalar entry under
+    :func:`batch_entry_key` — a cold batch costs one compile, warm
+    batches zero."""
+    import jax
+
+    return jax.vmap(trace_fn, in_axes=(None, 0))
+
+
+def batch_entry_key(
+    cfp: str, counted: bool, offload: bool, lanes: int, window: int
+) -> tuple:
+    """Compile-cache key of a batched entry: the scalar canonical
+    fingerprint plus the lane bucket and the demux window (the batched
+    program compacts each lane to the window, so the window is shape),
+    tagged so it can never collide with (or be served as) a scalar
+    entry."""
+    return (cfp, False, counted, offload, "batch", lanes, window)
+
+
 # ---------------------------------------------- statement canonicalization
 
 #: comparison operators whose bare literal operands are safe to hoist at

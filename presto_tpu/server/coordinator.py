@@ -147,6 +147,11 @@ class _Query:
         #: message): set by _apply_memory_kill when the victim may
         #: re-admit; consumed by the restart loop's re-admission lane
         self._mem_kill: Optional[str] = None
+        #: the admission high-water hold PARKED this query before
+        #: dispatch (memory governance): a parked statement must not
+        #: also accrue the micro-batch window after release — the
+        #: batch window starts at dispatch-eligibility, not submit
+        self._admission_parked = False
         #: prepared statements supplied by the CLIENT on this request
         #: (X-Presto-Prepared-Statement headers — the client owns the
         #: map; see server.protocol)
@@ -165,6 +170,160 @@ class _Query:
         self.stats.state = "FAILED"
         self.stats.error = error
         self.stats.end_time = time.time()
+
+
+class _MicrobatchMember:
+    """One statement parked in the batch queue: its cached canonical
+    plan (bound values included), its stats sink, and the event the
+    leader signals when the batched dispatch delivered (or dropped)
+    this member's lane.
+
+    ``claim()`` is the exactly-once ownership handshake: the LEADER
+    claims every member before dispatching, an abandoning FOLLOWER
+    (its belt-timeout fired) claims before falling back to scalar —
+    whoever claims serves the member, so a late leader can never
+    write batch results/stats into a query its own thread already
+    answered scalar."""
+
+    __slots__ = ("plan", "qs", "result", "event", "joined_at", "_own")
+
+    def __init__(self, plan, qs):
+        self.plan = plan
+        self.qs = qs
+        self.result = None
+        self.event = threading.Event()
+        self.joined_at = time.monotonic()
+        self._own = threading.Lock()
+
+    def claim(self) -> bool:
+        return self._own.acquire(blocking=False)
+
+
+class _MicrobatchGroup:
+    def __init__(self, key: str):
+        self.key = key
+        self.members: List[_MicrobatchMember] = []
+        #: set when the group hits microbatch_max — wakes the leader
+        #: before the window expires
+        self.full = threading.Event()
+        self.closed = False
+
+
+class MicrobatchQueue:
+    """Coordinator-side micro-batch serving plane: the batch queue in
+    front of local dispatch (ROADMAP item 1 — many point lookups, one
+    device dispatch).
+
+    The FIRST statement of a canonical fingerprint to reach dispatch
+    becomes its group's leader: it holds the window open for
+    ``microbatch_wait_ms`` (or until ``microbatch_max`` members join),
+    then answers the whole group with ONE vmapped device dispatch
+    (LocalQueryRunner.execute_plan_microbatch; the batch-axis stacking
+    and the vmapped compile entry live in plan/canonical.py).
+    Followers park on an event and receive their lane's result. Any
+    member whose lane fell out of the batch — trace failure,
+    non-hoistable shape, capacity overflow, over-capacity output —
+    re-runs the existing scalar path on its own thread: batching can
+    cost a wait, never a wrong answer or a failed query."""
+
+    def __init__(self, runner):
+        self._runner = runner
+        self._lock = threading.Lock()
+        self._groups: Dict[str, _MicrobatchGroup] = {}
+
+    def execute(
+        self,
+        key: str,
+        plan,
+        qs,
+        wait_ms: float,
+        max_size: int,
+        no_wait: bool = False,
+    ):
+        """-> QueryResult, or None (the caller runs the scalar path).
+
+        ``no_wait``: the statement already waited once (PR 9's
+        admission high-water hold parked it before dispatch) — it must
+        not accrue the batch window on top of the hold, so it neither
+        opens nor joins a window (the batch window starts at
+        dispatch-eligibility, not submit)."""
+        if no_wait:
+            return None
+        member = _MicrobatchMember(plan, qs)
+        with self._lock:
+            g = self._groups.get(key)
+            if (
+                g is not None
+                and not g.closed
+                and len(g.members) < max_size
+            ):
+                g.members.append(member)
+                if len(g.members) >= max_size:
+                    g.full.set()
+                leader = False
+            else:
+                g = _MicrobatchGroup(key)
+                g.members.append(member)
+                self._groups[key] = g
+                leader = True
+        if not leader:
+            # the leader delivers this lane's result at dispatch; the
+            # timeout is a belt — a wedged leader (a minutes-long cold
+            # vmapped compile on a tunneled backend) must never wedge
+            # a query. On timeout the follower CLAIMS itself: claim
+            # won -> the leader will skip this lane, scalar path here;
+            # claim lost -> the leader owns the lane and always
+            # delivers (finally below), so wait it out
+            if not member.event.wait(wait_ms / 1000.0 + 60.0):
+                if member.claim():
+                    self._note_wait(member)
+                    return None
+                member.event.wait()
+            self._note_wait(member)
+            return member.result
+        g.full.wait(wait_ms / 1000.0)
+        with self._lock:
+            g.closed = True
+            if self._groups.get(key) is g:
+                del self._groups[key]
+            members = list(g.members)
+        self._note_wait(member)
+        # exactly-once ownership: the leader claims every member it
+        # will serve; one whose claim is lost already abandoned (it is
+        # answering itself scalar) and must not be touched again
+        claimed = [m for m in members if m.claim()]
+        if len(claimed) < 2:
+            for m in claimed:
+                if m is not member:
+                    m.event.set()  # result stays None: scalar path
+            return None  # nobody to share the dispatch with
+        results = [None] * len(claimed)
+        try:
+            try:
+                results = self._runner.execute_plan_microbatch(
+                    [m.plan for m in claimed],
+                    [m.qs for m in claimed],
+                )
+            except Exception:
+                # a batch-plane bug must never fail a member:
+                # everyone falls back to the scalar path
+                log.exception(
+                    "micro-batch dispatch failed; members fall back"
+                )
+        finally:
+            # delivery is unconditional — followers whose claim the
+            # leader won are parked on this event
+            for m, r in zip(claimed, results):
+                m.result = r
+            for m in claimed:
+                m.event.set()
+        return member.result
+
+    @staticmethod
+    def _note_wait(member: _MicrobatchMember) -> None:
+        REGISTRY.distribution("serving.batch_wait_ms").add(
+            (time.monotonic() - member.joined_at) * 1000.0
+        )
 
 
 class CoordinatorServer:
@@ -319,6 +478,22 @@ class CoordinatorServer:
         pcen = config.get("plan.cache-enabled") if config else None
         if pcen is not None:
             self.local.session.set("enable_plan_cache", bool(pcen))
+        # micro-batched serving: tier-1 serving.* keys seed the session
+        # defaults (0 = off = bit-exact pre-batching dispatch), and the
+        # ONE batch queue fronts this coordinator's local dispatch
+        mb_wait = (
+            config.get("serving.microbatch-wait-ms") if config else None
+        )
+        if mb_wait is not None:
+            self.local.session.set(
+                "microbatch_wait_ms", float(mb_wait)
+            )
+        mb_max = (
+            config.get("serving.microbatch-max") if config else None
+        )
+        if mb_max is not None:
+            self.local.session.set("microbatch_max", int(mb_max))
+        self.microbatch = MicrobatchQueue(self.local)
         #: coordinator-global prepared statements (PREPARE over plain
         #: HTTP without a header-aware client); header-supplied maps on
         #: the request take precedence. Bounded: a serving fleet cycles
@@ -1027,6 +1202,7 @@ class CoordinatorServer:
                 and not self._shutting_down
                 and self.arbiter.admission_held()
             ):
+                q._admission_parked = True
                 time.sleep(0.05)
             if q.done.is_set():  # killed while queued (memory manager)
                 with self._lock:
@@ -1206,6 +1382,16 @@ class CoordinatorServer:
             q.rows = [[line] for line in text.split("\n")]
             return
         if not isinstance(stmt, ast.Select) or not workers:
+            if isinstance(stmt, ast.Select):
+                # micro-batch lane (coordinator-local dispatch);
+                # None = lane off, keep the bit-exact legacy path
+                with q.trace.span("execute-local"):
+                    res = self._microbatch_local_select(
+                        q, stmt, adopt=True
+                    )
+                if res is not None:
+                    self._store_result(q, res)
+                    return
             # non-SELECT (SET SESSION / SHOW / EXPLAIN) or empty cluster:
             # run on the coordinator's local engine
             with q.trace.span("execute-local"):
@@ -1293,7 +1479,15 @@ class CoordinatorServer:
             # plan_cached marks q.stats.plan_cache_hit through the
             # thread-local stats sink _execute_query installed
             with q.trace.span("execute-local"):
-                res = self.local.execute_bound(bound)
+                res = None
+                if isinstance(bound, A.Select):
+                    # micro-batch lane: concurrent same-fingerprint
+                    # EXECUTEs share one vmapped dispatch (None when
+                    # the lane is off — the legacy path below is then
+                    # bit-exact pre-batching)
+                    res = self._microbatch_local_select(q, bound)
+                if res is None:
+                    res = self.local.execute_bound(bound)
         self._store_result(q, res)
 
     def _parse_prepared(self, text: str):
@@ -1316,6 +1510,56 @@ class CoordinatorServer:
             while len(cache) > self.MAX_PREPARED:
                 cache.popitem(last=False)
         return parsed
+
+    def _microbatch_key(self, stmt_key: str) -> str:
+        """The batch-queue grouping key — constructed HERE and only
+        here (tools/analyze.py ``serving-batch`` rule): the canonical
+        statement cache key already carries catalog/schema and the
+        value-erased statement shape, so same-key statements are
+        literally the same compiled program with different parameter
+        vectors; the prefix keeps queue keys out of every other key
+        space."""
+        return f"mb|{stmt_key}"
+
+    def _microbatch_local_select(self, q: _Query, stmt, adopt=False):
+        """Coordinator-local SELECT through the micro-batch lane:
+        -> QueryResult, or None when the lane is OFF (the caller keeps
+        the bit-exact legacy path). With the lane on, an eligible
+        statement always returns here — its lane of a batched dispatch
+        when a group formed, the existing scalar path otherwise.
+
+        ``adopt``: the plain-SELECT caller bypasses the runner's own
+        execute() bookkeeping, so the lane adopts the coordinator
+        stats into the runner history (system.runtime.queries must
+        still see the query). Adoption happens AFTER the one wait-ms
+        read below — a None return must leave no adopted twin behind
+        for the legacy path to duplicate."""
+        runner = self.local
+        wait_ms = float(runner.session.get("microbatch_wait_ms"))
+        if wait_ms <= 0:
+            return None
+        if adopt:
+            runner.history.adopt(q.stats)
+            q._adopted = True
+        plan, _hit, key = runner.plan_cached_keyed(stmt)
+        if key is not None and runner.microbatch_plan_eligible(plan):
+            max_size = min(
+                int(runner.session.get("microbatch_max")), 128
+            )
+            res = self.microbatch.execute(
+                self._microbatch_key(key),
+                plan,
+                q.stats,
+                wait_ms,
+                max_size,
+                no_wait=q._admission_parked,
+            )
+            if res is not None:
+                return res
+        # ineligible statement, empty window, or a lane that fell out
+        # of the batch: the one scalar path (capacity retries, error
+        # surfacing, full materialization)
+        return runner.execute_plan(plan, qs=q.stats)
 
     def _run_select(self, q: _Query, stmt, workers):
         """Distributed SELECT: plan -> fragment -> schedule stages ->

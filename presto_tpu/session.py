@@ -254,6 +254,26 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             True,
         ),
         PropertyMetadata(
+            "microbatch_wait_ms",
+            "Micro-batched point-lookup serving: how long a dispatch-"
+            "eligible statement may wait for concurrent same-"
+            "fingerprint statements to group into ONE vmapped device "
+            "dispatch (coordinator batch queue). 0 = off — the "
+            "bit-exact pre-batching path, zero batches. Tier-1 twin: "
+            "serving.microbatch-wait-ms",
+            float,
+            0.0,
+            _non_negative("microbatch_wait_ms"),
+        ),
+        PropertyMetadata(
+            "microbatch_max",
+            "Largest micro-batch group (lanes of one batched "
+            "dispatch). Tier-1 twin: serving.microbatch-max",
+            int,
+            16,
+            _positive("microbatch_max"),
+        ),
+        PropertyMetadata(
             "enable_operator_stats",
             "Trace per-operator output-row counters (plus static "
             "capacity/page-bytes) out of every compiled program and "
@@ -454,6 +474,15 @@ class NodeConfig:
         # session default seed
         "plan.cache-entries": int,
         "plan.cache-enabled": bool,
+        # micro-batched point-lookup serving (server/coordinator.py
+        # batch queue + the vmapped compile entries in
+        # plan/canonical.py): the hold window concurrent same-
+        # fingerprint statements may wait to share ONE device
+        # dispatch (0 = off, bit-exact pre-batching) and the largest
+        # group size. Seed the microbatch_wait_ms / microbatch_max
+        # session defaults
+        "serving.microbatch-wait-ms": float,
+        "serving.microbatch-max": int,
         # history-based statistics (plan/history.py): directory of the
         # crash-safe JSONL history store and its entry bound; the
         # optimizer consults observed per-operator actuals keyed by

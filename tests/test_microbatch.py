@@ -1,0 +1,387 @@
+"""Micro-batched point-lookup serving (coordinator batch queue +
+vmapped compile entries in plan/canonical.py).
+
+Contracts under test:
+
+- ``serving.microbatch-wait-ms=0`` (the default) is bit-exact pre-PR:
+  zero batches, identical results, identical (scalar-shaped)
+  compile-cache keys, no ``batched`` flags.
+- An N-way batch answers every member exactly like N scalar runs —
+  point lookups AND small aggregates, mixed/duplicate literals
+  included — while dispatching strictly fewer device programs than
+  statements served.
+- Ineligible members (non-hoistable shapes, over-window outputs) fall
+  out of the batch and ride the existing scalar path: correct answers,
+  never a failed query.
+- A statement parked by the admission high-water hold does not also
+  accrue the batch window after release (the window starts at
+  dispatch-eligibility, not submit).
+- Observability: serving.* metrics, QueryStats.batched/batch_size,
+  the system.runtime.queries column, the EXPLAIN ANALYZE line.
+"""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.server.coordinator import CoordinatorServer
+from presto_tpu.utils.metrics import REGISTRY
+
+POINT = (
+    "select c_custkey, c_name, c_acctbal "
+    "from tpch.tiny.customer where c_custkey = ?"
+)
+AGG = (
+    "select count(*) as n, sum(c_acctbal) as s "
+    "from tpch.tiny.customer where c_custkey < ?"
+)
+PREPARED = {"point": POINT, "agg": AGG}
+
+#: tiny customer row count (literal values must stay in key range)
+N_KEYS = 1500
+
+
+def _coord(wait_ms=0.0, max_size=16, concurrency=64, **kw):
+    c = CoordinatorServer(max_concurrent_queries=concurrency, **kw)
+    if wait_ms:
+        c.local.session.set("microbatch_wait_ms", wait_ms)
+        c.local.session.set("microbatch_max", max_size)
+    return c
+
+
+def _submit_concurrent(coord, sqls, prepared=None):
+    """Submit all statements at once (barrier start) and wait for
+    completion; returns the _Query objects in submission order."""
+    out = [None] * len(sqls)
+    barrier = threading.Barrier(len(sqls))
+
+    def run(i):
+        barrier.wait(30)
+        q = coord.submit(sqls[i], prepared=dict(prepared or {}))
+        q.done.wait(180)
+        out[i] = q
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(sqls))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(240)
+    return out
+
+
+def _scalar_expected(sqls, prepared):
+    """Reference answers from a plain (batch-less) runner."""
+    r = LocalQueryRunner()
+    for name, text in prepared.items():
+        r.execute(f"prepare {name} from {text}")
+    return [[list(row) for row in r.execute(s).rows()] for s in sqls]
+
+
+def _batch_counters():
+    return (
+        int(REGISTRY.counter("serving.batches").total),
+        int(REGISTRY.counter("serving.batched_statements").total),
+    )
+
+
+# ------------------------------------------------------------ off = legacy
+
+
+def test_off_by_default_bit_exact():
+    """wait-ms=0 (default): zero batches, scalar-shaped compile keys
+    only, no batched flags, correct concurrent results."""
+    coord = _coord()
+    try:
+        sqls = [
+            f"execute point using {7 + 11 * i}" for i in range(6)
+        ]
+        expected = _scalar_expected(sqls, PREPARED)
+        b0, s0 = _batch_counters()
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        b1, s1 = _batch_counters()
+        assert (b1 - b0, s1 - s0) == (0, 0)
+        for q, exp in zip(qs, expected):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+            assert q.stats.batched is False
+            assert q.stats.batch_size == 0
+        # the compile cache holds only pre-PR-shaped scalar keys:
+        # (fingerprint, analyzed, counted, offload) 4-tuples, never a
+        # "batch"-tagged entry
+        for key in coord.local._compiled:
+            assert len(key) == 4
+            assert "batch" not in key
+    finally:
+        coord.shutdown()
+
+
+# ------------------------------------------------- batched == scalar
+
+
+def test_nway_batch_equals_scalar_point_lookups():
+    coord = _coord(wait_ms=400.0)
+    try:
+        # warm the plan/compile path so the batch window isn't racing
+        # a cold XLA compile
+        q = coord.submit("execute point using 3", prepared=PREPARED)
+        q.done.wait(120)
+        vals = [5, 118, 119, 700, 701, 42, 1499, 12]
+        sqls = [f"execute point using {v}" for v in vals]
+        expected = _scalar_expected(sqls, PREPARED)
+        b0, s0 = _batch_counters()
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        b1, s1 = _batch_counters()
+        for q, exp in zip(qs, expected):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+        # strictly fewer dispatches than statements: at least one
+        # multi-member batch formed
+        assert b1 - b0 >= 1
+        assert s1 - s0 > b1 - b0
+        batched = [q for q in qs if q.stats.batched]
+        assert batched, "no member rode the batch"
+        assert all(q.stats.batch_size >= 2 for q in batched)
+        # a batch-tagged compile entry exists beside the scalar one
+        assert any(
+            "batch" in key for key in coord.local._compiled
+        )
+    finally:
+        coord.shutdown()
+
+
+def test_nway_batch_equals_scalar_aggregates():
+    """Small-aggregate shapes batch too (flags lanes stay clean when
+    no lane overflows) and answer exactly like scalar runs."""
+    coord = _coord(wait_ms=400.0)
+    try:
+        q = coord.submit("execute agg using 10", prepared=PREPARED)
+        q.done.wait(120)
+        vals = [2, 55, 340, 1100, 1500, 9]
+        sqls = [f"execute agg using {v}" for v in vals]
+        expected = _scalar_expected(sqls, PREPARED)
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        for q, exp in zip(qs, expected):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+        assert any(q.stats.batched for q in qs)
+    finally:
+        coord.shutdown()
+
+
+def test_mixed_and_duplicate_literals_demux_correctly():
+    """Duplicate values in one batch each get their own (identical)
+    answer; distinct values each get their own row."""
+    coord = _coord(wait_ms=400.0)
+    try:
+        q = coord.submit("execute point using 3", prepared=PREPARED)
+        q.done.wait(120)
+        vals = [77, 77, 901, 14, 901, 77]
+        sqls = [f"execute point using {v}" for v in vals]
+        expected = _scalar_expected(sqls, PREPARED)
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        for q, exp, v in zip(qs, expected, vals):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+            assert q.rows[0][0] == v  # the row really is THIS member's
+    finally:
+        coord.shutdown()
+
+
+# ------------------------------------------------------- fallout lanes
+
+
+def test_non_hoistable_shape_falls_back_scalar():
+    """A shape with no hoistable literal (string predicate) has no
+    parameter vector to stack: the whole group rides the scalar path,
+    correctly, with zero batches."""
+    coord = _coord(wait_ms=300.0)
+    try:
+        sql = (
+            "select count(*) as n from tpch.tiny.customer "
+            "where c_mktsegment = 'BUILDING'"
+        )
+        r = LocalQueryRunner()
+        expected = [list(row) for row in r.execute(sql).rows()]
+        b0, _ = _batch_counters()
+        qs = _submit_concurrent(coord, [sql] * 4)
+        b1, _ = _batch_counters()
+        assert b1 - b0 == 0
+        for q in qs:
+            assert q.state == "FINISHED", q.error
+            assert q.rows == expected
+            assert q.stats.batched is False
+    finally:
+        coord.shutdown()
+
+
+def test_over_window_output_falls_back_scalar():
+    """Lanes whose true row count exceeds the speculative window fall
+    out of the batch and materialize scalar — full correct results,
+    never a truncated answer."""
+    coord = _coord(wait_ms=300.0)
+    try:
+        coord.local.session.set("speculative_result_rows", 4)
+        sqls = [
+            f"execute agg2_{i} using {100 + i}" for i in range(3)
+        ]
+        prepared = {
+            f"agg2_{i}": (
+                "select c_custkey from tpch.tiny.customer "
+                "where c_custkey <= ?"
+            )
+            for i in range(3)
+        }
+        # one prepared NAME per client is unrealistic; same text =
+        # same canonical fingerprint, so they still group
+        expected = _scalar_expected(sqls, prepared)
+        qs = _submit_concurrent(coord, sqls, prepared)
+        for q, exp in zip(qs, expected):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+            # >4 rows: the lane fell out, answered scalar
+            assert q.stats.batched is False
+    finally:
+        coord.shutdown()
+
+
+def test_plan_cache_off_keeps_scalar_path():
+    coord = _coord(wait_ms=300.0)
+    try:
+        coord.local.session.set("enable_plan_cache", False)
+        sqls = [f"execute point using {v}" for v in (4, 9, 44)]
+        expected = _scalar_expected(sqls, PREPARED)
+        b0, _ = _batch_counters()
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        assert _batch_counters()[0] == b0
+        for q, exp in zip(qs, expected):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+    finally:
+        coord.shutdown()
+
+
+# --------------------------------------------- concurrency at fleet scale
+
+
+def test_hundred_client_demux_correctness():
+    """100 concurrent clients, distinct literals, threads racing into
+    one queue: every client gets ITS OWN row back (no crossed lanes),
+    and dispatches are strictly fewer than statements."""
+    coord = _coord(wait_ms=400.0, max_size=32, concurrency=128)
+    try:
+        q = coord.submit("execute point using 2", prepared=PREPARED)
+        q.done.wait(180)
+        vals = [1 + ((i * 37) % (N_KEYS - 1)) for i in range(100)]
+        sqls = [f"execute point using {v}" for v in vals]
+        b0, s0 = _batch_counters()
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        b1, s1 = _batch_counters()
+        for q, v in zip(qs, vals):
+            assert q.state == "FINISHED", q.error
+            assert len(q.rows) == 1
+            assert q.rows[0][0] == v  # demux: my literal, my row
+        batches, stmts = b1 - b0, s1 - s0
+        assert batches >= 1
+        assert stmts > batches  # mean occupancy > 1
+        # total device dispatches = batches + scalar fallthroughs
+        scalar_runs = len(sqls) - stmts
+        assert batches + scalar_runs < len(sqls)
+        occ = REGISTRY.distribution("serving.batch_occupancy").values()
+        assert occ["count"] > 0
+        wait = REGISTRY.distribution("serving.batch_wait_ms").values()
+        assert wait["count"] > 0
+    finally:
+        coord.shutdown()
+
+
+# ------------------------------------------- admission-hold interplay
+
+
+def test_admission_parked_statement_skips_batch_window():
+    """PR 9 interplay: a statement parked by the admission high-water
+    hold must not ALSO accrue microbatch_wait_ms after release — with
+    a 3-second window configured, the released query completes far
+    inside the window instead of holding it open as a leader."""
+    coord = _coord(wait_ms=3000.0)
+    try:
+        # warm (also proves the lane works before we start parking)
+        q = coord.submit("execute point using 3", prepared=PREPARED)
+        q.done.wait(120)
+        held = {"v": True}
+        coord.arbiter.admission_held = lambda: held["v"]
+        q = coord.submit("execute point using 888", prepared=PREPARED)
+        time.sleep(0.5)
+        assert not q.done.is_set()  # parked at admission
+        held["v"] = False
+        t0 = time.monotonic()
+        assert q.done.wait(30)
+        after_release = time.monotonic() - t0
+        assert q.state == "FINISHED", q.error
+        assert q._admission_parked is True
+        assert q.stats.batched is False
+        # far under the 3s window: the parked statement dispatched
+        # immediately at release instead of opening a batch window
+        assert after_release < 2.0, after_release
+    finally:
+        coord.shutdown()
+
+
+def test_unparked_solo_leader_pays_at_most_the_window():
+    """Control for the parked case: a solo statement with the lane on
+    holds its window open (that is the price of leadership) but never
+    more than wait + scalar time."""
+    coord = _coord(wait_ms=700.0)
+    try:
+        q = coord.submit("execute point using 5", prepared=PREPARED)
+        q.done.wait(120)  # warm: plan + compile
+        t0 = time.monotonic()
+        q = coord.submit("execute point using 6", prepared=PREPARED)
+        assert q.done.wait(60)
+        dt = time.monotonic() - t0
+        assert q.state == "FINISHED", q.error
+        assert dt >= 0.6  # it really held the window...
+        assert q.stats.batched is False  # ...and answered scalar
+    finally:
+        coord.shutdown()
+
+
+# ------------------------------------------------------- observability
+
+
+def test_runtime_queries_column_and_explain_line():
+    """Plain (non-prepared) SELECT literal variants batch on the local
+    lane too, surface batched=true in system.runtime.queries, and the
+    analyze render prints the micro-batch line."""
+    from presto_tpu.exec.explain import render_query_analyze
+
+    coord = _coord(wait_ms=400.0)
+    try:
+        sql = "select c_acctbal from tpch.tiny.customer where c_custkey = {}"
+        q = coord.submit(sql.format(2))
+        q.done.wait(120)
+        qs = _submit_concurrent(
+            coord, [sql.format(v) for v in (31, 44, 57, 68)]
+        )
+        expected = _scalar_expected(
+            [sql.format(v) for v in (31, 44, 57, 68)], {}
+        )
+        for q, exp in zip(qs, expected):
+            assert q.state == "FINISHED", q.error
+            assert q.rows == exp
+        batched = [q for q in qs if q.stats.batched]
+        assert batched
+        rows = coord.local.execute(
+            "select query_id, batched from system.runtime.queries "
+            "where batched"
+        ).rows()
+        assert {q.qid for q in batched} <= {r[0] for r in rows}
+        text = render_query_analyze(batched[0].stats)
+        assert "micro-batch:" in text
+        assert f"{batched[0].stats.batch_size}-way" in text
+    finally:
+        coord.shutdown()

@@ -75,6 +75,7 @@ def test_every_rule_registered(repo_findings):
         "blocking-under-lock",
         "plan-params",
         "history-sites",
+        "serving-batch",
         "rpc-confinement",
         "staging-confinement",
         "dynfilter-confinement",
@@ -595,6 +596,47 @@ def test_plan_params_shim_clean_and_flags(tmp_path):
         "cache = {}\n"
     )
     assert check_plan_params.main([str(tmp_path)]) == 1
+
+
+def test_serving_batch_rule_flags_rogue_sites(tmp_path):
+    """The micro-batch plane's privileged constructs flag outside
+    their audited modules: raw vmap / stacking / batched-entry keys
+    outside plan/canonical.py, queue keys outside the coordinator."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            fn = jax.vmap(lambda p: p)
+            stacked = stack_param_vectors(vectors, 4)
+            entry = vmap_program(trace)
+            key = batch_entry_key(cfp, True, True, 4)
+            q = MicrobatchQueue(runner)
+            gk = coord._microbatch_key(stmt_key)
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["serving-batch"])
+    assert len(found) == 6
+    assert all(f.rule == "serving-batch" for f in found)
+
+
+def test_serving_batch_rule_clean_fixture(tmp_path):
+    """Reads/isinstance checks and unrelated calls never flag."""
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(qs):
+                return qs.batched, qs.batch_size
+
+            def g(runner, plans, sinks):
+                # attribute READS of the audited names are fine
+                return runner.microbatch_plan_eligible
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["serving-batch"]
+    )
 
 
 def test_history_shim_clean_and_flags(tmp_path):

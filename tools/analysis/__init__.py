@@ -14,6 +14,9 @@ plan-params           compile-plane constructs confined to
                       plan/canonical.py + audited consumers
 history-sites         history-plane constructs confined to
                       plan/history.py + audited consumers
+serving-batch         micro-batch constructs confined: batch-axis
+                      stacking/vmap entries to plan/canonical.py,
+                      batch-queue keys to server/coordinator.py
 rpc-confinement       raw urlopen confined to server/rpc.py
 staging-confinement   device_put / boundary jnp conversions confined
                       to exec/staging.py

@@ -155,3 +155,62 @@ def history_sites_pass(modules: List[core.Module], src_dir: str):
         "history-sites",
         "presto_tpu.plan.history",
     )
+
+
+# ------------------------------------------------------- serving batch
+
+_COORDINATOR = "server/coordinator.py"
+
+#: the micro-batch serving plane is only correct while its privileged
+#: constructs stay confined: batch-axis stacking / the vmapped compile
+#: entry in plan/canonical.py (a stacking built elsewhere can disagree
+#: with the hoisting eligibility rules and CROSS members' answers, not
+#: just miss a cache), the batched executor in its one audited caller,
+#: and batch-queue key construction in server/coordinator.py (a queue
+#: key minted elsewhere could group statements that do not share a
+#: compiled program)
+_BATCH_CALLS = {
+    "stack_param_vectors": {_CANONICAL, _RUNNER},
+    "vmap_program": {_CANONICAL, _RUNNER},
+    "batch_entry_key": {_CANONICAL, _RUNNER},
+    "batch_lanes": {_CANONICAL, _RUNNER},
+    "execute_plan_microbatch": {_RUNNER, _COORDINATOR},
+    "compact_page_window": {"page.py", _RUNNER},
+    "MicrobatchQueue": {_COORDINATOR},
+    "_microbatch_key": {_COORDINATOR},
+}
+
+
+@core.register(
+    "serving-batch",
+    "micro-batch constructs confined: batch-axis stacking and vmap "
+    "entries to plan/canonical.py, batch-queue keys to "
+    "server/coordinator.py",
+)
+def serving_batch_pass(modules: List[core.Module], src_dir: str):
+    findings = _confined_calls(
+        modules,
+        _BATCH_CALLS,
+        "serving-batch",
+        "presto_tpu.plan.canonical / the coordinator batch queue",
+    )
+    # raw vmap anywhere outside the canonicalizer is a batch-axis
+    # construction site by definition
+    for mod in modules:
+        if mod.rel == _CANONICAL:
+            continue
+        for node in mod.nodes:
+            if (
+                isinstance(node, ast.Call)
+                and core.terminal_name(node.func) == "vmap"
+            ):
+                findings.append(
+                    mod.finding(
+                        "serving-batch",
+                        node.lineno,
+                        "raw vmap — batched program entries are "
+                        "constructed only by plan/canonical.py "
+                        "(vmap_program)",
+                    )
+                )
+    return findings
