@@ -24,11 +24,26 @@ from presto_tpu import types as T
 
 @dataclasses.dataclass(frozen=True)
 class TableHandle:
-    """Opaque engine-side reference to a connector table."""
+    """Opaque engine-side reference to a connector table.
+
+    ``snapshot`` pins a committed table version for snapshot-capable
+    connectors (the streaming-ingest lane, ``server/ingest.py``):
+    None = the live/current contents (every pre-snapshot handle).
+    The snapshot participates in equality/hash on purpose — staged
+    pages of different versions must never share a cache entry — so
+    cache *invalidation* matches on :attr:`table_key` instead."""
 
     catalog: str
     schema: str
     table: str
+    snapshot: Optional[int] = None
+
+    @property
+    def table_key(self) -> tuple:
+        """Version-blind identity: (catalog, schema, table). The match
+        key for write-path cache invalidation, which must drop every
+        snapshot's entries of a written table."""
+        return (self.catalog, self.schema, self.table)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -186,6 +201,17 @@ class Connector:
 
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError
+
+    def pin_snapshot(self, handle: TableHandle) -> TableHandle:
+        """Resolve ``handle`` to a pinned committed version for the
+        duration of one plan (Iceberg-style snapshot reads): the
+        planner calls this once per table scan, so every split, staged
+        page, and capacity retry of the plan reads ONE immutable
+        version — long scans are isolated from concurrent ingest
+        commits. The default (and any connector without versioned
+        tables) returns the handle unchanged: reads keep the live
+        contents, bit-exact pre-snapshot behavior."""
+        return handle
 
     def get_splits(
         self,

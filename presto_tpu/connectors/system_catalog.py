@@ -85,6 +85,20 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "kind": T.VARCHAR,
             "value": T.DOUBLE,
         },
+        # materialized views (exec/mview.py): definition, base table,
+        # tip snapshot, and how/when the view was last maintained
+        "materialized_views": {
+            "view": T.VARCHAR,
+            "base_table": T.VARCHAR,
+            "eligible": T.BOOLEAN,
+            "reason": T.VARCHAR,
+            "snapshot_id": T.BIGINT,
+            "last_refresh_mode": T.VARCHAR,
+            "refresh_age_s": T.DOUBLE,
+            "refreshes": T.BIGINT,
+            "incremental_refreshes": T.BIGINT,
+            "rows": T.BIGINT,
+        },
         "caches": {
             "cache": T.VARCHAR,
             "entries": T.BIGINT,
@@ -196,6 +210,9 @@ class SystemConnector(Connector):
             ]
         if key == ("runtime", "caches"):
             return self._cache_rows()
+        if key == ("runtime", "materialized_views"):
+            reg = getattr(self._runner, "_mview_registry", None)
+            return reg.view_rows() if reg is not None else []
         if key == ("runtime", "memory"):
             return self._memory_rows()
         if key == ("runtime", "query_history"):
@@ -311,6 +328,23 @@ class SystemConnector(Connector):
                 "evictions": split.get("spills", 0),
             }
         )
+        # streaming-ingest WAL occupancy (server/ingest.py): pending
+        # (durable, not yet committed) batches, WAL bytes written,
+        # committed folds as hits, replayed tail batches as evictions
+        ingest = getattr(self._runner, "ingest", None)
+        if ingest is not None:
+            s = ingest.stats()
+            rows.append(
+                {
+                    "cache": "ingest.wal",
+                    "entries": s["pending_batches"],
+                    "bytes": s["wal_bytes"],
+                    "budget_bytes": 0,
+                    "hits": s["commits"],
+                    "misses": 0,
+                    "evictions": s["replayed"],
+                }
+            )
         # durable-exchange spool occupancy (fault-tolerant execution):
         # present when the embedding coordinator has exchange.spool-path
         # configured (server.spool shares the directory with workers)

@@ -225,6 +225,13 @@ class LocalQueryRunner:
         # scan hit a spilled-to-host page) lands on its stats sink —
         # the per-query spilled_bytes QueryInfo/EXPLAIN ANALYZE report
         self.split_cache.on_restage = self._note_spilled
+        # materialized views (exec/mview.py): registry created lazily
+        # at the first MV statement — plain query paths pay nothing
+        self._mview_registry = None
+        # streaming ingest lane (server/ingest.py): attached by the
+        # embedding coordinator (ingest.wal-path) or tests; None =
+        # the legacy write path, bit-exact pre-ingest
+        self.ingest = None
         # QueryStats while a query is in flight — THREAD-local: a
         # server embedding this runner executes admitted queries on
         # concurrent threads, and a shared slot races (one thread's
@@ -235,6 +242,17 @@ class LocalQueryRunner:
         # worker task with task_concurrency > 1 points every batch
         # driver's thread-local at the same TaskStats
         self._qs_mu = threading.Lock()
+
+    @property
+    def mview_registry(self):
+        """The materialized-view registry (exec/mview.py), created on
+        first use; :attr:`_mview_registry` stays None until then so
+        the hot write/read seams can skip it for free."""
+        if self._mview_registry is None:
+            from presto_tpu.exec.mview import MViewRegistry
+
+            self._mview_registry = MViewRegistry(self)
+        return self._mview_registry
 
     @property
     def _active_qs(self):
@@ -307,6 +325,21 @@ class LocalQueryRunner:
             return self._execute_create_table(stmt)
         if isinstance(stmt, ast.DropTable):
             return self._execute_drop_table(stmt)
+        if isinstance(stmt, ast.CreateMaterializedView):
+            self.mview_registry.create(stmt, sql)
+            return QueryResult(
+                ("result",), _message_page("CREATE MATERIALIZED VIEW")
+            )
+        if isinstance(stmt, ast.RefreshMaterializedView):
+            self.mview_registry.refresh(stmt.target)
+            return QueryResult(
+                ("result",), _message_page("REFRESH MATERIALIZED VIEW")
+            )
+        if isinstance(stmt, ast.DropMaterializedView):
+            self.mview_registry.drop(stmt.target, stmt.if_exists)
+            return QueryResult(
+                ("result",), _message_page("DROP MATERIALIZED VIEW")
+            )
         if isinstance(stmt, ast.Delete):
             return self._execute_delete(stmt)
         if isinstance(stmt, ast.Update):
@@ -419,9 +452,14 @@ class LocalQueryRunner:
         statement-level plan cache invalidates on the same hook: a
         DROP/recreate can change the schema a cached plan resolved
         against (plain INSERTs keep plans valid, but the hook is the
-        one audited write-path seam and a replan costs microseconds)."""
+        one audited write-path seam and a replan costs microseconds).
+        The materialized-view registry's staleness epoch rides the
+        same seam: every write (legacy or ingest commit) bumps the
+        written table's epoch for the read gate."""
         self.split_cache.invalidate(handle)
         self.plan_cache.invalidate(handle)
+        if self._mview_registry is not None:
+            self._mview_registry.note_write(handle)
 
     def _resolve_write_handle(self, parts):
         from presto_tpu.connectors.spi import TableHandle
@@ -670,7 +708,15 @@ class LocalQueryRunner:
     def plan_cached_keyed(self, stmt) -> Tuple[Plan, bool, Optional[str]]:
         """plan_cached plus the canonical statement cache key (None
         when the statement bypassed the cache) — the coordinator's
-        micro-batch queue groups concurrent same-key statements."""
+        micro-batch queue groups concurrent same-key statements.
+
+        Also the ONE select-planning seam every read path funnels
+        through (execute, EXECUTE, micro-batch lane, distributed
+        dispatch), which is where the materialized-view staleness read
+        gate sits: a referenced stale view refreshes before the
+        statement plans (``mview.max-staleness-s``)."""
+        if self._mview_registry is not None:
+            self._mview_registry.read_gate(stmt)
         plan, hit, key = self._plan_cached(stmt)
         if hit:
             # a server embedding this runner installs its QueryStats as

@@ -639,16 +639,24 @@ class SplitCache:
     def invalidate(self, handle) -> int:
         """Drop every entry of a written/dropped table (keys lead with
         the table handle), releasing their reservations. Returns the
-        number of entries dropped."""
+        number of entries dropped. Matching is version-blind
+        (``table_key``): a write must drop every SNAPSHOT's entries of
+        the table, not just the exact pinned handle it was issued
+        under."""
+        tk = handle.table_key
+
+        def _stale(k) -> bool:
+            return getattr(k[0], "table_key", k[0]) == tk
+
         with self._lock:
             self._epoch += 1
-            stale = [k for k in self._entries if k[0] == handle]
+            stale = [k for k in self._entries if _stale(k)]
             for k in stale:
                 _page, nbytes = self._entries.pop(k)
                 self._release(nbytes)
                 self._pins.pop(k, None)
             # spilled copies of a written/dropped table are stale too
-            for k in [k for k in self._spill if k[0] == handle]:
+            for k in [k for k in self._spill if _stale(k)]:
                 _host, nbytes = self._spill.pop(k)
                 self._spill_bytes -= nbytes
             return len(stale)

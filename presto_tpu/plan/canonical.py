@@ -656,11 +656,16 @@ class PlanCache:
             self._shrink()
 
     def invalidate(self, handle) -> None:
+        # version-blind match: a cached plan pins a SNAPSHOT of its
+        # tables (planner pin_snapshot), and a write/commit must drop
+        # plans planned against any version of the written table
+        tk = handle.table_key
         with self._lock:
             dead = [
                 k
                 for k, e in self._od.items()
-                if isinstance(e, PlanCacheEntry) and handle in e.handles
+                if isinstance(e, PlanCacheEntry)
+                and any(h.table_key == tk for h in e.handles)
             ]
             for k in dead:
                 del self._od[k]

@@ -575,6 +575,13 @@ class _Planner:
                 catalog, schema = rel.parts[0], rel.parts[1]
             handle = TableHandle(catalog, schema, name)
             conn = self.catalogs.get(catalog)
+            # snapshot-capable connectors (streaming ingest) pin the
+            # scan to the tip committed version HERE, once per plan:
+            # every split, staged page, and capacity retry then reads
+            # one immutable prefix — readers never see a torn batch,
+            # and long scans are isolated from concurrent commits.
+            # Default connectors return the handle unchanged.
+            handle = conn.pin_snapshot(handle)
             tschema = conn.metadata().get_table_schema(handle)
             node = N.TableScanNode(
                 handle=handle,

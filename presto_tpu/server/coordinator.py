@@ -494,6 +494,33 @@ class CoordinatorServer:
         if mb_max is not None:
             self.local.session.set("microbatch_max", int(mb_max))
         self.microbatch = MicrobatchQueue(self.local)
+        # streaming ingest lane (server/ingest.py): WAL'd micro-batch
+        # commits with snapshot reads + incrementally-maintained
+        # materialized views. Unset = none of it constructs — the
+        # legacy INSERT/CTAS write path is bit-exact pre-ingest
+        self.ingest = None
+        ing_path = config.get("ingest.wal-path") if config else None
+        mv_stale = (
+            config.get("mview.max-staleness-s") if config else None
+        )
+        mv_inc = (
+            config.get("mview.incremental-enabled") if config else None
+        )
+        if mv_stale is not None:
+            self.local.mview_registry.max_staleness_s = float(mv_stale)
+        if mv_inc is not None:
+            self.local.mview_registry.incremental_enabled = bool(mv_inc)
+        # constructed in start(), AFTER the embedder registered its
+        # catalogs (WAL replay resolves tables through them) and
+        # alongside journal recovery — recover before serving
+        self._ingest_cfg = (
+            (
+                ing_path,
+                float(config.get("ingest.commit-interval-ms", 50.0)),
+            )
+            if ing_path
+            else None
+        )
         #: coordinator-global prepared statements (PREPARE over plain
         #: HTTP without a header-aware client); header-supplied maps on
         #: the request take precedence. Bounded: a serving fleet cycles
@@ -610,6 +637,16 @@ class CoordinatorServer:
         # would 404 instead of resolving to the resumed run)
         if self.journal is not None:
             self._recover_from_journal()
+        # ingest-lane recovery rides the same before-serving seam (and
+        # AFTER catalog registration — WAL replay recreates tables
+        # through the mounted connectors)
+        if self._ingest_cfg is not None and self.ingest is None:
+            from presto_tpu.server.ingest import IngestManager
+
+            path, interval = self._ingest_cfg
+            self.ingest = IngestManager(
+                self.local, path, commit_interval_ms=interval
+            )
         self._serve_thread.start()
         return self
 
@@ -617,6 +654,10 @@ class CoordinatorServer:
         self._shutting_down = True
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        if self.ingest is not None:
+            # stop the commit loop and fold the pending tail (the WAL
+            # has it either way — replay would re-admit)
+            self.ingest.close()
         # httpd.shutdown() handshakes with the serve_forever loop and
         # blocks forever if that loop never ran (server constructed but
         # not .start()ed, e.g. in-process submit()-only tests).
@@ -3435,6 +3476,36 @@ def _make_handler(coord: CoordinatorServer):
                         "nextUri": f"{coord.uri}/v1/statement/{q.qid}/0",
                     },
                 )
+            if len(parts) == 3 and parts[:2] == ["v1", "ingest"]:
+                # streaming ingest: POST /v1/ingest/{table} with
+                # {"rows": [{col: val}, ...]} or
+                # {"columns": {col: [values]}}; optional
+                # {"commit": true} forces a synchronous fold instead
+                # of waiting for the commit loop. The batch is durable
+                # (WAL-framed) once this returns; visible at commit.
+                if coord.ingest is None:
+                    return self._json(
+                        503,
+                        {
+                            "error": "ingest lane not configured "
+                            "(set ingest.wal-path)"
+                        },
+                    )
+                try:
+                    body = json.loads(self._read_body() or b"{}")
+                    out = coord.ingest.append(
+                        parts[2],
+                        columns=body.get("columns"),
+                        rows=body.get("rows"),
+                    )
+                    if body.get("commit"):
+                        coord.ingest.flush()
+                        out["committed"] = True
+                    return self._json(200, out)
+                except Exception as e:
+                    return self._json(
+                        400, {"error": f"{type(e).__name__}: {e}"}
+                    )
             self._json(404, {"error": f"no route {self.path}"})
 
         def do_PUT(self):

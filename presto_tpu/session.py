@@ -520,6 +520,18 @@ class NodeConfig:
         # short drain grace a preemption notice gets
         "node.preemptible": bool,
         "pool.preempt-grace-s": float,
+        # streaming ingest lane (server/ingest.py): directory of the
+        # per-table crc32-framed WALs (unset = the lane never
+        # constructs; legacy INSERT/CTAS bit-exact) and the commit-loop
+        # cadence folding pending micro-batches into snapshots
+        "ingest.wal-path": str,
+        "ingest.commit-interval-ms": float,
+        # materialized views (exec/mview.py): the staleness bound the
+        # read gate enforces over views of legacy-written bases, and
+        # the master switch for incremental (delta-merge) maintenance
+        # (false = every maintenance event is a full refresh)
+        "mview.max-staleness-s": float,
+        "mview.incremental-enabled": bool,
         # deterministic chaos: JSON FaultPlane spec (utils.faults)
         "fault-injection.spec": str,
     }

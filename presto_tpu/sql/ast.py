@@ -394,3 +394,30 @@ class Update(Node):
     target: Tuple[str, ...]
     assignments: Tuple[Tuple[str, Node], ...]
     where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateMaterializedView(Node):
+    """CREATE MATERIALIZED VIEW name AS select (reference:
+    CreateMaterializedView). The view materializes into a stored table
+    under ``target``; eligible aggregate shapes are maintained
+    incrementally on ingest commits (exec/mview.py)."""
+
+    target: Tuple[str, ...]
+    query: Node = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshMaterializedView(Node):
+    """REFRESH MATERIALIZED VIEW name — a full recompute from the base
+    table (reference: RefreshMaterializedView)."""
+
+    target: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DropMaterializedView(Node):
+    """DROP MATERIALIZED VIEW [IF EXISTS] name."""
+
+    target: Tuple[str, ...]
+    if_exists: bool = False

@@ -29,6 +29,7 @@ SOFT_IDENT_KEYWORDS = frozenset({
     "date", "year", "month", "day", "values", "tables", "schemas",
     "first", "last", "columns", "using", "execute", "prepare",
     "delete", "describe", "deallocate", "if", "drop", "update",
+    "materialized", "view", "refresh",
 })
 
 
@@ -221,7 +222,20 @@ class _Parser:
             sel = self.parse_select()
             self._finish()
             return ast.Insert(target, query=sel)
+        if self.accept_kw("refresh"):
+            self.expect_kw("materialized")
+            self.expect_kw("view")
+            target = self._qualified_name()
+            self._finish()
+            return ast.RefreshMaterializedView(target)
         if self.accept_kw("create"):
+            if self.accept_kw("materialized"):
+                self.expect_kw("view")
+                target = self._qualified_name()
+                self.expect_kw("as")
+                sel = self.parse_select()
+                self._finish()
+                return ast.CreateMaterializedView(target, sel)
             self.expect_kw("table")
             target = self._qualified_name()
             if self.accept_op("("):
@@ -254,6 +268,15 @@ class _Parser:
             self._finish()
             return ast.Update(target, tuple(assigns), where)
         if self.accept_kw("drop"):
+            if self.accept_kw("materialized"):
+                self.expect_kw("view")
+                if_exists = False
+                if self.accept_kw("if"):
+                    self.expect_kw("exists")
+                    if_exists = True
+                target = self._qualified_name()
+                self._finish()
+                return ast.DropMaterializedView(target, if_exists)
             self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):

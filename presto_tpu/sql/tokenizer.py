@@ -28,6 +28,7 @@ KEYWORDS = {
     "insert", "into", "create", "table",
     "delete", "describe", "columns", "prepare", "execute",
     "deallocate", "using", "drop", "if", "update",
+    "materialized", "view", "refresh",
 }
 
 _TOKEN_RE = re.compile(

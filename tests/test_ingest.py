@@ -1,0 +1,884 @@
+"""Streaming ingest lane + incrementally-maintained materialized
+views (server/ingest.py, exec/mview.py, the memory connector's
+snapshot SPI).
+
+Covers the PR's acceptance contracts: WAL round-trip with torn-tail
+replay, snapshot isolation under concurrent append (a reader pinned
+mid-scan sees ONE version), kill-mid-commit chaos (replay loses zero
+committed batches and duplicates zero), incremental-vs-full-refresh
+bit-equality for every eligible aggregate, ineligible-view fallback,
+the staleness read gate, the HTTP ingest endpoint, runtime views +
+metrics, and the legacy write path staying bit-exact when
+``ingest.wal-path`` is unset.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import create_connector
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager
+from presto_tpu.server.ingest import (
+    IngestError,
+    IngestManager,
+    _parse_wal_line,
+    _wal_frame,
+)
+from presto_tpu.utils.metrics import REGISTRY
+
+
+def fresh_runner():
+    """A runner with a FRESH memory connector (the crash-simulation
+    primitive: a new connector is an empty volatile store)."""
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    mem = create_connector("memory")
+    catalogs.register("mem", mem)
+    return LocalQueryRunner(catalogs=catalogs), mem
+
+
+def make_events(mem, name="ev"):
+    mem.create_table(
+        TableHandle("mem", "default", name),
+        {"k": T.BIGINT, "v": T.BIGINT, "w": T.DOUBLE},
+    )
+    return TableHandle("mem", "default", name)
+
+
+@pytest.fixture()
+def lane(tmp_path):
+    runner, mem = fresh_runner()
+    make_events(mem)
+    ing = IngestManager(runner, str(tmp_path), start_thread=False)
+    yield runner, mem, ing, str(tmp_path)
+    ing.close(final_flush=False)
+
+
+# ------------------------------------------------------------- the WAL
+
+
+def test_wal_round_trip_and_commit_visibility(lane):
+    runner, mem, ing, _ = lane
+    out = ing.append(
+        "mem.default.ev",
+        columns={"k": [1, 1, 2], "v": [10, 20, 5], "w": [1.0, 2.0, 0.5]},
+    )
+    assert out["seq"] == 1 and out["rows"] == 3
+    # durable but NOT visible before the commit folds it
+    assert runner.execute(
+        "select count(*) from mem.default.ev"
+    ).rows() == [(0,)]
+    assert ing.commit_tick() == 1
+    assert runner.execute(
+        "select k, v from mem.default.ev order by v"
+    ).rows() == [(2, 5), (1, 10), (1, 20)]
+    # the table now has a committed snapshot the planner pins
+    assert mem.current_snapshot_id(
+        TableHandle("mem", "default", "ev")
+    ) == 1
+
+
+def test_append_validates_schema(lane):
+    _runner, _mem, ing, _ = lane
+    with pytest.raises(IngestError, match="unknown column"):
+        ing.append("mem.default.ev", columns={"nope": [1]})
+    # the rows form must be just as strict: a typo'd key must error,
+    # never silently NULL-fill the real column under a 200 ack
+    with pytest.raises(IngestError, match="unknown column"):
+        ing.append(
+            "mem.default.ev",
+            rows=[{"K": 1, "v": 2, "w": 0.1}],
+        )
+    with pytest.raises(IngestError, match="missing column"):
+        ing.append("mem.default.ev", rows=[{"k": 1, "v": 2}])
+    with pytest.raises(IngestError, match="missing column"):
+        ing.append("mem.default.ev", columns={"k": [1]})
+    with pytest.raises(IngestError, match="ragged"):
+        ing.append(
+            "mem.default.ev",
+            columns={"k": [1], "v": [1, 2], "w": [0.1]},
+        )
+    with pytest.raises(IngestError, match="zero rows"):
+        ing.append(
+            "mem.default.ev", columns={"k": [], "v": [], "w": []}
+        )
+
+
+def test_wal_frame_round_trip_and_corruption():
+    rec = {"ev": "batch", "seq": 3, "cols": {"k": [1]}}
+    line = _wal_frame(json.dumps(rec))
+    assert _parse_wal_line(line) == rec
+    # torn tail: any truncation breaks the crc
+    for cut in (len(line) - 1, len(line) // 2, 9):
+        assert _parse_wal_line(line[:cut]) is None
+    assert _parse_wal_line("zzzzzzzz {}") is None
+    assert _parse_wal_line("") is None
+
+
+def test_torn_tail_replay_readmits_exactly_once(lane, tmp_path):
+    runner, mem, ing, wal = lane
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [10], "w": [1.0]},
+    )
+    ing.commit_tick()
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [2], "v": [20], "w": [2.0]},
+    )
+    # crash before the second commit, tearing the tail frame mid-write
+    path = os.path.join(wal, "wal-mem.default.ev.jsonl")
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("deadbeef {\"ev\": \"batch\", \"seq\"")  # torn line
+    corrupt0 = int(REGISTRY.counter("ingest.wal_corrupt").total)
+    runner2, _mem2 = fresh_runner()
+    ing2 = IngestManager(runner2, wal, start_thread=False)
+    # committed batch 1 is back; uncommitted batch 2 is PENDING (not
+    # yet visible), re-admitted exactly once
+    assert runner2.execute(
+        "select k, v from mem.default.ev order by k"
+    ).rows() == [(1, 10)]
+    assert ing2.stats()["pending_batches"] == 1
+    assert (
+        int(REGISTRY.counter("ingest.wal_corrupt").total) == corrupt0 + 1
+    )
+    ing2.commit_tick()
+    assert runner2.execute(
+        "select k, v from mem.default.ev order by k"
+    ).rows() == [(1, 10), (2, 20)]
+    # a THIRD boot replays both batches as committed — no duplicates
+    runner3, _mem3 = fresh_runner()
+    IngestManager(runner3, wal, start_thread=False)
+    assert runner3.execute(
+        "select k, v from mem.default.ev order by k"
+    ).rows() == [(1, 10), (2, 20)]
+
+
+def test_legacy_write_path_untouched_without_wal():
+    """ingest.wal-path unset: no IngestManager constructs, no
+    snapshots mint, plain INSERT/CTAS behave bit-exactly pre-PR."""
+    runner, mem = fresh_runner()
+    make_events(mem)
+    assert runner.ingest is None
+    runner.execute(
+        "insert into mem.default.ev values (1, 10, 1.0), (2, 20, 2.0)"
+    )
+    handle = TableHandle("mem", "default", "ev")
+    assert mem.current_snapshot_id(handle) is None
+    # unversioned tables never pin: the planner's handle is unchanged
+    assert mem.pin_snapshot(handle) is handle
+    assert runner.execute(
+        "select k, v from mem.default.ev order by k"
+    ).rows() == [(1, 10), (2, 20)]
+    assert runner.execute("delete from mem.default.ev where k = 1").rows() == [
+        (1,)
+    ]
+    assert runner.execute(
+        "select k from mem.default.ev"
+    ).rows() == [(2,)]
+
+
+# -------------------------------------------------- snapshot isolation
+
+
+def test_pinned_snapshot_reader_sees_one_version(lane):
+    """A handle pinned at plan time keeps serving its version while
+    commits land: splits, stats, and page sources all clamp to the
+    pinned prefix."""
+    runner, mem, ing, _ = lane
+    handle = TableHandle("mem", "default", "ev")
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1, 2], "v": [10, 20], "w": [1.0, 2.0]},
+    )
+    ing.commit_tick()
+    pinned = mem.pin_snapshot(handle)
+    assert pinned.snapshot == 1
+    # a commit lands AFTER the reader pinned
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [3], "v": [30], "w": [3.0]},
+    )
+    ing.commit_tick()
+    # the pinned reader still sees exactly version 1 ...
+    src = mem.get_splits(pinned)
+    rows = 0
+    while not src.exhausted:
+        for sp in src.next_batch(16):
+            rows += len(
+                mem.create_page_source(sp, ["k"])["k"]
+            )
+    assert rows == 2
+    assert mem.metadata().get_table_stats(pinned).row_count == 2.0
+    # ... and a split minted before the commit cannot widen past it
+    from presto_tpu.connectors.spi import ConnectorSplit
+
+    wide = mem.create_page_source(
+        ConnectorSplit(pinned, 0, 99), ["k", "v"]
+    )
+    assert len(wide["k"]) == 2
+    # a fresh pin sees version 2
+    assert mem.pin_snapshot(handle).snapshot == 2
+    assert runner.execute(
+        "select count(*) from mem.default.ev"
+    ).rows() == [(3,)]
+
+
+@pytest.mark.slow
+def test_snapshot_isolation_under_concurrent_append(lane):
+    """Writers hammer the lane while readers scan: every result is a
+    consistent prefix — COUNT and SUM always agree with some committed
+    snapshot, never a torn batch."""
+    runner, _mem, ing, _ = lane
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                ing.append(
+                    "mem.default.ev",
+                    columns={
+                        "k": [i, i],
+                        "v": [1, 1],
+                        "w": [0.5, 0.5],
+                    },
+                )
+                ing.commit_tick()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    def reader():
+        while not stop.is_set():
+            try:
+                (n, s), = runner.execute(
+                    "select count(*) c, sum(v) s "
+                    "from mem.default.ev"
+                ).rows()
+                # every batch is (2 rows, sum 2): any consistent
+                # prefix has s == n and n even
+                if n and (s != n or n % 2):
+                    errors.append(
+                        AssertionError(f"torn read: n={n} s={s}")
+                    )
+                    return
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors[0]
+
+
+# ------------------------------------------------- kill-mid-commit chaos
+
+
+@pytest.mark.slow
+def test_kill_mid_commit_chaos_replay_exact_once(tmp_path):
+    """Crash at every point of the commit pipeline (frame written /
+    not written, connector folded / not): replay must expose every
+    batch whose commit frame landed EXACTLY once, keep the rest
+    pending exactly once, and an MV over the replayed table must equal
+    a cold full refresh bit-for-bit."""
+    wal = str(tmp_path)
+    runner, mem = fresh_runner()
+    make_events(mem)
+    ing = IngestManager(runner, wal, start_thread=False)
+    runner.execute(
+        "create materialized view mem.default.mv as "
+        "select k, sum(v) as sv, count(*) as c "
+        "from mem.default.ev group by k"
+    )
+    committed_rows = []  # (k, v) rows covered by a commit frame
+    tail_rows = []  # appended, no commit frame yet
+    seq = 0
+    for round_no in range(6):
+        seq += 1
+        rows = [(round_no % 3, 10 + seq), (round_no % 3 + 5, seq)]
+        ing.append(
+            "mem.default.ev",
+            columns={
+                "k": [r[0] for r in rows],
+                "v": [r[1] for r in rows],
+                "w": [0.0, 0.0],
+            },
+        )
+        tail_rows.extend(rows)
+        kill_point = round_no % 3
+        if kill_point == 0:
+            # crash BEFORE the commit frame: batch stays tail
+            pass
+        elif kill_point == 1:
+            # full commit, then crash
+            ing.commit_tick()
+            committed_rows.extend(tail_rows)
+            tail_rows = []
+        else:
+            # crash BETWEEN the commit frame and the connector fold:
+            # simulate by writing the commit frame by hand through the
+            # manager's own framing (the connector never sees it)
+            with ing._commit_mu:
+                lane_obj = ing._lane(
+                    TableHandle("mem", "default", "ev")
+                )
+                with lane_obj.lock:
+                    batches = lane_obj.pending
+                    lane_obj.pending = []
+                    upto = batches[-1][0]
+                    ing._write_frame(
+                        lane_obj,
+                        {
+                            "ev": "commit",
+                            "upto": upto,
+                            "snapshot": upto,
+                        },
+                    )
+                    lane_obj.committed = upto
+            committed_rows.extend(tail_rows)
+            tail_rows = []
+        # "kill": fresh store + fresh manager over the same WAL
+        runner, mem = fresh_runner()
+        ing = IngestManager(runner, wal, start_thread=False)
+        got = runner.execute(
+            "select k, v from mem.default.ev order by k, v"
+        ).rows()
+        assert got == sorted(committed_rows), (
+            f"round {round_no}: committed batches lost or duplicated"
+        )
+        assert ing.stats()["pending_batches"] == len(tail_rows) // 2
+        # MV over the replayed table == cold full refresh, bit-for-bit
+        mv_rows = runner.execute(
+            "select * from mem.default.mv order by k"
+        ).rows()
+        cold = runner.execute(
+            "select k, sum(v) as sv, count(*) as c "
+            "from mem.default.ev group by k order by k"
+        ).rows()
+        assert mv_rows == cold, f"round {round_no}: MV != cold refresh"
+    # final commit folds the surviving tail exactly once
+    ing.commit_tick()
+    committed_rows.extend(tail_rows)
+    assert runner.execute(
+        "select k, v from mem.default.ev order by k, v"
+    ).rows() == sorted(committed_rows)
+
+
+# --------------------------------------------------- materialized views
+
+
+def _mv_setup(lane, mv_sql=None):
+    runner, mem, ing, wal = lane
+    runner.execute(
+        mv_sql
+        or (
+            "create materialized view mem.default.mv as "
+            "select k, sum(v) as sv, count(*) as c, min(v) as mn, "
+            "max(v) as mx, avg(w) as aw "
+            "from mem.default.ev group by k"
+        )
+    )
+    return runner, mem, ing
+
+
+def test_incremental_vs_full_bit_equality_each_aggregate(lane):
+    """Every eligible aggregate (SUM/COUNT/MIN/MAX/AVG) maintained
+    incrementally across commits equals a full refresh bit-for-bit —
+    and equals the engine running the defining query directly."""
+    runner, _mem, ing = _mv_setup(lane)
+    batches = [
+        {"k": [1, 1, 2], "v": [10, 20, 5], "w": [1.0, 3.0, 0.5]},
+        {"k": [2, 3], "v": [7, 100], "w": [2.5, 4.0]},
+        {"k": [1, 3, 3], "v": [1, 2, 3], "w": [0.0, 8.0, 4.0]},
+    ]
+    for b in batches:
+        ing.append("mem.default.ev", columns=b)
+        ing.commit_tick()
+    mv = runner.mview_registry.lookup(("mem", "default", "mv"))
+    assert mv.eligible and mv.incremental_refreshes == 3
+    incremental = runner.execute(
+        "select * from mem.default.mv order by k"
+    ).rows()
+    direct = runner.execute(
+        "select k, sum(v), count(*), min(v), max(v), avg(w) "
+        "from mem.default.ev group by k order by k"
+    ).rows()
+    assert incremental == direct
+    # full refresh over the same base: bit-identical stored contents
+    runner.execute("refresh materialized view mem.default.mv")
+    assert mv.last_mode == "full"
+    full = runner.execute(
+        "select * from mem.default.mv order by k"
+    ).rows()
+    assert full == incremental
+
+
+def test_new_groups_appear_incrementally(lane):
+    runner, _mem, ing = _mv_setup(lane)
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [10], "w": [1.0]},
+    )
+    ing.commit_tick()
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [9], "v": [90], "w": [9.0]},
+    )
+    ing.commit_tick()
+    assert runner.execute(
+        "select k, sv from mem.default.mv order by k"
+    ).rows() == [(1, 10), (9, 90)]
+
+
+def test_where_clause_applies_to_delta(lane):
+    runner, _mem, ing = _mv_setup(
+        lane,
+        "create materialized view mem.default.mv as "
+        "select k, sum(v) as sv from mem.default.ev "
+        "where v >= 10 group by k",
+    )
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1, 1], "v": [5, 50], "w": [0.0, 0.0]},
+    )
+    ing.commit_tick()
+    assert runner.execute(
+        "select * from mem.default.mv"
+    ).rows() == [(1, 50)]
+    mv = runner.mview_registry.lookup(("mem", "default", "mv"))
+    assert mv.eligible and mv.incremental_refreshes == 1
+
+
+def test_ineligible_view_falls_back_to_full_refresh(lane):
+    """A join view still materializes, but every maintenance event is
+    a full recompute (and says so in the runtime view)."""
+    runner, _mem, ing = _mv_setup(
+        lane,
+        "create materialized view mem.default.mvj as "
+        "select r_name, count(*) as c "
+        "from mem.default.ev, tpch.tiny.region "
+        "where k = r_regionkey group by r_name",
+    )
+    mv = runner.mview_registry.lookup(("mem", "default", "mvj"))
+    assert not mv.eligible and mv.reason
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [0, 0, 1], "v": [1, 2, 3], "w": [0.0] * 3},
+    )
+    ing.commit_tick()
+    assert mv.incremental_refreshes == 0 and mv.refreshes == 2
+    assert runner.execute(
+        "select r_name, c from mem.default.mvj order by r_name"
+    ).rows() == runner.execute(
+        "select r_name, count(*) from mem.default.ev, tpch.tiny.region "
+        "where k = r_regionkey group by r_name order by r_name"
+    ).rows()
+
+
+def test_full_refresh_covers_racing_delta_exactly_once(lane):
+    """The double-apply guard: a full refresh that read the base
+    at/after commit sid already contains that delta — a late merger
+    for the same sid must skip, not double-count."""
+    runner, _mem, ing = _mv_setup(
+        lane,
+        "create materialized view mem.default.mv as "
+        "select k, sum(v) as sv from mem.default.ev group by k",
+    )
+    reg = runner.mview_registry
+    mv = reg.lookup(("mem", "default", "mv"))
+    delta = {"k": [1], "v": [10], "w": [1.0]}
+    ing.append("mem.default.ev", columns=delta)
+    ing.commit_tick()  # merges normally; last_snapshot == 1
+    assert mv.last_snapshot == 1
+    # a straggling merge for an ALREADY-COVERED sid must be a no-op
+    reg._incremental_refresh(mv, delta, 1)
+    assert runner.execute(
+        "select sv from mem.default.mv where k = 1"
+    ).rows() == [(10,)]
+    # and a REFRESH samples the covered snapshot so the guard holds
+    runner.execute("refresh materialized view mem.default.mv")
+    assert mv.last_snapshot == 1
+    reg._incremental_refresh(mv, delta, 1)
+    assert runner.execute(
+        "select sv from mem.default.mv where k = 1"
+    ).rows() == [(10,)]
+
+
+def test_incremental_disabled_forces_full(lane):
+    runner, _mem, ing = _mv_setup(lane)
+    runner.mview_registry.incremental_enabled = False
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [10], "w": [1.0]},
+    )
+    ing.commit_tick()
+    mv = runner.mview_registry.lookup(("mem", "default", "mv"))
+    assert mv.incremental_refreshes == 0 and mv.last_mode == "full"
+    assert runner.execute(
+        "select k, sv from mem.default.mv"
+    ).rows() == [(1, 10)]
+
+
+def test_staleness_read_gate_refreshes_legacy_writes(lane):
+    """A base written through the LEGACY path (no commit hook) leaves
+    the view stale; the read gate refreshes it in-line once the
+    staleness bound expires."""
+    runner, _mem, _ing = lane[0], lane[1], lane[2]
+    runner.execute(
+        "create materialized view mem.default.mv as "
+        "select k, sum(v) as sv from mem.default.ev group by k"
+    )
+    reg = runner.mview_registry
+    runner.execute("insert into mem.default.ev values (1, 10, 1.0)")
+    # gate off: the view stays stale
+    assert runner.execute("select * from mem.default.mv").rows() == []
+    # gate on with a tiny bound: the next read refreshes first
+    reg.max_staleness_s = 0.01
+    time.sleep(0.05)
+    assert runner.execute(
+        "select * from mem.default.mv"
+    ).rows() == [(1, 10)]
+    mv = reg.lookup(("mem", "default", "mv"))
+    assert mv.refreshes == 2  # create + the gate's refresh
+    # fresh view within the bound: no extra refresh on re-read
+    reg.max_staleness_s = 3600.0
+    runner.execute("insert into mem.default.ev values (2, 20, 2.0)")
+    assert runner.execute(
+        "select * from mem.default.mv order by k"
+    ).rows() == [(1, 10)]
+    assert mv.refreshes == 2
+
+
+def test_gate_repairs_view_after_legacy_write_between_commits(lane):
+    """A legacy INSERT between ingest commits rides into the next
+    snapshot but NOT into the incremental delta: the merge must not
+    mark the view fresh for it (epoch attribution), so the staleness
+    gate still repairs the divergence."""
+    runner, _mem, ing = _mv_setup(
+        lane,
+        "create materialized view mem.default.mv as "
+        "select k, sum(v) as sv from mem.default.ev group by k",
+    )
+    reg = runner.mview_registry
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [10], "w": [0.0]},
+    )
+    ing.commit_tick()
+    # LEGACY write between commits: no commit hook, no delta
+    runner.execute("insert into mem.default.ev values (2, 20, 0.0)")
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [3], "v": [30], "w": [0.0]},
+    )
+    ing.commit_tick()
+    # the merge carried only the ingest delta — k=2 is missing, and
+    # the view must still be CONSIDERED stale (not masked as fresh)
+    assert runner.execute(
+        "select k, sv from mem.default.mv order by k"
+    ).rows() == [(1, 10), (3, 30)]
+    reg.max_staleness_s = 0.01
+    time.sleep(0.05)
+    assert runner.execute(
+        "select k, sv from mem.default.mv order by k"
+    ).rows() == [(1, 10), (2, 20), (3, 30)]
+
+
+def test_drop_materialized_view(lane):
+    runner, _mem, _ing = _mv_setup(lane)
+    runner.execute("drop materialized view mem.default.mv")
+    assert runner.mview_registry.lookup(
+        ("mem", "default", "mv")
+    ) is None
+    with pytest.raises(Exception):
+        runner.execute("select * from mem.default.mv")
+    # IF EXISTS is quiet
+    runner.execute(
+        "drop materialized view if exists mem.default.mv"
+    )
+
+
+def test_mview_definition_survives_replay(lane):
+    runner, _mem, ing = _mv_setup(lane)
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1, 2], "v": [10, 20], "w": [1.0, 2.0]},
+    )
+    ing.commit_tick()
+    wal = lane[3]
+    runner2, _mem2 = fresh_runner()
+    IngestManager(runner2, wal, start_thread=False)
+    mv = runner2.mview_registry.lookup(("mem", "default", "mv"))
+    assert mv is not None and mv.last_mode == "replay"
+    assert runner2.execute(
+        "select k, sv from mem.default.mv order by k"
+    ).rows() == [(1, 10), (2, 20)]
+
+
+def test_replay_without_catalog_preserves_seq_watermarks(tmp_path):
+    """A WAL whose catalog is not mounted at replay cannot restore its
+    data, but the lane's seq/committed watermarks MUST restore — a
+    later append reusing a committed seq would make the next replay
+    promote the wrong batch to committed."""
+    wal = str(tmp_path)
+    runner, mem = fresh_runner()
+    make_events(mem)
+    ing = IngestManager(runner, wal, start_thread=False)
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [111], "w": [0.0]},
+    )
+    ing.commit_tick()
+    # boot 2: mem catalog NOT mounted — data unrestorable, watermarks
+    # preserved
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    r2 = LocalQueryRunner(catalogs=catalogs)
+    ing2 = IngestManager(r2, wal, start_thread=False)
+    lane_obj = ing2._lane(TableHandle("mem", "default", "ev"))
+    assert lane_obj.seq == 1 and lane_obj.committed == 1
+    # late-mounted catalog: new appends mint FRESH seqs past the
+    # committed watermark
+    mem2 = create_connector("memory")
+    make_events(mem2)
+    r2.catalogs.register("mem", mem2)
+    out = ing2.append(
+        "mem.default.ev",
+        columns={"k": [2], "v": [222], "w": [0.0]},
+    )
+    assert out["seq"] == 2
+    ing2.commit_tick()
+    # boot 3 with the catalog mounted: both batches exactly once
+    runner3, _mem3 = fresh_runner()
+    IngestManager(runner3, wal, start_thread=False)
+    assert runner3.execute(
+        "select k, v from mem.default.ev order by k"
+    ).rows() == [(1, 111), (2, 222)]
+
+
+def test_replay_applies_committed_into_recreated_empty_table(tmp_path):
+    """The idempotent-setup pattern: an embedder re-runs CREATE TABLE
+    on the fresh store before recovery. An existing-but-EMPTY table
+    must still get its committed WAL rows back (only a table WITH
+    data is assumed live)."""
+    wal = str(tmp_path)
+    runner, mem = fresh_runner()
+    make_events(mem)
+    ing = IngestManager(runner, wal, start_thread=False)
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [111], "w": [0.0]},
+    )
+    ing.commit_tick()
+    runner2, mem2 = fresh_runner()
+    make_events(mem2)  # re-created EMPTY before the manager constructs
+    IngestManager(runner2, wal, start_thread=False)
+    assert runner2.execute(
+        "select k, v from mem.default.ev"
+    ).rows() == [(1, 111)]
+
+
+def test_failed_merge_poisons_incremental_until_full_refresh(lane):
+    """A merge that dies loses its drained deltas: the view must NOT
+    keep merging on top of the hole — the next maintenance event falls
+    back to a full refresh and heals it (dirty flag)."""
+    runner, _mem, ing = _mv_setup(
+        lane,
+        "create materialized view mem.default.mv as "
+        "select k, sum(v) as sv from mem.default.ev group by k",
+    )
+    reg = runner.mview_registry
+    mv = reg.lookup(("mem", "default", "mv"))
+    orig = reg._merge_one_delta
+    def boom(*a, **k):
+        raise RuntimeError("injected merge failure")
+    reg._merge_one_delta = boom
+    try:
+        ing.append(
+            "mem.default.ev",
+            columns={"k": [1], "v": [10], "w": [0.0]},
+        )
+        ing.commit_tick()  # maintenance error absorbed by the lane
+    finally:
+        reg._merge_one_delta = orig
+    assert mv.dirty  # the hole is recorded
+    assert int(
+        REGISTRY.counter("mview.maintenance_errors").total
+    ) >= 1
+    # next commit repairs via FULL refresh, then incremental resumes
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [2], "v": [20], "w": [0.0]},
+    )
+    ing.commit_tick()
+    assert not mv.dirty and mv.last_mode == "full"
+    assert runner.execute(
+        "select k, sv from mem.default.mv order by k"
+    ).rows() == [(1, 10), (2, 20)]
+    ing.append(
+        "mem.default.ev",
+        columns={"k": [1], "v": [5], "w": [0.0]},
+    )
+    ing.commit_tick()
+    assert mv.last_mode == "incremental"
+    assert runner.execute(
+        "select k, sv from mem.default.mv order by k"
+    ).rows() == [(1, 15), (2, 20)]
+
+
+# -------------------------------------------- server + runtime surface
+
+
+def test_coordinator_endpoint_and_runtime_views(tmp_path):
+    from presto_tpu.server.coordinator import CoordinatorServer
+    from presto_tpu.session import NodeConfig
+
+    cfg = NodeConfig(
+        {
+            "ingest.wal-path": str(tmp_path),
+            "ingest.commit-interval-ms": "0",  # explicit commits only
+            "mview.max-staleness-s": "30",
+            "mview.incremental-enabled": "true",
+        }
+    )
+    coord = CoordinatorServer(config=cfg).start()
+    try:
+        coord.local.catalogs.register("mem", create_connector("memory"))
+        coord.local.execute(
+            "create table mem.default.ev (k bigint, v bigint)"
+        )
+        coord.local.execute(
+            "create materialized view mem.default.mv as "
+            "select k, sum(v) as sv from mem.default.ev group by k"
+        )
+        req = urllib.request.Request(
+            coord.uri + "/v1/ingest/mem.default.ev",
+            data=json.dumps(
+                {
+                    "rows": [{"k": 1, "v": 10}, {"k": 2, "v": 7}],
+                    "commit": True,
+                }
+            ).encode(),
+        )
+        out = json.load(urllib.request.urlopen(req))
+        assert out["rows"] == 2 and out["committed"]
+        assert coord.local.execute(
+            "select * from mem.default.mv order by k"
+        ).rows() == [(1, 10), (2, 7)]
+        # columnar form + rejection of a bad column
+        req = urllib.request.Request(
+            coord.uri + "/v1/ingest/mem.default.ev",
+            data=json.dumps(
+                {"columns": {"k": [3], "v": [1]}, "commit": True}
+            ).encode(),
+        )
+        assert json.load(urllib.request.urlopen(req))["rows"] == 1
+        bad = urllib.request.Request(
+            coord.uri + "/v1/ingest/mem.default.ev",
+            data=json.dumps({"columns": {"bogus": [1]}}).encode(),
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad)
+        assert ei.value.code == 400
+        # runtime views
+        rtv = coord.local.execute(
+            "select view, base_table, eligible, last_refresh_mode, "
+            "incremental_refreshes "
+            "from system.runtime.materialized_views"
+        ).rows()
+        assert rtv == [
+            ("mem.default.mv", "mem.default.ev", True, "incremental", 2)
+        ]
+        caches = dict(
+            (r[0], r)
+            for r in coord.local.execute(
+                "select cache, entries, hits "
+                "from system.runtime.caches"
+            ).rows()
+        )
+        assert "ingest.wal" in caches
+        assert caches["ingest.wal"][2] >= 2  # commits as hits
+        # metrics flowed
+        names = {
+            n
+            for n, _k, _v in REGISTRY.snapshot()
+        }
+        for expect in (
+            "ingest.batches.total",
+            "ingest.rows.total",
+            "ingest.wal_bytes.total",
+            "ingest.commit_ms.count",
+            "mview.refreshes.total",
+            "mview.incremental_refreshes.total",
+            "mview.rows_delta.total",
+            "mview.staleness_ms.count",
+        ):
+            assert expect in names, expect
+    finally:
+        coord.shutdown()
+
+
+def test_endpoint_without_lane_is_503(tmp_path):
+    from presto_tpu.server.coordinator import CoordinatorServer
+
+    coord = CoordinatorServer().start()
+    try:
+        req = urllib.request.Request(
+            coord.uri + "/v1/ingest/mem.default.ev",
+            data=b"{}",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 503
+    finally:
+        coord.shutdown()
+
+
+@pytest.mark.slow
+def test_commit_loop_drives_visibility(tmp_path):
+    """The background commit loop (no explicit flush) folds pending
+    batches and maintains the view."""
+    runner, mem = fresh_runner()
+    make_events(mem)
+    ing = IngestManager(
+        runner, str(tmp_path), commit_interval_ms=20.0
+    )
+    try:
+        runner.execute(
+            "create materialized view mem.default.mv as "
+            "select k, sum(v) as sv from mem.default.ev group by k"
+        )
+        ing.append(
+            "mem.default.ev",
+            columns={"k": [1], "v": [10], "w": [1.0]},
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if runner.execute(
+                "select * from mem.default.mv"
+            ).rows() == [(1, 10)]:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                "commit loop never surfaced the batch in the view"
+            )
+    finally:
+        ing.close()

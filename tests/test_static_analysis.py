@@ -81,6 +81,7 @@ def test_every_rule_registered(repo_findings):
         "dynfilter-confinement",
         "attempt-ids",
         "journal-sites",
+        "ingest-frames",
         "reserve-sites",
         "metric-names",
     ):
@@ -618,6 +619,63 @@ def test_serving_batch_rule_flags_rogue_sites(tmp_path):
     found = analysis.run_passes(str(tmp_path), rules=["serving-batch"])
     assert len(found) == 6
     assert all(f.rule == "serving-batch" for f in found)
+
+
+def test_ingest_frames_rule_flags_rogue_sites(tmp_path):
+    """The streaming-ingest lane's privileged constructs flag outside
+    server/ingest.py: WAL frame construction/parsing, the on-disk
+    ``wal-`` segment prefix, and commit_snapshot (snapshot-id
+    minting)."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            line = _wal_frame(payload)
+            rec = _parse_wal_line(raw)
+            name = "wal-mem.default.ev.jsonl"
+            n = conn.commit_snapshot(handle, delta, 7)
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["ingest-frames"])
+    assert len(found) == 4
+    assert all(f.rule == "ingest-frames" for f in found)
+
+
+def test_ingest_frames_rule_clean_fixtures(tmp_path):
+    """The audited module itself, attribute reads, and unrelated
+    strings never flag — and the REPO is clean under the rule (frames
+    and minting really are confined)."""
+    mod = tmp_path / "server" / "ingest.py"
+    mod.parent.mkdir()
+    mod.write_text(
+        textwrap.dedent(
+            """
+            def _wal_frame(payload):
+                return payload
+
+            def commit(conn, handle, delta):
+                line = _wal_frame("x")
+                path = "wal-a.b.c.jsonl"
+                return conn.commit_snapshot(handle, delta, 1)
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(conn, handle):
+                # reads of the audited names are fine
+                can = hasattr(conn, "commit_snapshot")
+                sid = conn.current_snapshot_id(handle)
+                pinned = conn.pin_snapshot(handle)
+                s = "walrus-operator"  # not the wal- prefix
+                return can, sid, pinned, s
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["ingest-frames"]
+    )
 
 
 def test_serving_batch_rule_clean_fixture(tmp_path):

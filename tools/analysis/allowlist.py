@@ -86,6 +86,40 @@ BLOCKING_ALLOWLIST = [
         "half-deleted attempt)",
     ),
     Allow(
+        "server/ingest.py",
+        "IngestManager.append",
+        "open",
+        "the lane lock exists to serialize exactly this append: "
+        "on-disk batch-frame order must equal seq order or replay "
+        "re-admits the wrong tail (same invariant as the coordinator "
+        "journal)",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager._flush_lane",
+        "open",
+        "the commit frame is the durability point AND the snapshot-id "
+        "mint: it must land strictly after every batch frame it "
+        "covers and strictly ordered against concurrent appends — "
+        "the lane lock guards exactly that ordering",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.record_mview",
+        "open",
+        "the mview-definition log lock exists to serialize exactly "
+        "this append: interleaved create/drop frames would replay "
+        "into the wrong live-view set",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.record_mview_drop",
+        "open",
+        "drop frames serialize against create frames under the same "
+        "log lock (see record_mview); replay order is the live-view "
+        "set",
+    ),
+    Allow(
         "server/spool.py",
         "ExchangeSpool._read_frames",
         "open",

@@ -312,6 +312,74 @@ def journal_pass(modules: List[core.Module], src_dir: str):
     return findings
 
 
+# -------------------------------------------------------------- ingest
+
+_INGEST = "server/ingest.py"
+
+
+@core.register(
+    "ingest-frames",
+    "WAL frame construction/parse and snapshot-id minting confined to "
+    "server/ingest.py (replay + snapshot-isolation correctness)",
+)
+def ingest_pass(modules: List[core.Module], src_dir: str):
+    """The streaming-ingest twin of ``journal-sites``: the WAL frame
+    helpers (``_wal_frame``/``_parse_wal_line``), the on-disk ``wal-``
+    segment-name prefix, and ``commit_snapshot`` — the one call that
+    registers a MINTED snapshot id against a connector — stay inside
+    server/ingest.py. An ad-hoc frame writer elsewhere would silently
+    break replay; a second id minter would let two commit paths hand
+    readers conflicting versions."""
+    findings = []
+    for mod in modules:
+        frame_ok = mod.rel == _INGEST
+        for node in mod.nodes:
+            if isinstance(node, ast.Call):
+                term = core.terminal_name(node.func)
+                if not frame_ok and term in (
+                    "_wal_frame",
+                    "_parse_wal_line",
+                ):
+                    findings.append(
+                        mod.finding(
+                            "ingest-frames",
+                            node.lineno,
+                            f"WAL frame internal {term}() outside "
+                            "server/ingest.py",
+                        )
+                    )
+                elif (
+                    term == "commit_snapshot"
+                    and isinstance(node.func, ast.Attribute)
+                    and not frame_ok
+                ):
+                    findings.append(
+                        mod.finding(
+                            "ingest-frames",
+                            node.lineno,
+                            "commit_snapshot() outside the ingest "
+                            "lane — snapshot ids are minted (and made "
+                            "durable) only by server/ingest.py's "
+                            "commit frames",
+                        )
+                    )
+            elif (
+                not frame_ok
+                and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith("wal-")
+            ):
+                findings.append(
+                    mod.finding(
+                        "ingest-frames",
+                        node.lineno,
+                        "ingest WAL segment-name prefix outside "
+                        "server/ingest.py",
+                    )
+                )
+    return findings
+
+
 # ------------------------------------------------------------- reserve
 
 _RESERVE_ALLOWED = {
