@@ -690,6 +690,165 @@ def _streaming_ingest_line(backend: str) -> dict:
     }
 
 
+def _qos_line(backend: str) -> dict:
+    """Tail-latency QoS measurement (the QoS-plane PR): interactive
+    point-lookup p99 WITH a concurrent analytic scan load in the same
+    cluster, qos-on vs qos-off, against the idle (no-load) p99. The
+    QoS plane's promise is that priority lanes + preempt-and-resume
+    hold interactive latency while batch work shares the cluster:
+    contract ``qos-on p99 <= 2x idle p99`` (the qos-off number is
+    reported beside it to show the degradation the plane removes).
+    Backend-tagged; a cluster that cannot boot emits a ``skipped``
+    line, never a fake zero."""
+    import tempfile
+    import threading
+
+    from presto_tpu.server import CoordinatorServer, WorkerServer
+    from presto_tpu.session import NodeConfig
+
+    lookups = 24
+    lookup_sql = (
+        "select c_name from tpch.tiny.customer where c_custkey = 7"
+    )
+    scan_sql = (
+        "select l_returnflag, sum(l_quantity) as q, "
+        "sum(l_extendedprice) as p from tpch.tiny.lineitem "
+        "group by l_returnflag"
+    )
+    groups = {
+        "rootGroups": [
+            {
+                "name": "interactive",
+                "weight": 1,
+                "hardConcurrencyLimit": 4,
+                "priority": 10,
+            },
+            {
+                "name": "batch",
+                "weight": 1,
+                "hardConcurrencyLimit": 4,
+                "priority": 0,
+            },
+        ],
+        "selectors": [{"user": "inter-.*", "group": "interactive"}],
+        "defaultGroup": "batch",
+    }
+
+    def boot(td: str, qos_on: bool):
+        cfg = {"exchange.spool-path": td + "/spool", "retry-policy": "TASK"}
+        if qos_on:
+            cfg.update(
+                {
+                    "qos.enabled": "true",
+                    "qos.resume-grace-s": "0.1",
+                    "qos.interactive.target-p99-ms": "500",
+                }
+            )
+        node = NodeConfig(cfg)
+        coord = CoordinatorServer(
+            config=node,
+            max_concurrent_queries=2,
+            resource_groups=groups,
+        ).start()
+        workers = []
+        try:
+            for _ in range(2):
+                workers.append(
+                    WorkerServer(
+                        coordinator_uri=coord.uri, config=node
+                    ).start()
+                )
+            deadline = time.monotonic() + 15
+            while (
+                time.monotonic() < deadline
+                and len(coord.active_workers()) < 2
+            ):
+                time.sleep(0.05)
+        except BaseException:
+            # a half-booted cluster must not outlive the skip line
+            for w in workers:
+                w.shutdown(graceful=False)
+            coord.shutdown()
+            raise
+        return coord, workers
+
+    def measure(coord, with_load: bool):
+        stop = threading.Event()
+
+        def load_loop():
+            while not stop.is_set():
+                q = coord.submit(scan_sql, user="batch-1")
+                q.done.wait(60)
+
+        loaders = (
+            [threading.Thread(target=load_loop) for _ in range(2)]
+            if with_load
+            else []
+        )
+        for t in loaders:
+            t.start()
+        if with_load:
+            time.sleep(0.5)  # let the scan load occupy the cluster
+        lat = []
+        try:
+            for _ in range(lookups):
+                t0 = time.monotonic()
+                q = coord.submit(lookup_sql, user="inter-1")
+                q.done.wait(60)
+                if q.state == "FINISHED":
+                    lat.append((time.monotonic() - t0) * 1000.0)
+        finally:
+            stop.set()
+            for t in loaders:
+                t.join(120)
+        lat.sort()
+        if not lat:
+            raise RuntimeError("no interactive lookups completed")
+        return (
+            lat[len(lat) // 2],
+            lat[min(len(lat) - 1, int(0.99 * len(lat)))],
+        )
+
+    def run_cluster(qos_on: bool, with_idle: bool):
+        with tempfile.TemporaryDirectory() as td:
+            coord, workers = boot(td, qos_on)
+            try:
+                idle = measure(coord, with_load=False) if with_idle else None
+                loaded = measure(coord, with_load=True)
+                susp = (
+                    int(
+                        sum(
+                            r["suspensions"]
+                            for r in coord.qos.view_rows()
+                        )
+                    )
+                    if coord.qos is not None
+                    else 0
+                )
+                return idle, loaded, susp
+            finally:
+                for w in workers:
+                    w.shutdown(graceful=False)
+                coord.shutdown()
+
+    idle, on_loaded, suspensions = run_cluster(qos_on=True, with_idle=True)
+    _, off_loaded, _ = run_cluster(qos_on=False, with_idle=False)
+    return {
+        "metric": "qos_interactive_p99_under_scan",
+        "value": round(on_loaded[1], 1),
+        "unit": "ms",
+        "idle_p50_ms": round(idle[0], 1),
+        "idle_p99_ms": round(idle[1], 1),
+        "qos_on_p50_ms": round(on_loaded[0], 1),
+        "qos_on_p99_ms": round(on_loaded[1], 1),
+        "qos_off_p99_ms": round(off_loaded[1], 1),
+        "suspensions": suspensions,
+        "lookups": lookups,
+        "contract_ok": on_loaded[1] <= 2.0 * idle[1],
+        "backend": backend,
+    }
+
+
 def _probe_backend() -> str:
     """Run a real tiny computation — trace + compile + execute + fetch,
     the full dispatch path a query exercises (an if, not an assert:
@@ -877,6 +1036,18 @@ def main() -> None:
             print(
                 json.dumps(
                     skip_line("streaming_ingest_mview_qps", e)
+                ),
+                flush=True,
+            )
+        # tail-latency QoS: interactive point-lookup p99 with a
+        # concurrent analytic scan load, qos-on vs qos-off — the
+        # contract is qos-on p99 <= 2x idle p99
+        try:
+            print(json.dumps(_qos_line(backend)), flush=True)
+        except Exception as e:
+            print(
+                json.dumps(
+                    skip_line("qos_interactive_p99_under_scan", e, "ms")
                 ),
                 flush=True,
             )

@@ -108,6 +108,24 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "misses": T.BIGINT,
             "evictions": T.BIGINT,
         },
+        # tail-latency QoS plane (server/qos.py): one row per
+        # admission lane member — priority, SLO target, live
+        # running/queued/suspended occupancy, p50/p99 latency
+        # reservoir, and suspension/resume/SLO-miss counters
+        "qos": {
+            "group": T.VARCHAR,
+            "priority": T.BIGINT,
+            "target_p99_ms": T.DOUBLE,
+            "queries": T.BIGINT,
+            "running": T.BIGINT,
+            "queued": T.BIGINT,
+            "suspended": T.BIGINT,
+            "p50_ms": T.DOUBLE,
+            "p99_ms": T.DOUBLE,
+            "slo_misses": T.BIGINT,
+            "suspensions": T.BIGINT,
+            "resumes": T.BIGINT,
+        },
         # cluster memory governance (server/memory_arbiter.py): one
         # row per node (query_id '') + one per (node, query) holder,
         # plus KILLED rows for the arbiter's victim decisions
@@ -215,6 +233,12 @@ class SystemConnector(Connector):
             return reg.view_rows() if reg is not None else []
         if key == ("runtime", "memory"):
             return self._memory_rows()
+        if key == ("runtime", "qos"):
+            cluster = getattr(self._runner, "cluster", None)
+            qos = getattr(cluster, "qos", None) if cluster else None
+            # plane off (or plain local runner): an empty view, not an
+            # error — dashboards can always select from it
+            return qos.view_rows() if qos is not None else []
         if key == ("runtime", "query_history"):
             store = getattr(self._runner, "history_store", None)
             return store.snapshot() if store is not None else []

@@ -89,6 +89,24 @@ class PrestoTpuClient:
             resp = self._get_with_reconnect(nxt, deadline)
             self._absorb_prepared_headers(resp.headers)
             cur = resp.json()
+            # a SUSPENDED (QoS-parked) query answers polls immediately
+            # with empty data + a Retry-After hint: honor it so the
+            # poll loop idles gently instead of hammering the
+            # coordinator until resume
+            retry_after = resp.headers.get("Retry-After")
+            if retry_after and not cur.get("data") and cur.get(
+                "nextUri"
+            ):
+                try:
+                    time.sleep(
+                        min(
+                            float(retry_after),
+                            max(deadline - time.monotonic(), 0.0),
+                            2.0,
+                        )
+                    )
+                except ValueError:
+                    pass
 
     def _get_with_reconnect(self, url: str, deadline: float):
         """One nextUri GET with transparent reconnect: a coordinator

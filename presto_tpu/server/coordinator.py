@@ -621,6 +621,17 @@ class CoordinatorServer:
         from presto_tpu.server.memory_arbiter import ClusterMemoryArbiter
 
         self.arbiter = ClusterMemoryArbiter(self, config)
+        # tail-latency QoS plane (server/qos.py): priority admission
+        # lanes + preempt-and-resume + per-group SLOs. Disabled
+        # (default) the controller is never constructed and admission
+        # stays the bit-exact legacy semaphore below
+        self.qos = None
+        if config and config.get("qos.enabled", False):
+            from presto_tpu.server.qos import QosController
+
+            self.qos = QosController(
+                self, config, max_concurrent_queries
+            )
 
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -1233,76 +1244,108 @@ class CoordinatorServer:
         return q
 
     def _execute_query(self, q: _Query) -> None:
-        with self._admit:  # admission gate: bounded concurrency
-            # admission high-water (cluster memory governance): while
-            # the cluster's query-attributed usage is over
-            # memory.admission-high-water, QUEUED queries are HELD —
-            # never failed — and release on the low-water hysteresis
-            while (
-                not q.done.is_set()
-                and not self._shutting_down
-                and self.arbiter.admission_held()
-            ):
-                q._admission_parked = True
-                time.sleep(0.05)
-            if q.done.is_set():  # killed while queued (memory manager)
-                with self._lock:
-                    self._pending -= 1
-                if (
-                    self.resource_groups is not None
-                    and getattr(q, "resource_group", None) is not None
-                ):
-                    self.resource_groups.finish(q.resource_group)
-                if self.journal is not None:
-                    self.journal.record_finish(q.qid, q.state)
-                return
-            q.state = "RUNNING"
-            q.stats.state = "RUNNING"
-            log.info(
-                "trace=%s query=%s state=RUNNING", q.trace.trace_id, q.qid
-            )
-            # pool reservations this thread makes are owned by THIS
-            # query id (one id space for holders, kills, and clients);
-            # the stats sink makes coordinator-local staging (gather
-            # splices, local fallback) pin the cache entries it
-            # executes over — released in the finally below
-            self.local._owner_override.value = q.qid
-            self.local._qs_local.value = q.stats
+        # admission gate: the QoS plane's priority lanes when enabled
+        # (strict-priority dequeue, weighted-fair within a lane,
+        # preempt-and-resume of lower-priority running work), else the
+        # legacy bounded semaphore — qos.enabled=false is bit-exact
+        # legacy admission
+        if self.qos is not None:
+            admitted = self.qos.qos_admit(q)
             try:
-                with REGISTRY.timer("coordinator.query_time").time():
-                    with q.trace.span("query", query_id=q.qid):
-                        self._run_sql_with_restart(q)
-                if not q.done.is_set():  # a killed query stays FAILED
-                    q.state = "FINISHED"
-            except Exception as e:
-                if not q.done.is_set():
-                    q.state = "FAILED"
-                    q.error = (
-                        f"{type(e).__name__}: {e}\n"
-                        f"{traceback.format_exc()[-1000:]}"
+                if not admitted and not q.done.is_set():
+                    # shutdown while lane-queued: never execute — fail
+                    # the query so _admitted_execute's queued-death
+                    # branch closes it out (pending count, group slot,
+                    # journal finish)
+                    q.fail(
+                        "Query rejected: coordinator shut down before "
+                        "admission"
                     )
-                REGISTRY.counter("coordinator.queries_failed").update()
+                    q.done.set()
+                self._admitted_execute(q)
             finally:
-                self._finish_query_stats(q)
-                self.local._owner_override.value = None
-                self.local._qs_local.value = None
-                self.local.release_pins(q.stats)
-                self.memory_pool.release(q.qid)
-                with self._lock:
-                    self._pending -= 1
-                if self.journal is not None:
-                    # terminal close-out BEFORE done is observable: a
-                    # restart must never re-admit a query whose client
-                    # already saw the outcome
-                    self.journal.record_finish(q.qid, q.state)
-                q.done.set()
-                if (
-                    self.resource_groups is not None
-                    and getattr(q, "resource_group", None) is not None
-                ):
-                    # frees the group slot and admits the next queued
-                    # query by weighted fairness
-                    self.resource_groups.finish(q.resource_group)
+                self.qos.qos_release(q)
+        else:
+            with self._admit:
+                self._admitted_execute(q)
+
+    def _qos_checkpoint(self, q: Optional[_Query]) -> None:
+        """Cooperative QoS suspension point (server/qos.py): a
+        suspended query's stage threads park here between ranges.
+        No-op when the plane is off."""
+        if self.qos is not None and q is not None:
+            self.qos.qos_checkpoint(q)
+
+    def _admitted_execute(self, q: _Query) -> None:
+        # admission high-water (cluster memory governance): while
+        # the cluster's query-attributed usage is over
+        # memory.admission-high-water, QUEUED queries are HELD —
+        # never failed — and release on the low-water hysteresis
+        while (
+            not q.done.is_set()
+            and not self._shutting_down
+            and self.arbiter.admission_held()
+        ):
+            q._admission_parked = True
+            time.sleep(0.05)
+        if q.done.is_set():  # killed while queued (memory manager)
+            with self._lock:
+                self._pending -= 1
+            if (
+                self.resource_groups is not None
+                and getattr(q, "resource_group", None) is not None
+            ):
+                self.resource_groups.finish(q.resource_group)
+            if self.journal is not None:
+                self.journal.record_finish(q.qid, q.state)
+            return
+        q.state = "RUNNING"
+        q.stats.state = "RUNNING"
+        log.info(
+            "trace=%s query=%s state=RUNNING", q.trace.trace_id, q.qid
+        )
+        # pool reservations this thread makes are owned by THIS
+        # query id (one id space for holders, kills, and clients);
+        # the stats sink makes coordinator-local staging (gather
+        # splices, local fallback) pin the cache entries it
+        # executes over — released in the finally below
+        self.local._owner_override.value = q.qid
+        self.local._qs_local.value = q.stats
+        try:
+            with REGISTRY.timer("coordinator.query_time").time():
+                with q.trace.span("query", query_id=q.qid):
+                    self._run_sql_with_restart(q)
+            if not q.done.is_set():  # a killed query stays FAILED
+                q.state = "FINISHED"
+        except Exception as e:
+            if not q.done.is_set():
+                q.state = "FAILED"
+                q.error = (
+                    f"{type(e).__name__}: {e}\n"
+                    f"{traceback.format_exc()[-1000:]}"
+                )
+            REGISTRY.counter("coordinator.queries_failed").update()
+        finally:
+            self._finish_query_stats(q)
+            self.local._owner_override.value = None
+            self.local._qs_local.value = None
+            self.local.release_pins(q.stats)
+            self.memory_pool.release(q.qid)
+            with self._lock:
+                self._pending -= 1
+            if self.journal is not None:
+                # terminal close-out BEFORE done is observable: a
+                # restart must never re-admit a query whose client
+                # already saw the outcome
+                self.journal.record_finish(q.qid, q.state)
+            q.done.set()
+            if (
+                self.resource_groups is not None
+                and getattr(q, "resource_group", None) is not None
+            ):
+                # frees the group slot and admits the next queued
+                # query by weighted fairness
+                self.resource_groups.finish(q.resource_group)
 
     def _run_sql_with_restart(self, q: _Query) -> None:
         """``retry_policy=QUERY``: a bounded full-query restart is the
@@ -1902,6 +1945,9 @@ class CoordinatorServer:
         info["error"] = q.error
         info["user"] = getattr(q, "user", None)
         info["resource_group"] = getattr(q, "resource_group", None)
+        if self.qos is not None:
+            # QoS plane: lane/SLO identity + suspension/resume counters
+            info["qos"] = self.qos.query_info(q)
         info["trace"] = q.trace.to_tree()
         return info
 
@@ -2286,6 +2332,9 @@ class CoordinatorServer:
         in the given root SortNode so workers emit sorted runs, and
         k-way merge the runs at the gather instead of re-sorting. The
         caller guarantees the stage has no aggregation cut."""
+        # QoS: stage boundaries are suspension points too — a query
+        # suspended between stages parks before scheduling the next
+        self._qos_checkpoint(q)
         jdt = str(
             self.local.session.get("join_distribution_type")
         ).upper()
@@ -3073,7 +3122,13 @@ class CoordinatorServer:
             v = durations.values()
             if v["count"] < 3:
                 return None  # too few samples to call a straggler
-            return max(spec_min, spec_mult * v["p50"])
+            th = max(spec_min, spec_mult * v["p50"])
+            if self.qos is not None and q is not None:
+                # deadline-aware speculation (server/qos.py): the
+                # threshold tightens as the query approaches its
+                # group's SLO budget
+                th *= self.qos.speculation_scale(q)
+            return th
 
         def spare_worker(tried_ids):
             # exclude BEFORE the breaker check: asking for a spare
@@ -3319,6 +3374,12 @@ class CoordinatorServer:
         def drain_worker(w):
             out = []
             while True:
+                # QoS preempt-and-resume: a suspended query's stage
+                # threads park HERE, between ranges — claimed ranges
+                # ran to completion (tasks exit clean, spool-backed
+                # producers committed), unclaimed ones wait out the
+                # suspension and re-run under fresh claims on resume
+                self._qos_checkpoint(q)
                 try:
                     lo, hi = range_q.get_nowait()
                 except _queue.Empty:
@@ -3562,9 +3623,34 @@ def _make_handler(coord: CoordinatorServer):
                 q = coord.lookup_query(qid)
                 if q is None:
                     return self._json(404, {"error": "no such query"})
+                if q.state == "SUSPENDED" and not q.done.is_set():
+                    # QoS preempt-and-resume: a parked query must not
+                    # hold its client on the long-poll — answer NOW
+                    # with empty data and a retry hint, keeping the
+                    # poll loop alive (and cheap) until resume
+                    return self._json(
+                        200,
+                        {
+                            "id": qid,
+                            "stats": {"state": "SUSPENDED"},
+                            "data": [],
+                            "nextUri": (
+                                f"{coord.uri}/v1/statement/{qid}/"
+                                f"{token}"
+                            ),
+                        },
+                        extra_headers={"Retry-After": "0.5"},
+                    )
                 # long-poll up to 1s for progress (reference: long-poll)
                 q.done.wait(timeout=1.0)
-                if q.state == "FAILED":
+                # q.error decides failure delivery alongside the state
+                # string: a rare suspension decision racing a kill can
+                # leave a non-FAILED state on a done-with-error query,
+                # and the client must still get the error, never an
+                # empty success page
+                if q.state == "FAILED" or (
+                    q.done.is_set() and q.error is not None
+                ):
                     q._drained = True  # error delivered: safe to evict
                     return self._json(
                         200,

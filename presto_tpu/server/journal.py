@@ -331,6 +331,41 @@ class CoordinatorJournal:
             }
         )
 
+    def record_suspend(
+        self,
+        qid: str,
+        spooled_attempts: int = 0,
+        running_stages: int = 0,
+        suspensions: int = 1,
+    ) -> None:
+        """One QoS preempt-and-resume suspension (server/qos.py):
+        pure audit trail, replay-inert — the parked query is still
+        OPEN (its submit frame has no finish), so a coordinator bounce
+        re-admits it exactly like any other non-terminal query. The
+        frame records the victim's spooled progress (committed
+        exchange-spool attempts + stages running at the decision), so
+        an operator can see what a resume will reuse."""
+        self._append(
+            {
+                "ev": "qos_suspend",
+                "qid": qid,
+                "spooled_attempts": int(spooled_attempts),
+                "running_stages": int(running_stages),
+                "suspensions": int(suspensions),
+            }
+        )
+
+    def record_resume(self, qid: str, suspended_ms: float = 0.0) -> None:
+        """The matching QoS resume close-out (audit trail, replay-
+        inert)."""
+        self._append(
+            {
+                "ev": "qos_resume",
+                "qid": qid,
+                "suspended_ms": float(suspended_ms),
+            }
+        )
+
     def record_prepare(self, name: str, sql: str) -> None:
         self._append({"ev": "prepare", "name": name, "sql": sql})
 

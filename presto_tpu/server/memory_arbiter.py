@@ -138,6 +138,18 @@ class ClusterMemoryArbiter:
         with self._lock:
             self._killed.discard(qid)
 
+    def suspend_release(self, qid: str) -> None:
+        """A QoS suspension (server/qos.py) released this query's
+        cluster reservation: drop its entries from the cached per-node
+        reports NOW — admission-hold and quota math must stop charging
+        a parked query immediately, not a heartbeat later. The
+        victim's still-draining tasks re-assert whatever they actually
+        hold on their next heartbeats, so accounting converges on
+        truth either way."""
+        with self._lock:
+            for rep in self._reports.values():
+                rep["queries"].pop(qid, None)
+
     def _live_reports(self) -> Dict[str, dict]:
         now = time.time()
         with self._lock:

@@ -49,6 +49,11 @@ class ResourceGroup:
     hard_concurrency_limit: int = 1 << 30
     max_queued: int = 100
     soft_memory_limit_bytes: Optional[int] = None
+    #: QoS admission lane (server/qos.py): higher-priority groups
+    #: dequeue strictly first at the coordinator's admission gate, and
+    #: may preempt-and-resume running lower-priority queries. Inert
+    #: unless qos.enabled (weighted fairness applies within a lane).
+    priority: int = 0
     running: int = 0
     queue: deque = dataclasses.field(default_factory=deque)
 
@@ -85,6 +90,7 @@ class ResourceGroupManager:
                     if "softMemoryLimit" in g
                     else None
                 ),
+                priority=int(g.get("priority", 0)),
             )
             if grp.weight <= 0:
                 raise ValueError(
@@ -195,6 +201,7 @@ class ResourceGroupManager:
                 {
                     "name": g.name,
                     "weight": g.weight,
+                    "priority": g.priority,
                     "running": g.running,
                     "queued": g.queued,
                     "hardConcurrencyLimit": g.hard_concurrency_limit,

@@ -170,6 +170,20 @@ class ExchangeSpool:
 
     # ------------------------------------------------------- consume side
 
+    def committed_for_query(self, query_id: str) -> int:
+        """Committed attempts belonging to one query — the "spooled
+        progress" a QoS suspension records in its journal frame
+        (server/qos.py): every counted attempt's partitions will serve
+        from the spool on resume instead of re-running, even if its
+        worker dies while the query is parked."""
+        prefix = query_id + "."
+        with self._lock:
+            return sum(
+                1
+                for fn in self._listdir()
+                if fn.endswith(".ok") and fn.startswith(prefix)
+            )
+
     def committed_attempts(self, logical_key: str) -> List[str]:
         """Committed attempt ids for one logical task, lowest attempt
         first (the deterministic dedup order)."""

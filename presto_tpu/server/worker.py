@@ -1250,6 +1250,10 @@ def _make_handler(worker: WorkerServer):
                         "state": t.state,
                         "error": t.error,
                         "num_pages": len(t.pages),
+                        # durable-copy flag: a FINISHED+spooled task's
+                        # output outlives this worker (drain protocol;
+                        # QoS suspend-progress accounting reads it too)
+                        "spooled": t.spooled,
                         "stats": t.stats.to_dict(),
                         "spans": t.spans,
                         "dynamic_filter": t.dynfilter,

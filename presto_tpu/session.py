@@ -17,6 +17,7 @@ dual-backend seam (SURVEY.md preamble) — same plans, different executor.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Callable, Dict, Optional
 
 
@@ -532,16 +533,35 @@ class NodeConfig:
         # (false = every maintenance event is a full refresh)
         "mview.max-staleness-s": float,
         "mview.incremental-enabled": bool,
+        # tail-latency QoS plane (server/qos.py): the master gate
+        # (false = bit-exact legacy admission), the post-resume grace
+        # during which a resumed query is immune to re-suspension, and
+        # the lifetime suspension cap per query. Per-group keys
+        # (qos.<group>.priority / qos.<group>.target-p99-ms) are
+        # accepted dynamically — see _QOS_GROUP_KEY below
+        "qos.enabled": bool,
+        "qos.resume-grace-s": float,
+        "qos.max-suspensions-per-query": int,
         # deterministic chaos: JSON FaultPlane spec (utils.faults)
         "fault-injection.spec": str,
     }
 
+    #: dynamic per-group QoS keys: qos.<group>.priority (int) and
+    #: qos.<group>.target-p99-ms (float) — group names are config
+    #: data, so they cannot enumerate in KNOWN
+    _QOS_GROUP_KEY = re.compile(
+        r"^qos\.([A-Za-z0-9_\-]+)\.(priority|target-p99-ms)$"
+    )
+
     def __init__(self, props: Optional[Dict[str, str]] = None):
         self.props: Dict[str, Any] = {}
         for k, v in (props or {}).items():
-            if k not in self.KNOWN:
-                raise KeyError(f"unknown config key: {k}")
-            t = self.KNOWN[k]
+            t = self.KNOWN.get(k)
+            if t is None:
+                m = self._QOS_GROUP_KEY.match(k)
+                if m is None:
+                    raise KeyError(f"unknown config key: {k}")
+                t = int if m.group(2) == "priority" else float
             self.props[k] = (
                 v.lower() == "true" if t is bool and isinstance(v, str) else t(v)
             )

@@ -32,6 +32,7 @@ Rule spec (all match fields optional; empty matches everything)::
         "count": 1},
        {"action": "mem_pressure", "node": "worker-ab",
         "budget": 65536},
+       {"action": "suspend_storm", "owner": "q_c1_", "count": 3},
      ]}
 
 ``count`` bounds how many times a rule fires (default unlimited),
@@ -68,6 +69,13 @@ SPOOL_ACTIONS = ("spool_corrupt",)
 #: crashes a worker WHILE it is draining — the drain protocol must
 #: stay recoverable mid-handshake
 DRAIN_ACTIONS = ("kill_worker_draining",)
+#: actions injected at the QoS checkpoint hook (server.qos):
+#: ``suspend_storm`` delivers a preemption trigger against the
+#: matched query at its next cooperative checkpoint — ``count: N``
+#: models N back-to-back interactive arrivals targeting one analytic
+#: query, which is how the controller's re-suspend hysteresis
+#: (``qos.resume-grace-s`` immunity after a resume) is tested
+QOS_ACTIONS = ("suspend_storm",)
 #: actions injected at the MemoryPool reserve hook (utils.memory):
 #: ``reserve_fail`` forces a pool reservation failure at the Nth
 #: matched reserve (skip/count bound it); ``mem_pressure`` shrinks the
@@ -112,6 +120,7 @@ class FaultRule:
             | set(SPOOL_ACTIONS)
             | set(DRAIN_ACTIONS)
             | set(MEM_ACTIONS)
+            | set(QOS_ACTIONS)
         )
         if rule.action not in known_actions:
             raise ValueError(f"unknown fault action: {rule.action!r}")
@@ -233,6 +242,23 @@ class FaultPlane:
                 return True
         return False
 
+    def on_qos(self, query_id: str) -> bool:
+        """QoS checkpoint hook (server.qos): True when a
+        ``suspend_storm`` rule fires for this query — the controller
+        treats it as one preemption trigger (suspend if hysteresis
+        allows, count it either way). ``owner`` matches the query id
+        by substring, like the reserve-hook rules."""
+        for rule in self.rules:
+            if rule.action not in QOS_ACTIONS:
+                continue
+            if rule.method or rule.url or rule.node or rule.task:
+                continue  # scoped rules stay in their own hooks
+            if rule.owner and rule.owner not in query_id:
+                continue
+            if self._fire(rule):
+                return True
+        return False
+
     def on_reserve(self, node_id: str, owner: str):
         """MemoryPool reserve hook: returns ``("reserve_fail", None)``
         when a reserve_fail rule fires (the pool raises its own
@@ -313,6 +339,13 @@ def maybe_inject_drain(node_id: str, kill=None) -> None:
     plane = _PLANE
     if plane is not None:
         plane.on_drain(node_id, kill=kill)
+
+
+def maybe_inject_qos(query_id: str) -> bool:
+    """QoS checkpoint hook (server.qos): True = one injected
+    preemption trigger against this query (``suspend_storm``)."""
+    plane = _PLANE
+    return plane is not None and plane.on_qos(query_id)
 
 
 def maybe_inject_reserve(node_id: str, owner: str):

@@ -1,0 +1,479 @@
+"""Tail-latency QoS plane (server/qos.py): priority admission lanes,
+preempt-and-resume of analytic queries, and per-group SLO enforcement.
+
+Chaos acceptance: an interactive burst preempts a running analytic
+join; the victim suspends through the drain+spool machinery (claimed
+ranges run to completion, spool-backed producers commit), resumes when
+the interactive lane drains, and finishes with results bit-identical
+to an unpreempted run — asserted via per-stage attempt counters (zero
+re-runs of completed producer tasks). ``qos.enabled`` unset keeps the
+coordinator's bit-exact legacy admission semaphore.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.server import CoordinatorServer, WorkerServer, task_ids
+from presto_tpu.session import NodeConfig
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY
+
+#: multi-stage TPC-H join forced onto the partitioned-producer path
+#: (the spool-backed stage shape preempt-and-resume targets)
+JOIN_SQL = (
+    "select o_orderpriority, count(*) as n "
+    "from tpch.tiny.orders, tpch.tiny.lineitem "
+    "where o_orderkey = l_orderkey "
+    "group by o_orderpriority order by o_orderpriority"
+)
+
+LOOKUP_SQL = "select count(*) as c from tpch.tiny.region"
+
+#: two lanes: interactive strictly above batch
+RESOURCE_GROUPS = {
+    "rootGroups": [
+        {
+            "name": "interactive",
+            "weight": 1,
+            "hardConcurrencyLimit": 4,
+            "priority": 10,
+        },
+        {
+            "name": "batch",
+            "weight": 1,
+            "hardConcurrencyLimit": 4,
+            "priority": 0,
+        },
+    ],
+    "selectors": [{"user": "inter-.*", "group": "interactive"}],
+    "defaultGroup": "batch",
+}
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    yield
+    faults.configure(None)
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+def _mk_cluster(tmp_path, n=2, policy="TASK", extra=None, slots=1):
+    cfg = {
+        "exchange.spool-path": str(tmp_path / "spool"),
+        "exchange.spool-bytes": "64MB",
+        "qos.enabled": "true",
+        "qos.resume-grace-s": "0.2",
+    }
+    cfg.update(extra or {})
+    coord = CoordinatorServer(
+        config=NodeConfig(dict(cfg)),
+        max_concurrent_queries=slots,
+        resource_groups=RESOURCE_GROUPS,
+    ).start()
+    coord.local.session.set("retry_policy", policy)
+    coord.local.session.set("join_distribution_type", "PARTITIONED")
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri, config=NodeConfig(dict(cfg))
+        ).start()
+        for _ in range(n)
+    ]
+    _wait_workers(coord, n)
+    return coord, workers
+
+
+def _teardown(coord, workers):
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def _wait_attr(q, attr, val, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if getattr(q, attr, 0) >= val:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _producer_reruns(info):
+    """(logical_key, attempts) of producer-stage tasks with more than
+    one attempt — the acceptance asserts this list is empty."""
+    out = []
+    for st in info["stages"]:
+        if st["kind"] != "producer":
+            continue
+        by = {}
+        for t in st["tasks"]:
+            by.setdefault(
+                task_ids.logical_key(t["task_id"]), []
+            ).append(t)
+        for lk, ts in by.items():
+            if len(ts) != 1:
+                out.append((lk, len(ts)))
+    return out
+
+
+# ------------------------------------------------------- fault rule
+
+
+def test_suspend_storm_rule_validation():
+    r = faults.FaultRule.from_dict(
+        {"action": "suspend_storm", "owner": "q_c1_", "count": 3}
+    )
+    assert r.action == "suspend_storm" and r.count == 3
+    with pytest.raises(ValueError):
+        faults.FaultRule.from_dict(
+            {"action": "suspend_storm", "victim": "q_c1_"}
+        )
+    with pytest.raises(ValueError):
+        faults.FaultRule.from_dict({"action": "suspend_tornado"})
+
+
+def test_suspend_storm_hook_matches_by_owner():
+    faults.configure(
+        {"rules": [{"action": "suspend_storm", "owner": "q_c7", "count": 1}]}
+    )
+    assert not faults.maybe_inject_qos("q_c9_aaaa")  # no match
+    assert faults.maybe_inject_qos("q_c7_bbbb")
+    assert not faults.maybe_inject_qos("q_c7_bbbb")  # count exhausted
+
+
+# ------------------------------------------------- off = legacy path
+
+
+def test_qos_disabled_is_legacy_admission():
+    """No qos.enabled: the controller never constructs and admission
+    is the legacy semaphore — and the runtime view is empty, not an
+    error."""
+    coord = CoordinatorServer(max_concurrent_queries=2)
+    try:
+        assert coord.qos is None
+        q = coord.submit(LOOKUP_SQL)
+        q.done.wait(60)
+        assert q.state == "FINISHED", q.error
+        res = coord.local.execute("select * from system.runtime.qos")
+        assert res.rows() == []
+        assert "qos" not in coord.query_info(q)
+    finally:
+        coord.shutdown()
+
+
+def test_resource_group_priority_parsed():
+    from presto_tpu.server.resource_groups import ResourceGroupManager
+
+    mgr = ResourceGroupManager(RESOURCE_GROUPS)
+    assert mgr.groups["interactive"].priority == 10
+    assert mgr.groups["batch"].priority == 0
+    snap = {g["name"]: g for g in mgr.snapshot()}
+    assert snap["interactive"]["priority"] == 10
+
+
+# ------------------------------------------------- admission lanes
+
+
+def test_priority_lane_ordering():
+    """With preemption off (max-suspensions 0), a queued interactive
+    query still dequeues BEFORE earlier-queued batch work: strict
+    priority across lanes."""
+    coord = CoordinatorServer(
+        config=NodeConfig(
+            {
+                "qos.enabled": "true",
+                "qos.max-suspensions-per-query": "0",
+            }
+        ),
+        max_concurrent_queries=1,
+        resource_groups=RESOURCE_GROUPS,
+    )
+    order = []
+    gate = threading.Event()
+    orig = coord._run_sql
+
+    def slow(q):
+        order.append(getattr(q, "resource_group", None))
+        gate.wait(timeout=30)
+        return orig(q)
+
+    coord._run_sql = slow
+    try:
+        assert coord.qos is not None
+        b1 = coord.submit(LOOKUP_SQL, user="batch-1")
+        time.sleep(0.3)  # b1 holds the one slot
+        b2 = coord.submit(LOOKUP_SQL, user="batch-2")
+        i1 = coord.submit(LOOKUP_SQL, user="inter-1")
+        time.sleep(0.3)
+        # preemption disabled: interactive waits, but dequeues first
+        assert order == ["batch"]
+        gate.set()
+        for q in (b1, b2, i1):
+            q.done.wait(60)
+            assert q.state == "FINISHED", (q.state, q.error)
+        assert order == ["batch", "interactive", "batch"]
+        assert getattr(b1, "qos_suspensions", 0) == 0
+    finally:
+        gate.set()
+        coord.shutdown()
+
+
+# --------------------------------------- preempt-and-resume acceptance
+
+
+def test_preempt_and_resume_bit_identical(tmp_path):
+    """The tentpole acceptance: an interactive burst suspends a running
+    analytic join through drain+spool, the victim parks SUSPENDED
+    (client polls answer immediately with empty data + Retry-After),
+    resumes when the interactive lane drains, and finishes with rows
+    bit-identical to the unpreempted run — with ZERO re-runs of
+    completed producer tasks (per-stage attempt counters)."""
+    coord, workers = _mk_cluster(
+        tmp_path,
+        extra={"coordinator.journal-path": str(tmp_path / "journal")},
+    )
+    try:
+        expected = [
+            tuple(r) for r in coord.local.execute(JOIN_SQL).rows()
+        ]
+        # slow the analytic's producer tasks and the interactive
+        # query's source tasks (to hold the suspension window open);
+        # neither rule touches the other query's task kinds
+        faults.configure(
+            {
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.25},
+                    {"action": "delay", "task": ".src.", "delay_s": 0.3},
+                ]
+            }
+        )
+        qa = coord.submit(JOIN_SQL, user="batch-1")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and qa.state != "RUNNING":
+            time.sleep(0.01)
+        time.sleep(0.4)  # let producer ranges get claimed
+        qi = coord.submit(LOOKUP_SQL, user="inter-1")
+        assert _wait_attr(qa, "qos_suspensions", 1), qa.state
+        # satellite: a SUSPENDED query's client poll answers NOW with
+        # empty data + a retry hint — it neither hangs until resume
+        # nor burns the 1s long-poll
+        t0 = time.monotonic()
+        with urllib.request.urlopen(
+            f"{coord.uri}/v1/statement/{qa.qid}/0", timeout=5
+        ) as resp:
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert resp.headers.get("Retry-After")
+        assert time.monotonic() - t0 < 0.8
+        assert body["data"] == []
+        assert body["stats"]["state"] == "SUSPENDED"
+        assert body["nextUri"].endswith("/0")  # same token: no progress lost
+        qi.done.wait(60)
+        assert qi.state == "FINISHED", qi.error
+        qa.done.wait(120)
+        assert qa.state == "FINISHED", qa.error
+        assert [tuple(r) for r in qa.rows] == expected
+        # zero re-runs of completed producer tasks: nothing died, so
+        # EVERY producer logical task must have exactly one attempt
+        info = coord.query_info(qa)
+        assert _producer_reruns(info) == []
+        # suspension/resume accounting: QueryInfo + the runtime view
+        assert info["qos"]["suspensions"] >= 1
+        assert info["qos"]["resumes"] >= 1
+        assert getattr(qa, "qos_suspended_ms", 0.0) > 0.0
+        rows = {
+            r[0]: r
+            for r in coord.local.execute(
+                'select "group", suspensions, resumes, queries '
+                "from system.runtime.qos"
+            ).rows()
+        }
+        assert rows["batch"][1] >= 1 and rows["batch"][2] >= 1
+        assert rows["interactive"][3] >= 1
+        # the journal carries the suspend/resume audit frames (replay-
+        # inert: both queries also have terminal finish frames)
+        text = "".join(
+            open(os.path.join(tmp_path / "journal", f)).read()
+            for f in os.listdir(tmp_path / "journal")
+        )
+        assert '"qos_suspend"' in text and '"qos_resume"' in text
+    finally:
+        _teardown(coord, workers)
+
+
+def test_suspend_storm_hysteresis(tmp_path):
+    """N back-to-back preemption triggers against one query (the
+    ``suspend_storm`` fault rule) suspend it exactly ONCE: after the
+    resume, the ``qos.resume-grace-s`` immunity window refuses the
+    rest — and the query still finishes correctly."""
+    coord, workers = _mk_cluster(
+        tmp_path, n=1, extra={"qos.resume-grace-s": "60"}
+    )
+    try:
+        sql = (
+            "select count(*) as c, sum(l_quantity) as s "
+            "from tpch.tiny.lineitem"
+        )
+        expected = [tuple(r) for r in coord.local.execute(sql).rows()]
+        trig0 = REGISTRY.counter("qos.preempt_triggers").total
+        faults.configure(
+            {
+                "rules": [
+                    {"action": "delay", "task": ".src.", "delay_s": 0.2},
+                    {
+                        "action": "suspend_storm",
+                        "owner": "q_c",
+                        "count": 3,
+                    },
+                ]
+            }
+        )
+        q = coord.submit(sql, user="batch-1")
+        q.done.wait(120)
+        assert q.state == "FINISHED", q.error
+        assert [tuple(r) for r in q.rows] == expected
+        # 3 triggers fired, hysteresis let exactly one suspend through
+        assert (
+            REGISTRY.counter("qos.preempt_triggers").total - trig0 == 3
+        )
+        assert getattr(q, "qos_suspensions", 0) == 1
+        assert getattr(q, "qos_resumes", 0) == 1
+    finally:
+        _teardown(coord, workers)
+
+
+@pytest.mark.slow
+def test_worker_kill_mid_suspend_zero_failures(tmp_path):
+    """Chaos: a worker dies WHILE the analytic victim is parked. On
+    resume, committed producer partitions re-serve from the spool and
+    lost work reschedules (QUERY restart as last resort) — zero failed
+    queries, exact rows."""
+    # long breaker cool-off: the dead worker must stay excluded from
+    # scheduling for the whole recovery window (a half-open probe
+    # re-admitting it mid-restart would feed join-task POSTs a dead
+    # socket and burn the restart budget)
+    coord, workers = _mk_cluster(
+        tmp_path,
+        policy="QUERY",
+        extra={"failure-detector.open-s": "30"},
+    )
+    coord.local.session.set("query_retry_count", 2)
+    try:
+        expected = [
+            tuple(r) for r in coord.local.execute(JOIN_SQL).rows()
+        ]
+        faults.configure(
+            {
+                "rules": [
+                    {"action": "delay", "task": ".prod.", "delay_s": 0.25},
+                    {"action": "delay", "task": ".src.", "delay_s": 0.3},
+                ]
+            }
+        )
+        qa = coord.submit(JOIN_SQL, user="batch-1")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and qa.state != "RUNNING":
+            time.sleep(0.01)
+        time.sleep(0.4)
+        qi = coord.submit(LOOKUP_SQL, user="inter-1")
+        assert _wait_attr(qa, "qos_suspensions", 1), qa.state
+        # kill a worker while the victim is parked: its committed
+        # producer attempts survive in the spool
+        workers[0]._fault_kill()
+        qi.done.wait(60)
+        qa.done.wait(180)
+        assert qi.state == "FINISHED", qi.error
+        assert qa.state == "FINISHED", qa.error
+        assert [tuple(r) for r in qa.rows] == expected
+    finally:
+        _teardown(coord, workers)
+
+
+# ------------------------------------------------ SLO / speculation
+
+
+def test_speculation_scale_tightens_near_slo():
+    """Deadline-aware straggler speculation: the threshold scale is
+    1.0 with no SLO, shrinks as elapsed time eats the target-p99-ms
+    budget, and floors at 0.25 past it."""
+    from presto_tpu.exec.stats import QueryStats
+
+    coord = CoordinatorServer(
+        config=NodeConfig(
+            {
+                "qos.enabled": "true",
+                "qos.interactive.target-p99-ms": "1000",
+            }
+        ),
+        max_concurrent_queries=1,
+        resource_groups=RESOURCE_GROUPS,
+    )
+    try:
+        qos = coord.qos
+
+        class FakeQ:
+            def __init__(self, group, age_s):
+                self.qid = "q_fake"
+                self.resource_group = group
+                self.stats = QueryStats(
+                    query_id="q_fake",
+                    sql="",
+                    create_time=time.time() - age_s,
+                )
+
+        assert qos.speculation_scale(FakeQ("batch", 10.0)) == 1.0
+        mid = qos.speculation_scale(FakeQ("interactive", 0.5))
+        assert 0.3 < mid < 0.7
+        assert qos.speculation_scale(FakeQ("interactive", 5.0)) == 0.25
+        # the view surfaces the configured SLO target
+        row = [
+            r
+            for r in qos.view_rows()
+            if r["group"] == "interactive"
+        ][0]
+        assert row["target_p99_ms"] == 1000.0
+    finally:
+        coord.shutdown()
+
+
+def test_slo_miss_counted(tmp_path):
+    """A finished query over its group's target-p99-ms counts an SLO
+    miss and lands in the group's latency reservoir."""
+    coord = CoordinatorServer(
+        config=NodeConfig(
+            {
+                "qos.enabled": "true",
+                # everything misses a 0.001ms target
+                "qos.batch.target-p99-ms": "0.001",
+            }
+        ),
+        max_concurrent_queries=2,
+        resource_groups=RESOURCE_GROUPS,
+    )
+    try:
+        q = coord.submit(LOOKUP_SQL, user="batch-1")
+        q.done.wait(60)
+        assert q.state == "FINISHED", q.error
+        row = [
+            r
+            for r in coord.qos.view_rows()
+            if r["group"] == "batch"
+        ][0]
+        assert row["queries"] >= 1
+        assert row["slo_misses"] >= 1
+        assert row["p99_ms"] > 0.0
+    finally:
+        coord.shutdown()

@@ -83,6 +83,7 @@ def test_every_rule_registered(repo_findings):
         "journal-sites",
         "ingest-frames",
         "reserve-sites",
+        "qos-plane",
         "metric-names",
     ):
         assert expected in rules
@@ -695,6 +696,57 @@ def test_serving_batch_rule_clean_fixture(tmp_path):
     assert not analysis.run_passes(
         str(tmp_path), rules=["serving-batch"]
     )
+
+
+def test_qos_plane_rule_flags_rogue_sites(tmp_path):
+    """The QoS plane's privileged constructs flag outside their
+    audited modules: controller construction / admission seams outside
+    the coordinator, and the suspend-side-effect hooks (journal
+    frames, arbiter release, spool progress) outside server/qos.py."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            ctl = QosController(coord, cfg, 4)
+            ctl.qos_admit(q)
+            ctl.qos_checkpoint(q)
+            journal.record_suspend("q_c1", 1)
+            journal.record_resume("q_c1", 5.0)
+            arbiter.suspend_release("q_c1")
+            n = spool.committed_for_query("q_c1")
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["qos-plane"])
+    assert len(found) == 7
+    assert all(f.rule == "qos-plane" for f in found)
+
+
+def test_qos_plane_rule_clean_fixtures(tmp_path):
+    """The audited module itself and attribute reads never flag."""
+    mod = tmp_path / "server" / "qos.py"
+    mod.parent.mkdir()
+    mod.write_text(
+        textwrap.dedent(
+            """
+            def suspend(coord, q, entry):
+                n = coord.spool.committed_for_query(q.qid)
+                coord.journal.record_suspend(q.qid, n)
+                coord.arbiter.suspend_release(q.qid)
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(coord, q):
+                # reads of the audited names are fine
+                has = coord.qos is not None
+                susp = getattr(q, "qos_suspensions", 0)
+                return has, susp
+            """
+        )
+    )
+    assert not analysis.run_passes(str(tmp_path), rules=["qos-plane"])
 
 
 def test_history_shim_clean_and_flags(tmp_path):

@@ -380,6 +380,57 @@ def ingest_pass(modules: List[core.Module], src_dir: str):
     return findings
 
 
+# ----------------------------------------------------------- qos plane
+
+_QOS = "server/qos.py"
+_QOS_COORD = {_QOS, "server/coordinator.py"}
+
+#: the QoS plane's privileged constructs and their audited callers:
+#: the controller + its admission/checkpoint seams are reachable only
+#: from the coordinator; the suspend-side-effect hooks — journal
+#: frames, arbiter reservation release, spool progress scans — only
+#: from server/qos.py (victim selection, suspend, and resume live
+#: there as the ONE audited module). A rogue suspend path elsewhere
+#: could park a query nothing ever resumes.
+_QOS_CALLS = {
+    "QosController": _QOS_COORD,
+    "qos_admit": _QOS_COORD,
+    "qos_release": _QOS_COORD,
+    "qos_checkpoint": _QOS_COORD,
+    "speculation_scale": _QOS_COORD,
+    "record_suspend": {"server/journal.py", _QOS},
+    "record_resume": {"server/journal.py", _QOS},
+    "suspend_release": {"server/memory_arbiter.py", _QOS},
+    "committed_for_query": {"server/spool.py", _QOS},
+}
+
+
+@core.register(
+    "qos-plane",
+    "QoS suspend/resume/victim-selection constructs confined to "
+    "server/qos.py + audited consumers (coordinator admission seam; "
+    "journal/arbiter/spool hooks)",
+)
+def qos_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        for call in _walk_calls(mod):
+            term = core.terminal_name(call.func)
+            allowed = _QOS_CALLS.get(term)
+            if allowed is None or mod.rel in allowed:
+                continue
+            findings.append(
+                mod.finding(
+                    "qos-plane",
+                    call.lineno,
+                    f"QoS construct {term}() outside its audited "
+                    f"modules ({', '.join(sorted(allowed))}) — route "
+                    "through presto_tpu.server.qos",
+                )
+            )
+    return findings
+
+
 # ------------------------------------------------------------- reserve
 
 _RESERVE_ALLOWED = {
