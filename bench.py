@@ -849,6 +849,110 @@ def _qos_line(backend: str) -> dict:
     }
 
 
+def _partitioned_join_line(backend: str) -> dict:
+    """ICI-native collective shuffle (the exchange-plane PR): wall-
+    clock of a hash-partitioned TPC-H join + aggregation across
+    in-process workers, ICI shuffle vs HTTP shuffle on the SAME
+    backend. The ICI window must move ZERO bytes through the
+    pages_wire shuffle (``exchange.http_shuffle_bytes`` flat) while
+    ``exchange.ici_bytes_elided`` grows — the win is asserted from
+    counters, not claimed. Reuses the PR 11 backend discipline: the
+    caller probed the backend (``_probe_backend``/``_force_cpu``) and
+    a cluster that cannot boot emits ``skip_line`` — never value 0."""
+    import time as _time
+
+    import jax
+
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+    from presto_tpu.session import NodeConfig
+    from presto_tpu.utils.metrics import REGISTRY
+
+    sql = (
+        "select o_orderpriority, count(*) as n, "
+        "sum(l_extendedprice) as v "
+        "from tpch.tiny.orders, tpch.tiny.lineitem "
+        "where o_orderkey = l_orderkey "
+        "group by o_orderpriority order by o_orderpriority"
+    )
+    iters = 3
+    n_workers = 4
+
+    def run_cluster(ici_on: bool):
+        cfg = {"exchange.ici-enabled": "true" if ici_on else "false"}
+        coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
+        workers = []
+        try:
+            for _ in range(n_workers):
+                workers.append(
+                    WorkerServer(
+                        coordinator_uri=coord.uri,
+                        config=NodeConfig(dict(cfg)),
+                    ).start()
+                )
+            deadline = _time.monotonic() + 15
+            while (
+                _time.monotonic() < deadline
+                and len(coord.active_workers()) < n_workers
+            ):
+                _time.sleep(0.05)
+            if len(coord.active_workers()) < n_workers:
+                raise RuntimeError("workers not discovered")
+            client = PrestoTpuClient(coord.uri, timeout_s=600)
+            client.execute(
+                "set session join_distribution_type = PARTITIONED"
+            )
+            rows = [tuple(r) for r in client.execute(sql).rows()]
+            times = []
+            for _ in range(iters):
+                t0 = _time.perf_counter()
+                client.execute(sql).rows()
+                times.append(_time.perf_counter() - t0)
+            return rows, min(times)
+        finally:
+            for w in workers:
+                w.shutdown(graceful=False)
+            coord.shutdown()
+
+    http0 = REGISTRY.counter("exchange.http_shuffle_bytes").total
+    rows_http, http_s = run_cluster(False)
+    http_during_off = (
+        REGISTRY.counter("exchange.http_shuffle_bytes").total - http0
+    )
+    http1 = REGISTRY.counter("exchange.http_shuffle_bytes").total
+    elided0 = REGISTRY.counter("exchange.ici_bytes_elided").total
+    edges0 = REGISTRY.counter("exchange.ici_edges").total
+    rows_ici, ici_s = run_cluster(True)
+    http_during_ici = (
+        REGISTRY.counter("exchange.http_shuffle_bytes").total - http1
+    )
+    elided = (
+        REGISTRY.counter("exchange.ici_bytes_elided").total - elided0
+    )
+    edges = REGISTRY.counter("exchange.ici_edges").total - edges0
+    return {
+        "metric": "partitioned_join_shuffle_8dev",
+        "value": round(ici_s, 4),
+        "unit": "s",
+        "ici_wall_s": round(ici_s, 4),
+        "http_wall_s": round(http_s, 4),
+        "speedup": round(http_s / ici_s, 3) if ici_s > 0 else None,
+        "ici_beats_http": ici_s < http_s,
+        "ici_bytes_elided": int(elided),
+        "ici_edges": int(edges),
+        "http_shuffle_bytes_during_ici": int(http_during_ici),
+        "http_shuffle_bytes_during_http": int(http_during_off),
+        "zero_wire_bytes_ok": elided > 0 and http_during_ici == 0,
+        "results_equal": rows_http == rows_ici,
+        "workers": n_workers,
+        "n_devices": len(jax.devices()),
+        "backend": backend,
+    }
+
+
 def _probe_backend() -> str:
     """Run a real tiny computation — trace + compile + execute + fetch,
     the full dispatch path a query exercises (an if, not an assert:
@@ -1048,6 +1152,22 @@ def main() -> None:
             print(
                 json.dumps(
                     skip_line("qos_interactive_p99_under_scan", e, "ms")
+                ),
+                flush=True,
+            )
+        # exchange plane: partitioned join + aggregation wall-clock,
+        # ICI (in-slice device collectives) vs HTTP shuffle on the
+        # same backend — zero pages_wire bytes on in-slice edges is
+        # the contract, asserted from counters
+        try:
+            print(
+                json.dumps(_partitioned_join_line(backend)),
+                flush=True,
+            )
+        except Exception as e:
+            print(
+                json.dumps(
+                    skip_line("partitioned_join_shuffle_8dev", e, "s")
                 ),
                 flush=True,
             )

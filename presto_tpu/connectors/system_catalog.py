@@ -369,6 +369,28 @@ class SystemConnector(Connector):
                     "evictions": s["replayed"],
                 }
             )
+        # in-slice exchange segment (server/exchange_spi.py): device-
+        # resident partitioned output parked for co-located consumers.
+        # hits = ICI edges served, misses = planned-ICI fetches that
+        # fell back to the wire, evictions = drain/retry
+        # materializations to HTTP — the win is observable, not
+        # asserted
+        from presto_tpu.server.exchange_spi import SEGMENT
+
+        seg = SEGMENT.stats()
+        rows.append(
+            {
+                "cache": "exchange.ici",
+                "entries": seg["entries"],
+                "bytes": seg["bytes"],
+                "budget_bytes": 0,  # bounded by the MemoryPool
+                "hits": seg["hits"],
+                "misses": seg["misses"],
+                "evictions": int(
+                    REGISTRY.counter("exchange.ici_materialized").total
+                ),
+            }
+        )
         # durable-exchange spool occupancy (fault-tolerant execution):
         # present when the embedding coordinator has exchange.spool-path
         # configured (server.spool shares the directory with workers)

@@ -28,7 +28,8 @@ equal keys — including NULL group keys — always land on the same worker.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -226,3 +227,190 @@ def gather_stacked(
         )
     live = segmented_live_mask(counts, shard_cap)
     return compact_flat(page_flat, live, jnp.sum(counts))
+
+
+# --------------------------------------------------------------------
+# ICI-native collective shuffle: the device-side half of the unified
+# exchange SPI (server/exchange_spi.py).
+#
+# Co-located workers (one slice, one host process driving the device
+# mesh) exchange partitioned join/agg/distinct output WITHOUT the host
+# round trip: the producer computes each row's destination partition in
+# a compiled program (``bucket_dest``) and hands the device-resident
+# page to the in-slice exchange segment; each consumer gathers its
+# partition's rows straight out of the producers' device pages with a
+# compiled select-and-scatter (``ici_append``) — the all-to-all data
+# movement happens device-to-device over ICI when the pages live on
+# different chips, with zero serialization, zero zlib, zero HTTP.
+#
+# CORRECTNESS CONTRACT: ``bucket_dest`` must assign every row to the
+# SAME partition as the host wire path's ``exec.streaming._bucket_of``.
+# Attempts of one logical producer may run on either path (an ICI
+# producer's retry can land on a cross-slice worker), and merge tasks
+# for different partitions pick attempts independently — if the two
+# hash functions ever disagreed, a retried stage could duplicate or
+# lose rows across partitions. ``_wire_hash_image`` therefore
+# replicates ``streaming._col_hash_input`` bit-for-bit (same mixer,
+# same NULL/dictionary/float/limb handling); tests pin the equality.
+
+
+def wire_crc_table(dictionary) -> "jnp.ndarray":
+    """Per-value crc32 table of a page dictionary, as a device uint64
+    array — the dictionary-id hash image of ``_col_hash_input`` (ids
+    hash by VALUE, so partitioning agrees across producers whose
+    dictionaries differ)."""
+    import zlib
+
+    import numpy as np
+
+    vals = np.asarray(dictionary.values, object)
+    return jnp.asarray(
+        np.asarray(
+            [zlib.crc32(str(v).encode()) for v in vals], np.uint64
+        )
+    )
+
+
+def _wire_hash_image(
+    blk: Block, crc_table: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    """uint64 per-row image of one key block, replicating
+    ``exec.streaming._col_hash_input`` exactly (see contract above).
+
+    ``crc_table`` is the ``wire_crc_table`` of the block's dictionary
+    (None for non-dictionary blocks) — passed separately so jitted
+    callers can strip host-side ``Dictionary`` objects from the page
+    pytree (a static-aux dictionary would fork the compile cache per
+    producer batch)."""
+    data = blk.data
+    if crc_table is not None:
+        if crc_table.shape[0] == 0:  # all-NULL column: empty dictionary
+            img = jnp.zeros((data.shape[0],), jnp.uint64)
+        else:
+            ids = jnp.clip(
+                data.astype(jnp.int64), 0, crc_table.shape[0] - 1
+            )
+            img = crc_table[ids]
+    elif data.ndim == 2 and data.shape[1] == 2:
+        # long-decimal limb pairs: mix the hi limb, fold in lo
+        hi = jax.lax.bitcast_convert_type(
+            data[:, 0].astype(jnp.int64), jnp.uint64
+        )
+        lo = jax.lax.bitcast_convert_type(
+            data[:, 1].astype(jnp.int64), jnp.uint64
+        )
+        img = _mix64(hi) ^ lo
+    elif blk.dtype.name in ("double", "real"):
+        f = data.astype(jnp.float64)
+        f = jnp.where(f == 0, 0.0, f)  # -0.0 hashes like +0.0
+        img = jax.lax.bitcast_convert_type(f, jnp.uint64)
+    else:
+        img = jax.lax.bitcast_convert_type(
+            data.astype(jnp.int64), jnp.uint64
+        )
+    if blk.valid is not None:
+        img = jnp.where(blk.valid, img, jnp.uint64(0))
+    return img
+
+
+@partial(jax.jit, static_argnames=("key_cols",))
+def bucket_dest(
+    page: Page,
+    crc_tables: Dict[str, jnp.ndarray],
+    n_buckets: jnp.ndarray,
+    key_cols: tuple,
+) -> jnp.ndarray:
+    """Per-row destination partition, == ``streaming._bucket_of`` on
+    the same rows. ``page`` must be dictionary-stripped
+    (``strip_dictionaries``); dictionary key columns hash through
+    their entry in ``crc_tables``. Dead rows get arbitrary (masked)
+    destinations."""
+    h = jnp.full((page.capacity,), 0x9E3779B97F4A7C15, jnp.uint64)
+    for c in key_cols:
+        h = h ^ _mix64(_wire_hash_image(page.block(c), crc_tables.get(c)))
+        h = _mix64(h)
+    return (h % n_buckets.astype(jnp.uint64)).astype(jnp.int32)
+
+
+def strip_dictionaries(page: Page) -> Page:
+    """Drop host-side Dictionary objects from every block: dictionaries
+    are static jit metadata, and per-batch producer dictionaries would
+    fork the ICI kernels' compile cache per batch. The caller carries
+    dictionaries out of band (crc tables in, union remaps in, the union
+    dictionary re-attached to the merged page host-side)."""
+    return dataclasses.replace(
+        page,
+        blocks=tuple(
+            dataclasses.replace(b, dictionary=None) for b in page.blocks
+        ),
+    )
+
+
+#: static segment count for the one-shot per-partition count kernel —
+#: partition fan-outs beyond this take the HTTP wire path (the
+#: scheduler's transport selection enforces it)
+MAX_ICI_PARTS = 64
+
+
+@jax.jit
+def ici_partition_counts(page: Page, dest: jnp.ndarray) -> jnp.ndarray:
+    """Live-row count per partition, shape (MAX_ICI_PARTS,) — one
+    fetch sizes every consumer's merge buffer."""
+    live = page.row_mask()
+    d = jnp.where(live, dest, jnp.int32(-1))
+    return jax.ops.segment_sum(
+        jnp.ones((page.capacity,), jnp.int32),
+        d + 1,
+        num_segments=MAX_ICI_PARTS + 1,
+    )[1:]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def ici_append(
+    out: Dict[str, dict],
+    page: Page,
+    dest: jnp.ndarray,
+    part: jnp.ndarray,
+    offset: jnp.ndarray,
+    remaps: Dict[str, Optional[jnp.ndarray]],
+) -> Dict[str, dict]:
+    """Scatter one producer page's rows for partition ``part`` into the
+    consumer's merge buffer at ``offset`` (the receive side of the
+    all-to-all: rows move device-to-device here, already partitioned,
+    never through the host).
+
+    ``out`` maps column name -> {"data": array, "valid": array|None}
+    (donated: updated in place buffer-wise); ``page`` is dictionary-
+    stripped; ``remaps`` carries per-column id remap tables into the
+    union dictionary (None = identity). Selected rows keep producer
+    row order, so the merged buffer is bit-identical to the HTTP wire
+    path's payload concatenation."""
+    live = page.row_mask() & (dest == part)
+    count = jnp.sum(live).astype(jnp.int32)
+    cap = page.capacity
+    (sel,) = jnp.nonzero(live, size=cap, fill_value=0)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    new_out = {}
+    for name, blk in zip(page.names, page.blocks):
+        slot = out[name]
+        ocap = slot["data"].shape[0]
+        pos = jnp.where(idx < count, offset.astype(jnp.int32) + idx, ocap)
+        d = blk.data[sel]
+        rmp = remaps.get(name)
+        if rmp is not None:
+            d = rmp[
+                jnp.clip(d.astype(jnp.int64), 0, rmp.shape[0] - 1)
+            ].astype(slot["data"].dtype)
+        data = slot["data"].at[pos].set(
+            d.astype(slot["data"].dtype), mode="drop"
+        )
+        valid = slot["valid"]
+        if valid is not None:
+            v = (
+                blk.valid[sel]
+                if blk.valid is not None
+                else jnp.ones((cap,), jnp.bool_)
+            )
+            valid = valid.at[pos].set(v, mode="drop")
+        new_out[name] = {"data": data, "valid": valid}
+    return new_out

@@ -38,6 +38,7 @@ from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.scheduler import (
     assign_ranges,
     plan_stage,
+    select_exchange_transport,
     stable_workers,
 )
 from presto_tpu.server.spool import ExchangeSpool
@@ -105,6 +106,15 @@ class _WorkerNode:
     #: preemptible capacity (elastic pools): gather/merge stages are
     #: placed on stable nodes when any exist (scheduler.stable_workers)
     preemptible: bool = False
+    #: slice identity announced on discovery (in-slice collective
+    #: shuffle): workers sharing one non-empty slice id are co-located
+    #: — the scheduler plans their partitioned exchanges as device
+    #: collectives (scheduler.select_exchange_transport); "" = unknown
+    #: topology, HTTP only
+    slice_id: str = ""
+    #: device coordinates announced beside the slice id (topology
+    #: observability only)
+    device_coords: tuple = ()
 
 
 class _Query:
@@ -470,6 +480,16 @@ class CoordinatorServer:
         rp = config.get("retry-policy") if config else None
         if rp is not None:
             self.local.session.set("retry_policy", rp)
+        # ICI-native collective shuffle (server/exchange_spi.py):
+        # tier-1 exchange.ici-enabled seeds the session default; off
+        # (the default) keeps the HTTP shuffle bit-exact
+        ici_on = (
+            config.get("exchange.ici-enabled") if config else None
+        )
+        if ici_on is not None:
+            self.local.session.set(
+                "exchange_ici_enabled", bool(ici_on)
+            )
         # parameterized plan cache (plan/canonical.py): tier-1 keys
         # bound the statement-level LRU and seed the session default
         pce = config.get("plan.cache-entries") if config else None
@@ -953,6 +973,8 @@ class CoordinatorServer:
         state: str = "ACTIVE",
         preemptible: bool = False,
         memory: Optional[dict] = None,
+        slice_id: str = "",
+        device_coords=(),
     ) -> None:
         with self._lock:
             w = self.workers.get(node_id)
@@ -960,12 +982,16 @@ class CoordinatorServer:
                 self.workers[node_id] = _WorkerNode(
                     node_id=node_id, uri=uri, last_seen=time.time(),
                     state=state, preemptible=bool(preemptible),
+                    slice_id=str(slice_id or ""),
+                    device_coords=tuple(device_coords or ()),
                 )
             else:
                 w.last_seen = time.time()
                 w.uri = uri
                 w.state = state
                 w.preemptible = bool(preemptible)
+                w.slice_id = str(slice_id or "")
+                w.device_coords = tuple(device_coords or ())
         # fold the heartbeat's memory report into the cluster view —
         # OUTSIDE the discovery lock (enforcement may scan queries)
         if memory is not None:
@@ -2706,6 +2732,20 @@ class CoordinatorServer:
         over = max(1, int(self.local.session.get("split_queue_factor")))
         created: List[tuple] = []
         clock = threading.Lock()
+        # transport selection (the scheduler owns it): both producer
+        # stages and the join stage ride the same decision — either
+        # side's schema being ICI-ineligible keeps the whole exchange
+        # on the HTTP wire
+        ici_slice = select_exchange_transport(
+            workers,
+            bool(self.local.session.get("exchange_ici_enabled")),
+            schemas=(
+                dict(side_stages[0].worker_fragment.output_schema()),
+                dict(side_stages[1].worker_fragment.output_schema()),
+            ),
+        )
+        if ici_slice:
+            REGISTRY.counter("exchange.ici_stages").update()
 
         def run_producers(stage, keys, group):
             ranges = assign_ranges(
@@ -2736,6 +2776,7 @@ class CoordinatorServer:
                     n_partitions=nparts,
                     partition_keys=tuple(keys),
                     spool=self._spooling(),
+                    ici_slice=ici_slice,
                     traceparent=q.trace.traceparent(),
                 ))
 
@@ -2798,6 +2839,7 @@ class CoordinatorServer:
                     sources=tuple(sources),
                     partition=i,
                     spool=self._spooling(),
+                    ici_slice=ici_slice,
                     traceparent=q.trace.traceparent(),
                 ))
                 with clock:
@@ -2862,6 +2904,16 @@ class CoordinatorServer:
         nparts = len(workers)
         prod_stage = self._new_stage(q, "producer")
         merge_stage = self._new_stage(q, "merge")
+        # transport selection (the scheduler owns it): co-located
+        # producer/merge workers exchange partitions as device
+        # collectives; "" keeps the serialized HTTP wire
+        ici_slice = select_exchange_transport(
+            workers,
+            bool(self.local.session.get("exchange_ici_enabled")),
+            schemas=(dict(worker_fragment.output_schema()),),
+        )
+        if ici_slice:
+            REGISTRY.counter("exchange.ici_stages").update()
 
         def make_spec(lo: int, hi: int) -> FragmentSpec:
             return self._register_task(q, prod_stage, FragmentSpec(
@@ -2885,6 +2937,7 @@ class CoordinatorServer:
                 n_partitions=nparts,
                 partition_keys=tuple(key_names),
                 spool=self._spooling(),
+                ici_slice=ici_slice,
                 traceparent=q.trace.traceparent(),
             ))
 
@@ -2955,6 +3008,7 @@ class CoordinatorServer:
                         split_end=0,
                         partition=i,
                         spool=self._spooling(),
+                        ici_slice=ici_slice,
                         traceparent=q.trace.traceparent(),
                     ))
                     try:
@@ -3577,6 +3631,8 @@ def _make_handler(coord: CoordinatorServer):
                     d["node_id"], d["uri"], d.get("state", "ACTIVE"),
                     preemptible=bool(d.get("preemptible", False)),
                     memory=d.get("memory"),
+                    slice_id=d.get("slice_id", ""),
+                    device_coords=d.get("device_coords", ()),
                 )
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})

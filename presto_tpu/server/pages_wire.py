@@ -50,11 +50,6 @@ COMPRESS_SAMPLE_BYTES = 4096
 COMPRESS_SAMPLE_RATIO = 0.9
 
 
-def _compress(raw: bytes) -> Tuple[bytes, int]:
-    comp = zlib.compress(raw, level=1)
-    return comp, zlib.crc32(raw)
-
-
 def _encode_buffer(raw: bytes) -> Tuple[bytes, int, str]:
     """Adaptive wire encoding: ``(payload, crc32(raw), enc)`` where
     ``enc`` is ``"zlib"`` or ``"raw"``. Small buffers and buffers whose
@@ -62,22 +57,35 @@ def _encode_buffer(raw: bytes) -> Tuple[bytes, int, str]:
     ``exchange.compress_skipped``); compressed buffers record the bytes
     saved (``exchange.bytes_saved``). The header's per-buffer ``enc``
     field defaults to ``"zlib"`` when absent, so old frames decode
-    unchanged (wire format stays PTP1)."""
+    unchanged (wire format stays PTP1).
+
+    This is the ONE encoder behind both producer entry points —
+    ``page_to_wire_columns`` (device-page serialization) and
+    ``payload_to_wire_columns`` (the partitioned-output re-serialize
+    path, which slices a page into many small per-partition buffers) —
+    so the skip/saved counters read consistently whichever path
+    produced the frame. Buffers below the size floor return BEFORE any
+    probe logic: the 4KB ratio probe would compress a sample larger
+    than the buffer itself, exactly the waste the floor exists to
+    avoid."""
     from presto_tpu.utils.metrics import REGISTRY
 
     crc = zlib.crc32(raw)
-    skip = len(raw) < MIN_COMPRESS_BYTES
-    if not skip and len(raw) > COMPRESS_SAMPLE_BYTES:
+    if len(raw) < MIN_COMPRESS_BYTES:
+        REGISTRY.counter("exchange.compress_skipped").update()
+        return raw, crc, "raw"
+    if len(raw) > COMPRESS_SAMPLE_BYTES:
         sample = raw[:COMPRESS_SAMPLE_BYTES]
         ratio = len(zlib.compress(sample, 1)) / len(sample)
-        skip = ratio > COMPRESS_SAMPLE_RATIO
-    if not skip:
-        comp = zlib.compress(raw, 1)
-        if len(comp) < len(raw):
-            REGISTRY.counter("exchange.bytes_saved").update(
-                len(raw) - len(comp)
-            )
-            return comp, crc, "zlib"
+        if ratio > COMPRESS_SAMPLE_RATIO:
+            REGISTRY.counter("exchange.compress_skipped").update()
+            return raw, crc, "raw"
+    comp = zlib.compress(raw, 1)
+    if len(comp) < len(raw):
+        REGISTRY.counter("exchange.bytes_saved").update(
+            len(raw) - len(comp)
+        )
+        return comp, crc, "zlib"
     REGISTRY.counter("exchange.compress_skipped").update()
     return raw, crc, "raw"
 

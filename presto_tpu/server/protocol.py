@@ -226,6 +226,16 @@ class FragmentSpec:
     #: upstream peer died re-serves that source's partition from the
     #: spool instead of failing (server.spool)
     spool: bool = False
+    #: in-slice collective shuffle (server/exchange_spi.py): the slice
+    #: id the SCHEDULER selected for this stage's exchange edges —
+    #: producers whose own slice matches keep partitioned output
+    #: device-resident in the ICI segment, and merge/join consumers
+    #: gather their partition device-to-device instead of pulling
+    #: serialized pages over HTTP. Empty = the HTTP wire (bit-exact
+    #: legacy). A worker whose slice does NOT match (a retry landed
+    #: cross-slice) silently uses HTTP; recovery and drain degrade the
+    #: same way.
+    ici_slice: str = ""
     #: trace context (utils.tracing traceparent header value): the
     #: coordinator stamps every task with the query's trace so
     #: worker-side spans join the query's span tree; also sent as the
@@ -250,6 +260,7 @@ class FragmentSpec:
             "dynfilter_keys": list(self.dynfilter_keys),
             "dynfilter_ndv": self.dynfilter_ndv,
             "spool": self.spool,
+            "ici_slice": self.ici_slice,
             "traceparent": self.traceparent,
         }
 
@@ -274,5 +285,6 @@ class FragmentSpec:
             dynfilter_keys=tuple(d.get("dynfilter_keys", ())),
             dynfilter_ndv=d.get("dynfilter_ndv", 0),
             spool=bool(d.get("spool", False)),
+            ici_slice=d.get("ici_slice", ""),
             traceparent=d.get("traceparent", ""),
         )

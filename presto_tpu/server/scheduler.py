@@ -224,6 +224,47 @@ def stable_workers(workers) -> list:
     return stable if stable else list(workers)
 
 
+def select_exchange_transport(
+    workers, enabled: bool, schemas=()
+) -> str:
+    """Transport selection for one partitioned exchange stage — the
+    ONE place that decides ICI vs HTTP (the exchange-plane confinement
+    rule pins it here; producers and consumers only *honor* the choice
+    carried on ``FragmentSpec.ici_slice``).
+
+    Returns the slice id when every candidate worker announces the
+    SAME non-empty slice (co-located: one host process driving one
+    device mesh — the topology the in-slice exchange segment requires)
+    and every exchanged schema is ICI-transportable (fixed-width
+    scalar columns; array/map/row keep the serialized wire). Returns
+    "" (the HTTP wire) otherwise: mixed slices, unannounced topology,
+    a DRAINING peer in the set, an oversized fan-out, or the gate off.
+    A DRAINING worker's edges must degrade to HTTP so the
+    zero-failure-drain contract holds even for stages planned at the
+    drain boundary."""
+    from presto_tpu.parallel.exchange import MAX_ICI_PARTS
+
+    if not enabled or not workers:
+        return ""
+    if len(workers) > MAX_ICI_PARTS:
+        return ""
+    slices = set()
+    for w in workers:
+        if getattr(w, "state", "ACTIVE") != "ACTIVE":
+            return ""
+        slices.add(getattr(w, "slice_id", ""))
+    if len(slices) != 1:
+        return ""
+    (slice_id,) = slices
+    if not slice_id:
+        return ""
+    for schema in schemas:
+        for t in schema.values():
+            if t.is_array or t.is_map or t.is_row:
+                return ""
+    return slice_id
+
+
 def assign_ranges(total_rows: int, n_ranges: int) -> List[Tuple[int, int]]:
     """Contiguous row ranges of the partitioned scan. The coordinator
     over-partitions (n_ranges = workers x split_queue_factor) and lets

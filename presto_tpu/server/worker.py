@@ -36,7 +36,7 @@ from presto_tpu.exec.staging import (
 )
 from presto_tpu.exec.stats import TaskStats
 from presto_tpu.plan import nodes as N
-from presto_tpu.server import pages_wire, rpc, task_ids
+from presto_tpu.server import exchange_spi, pages_wire, rpc, task_ids
 from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.spool import ExchangeSpool
 from presto_tpu.utils import faults, tracing
@@ -109,8 +109,15 @@ class _Task:
         self.spooled = False  # committed to the spool
         #: per-partition "consumer saw X-Complete" flags — the drain
         #: protocol waits on these (a draining worker must not exit
-        #: under a consumer still pulling)
+        #: under a consumer still pulling). ICI consumers flip them
+        #: through the segment's consumed callback.
         self.complete_served: List[bool] = [False] * nparts
+        #: in-slice exchange degrade-to-HTTP latch: materialization of
+        #: this task's device-resident partitions into the serialized
+        #: buffers runs exactly once, and concurrent result pulls block
+        #: on it (a half-materialized buffer must never serve)
+        self._ici_mat_lock = threading.Lock()
+        self._ici_mat_done = False
         self.cond = threading.Condition()
         self.created = time.time()
         # buffered output bytes are accounted against the worker's
@@ -358,6 +365,17 @@ class WorkerServer:
         # directory every node mounts (exchange.spool-path); None when
         # unconfigured — retry_policy=NONE never touches it
         self.spool = ExchangeSpool.from_config(config)
+        # in-slice collective shuffle (server/exchange_spi.py): the
+        # slice identity this worker announces — workers sharing one
+        # slice exchange partitioned output device-to-device through
+        # the process-local segment; the default identity IS that
+        # co-location (platform + host process). Config override for
+        # explicit topologies; a wrong override is safe (segment miss
+        # -> HTTP fallback).
+        self.slice_id = str(
+            (config.get("exchange.slice-id") if config else None)
+            or exchange_spi.default_slice_id()
+        )
         self._draining = False
         self._drain_grace_s = float(
             config.get("drain.grace-s", 30.0) if config else 30.0
@@ -437,6 +455,26 @@ class WorkerServer:
         # protocol must stay recoverable — consumers fall back to the
         # spool / task retry)
         faults.maybe_inject_drain(self.node_id, kill=self._fault_kill)
+        # ICI edges degrade to HTTP: serialize every FINISHED task's
+        # device-resident partitions into its output buffers so any
+        # consumer that has not taken its partition in-slice can still
+        # pull it over the wire (still-RUNNING tasks materialize
+        # themselves at seal time — they observe _draining)
+        with self._lock:
+            tasks = list(self.tasks.values())
+        for t in tasks:
+            if t.spec.n_partitions > 1 and t.spec.ici_slice:
+                with t.cond:
+                    finished = t.state == "FINISHED"
+                if finished:
+                    try:
+                        self._materialize_ici(t)
+                    except Exception:
+                        log.warning(
+                            "node=%s drain ICI materialize failed for "
+                            "%s", self.node_id, t.spec.task_id,
+                            exc_info=True,
+                        )
         grace = self._drain_grace_s if grace_s is None else grace_s
         deadline = time.monotonic() + grace
         while time.monotonic() < deadline and not self._shutting_down:
@@ -518,6 +556,11 @@ class WorkerServer:
             "uri": self.uri,
             "state": self._announce_state(),
             "preemptible": self.preemptible,
+            # slice/device-coordinate identity: the scheduler groups
+            # co-located workers by slice id and plans their
+            # partitioned exchanges as device collectives
+            "slice_id": self.slice_id,
+            "device_coords": exchange_spi.device_coords(),
             "memory": self._memory_report(),
         }
 
@@ -679,6 +722,36 @@ class WorkerServer:
                         "node=%s spool seal failed for %s",
                         self.node_id, task.spec.task_id, exc_info=True,
                     )
+            # in-slice exchange segment: seal BEFORE the terminal state
+            # is visible (FINISHED implies the device copy is complete,
+            # the spool-commit ordering). A DRAINING worker immediately
+            # degrades its ICI edges to HTTP — consumers that have not
+            # taken their partition yet fall back to the wire
+            if (
+                task.spec.n_partitions > 1
+                and task.spec.ici_slice
+                and task.spec.ici_slice == self.slice_id
+            ):
+                try:
+                    if outcome == "FINISHED" and task.state != "ABORTED":
+                        exchange_spi.seal_task(
+                            self.slice_id,
+                            task.spec.task_id,
+                            task.spec.n_partitions,
+                        )
+                        if self._draining:
+                            self._materialize_ici(task)
+                    else:
+                        freed = exchange_spi.discard_task(
+                            task.spec.task_id
+                        )
+                        if freed:
+                            self.memory_pool.release(task.buf_key, freed)
+                except Exception:
+                    log.warning(
+                        "node=%s ici seal failed for %s",
+                        self.node_id, task.spec.task_id, exc_info=True,
+                    )
             # publish the terminal state LAST: it flips X-Complete on
             # the result stream, and the coordinator reads the final
             # status (stats + spans above) as soon as it sees it
@@ -828,7 +901,14 @@ class WorkerServer:
                     summary_cell.append(s)
                 return
             if spec.n_partitions > 1:
-                return _emit_partitioned(task, out)
+                # partitioned output rides the unified exchange SPI:
+                # the scheduler-chosen transport (device-resident ICI
+                # publish for in-slice stages, serialized HTTP buffers
+                # otherwise), spool tee included
+                return exchange_spi.emit_partitioned(
+                    task, out,
+                    slice_id=self.slice_id, pool=self.memory_pool,
+                )
             cols, n = pages_wire.page_to_wire_columns(out)
             _offer_chunked(task, cols, n)
 
@@ -901,6 +981,70 @@ class WorkerServer:
                 emit(f.result())
         finish_summary()
 
+    def _ici_probe(self, uri: str, src_task: str):
+        """Liveness probe for the in-slice fetch wait: is the producer
+        attempt still working toward a seal? Control-plane only (one
+        tiny status GET between waits); any doubt answers False and
+        the consumer degrades to the wire, which has its own retry
+        discipline."""
+        def probe():
+            try:
+                st = rpc.call_json(
+                    "GET", f"{uri}/v1/task/{src_task}/status",
+                    policy=rpc.RpcPolicy(timeout_s=2.0, retries=0),
+                )
+                return st.get("state") in ("QUEUED", "RUNNING")
+            except Exception:
+                return False
+
+        return probe
+
+    def _merge_group_page(self, task: "_Task", entries, rschema):
+        """Resolve one merge group's tagged transport entries into the
+        RemoteSource leaf's input: an all-ICI group merges ON DEVICE
+        (``exchange_spi.device_merge`` — same union dictionary, row
+        order, and capacity bucket as the wire path, so the fragment
+        compiles and computes identically); a mixed or oversized group
+        degrades to host payloads. Returns ``(page, None)`` for the
+        device lane or ``(None, payloads)`` for the legacy host
+        lanes."""
+        if entries and all(k == "ici" for k, _ in entries):
+            try:
+                res = exchange_spi.device_merge(
+                    [b for _, b in entries],
+                    task.spec.partition,
+                    rschema,
+                    max_rows=int(
+                        self.runner.session.get("max_device_rows")
+                    ),
+                )
+            except Exception:
+                REGISTRY.counter("exchange.ici_merge_errors").update()
+                log.warning(
+                    "node=%s device merge failed; degrading to host "
+                    "merge", self.node_id, exc_info=True,
+                )
+                res = None
+            if res is not None:
+                page, total = res
+                with task.cond:
+                    task.stats.input_rows += total
+                return page, None
+        payloads = []
+        for kind, val in entries:
+            if kind == "http":
+                payloads.extend(val)
+            else:
+                conv = exchange_spi.ici_batches_to_payloads(
+                    val, task.spec.partition, rschema
+                )
+                with task.cond:
+                    task.stats.input_rows += sum(
+                        n for _, _, n in conv
+                    )
+                payloads.extend(conv)
+        return None, payloads
+
     def _spool_partition(self, task: "_Task", logical_key: str):
         """Recovery read: one committed attempt's pages for this merge
         task's partition out of the durable spool (None = nothing
@@ -926,6 +1070,34 @@ class WorkerServer:
         split = ConnectorSplit(scan.handle, lo, hi)
         return conn.create_page_source(split, list(scan.columns))
 
+    def _materialize_ici(self, task: "_Task") -> None:
+        """Degrade one task's ICI edges to HTTP, exactly once: the
+        drain path and the lazy results-handler path both land here,
+        and concurrent result pulls block until the serialized buffers
+        are complete (a half-materialized buffer must never flip
+        X-Complete under a puller). Serialize is the pure half —
+        raising there leaves nothing torn and clears the latch for a
+        retry; the buffered commit is atomic."""
+        with task._ici_mat_lock:
+            if task._ici_mat_done:
+                return
+            frames = exchange_spi.serialize_ici_frames(task)
+            if frames is not None:
+                exchange_spi.buffer_frames(
+                    task, frames, self.memory_pool
+                )
+            task._ici_mat_done = True
+        # a DELETE may have raced the materialize: its release-all can
+        # run BEFORE buffer_frames' reservation, and a task no longer
+        # registered gets no future DELETE to release it — re-check
+        # membership and drop everything if the task is gone (pullers
+        # of a deleted task 404 before reaching the buffers)
+        with self._lock:
+            gone = task.spec.task_id not in self.tasks
+        if gone:
+            exchange_spi.discard_task(task.spec.task_id)
+            task.drop_buffers()
+
     # ------------------------------------------- merge task (shuffle read)
 
     def _execute_merge(self, task: "_Task") -> None:
@@ -949,8 +1121,17 @@ class WorkerServer:
         # producer stage to one RemoteSourceNode leaf (a partitioned
         # JOIN stage has two producer stages — group 0 probe, group 1
         # build); untagged sources are group 0.
+        #: per-group tagged transport entries, in source order:
+        #: ("http", [(payload, schema, nrows), ...]) from the wire or
+        #: the spool, ("ici", [(page, dest), ...]) from the in-slice
+        #: segment — _merge_group_page resolves them into each
+        #: RemoteSource leaf's input page
         by_group: Dict[int, list] = {}
         pulled = set()
+        # in-slice transport applies only when the scheduler planned it
+        # AND this attempt actually runs on that slice (a retry that
+        # landed cross-slice keeps the wire)
+        use_ici = bool(spec.ici_slice) and spec.ici_slice == self.slice_id
         # attempt-id dedup (fault-tolerant execution): every attempt of
         # one logical upstream task shares a logical key, and exactly
         # ONE attempt's pages may be consumed — a retried producer and
@@ -987,6 +1168,27 @@ class WorkerServer:
                     pulled.add(tuple(src))
                     continue
                 t_pull = time.perf_counter()
+                if use_ici:
+                    # in-slice lane: take this partition straight out
+                    # of the producer's device-resident segment entry
+                    # (no serialization, no HTTP); a miss — producer
+                    # died, drained, or fell back itself — degrades to
+                    # the wire below, then to the spool
+                    got_ici = exchange_spi.ici_fetch(
+                        self.slice_id, spec, src_task, deadline,
+                        probe=self._ici_probe(uri, src_task),
+                    )
+                    if got_ici is not None:
+                        by_group.setdefault(group, []).append(
+                            ("ici", got_ici)
+                        )
+                        task.stats.staging_ms += (
+                            time.perf_counter() - t_pull
+                        ) * 1000.0
+                        abandoned.pop(lk, None)
+                        pulled.add(tuple(src))
+                        pulled_logical.add(lk)
+                        continue
                 try:
                     got = _pull_partition(
                         uri, src_task, spec.partition,
@@ -1008,7 +1210,7 @@ class WorkerServer:
                             continue
                         raise
                 abandoned.pop(lk, None)
-                by_group.setdefault(group, []).extend(got)
+                by_group.setdefault(group, []).append(("http", got))
                 task.stats.staging_ms += (
                     time.perf_counter() - t_pull
                 ) * 1000.0
@@ -1030,23 +1232,29 @@ class WorkerServer:
         if len(remotes) > 1:
             # multi-source fragment (partitioned join stage): group i
             # feeds the i-th RemoteSourceNode in walk order; each
-            # group's payloads merge + stage separately, then the
-            # fragment runs once over all leaves
+            # group's entries merge + stage separately (on device when
+            # the whole group arrived in-slice), then the fragment
+            # runs once over all leaves
             import numpy as np
 
             pages = []
             for i, r in enumerate(remotes):
                 rschema = dict(r.fragment_root.output_schema())
-                if by_group.get(i):
-                    merged = pages_wire.merge_payloads(
-                        by_group[i], rschema
-                    )
-                else:  # no rows from this side in this partition
-                    merged = {
-                        nm: np.empty(0, t.np_dtype)
-                        for nm, t in rschema.items()
-                    }
-                pages.append(stage_page(merged, rschema))
+                page, payloads = self._merge_group_page(
+                    task, by_group.get(i, []), rschema
+                )
+                if page is None:
+                    if payloads:
+                        merged = pages_wire.merge_payloads(
+                            payloads, rschema
+                        )
+                    else:  # no rows from this side in this partition
+                        merged = {
+                            nm: np.empty(0, t.np_dtype)
+                            for nm, t in rschema.items()
+                        }
+                    page = stage_page(merged, rschema)
+                pages.append(page)
             # same accounting as the single-remote path: a too-big
             # (skewed) join partition fails on MemoryPool accounting
             # (kill-largest policy visible), not device OOM
@@ -1073,8 +1281,27 @@ class WorkerServer:
                 f"merge fragment must have one RemoteSource leaf, "
                 f"got {len(remotes)}"
             )
-        payloads = by_group.get(0, [])
         schema = dict(remotes[0].fragment_root.output_schema())
+        page0, payloads = self._merge_group_page(
+            task, by_group.get(0, []), schema
+        )
+        if page0 is not None:
+            # all-in-slice merge: the input page was assembled on
+            # device (bit-compatible with the wire path's staged page)
+            staged = sum(int(b.data.nbytes) for b in page0.blocks)
+            self.memory_pool.reserve(spec.query_id, staged)
+            task.stats.input_bytes += staged
+            t_exec = time.perf_counter()
+            try:
+                out = self.runner._run_with_pages(root, remotes, [page0])
+            finally:
+                task.stats.execute_ms += (
+                    time.perf_counter() - t_exec
+                ) * 1000.0
+                self.memory_pool.release(spec.query_id, staged)
+            cols, n = pages_wire.page_to_wire_columns(out)
+            _offer_chunked(task, cols, n)
+            return
         # same grouped-execution discipline as the coordinator gather:
         # a partition beyond max_device_rows sub-buckets and merges one
         # bucket at a time (or fails under spill_enabled=false) instead
@@ -1122,6 +1349,7 @@ class WorkerServer:
             "state": state,
             "uri": self.uri,
             "preemptible": self.preemptible,
+            "slice_id": self.slice_id,
             "tasks": tasks,
             "memory": self._memory_report(),
         }
@@ -1135,6 +1363,10 @@ class WorkerServer:
         if t is None:
             return False
         t.abort()
+        # in-slice segment entries die with the task (shuffle
+        # partitions must not outlive the query on any worker); the
+        # full buf-key release below covers their reservation
+        exchange_spi.discard_task(task_id)
         t.drop_buffers()
         return True
 
@@ -1161,35 +1393,6 @@ class WorkerServer:
                 self.node_id, n, query_id,
             )
         return n
-
-
-def _emit_partitioned(task: "_Task", out) -> None:
-    """Partitioned output (reference: PartitionedOutputOperator): hash
-    the batch's rows by the stage's partition keys — on VALUES, not
-    dictionary ids, so partitioning agrees across producers whose
-    dictionaries differ (exec.streaming owns the hash) — and offer each
-    partition's slice to its own output buffer."""
-    from presto_tpu.exec import streaming as S
-
-    spec = task.spec
-    payload, schema, nrows = S._page_to_payload(out)
-    if nrows == 0:
-        return
-    buckets = S._bucket_of(
-        payload, list(spec.partition_keys), nrows, spec.n_partitions
-    )
-    import numpy as _np
-
-    for b in _np.unique(buckets):
-        mask = buckets == b
-        sliced = S._slice_payload(payload, schema, mask)
-        n = int(mask.sum())
-        cols = pages_wire.payload_to_wire_columns(sliced, schema, n)
-        task.offer_page(
-            pages_wire.serialize_page(cols, n), part=int(b)
-        )
-        with task.cond:
-            task.stats.output_rows += n
 
 
 def _pull_partition(
@@ -1291,23 +1494,43 @@ def _make_handler(worker: WorkerServer):
                 # final append + FINISHED publish — a 204 with
                 # X-Complete=true would silently drop the last page
                 # (pipelined pulls keep a beyond-the-end token in
-                # flight, so the race window is hit on every pull)
-                with t.cond:
-                    pages = t.parts[part]
-                    body = (
-                        pages[token] if token < len(pages) else None
-                    )
-                    n_pages = len(pages)
-                    state = t.state
-                    complete = state == "FINISHED" and (
-                        token + (1 if body is not None else 0)
-                        >= n_pages
-                    )
-                    if complete:
-                        # drain protocol: this consumer has seen the
-                        # whole stream — the buffer no longer pins a
-                        # draining worker alive
-                        t.complete_served[part] = True
+                # flight, so the race window is hit on every pull).
+                # Lazy ICI degrade rides the SAME snapshot: a wire
+                # pull of a FINISHED in-slice task (a merge retry
+                # that landed cross-slice) must see the real pages —
+                # an ICI task's serialized buffers are empty until
+                # materialized, and FINISHED + empty would read as a
+                # complete zero-row partition (silent data loss). The
+                # FINISHED decision and the materialize check happen
+                # on the LOCKED state, then the snapshot re-runs: a
+                # producer publishing FINISHED between an unlocked
+                # pre-check and the snapshot can never slip through.
+                while True:
+                    with t.cond:
+                        pages = t.parts[part]
+                        body = (
+                            pages[token] if token < len(pages) else None
+                        )
+                        n_pages = len(pages)
+                        state = t.state
+                        complete = state == "FINISHED" and (
+                            token + (1 if body is not None else 0)
+                            >= n_pages
+                        )
+                        need_mat = (
+                            state == "FINISHED"
+                            and t.spec.n_partitions > 1
+                            and bool(t.spec.ici_slice)
+                            and not t._ici_mat_done
+                        )
+                        if complete and not need_mat:
+                            # drain protocol: this consumer has seen
+                            # the whole stream — the buffer no longer
+                            # pins a draining worker alive
+                            t.complete_served[part] = True
+                    if not need_mat:
+                        break
+                    worker._materialize_ici(t)
                 if body is not None:
                     self.send_response(200)
                     self.send_header(

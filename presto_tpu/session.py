@@ -358,6 +358,17 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             1,
             _non_negative("query_retry_count"),
         ),
+        PropertyMetadata(
+            "exchange_ici_enabled",
+            "In-slice collective shuffle (server/exchange_spi.py): "
+            "partitioned join/agg/distinct exchanges between workers "
+            "co-located on one slice move device-to-device (no host "
+            "copy, no serialization, no HTTP); cross-slice edges and "
+            "recovery keep the HTTP/spool wire. False = bit-exact "
+            "legacy HTTP shuffle. Seeded by tier-1 exchange.ici-enabled",
+            bool,
+            False,
+        ),
     ]
 }
 
@@ -470,6 +481,14 @@ class NodeConfig:
         "exchange.spool-path": str,
         "exchange.spool-bytes": str,
         "exchange.spool-ttl-s": float,
+        # ICI-native collective shuffle (server/exchange_spi.py): the
+        # master gate (false = bit-exact legacy HTTP shuffle; seeds the
+        # exchange_ici_enabled session default) and an explicit slice
+        # identity override — by default a worker derives its slice
+        # from platform + host process, the co-location the in-slice
+        # exchange segment actually requires
+        "exchange.ici-enabled": bool,
+        "exchange.slice-id": str,
         # parameterized plan cache (plan/canonical.py): LRU entry bound
         # of the statement-level cache, and the enable_plan_cache
         # session default seed

@@ -1,0 +1,520 @@
+"""ICI-native collective shuffle (the unified exchange SPI).
+
+The scheduler plans partitioned join/agg/distinct exchanges between
+co-located workers (same announced slice) as device-to-device
+transfers through the in-slice segment — zero serialization, zero
+zlib, zero HTTP on those edges — while cross-slice edges, recovery,
+and drain keep the serialized wire + spool.
+
+Pinned here:
+- the DEVICE bucket hash == the HOST wire hash, bit-for-bit (mixed
+  transports of one logical producer must partition identically or
+  rows are lost across partitions);
+- ICI-vs-HTTP result equality for partitioned join / shuffled agg /
+  distinct on the 8-virtual-device CPU mesh, with the ICI window
+  moving ZERO bytes through the pages_wire shuffle;
+- transport selection rules (scheduler-owned);
+- chaos: kill a co-located worker mid-join under retry_policy=TASK —
+  the lost partitions recover over the HTTP/spool ladder with zero
+  failed queries; drain-under-load still loses nothing;
+- the compression-floor satellite: sub-floor buffers ship raw with
+  no ratio probe, counted identically on both producer entry points.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.server import (
+    CoordinatorServer,
+    PrestoTpuClient,
+    WorkerServer,
+)
+from presto_tpu.server import exchange_spi, rpc, task_ids
+from presto_tpu.session import NodeConfig
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY
+
+
+JOIN_SQL = (
+    "select o_orderpriority, count(*) as n, "
+    "sum(l_extendedprice) as v "
+    "from tpch.tiny.orders, tpch.tiny.lineitem "
+    "where o_orderkey = l_orderkey "
+    "group by o_orderpriority order by o_orderpriority"
+)
+AGG_SQL = (
+    "select l_returnflag, l_linestatus, sum(l_quantity) as q, "
+    "count(*) as n from tpch.tiny.lineitem "
+    "group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+DISTINCT_SQL = (
+    "select distinct l_suppkey from tpch.tiny.lineitem "
+    "order by l_suppkey limit 50"
+)
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    yield
+    faults.configure(None)
+
+
+def _wait_workers(coord, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+def _mk_cluster(n=3, cfg=None):
+    cfg = dict(cfg or {})
+    coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri, config=NodeConfig(dict(cfg))
+        ).start()
+        for _ in range(n)
+    ]
+    _wait_workers(coord, n)
+    return coord, workers
+
+
+def _teardown(coord, workers):
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def _counter(name):
+    return REGISTRY.counter(name).total
+
+
+# ------------------------------------------------ device == host hash
+
+
+def test_device_bucket_hash_matches_host_wire_hash():
+    """THE correctness contract: parallel.exchange.bucket_dest must
+    assign every row the same partition as exec.streaming._bucket_of.
+    Mixed attempts of one logical producer may run on either
+    transport, and merge tasks pick attempts per-partition
+    independently — disagreement loses or duplicates rows."""
+    import jax.numpy as jnp
+
+    from presto_tpu import types as T
+    from presto_tpu.connectors.tpch import DictColumn
+    from presto_tpu.exec import streaming as S
+    from presto_tpu.exec.staging import MaskedColumn
+    from presto_tpu.page import Block, Dictionary, Page
+    from presto_tpu.parallel import exchange as X
+
+    rng = np.random.default_rng(7)
+    n, cap = 900, 1024
+    ints = rng.integers(-5000, 5000, n).astype(np.int64)
+    flts = rng.normal(size=n)
+    flts[::7] = 0.0
+    flts[::11] = -0.0  # -0.0 must hash like +0.0
+    vals = np.array(sorted({"a", "bb", "ccc", "dddd", "e"}), object)
+    ids = rng.integers(0, len(vals), n).astype(np.int32)
+    valid = rng.random(n) > 0.15  # NULLs hash to one bucket
+    limbs = rng.integers(-2**40, 2**40, size=(n, 2)).astype(np.int64)
+
+    payload = {
+        "k": ints,
+        "f": flts,
+        "s": DictColumn(ids=ids, values=vals),
+        "m": MaskedColumn(data=ints.copy(), valid=valid),
+        "d": limbs,
+    }
+    keys = ["k", "f", "s", "m", "d"]
+    host = S._bucket_of(payload, keys, n, 7)
+
+    def pad(a, tail=()):
+        out = np.zeros((cap,) + tail, a.dtype)
+        out[:n] = a
+        return out
+
+    dic = Dictionary(vals)
+    page = Page(
+        blocks=(
+            Block(data=jnp.asarray(pad(ints)), valid=None,
+                  dtype=T.BIGINT),
+            Block(data=jnp.asarray(pad(flts)), valid=None,
+                  dtype=T.DOUBLE),
+            Block(data=jnp.asarray(pad(ids)), valid=None,
+                  dtype=T.VARCHAR, dictionary=dic),
+            Block(data=jnp.asarray(pad(ints)),
+                  valid=jnp.asarray(pad(valid)), dtype=T.BIGINT),
+            Block(data=jnp.asarray(pad(limbs, (2,))), valid=None,
+                  dtype=T.decimal(30, 2)),
+        ),
+        num_valid=jnp.asarray(n, jnp.int32),
+        names=("k", "f", "s", "m", "d"),
+    )
+    crc = {"s": X.wire_crc_table(dic)}
+    dest = X.bucket_dest(
+        X.strip_dictionaries(page), crc, jnp.asarray(7), tuple(keys)
+    )
+    assert np.array_equal(
+        np.asarray(dest)[:n], host.astype(np.int32)
+    ), "device bucket hash diverged from the host wire hash"
+    counts = np.asarray(X.ici_partition_counts(page, dest))
+    assert counts[:7].sum() == n and counts[7:].sum() == 0
+
+
+# ------------------------------------------------ transport selection
+
+
+def test_select_exchange_transport_rules():
+    from presto_tpu import types as T
+    from presto_tpu.server.scheduler import select_exchange_transport
+
+    class W:
+        def __init__(self, slice_id, state="ACTIVE"):
+            self.slice_id = slice_id
+            self.state = state
+
+    same = [W("s1"), W("s1"), W("s1")]
+    schema = {"a": T.BIGINT, "b": T.VARCHAR}
+    assert select_exchange_transport(same, True, (schema,)) == "s1"
+    # the gate off, mixed slices, unannounced topology, a DRAINING
+    # peer, or a nested-type schema all keep the HTTP wire
+    assert select_exchange_transport(same, False, (schema,)) == ""
+    assert (
+        select_exchange_transport([W("s1"), W("s2")], True, (schema,))
+        == ""
+    )
+    assert select_exchange_transport([W(""), W("")], True, (schema,)) == ""
+    assert (
+        select_exchange_transport(
+            [W("s1"), W("s1", state="DRAINING")], True, (schema,)
+        )
+        == ""
+    )
+    nested = {"a": T.array(T.BIGINT)}
+    assert select_exchange_transport(same, True, (schema, nested)) == ""
+    assert select_exchange_transport([], True, (schema,)) == ""
+
+
+def test_fragment_spec_ici_slice_wire_roundtrip():
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.server.protocol import FragmentSpec
+
+    from presto_tpu import types as T
+
+    root = N.ValuesNode(schema=(("a", T.BIGINT),))
+    spec = FragmentSpec(
+        task_id="q.prod.0.a0", query_id="q", fragment=root,
+        partition_scan=-1, split_start=0, split_end=0,
+        n_partitions=4, partition_keys=("a",), ici_slice="cpu-123",
+    )
+    back = FragmentSpec.from_json(spec.to_json())
+    assert back.ici_slice == "cpu-123"
+    # absent on old wire frames -> "" (HTTP), back-compatible
+    d = spec.to_json()
+    del d["ici_slice"]
+    assert FragmentSpec.from_json(d).ici_slice == ""
+
+
+# ------------------------------------------------ the equality battery
+
+
+def test_ici_vs_http_battery_join_agg_distinct():
+    """One cluster, each statement run under BOTH transports via the
+    session override: results must match exactly, the ICI window must
+    move zero bytes through the pages_wire shuffle, and in-slice edges
+    + elided bytes must be counted. Also pins: slice discovery, the
+    exchange.ici caches row, segment drained after DELETE."""
+    coord, ws = _mk_cluster(3, {"exchange.ici-enabled": "true"})
+    try:
+        # slice discovery: every in-process worker announces the same
+        # non-empty slice
+        slices = {
+            w.slice_id for w in coord.active_workers()
+        }
+        assert len(slices) == 1 and "" not in slices
+
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        client.execute(
+            "set session join_distribution_type = PARTITIONED"
+        )
+        for sql in (AGG_SQL, DISTINCT_SQL, JOIN_SQL):
+            client.execute(
+                "set session exchange_ici_enabled = false"
+            )
+            h0 = _counter("exchange.http_shuffle_bytes")
+            rows_http = [tuple(r) for r in client.execute(sql).rows()]
+            assert _counter("exchange.http_shuffle_bytes") > h0, sql
+
+            client.execute("set session exchange_ici_enabled = true")
+            h1 = _counter("exchange.http_shuffle_bytes")
+            e1 = _counter("exchange.ici_edges")
+            b1 = _counter("exchange.ici_bytes_elided")
+            rows_ici = [tuple(r) for r in client.execute(sql).rows()]
+            assert rows_ici == rows_http, f"transport changed answers: {sql}"
+            assert _counter("exchange.http_shuffle_bytes") == h1, (
+                f"ICI window moved bytes through pages_wire: {sql}"
+            )
+            assert _counter("exchange.ici_edges") > e1, sql
+            assert _counter("exchange.ici_bytes_elided") > b1, sql
+
+        # the win is observable: exchange.ici row in runtime.caches
+        res = client.execute(
+            "select cache, hits from system.runtime.caches "
+            "where cache = 'exchange.ici'"
+        )
+        rows = [tuple(r) for r in res.rows()]
+        assert len(rows) == 1 and rows[0][1] > 0
+        # shuffle partitions must not outlive their queries: the
+        # segment drains once tasks are DELETEd
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if exchange_spi.SEGMENT.stats()["entries"] == 0:
+                break
+            time.sleep(0.05)
+        assert exchange_spi.SEGMENT.stats()["entries"] == 0
+    finally:
+        _teardown(coord, ws)
+
+
+def test_ici_default_off_is_bit_exact_http():
+    """No config key -> no segment publish, no ICI counters, specs
+    carry no slice: the legacy HTTP shuffle, bit-exact."""
+    coord, ws = _mk_cluster(2)
+    try:
+        e0 = _counter("exchange.ici_edges")
+        b0 = _counter("exchange.ici_bytes_elided")
+        f0 = _counter("exchange.ici_fallbacks")
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        expected = [
+            tuple(r) for r in coord.local.execute(AGG_SQL).rows()
+        ]
+        assert [
+            tuple(r) for r in client.execute(AGG_SQL).rows()
+        ] == expected
+        assert _counter("exchange.ici_edges") == e0
+        assert _counter("exchange.ici_bytes_elided") == b0
+        assert _counter("exchange.ici_fallbacks") == f0
+    finally:
+        _teardown(coord, ws)
+
+
+# ----------------------------------------------------------- recovery
+
+
+def test_chaos_kill_colocated_worker_mid_join_falls_back(tmp_path):
+    """THE acceptance chaos test: kill one co-located worker mid
+    multi-stage join with ICI on under retry_policy=TASK. The dead
+    worker's device pages are gone (segment entries discarded, as a
+    real crash would lose them) — the rescheduled merge recovers its
+    partitions over the HTTP/spool ladder, with zero failed queries
+    and upstream producers NOT re-run."""
+    cfg = {
+        "exchange.ici-enabled": "true",
+        "exchange.spool-path": str(tmp_path / "spool"),
+        "exchange.spool-bytes": "64MB",
+        "retry-policy": "TASK",
+    }
+    coord, ws = _mk_cluster(2, cfg)
+    coord.local.session.set("retry_policy", "TASK")
+    try:
+        expected = [
+            tuple(r) for r in coord.local.execute(JOIN_SQL).rows()
+        ]
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        faults.configure(
+            {
+                "seed": 2,
+                "rules": [
+                    {"action": "delay", "task": ".prod.",
+                     "delay_s": 0.05},
+                    {"action": "delay", "task": ".merge.",
+                     "delay_s": 0.8},
+                ],
+            }
+        )
+        out, errs = {}, []
+
+        def run():
+            try:
+                out["res"] = client.execute(JOIN_SQL)
+            except Exception as e:
+                errs.append(e)
+
+        def seal_observed():
+            for w in ws:
+                with w._lock:
+                    tasks = list(w.tasks.values())
+                for t in tasks:
+                    if t.spec.partition_scan < 0 and t.sources_done:
+                        return True
+            return False
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not seal_observed():
+            time.sleep(0.002)
+        assert seal_observed(), "producer stage never sealed"
+        victim = ws[0]
+        victim._fault_kill()  # dead sockets, no drain
+        # a real crash loses the victim's device memory: drop its
+        # segment entries so recovery must take the HTTP/spool ladder
+        with victim._lock:
+            doomed = list(victim.tasks)
+        for tid in doomed:
+            exchange_spi.SEGMENT.discard(tid)
+        t.join(120)
+        assert not errs, f"query failed despite TASK recovery: {errs}"
+        assert [tuple(r) for r in out["res"].rows()] == expected
+
+        info = client.query_info(out["res"].query_id)
+        assert info["task_recoveries"] >= 1
+        # upstream producer stage not re-run: one attempt per logical
+        stages = {st["stage_id"]: st for st in info["stages"]}
+        prod = next(
+            st for st in stages.values() if st["kind"] == "producer"
+        )
+        by_logical = {}
+        for tk in prod["tasks"]:
+            by_logical.setdefault(
+                task_ids.logical_key(tk["task_id"]), []
+            ).append(tk)
+        for lk, attempts in by_logical.items():
+            assert len(attempts) == 1, f"producer {lk} re-ran"
+    finally:
+        _teardown(coord, ws)
+
+
+def test_drain_under_load_with_ici_zero_failures(tmp_path):
+    """Drain composes: a DRAINING worker's ICI edges degrade to HTTP
+    (segment entries materialize into serialized buffers), the query
+    completes with zero failures, and the drained worker exits."""
+    cfg = {
+        "exchange.ici-enabled": "true",
+        "exchange.spool-path": str(tmp_path / "spool"),
+        "retry-policy": "TASK",
+    }
+    coord, ws = _mk_cluster(2, cfg)
+    coord.local.session.set("retry_policy", "TASK")
+    try:
+        expected = [
+            tuple(r) for r in coord.local.execute(JOIN_SQL).rows()
+        ]
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        faults.configure(
+            {
+                "seed": 5,
+                "rules": [
+                    {"action": "delay", "task": ".prod.",
+                     "delay_s": 0.1}
+                ],
+            }
+        )
+        results, errs = [], []
+
+        def run():
+            try:
+                results.append(client.execute(JOIN_SQL).rows())
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.15)
+        rpc.call_json("PUT", ws[0].uri + "/v1/state/drain")
+        t.join(120)
+        assert not errs, f"drain lost a query: {errs}"
+        assert [tuple(r) for r in results[0]] == expected
+        # the drained worker's segment entries were materialized or
+        # consumed — nothing device-resident pins it alive
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not ws[0]._shutting_down:
+            time.sleep(0.05)
+        assert ws[0]._shutting_down, "drained worker did not exit"
+        # the cluster keeps serving on the survivor
+        res = client.execute(
+            "select count(*) as c from tpch.tiny.orders"
+        )
+        assert [tuple(r) for r in res.rows()] == [(15000,)]
+    finally:
+        _teardown(coord, ws)
+
+
+def test_cross_slice_worker_keeps_http():
+    """A worker announcing a different slice id never rides the
+    segment: the scheduler sees mixed slices and keeps the whole
+    stage on the wire (correct answers, zero ICI edges)."""
+    cfg = {"exchange.ici-enabled": "true"}
+    coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri, config=NodeConfig(dict(cfg))
+        ).start(),
+        WorkerServer(
+            coordinator_uri=coord.uri,
+            config=NodeConfig(
+                dict(cfg, **{"exchange.slice-id": "other-slice"})
+            ),
+        ).start(),
+    ]
+    _wait_workers(coord, 2)
+    try:
+        e0 = _counter("exchange.ici_edges")
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        expected = [
+            tuple(r) for r in coord.local.execute(AGG_SQL).rows()
+        ]
+        assert [
+            tuple(r) for r in client.execute(AGG_SQL).rows()
+        ] == expected
+        assert _counter("exchange.ici_edges") == e0
+    finally:
+        _teardown(coord, workers)
+
+
+# --------------------------------------- pages_wire floor satellite
+
+
+def test_compress_floor_skips_probe_and_counts_both_entry_points():
+    """Sub-floor buffers ship raw (enc="raw") with no ratio probe, and
+    exchange.compress_skipped counts identically whichever producer
+    entry point built the frame — device-page serialization and the
+    partitioned re-serialize path share the ONE encoder."""
+    from presto_tpu import types as T
+    from presto_tpu.server import pages_wire
+
+    n = 8  # 64 bytes of int64 — far below the 512B floor
+    data = np.arange(n, dtype=np.int64)
+
+    s0 = _counter("exchange.compress_skipped")
+    frame_direct = pages_wire.serialize_page(
+        [("a", data, None, T.BIGINT, None)], n
+    )
+    direct_skips = _counter("exchange.compress_skipped") - s0
+    assert direct_skips == 1
+
+    # the re-serialize path (partitioned output): payload -> wire
+    cols = pages_wire.payload_to_wire_columns(
+        {"a": data}, {"a": T.BIGINT}, n
+    )
+    s1 = _counter("exchange.compress_skipped")
+    frame_reser = pages_wire.serialize_page(cols, n)
+    assert _counter("exchange.compress_skipped") - s1 == direct_skips
+    # both frames mark the buffer raw and decode identically
+    for frame in (frame_direct, frame_reser):
+        payload, schema, nrows = pages_wire.deserialize_page(frame)
+        assert nrows == n
+        assert np.array_equal(np.asarray(payload["a"]), data)
+    import json as _json
+    import struct
+
+    (hlen,) = struct.unpack_from("<I", frame_direct, 4)
+    header = _json.loads(frame_direct[8: 8 + hlen].decode())
+    assert header["columns"][0]["enc"] == "raw"

@@ -84,6 +84,7 @@ def test_every_rule_registered(repo_findings):
         "ingest-frames",
         "reserve-sites",
         "qos-plane",
+        "exchange-plane",
         "metric-names",
     ):
         assert expected in rules
@@ -695,6 +696,80 @@ def test_serving_batch_rule_clean_fixture(tmp_path):
     )
     assert not analysis.run_passes(
         str(tmp_path), rules=["serving-batch"]
+    )
+
+
+def test_exchange_plane_rule_flags_rogue_sites(tmp_path):
+    """The exchange plane's privileged constructs flag outside their
+    audited modules: device collectives / ICI kernels outside
+    parallel/exchange.py, the segment + emit/fetch surface outside
+    server/exchange_spi.py (+ the worker), transport selection outside
+    the scheduler."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            r = jax.lax.all_to_all(x, "workers", 0, 0)
+            g = jax.lax.all_gather(x, "workers")
+            d = bucket_dest(page, crc, 4, ("k",))
+            out = ici_append(out, page, dest, 0, 0, {})
+            seg = IciSegment()
+            emit_partitioned(task, page, slice_id="s", pool=None)
+            got = ici_fetch("s", spec, "t", 0.0, probe)
+            merged = device_merge(batches, 0, schema)
+            t = select_exchange_transport(workers, True, ())
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["exchange-plane"])
+    assert len(found) == 9
+    assert all(f.rule == "exchange-plane" for f in found)
+
+
+def test_exchange_plane_rule_clean_fixtures(tmp_path):
+    """The audited modules themselves and attribute reads never
+    flag — and the REPO is clean under the rule (collectives and the
+    exchange surface really are confined)."""
+    kern = tmp_path / "parallel" / "exchange.py"
+    kern.parent.mkdir()
+    kern.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def partition_exchange(page, dest, n, axis, cap):
+                return jax.lax.all_to_all(page, axis, 0, 0)
+
+            def replicate(page, n, axis):
+                return jax.lax.all_gather(page, axis)
+            """
+        )
+    )
+    spi = tmp_path / "server" / "exchange_spi.py"
+    spi.parent.mkdir()
+    spi.write_text(
+        textwrap.dedent(
+            """
+            def emit(task, out, slice_id):
+                dest = bucket_dest(out, {}, 4, ("k",))
+                SEGMENT = IciSegment()
+                return dest
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(spec, seg):
+                # reads of the audited names are fine
+                s = spec.ici_slice
+                n = seg.stats()["entries"]
+                return s, n
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["exchange-plane"]
     )
 
 

@@ -127,4 +127,25 @@ BLOCKING_ALLOWLIST = [
         "file mid-read; recovery is rare and the frames are small "
         "(the hot exchange path never touches the spool reader)",
     ),
+    Allow(
+        "server/worker.py",
+        "WorkerServer._materialize_ici",
+        "jax.device_get",
+        "the materialize latch exists to serialize exactly this "
+        "degrade: result pulls of an ICI task must block until its "
+        "serialized buffers are COMPLETE (a half-materialized buffer "
+        "under X-Complete is silent data loss), and the latch is "
+        "taken by nothing else — drain and the results handler are "
+        "its only users, off the produce/consume hot path",
+    ),
+    Allow(
+        "server/worker.py",
+        "WorkerServer._materialize_ici",
+        "utils/memory.MemoryPool._lock.wait",
+        "the governance-lane reserve for materialized frames may "
+        "block for headroom while the materialize latch is held; the "
+        "latch is private to this degrade (see the device_get entry) "
+        "and blocking pullers behind an under-pressure materialize is "
+        "the intended backpressure",
+    ),
 ]

@@ -75,6 +75,19 @@ def rpc_pass(modules: List[core.Module], src_dir: str):
 
 _HOST_BOUNDARY = ("server", "connectors", "parallel")
 
+#: audited device-boundary modules beside exec/staging.py: the
+#: exchange plane's kernels (parallel/exchange.py) and their SPI
+#: orchestration (server/exchange_spi.py) move hash/remap tables and
+#: traced scalars to device as kernel parameters — not page staging;
+#: the pages they build are accounted by the worker under the same
+#: owners the staged path uses, and the exchange-plane rule confines
+#: the constructs themselves
+_STAGING_EXEMPT = {
+    "exec/staging.py",
+    "parallel/exchange.py",
+    "server/exchange_spi.py",
+}
+
 
 @core.register(
     "staging-confinement",
@@ -84,7 +97,7 @@ _HOST_BOUNDARY = ("server", "connectors", "parallel")
 def staging_pass(modules: List[core.Module], src_dir: str):
     findings = []
     for mod in modules:
-        if mod.rel == "exec/staging.py":
+        if mod.rel in _STAGING_EXEMPT:
             continue
         top = mod.rel.split("/")[0]
         boundary = top in _HOST_BOUNDARY
@@ -439,6 +452,11 @@ _RESERVE_ALLOWED = {
     "exec/local_runner.py",
     "server/worker.py",
     "server/coordinator.py",
+    # the exchange SPI accounts in-slice device pages and their
+    # drain-materialized serialized twins under the producing task's
+    # buffer key — the same owner the worker's HTTP shuffle buffers
+    # use, released by the same DELETE/drop path
+    "server/exchange_spi.py",
 }
 
 

@@ -181,6 +181,58 @@ _BATCH_CALLS = {
 }
 
 
+# ------------------------------------------------------ exchange plane
+
+_EXCHANGE = "parallel/exchange.py"
+_EXCHANGE_SPI = "server/exchange_spi.py"
+_SCHEDULER = "server/scheduler.py"
+_WORKER = "server/worker.py"
+
+#: the ICI-native shuffle is only correct while its privileged
+#: constructs stay confined: device collectives and the exchange
+#: kernels in parallel/exchange.py (a bucket hash built elsewhere can
+#: silently disagree with the host wire hash and lose rows across
+#: partitions on a mixed-transport retry), the segment + emit/fetch
+#: surface in server/exchange_spi.py with the worker as its one
+#: audited consumer, and transport SELECTION in the scheduler (a
+#: transport chosen ad hoc can put an ICI edge across slices, where
+#: the segment cannot serve it)
+_EXCHANGE_CALLS = {
+    "all_to_all": {_EXCHANGE},
+    "all_gather": {_EXCHANGE},
+    "bucket_dest": {_EXCHANGE, _EXCHANGE_SPI},
+    "ici_append": {_EXCHANGE, _EXCHANGE_SPI},
+    "ici_partition_counts": {_EXCHANGE, _EXCHANGE_SPI},
+    "wire_crc_table": {_EXCHANGE, _EXCHANGE_SPI},
+    "partition_exchange": {_EXCHANGE, "parallel/distributed_runner.py"},
+    "IciSegment": {_EXCHANGE_SPI},
+    "emit_partitioned": {_EXCHANGE_SPI, _WORKER},
+    "ici_fetch": {_EXCHANGE_SPI, _WORKER},
+    "device_merge": {_EXCHANGE_SPI, _WORKER},
+    "ici_batches_to_payloads": {_EXCHANGE_SPI, _WORKER},
+    "serialize_ici_frames": {_EXCHANGE_SPI, _WORKER},
+    "buffer_frames": {_EXCHANGE_SPI, _WORKER},
+    "select_exchange_transport": {_SCHEDULER, "server/coordinator.py"},
+}
+
+
+@core.register(
+    "exchange-plane",
+    "collective construction and ICI exchange kernels confined to "
+    "parallel/exchange.py, the segment/emit/fetch surface to "
+    "server/exchange_spi.py (+ the worker), transport selection to "
+    "the scheduler",
+)
+def exchange_plane_pass(modules: List[core.Module], src_dir: str):
+    return _confined_calls(
+        modules,
+        _EXCHANGE_CALLS,
+        "exchange-plane",
+        "presto_tpu.parallel.exchange / "
+        "presto_tpu.server.exchange_spi / the scheduler",
+    )
+
+
 @core.register(
     "serving-batch",
     "micro-batch constructs confined: batch-axis stacking and vmap "
