@@ -50,6 +50,10 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "query": T.VARCHAR,
             "node_count": T.BIGINT,
             "total_rows": T.BIGINT,
+            # adaptive execution: the statement fingerprint's history
+            # epoch (bumped on material cardinality change; the signal
+            # epoch-versioned plan-cache entries are judged by)
+            "epoch": T.BIGINT,
             "updated": T.DOUBLE,
         },
         "nodes": {

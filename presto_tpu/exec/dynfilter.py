@@ -115,9 +115,17 @@ class ColumnFilter:
 @dataclasses.dataclass(frozen=True)
 class FilterSummary:
     """Per-key summaries of one build side (aligned with the join's
-    build-key list)."""
+    build-key list).
+
+    ``rows`` is the OBSERVED build cardinality the summarized pages
+    covered (-1 = unknown, e.g. a summary deserialized from an older
+    wire form). Partials sum under :meth:`merge`, so the coordinator's
+    merged summary reports the build side's true row count — the
+    runtime signal adaptive execution judges the planner's estimate
+    against at the build-summary barrier."""
 
     columns: Tuple[ColumnFilter, ...]
+    rows: int = -1
 
     def merge(self, other: "FilterSummary", ndv_limit: int) -> "FilterSummary":
         assert len(self.columns) == len(other.columns)
@@ -125,7 +133,12 @@ class FilterSummary:
             columns=tuple(
                 a.merge(b, ndv_limit)
                 for a, b in zip(self.columns, other.columns)
-            )
+            ),
+            rows=(
+                self.rows + other.rows
+                if self.rows >= 0 and other.rows >= 0
+                else -1
+            ),
         )
 
     @property
@@ -133,14 +146,18 @@ class FilterSummary:
         return all(c.empty for c in self.columns)
 
     def to_json(self) -> dict:
-        return {"columns": [c.to_json() for c in self.columns]}
+        return {
+            "columns": [c.to_json() for c in self.columns],
+            "rows": self.rows,
+        }
 
     @staticmethod
     def from_json(d: dict) -> "FilterSummary":
         return FilterSummary(
             columns=tuple(
                 ColumnFilter.from_json(c) for c in d["columns"]
-            )
+            ),
+            rows=int(d.get("rows", -1)),
         )
 
 
@@ -149,7 +166,7 @@ def empty_summary(keys) -> FilterSummary:
     range was empty): every key column is empty — merging with real
     partials leaves the partner untouched."""
     return FilterSummary(
-        columns=tuple(ColumnFilter(column=k) for k in keys)
+        columns=tuple(ColumnFilter(column=k) for k in keys), rows=0
     )
 
 
@@ -225,7 +242,7 @@ def summarize_page(page, keys, ndv_limit: int = DEFAULT_NDV_LIMIT) -> FilterSumm
                 column=key, lo=lo, hi=hi, values=values, empty=False
             )
         )
-    return FilterSummary(columns=tuple(cols))
+    return FilterSummary(columns=tuple(cols), rows=n)
 
 
 # --------------------------------------------- apply: Expr / constraint

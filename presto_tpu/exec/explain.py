@@ -282,6 +282,11 @@ def render_distributed_analyze(
             f"micro-batch: {qstats.batch_size}-way "
             "(one device dispatch served the group)"
         )
+    # adaptive execution: every replan / mid-query strategy decision
+    # this statement took ("REPLANNED (epoch N→M) ..." / "SWITCHED
+    # broadcast→partitioned ...")
+    for note in getattr(qstats, "adaptive_notes", ()) or ():
+        lines.append(f"adaptive: {note}")
     if (
         qstats.dynamic_filters
         or qstats.dynamic_filter_wait_ms

@@ -394,7 +394,18 @@ class LocalQueryRunner:
             ):
                 with trace.span("plan"):
                     if isinstance(stmt, ast.Select):
-                        plan, qs.plan_cache_hit = self.plan_cached(stmt)
+                        # the stats sink is live DURING planning so an
+                        # adaptive replan attributes its flag/note to
+                        # this query (the coordinator path installs it
+                        # earlier for the same reason)
+                        prev_qs = self._active_qs
+                        self._active_qs = qs
+                        try:
+                            plan, qs.plan_cache_hit = self.plan_cached(
+                                stmt
+                            )
+                        finally:
+                            self._active_qs = prev_qs
                     else:
                         plan = self._plan_statement(stmt)
                 qs.planning_ms = (time.perf_counter() - t0) * 1000.0
@@ -766,6 +777,12 @@ class LocalQueryRunner:
         bound = {i: lit for i, lit in enumerate(lits)}
         entry = self.plan_cache.get(key)
         if isinstance(entry, canonical.PlanCacheEntry):
+            # adaptive execution: an epoch-stale entry replans instead
+            # of serving the plan its worst early estimates built
+            # (None = entry still fresh, or the plane is off)
+            replanned = self._adaptive_replan(key, entry, canon, bound)
+            if replanned is not None:
+                return replanned
             return (
                 Plan(
                     root=entry.root,
@@ -784,7 +801,12 @@ class LocalQueryRunner:
                 None,
             )
         try:
-            plan = self._plan_statement(canon)
+            # capture which history fingerprints (and which estimates)
+            # this optimization consulted: the evidence the entry's
+            # later staleness checks re-validate against (null scope
+            # when the adaptive plane is off — see _capture_scope)
+            with self._capture_scope() as consulted:
+                plan = self._plan_statement(canon)
         except Exception:
             # parameterized planning failed (hoisted literal in a
             # structural position): permanent literal-form lane
@@ -812,6 +834,37 @@ class LocalQueryRunner:
                 False,
                 None,
             )
+        return self._store_canonical_entry(
+            key, plan, consulted, bound, handles, len(lits)
+        )
+
+    def _capture_scope(self):
+        """Consult capture for canonical-statement planning — active
+        only when the adaptive plane could ever read the evidence
+        (session ``adaptive_enabled``): the default path must not pay
+        per-consult store reads or retain consulted dicts nothing
+        will judge. Entries planned with the plane OFF therefore
+        carry no evidence and are never replanned — flipping adaptive
+        on mid-process adapts newly (re)planned shapes, not cached
+        ones retroactively."""
+        import contextlib
+
+        from presto_tpu.plan import history as plan_history
+
+        if self.session.get("adaptive_enabled"):
+            return plan_history.capture_consults()
+        return contextlib.nullcontext({})
+
+    def _store_canonical_entry(
+        self, key, plan, consulted, bound, handles, n_slots
+    ):
+        """Build + store the statement-cache entry for a planned
+        canonical statement; -> its bound ``(plan, False, key)``
+        triple. The ONE entry constructor the miss path and the
+        adaptive replan share — entries built by either must never
+        diverge in shape or preoptimization."""
+        from presto_tpu.plan import canonical
+
         root, preopt = plan.root, False
         if not plan.params:
             # value-independent over a canonical root: optimize ONCE at
@@ -828,7 +881,8 @@ class LocalQueryRunner:
                 output_names=plan.output_names,
                 preoptimized=preopt,
                 handles=handles,
-                n_slots=len(lits),
+                n_slots=n_slots,
+                consulted=dict(consulted),
             ),
         )
         return (
@@ -842,6 +896,60 @@ class LocalQueryRunner:
             False,
             key,
         )
+
+    def _adaptive_replan(self, key, entry, canon, bound):
+        """Epoch-versioned plan cache (adaptive execution, ROADMAP
+        item 2): a statement-cache HIT whose consulted history
+        estimates have materially diverged (plan/canonical.
+        stale_consults — the shared divergence test) replans the
+        canonical statement against TODAY's learned cardinalities and
+        REPLACES the entry, so the hottest shapes stop paying for
+        their worst early guesses. Fail-open: any replan failure
+        serves the cached plan — never a failed query. Returns the
+        ``(plan, hit=False, key)`` triple, or None when the entry is
+        still fresh / the plane is off."""
+        from presto_tpu.plan import canonical
+        from presto_tpu.plan import history as plan_history
+        from presto_tpu.utils.metrics import REGISTRY
+
+        if not self.session.get("adaptive_enabled"):
+            return None
+        store = self.history_store
+        if (
+            store is None
+            or not self.session.get("enable_history_stats")
+            or not entry.consulted
+        ):
+            return None
+        factor = float(self.session.get("adaptive_divergence_factor"))
+        stale = canonical.stale_consults(entry.consulted, store, factor)
+        if stale is None:
+            return None
+        fp, old_epoch, new_epoch = stale
+        REGISTRY.counter("adaptive.divergence_detected").update()
+        try:
+            with plan_history.capture_consults() as consulted:
+                plan = self._plan_statement(canon)
+            out = self._store_canonical_entry(
+                key, plan, consulted, bound,
+                canonical.plan_handles(plan), entry.n_slots,
+            )
+        except Exception:
+            # replan failure: the cached plan still answers correctly
+            # (its estimates were stale, not its semantics) — serve it
+            REGISTRY.counter("plan.replan_failures").update()
+            return None
+        REGISTRY.counter("plan.replans").update()
+        self.plan_cache.note_replan()
+        qs = self._active_qs
+        if qs is not None:
+            with self._qs_mu:
+                qs.replanned = True
+                qs.adaptive_notes.append(
+                    f"REPLANNED (epoch {old_epoch}→{new_epoch}) "
+                    f"node {fp}"
+                )
+        return out
 
     def _execute_write(self, stmt) -> QueryResult:
         """Table writer (reference: TableWriterOperator + the SPI's

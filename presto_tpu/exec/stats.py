@@ -245,6 +245,17 @@ class QueryStats:
     #: same-fingerprint members rode that one dispatch
     batched: bool = False
     batch_size: int = 0
+    #: adaptive execution (ROADMAP item 2): replanned = a statement-
+    #: cache hit was judged epoch-stale and re-optimized against
+    #: today's learned cardinalities; adapted = the runtime decision
+    #: point changed strategy mid-query (broadcast<->partitioned flip,
+    #: remainder re-ordering, partition resize). adaptive_notes holds
+    #: the human-readable decision lines EXPLAIN ANALYZE renders
+    #: ("REPLANNED (epoch 1→2) ..." / "SWITCHED broadcast→partitioned
+    #: ...").
+    replanned: bool = False
+    adapted: bool = False
+    adaptive_notes: List[str] = dataclasses.field(default_factory=list)
     staging_cache_hits: int = 0  # pages served device-resident
     retries: int = 0  # capacity-overflow re-runs
     device_fragments: int = 0  # stage-at-a-time programs beyond the root
@@ -459,6 +470,9 @@ class QueryStats:
             "plan_cache_hit": self.plan_cache_hit,
             "batched": self.batched,
             "batch_size": self.batch_size,
+            "replanned": self.replanned,
+            "adapted": self.adapted,
+            "adaptive_notes": list(self.adaptive_notes),
             "staging_cache_hits": self.staging_cache_hits,
             "retries": self.retries,
             "device_fragments": self.device_fragments,

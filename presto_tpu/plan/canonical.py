@@ -597,6 +597,52 @@ class PlanCacheEntry:
     preoptimized: bool
     handles: frozenset
     n_slots: int
+    #: adaptive execution (epoch-versioned entries): the history
+    #: evidence this plan's optimization consulted — node fingerprint
+    #: -> {"epoch", "rows", "est"} captured by
+    #: plan/history.capture_consults around planning. A later hit
+    #: re-validates against it (:func:`stale_consults`); empty = the
+    #: entry can never go stale (no history was consulted).
+    consulted: dict = dataclasses.field(default_factory=dict)
+
+
+def stale_consults(consulted: dict, store, factor: float):
+    """The statement-cache REPLAN seam's divergence test (adaptive
+    execution — the one place a cached plan is judged stale; audited
+    consumer: exec/local_runner._adaptive_replan).
+
+    -> ``(fp, captured_epoch, current_epoch)`` of the first consulted
+    node whose learned cardinality has since MATERIALLY diverged from
+    the estimate this plan was built on, else None. The epoch
+    comparison is the cheap pre-filter (epochs bump only on material
+    change — plan/history.record_query) — valid only when the
+    caller's factor is at least the STORE's bump factor; a tighter
+    session factor falls through to the full per-node judgement, so
+    ``adaptive_divergence_factor=2`` still replans on drift the
+    store's 4x epochs never flagged. The captured estimate (the
+    learned rows at consult time, or the classic fallback on a miss)
+    is what the fresh learned value is judged against, so an epoch
+    bump that lands back NEAR the plan's own assumptions keeps the
+    plan. Never raises — staleness checking must not fail a query."""
+    from presto_tpu.plan import history
+
+    epoch_gated = factor >= getattr(store, "divergence_factor", 1.0)
+    for fp, cap in (consulted or {}).items():
+        try:
+            cur_epoch = store.epoch_of(fp)
+            if epoch_gated and cur_epoch == cap.get("epoch", 0):
+                continue
+            learned = store.learned_rows(fp)
+            base = cap.get("rows")
+            if base is None:
+                base = cap.get("est")
+            if learned is None or base is None:
+                continue
+            if history.diverged(base, learned, factor):
+                return fp, cap.get("epoch", 0), cur_epoch
+        except Exception:
+            continue
+    return None
 
 
 #: sentinel: this canonical shape could not be planned in parameterized
@@ -619,6 +665,9 @@ class PlanCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: adaptive execution: hits whose entry was judged stale
+        #: (stale_consults) and replaced by a fresh plan
+        self.replans = 0
 
     def resize(self, entries: int) -> None:
         with self._lock:
@@ -655,6 +704,13 @@ class PlanCache:
             self._od.move_to_end(key)
             self._shrink()
 
+    def note_replan(self) -> None:
+        """Count one adaptive replan under the cache lock (like every
+        other counter here — concurrent stale hits must not lose
+        updates against the stats row)."""
+        with self._lock:
+            self.replans += 1
+
     def invalidate(self, handle) -> None:
         # version-blind match: a cached plan pins a SNAPSHOT of its
         # tables (planner pin_snapshot), and a write/commit must drop
@@ -686,6 +742,7 @@ class PlanCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "replans": self.replans,
             }
 
 

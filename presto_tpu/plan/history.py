@@ -41,6 +41,24 @@ tools/check_history_sites.py):
    planning by the runner (gated on session ``enable_history_stats``;
    ``false`` — or no configured store — leaves every estimate
    bit-exact pre-PR).
+
+4. **The adaptive-execution epoch plane** (ROADMAP item 2 — Presto's
+   HBO + adaptive-execution direction): every node fingerprint
+   carries a monotonic in-memory **epoch**
+   (:meth:`QueryHistoryStore.epoch_of`), bumped when
+   :meth:`record_query` *materially* changes the learned cardinality —
+   relative change beyond the store's divergence factor
+   (``adaptive.divergence-factor``), judged by :func:`diverged`, the
+   ONE divergence test both adaptive layers share (the statement-cache
+   replan seam in plan/canonical.py and the runtime join-strategy
+   switch in the coordinator). Small drift does NOT bump the epoch, so
+   cached plans survive noise. :func:`capture_consults` records which
+   fingerprints (and which estimates) a planning pass consulted — the
+   evidence a plan-cache entry is later re-validated against — and
+   :func:`with_overrides` installs mid-query OBSERVED cardinalities so
+   the coordinator can re-rank not-yet-scheduled joins by runtime
+   truth. Epochs are process-local (like the plan cache they version)
+   and never persist.
 """
 
 from __future__ import annotations
@@ -58,6 +76,34 @@ from presto_tpu.plan import nodes as N
 
 #: records per on-disk segment file before rotation
 _SEGMENT_ENTRIES_MIN = 8
+
+#: relative change beyond which a learned cardinality is considered to
+#: CONTRADICT an estimate (tier-1 ``adaptive.divergence-factor`` /
+#: session ``adaptive_divergence_factor``)
+DEFAULT_DIVERGENCE_FACTOR = 4.0
+
+
+def diverged(estimate, observed, factor: float) -> bool:
+    """The ONE divergence test both adaptive layers share: does the
+    observed cardinality contradict the estimate beyond ``factor``
+    (symmetric ratio — a 4x factor flags both 4x-over and 4x-under)?
+    None/negative inputs never diverge — missing evidence must keep
+    plans, not invalidate them."""
+    if estimate is None or observed is None:
+        return False
+    try:
+        e = float(estimate)
+        o = float(observed)
+    except (TypeError, ValueError):
+        return False
+    if e < 0 or o < 0:
+        # negative = an unknown-sentinel (FilterSummary.rows uses -1),
+        # never real evidence — checked BEFORE the floor clamp, which
+        # would otherwise read -1 as "1 row" and diverge spuriously
+        return False
+    e, o = max(e, 1.0), max(o, 1.0)
+    f = max(float(factor), 1.0)
+    return o > e * f or e > o * f
 
 
 # ------------------------------------------------- canonical fingerprints
@@ -210,6 +256,50 @@ def active_store() -> Optional["QueryHistoryStore"]:
     return getattr(_SCOPE, "store", None)
 
 
+@contextlib.contextmanager
+def capture_consults():
+    """Record every fingerprint :func:`lookup_rows` is asked about
+    inside this scope, mapping it to the evidence the plan was built
+    on: ``{"epoch": store epoch at consult time, "rows": learned
+    cardinality or None, "est": the classic estimate used on a miss}``.
+    The runner wraps canonical-statement planning in this and stores
+    the captured dict on the plan-cache entry — the replan seam
+    (plan/canonical.stale_consults) later re-validates the entry
+    against it. Nests inside :func:`using`."""
+    prev = getattr(_SCOPE, "consulted", None)
+    con: Dict[str, dict] = {}
+    _SCOPE.consulted = con
+    try:
+        yield con
+    finally:
+        _SCOPE.consulted = prev
+
+
+@contextlib.contextmanager
+def with_overrides(rows_by_fp: Optional[Dict[str, float]]):
+    """Install mid-query OBSERVED cardinalities (node fingerprint ->
+    rows) as the highest-priority estimate source for the current
+    thread — the coordinator's runtime adaptation re-ranks the
+    not-yet-scheduled join remainder under this after each executed
+    stage reports its true output rows. Works with or without a
+    backing store (overrides are consulted before it)."""
+    prev = getattr(_SCOPE, "overrides", None)
+    prev_memo = getattr(_SCOPE, "memo", None)
+    prev_sigs = getattr(_SCOPE, "sigs", None)
+    _SCOPE.overrides = dict(rows_by_fp or {})
+    # fingerprint computation inside lookup_rows rides the scope memo;
+    # give overrides-only scopes (no store installed) one too
+    if prev_memo is None:
+        _SCOPE.memo = {}
+        _SCOPE.sigs = {}
+    try:
+        yield
+    finally:
+        _SCOPE.overrides = prev
+        _SCOPE.memo = prev_memo
+        _SCOPE.sigs = prev_sigs
+
+
 def _pinned_signature(node: N.PlanNode, sigs: dict) -> str:
     """Subtree signature memoized ACROSS lookup calls within one scope:
     planner join ordering builds fresh candidate trees around shared
@@ -235,10 +325,14 @@ def lookup_rows(node: N.PlanNode) -> Optional[float]:
     """Observed output rows for ``node``'s canonical sub-fingerprint,
     or None (no active store / no history). The ONE read path
     ``optimizer.estimate_rows`` consults (lint:
-    tools/check_history_sites.py). Never raises — a broken store must
-    degrade to classic estimation, not fail planning."""
+    tools/check_history_sites.py). Mid-query runtime observations
+    (:func:`with_overrides`) take precedence over the store; an
+    active :func:`capture_consults` scope records the evidence every
+    consult returned. Never raises — a broken store must degrade to
+    classic estimation, not fail planning."""
     store = getattr(_SCOPE, "store", None)
-    if store is None:
+    overrides = getattr(_SCOPE, "overrides", None)
+    if store is None and not overrides:
         return None
     try:
         memo = getattr(_SCOPE, "memo", None)
@@ -254,9 +348,48 @@ def lookup_rows(node: N.PlanNode) -> Optional[float]:
             if memo is not None:
                 # keep the node referenced so its id cannot be reused
                 memo[id(node)] = (node, fp)
-        return store.lookup(fp)
+        if overrides:
+            got = overrides.get(fp)
+            if got is not None:
+                return float(got)
+        if store is None:
+            return None
+        got = store.lookup(fp)
+        con = getattr(_SCOPE, "consulted", None)
+        if con is not None and fp not in con:
+            con[fp] = {
+                "epoch": store.epoch_of(fp),
+                "rows": got,
+                "est": None,
+            }
+        return got
     except Exception:
         return None
+
+
+def note_estimate(node: N.PlanNode, rows: float) -> None:
+    """Record the CLASSIC estimate the optimizer fell back to for a
+    consulted node with no history — the base the replan divergence
+    test compares the first learned cardinality against
+    (``optimizer.estimate_rows`` is the one caller). No active capture
+    scope = no-op; never raises."""
+    con = getattr(_SCOPE, "consulted", None)
+    if con is None:
+        return
+    try:
+        memo = getattr(_SCOPE, "memo", None)
+        ent = memo.get(id(node)) if memo is not None else None
+        if ent is None or ent[0] is not node:
+            return
+        cap = con.get(ent[1])
+        if (
+            cap is not None
+            and cap.get("rows") is None
+            and cap.get("est") is None
+        ):
+            cap["est"] = float(rows)
+    except Exception:
+        pass
 
 
 # -------------------------------------------------------------- the store
@@ -269,9 +402,17 @@ class QueryHistoryStore:
     sub-fingerprints. One record per completed query (latest record of
     a statement wins)."""
 
-    def __init__(self, path: str, max_entries: int = 256):
+    def __init__(
+        self,
+        path: str,
+        max_entries: int = 256,
+        divergence_factor: float = DEFAULT_DIVERGENCE_FACTOR,
+    ):
         self.path = path
         self.max_entries = max(int(max_entries), 1)
+        #: relative change beyond which a re-learned cardinality bumps
+        #: its fingerprint's epoch (tier-1 adaptive.divergence-factor)
+        self.divergence_factor = max(float(divergence_factor), 1.0)
         self._seg_entries = max(
             _SEGMENT_ENTRIES_MIN, self.max_entries // 4
         )
@@ -280,6 +421,13 @@ class QueryHistoryStore:
         self._index: "OrderedDict[str, dict]" = OrderedDict()
         #: node sub-fingerprint -> latest observed output rows
         self._nodes: Dict[str, float] = {}
+        #: node sub-fingerprint -> monotonic epoch, bumped when a
+        #: record MATERIALLY changes the learned cardinality (first
+        #: learn included — new evidence versus no evidence). Process-
+        #: local, like the plan-cache entries it versions: never
+        #: persisted, never reset by eviction (monotonicity is the
+        #: staleness signal).
+        self._epochs: Dict[str, int] = {}
         self.hits = 0
         self.misses = 0
         self.writes = 0
@@ -431,6 +579,21 @@ class QueryHistoryStore:
                     self._gc_segments()
             except OSError:
                 pass  # a full/broken disk must never fail the query
+            # epoch plane: a record that MATERIALLY changes a learned
+            # cardinality (or learns one for the first time) bumps the
+            # node's epoch — the cheap staleness signal plan-cache
+            # entries compare against. Small drift keeps the epoch:
+            # noise must not invalidate every warm plan.
+            for nfp, nd in nodes.items():
+                try:
+                    new_rows = float(nd["rows"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                prev_rows = self._nodes.get(nfp)
+                if prev_rows is None or diverged(
+                    prev_rows, new_rows, self.divergence_factor
+                ):
+                    self._epochs[nfp] = self._epochs.get(nfp, 0) + 1
             prev = self._index.get(stmt_fp)
             self._apply(rec)
             evicted = self._shrink_index()
@@ -494,6 +657,20 @@ class QueryHistoryStore:
         REGISTRY.counter("history.hit").update()
         return got
 
+    def epoch_of(self, fp: str) -> int:
+        """Current epoch of one node fingerprint (0 = never learned /
+        never materially changed in this process). Metric-silent: the
+        plan-cache staleness check must not skew history.hit/miss."""
+        with self._lock:
+            return self._epochs.get(fp, 0)
+
+    def learned_rows(self, fp: str) -> Optional[float]:
+        """Latest learned cardinality for one node fingerprint,
+        metric-silent (the replan seam's read — see ``lookup`` for
+        the counted estimate-time path)."""
+        with self._lock:
+            return self._nodes.get(fp)
+
     # ----------------------------------------------------- introspection
 
     def stats(self) -> dict:
@@ -521,6 +698,18 @@ class QueryHistoryStore:
                         "node_count": len(nodes),
                         "total_rows": sum(
                             int(n.get("rows", 0)) for n in nodes.values()
+                        ),
+                        # adaptive-execution staleness signal: the
+                        # newest epoch among this statement's recorded
+                        # operator fingerprints (the statement-level
+                        # view of what epoch-versioned plan-cache
+                        # entries are judged by)
+                        "epoch": max(
+                            (
+                                self._epochs.get(nfp, 0)
+                                for nfp in nodes
+                            ),
+                            default=0,
                         ),
                         "updated": float(rec.get("ts", 0.0)),
                     }

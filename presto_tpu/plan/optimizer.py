@@ -161,7 +161,13 @@ def estimate_rows(node: N.PlanNode, catalogs) -> float:
     got = history.lookup_rows(node)
     if got is not None:
         return max(float(got), 1.0)
-    return _estimate_rows_classic(node, catalogs)
+    rows = _estimate_rows_classic(node, catalogs)
+    # adaptive execution: an active capture scope remembers the classic
+    # estimate a history MISS fell back to — the base the replan seam's
+    # divergence test compares the first learned cardinality against
+    # (no-op outside a capture scope)
+    history.note_estimate(node, rows)
+    return rows
 
 
 def estimate_rows_with_source(

@@ -498,6 +498,24 @@ class CoordinatorServer:
         pcen = config.get("plan.cache-enabled") if config else None
         if pcen is not None:
             self.local.session.set("enable_plan_cache", bool(pcen))
+        # adaptive execution (epoch-versioned replanning + runtime
+        # join-strategy switching): tier-1 keys seed the session
+        # defaults, and the divergence factor also drives the history
+        # store's epoch bumps (one factor, both layers)
+        ad_on = config.get("adaptive.enabled") if config else None
+        if ad_on is not None:
+            self.local.session.set("adaptive_enabled", bool(ad_on))
+        ad_factor = (
+            config.get("adaptive.divergence-factor") if config else None
+        )
+        if ad_factor is not None:
+            self.local.session.set(
+                "adaptive_divergence_factor", float(ad_factor)
+            )
+            if self.local.history_store is not None:
+                self.local.history_store.divergence_factor = max(
+                    float(ad_factor), 1.0
+                )
         # micro-batched serving: tier-1 serving.* keys seed the session
         # defaults (0 = off = bit-exact pre-batching dispatch), and the
         # ONE batch queue fronts this coordinator's local dispatch
@@ -2012,7 +2030,11 @@ class CoordinatorServer:
         slowness, task failure, or worker death degrade to ``None`` —
         the caller runs the exact unfiltered plan (never blocks, never
         fails the query). Returns None or a
-        ``(fragment, partition_scan, ranges)`` override triple."""
+        ``(fragment, partition_scan, ranges, adapt_obs)`` override
+        tuple — ``adapt_obs`` (adaptive execution) carries the build
+        side's OBSERVED cardinality beside the estimate it was planned
+        on, turning this barrier into the runtime decision point
+        ``_run_stage`` consults before the probe schedules."""
         from presto_tpu.exec import dynfilter
         from presto_tpu.server.scheduler import _path_to, _replace_on_path
 
@@ -2096,10 +2118,33 @@ class CoordinatorServer:
             REGISTRY.counter("dynamic_filter.wait_expired").update()
             return None
         REGISTRY.counter("dynamic_filter.built").update()
+        # adaptive execution: the merged summary's observed build
+        # cardinality is runtime TRUTH about the estimate this join's
+        # distribution was chosen on — hand it to the decision point
+        # in _run_stage (returned, not stashed on q: independent
+        # fragments run _run_stage concurrently on one query)
+        adapt_obs = None
+        if session.get("adaptive_enabled") and summary.rows >= 0:
+            from presto_tpu.plan import optimizer
+
+            try:
+                with self.local._history_scope():
+                    est = float(
+                        optimizer.estimate_rows(
+                            J.right, self.local.catalogs
+                        )
+                    )
+            except Exception:
+                est = None
+            adapt_obs = {
+                "join": J,
+                "observed": int(summary.rows),
+                "estimate": est,
+            }
         probe_cols = [(lk, left_schema[lk]) for lk, _ in pairs]
         pred = dynfilter.to_predicate(summary, probe_cols)
         if pred is None:
-            return None
+            return None, None, None, adapt_obs
         # count the conjuncts actually fused (a merged summary column
         # can lose its value set past the NDV cap and contribute none)
         n_filters = dynfilter.applicable_count(summary, probe_cols)
@@ -2148,7 +2193,7 @@ class CoordinatorServer:
                     q, stage, part_scan, con,
                     deadline=t0 + 2.0 * wait_s,
                 )
-        return new_frag, new_idx, ranges
+        return new_frag, new_idx, ranges, adapt_obs
 
     def _run_dynfilter_summary(
         self, q: _Query, bstage, workers, keys, ndv, deadline
@@ -2347,6 +2392,163 @@ class CoordinatorServer:
                 lo += chunk
         return ranges or [(0, 0)]
 
+    # -------------------------------------------- adaptive execution
+    #
+    # Runtime strategy switching at the build-summary barrier (ROADMAP
+    # item 2, Presto's adaptive-execution direction): the dynamic-
+    # filter plane already runs a join's build subtree FIRST and
+    # reports its true cardinality before the probe schedules — these
+    # helpers turn that into a decision point. Strategy-switch
+    # construction lives HERE and in exec/dynfilter.py only
+    # (tools/analyze.py ``adaptive-plane`` rule); every lane fails
+    # OPEN to the original plan, and ``adaptive.enabled=false`` never
+    # reaches any of it.
+
+    def _adaptive_note(self, q: _Query, note: str) -> None:
+        """Record one adaptive decision on the query (the ``adapted``
+        QueryInfo flag + the EXPLAIN ANALYZE ``adaptive:`` line)."""
+        with q._stats_lock:
+            q.stats.adapted = True
+            q.stats.adaptive_notes.append(note)
+
+    def _adaptive_nparts(self, observed: int, workers) -> int:
+        """Resize the shuffle partition count to the OBSERVED build
+        cardinality: one partition per ``page_capacity`` rows, clamped
+        to the worker pool — a small-but-mispredicted build must not
+        fan a near-empty hash exchange across every worker."""
+        cap = max(int(self.local.session.get("page_capacity")), 1)
+        return max(1, min(len(workers), -(-int(observed) // cap)))
+
+    def _adaptive_maybe_switch(
+        self, q: _Query, fragment_root, obs: dict, workers
+    ):
+        """Broadcast->partitioned direction: the stage was headed for
+        a replicated-build join, and the build summary observed a
+        cardinality that contradicts the estimate beyond the
+        divergence factor AND exceeds the broadcast bound. Returns the
+        fragment's result page (the switched join ran + the remainder
+        spliced), or None — keep the original plan."""
+        from presto_tpu.plan import history as plan_history
+
+        session = self.local.session
+        est, observed = obs.get("estimate"), obs.get("observed")
+        factor = float(session.get("adaptive_divergence_factor"))
+        if est is None or observed is None:
+            return None
+        if not plan_history.diverged(est, observed, factor):
+            return None
+        REGISTRY.counter("adaptive.divergence_detected").update()
+        jdt = str(session.get("join_distribution_type")).upper()
+        if (
+            observed <= int(session.get("join_max_broadcast_rows"))
+            or len(workers) <= 1
+            or jdt not in ("AUTOMATIC", "AUTO")
+        ):
+            return None
+        J = obs["join"]
+        # both sides must admit cut-free source-partitioned producer
+        # stages — the same qualification _choose_partitioned_join
+        # applies (estimates said "broadcast" so it never planned them)
+        side_stages = []
+        for side in (J.left, J.right):
+            st = plan_stage(side, self.local.catalogs)
+            if st is None or not isinstance(
+                st.final_root, N.RemoteSourceNode
+            ):
+                return None
+            side_stages.append(st)
+        from presto_tpu.server.scheduler import (
+            _path_to,
+            _replace_on_path,
+        )
+
+        path = None
+        if J is not fragment_root:
+            # resolve the remainder splice BEFORE running anything: a
+            # join we cannot splice back must not execute twice
+            path = _path_to(fragment_root, J)
+            if path is None:
+                return None
+        nparts = self._adaptive_nparts(observed, workers)
+        page = self._run_one_partitioned_join(
+            J, side_stages, workers, q, nparts=nparts
+        )
+        if path is not None:
+            # re-plan ONLY the not-yet-scheduled remainder: the
+            # executed join splices in as a remote page and everything
+            # above it runs over the splice
+            remote = N.RemoteSourceNode(fragment_root=J)
+            root = _replace_on_path(path[:-1], J, remote)
+            leaves, pages = self.local.leaf_pages(
+                root, {id(remote): page}
+            )
+            page = self.local._run_with_pages(root, leaves, pages)
+        # count + note only once the switched plan ACTUALLY answered:
+        # a splice failure falls back to the original plan (the
+        # caller's fail-open catch), and stats must not claim a switch
+        # that was rolled back
+        REGISTRY.counter("adaptive.strategy_switches").update()
+        self._adaptive_note(
+            q,
+            f"SWITCHED broadcast→partitioned (est {est:.0f} rows, "
+            f"observed {observed}, parts {nparts})",
+        )
+        return page
+
+    def _adaptive_probe_build(
+        self, q: _Query, J, side_stages, workers, observed_fp: dict
+    ):
+        """Partitioned->broadcast direction's evidence gatherer: before
+        committing a candidate join's two sides to producer stages, run
+        the BUILD subtree as a dynamic-filter-style summary stage (the
+        same machinery and the same ``dynamic_filtering_wait_ms``
+        budget as PR 4's plane) and report its observed cardinality
+        beside the estimate. The observation also lands in
+        ``observed_fp`` so the remaining join sequence re-ranks by
+        runtime truth. Returns ``{"estimate", "observed"}`` or None —
+        no budget, or any failure (fail-open: the partitioned plan
+        proceeds as estimated)."""
+        from presto_tpu.plan import history as plan_history
+        from presto_tpu.plan import optimizer
+
+        wait_s = (
+            float(self.local.session.get("dynamic_filtering_wait_ms"))
+            / 1000.0
+        )
+        if wait_s <= 0:
+            return None
+        bstage = side_stages[1]
+        build_schema = dict(bstage.worker_fragment.output_schema())
+        keys = [rk for rk in J.right_keys if rk in build_schema]
+        if not keys:
+            return None
+        try:
+            with plan_history.with_overrides(observed_fp):
+                with self.local._history_scope():
+                    est = float(
+                        optimizer.estimate_rows(
+                            J.right, self.local.catalogs
+                        )
+                    )
+            ndv = int(
+                self.local.session.get("dynamic_filtering_ndv_limit")
+            )
+            summary = self._run_dynfilter_summary(
+                q, bstage, workers, keys, ndv,
+                deadline=time.monotonic() + wait_s,
+            )
+        except Exception:
+            return None
+        if summary is None or summary.rows < 0:
+            return None
+        try:
+            observed_fp[plan_history.node_fingerprint(J.right)] = float(
+                summary.rows
+            )
+        except Exception:
+            pass
+        return {"estimate": est, "observed": int(summary.rows)}
+
     # ------------------------------------------------------- stage runner
 
     def _run_stage(
@@ -2400,9 +2602,31 @@ class CoordinatorServer:
                 "unfiltered", q.qid, exc_info=True,
             )
             dyn = None
-        dyn_fragment, dyn_scan_idx, dyn_ranges = (
-            dyn if dyn is not None else (None, None, None)
+        dyn_fragment, dyn_scan_idx, dyn_ranges, adapt_obs = (
+            dyn if dyn is not None else (None, None, None, None)
         )
+        # adaptive execution: the build-summary barrier just reported
+        # the build side's TRUE cardinality. When it contradicts the
+        # estimate this join's broadcast distribution was chosen on
+        # (beyond the divergence factor) and the build is too big to
+        # replicate, flip to a hash-partitioned join and run only the
+        # not-yet-scheduled remainder over its output — fail-open to
+        # the original (possibly dyn-filtered) plan on any error,
+        # exactly like the dynamic-filter plane itself
+        if adapt_obs is not None and order_by is None:
+            try:
+                out = self._adaptive_maybe_switch(
+                    q, fragment_root, adapt_obs, workers
+                )
+            except Exception:
+                REGISTRY.counter("adaptive.plan_errors").update()
+                log.warning(
+                    "query=%s adaptive strategy switch failed; keeping "
+                    "the original plan", q.qid, exc_info=True,
+                )
+                out = None
+            if out is not None:
+                return out
         worker_fragment = (
             dyn_fragment
             if dyn_fragment is not None
@@ -2634,21 +2858,90 @@ class CoordinatorServer:
             if auto
             else None
         )
+        from presto_tpu.plan import history as plan_history
+
+        session = self.local.session
+        adaptive = bool(session.get("adaptive_enabled"))
+        factor = float(session.get("adaptive_divergence_factor"))
+        #: adaptive execution: node fingerprint -> OBSERVED rows of
+        #: already-executed stages this query — candidate ranking for
+        #: the not-yet-scheduled remainder re-runs under these
+        #: overrides, so the join sequence re-orders by runtime truth
+        observed_fp: Dict[str, float] = {}
+        #: candidates the runtime decision point sent back to the
+        #: broadcast path (never reconsidered this query)
+        skip: set = set()
         root = fragment_root
         pages_map: Dict[int, object] = {}
         ran = False
         while True:
-            target = self._choose_partitioned_join(root, thresh)
+            target = self._choose_partitioned_join(
+                root, thresh, skip=skip,
+                observed=observed_fp if adaptive else None,
+            )
             if target is None:
                 break
             J, side_stages = target
+            nparts = None
+            if adaptive and thresh is not None:
+                # runtime decision point (fail-open inside): observe
+                # the build side through a summary stage BEFORE
+                # committing both sides to producer stages
+                obs = self._adaptive_probe_build(
+                    q, J, side_stages, workers, observed_fp
+                )
+                if obs is not None and plan_history.diverged(
+                    obs["estimate"], obs["observed"], factor
+                ):
+                    REGISTRY.counter(
+                        "adaptive.divergence_detected"
+                    ).update()
+                    if obs["observed"] <= thresh:
+                        # the build is actually broadcast-small: leave
+                        # this join to the replicated-build path (the
+                        # caller's fallback, dynamic filter included)
+                        REGISTRY.counter(
+                            "adaptive.strategy_switches"
+                        ).update()
+                        self._adaptive_note(
+                            q,
+                            "SWITCHED partitioned→broadcast (est "
+                            f"{obs['estimate']:.0f} rows, observed "
+                            f"{obs['observed']})",
+                        )
+                        skip.add(id(J))
+                        continue
+                    nparts = self._adaptive_nparts(
+                        obs["observed"], workers
+                    )
+                    if nparts != len(workers):
+                        self._adaptive_note(
+                            q,
+                            f"RESIZED shuffle to {nparts} partition(s) "
+                            f"(observed {obs['observed']} build rows)",
+                        )
             page = self._run_one_partitioned_join(
-                J, side_stages, workers, q
+                J, side_stages, workers, q, nparts=nparts
             )
             ran = True
             if J is root and not pages_map:
                 return page
             remote = N.RemoteSourceNode(fragment_root=J)
+            if adaptive:
+                # feed the executed join's TRUE output rows back into
+                # the remainder's ranking (both identities: the join
+                # subtree itself and the remote splice that now stands
+                # where it stood)
+                try:
+                    rows = float(page.num_valid)
+                    observed_fp[
+                        plan_history.node_fingerprint(J)
+                    ] = rows
+                    observed_fp[
+                        plan_history.node_fingerprint(remote)
+                    ] = rows
+                except Exception:
+                    pass
             from presto_tpu.server.scheduler import (
                 _path_to,
                 _replace_on_path,
@@ -2662,19 +2955,47 @@ class CoordinatorServer:
         leaves, pages = self.local.leaf_pages(root, pages_map)
         return self.local._run_with_pages(root, leaves, pages)
 
-    def _choose_partitioned_join(self, root, thresh: Optional[int]):
+    def _choose_partitioned_join(
+        self, root, thresh: Optional[int], skip=(), observed=None
+    ):
         """Best qualifying join for a partitioned stage, or None.
 
         Qualifying: an equi-join whose sides BOTH admit cut-free
         source-partitioned stages. With ``thresh`` (AUTOMATIC mode) the
         min-side row estimate must exceed it, and candidates rank by
         that estimate — the join where replicating the smaller side
-        would ship the most rows wins first."""
+        would ship the most rows wins first.
+
+        Adaptive execution: ``skip`` holds joins the runtime decision
+        point sent back to the broadcast path, and ``observed`` (node
+        fingerprint -> rows of already-executed stages) re-ranks the
+        remainder under plan/history.with_overrides — observed
+        cardinality outranks the estimate it contradicted. Both
+        default empty = today's ranking, bit-exact."""
+        import contextlib
+
+        from presto_tpu.plan import history as plan_history
         from presto_tpu.plan import optimizer
 
+        if observed:
+            scope = contextlib.ExitStack()
+            scope.enter_context(plan_history.with_overrides(observed))
+            scope.enter_context(self.local._history_scope())
+        else:
+            scope = contextlib.nullcontext()
+        with scope:
+            return self._choose_partitioned_join_ranked(
+                root, thresh, skip, optimizer
+            )
+
+    def _choose_partitioned_join_ranked(
+        self, root, thresh: Optional[int], skip, optimizer
+    ):
         cands = []
         for J in N.walk(root):
             if not isinstance(J, N.JoinNode) or not J.left_keys:
+                continue
+            if id(J) in skip:
                 continue
             # a side spliced with a prior iteration's materialized
             # RemoteSourceNode cannot run as a producer stage (workers
@@ -2722,13 +3043,21 @@ class CoordinatorServer:
                 return (J, stages)
         return None
 
-    def _run_one_partitioned_join(self, J, side_stages, workers, q):
+    def _run_one_partitioned_join(
+        self, J, side_stages, workers, q, nparts=None
+    ):
         """Run ONE join as producer stages + a partitioned join stage;
-        returns the gathered join output page."""
+        returns the gathered join output page. ``nparts`` (adaptive
+        execution) overrides the partition fan-out — clamped to the
+        pool; None = one partition per worker, the legacy shape."""
         from concurrent.futures import ThreadPoolExecutor
 
         REGISTRY.counter("coordinator.partitioned_join_stages").update()
-        nparts = len(workers)
+        nparts = (
+            len(workers)
+            if nparts is None
+            else max(1, min(int(nparts), len(workers)))
+        )
         over = max(1, int(self.local.session.get("split_queue_factor")))
         created: List[tuple] = []
         clock = threading.Lock()

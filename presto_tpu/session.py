@@ -297,6 +297,34 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             True,
         ),
         PropertyMetadata(
+            "adaptive_enabled",
+            "Adaptive execution (ROADMAP item 2 — Presto's HBO + "
+            "adaptive-execution direction): statement-cache hits "
+            "whose consulted history estimates have materially "
+            "diverged REPLAN instead of serving the stale plan "
+            "(epoch-versioned plan-cache entries), and the "
+            "dynamic-filter build-summary barrier becomes a runtime "
+            "decision point — observed build rows contradicting the "
+            "estimate flip broadcast<->partitioned distribution, "
+            "re-order the not-yet-scheduled join remainder, and "
+            "resize the shuffle partition count. Every lane fails "
+            "OPEN to the original plan. False (the default) = "
+            "bit-exact pre-adaptive behavior",
+            bool,
+            False,
+        ),
+        PropertyMetadata(
+            "adaptive_divergence_factor",
+            "Relative change beyond which a learned/observed "
+            "cardinality CONTRADICTS the estimate a plan was built "
+            "on (symmetric ratio; shared by the replan seam and the "
+            "runtime strategy switch). Tier-1 twin: "
+            "adaptive.divergence-factor",
+            float,
+            4.0,
+            _positive("adaptive_divergence_factor"),
+        ),
+        PropertyMetadata(
             "query_max_run_time_s",
             "Per-query wall-clock limit (seconds)",
             float,
@@ -509,6 +537,16 @@ class NodeConfig:
         # canonical plan fingerprints before connector stats
         "history.path": str,
         "history.max-entries": int,
+        # adaptive execution (epoch-versioned plan cache + runtime
+        # join-strategy switching at the dynamic-filter build-summary
+        # barrier): the master gate (false = bit-exact pre-adaptive;
+        # seeds the adaptive_enabled session default) and the shared
+        # divergence factor — relative change beyond which a learned /
+        # observed cardinality contradicts the estimate a plan was
+        # built on (bumps history epochs, triggers replans and
+        # broadcast<->partitioned switches)
+        "adaptive.enabled": bool,
+        "adaptive.divergence-factor": float,
         # per-operator observability (exec/stats.OperatorStats): seeds
         # the enable_operator_stats session default
         "operator-stats.enabled": bool,

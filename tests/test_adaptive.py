@@ -1,0 +1,435 @@
+"""Adaptive execution (ISSUE 15): epoch-versioned replanning on
+history divergence + mid-query join-strategy switching.
+
+Covers the acceptance surface: epoch bumps on MATERIAL divergence only
+(small drift never invalidates), statement-cache hits replanning
+against learned cardinalities (old entry replaced; replan failure
+serves the cached plan, never a failed query), adaptive-off
+bit-exactness, both runtime switch directions at the dynamic-filter
+build-summary barrier (broadcast->partitioned on an under-estimated
+build, partitioned->broadcast on an over-estimated one) with on/off
+result equality, remainder-replan after an already-scheduled stage,
+and chaos: a build-worker kill during the decision window degrades to
+the original plan with zero failed queries.
+"""
+
+import time
+
+import pytest
+
+from presto_tpu.connectors import create_connector  # noqa: E402
+from presto_tpu.exec.local_runner import LocalQueryRunner  # noqa: E402
+from presto_tpu.exec.staging import CatalogManager  # noqa: E402
+from presto_tpu.plan import canonical  # noqa: E402
+from presto_tpu.plan.history import (  # noqa: E402
+    QueryHistoryStore,
+    diverged,
+)
+from presto_tpu.utils import faults  # noqa: E402
+from presto_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+
+def _counter(name: str) -> int:
+    return int(REGISTRY.counter(name).total)
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    yield
+    faults.configure(None)
+
+
+# ------------------------------------------------------ the epoch plane
+
+
+def test_diverged_is_symmetric_and_bounded():
+    assert not diverged(100, 350, 4.0)  # 3.5x: within the factor
+    assert diverged(100, 401, 4.0)
+    assert diverged(401, 100, 4.0)  # symmetric
+    assert not diverged(None, 100, 4.0)
+    assert not diverged(100, None, 4.0)
+    assert not diverged(0, 3, 4.0)  # clamped floor: 1 vs 3
+    # negative = unknown-sentinel (FilterSummary.rows uses -1): never
+    # evidence, never a divergence
+    assert not diverged(5000, -1, 4.0)
+    assert not diverged(-1, 5000, 4.0)
+
+
+def test_epoch_bumps_on_divergence_only(tmp_path):
+    store = QueryHistoryStore(str(tmp_path), divergence_factor=4.0)
+    store.record_query("s1", "q", {"n1": {"rows": 100, "label": "x"}})
+    assert store.epoch_of("n1") == 1  # first learn = new evidence
+    store.record_query("s1", "q", {"n1": {"rows": 150, "label": "x"}})
+    assert store.epoch_of("n1") == 1  # 1.5x drift: NO bump
+    store.record_query("s1", "q", {"n1": {"rows": 1000, "label": "x"}})
+    assert store.epoch_of("n1") == 2  # ~6.7x: material change
+    assert store.learned_rows("n1") == 1000.0
+    assert store.epoch_of("never-seen") == 0
+    assert store.learned_rows("never-seen") is None
+
+
+def test_query_history_view_carries_epoch(tmp_path):
+    store = QueryHistoryStore(str(tmp_path))
+    store.record_query("s1", "q", {"s1": {"rows": 10, "label": "x"}})
+    (row,) = store.snapshot()
+    assert row["epoch"] == 1
+    store.record_query("s1", "q", {"s1": {"rows": 9000, "label": "x"}})
+    (row,) = store.snapshot()
+    assert row["epoch"] == 2
+
+
+def test_stale_consults_judges_against_captured_estimate(tmp_path):
+    store = QueryHistoryStore(str(tmp_path), divergence_factor=4.0)
+    # the entry planned on a classic estimate of 50 for n1 (a miss)
+    consulted = {"n1": {"epoch": 0, "rows": None, "est": 50.0}}
+    assert canonical.stale_consults(consulted, store, 4.0) is None
+    # learning 60 bumps the epoch (first learn) but 60 vs 50 is NOT
+    # material — the plan survives the bump
+    store.record_query("s", "q", {"n1": {"rows": 60, "label": "x"}})
+    assert store.epoch_of("n1") == 1
+    assert canonical.stale_consults(consulted, store, 4.0) is None
+    # re-learning 5000 is material versus the captured base
+    store.record_query("s", "q", {"n1": {"rows": 5000, "label": "x"}})
+    got = canonical.stale_consults(consulted, store, 4.0)
+    assert got == ("n1", 0, 2)
+
+
+def test_stale_consults_honors_tighter_session_factor(tmp_path):
+    """A session divergence factor TIGHTER than the store's epoch-bump
+    factor must still replan: the epoch pre-filter only applies when
+    the caller's factor is at least the store's (a 3x drift bumps no
+    epoch at the store's 4x, but a factor-2 caller must see it)."""
+    store = QueryHistoryStore(str(tmp_path), divergence_factor=4.0)
+    store.record_query("s", "q", {"n1": {"rows": 100, "label": "x"}})
+    consulted = {"n1": {"epoch": 1, "rows": 100.0, "est": None}}
+    store.record_query("s", "q", {"n1": {"rows": 300, "label": "x"}})
+    assert store.epoch_of("n1") == 1  # 3x: no bump at the store's 4x
+    assert canonical.stale_consults(consulted, store, 4.0) is None
+    got = canonical.stale_consults(consulted, store, 2.0)
+    assert got is not None and got[0] == "n1"
+
+
+# --------------------------------------------- statement-cache replan
+
+#: every row of the build table carries key 7, so the classic
+#: ``k = 7 and v > -1e6`` selectivity math (0.1 x 0.33 with no column
+#: stats on the memory connector) under-estimates the build ~30x
+_SKEW_SQL = (
+    "select count(*) as n, sum(s.v) as sv "
+    "from mem.default.adaptive_skew s "
+    "join tpch.tiny.customer c on s.k = c.c_custkey "
+    "where s.k = 7 and s.v > -1000000"
+)
+
+
+def _skew_runner(tmp_path, adaptive: bool) -> LocalQueryRunner:
+    r = LocalQueryRunner(history_path=str(tmp_path / "hist"))
+    r.session.set("adaptive_enabled", "true" if adaptive else "false")
+    r.catalogs.register("mem", create_connector("memory"))
+    r.execute(
+        "create table mem.default.adaptive_skew as "
+        "select 7 as k, c_acctbal as v from tpch.tiny.customer"
+    )
+    return r
+
+
+def test_cache_hit_replan_serves_new_plan(tmp_path):
+    r = _skew_runner(tmp_path, adaptive=True)
+    replans0 = _counter("plan.replans")
+    div0 = _counter("adaptive.divergence_detected")
+    cold = r.execute(_SKEW_SQL).rows()
+    (key, entry_before) = next(
+        (k, e)
+        for k, e in r.plan_cache._od.items()
+        if isinstance(e, canonical.PlanCacheEntry)
+    )
+    assert entry_before.consulted, "planning must capture its consults"
+    warm = r.execute(_SKEW_SQL).rows()
+    assert warm == cold
+    assert _counter("plan.replans") == replans0 + 1
+    assert _counter("adaptive.divergence_detected") == div0 + 1
+    # the stale entry was REPLACED, not served
+    entry_after = r.plan_cache._od[key]
+    assert entry_after is not entry_before
+    assert r.plan_cache.replans == 1
+    assert r.plan_cache.stats()["replans"] == 1
+    # steady state: the replanned entry's consulted evidence matches
+    # today's history — a third run serves it without replanning
+    warm2 = r.execute(_SKEW_SQL).rows()
+    assert warm2 == cold
+    assert _counter("plan.replans") == replans0 + 1
+
+
+def test_small_drift_does_not_invalidate(tmp_path):
+    r = _skew_runner(tmp_path, adaptive=True)
+    r.execute(_SKEW_SQL)
+    r.execute(_SKEW_SQL)  # the one replan
+    replans0 = _counter("plan.replans")
+    # re-recording identical actuals is zero drift: no epoch bumps, no
+    # further replans — the hot shape stays zero-planning
+    for _ in range(3):
+        r.execute(_SKEW_SQL)
+    assert _counter("plan.replans") == replans0
+
+
+def test_replan_failure_serves_cached_plan(tmp_path):
+    r = _skew_runner(tmp_path, adaptive=True)
+    cold = r.execute(_SKEW_SQL).rows()
+    fails0 = _counter("plan.replan_failures")
+    replans0 = _counter("plan.replans")
+    orig = r._plan_statement
+
+    def boom(stmt):
+        raise RuntimeError("injected replan failure")
+
+    r._plan_statement = boom
+    try:
+        warm = r.execute(_SKEW_SQL).rows()
+    finally:
+        r._plan_statement = orig
+    # the divergence WAS detected, the replan failed, and the cached
+    # plan answered — never a failed query
+    assert warm == cold
+    assert _counter("plan.replan_failures") == fails0 + 1
+    assert _counter("plan.replans") == replans0
+
+
+def test_adaptive_off_is_bit_exact(tmp_path):
+    r = _skew_runner(tmp_path, adaptive=False)
+    replans0 = _counter("plan.replans")
+    div0 = _counter("adaptive.divergence_detected")
+    cold = r.execute(_SKEW_SQL).rows()
+    warm = r.execute(_SKEW_SQL).rows()
+    assert warm == cold
+    # off = the pre-adaptive world: zero divergence checks, zero
+    # replans, the warm run is a plain statement-cache hit
+    assert _counter("plan.replans") == replans0
+    assert _counter("adaptive.divergence_detected") == div0
+    assert r.plan_cache.hits >= 1
+    assert r.plan_cache.replans == 0
+
+
+def test_runtime_query_history_epoch_column(tmp_path):
+    r = _skew_runner(tmp_path, adaptive=True)
+    r.execute(_SKEW_SQL)
+    rows = r.execute(
+        "select fingerprint, epoch from system.runtime.query_history"
+    ).rows()
+    assert rows and all(int(e) >= 1 for _fp, e in rows)
+
+
+# ------------------------------------------- runtime strategy switching
+
+
+def _wait_workers(coord, n, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(coord.active_workers()) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("workers not discovered")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """2-worker cluster with adaptive on (no history store: the
+    runtime layer acts on classic estimates vs observed rows) and a
+    SHARED memory connector so worker scans see coordinator writes."""
+    from presto_tpu.server import (
+        CoordinatorServer,
+        PrestoTpuClient,
+        WorkerServer,
+    )
+    from presto_tpu.session import NodeConfig
+
+    mem = create_connector("memory")
+
+    def catalogs():
+        c = CatalogManager()
+        c.register("tpch", create_connector("tpch"))
+        c.register("mem", mem)
+        return c
+
+    cfg = NodeConfig({"adaptive.enabled": "true"})
+    coord = CoordinatorServer(config=cfg, catalogs=catalogs()).start()
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri, config=cfg, catalogs=catalogs()
+        ).start()
+        for _ in range(2)
+    ]
+    _wait_workers(coord, 2)
+    client = PrestoTpuClient(coord.uri, timeout_s=300)
+    # under-estimated build: every row passes f = 7 but the memory
+    # connector has no column stats, so classic math says 10%
+    client.execute(
+        "create table mem.default.skew as "
+        "select o_orderkey as k, 7 as f from tpch.tiny.orders"
+    )
+    # over-estimated build: v = 999999 matches NOTHING but is
+    # classically estimated at 10% of 60k rows
+    client.execute(
+        "create table mem.default.big as "
+        "select l_orderkey as k, l_linenumber as v "
+        "from tpch.tiny.lineitem"
+    )
+    coord.local.session.set("join_max_broadcast_rows", "2000")
+    coord.local.session.set("page_capacity", "8192")
+    yield coord, workers, client
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    coord.shutdown()
+
+
+def _adaptive_on_off(coord, client, sql):
+    """Run ``sql`` with adaptive OFF (the oracle) then ON; return both
+    results and the ON run's coordinator query."""
+    coord.local.session.set("adaptive_enabled", "false")
+    try:
+        off = client.execute(sql).data
+    finally:
+        coord.local.session.set("adaptive_enabled", "true")
+    res = client.execute(sql)
+    return off, res.data, coord.queries[res.query_id]
+
+
+_UNDER_SQL = (
+    "select count(*) as n, sum(l.l_extendedprice) as s "
+    "from tpch.tiny.lineitem l join mem.default.skew s "
+    "on l.l_orderkey = s.k where s.f = 7"
+)
+
+_OVER_SQL = (
+    "select count(*) as n "
+    "from tpch.tiny.lineitem l join mem.default.big b "
+    "on l.l_orderkey = b.k where b.v = 999999"
+)
+
+
+def test_switch_broadcast_to_partitioned(cluster):
+    """The build summary observes 15000 rows where the estimate said
+    1500: past the divergence factor AND the broadcast bound, so the
+    not-yet-scheduled probe+join remainder re-plans as a partitioned
+    join — results bit-equal to the un-adapted plan."""
+    coord, _workers, client = cluster
+    sw0 = _counter("adaptive.strategy_switches")
+    pj0 = _counter("coordinator.partitioned_join_stages")
+    off, on, q = _adaptive_on_off(coord, client, _UNDER_SQL)
+    assert on == off
+    assert _counter("adaptive.strategy_switches") == sw0 + 1
+    # the switched join really ran partitioned
+    assert _counter("coordinator.partitioned_join_stages") == pj0 + 1
+    assert q.stats.adapted
+    assert any(
+        "SWITCHED broadcast→partitioned" in n
+        for n in q.stats.adaptive_notes
+    )
+    # rolled into QueryInfo
+    info = coord.query_info(q)
+    assert info["adapted"] is True
+    assert info["replanned"] is False
+
+
+def test_switch_partitioned_to_broadcast(cluster):
+    """The estimates pick a partitioned join (both sides 'big'), the
+    build probe observes an (actually empty) build far below the
+    broadcast bound: the join goes back to the replicated-build path —
+    zero partitioned stages, equal results."""
+    coord, _workers, client = cluster
+    sw0 = _counter("adaptive.strategy_switches")
+    off_pj0 = _counter("coordinator.partitioned_join_stages")
+    coord.local.session.set("adaptive_enabled", "false")
+    try:
+        off = client.execute(_OVER_SQL).data
+    finally:
+        coord.local.session.set("adaptive_enabled", "true")
+    # adaptive OFF runs it partitioned, as estimated
+    assert _counter("coordinator.partitioned_join_stages") == off_pj0 + 1
+    pj0 = _counter("coordinator.partitioned_join_stages")
+    res = client.execute(_OVER_SQL)
+    q = coord.queries[res.query_id]
+    assert res.data == off
+    assert _counter("adaptive.strategy_switches") == sw0 + 1
+    assert _counter("coordinator.partitioned_join_stages") == pj0
+    assert any(
+        "SWITCHED partitioned→broadcast" in n
+        for n in q.stats.adaptive_notes
+    )
+
+
+def test_remainder_replan_after_scheduled_stage(cluster):
+    """The decision window opens only after a stage has ALREADY been
+    scheduled (the build-summary tasks ran on workers); only the
+    not-yet-scheduled remainder re-plans. The ON run must carry both
+    the scheduled dynfilter stage and the switched join's stages."""
+    coord, _workers, client = cluster
+    off, on, q = _adaptive_on_off(coord, client, _UNDER_SQL)
+    assert on == off
+    kinds = [s.kind for s in q.stats.stages]
+    assert "dynfilter" in kinds  # the already-scheduled decision stage
+    assert "producer" in kinds and "join" in kinds  # the re-planned rest
+
+
+def test_switch_renders_in_explain_analyze(cluster):
+    coord, _workers, client = cluster
+    res = client.execute("explain analyze " + _UNDER_SQL)
+    text = "\n".join(r[0] for r in res.data)
+    assert "adaptive: SWITCHED broadcast→partitioned" in text
+
+
+def test_switch_resizes_partition_count(cluster):
+    """The switched shuffle is sized by the OBSERVED build (one
+    partition per page_capacity rows, clamped to the pool) — recorded
+    on the decision note."""
+    coord, _workers, client = cluster
+    _off, _on, q = _adaptive_on_off(coord, client, _UNDER_SQL)
+    note = next(
+        n for n in q.stats.adaptive_notes if "SWITCHED broadcast" in n
+    )
+    # observed 15000 rows / page_capacity 8192 -> 2 partitions
+    assert "parts 2" in note
+
+
+def test_build_worker_kill_during_decision_window(cluster):
+    """Chaos: the worker running the build-summary (decision) task is
+    killed mid-window. The barrier degrades exactly like the dynamic-
+    filter plane — the ORIGINAL plan runs, the query succeeds, and no
+    strategy switch is claimed."""
+    from presto_tpu.server import WorkerServer
+
+    coord, workers, client = cluster
+    spare = WorkerServer(
+        coordinator_uri=coord.uri,
+        catalogs=workers[0].runner.catalogs,
+    ).start()
+    try:
+        _wait_workers(coord, 3)
+        sw0 = _counter("adaptive.strategy_switches")
+        faults.configure(
+            {
+                "rules": [
+                    {
+                        "action": "kill_worker",
+                        "task": ".df.",
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        res = client.execute(_UNDER_SQL)
+        q = coord.queries[res.query_id]
+        assert q.state == "FINISHED"
+        # the dead build summary degraded: no switch was claimed on
+        # evidence that never arrived
+        assert _counter("adaptive.strategy_switches") == sw0
+        # and the answer is still exact
+        coord.local.session.set("adaptive_enabled", "false")
+        try:
+            off = client.execute(_UNDER_SQL).data
+        finally:
+            coord.local.session.set("adaptive_enabled", "true")
+        assert res.data == off
+    finally:
+        faults.configure(None)
+        spare.shutdown(graceful=False)

@@ -35,3 +35,46 @@ def test_bench_source_never_emits_zero_value_error_lines():
     src = open(bench.__file__, encoding="utf-8").read()
     assert '"value": 0' not in src
     assert src.count("skip_line(") >= 3  # def + both failure paths
+
+
+def test_every_print_site_routes_through_emit():
+    """The ONE raw print of a result line lives inside _emit — every
+    other site calls it, so the skip contract is enforced at the last
+    moment for every line the driver will ever emit (the BENCH_r04/r05
+    hole was a failure path that printed its own dict)."""
+    src = open(bench.__file__, encoding="utf-8").read()
+    assert src.count("print(json.dumps(") == 1  # _emit's own print
+    assert src.count("_emit(") >= 15
+
+
+def test_emit_converts_error_value_line_to_skip(capsys):
+    """Defense in depth: a line that somehow carries BOTH an error and
+    a value is demoted to a skip at print time — value: 0 beside an
+    error can never reach the metric trajectory again."""
+    bench._emit(
+        {
+            "metric": "tpch_q1_sf1_rows_per_sec",
+            "value": 0,
+            "unit": "rows/s",
+            "error": "Unable to initialize backend 'axon'",
+        }
+    )
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["skipped"] is True
+    assert "value" not in line
+    assert line["metric"] == "tpch_q1_sf1_rows_per_sec"
+    assert "axon" in line["error"]
+
+
+def test_emit_passes_clean_lines_through(capsys):
+    good = {"metric": "m", "value": 42, "unit": "rows/s"}
+    bench._emit(good)
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line == good
+
+
+def test_emit_leaves_real_skips_alone(capsys):
+    skip = bench.skip_line("m", RuntimeError("boom"))
+    bench._emit(skip)
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line == skip

@@ -85,6 +85,7 @@ def test_every_rule_registered(repo_findings):
         "reserve-sites",
         "qos-plane",
         "exchange-plane",
+        "adaptive-plane",
         "metric-names",
     ):
         assert expected in rules
@@ -770,6 +771,74 @@ def test_exchange_plane_rule_clean_fixtures(tmp_path):
     )
     assert not analysis.run_passes(
         str(tmp_path), rules=["exchange-plane"]
+    )
+
+
+def test_adaptive_plane_rule_flags_rogue_sites(tmp_path):
+    """The adaptive-execution plane's privileged constructs flag
+    outside their audited modules: epoch reads / the divergence test
+    outside plan/history.py (+ the replan seam), the replan seam
+    outside plan/canonical.py (+ the runner), strategy-switch
+    construction outside the coordinator."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            e = store.epoch_of(fp)
+            r = store.learned_rows(fp)
+            d = diverged(est, observed, 4.0)
+            s = stale_consults(entry.consulted, store, 4.0)
+            with capture_consults() as con:
+                pass
+            note_estimate(node, 50.0)
+            with with_overrides({"fp": 10.0}):
+                pass
+            out = coord._adaptive_maybe_switch(q, root, obs, workers)
+            probe = coord._adaptive_probe_build(q, J, st, workers, {})
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["adaptive-plane"])
+    assert len(found) == 9
+    assert all(f.rule == "adaptive-plane" for f in found)
+
+
+def test_adaptive_plane_rule_clean_fixtures(tmp_path):
+    """The audited modules themselves and attribute reads never
+    flag."""
+    hist = tmp_path / "plan" / "history.py"
+    hist.parent.mkdir()
+    hist.write_text(
+        textwrap.dedent(
+            """
+            def lookup_rows(node):
+                con = capture_consults()
+                return diverged(1.0, 2.0, 4.0)
+            """
+        )
+    )
+    (tmp_path / "plan" / "canonical.py").write_text(
+        textwrap.dedent(
+            """
+            def stale(consulted, store, factor):
+                if diverged(1.0, store.learned_rows("fp"), factor):
+                    return store.epoch_of("fp")
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(store, entry, qs):
+                # reads of the audited names are fine
+                factor = store.divergence_factor
+                con = entry.consulted
+                flag = qs.replanned or qs.adapted
+                return factor, con, flag
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["adaptive-plane"]
     )
 
 

@@ -233,6 +233,60 @@ def exchange_plane_pass(modules: List[core.Module], src_dir: str):
     )
 
 
+# ----------------------------------------------------- adaptive plane
+
+_DYNFILTER = "exec/dynfilter.py"
+_OPTIMIZER = "plan/optimizer.py"
+
+#: the adaptive-execution plane is only correct while its privileged
+#: constructs stay confined: epoch reads/bumps and the shared
+#: divergence test live in plan/history.py (an epoch minted elsewhere
+#: would desynchronize every staleness judgement), the statement-cache
+#: replan seam in plan/canonical.py with the runner as its one audited
+#: consumer (a replan decided elsewhere could serve a plan whose
+#: consulted evidence was never captured), and runtime strategy-switch
+#: construction in the coordinator + exec/dynfilter.py (a switch built
+#: elsewhere could bypass the fail-open discipline and turn a wrong
+#: estimate into a failed query)
+_ADAPTIVE_CALLS = {
+    # epoch plane: reads confined to history + the replan seam
+    "epoch_of": {_HISTORY, _CANONICAL},
+    "learned_rows": {_HISTORY, _CANONICAL},
+    # the ONE divergence test both layers share
+    "diverged": {_HISTORY, _CANONICAL, _DYNFILTER, _COORDINATOR},
+    # consult capture: the runner wraps canonical planning in it;
+    # the optimizer notes the classic fallback estimate
+    "capture_consults": {_HISTORY, _RUNNER},
+    "note_estimate": {_HISTORY, _OPTIMIZER},
+    "with_overrides": {_HISTORY, _COORDINATOR},
+    # the replan seam and its audited consumer
+    "stale_consults": {_CANONICAL, _RUNNER},
+    "_adaptive_replan": {_RUNNER},
+    # runtime strategy-switch construction
+    "_adaptive_maybe_switch": {_COORDINATOR},
+    "_adaptive_probe_build": {_COORDINATOR},
+    "_adaptive_nparts": {_COORDINATOR},
+    "_adaptive_note": {_COORDINATOR},
+}
+
+
+@core.register(
+    "adaptive-plane",
+    "adaptive-execution constructs confined: epoch reads/bumps and "
+    "the divergence test to plan/history.py, the replan seam to "
+    "plan/canonical.py (+ the runner), strategy-switch construction "
+    "to the coordinator and exec/dynfilter.py",
+)
+def adaptive_plane_pass(modules: List[core.Module], src_dir: str):
+    return _confined_calls(
+        modules,
+        _ADAPTIVE_CALLS,
+        "adaptive-plane",
+        "presto_tpu.plan.history / presto_tpu.plan.canonical / the "
+        "coordinator's adaptive seam",
+    )
+
+
 @core.register(
     "serving-batch",
     "micro-batch constructs confined: batch-axis stacking and vmap "
