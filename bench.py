@@ -60,6 +60,37 @@ WARMUP = 1
 ITERS = 5
 
 
+#: previous device-plane snapshot — every emitted line carries the
+#: delta spent since the line before it (measurements run sequentially
+#: between emits, so the delta IS the measurement's device cost plus
+#: its setup)
+_DEV_SNAP = None
+
+
+def _device_delta() -> dict:
+    """Device counters spent since the previous emitted line
+    (utils/telemetry): dispatch count, compile time, and total
+    host<->device transfer bytes."""
+    global _DEV_SNAP
+    from presto_tpu.utils.telemetry import device_snapshot
+
+    snap = device_snapshot()
+    prev = _DEV_SNAP or {}
+    _DEV_SNAP = snap
+    return {
+        "dispatches": int(
+            snap["dispatches"] - prev.get("dispatches", 0)
+        ),
+        "compile_ms": round(
+            snap["compile_ms"] - prev.get("compile_ms", 0.0), 1
+        ),
+        "transfer_bytes": int(
+            (snap["h2d_bytes"] + snap["d2h_bytes"])
+            - (prev.get("h2d_bytes", 0) + prev.get("d2h_bytes", 0))
+        ),
+    }
+
+
 def _emit(line: dict) -> None:
     """Print ONE result line, enforcing the skip contract at the last
     possible moment (BENCH_r04/r05 regression): a line carrying an
@@ -67,7 +98,12 @@ def _emit(line: dict) -> None:
     failed measurement printed as ``value: 0`` reads as a measured
     zero and poisons the metric trajectory. Every print site routes
     through here, so no future failure path can reintroduce the bug
-    by hand-building its dict."""
+    by hand-building its dict.
+
+    Every line (skips included) is also stamped with the device-plane
+    delta since the previous line and the boot probe's structured
+    ``backend_diag`` — a CPU-fallback run is distinguishable from a
+    TPU run on every metric, not just the headline."""
     if "error" in line and not line.get("skipped"):
         line = {
             "metric": line.get("metric", "unknown"),
@@ -75,6 +111,20 @@ def _emit(line: dict) -> None:
             "unit": line.get("unit", "rows/s"),
             "error": str(line["error"])[:300],
         }
+    if "device" not in line:
+        line["device"] = _device_delta()
+    if "backend_diag" not in line:
+        from presto_tpu.utils.devicediag import last_diag_dict
+
+        diag = last_diag_dict()
+        if diag:
+            line["backend_diag"] = {
+                k: diag[k]
+                for k in (
+                    "backend", "phase", "ok", "error_class", "fallback"
+                )
+                if k in diag
+            }
     print(json.dumps(line), flush=True)
 
 
@@ -1054,15 +1104,19 @@ def _adaptive_line(backend: str) -> dict:
 
 def _probe_backend() -> str:
     """Run a real tiny computation — trace + compile + execute + fetch,
-    the full dispatch path a query exercises (an if, not an assert:
-    python -O must not strip the probe) — and return the platform."""
-    import jax
-    import jax.numpy as jnp
+    the full dispatch path a query exercises — via the shared
+    structured probe (utils/devicediag), so every bench line's
+    ``backend_diag`` records WHICH phase died (enumerate / compile /
+    execute) and what fallback followed, not just that one did."""
+    from presto_tpu.utils.devicediag import probe_backend
 
-    platform = jax.devices()[0].platform
-    if int(jnp.arange(3).sum()) != 3:
-        raise RuntimeError("backend computed a wrong result")
-    return platform
+    diag = probe_backend()
+    if not diag.ok:
+        raise RuntimeError(
+            f"backend probe failed at {diag.phase}: "
+            f"{diag.error_class}: {diag.error}"
+        )
+    return diag.backend
 
 
 def _force_cpu(reason: BaseException) -> str:
@@ -1070,12 +1124,15 @@ def _force_cpu(reason: BaseException) -> str:
     axon plugin overrides JAX_PLATFORMS on this image) and re-probe."""
     import jax
 
+    from presto_tpu.utils.devicediag import note_fallback
+
     print(
         f"bench: backend failed ({reason}); falling back to CPU",
         file=sys.stderr,
         flush=True,
     )
     jax.config.update("jax_platforms", "cpu")
+    note_fallback("cpu")
     return _probe_backend()
 
 

@@ -9,6 +9,7 @@ QueryHistory and the process metrics registry — zero stored bytes.
 
 from __future__ import annotations
 
+import json
 from typing import Dict, Sequence
 
 import numpy as np
@@ -68,6 +69,10 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "preemptible": T.BOOLEAN,
             "pool_state": T.VARCHAR,
             "last_decision": T.VARCHAR,
+            # boot-time device probe (utils/devicediag.py), JSON: the
+            # failing phase, error class, and fallback decision — a
+            # silently CPU-degraded node is visible from SQL
+            "backend_diag": T.VARCHAR,
         },
         "tasks": {
             "query_id": T.VARCHAR,
@@ -88,6 +93,17 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "name": T.VARCHAR,
             "kind": T.VARCHAR,
             "value": T.DOUBLE,
+        },
+        # time-series view over the coordinator's telemetry sampler
+        # (utils/telemetry.MetricsSampler; telemetry.sample-interval-s
+        # enables it): one row per retained (node, metric) sample with
+        # the rate against the stream's previous observation
+        "metrics_history": {
+            "node": T.VARCHAR,
+            "ts": T.DOUBLE,
+            "name": T.VARCHAR,
+            "value": T.DOUBLE,
+            "rate": T.DOUBLE,
         },
         # materialized views (exec/mview.py): definition, base table,
         # tip snapshot, and how/when the view was last maintained
@@ -230,6 +246,16 @@ class SystemConnector(Connector):
                 {"name": n, "kind": k, "value": v}
                 for n, k, v in REGISTRY.snapshot()
             ]
+        if key == ("runtime", "metrics_history"):
+            cluster = getattr(self._runner, "cluster", None)
+            sampler = (
+                getattr(cluster, "telemetry_sampler", None)
+                if cluster
+                else None
+            )
+            # sampler off (or plain local runner): empty view, not an
+            # error — same contract as the qos view
+            return sampler.rows() if sampler is not None else []
         if key == ("runtime", "caches"):
             return self._cache_rows()
         if key == ("runtime", "materialized_views"):
@@ -486,10 +512,15 @@ class SystemConnector(Connector):
                     "last_decision": (
                         decision if w.coordinator else ""
                     ),
+                    "backend_diag": json.dumps(
+                        getattr(w, "backend_diag", {}) or {}
+                    ),
                 }
                 for w in cluster.nodes()
             ]
         import jax
+
+        from presto_tpu.utils.devicediag import last_diag_dict
 
         return [
             {
@@ -501,5 +532,6 @@ class SystemConnector(Connector):
                 "preemptible": False,
                 "pool_state": "STABLE",
                 "last_decision": "",
+                "backend_diag": json.dumps(last_diag_dict()),
             }
         ]

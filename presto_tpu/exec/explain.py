@@ -313,6 +313,21 @@ def render_distributed_analyze(
         f"current {qstats.current_memory_bytes}B, "
         f"spilled {qstats.spilled_bytes}B"
     )
+    # device-plane accounting (utils/telemetry.py): the before/after
+    # probe ROADMAP item 1's "dispatch counts visibly down" is judged
+    # by — dispatches, compile attribution, transfer bytes, and the
+    # padding share of capacity bucketing
+    from presto_tpu.utils.telemetry import pad_waste_pct
+
+    lines.append(
+        f"device: dispatches {qstats.device_dispatches}, "
+        f"compiles {qstats.device_compiles} "
+        f"({qstats.device_compile_ms:.1f} ms), "
+        f"h2d {qstats.device_h2d_bytes}B, "
+        f"d2h {qstats.device_d2h_bytes}B, "
+        "pad waste "
+        f"{pad_waste_pct(qstats.device_pad_rows, qstats.device_live_rows):.1f}%"
+    )
     for st in qstats.stages:
         r = st.rollup()
         lines.append(

@@ -66,6 +66,7 @@ from presto_tpu.plan.planner import Plan, plan_statement
 from presto_tpu.session import Session
 from presto_tpu.sql import parse_statement
 from presto_tpu.sql import ast
+from presto_tpu.utils.telemetry import DEVICE
 
 
 class ExecutionError(RuntimeError):
@@ -1698,6 +1699,19 @@ class LocalQueryRunner:
         t_disped = time.perf_counter()
         fetched = jax.device_get(leaves)
         t_fetched = time.perf_counter()
+        # device-plane accounting: the batch is ONE real dispatch +
+        # one fetch on the process counters; per-lane attribution
+        # happens below for SERVED lanes only (each answer required
+        # this dispatch), with fetch bytes split evenly
+        batch_d2h = 0
+        if DEVICE.enabled:
+            batch_d2h = sum(
+                int(getattr(leaf, "nbytes", 0)) for leaf in fetched
+            )
+            DEVICE.count_dispatch()
+            DEVICE.count_d2h(batch_d2h)
+            if fresh:
+                DEVICE.count_compile((t_disped - t_disp) * 1000.0)
         flags_np, err_np, cnt_np, dyn_np, nv_np = fetched[:5]
         prefix = fetched[5:]
         wall_ms = (t_fetched - t_disp) * 1000.0
@@ -1733,6 +1747,14 @@ class LocalQueryRunner:
                 # leader's staging-time fold was undone above)
                 qs.input_rows += in_rows
                 qs.input_bytes += in_bytes
+                # device attribution: the shared dispatch, counted
+                # once per served lane (micro-batch lanes have no
+                # stages, so roll_up's delta fold never races this)
+                if DEVICE.enabled:
+                    qs.device_dispatches += 1
+                    qs.device_d2h_bytes += batch_d2h // n
+                    if fresh:
+                        qs.device_compiles += 1
             if counted and nodes_cell:
                 self._active_qs = qs
                 try:
@@ -1914,6 +1936,28 @@ class LocalQueryRunner:
             t_disped = time.perf_counter()
             fetched = jax.device_get(leaves)
             t_fetched = time.perf_counter()
+            # device-plane accounting (utils/telemetry.py): one real
+            # dispatch + its fetch bytes; a fresh entry's dispatch
+            # window carries trace + XLA compile (jit compiles lazily
+            # at first call — documented approximation). Counted on
+            # retry iterations too: an overflowed run still dispatched.
+            if DEVICE.enabled:
+                d2h = sum(
+                    int(getattr(leaf, "nbytes", 0)) for leaf in fetched
+                )
+                compile_ms = (
+                    (t_disped - t_disp) * 1000.0 if fresh else 0.0
+                )
+                DEVICE.count_dispatch()
+                DEVICE.count_d2h(d2h)
+                if fresh:
+                    DEVICE.count_compile(compile_ms)
+                self._fold_device_stat(
+                    device_dispatches=1,
+                    device_d2h_bytes=d2h,
+                    device_compiles=1 if fresh else 0,
+                    device_compile_ms=compile_ms,
+                )
             flags_np, err_np, cnt_np, dyn_np, n_out = fetched[:5]
             for msg, flag in zip(msgs_cell, err_np):
                 if bool(flag):
@@ -1953,6 +1997,14 @@ class LocalQueryRunner:
                             "dynamic_filter_rows_pruned", pruned
                         )
                 n = int(n_out)
+                # output capacity-bucket padding waste: the rows this
+                # program computed over vs the rows anyone will read
+                if DEVICE.enabled:
+                    DEVICE.count_padding(n, page.capacity)
+                    self._fold_device_stat(
+                        device_pad_rows=page.capacity - n,
+                        device_live_rows=n,
+                    )
                 if not fetch_result:
                     from presto_tpu.page import pad_capacity
 
@@ -1989,6 +2041,28 @@ class LocalQueryRunner:
                     setattr(qs, attr, getattr(qs, attr) + n)
             else:
                 setattr(qs, attr, getattr(qs, attr) + n)
+
+    def _fold_device_stat(self, **fields) -> None:
+        """Add device-plane quantities (utils/telemetry.py families)
+        to the active sink under the ``_fold_dyn_stat`` locking
+        discipline — a QueryStats sink also folds worker-task deltas
+        into these same fields under its ``_roll_lock``. No-op when
+        the telemetry plane is disabled, so per-query attribution
+        tracks the process counters exactly (zero-delta off)."""
+        qs = self._active_qs
+        if qs is None or not DEVICE.enabled:
+            return
+        with self._qs_mu:
+            sink_lock = getattr(qs, "_roll_lock", None)
+            if sink_lock is not None:
+                with sink_lock:
+                    for attr, n in fields.items():
+                        if n:
+                            setattr(qs, attr, getattr(qs, attr) + n)
+            else:
+                for attr, n in fields.items():
+                    if n:
+                        setattr(qs, attr, getattr(qs, attr) + n)
 
     def _fold_operator_stats(
         self,
@@ -2140,6 +2214,9 @@ class LocalQueryRunner:
                 page = stage_page(merged, dict(scan.schema))
             nbytes = _page_nbytes(page)
             REGISTRY.distribution("staging.bytes").add(nbytes)
+            # per-query h2d attribution (the process counter lives in
+            # staging.stage_page); cache hits above transferred nothing
+            self._fold_device_stat(device_h2d_bytes=nbytes)
             cached = cacheable and self.split_cache.put(
                 key, page, nbytes, reserve_required=True, pin=pin
             )
@@ -2252,6 +2329,9 @@ class LocalQueryRunner:
 
         nbytes = _page_nbytes(page)
         REGISTRY.distribution("staging.bytes").add(nbytes)
+        # per-query h2d attribution of the split transfer (cache hits
+        # returned above without touching the device)
+        self._fold_device_stat(device_h2d_bytes=nbytes)
         if self._active_qs is not None:
             # locked: concurrent task drivers / the prefetch thread
             # share one TaskStats sink (+= would drop updates)

@@ -29,6 +29,7 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.connectors.tpch import DictColumn
 from presto_tpu.page import Block, Dictionary, Page
+from presto_tpu.utils.telemetry import DEVICE
 
 MIN_BUCKET = 1 << 10
 
@@ -183,11 +184,19 @@ def stage_page(
         else:
             vals = list(v) + [None] * (cap - len(v))
             blocks.append(Block.from_pylist(vals, t))
-    return Page(
+    page = Page(
         blocks=tuple(blocks),
         num_valid=jnp.asarray(n, jnp.int32),
         names=names,
     )
+    # device-plane accounting (utils/telemetry.py): the h2d transfer
+    # this staging paid and the capacity-bucket padding the device
+    # will compute over; guarded so the disabled plane skips even the
+    # nbytes walk
+    if DEVICE.enabled:
+        DEVICE.count_h2d(page_nbytes(page))
+        DEVICE.count_padding(n, cap)
+    return page
 
 
 def merge_column_chunks(parts: List[object], dtype=None):
@@ -214,6 +223,8 @@ def page_to_host(page: Page):
     static aux (dtype, dictionary, names) rides along untouched."""
     import jax
 
+    if DEVICE.enabled:
+        DEVICE.count_d2h(page_nbytes(page))
     return jax.device_get(page)
 
 
@@ -223,7 +234,10 @@ def host_to_page(host) -> Page:
     transfer stays in this module — tools/check_device_puts.py)."""
     import jax
 
-    return jax.tree_util.tree_map(jnp.asarray, host)
+    page = jax.tree_util.tree_map(jnp.asarray, host)
+    if DEVICE.enabled:
+        DEVICE.count_h2d(page_nbytes(page))
+    return page
 
 
 def page_nbytes(page: Page) -> int:
@@ -791,7 +805,11 @@ def stage_sharded(tables, sharding):
     through this module (tools/check_device_puts.py enforces that)."""
     import jax
 
-    return [jax.device_put(t, sharding) for t in tables]
+    out = [jax.device_put(t, sharding) for t in tables]
+    if DEVICE.enabled:
+        for t in jax.tree_util.tree_leaves(out):
+            DEVICE.count_h2d(int(getattr(t, "nbytes", 0)))
+    return out
 
 
 class CatalogManager:

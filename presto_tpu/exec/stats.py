@@ -145,6 +145,19 @@ class TaskStats:
     #: pressure — cluster memory governance)
     spilled_bytes: int = 0
     device_fragments: int = 0
+    #: device-plane accounting (utils/telemetry.py choke points; the
+    #: runner folds these via _fold_device_stat). A micro-batched lane
+    #: counts the shared dispatch once per SERVED member — its answer
+    #: required that dispatch — with transfer bytes split evenly.
+    device_dispatches: int = 0
+    device_compiles: int = 0
+    device_compile_ms: float = 0.0
+    device_h2d_bytes: int = 0
+    device_d2h_bytes: int = 0
+    #: capacity-bucket padding waste: pad vs live row slots of the
+    #: pages this task's programs produced/staged
+    device_pad_rows: int = 0
+    device_live_rows: int = 0
     #: this attempt was a speculative (backup) launch of a straggling
     #: range — winners and losers both carry the flag in the rollup
     speculative: bool = False
@@ -203,6 +216,27 @@ class StageStats:
                 t.spool_pages_served for t in self.tasks
             ),
             "spilled_bytes": sum(t.spilled_bytes for t in self.tasks),
+            "device_dispatches": sum(
+                t.device_dispatches for t in self.tasks
+            ),
+            "device_compiles": sum(
+                t.device_compiles for t in self.tasks
+            ),
+            "device_compile_ms": sum(
+                t.device_compile_ms for t in self.tasks
+            ),
+            "device_h2d_bytes": sum(
+                t.device_h2d_bytes for t in self.tasks
+            ),
+            "device_d2h_bytes": sum(
+                t.device_d2h_bytes for t in self.tasks
+            ),
+            "device_pad_rows": sum(
+                t.device_pad_rows for t in self.tasks
+            ),
+            "device_live_rows": sum(
+                t.device_live_rows for t in self.tasks
+            ),
             "failed_tasks": sum(
                 1 for t in self.tasks if t.state == "FAILED"
             ),
@@ -275,6 +309,18 @@ class QueryStats:
     current_memory_bytes: int = 0
     peak_memory_bytes: int = 0
     spilled_bytes: int = 0
+    #: device-plane accounting (utils/telemetry.py): dispatches /
+    #: compiles / transfer bytes / padding waste of THIS query's
+    #: programs — coordinator-local executions accumulate directly
+    #: (runner._fold_device_stat); worker-task portions fold in as
+    #: deltas in roll_up, like the dynamic-filter fields
+    device_dispatches: int = 0
+    device_compiles: int = 0
+    device_compile_ms: float = 0.0
+    device_h2d_bytes: int = 0
+    device_d2h_bytes: int = 0
+    device_pad_rows: int = 0
+    device_live_rows: int = 0
     #: task-side spill bytes already folded into spilled_bytes
     #: (roll_up delta bookkeeping, like the dynamic-filter fields)
     _spill_from_tasks: int = 0
@@ -284,6 +330,12 @@ class QueryStats:
     #: not exported)
     _df_rows_from_tasks: int = 0
     _df_filters_from_tasks: int = 0
+    #: task-side device_* portions already folded (field name ->
+    #: last-seen task sum; same delta bookkeeping, one dict instead of
+    #: seven more fields)
+    _device_from_tasks: Dict[str, float] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
     #: guards the delta fold above: roll_up runs concurrently from the
     #: query thread and /v1/query status polls, and a racy
     #: read-modify-write would double-count the delta (every other
@@ -388,6 +440,28 @@ class QueryStats:
             self._df_filters_from_tasks = task_filters
             self.spilled_bytes += task_spilled - self._spill_from_tasks
             self._spill_from_tasks = task_spilled
+            # device-plane accounting folds like spill: the fields mix
+            # coordinator-local contributions (gather splice, local
+            # fallback) with worker-task sums
+            for attr in (
+                "device_dispatches",
+                "device_compiles",
+                "device_compile_ms",
+                "device_h2d_bytes",
+                "device_d2h_bytes",
+                "device_pad_rows",
+                "device_live_rows",
+            ):
+                task_sum = sum(
+                    getattr(t, attr, 0)
+                    for s in self.stages
+                    for t in s.tasks
+                )
+                seen = self._device_from_tasks.get(attr, 0)
+                setattr(
+                    self, attr, getattr(self, attr) + task_sum - seen
+                )
+                self._device_from_tasks[attr] = task_sum
 
     def all_operator_stats(self) -> List[OperatorStats]:
         """Merged per-operator actuals across the whole query: locally
@@ -436,6 +510,25 @@ class QueryStats:
                         (s.stage_id, op.node_id, op.fingerprint), op
                     )
         return order
+
+    def device_dict(self) -> dict:
+        """The query's device-plane section (QueryInfo, the event
+        sink, and the EXPLAIN ANALYZE "device:" line all read this
+        one shape)."""
+        from presto_tpu.utils.telemetry import pad_waste_pct
+
+        return {
+            "dispatches": self.device_dispatches,
+            "compiles": self.device_compiles,
+            "compile_ms": self.device_compile_ms,
+            "h2d_bytes": self.device_h2d_bytes,
+            "d2h_bytes": self.device_d2h_bytes,
+            "pad_rows": self.device_pad_rows,
+            "live_rows": self.device_live_rows,
+            "pad_waste_pct": pad_waste_pct(
+                self.device_pad_rows, self.device_live_rows
+            ),
+        }
 
     def _operators_dicts(self) -> List[dict]:
         """Serialized operator rollup. The merge walks every stage/
@@ -492,6 +585,11 @@ class QueryStats:
             "input_rows": self.input_rows,
             "input_bytes": self.input_bytes,
             "output_rows": self.output_rows,
+            # device-plane section (utils/telemetry.py accounting) —
+            # additive: every pre-existing field above is untouched,
+            # so JSONL event-sink consumers keep parsing (asserted in
+            # tests/test_telemetry.py)
+            "device": self.device_dict(),
             # per-operator actuals (merged local + worker tasks): the
             # history store's write path reads this same record
             "operators": self._operators_dicts(),

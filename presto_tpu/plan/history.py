@@ -392,6 +392,25 @@ def note_estimate(node: N.PlanNode, rows: float) -> None:
         pass
 
 
+def progress_total_rows(
+    store: Optional["QueryHistoryStore"], node
+) -> Optional[float]:
+    """History-observed output cardinality for a running query's plan
+    root — the expected-total denominator behind the live progress
+    endpoint's ETA (``coordinator.query_progress``). Lives HERE so the
+    coordinator never calls :func:`lookup_rows` directly (the
+    history-sites confinement rule pins that read path to this module
+    and the optimizer). None = no store, no plan, or no history for
+    this shape; never raises."""
+    if store is None or node is None:
+        return None
+    try:
+        with using(store):
+            return lookup_rows(node)
+    except Exception:
+        return None
+
+
 # -------------------------------------------------------------- the store
 
 

@@ -149,6 +149,15 @@ class PrestoTpuClient:
         task timings) and the span tree (``GET /v1/query/{id}``)."""
         return self._get_json(f"{self.uri}/v1/query/{query_id}")
 
+    def query_progress(self, query_id: str) -> dict:
+        """Live progress for one query — per-stage splits done/total,
+        rows/bytes/dispatch counters, and an ETA — consumable while
+        the query is still RUNNING
+        (``GET /v1/query/{id}/progress``)."""
+        return self._get_json(
+            f"{self.uri}/v1/query/{query_id}/progress"
+        )
+
     def list_queries(self) -> List[dict]:
         """Summaries of every query the coordinator remembers
         (``GET /v1/query``)."""

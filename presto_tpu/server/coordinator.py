@@ -115,6 +115,12 @@ class _WorkerNode:
     #: device coordinates announced beside the slice id (topology
     #: observability only)
     device_coords: tuple = ()
+    #: the worker's boot-time device probe (utils/devicediag.py):
+    #: which phase failed (enumerate/compile/execute), the error
+    #: class, and any fallback decision — surfaced verbatim on
+    #: system.runtime.nodes so a silently-degraded node is visible
+    #: from the coordinator
+    backend_diag: dict = dataclasses.field(default_factory=dict)
 
 
 class _Query:
@@ -671,6 +677,44 @@ class CoordinatorServer:
                 self, config, max_concurrent_queries
             )
 
+        # device-plane telemetry (utils/telemetry.py): federation of
+        # the workers' /v1/metrics expositions behind
+        # /v1/metrics/cluster, plus the bounded time-series sampler
+        # backing system.runtime.metrics_history. Sampling and
+        # persistence are off by default; the DEVICE counter plane
+        # itself follows telemetry.enabled so a disabled cluster stays
+        # bit-exact pre-telemetry.
+        from presto_tpu.utils.telemetry import (
+            DEVICE,
+            MetricsFederation,
+            MetricsSampler,
+        )
+
+        if config is not None:
+            t_enabled = config.get("telemetry.enabled")
+            if t_enabled is not None:
+                DEVICE.set_enabled(bool(t_enabled))
+        self.federation = MetricsFederation(
+            lambda uri: rpc.call("GET", uri).body.decode(
+                "utf-8", "replace"
+            )
+        )
+        self.telemetry_sampler = None
+        self._telemetry_interval_s = float(
+            (config.get("telemetry.sample-interval-s", 0.0) or 0.0)
+            if config
+            else 0.0
+        )
+        if self._telemetry_interval_s > 0:
+            self.telemetry_sampler = MetricsSampler(
+                retention=int(
+                    config.get("telemetry.retention", 4096) or 4096
+                ),
+                path=config.get("telemetry.path") or None,
+            )
+        self._telemetry_stop = threading.Event()
+        self._telemetry_thread = None
+
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -696,11 +740,24 @@ class CoordinatorServer:
             self.ingest = IngestManager(
                 self.local, path, commit_interval_ms=interval
             )
+        # time-series sampler (telemetry.sample-interval-s > 0): a
+        # daemon loop folding node scrapes into the metrics_history
+        # ring. Started with the server, never before — an unstarted
+        # coordinator must stay thread-free for in-process tests.
+        if (
+            self.telemetry_sampler is not None
+            and self._telemetry_thread is None
+        ):
+            self._telemetry_thread = threading.Thread(
+                target=self._telemetry_loop, daemon=True
+            )
+            self._telemetry_thread.start()
         self._serve_thread.start()
         return self
 
     def shutdown(self) -> None:
         self._shutting_down = True
+        self._telemetry_stop.set()
         if self.autoscaler is not None:
             self.autoscaler.stop()
         if self.ingest is not None:
@@ -993,6 +1050,7 @@ class CoordinatorServer:
         memory: Optional[dict] = None,
         slice_id: str = "",
         device_coords=(),
+        backend_diag: Optional[dict] = None,
     ) -> None:
         with self._lock:
             w = self.workers.get(node_id)
@@ -1002,6 +1060,7 @@ class CoordinatorServer:
                     state=state, preemptible=bool(preemptible),
                     slice_id=str(slice_id or ""),
                     device_coords=tuple(device_coords or ()),
+                    backend_diag=dict(backend_diag or {}),
                 )
             else:
                 w.last_seen = time.time()
@@ -1010,6 +1069,8 @@ class CoordinatorServer:
                 w.preemptible = bool(preemptible)
                 w.slice_id = str(slice_id or "")
                 w.device_coords = tuple(device_coords or ())
+                if backend_diag:
+                    w.backend_diag = dict(backend_diag)
         # fold the heartbeat's memory report into the cluster view —
         # OUTSIDE the discovery lock (enforcement may scan queries)
         if memory is not None:
@@ -1156,11 +1217,14 @@ class CoordinatorServer:
 
     def nodes(self) -> List[_WorkerNode]:
         """All nodes incl. self, for system.runtime.nodes."""
+        from presto_tpu.utils.devicediag import last_diag_dict
+
         me = _WorkerNode(
             node_id="coordinator",
             uri=self.uri,
             last_seen=time.time(),
             coordinator=True,
+            backend_diag=last_diag_dict(),
         )
         now = time.time()
         with self._lock:
@@ -2005,6 +2069,157 @@ class CoordinatorServer:
             "user": getattr(q, "user", None),
             "stages": len(q.stats.stages),
         }
+
+    def query_progress(self, q: _Query) -> dict:
+        """Live progress view (``GET /v1/query/{id}/progress``),
+        consumable MID-query: per-stage task completion + the
+        rows/bytes/dispatch counters accumulated so far, a completion
+        fraction, and an ETA.
+
+        The ETA numerator is split completion (tasks FINISHED over
+        tasks scheduled — stages appear as the scheduler creates them,
+        so ``splits_total`` grows while the query plans new stages and
+        the fraction is a floor, never an overestimate of progress).
+        When the plan shape has history (the PR-7 store), the
+        history-observed root cardinality rides along as
+        ``expected_rows`` and backstops the fraction before any task
+        has finished. All the ``*_done``/rows/bytes/dispatch counters
+        are monotone over a query's lifetime."""
+        if not q.done.is_set():
+            self._fold_memory_stats(q)
+        q.stats.roll_up()
+        stages = []
+        splits_done = splits_total = 0
+        rows = nbytes = dispatches = spilled = 0
+        for s in q.stats.stages:
+            r = s.rollup()
+            s_total = len(s.tasks)
+            s_done = sum(
+                1 for t in s.tasks if t.state == "FINISHED"
+            )
+            splits_done += s_done
+            splits_total += s_total
+            rows += r["output_rows"]
+            nbytes += r["output_bytes"]
+            dispatches += r["device_dispatches"]
+            spilled += r["spilled_bytes"]
+            stages.append(
+                {
+                    "stage_id": s.stage_id,
+                    "kind": s.kind,
+                    "state": s.state,
+                    "splits_done": s_done,
+                    "splits_total": s_total,
+                    "rows": r["output_rows"],
+                    "bytes": r["output_bytes"],
+                    "dispatches": r["device_dispatches"],
+                    "spilled_bytes": r["spilled_bytes"],
+                }
+            )
+        from presto_tpu.plan.history import progress_total_rows
+
+        expected = progress_total_rows(
+            self.local.history_store, q._plan_root
+        )
+        frac: Optional[float] = None
+        if q.done.is_set():
+            frac = 1.0
+        elif splits_total > 0:
+            frac = splits_done / splits_total
+        elif expected and rows > 0:
+            # no tasks scheduled yet but history knows the shape:
+            # cardinality-based floor, capped below 1 (history can
+            # underestimate today's data)
+            frac = min(rows / expected, 0.99)
+        elapsed_ms = q.stats.elapsed_ms
+        eta_ms: Optional[float] = None
+        if frac is not None:
+            if frac >= 1.0:
+                eta_ms = 0.0
+            elif frac > 0 and elapsed_ms > 0:
+                eta_ms = elapsed_ms * (1.0 - frac) / frac
+        return {
+            "query_id": q.qid,
+            "state": q.state,
+            "done": q.done.is_set(),
+            "elapsed_ms": elapsed_ms,
+            "splits_done": splits_done,
+            "splits_total": splits_total,
+            "rows": rows,
+            "bytes": nbytes,
+            "device_dispatches": dispatches,
+            "spilled_bytes": spilled,
+            "expected_rows": expected,
+            "progress": frac,
+            "eta_ms": eta_ms,
+            "stages": stages,
+        }
+
+    # ------------------------------------------------- metrics federation
+
+    def cluster_metrics(self) -> str:
+        """One federated exposition (``GET /v1/metrics/cluster``): the
+        coordinator's own registry plus every TTL-live worker's scrape,
+        per-node labeled, with ``node="cluster"`` sums of the additive
+        families."""
+        from presto_tpu.utils.telemetry import parse_prometheus
+
+        by_node = {
+            "coordinator": parse_prometheus(
+                REGISTRY.render_prometheus()
+            )
+        }
+        by_node.update(
+            self.federation.scrape(
+                (w.node_id, w.uri + "/v1/metrics")
+                for w in self._ttl_workers()
+            )
+        )
+        return self.federation.render(by_node)
+
+    def _telemetry_tick(self) -> None:
+        """One sampler round: fold the coordinator's registry and every
+        TTL-live worker's scrape into the ring buffer (monotone,
+        label-free streams only — quantile samples don't rate)."""
+        from presto_tpu.utils.telemetry import (
+            _monotone,
+            parse_prometheus,
+        )
+
+        samp = self.telemetry_sampler
+        if samp is None:
+            return
+        by_node = {
+            "coordinator": parse_prometheus(
+                REGISTRY.render_prometheus()
+            )
+        }
+        by_node.update(
+            self.federation.scrape(
+                (w.node_id, w.uri + "/v1/metrics")
+                for w in self._ttl_workers()
+            )
+        )
+        ts = time.time()
+        for node_id, samples in by_node.items():
+            samp.observe(
+                node_id,
+                [
+                    (name, value)
+                    for name, labels, value in samples
+                    if _monotone(name) and not labels
+                ],
+                ts=ts,
+            )
+
+    def _telemetry_loop(self) -> None:
+        while not self._telemetry_stop.wait(
+            self._telemetry_interval_s
+        ):
+            try:
+                self._telemetry_tick()
+            except Exception:
+                log.debug("telemetry tick failed", exc_info=True)
 
     # ------------------------------------------- dynamic filtering plane
 
@@ -3962,6 +4177,7 @@ def _make_handler(coord: CoordinatorServer):
                     memory=d.get("memory"),
                     slice_id=d.get("slice_id", ""),
                     device_coords=d.get("device_coords", ()),
+                    backend_diag=d.get("backend_diag"),
                 )
                 return self._json(200, {"ok": True})
             self._json(404, {"error": f"no route {self.path}"})
@@ -3986,6 +4202,31 @@ def _make_handler(coord: CoordinatorServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if parts == ["v1", "metrics", "cluster"]:
+                # cluster metrics federation: the coordinator's own
+                # exposition plus every TTL-live worker's, re-emitted
+                # with node="<id>" labels and a node="cluster" sum of
+                # the monotone families (utils/telemetry.py)
+                body = coord.cluster_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if (
+                len(parts) == 4
+                and parts[:2] == ["v1", "query"]
+                and parts[3] == "progress"
+            ):
+                # live query progress, consumable MID-query: per-stage
+                # splits done/total + rows/bytes/dispatches and a
+                # history-derived ETA. Must be routed BEFORE the
+                # len==3 QueryInfo route.
+                x = coord.lookup_query(parts[2])
+                if x is None:
+                    return self._json(404, {"error": "no such query"})
+                return self._json(200, coord.query_progress(x))
             if parts == ["v1", "query"]:
                 # query listing (reference: GET /v1/query)
                 with coord._lock:

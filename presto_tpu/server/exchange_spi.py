@@ -45,8 +45,20 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from presto_tpu.utils.metrics import REGISTRY
+from presto_tpu.utils.telemetry import DEVICE
 
 log = logging.getLogger("presto_tpu.exchange")
+
+
+def _fetch_dest(dest, nr: int):
+    """The ONE destination-vector fetch of the ICI lane (a small
+    device->host control transfer per batch), accounted on the
+    device-plane telemetry counters."""
+    import jax
+
+    arr = np.asarray(jax.device_get(dest))
+    DEVICE.count_d2h(int(arr.nbytes))
+    return arr[:nr].astype(np.int64)
 
 
 def default_slice_id() -> str:
@@ -361,7 +373,7 @@ def emit_partitioned(task, out, *, slice_id: str, pool) -> None:
             # device and host hashes are pinned equal, but recovery
             # must match what live consumers gathered, not re-derive)
             payload, schema, nr = S._page_to_payload(out)
-            bk = np.asarray(jax.device_get(dest))[:nr].astype(np.int64)
+            bk = _fetch_dest(dest, nr)
             for part, frame, _ in _serialize_partition_slices(
                 payload, schema, nr, bk
             ):
@@ -448,7 +460,7 @@ def serialize_ici_frames(task):
     frames = []
     for page, dest in snap["batches"]:
         payload, schema, nr = S._page_to_payload(page)
-        bk = np.asarray(jax.device_get(dest))[:nr].astype(np.int64)
+        bk = _fetch_dest(dest, nr)
         for part, frame, _ in _serialize_partition_slices(
             payload, schema, nr, bk
         ):
@@ -544,7 +556,7 @@ def ici_batches_to_payloads(batches, part: int, schema):
     out = []
     for page, dest in batches:
         payload, pschema, nr = S._page_to_payload(page)
-        bk = np.asarray(jax.device_get(dest))[:nr].astype(np.int64)
+        bk = _fetch_dest(dest, nr)
         mask = bk == part
         n = int(mask.sum())
         if n == 0:
@@ -583,6 +595,10 @@ def device_merge(batches_by_source, part: int, schema, max_rows=None):
     count_vecs = jax.device_get(
         [X.ici_partition_counts(pg, d) for pg, d in flat]
     )
+    if DEVICE.enabled:
+        DEVICE.count_d2h(
+            sum(int(np.asarray(c).nbytes) for c in count_vecs)
+        )
     counts = [int(np.asarray(c)[part]) for c in count_vecs]
     total = int(sum(counts))
     if max_rows is not None and total > max_rows:

@@ -39,7 +39,7 @@ from presto_tpu.plan import nodes as N
 from presto_tpu.server import exchange_spi, pages_wire, rpc, task_ids
 from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.spool import ExchangeSpool
-from presto_tpu.utils import faults, tracing
+from presto_tpu.utils import devicediag, faults, tracing
 from presto_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger("presto_tpu.worker")
@@ -403,6 +403,11 @@ class WorkerServer:
     # ---------------------------------------------------------- lifecycle
 
     def start(self) -> "WorkerServer":
+        # boot-time device probe (utils/devicediag.py): once per
+        # process — the structured diagnosis rides every announcement
+        # and /v1/status from then on
+        if devicediag.last_diag() is None:
+            devicediag.probe_backend()
         self._serve_thread.start()
         if self.coordinator_uri:
             self._announcer = threading.Thread(
@@ -562,6 +567,9 @@ class WorkerServer:
             "slice_id": self.slice_id,
             "device_coords": exchange_spi.device_coords(),
             "memory": self._memory_report(),
+            # boot-time device probe: the coordinator keeps the last
+            # non-empty diagnosis per node (system.runtime.nodes)
+            "backend_diag": devicediag.last_diag_dict(),
         }
 
     def _announce_once(self) -> None:
@@ -1352,6 +1360,7 @@ class WorkerServer:
             "slice_id": self.slice_id,
             "tasks": tasks,
             "memory": self._memory_report(),
+            "backend_diag": devicediag.last_diag_dict(),
         }
 
     def delete_task(self, task_id: str) -> bool:

@@ -601,6 +601,18 @@ class NodeConfig:
         "qos.max-suspensions-per-query": int,
         # deterministic chaos: JSON FaultPlane spec (utils.faults)
         "fault-injection.spec": str,
+        # device-plane telemetry (utils/telemetry.py): the master gate
+        # for the dispatch/transfer/compile counters (false = zero
+        # counter delta, bit-exact results either way), the cluster
+        # sampler cadence (<=0 = sampler off — the default; when on,
+        # the coordinator scrapes itself + every announced worker each
+        # interval into the metrics_history ring), the ring-buffer row
+        # bound, and the optional JSONL persistence path (journal
+        # segment idiom, newest two segments kept)
+        "telemetry.enabled": bool,
+        "telemetry.sample-interval-s": float,
+        "telemetry.retention": int,
+        "telemetry.path": str,
     }
 
     #: dynamic per-group QoS keys: qos.<group>.priority (int) and

@@ -953,3 +953,143 @@ def test_loop_registered_families_visible_on_repo():
         "spill.pages_spilled",
     ):
         assert fam in sites, fam
+
+
+# ------------------------------------------------- telemetry plane
+
+
+def test_telemetry_plane_rule_flags_rogue_sites(tmp_path):
+    """The device-telemetry plane's privileged constructs flag outside
+    their audited modules: counter increments outside the
+    runner/staging/exchange choke points, sampler + federation
+    construction outside the coordinator, probes outside the worker
+    boot seam, the history-derived progress denominator outside
+    plan/history.py (+ the coordinator)."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            t = DeviceTelemetry()
+            DEVICE.count_dispatch()
+            DEVICE.count_compile(12.5)
+            DEVICE.count_h2d(1024)
+            DEVICE.count_d2h(1024)
+            DEVICE.count_padding(10, 16)
+            runner._fold_device_stat(device_dispatches=1)
+            fed = MetricsFederation(lambda uri: "")
+            samp = MetricsSampler(retention=16)
+            diag = probe_backend()
+            record_diag(diag)
+            rows = progress_total_rows(store, root)
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["telemetry-plane"])
+    assert len(found) == 12
+    assert all(f.rule == "telemetry-plane" for f in found)
+
+
+def test_telemetry_plane_rule_clean_fixtures(tmp_path):
+    """The audited modules themselves never flag — and snapshot reads
+    (what bench/tests consume) are not confined at all."""
+    runner = tmp_path / "exec" / "local_runner.py"
+    runner.parent.mkdir()
+    runner.write_text(
+        textwrap.dedent(
+            """
+            def run(self, d2h):
+                DEVICE.count_dispatch()
+                DEVICE.count_d2h(d2h)
+                self._fold_device_stat(device_dispatches=1)
+            """
+        )
+    )
+    staging = tmp_path / "exec" / "staging.py"
+    staging.write_text(
+        textwrap.dedent(
+            """
+            def stage(page, n, cap):
+                DEVICE.count_h2d(1024)
+                DEVICE.count_padding(n, cap)
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f():
+                # snapshot reads are not privileged
+                snap = device_snapshot()
+                d = last_diag_dict()
+                return snap, d
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["telemetry-plane"]
+    )
+
+
+def test_metric_family_confinement_flags_rogue_registration(tmp_path):
+    """A device.*/telemetry.* metric registered outside the owning
+    modules is a metric-names finding, including loop-registered
+    names."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            REGISTRY.counter("device.dispatches")
+            for m in ("telemetry.samples", "telemetry.scrape_failures"):
+                REGISTRY.counter(m)
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["metric-names"])
+    assert len(found) == 3
+    assert all("owning modules" in f.message for f in found)
+
+
+def test_metric_family_confinement_clean_in_owner(tmp_path):
+    """The same registrations inside utils/telemetry.py (and the diag
+    counters in utils/devicediag.py) are clean."""
+    tele = tmp_path / "utils" / "telemetry.py"
+    tele.parent.mkdir()
+    tele.write_text(
+        textwrap.dedent(
+            """
+            REGISTRY.counter("device.dispatches")
+            REGISTRY.counter("telemetry.samples")
+            """
+        )
+    )
+    diag = tmp_path / "utils" / "devicediag.py"
+    diag.write_text(
+        textwrap.dedent(
+            """
+            REGISTRY.counter("device.probes")
+            REGISTRY.counter("device.probe_failures")
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["metric-names"]
+    )
+
+
+def test_device_families_visible_on_repo():
+    """The live device/telemetry families are in the scanned set, in
+    their owning modules only."""
+    from analysis import metric_names
+
+    mods, _errs = analysis.core.load_modules(SRC)
+    sites = metric_names.collect_sites(mods)
+    for fam in (
+        "device.dispatches",
+        "device.compiles",
+        "device.compile_ms",
+        "device.h2d_bytes",
+        "device.d2h_bytes",
+        "device.probes",
+        "telemetry.samples",
+        "telemetry.scrape_failures",
+    ):
+        assert fam in sites, fam
+    assert not metric_names.find_family_violations(sites)

@@ -84,15 +84,44 @@ def find_conflicts(sites):
     return out
 
 
+#: metric-family confinement: families whose names may register only
+#: in their owning modules. The ``device.*`` counters ARE the
+#: device-plane numbers the bench diffs and EXPLAIN ANALYZE renders —
+#: a stray registration elsewhere would fork a family the dashboards
+#: treat as one stream; ``telemetry.*`` is the plane's own
+#: bookkeeping (scrape failures, sample counts).
+FAMILY_CONFINEMENT = {
+    "device.": {"utils/telemetry.py", "utils/devicediag.py"},
+    "telemetry.": {"utils/telemetry.py"},
+}
+
+
+def find_family_violations(sites):
+    """Registrations of a confined family outside its owning modules:
+    ``[(name, rel, line, allowed), ...]``."""
+    out = []
+    for name, entries in sorted(sites.items()):
+        for prefix, allowed in FAMILY_CONFINEMENT.items():
+            if not name.startswith(prefix):
+                continue
+            for _kind, rel, line in sorted(entries):
+                if rel not in allowed:
+                    out.append((name, rel, line, allowed))
+    return out
+
+
 @core.register(
     "metric-names",
     "every metric name registers under ONE kind "
-    "(counter/timer/distribution), loop-registered families included",
+    "(counter/timer/distribution), loop-registered families included; "
+    "device.*/telemetry.* families register only in their owning "
+    "modules",
 )
 def metric_names_pass(modules: List[core.Module], src_dir: str):
     by_rel = {m.rel: m for m in modules}
     findings = []
-    for name, entries in find_conflicts(collect_sites(modules)):
+    sites = collect_sites(modules)
+    for name, entries in find_conflicts(sites):
         kind0, rel0, line0 = entries[0]
         mod = by_rel[rel0]
         where = ", ".join(
@@ -104,6 +133,16 @@ def metric_names_pass(modules: List[core.Module], src_dir: str):
                 line0,
                 f"metric {name!r} registered under conflicting kinds: "
                 f"{where}",
+            )
+        )
+    for name, rel, line, allowed in find_family_violations(sites):
+        findings.append(
+            by_rel[rel].finding(
+                "metric-names",
+                line,
+                f"metric {name!r} registers outside its family's "
+                f"owning modules ({', '.join(sorted(allowed))}) — "
+                "the device/telemetry planes must stay one stream",
             )
         )
     return findings

@@ -320,3 +320,57 @@ def serving_batch_pass(modules: List[core.Module], src_dir: str):
                     )
                 )
     return findings
+
+
+_TELEMETRY = "utils/telemetry.py"
+_DEVICEDIAG = "utils/devicediag.py"
+_STAGING = "exec/staging.py"
+
+#: the device-plane numbers are only trustworthy while their
+#: increment sites stay the audited choke points: a rogue
+#: ``count_dispatch`` in a connector would double-count the plane the
+#: ROADMAP's "dispatch counts visibly down" is judged by, a second
+#: DeviceTelemetry instance would fork the counters the bench diffs,
+#: and a sampler/federation constructed outside the coordinator would
+#: sample a registry no system table serves. (bench.py and tests are
+#: outside the analyzed tree; they consume snapshots, not counters.)
+_TELEMETRY_CALLS = {
+    # the ONE instance lives in utils/telemetry.py (module singleton)
+    "DeviceTelemetry": {_TELEMETRY},
+    # federation/sampler construction: the coordinator's boot seam
+    "MetricsFederation": {_TELEMETRY, _COORDINATOR},
+    "MetricsSampler": {_TELEMETRY, _COORDINATOR},
+    # increment choke points
+    "count_dispatch": {_TELEMETRY, _RUNNER},
+    "count_compile": {_TELEMETRY, _RUNNER},
+    "count_h2d": {_TELEMETRY, _STAGING},
+    "count_d2h": {_TELEMETRY, _RUNNER, _STAGING, _EXCHANGE_SPI},
+    "count_padding": {_TELEMETRY, _RUNNER, _STAGING},
+    # per-query attribution fold: the runner's locked seam
+    "_fold_device_stat": {_RUNNER},
+    # structured diagnosis: probes from the worker boot seam only
+    # (the bench rides the same helper from outside the tree);
+    # recording is the probe's own epilogue
+    "probe_backend": {_DEVICEDIAG, _WORKER},
+    "record_diag": {_DEVICEDIAG},
+    # the history-derived progress denominator: kept inside
+    # plan/history.py (the lookup_rows confinement) with the
+    # coordinator as its one consumer
+    "progress_total_rows": {_HISTORY, _COORDINATOR},
+}
+
+
+@core.register(
+    "telemetry-plane",
+    "device-telemetry constructs confined: counter increments to the "
+    "runner/staging/exchange choke points, sampler+federation "
+    "construction to the coordinator, probes to the worker boot seam",
+)
+def telemetry_plane_pass(modules: List[core.Module], src_dir: str):
+    return _confined_calls(
+        modules,
+        _TELEMETRY_CALLS,
+        "telemetry-plane",
+        "presto_tpu.utils.telemetry (DEVICE) / the coordinator's "
+        "telemetry seam",
+    )
