@@ -1151,6 +1151,144 @@ def _ensure_backend() -> str:
         return _force_cpu(e)
 
 
+def _multi_coordinator_failover_line(backend: str) -> dict:
+    """Multi-coordinator HA (ISSUE 17): statement throughput with 1
+    coordinator vs 3 lease-federated coordinators under sprayed client
+    load, with a SCRIPTED kill of one coordinator mid-window in the
+    3-coordinator phase. The contract is ``failed == 0``: every open
+    query on the killed coordinator resumes on a lease-fenced peer and
+    its statement URI keeps resolving through the alias chain, so
+    clients never observe a failure — and the line records the
+    1 -> 3 statement-qps scaling. A cluster that cannot even boot
+    emits ``skipped``, never a fake zero."""
+    import tempfile
+    import threading
+
+    from presto_tpu.server import CoordinatorServer, PrestoTpuClient
+    from presto_tpu.session import NodeConfig
+    from presto_tpu.utils import faults
+
+    window_s = 4.0
+    sql = "select count(*) as c from tpch.tiny.orders"
+
+    def load_window(uris, expected, n_clients, kill=None):
+        done = {"completed": 0, "failed": 0}
+        lock = threading.Lock()
+        stop = time.monotonic() + window_s
+
+        def client_loop():
+            client = PrestoTpuClient(
+                uris, timeout_s=60, reconnect_attempts=16
+            )
+            while time.monotonic() < stop:
+                try:
+                    rows = [
+                        tuple(r) for r in client.execute(sql).rows()
+                    ]
+                    ok = rows == expected
+                except Exception:
+                    ok = False
+                with lock:
+                    done["completed" if ok else "failed"] += 1
+
+        threads = [
+            threading.Thread(target=client_loop)
+            for _ in range(n_clients)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        if kill is not None:
+            # the scripted kill: a quarter into the window, arm a
+            # one-shot kill_coordinator rule against coord-0 — the
+            # next statement it admits crashes it (lease goes silent,
+            # socket closes, journal strands open queries)
+            time.sleep(window_s * 0.25)
+            kill()
+        for t in threads:
+            t.join(120)
+        return done, time.monotonic() - t0
+
+    def mk_coords(ctl, n):
+        ports, socks = [], []
+        import socket as _socket
+
+        for _ in range(n):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        uris = [f"http://127.0.0.1:{p}" for p in ports]
+        coords = []
+        for i in range(n):
+            cfg = {"node.id": f"coord-{i}"}
+            if n > 1:
+                cfg["coordinator.journal-path"] = ctl
+                cfg["coordinator.peers"] = ",".join(
+                    u for j, u in enumerate(uris) if j != i
+                )
+                cfg["lease.ttl-s"] = "0.75"
+            coords.append(
+                CoordinatorServer(
+                    port=ports[i], config=NodeConfig(cfg)
+                ).start()
+            )
+        return coords
+
+    with tempfile.TemporaryDirectory() as td:
+        # phase 1: the single-coordinator baseline
+        coords = mk_coords(td + "/ctl1", 1)
+        try:
+            expected = [
+                tuple(r) for r in coords[0].local.execute(sql).rows()
+            ]
+            solo, solo_wall = load_window(
+                [coords[0].uri], expected, n_clients=8
+            )
+        finally:
+            for c in coords:
+                c.shutdown()
+        # phase 2: 3 lease-federated coordinators + the scripted kill
+        coords = mk_coords(td + "/ctl3", 3)
+        try:
+            spray = [c.uri for c in coords]
+            fleet, fleet_wall = load_window(
+                spray,
+                expected,
+                n_clients=8,
+                kill=lambda: faults.configure({
+                    "rules": [
+                        {
+                            "action": "kill_coordinator",
+                            "node": "coord-0",
+                            "count": 1,
+                        },
+                    ],
+                }),
+            )
+            claims = sum(c.failover_claims for c in coords[1:])
+        finally:
+            faults.configure(None)
+            for c in coords:
+                c.shutdown()
+    solo_qps = solo["completed"] / max(solo_wall, 1e-9)
+    fleet_qps = fleet["completed"] / max(fleet_wall, 1e-9)
+    return {
+        "metric": "multi_coordinator_failover_qps",
+        "value": round(fleet_qps, 2),
+        "unit": "queries/s",
+        "qps_1coord": round(solo_qps, 2),
+        "scaling_x": round(fleet_qps / max(solo_qps, 1e-9), 2),
+        "failed": solo["failed"] + fleet["failed"],
+        "failover_claims": claims,
+        "clients": 8,
+        "coordinators": "1, then 3 with coord-0 killed mid-window",
+        "backend": backend,
+    }
+
+
 def _q1_line(runner, backend: str) -> dict:
     """The headline TPC-H Q1 @ SF1 measurement (cold + steady-state
     rows/s). Raises on backend death mid-measurement — the caller owns
@@ -1286,6 +1424,17 @@ def main() -> None:
             _emit(_adaptive_line(backend))
         except Exception as e:
             _emit(skip_line("adaptive_skewed_join_warm_vs_cold", e, "x"))
+        # multi-coordinator HA: 1 -> 3 coordinator statement qps with
+        # a scripted kill mid-window — failed == 0 is the contract
+        # (open queries fail over through the lease + alias chain)
+        try:
+            _emit(_multi_coordinator_failover_line(backend))
+        except Exception as e:
+            _emit(
+                skip_line(
+                    "multi_coordinator_failover_qps", e, "queries/s"
+                )
+            )
     if not run_all:
         return
 

@@ -57,8 +57,11 @@ tools/check_history_sites.py):
    evidence a plan-cache entry is later re-validated against — and
    :func:`with_overrides` installs mid-query OBSERVED cardinalities so
    the coordinator can re-rank not-yet-scheduled joins by runtime
-   truth. Epochs are process-local (like the plan cache they version)
-   and never persist.
+   truth. Epochs PERSIST through the store: every record (and every
+   checkpoint copy at rotation) carries the current epoch beside each
+   node's learned rows, and load restores the highest epoch seen — a
+   restarted or failed-over coordinator keeps its epoch plane instead
+   of silently serving cold-epoch cache hits against warm plans.
 """
 
 from __future__ import annotations
@@ -442,10 +445,12 @@ class QueryHistoryStore:
         self._nodes: Dict[str, float] = {}
         #: node sub-fingerprint -> monotonic epoch, bumped when a
         #: record MATERIALLY changes the learned cardinality (first
-        #: learn included — new evidence versus no evidence). Process-
-        #: local, like the plan-cache entries it versions: never
-        #: persisted, never reset by eviction (monotonicity is the
-        #: staleness signal).
+        #: learn included — new evidence versus no evidence). Never
+        #: reset by eviction (monotonicity is the staleness signal);
+        #: persisted beside every record ("epochs" field) and restored
+        #: at load as the max epoch seen, so a restarted coordinator's
+        #: plan-cache entries compare against the SAME epochs they
+        #: were validated at.
         self._epochs: Dict[str, int] = {}
         self.hits = 0
         self.misses = 0
@@ -509,6 +514,16 @@ class QueryHistoryStore:
         fp = rec["fp"]
         self._index[fp] = rec
         self._index.move_to_end(fp)
+        # epoch restore: records persist the epoch each node carried
+        # when written; max() keeps monotonicity over replay order
+        # (older checkpoint copies must never roll a newer epoch back)
+        for nfp, ep in (rec.get("epochs") or {}).items():
+            try:
+                ep = int(ep)
+            except (TypeError, ValueError):
+                continue
+            if ep > self._epochs.get(nfp, 0):
+                self._epochs[nfp] = ep
 
     def _shrink_index(self, evict_metric: bool = True) -> int:
         from presto_tpu.utils.metrics import REGISTRY
@@ -573,32 +588,9 @@ class QueryHistoryStore:
             "ts": time.time(),
             "nodes": nodes,
         }
-        line = json.dumps(rec, default=str)
         with self._lock:
-            rotate = self._cur_count >= self._seg_entries
-            if rotate:
-                self._seg_seq += 1
-                self._cur_count = 0
-            try:
-                with open(self._cur_segment(), "a", encoding="utf-8") as f:
-                    if rotate:
-                        # compaction checkpoint: the fresh segment
-                        # opens with a snapshot of the live index, so
-                        # every entry stays replayable once GC drops
-                        # the older segments
-                        for old in self._index.values():
-                            if old.get("fp") != stmt_fp:
-                                f.write(
-                                    json.dumps(old, default=str) + "\n"
-                                )
-                    f.write(line + "\n")
-                    f.flush()
-                self._cur_count += 1
-                if rotate:
-                    self._gc_segments()
-            except OSError:
-                pass  # a full/broken disk must never fail the query
-            # epoch plane: a record that MATERIALLY changes a learned
+            # epoch plane FIRST (so the record persists the bumped
+            # epochs): a record that MATERIALLY changes a learned
             # cardinality (or learns one for the first time) bumps the
             # node's epoch — the cheap staleness signal plan-cache
             # entries compare against. Small drift keeps the epoch:
@@ -613,6 +605,44 @@ class QueryHistoryStore:
                     prev_rows, new_rows, self.divergence_factor
                 ):
                     self._epochs[nfp] = self._epochs.get(nfp, 0) + 1
+            # the epoch rides the record to disk: a restarted
+            # coordinator restores it at load instead of serving
+            # cold-epoch cache hits (epoch 0) against warm plans
+            rec["epochs"] = {
+                nfp: self._epochs.get(nfp, 0) for nfp in nodes
+            }
+            line = json.dumps(rec, default=str)
+            rotate = self._cur_count >= self._seg_entries
+            if rotate:
+                self._seg_seq += 1
+                self._cur_count = 0
+            try:
+                with open(self._cur_segment(), "a", encoding="utf-8") as f:
+                    if rotate:
+                        # compaction checkpoint: the fresh segment
+                        # opens with a snapshot of the live index, so
+                        # every entry stays replayable once GC drops
+                        # the older segments — epochs refreshed to
+                        # CURRENT (a record's stored epoch may predate
+                        # later bumps; the checkpoint must not replay
+                        # a rollback)
+                        for old in self._index.values():
+                            if old.get("fp") != stmt_fp:
+                                dup = dict(old)
+                                dup["epochs"] = {
+                                    nfp: self._epochs.get(nfp, 0)
+                                    for nfp in (old.get("nodes") or {})
+                                }
+                                f.write(
+                                    json.dumps(dup, default=str) + "\n"
+                                )
+                    f.write(line + "\n")
+                    f.flush()
+                self._cur_count += 1
+                if rotate:
+                    self._gc_segments()
+            except OSError:
+                pass  # a full/broken disk must never fail the query
             prev = self._index.get(stmt_fp)
             self._apply(rec)
             evicted = self._shrink_index()

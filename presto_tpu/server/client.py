@@ -4,12 +4,24 @@ Reference parity: the ``StatementClient`` inside ``presto-client/``
 (SURVEY.md §1 L0) — submit SQL with one POST, then follow ``nextUri``
 pages until the response carries no continuation, accumulating data
 rows; surface server-side failures as exceptions.
+
+Multi-coordinator HA: constructed with a LIST of coordinator URIs the
+client SPRAYS statements round-robin, and on a connection-level
+failure re-targets the SAME statement token at a peer — a coordinator
+that failed over the query serves it by alias, any other live
+coordinator redirects through its lease-payload lookup. A 404 from
+EVERY coordinator means the alias chain is exhausted (nothing can
+resume the statement) and fails the query immediately instead of
+spinning the full reconnect budget. One URI keeps the legacy
+single-coordinator behavior bit-exact.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
+import urllib.error
 from typing import Dict, List
 
 from presto_tpu.server import protocol, rpc
@@ -33,17 +45,35 @@ class ClientResult:
 
 
 class PrestoTpuClient:
-    """Minimal blocking client for one coordinator."""
+    """Minimal blocking client for one coordinator (or a spray list
+    of peers — see the module docstring)."""
 
     def __init__(
         self,
-        coordinator_uri: str,
+        coordinator_uri,
         timeout_s: float = 120.0,
         user: str = "presto_tpu",
         rpc_policy: rpc.RpcPolicy = rpc.DEFAULT_POLICY,
         reconnect_attempts: int = 8,
     ):
-        self.uri = coordinator_uri.rstrip("/")
+        # one URI, a comma-separated string, or a sequence of URIs
+        if isinstance(coordinator_uri, str):
+            uris = [
+                u.strip()
+                for u in coordinator_uri.split(",")
+                if u.strip()
+            ]
+        else:
+            uris = [str(u).strip() for u in coordinator_uri]
+        if not uris:
+            raise ValueError("at least one coordinator URI required")
+        #: spray set: statements round-robin across these; nextUri
+        #: polls re-target across them on connection failure
+        self.uris = [u.rstrip("/") for u in uris]
+        #: first coordinator — the single-target compatibility handle
+        #: (observability GETs and existing callers read it)
+        self.uri = self.uris[0]
+        self._rr = itertools.count(0)
         self.timeout_s = timeout_s
         self.user = user  # sent as X-Presto-User (resource-group routing)
         #: per-request policy: nextUri GETs are idempotent and retry
@@ -67,9 +97,7 @@ class PrestoTpuClient:
         self.prepared: Dict[str, str] = {}
 
     def execute(self, sql: str) -> ClientResult:
-        first = self._post_json(
-            self.uri + "/v1/statement", sql.encode()
-        )
+        first = self._post_statement(sql.encode())
         qid = first["id"]
         columns: List[str] = []
         data: List[list] = []
@@ -108,6 +136,49 @@ class PrestoTpuClient:
                 except ValueError:
                     pass
 
+    def _post_statement(self, body: bytes) -> dict:
+        """Submit one statement, spraying the coordinator list
+        round-robin. A connection-level failure moves to the next peer
+        (the POST was never delivered, so re-targeting starts no
+        duplicate query); a 503 moves on too — the coordinator is
+        shutting down and explicitly admitted NOTHING. Any other HTTP
+        error response surfaces — the server answered, resubmitting
+        elsewhere WOULD double-run."""
+        start = next(self._rr) % len(self.uris)
+        order = self.uris[start:] + self.uris[:start]
+        for i, base in enumerate(order):
+            try:
+                return self._post_json(base + "/v1/statement", body)
+            except Exception as e:
+                refused = (
+                    isinstance(e, urllib.error.HTTPError)
+                    and e.code == 503
+                )
+                if (
+                    not (refused or rpc.is_retryable(e))
+                    or i + 1 >= len(order)
+                ):
+                    raise
+                REGISTRY.counter("client.spray_retargets").update()
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _spray_targets(self, url: str) -> List[str]:
+        """The URL plus its rebase onto every other coordinator in the
+        spray set (origin first — the server that minted it is the
+        likeliest to answer). Single-coordinator: just the URL."""
+        if len(self.uris) == 1:
+            return [url]
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        path = parts.path + (
+            f"?{parts.query}" if parts.query else ""
+        )
+        origin = f"{parts.scheme}://{parts.netloc}"
+        return [origin + path] + [
+            b + path for b in self.uris if b != origin
+        ]
+
     def _get_with_reconnect(self, url: str, deadline: float):
         """One nextUri GET with transparent reconnect: a coordinator
         bounce mid-pagination presents as connection resets/refusals,
@@ -115,24 +186,57 @@ class PrestoTpuClient:
         for journal-resumed queries — so connection-level failures
         retry with full-jitter backoff up to the reconnect budget. An
         HTTP error response (the server answered) and the query's own
-        ``error`` payload surface immediately, as before."""
+        ``error`` payload surface immediately, as before.
+
+        With a spray set, each attempt SWEEPS every coordinator: a
+        peer that claimed the dead coordinator's journal serves the
+        statement by alias, and any other live peer redirects to it.
+        Two terminal verdicts are distinguished: "coordinator gone"
+        (connection failure — re-target and, across sweeps, spend the
+        reconnect budget) versus "statement gone" (404 from EVERY
+        coordinator — the alias chain is exhausted, nothing can resume
+        the query: fail NOW, not after the full backoff schedule)."""
         attempt = 0
+        last_exc: Exception = None
         while True:
-            try:
-                return rpc.call("GET", url, policy=self.rpc_policy)
-            except Exception as e:
-                if not rpc.is_retryable(e):
+            targets = self._spray_targets(url)
+            gone = 0
+            for target in targets:
+                try:
+                    resp = rpc.call(
+                        "GET", target, policy=self.rpc_policy
+                    )
+                    if target != url:
+                        REGISTRY.counter("client.retargets").update()
+                    return resp
+                except urllib.error.HTTPError as e:
+                    # the server ANSWERED. Only a 404 with peers left
+                    # to consult means "ask another coordinator" —
+                    # anything else is final, exactly as before
+                    if e.code == 404 and len(targets) > 1:
+                        gone += 1
+                        last_exc = e
+                        continue
                     raise
-                attempt += 1
-                if (
-                    attempt > self.reconnect_attempts
-                    or time.monotonic() > deadline
-                ):
-                    raise
-                REGISTRY.counter("client.reconnects").update()
-                time.sleep(
-                    rpc.compute_backoff(attempt - 1, self.rpc_policy)
+                except Exception as e:
+                    if not rpc.is_retryable(e):
+                        raise
+                    last_exc = e
+            if gone == len(targets):
+                raise QueryFailed(
+                    "statement gone on every coordinator "
+                    f"(alias chain exhausted): {url}"
                 )
+            attempt += 1
+            if (
+                attempt > self.reconnect_attempts
+                or time.monotonic() > deadline
+            ):
+                raise last_exc
+            REGISTRY.counter("client.reconnects").update()
+            time.sleep(
+                rpc.compute_backoff(attempt - 1, self.rpc_policy)
+            )
 
     def _absorb_prepared_headers(self, headers) -> None:
         added = headers.get_all(protocol.ADDED_PREPARE_HEADER)

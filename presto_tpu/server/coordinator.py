@@ -17,6 +17,7 @@ import dataclasses
 import itertools
 import json
 import logging
+import os
 import sys
 import threading
 import time
@@ -576,7 +577,48 @@ class CoordinatorServer:
         # running queries + the prepared registry survive a bounce —
         # start() replays the journal and re-admits open queries
         jp = config.get("coordinator.journal-path") if config else None
-        self.journal = CoordinatorJournal(jp) if jp else None
+        # multi-coordinator control plane: with coordinator.peers set,
+        # the journal path is a SHARED directory — each coordinator
+        # journals under its own subdirectory and publishes an
+        # atomic-rename lease beside it (server/lease.py). Peers fold
+        # each other's lease payloads into admission (memory arbiter,
+        # resource-group quotas, QoS lanes) and claim+resume a dead
+        # peer's journal on lease expiry. Without peers the lease
+        # plane never constructs and the journal lives at the path
+        # root — bit-exact single-coordinator behavior.
+        self.coord_id = (
+            (config.get("node.id") if config else None)
+            or f"coord-{uuid.uuid4().hex[:6]}"
+        )
+        peers_raw = config.get("coordinator.peers") if config else None
+        self._peer_uris = [
+            u.strip()
+            for u in str(peers_raw or "").split(",")
+            if u.strip()
+        ]
+        self.lease = None
+        self._control_dir = None
+        self._lease_thread = None
+        #: dead-peer journals this incarnation claimed / queries it
+        #: resumed from them (nodes + failover observability)
+        self.failover_claims = 0
+        self.failover_resumed = 0
+        if jp and self._peer_uris:
+            from presto_tpu.server.lease import LeasePlane
+
+            self._control_dir = jp
+            self.journal = CoordinatorJournal(
+                os.path.join(jp, self.coord_id)
+            )
+            self.lease = LeasePlane(
+                jp,
+                self.coord_id,
+                ttl_s=float(
+                    config.get("lease.ttl-s", 10.0) if config else 10.0
+                ),
+            )
+        else:
+            self.journal = CoordinatorJournal(jp) if jp else None
         #: queries re-admitted from the journal at this boot
         self.resumed_queries = 0
         #: old-boot qid -> this boot's qid: statement/query-info URLs
@@ -676,6 +718,13 @@ class CoordinatorServer:
             self.qos = QosController(
                 self, config, max_concurrent_queries
             )
+        # multi-coordinator shared admission: live peers' lease
+        # payloads fold into the memory view and the QoS lane columns
+        # (both hooks default None — single-coordinator stays bit-exact)
+        if self.lease is not None:
+            self.arbiter.peer_reports_fn = self._peer_memory_reports
+            if self.qos is not None:
+                self.qos.peer_lanes_fn = self.peer_lane_occupancy
 
         # device-plane telemetry (utils/telemetry.py): federation of
         # the workers' /v1/metrics expositions behind
@@ -719,6 +768,10 @@ class CoordinatorServer:
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.uri = f"http://127.0.0.1:{self.port}"
+        if self.lease is not None:
+            # the serving URI exists only after the bind: peers reach
+            # a claimed incarnation's clients through this lease field
+            self.lease.uri = self.uri
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )
@@ -752,12 +805,28 @@ class CoordinatorServer:
                 target=self._telemetry_loop, daemon=True
             )
             self._telemetry_thread.start()
+        # multi-coordinator lease: publish BEFORE serving (a peer must
+        # never observe this incarnation's statements without a lease
+        # to locate them through), then heartbeat + peer-watch loop
+        if self.lease is not None and self._lease_thread is None:
+            try:
+                self.lease.renew(self._lease_state())
+            except Exception:
+                log.exception("initial lease publish failed")
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, daemon=True
+            )
+            self._lease_thread.start()
         self._serve_thread.start()
         return self
 
     def shutdown(self) -> None:
         self._shutting_down = True
         self._telemetry_stop.set()
+        if self.lease is not None:
+            # clean shutdown WITHDRAWS the lease: peers see an absent
+            # file, not an expiring one, and claim nothing
+            self.lease.stop()
         if self.autoscaler is not None:
             self.autoscaler.stop()
         if self.ingest is not None:
@@ -860,6 +929,284 @@ class CoordinatorServer:
             if new:
                 q = self.queries.get(new)
         return q
+
+    # --------------------------------- multi-coordinator control plane
+
+    def _lease_state(self) -> dict:
+        """This coordinator's lease payload (server/lease.py): the
+        shared-state channel peers fold into THEIR admission view —
+        open statement ids (plus aliases, so any peer can redirect a
+        sprayed client), admission occupancy, the local-pool memory
+        report, per-resource-group usage, and QoS-lane counts."""
+        with self._lock:
+            open_q = [
+                (qid, getattr(q, "resource_group", None))
+                for qid, q in self.queries.items()
+                if not q.done.is_set()
+            ]
+            aliases = list(self._qid_alias.keys())
+            pending = self._pending
+        groups: Dict[str, dict] = {}
+        for qid, g in open_q:
+            if not g:
+                continue
+            d = groups.setdefault(g, {"qids": [], "local_bytes": 0})
+            d["qids"].append(qid)
+            d["local_bytes"] += self.memory_pool.used_bytes(qid)
+        state = {
+            "uri": self.uri,
+            "boot": self._boot,
+            "qids": [qid for qid, _ in open_q] + aliases,
+            "running": pending,
+            "local": self.arbiter.local_report(),
+            "groups": groups,
+        }
+        if self.qos is not None:
+            state["lanes"] = self.qos.lane_occupancy()
+        return state
+
+    def _peer_memory_reports(self) -> Dict[str, dict]:
+        """Live peers' LOCAL-pool reports for the arbiter's cluster
+        view, keyed ``coord:<id>``. Worker bytes are NOT re-folded
+        (workers heartbeat every coordinator directly); the blocked
+        lane is cleared — kill/unblock decisions stay local-evidence
+        only, a stale peer payload must never nominate victims here."""
+        out: Dict[str, dict] = {}
+        for pl in self.lease.peers(live_only=True):
+            rep = (pl.state or {}).get("local")
+            if not isinstance(rep, dict):
+                continue
+            rep = dict(rep)
+            rep["ts"] = pl.ts
+            rep["blocked"] = []
+            out[f"coord:{pl.owner}"] = rep
+        return out
+
+    def peer_lane_occupancy(self) -> Dict[str, dict]:
+        """Live peers' QoS-lane occupancy keyed by peer id — the
+        ``system.runtime.qos`` cluster fold (server/qos.py)."""
+        out: Dict[str, dict] = {}
+        for pl in self.lease.peers(live_only=True):
+            lanes = (pl.state or {}).get("lanes")
+            if isinstance(lanes, dict):
+                out[pl.owner] = lanes
+        return out
+
+    def locate_peer(self, qid: str) -> str:
+        """URI of the live peer serving ``qid`` (its lease payload
+        lists it as open or aliased), or "". The statement route uses
+        this to redirect a sprayed/failed-over client that landed on
+        the wrong coordinator."""
+        if self.lease is None:
+            return ""
+        for pl in self.lease.peers(live_only=True):
+            st = pl.state or {}
+            if qid in (st.get("qids") or ()):
+                return str(st.get("uri") or pl.uri)
+        return ""
+
+    def _lease_loop(self) -> None:
+        """Heartbeat + peer watch, at TTL/3 cadence (two missed beats
+        never expire a healthy owner): renew the lease with fresh
+        shared state, announce this coordinator to every peer (they
+        surface it in system.runtime.nodes), and claim + fail over any
+        peer whose lease expired."""
+        interval = max(self.lease.ttl_s / 3.0, 0.05)
+        policy = rpc.RpcPolicy(timeout_s=2.0, retries=0)
+        while not self._shutting_down:
+            try:
+                self.lease.renew(self._lease_state())
+            except Exception:
+                log.exception("lease renewal failed")
+            for peer in self._peer_uris:
+                if self._shutting_down:
+                    break
+                try:
+                    rpc.call_json(
+                        "PUT",
+                        peer + "/v1/announcement",
+                        {
+                            "node_id": self.coord_id,
+                            "uri": self.uri,
+                            "state": "ACTIVE",
+                            "role": "coordinator",
+                        },
+                        policy=policy,
+                    )
+                except Exception:
+                    pass  # the lease file is the durable signal
+            try:
+                self._scan_expired_peers()
+            except Exception:
+                log.exception("peer lease scan failed")
+            deadline = time.monotonic() + interval
+            while (
+                not self._shutting_down
+                and time.monotonic() < deadline
+            ):
+                time.sleep(min(0.05, interval))
+
+    def _scan_expired_peers(self) -> None:
+        if self._shutting_down:
+            return
+        for pl in self.lease.peers(live_only=False):
+            if not self.lease.is_expired(pl):
+                continue
+            claim = self.lease.claim_expired(pl.owner)
+            if claim is None:
+                continue  # still live, retired, or another claimant won
+            self.failover_claims += 1
+            REGISTRY.counter("coordinator.failover_claims").update()
+            log.warning(
+                "lease of %s expired (age %.1fs): claimed its journal "
+                "at fencing epoch %d",
+                pl.owner,
+                pl.age(),
+                claim.epoch,
+            )
+            self._failover_from(pl.owner, claim)
+
+    def _failover_from(self, owner: str, claim) -> None:
+        """Replay a dead peer's claimed journal: re-admit every
+        non-terminal query under THIS boot's qids, close the old ids
+        out as RESUMED (with ``resumed_as``) in the DEAD journal, and
+        alias them locally + in OUR journal so the dead incarnation's
+        statement URIs resolve here — for clients landing directly
+        (reconnect spray) and via any peer's alias redirect. Every
+        write into claimed state is fence-checked: a superseded
+        claimant abandons the failover instead of double-resuming."""
+        from presto_tpu.server.lease import FencedError
+
+        dead_dir = os.path.join(self._control_dir, owner)
+        if not os.path.isdir(dead_dir):
+            # peer never journaled (no queries): nothing to replay
+            self.lease.retire(owner)
+            return
+        try:
+            self.lease.check_fence(claim)
+            dead = CoordinatorJournal(dead_dir)
+            # stamp the claim INTO the claimed journal first: a
+            # replayer (including the dead owner restarting) sees who
+            # took the queries and at what epoch
+            dead.record_claim(self.coord_id, claim.epoch)
+            state = dead.replay()
+        except FencedError:
+            log.warning(
+                "failover from %s abandoned: claim superseded", owner
+            )
+            return
+        resumed: Dict[str, str] = {}
+        # same temporary-headroom rule as _recover_from_journal: the
+        # dead peer already admitted these under its own queue cap
+        prev_max = self._max_queued
+        self._max_queued = prev_max + len(state.open)
+        try:
+            for rec in state.open:
+                old_qid = rec.get("qid", "")
+                try:
+                    self.lease.check_fence(claim)
+                except FencedError:
+                    log.warning(
+                        "failover from %s fenced mid-replay "
+                        "(resumed %d of %d)",
+                        owner,
+                        len(resumed),
+                        len(state.open),
+                    )
+                    return
+                q = self.submit(
+                    rec.get("sql", ""),
+                    user=rec.get("user") or "presto_tpu",
+                    prepared=rec.get("prepared") or {},
+                )
+                if q.done.is_set() and q.state == "FAILED" and (
+                    q.error or ""
+                ).startswith("Query rejected"):
+                    dead.record_finish(old_qid, "FAILED")
+                    log.warning(
+                        "failover: re-admission of %s rejected", old_qid
+                    )
+                    continue
+                # our submit frame is on disk before the dead id's
+                # RESUMED close-out — a crash between the two can only
+                # duplicate a resume, never lose the query
+                dead.record_finish(
+                    old_qid, "RESUMED", resumed_as=q.qid
+                )
+                if self.journal is not None:
+                    self.journal.record_alias(old_qid, q.qid)
+                resumed[old_qid] = q.qid
+                with self._lock:
+                    self._qid_alias[old_qid] = q.qid
+                q.resumed_from = old_qid
+                self.failover_resumed += 1
+                self.resumed_queries += 1
+                REGISTRY.counter("coordinator.failover_resumed").update()
+                REGISTRY.counter("coordinator.resumed_queries").update()
+                log.info(
+                    "failover: resumed %s (from %s) as %s",
+                    old_qid,
+                    owner,
+                    q.qid,
+                )
+        finally:
+            self._max_queued = prev_max
+        # transitive aliases: ids the DEAD peer was itself serving by
+        # alias chain land on this boot's runs too (journal writes
+        # happen OUTSIDE the discovery lock)
+        trans = [
+            (old, resumed[tip])
+            for old, tip in state.aliases.items()
+            if tip in resumed
+        ]
+        with self._lock:
+            for old, new in trans:
+                self._qid_alias[old] = new
+        if self.journal is not None:
+            for old, new in trans:
+                self.journal.record_alias(old, new)
+        # adopt the dead peer's prepared registry (names a sprayed
+        # client may EXECUTE against any coordinator)
+        adopted = []
+        for name, text in state.prepared.items():
+            with self._prepared_mu:
+                if name in self._prepared_sql:
+                    continue
+                self._prepared_sql[name] = text
+                self._prepared_sql.move_to_end(name)
+            adopted.append((name, text))
+        if self.journal is not None:
+            for name, text in adopted:
+                self.journal.record_prepare(name, text)
+        # fully failed over: drop the lease + claim files so restarts
+        # of the dead owner rejoin fresh instead of re-claiming
+        self.lease.retire(owner)
+        if state.open:
+            log.info(
+                "failover from %s complete: resumed %d quer%s",
+                owner,
+                len(resumed),
+                "y" if len(resumed) == 1 else "ies",
+            )
+
+    def _fault_kill(self) -> None:
+        """Abrupt crash for the fault plane's ``kill_coordinator``
+        action: drop the journal handle (no FAILED close-out may reach
+        disk — the open frames are what a survivor resumes), leave the
+        lease to EXPIRE (survivors must take the TTL path, exactly
+        like a real crash), and close the socket so clients see a dead
+        peer, not a clean error."""
+        self._shutting_down = True
+        self.journal = None
+        try:
+            if self._serve_thread.is_alive():
+                self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+        log.warning(
+            "node=%s fault plane killed this coordinator", self.coord_id
+        )
 
     # ------------------------------------------------ elastic worker pool
 
@@ -1051,7 +1398,12 @@ class CoordinatorServer:
         slice_id: str = "",
         device_coords=(),
         backend_diag: Optional[dict] = None,
+        role: str = "",
     ) -> None:
+        # peer coordinators announce like workers (role=coordinator on
+        # the discovery body): visible in system.runtime.nodes, but
+        # NEVER schedulable — _ttl_workers filters them out
+        is_coord = role == "coordinator"
         with self._lock:
             w = self.workers.get(node_id)
             if w is None:
@@ -1061,6 +1413,7 @@ class CoordinatorServer:
                     slice_id=str(slice_id or ""),
                     device_coords=tuple(device_coords or ()),
                     backend_diag=dict(backend_diag or {}),
+                    coordinator=is_coord,
                 )
             else:
                 w.last_seen = time.time()
@@ -1069,6 +1422,7 @@ class CoordinatorServer:
                 w.preemptible = bool(preemptible)
                 w.slice_id = str(slice_id or "")
                 w.device_coords = tuple(device_coords or ())
+                w.coordinator = is_coord
                 if backend_diag:
                     w.backend_diag = dict(backend_diag)
         # fold the heartbeat's memory report into the cluster view —
@@ -1079,13 +1433,16 @@ class CoordinatorServer:
     def _ttl_workers(self) -> List[_WorkerNode]:
         """Workers announced within the discovery TTL (no breaker
         filtering — callers that must not consume half-open probe
-        slots use this directly)."""
+        slots use this directly). Peer coordinators announce through
+        the same channel but are NOT workers: nothing schedules on
+        them, probes them, or expects task routes there."""
         now = time.time()
         with self._lock:
             return [
                 w
                 for w in self.workers.values()
                 if now - w.last_seen <= NODE_TTL_S
+                and not w.coordinator
             ]
 
     def active_workers(self, exclude=()) -> List[_WorkerNode]:
@@ -1259,6 +1616,23 @@ class CoordinatorServer:
                 and getattr(q, "resource_group", None) == group_name
             ]
         local = sum(self.memory_pool.used_bytes(qid) for qid in qids)
+        # multi-coordinator shared quotas: fold live peers' published
+        # per-group usage (their coordinator-local bytes directly;
+        # their qids through the arbiter, which holds every worker's
+        # heartbeat once) so one group's softMemoryLimit holds across
+        # N admitters
+        if self.lease is not None:
+            for pl in self.lease.peers(live_only=True):
+                g = ((pl.state or {}).get("groups") or {}).get(
+                    group_name
+                )
+                if not isinstance(g, dict):
+                    continue
+                qids.extend(g.get("qids") or [])
+                try:
+                    local += int(g.get("local_bytes") or 0)
+                except (TypeError, ValueError):
+                    pass
         return local + self.arbiter.queries_bytes(qids)
 
     def submit(
@@ -1276,6 +1650,12 @@ class CoordinatorServer:
         q.user = user
         q.prepared = dict(prepared or {})
         q.resource_group = None
+        # snapshot the journal handle: a fault-plane kill racing this
+        # submit nulls self.journal (no close-out may reach disk), but
+        # a statement already past the handler's shutdown gate must
+        # still land its submit frame — an ACKed query with no frame
+        # would be unresumable by any survivor
+        j = self.journal
         with self._lock:
             self.queries[q.qid] = q
             # bounded retention (reference: query.max-history): evict
@@ -1315,10 +1695,8 @@ class CoordinatorServer:
         if self.resource_groups is None:
             # journal BEFORE the execution thread can start: finish
             # must never precede submit on disk
-            if self.journal is not None:
-                self.journal.record_submit(
-                    q.qid, sql, user, q.prepared, None
-                )
+            if j is not None:
+                j.record_submit(q.qid, sql, user, q.prepared, None)
             threading.Thread(
                 target=self._execute_query, args=(q,), daemon=True
             ).start()
@@ -1332,10 +1710,10 @@ class CoordinatorServer:
         # group assignment is deterministic: record it before the
         # thread can race to the finish hook
         q.resource_group = self.resource_groups.group_of(user).name
-        if self.journal is not None:
+        if j is not None:
             # before resource_groups.submit — a run-now admission
             # starts the thread synchronously inside it
-            self.journal.record_submit(
+            j.record_submit(
                 q.qid, sql, user, q.prepared, q.resource_group
             )
         state, info = self.resource_groups.submit(user, start)
@@ -1345,8 +1723,8 @@ class CoordinatorServer:
             q.fail(info)
             REGISTRY.counter("coordinator.queries_rejected").update()
             q.done.set()
-            if self.journal is not None:
-                self.journal.record_finish(q.qid, "FAILED")
+            if j is not None:
+                j.record_finish(q.qid, "FAILED")
             return q
         q.resource_group = info
         return q
@@ -1406,6 +1784,18 @@ class CoordinatorServer:
                 self.resource_groups.finish(q.resource_group)
             if self.journal is not None:
                 self.journal.record_finish(q.qid, q.state)
+            return
+        # chaos hook (utils/faults.py kill_coordinator): fires at the
+        # admitted-but-not-yet-RUNNING seam — the journal holds the
+        # submit frame with no close-out, exactly the state a real
+        # crash strands. The "dead" coordinator returns silently: no
+        # FAILED transition, no journal write, no client answer — a
+        # surviving peer claims and resumes the query
+        try:
+            faults.maybe_inject_coordinator(
+                self.coord_id, q.qid, kill=self._fault_kill
+            )
+        except faults.FaultInjectedError:
             return
         q.state = "RUNNING"
         q.stats.state = "RUNNING"
@@ -4117,6 +4507,14 @@ def _make_handler(coord: CoordinatorServer):
             if parts == ["v1", "statement"]:
                 from presto_tpu.server import protocol
 
+                # a dying coordinator must not ACK a statement it
+                # cannot journal (the ack promises a resumable query):
+                # 503 = "nothing admitted", which the spray client
+                # re-targets at a peer duplicate-free
+                if coord._shutting_down:
+                    return self._json(
+                        503, {"error": "coordinator shutting down"}
+                    )
                 sql = self._read_body().decode()
                 user = self.headers.get("X-Presto-User", "presto_tpu")
                 # client-owned prepared statements ride per-request
@@ -4128,6 +4526,15 @@ def _make_handler(coord: CoordinatorServer):
                     )
                 )
                 q = coord.submit(sql, user=user, prepared=prepared)
+                # re-check AFTER submit: a kill that raced past the
+                # gate above may have dropped the journal before the
+                # frame landed — refuse the ACK (the client resubmits
+                # at a peer; a frame that DID land resumes there too,
+                # which is the journal's at-least-once contract)
+                if coord._shutting_down:
+                    return self._json(
+                        503, {"error": "coordinator shutting down"}
+                    )
                 return self._json(
                     200,
                     {
@@ -4178,8 +4585,20 @@ def _make_handler(coord: CoordinatorServer):
                     slice_id=d.get("slice_id", ""),
                     device_coords=d.get("device_coords", ()),
                     backend_diag=d.get("backend_diag"),
+                    role=d.get("role", ""),
                 )
-                return self._json(200, {"ok": True})
+                # the ack names this coordinator incarnation: workers
+                # track the boot nonces they have heard from so the
+                # orphan reaper can tell "my coordinator restarted"
+                # from "my coordinator is briefly quiet"
+                return self._json(
+                    200,
+                    {
+                        "ok": True,
+                        "node_id": coord.coord_id,
+                        "boot": coord._boot,
+                    },
+                )
             self._json(404, {"error": f"no route {self.path}"})
 
         def do_GET(self):
@@ -4248,6 +4667,23 @@ def _make_handler(coord: CoordinatorServer):
                 qid, token = parts[2], int(parts[3])
                 q = coord.lookup_query(qid)
                 if q is None:
+                    # multi-coordinator alias lookup: a sprayed (or
+                    # failed-over) client may land here holding a
+                    # statement another live coordinator serves —
+                    # redirect via its lease payload. Loop-free:
+                    # coordinators only advertise qids they can
+                    # resolve locally
+                    peer = coord.locate_peer(qid)
+                    if peer:
+                        return self._json(
+                            200,
+                            {
+                                "id": qid,
+                                "nextUri": (
+                                    f"{peer}/v1/statement/{qid}/{token}"
+                                ),
+                            },
+                        )
                     return self._json(404, {"error": "no such query"})
                 if q.state == "SUSPENDED" and not q.done.is_set():
                     # QoS preempt-and-resume: a parked query must not

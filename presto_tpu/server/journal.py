@@ -12,7 +12,11 @@ they happen:
 - query completion (``finish``: any terminal state — FINISHED, FAILED,
   or RESUMED when a restart re-admitted the query under a new id),
 - the coordinator-global prepared-statement registry
-  (``prepare`` / ``deallocate``).
+  (``prepare`` / ``deallocate``),
+- multi-coordinator failover (``claim``: a lease-fenced survivor took
+  this journal's open queries over at a fencing epoch; ``alias``: a
+  dead peer's qid now resolves to one of OURS — the durable half of
+  the cross-coordinator alias chain).
 
 On restart the coordinator replays the journal and re-admits every
 query that never reached a terminal state, under the NEW boot's query
@@ -108,6 +112,13 @@ class JournalState:
     #: to (collapsed): a client URI from N bounces ago must still
     #: resolve to whatever run carries its query today
     aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: last ``claim`` frame, or None: a peer coordinator fenced this
+    #: journal at ``claim["epoch"]`` and took its open queries over. A
+    #: restarted original owner must rejoin ABOVE that epoch (the
+    #: lease plane reads it at construction) and must not re-admit
+    #: what the claimant already resumed — the claimant's RESUMED
+    #: close-outs in this same journal guarantee that.
+    claim: Optional[dict] = None
 
 
 class CoordinatorJournal:
@@ -123,6 +134,8 @@ class CoordinatorJournal:
         #: resumed old qid -> its replacement qid (one hop; collapsed
         #: to the live tip in :meth:`_live_aliases`)
         self._alias: Dict[str, str] = {}
+        #: last claim frame a failover survivor fenced this journal at
+        self._claim: Optional[dict] = None
         os.makedirs(path, exist_ok=True)
         self._replayed = self._load()
 
@@ -187,6 +200,7 @@ class CoordinatorJournal:
             open=list(self._open.values()),
             prepared=dict(self._prepared),
             aliases=self._live_aliases(),
+            claim=dict(self._claim) if self._claim else None,
         )
         REGISTRY.counter("journal.replayed").update(len(state.open))
         return state
@@ -220,6 +234,18 @@ class CoordinatorJournal:
                 self._alias[rec["qid"]] = rec["resumed_as"]
             else:
                 self._alias.pop(rec.get("qid"), None)
+        elif ev == "alias":
+            # cross-coordinator inheritance: a failover survivor folds
+            # the DEAD journal's alias chains into its OWN journal, so
+            # a statement URI minted two coordinators ago still
+            # resolves after the survivor itself dies
+            if rec.get("old") and rec.get("qid"):
+                self._alias[rec["old"]] = rec["qid"]
+        elif ev == "claim":
+            self._claim = {
+                "claimant": rec.get("claimant", ""),
+                "epoch": int(rec.get("epoch", 0)),
+            }
         elif ev == "prepare" and rec.get("name"):
             self._prepared[rec["name"]] = rec.get("sql", "")
         elif ev == "deallocate":
@@ -234,6 +260,9 @@ class CoordinatorJournal:
             )
             self._prepared = dict(rec.get("prepared") or {})
             self._alias = dict(rec.get("aliases") or {})
+            self._claim = (
+                dict(rec["claim"]) if rec.get("claim") else None
+            )
 
     # ----------------------------------------------------------- write
 
@@ -260,6 +289,11 @@ class CoordinatorJournal:
                             # aliases pruned to live chains, so the
                             # map cannot grow past the open set
                             "aliases": self._live_aliases(),
+                            "claim": (
+                                dict(self._claim)
+                                if self._claim
+                                else None
+                            ),
                         }
                         f.write(
                             _frame_line(json.dumps(ckpt, default=str))
@@ -365,6 +399,23 @@ class CoordinatorJournal:
                 "suspended_ms": float(suspended_ms),
             }
         )
+
+    def record_claim(self, claimant: str, epoch: int) -> None:
+        """One failover claim against THIS journal (written by the
+        lease-fenced survivor, first, before any close-out): a
+        restarted original owner replays it and learns it was claimed
+        at ``epoch`` — its new lease must rejoin strictly above."""
+        self._append(
+            {"ev": "claim", "claimant": claimant, "epoch": int(epoch)}
+        )
+
+    def record_alias(self, old_qid: str, qid: str) -> None:
+        """One inherited restart alias (written into the SURVIVOR's
+        own journal at failover): ``old_qid`` — an id minted by a dead
+        peer — now resolves to this coordinator's ``qid``. Makes the
+        cross-coordinator alias chain durable past the survivor's own
+        next bounce."""
+        self._append({"ev": "alias", "old": old_qid, "qid": qid})
 
     def record_prepare(self, name: str, sql: str) -> None:
         self._append({"ev": "prepare", "name": name, "sql": sql})

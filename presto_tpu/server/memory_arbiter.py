@@ -112,6 +112,15 @@ class ClusterMemoryArbiter:
         self._last_kill_ts = 0.0
         #: kill decisions, newest last (system.runtime.memory rows)
         self.decisions: deque = deque(maxlen=MAX_DECISIONS)
+        #: multi-coordinator hook (server/lease.py plane): returns
+        #: peer coordinators' LOCAL-pool reports keyed by a synthetic
+        #: node id — folded into the cluster view so admission
+        #: high-water and capacity are cluster-wide across N
+        #: admitters. None (the default) = single-coordinator view,
+        #: bit-exact pre-HA. Worker-side bytes are NOT re-folded here:
+        #: workers heartbeat every coordinator directly, so each
+        #: arbiter already holds them once.
+        self.peer_reports_fn = None
 
     # ---------------------------------------------------------- accounting
 
@@ -176,10 +185,31 @@ class ClusterMemoryArbiter:
         return rep
 
     def _view(self) -> Dict[str, dict]:
-        """Live per-node reports, coordinator included."""
+        """Live per-node reports, coordinator included — and, with
+        the multi-coordinator lease plane on, every live PEER
+        coordinator's local-pool report (their lease payloads), so
+        admission water marks gate against the whole cluster's
+        query-attributed bytes and pooled capacity."""
         view = self._live_reports()
         view["coordinator"] = self._local_report()
+        if self.peer_reports_fn is not None:
+            try:
+                for node, rep in (self.peer_reports_fn() or {}).items():
+                    if (
+                        isinstance(rep, dict)
+                        and "limit" in rep
+                        and isinstance(rep.get("queries"), dict)
+                    ):
+                        view.setdefault(node, rep)
+            except Exception:
+                pass  # a torn peer read must never stall admission
         return view
+
+    def local_report(self) -> dict:
+        """Public form of the coordinator-local fold — what this
+        coordinator publishes in its own lease payload for PEER
+        arbiters to fold (the mirror of ``peer_reports_fn``)."""
+        return self._local_report()
 
     def query_bytes(self, qid: str) -> Tuple[int, int]:
         """(current, peak) WORKER-side bytes of one query — remote

@@ -171,6 +171,10 @@ class QosController:
         )
         self._cond = threading.Condition()
         self._groups: Dict[str, _QosGroup] = {}
+        #: multi-coordinator hook (server/lease.py plane): returns
+        #: {peer_id: {lane: {"running", "queued"}}} from live peer
+        #: lease payloads; None (default) = local-only view, bit-exact
+        self.peer_lanes_fn = None
         #: qid -> entry, admission through release (suspended included)
         self._entries: Dict[str, _QosEntry] = {}
         self._running: Dict[str, _QosEntry] = {}
@@ -565,8 +569,32 @@ class QosController:
             "suspended_ms": getattr(q, "qos_suspended_ms", 0.0),
         }
 
+    def lane_occupancy(self) -> dict:
+        """Per-lane live occupancy — the QoS share of this
+        coordinator's lease payload (server/lease.py): peers fold it
+        into their ``system.runtime.qos`` view so lane pressure is
+        visible cluster-wide across N admitters."""
+        with self._cond:
+            return {
+                g.name: {
+                    "running": g.running,
+                    "queued": len(g.queue),
+                }
+                for g in self._groups.values()
+            }
+
     def view_rows(self) -> List[dict]:
-        """``system.runtime.qos``: one row per lane member."""
+        """``system.runtime.qos``: one row per lane member. With the
+        multi-coordinator lease plane on (``peer_lanes_fn`` set by the
+        coordinator), live peers' published lane occupancy folds into
+        the running/queued columns — the view reads cluster-wide;
+        single-coordinator deploys are bit-exact."""
+        peer_lanes: dict = {}
+        if self.peer_lanes_fn is not None:
+            try:
+                peer_lanes = self.peer_lanes_fn() or {}
+            except Exception:
+                peer_lanes = {}
         with self._cond:
             snap = []
             for g in self._groups.values():
@@ -588,6 +616,11 @@ class QosController:
         for g, running, queued, suspended in sorted(
             snap, key=lambda t: (-t[0].priority, t[0].name)
         ):
+            for lanes in peer_lanes.values():
+                peer = lanes.get(g.name)
+                if isinstance(peer, dict):
+                    running += int(peer.get("running", 0))
+                    queued += int(peer.get("queued", 0))
             v = g.latency.values()
             rows.append(
                 {

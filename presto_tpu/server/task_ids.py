@@ -109,3 +109,19 @@ def next_attempt(task_id: str) -> str:
     """Id for the replacement attempt of the same logical task."""
     t = parse(task_id)
     return mint(t.query_id, t.kind, t.seq, t.attempt + 1)
+
+
+#: coordinator query ids carry a per-boot nonce: ``q_c{N}_{hex6}``
+#: (see CoordinatorServer._boot — attempt ids minted across restarts
+#: sharing one spool must never collide)
+_QID_BOOT_RE = re.compile(r"^q_c\d+_([0-9a-f]{6})$")
+
+
+def boot_of_query(query_id: str) -> str:
+    """The coordinator-incarnation nonce baked into a query id, or ""
+    for ids without one (embedded-runner ``q_N`` ids, hand-written
+    test ids). The worker's orphan reaper keys task liveness on it: a
+    task whose minting incarnation stopped heartbeating is orphaned —
+    its buffers are held for nobody."""
+    m = _QID_BOOT_RE.match(query_id or "")
+    return m.group(1) if m is not None else ""

@@ -345,8 +345,40 @@ class WorkerServer:
         self.tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._shutting_down = False
-        self.coordinator_uri = coordinator_uri
+        # multi-coordinator discovery: one URI, a comma-separated
+        # string, or a sequence — the worker heartbeats EVERY
+        # coordinator (each runs its own arbiter/scheduler view), so
+        # any survivor of a coordinator failover already knows this
+        # worker. coordinator_uri keeps the first entry for existing
+        # callers.
+        if isinstance(coordinator_uri, str):
+            self.coordinator_uris = [
+                u.strip().rstrip("/")
+                for u in coordinator_uri.split(",")
+                if u.strip()
+            ]
+        else:
+            self.coordinator_uris = [
+                str(u).strip().rstrip("/")
+                for u in (coordinator_uri or [])
+                if str(u).strip()
+            ]
+        self.coordinator_uri = (
+            self.coordinator_uris[0] if self.coordinator_uris else None
+        )
         self._announcer: Optional[threading.Thread] = None
+        # orphan-task reaper (task.orphan-ttl-s, 0 = off): announce
+        # acks carry the answering coordinator's BOOT nonce, and every
+        # qid embeds the boot of the coordinator that minted it — a
+        # task whose minting boot has not been heard from in TTL is
+        # orphaned (its coordinator died or was replaced; a failover
+        # peer re-runs the query under ITS boot) and is deleted so a
+        # dead fleet's buffers never pin worker memory
+        self._orphan_ttl_s = float(
+            config.get("task.orphan-ttl-s", 0.0) if config else 0.0
+        )
+        #: coordinator boot nonce -> last monotonic time heard from
+        self._boot_seen: Dict[str, float] = {}
         # fault-tolerance plane: one RPC policy for worker->worker
         # shuffle pulls, config-driven announce cadence/timeout
         self._rpc_policy = rpc.RpcPolicy.from_config(config)
@@ -409,11 +441,15 @@ class WorkerServer:
         if devicediag.last_diag() is None:
             devicediag.probe_backend()
         self._serve_thread.start()
-        if self.coordinator_uri:
+        if self.coordinator_uris:
             self._announcer = threading.Thread(
                 target=self._announce_loop, daemon=True
             )
             self._announcer.start()
+        if self._orphan_ttl_s > 0:
+            threading.Thread(
+                target=self._reaper_loop, daemon=True
+            ).start()
         return self
 
     def shutdown(self, graceful: bool = True) -> None:
@@ -573,21 +609,30 @@ class WorkerServer:
         }
 
     def _announce_once(self) -> None:
-        """One best-effort, no-retry announcement (drain flips state
-        immediately; failures fall back to the regular loop)."""
-        if not self.coordinator_uri:
-            return
-        try:
-            rpc.call_json(
-                "PUT",
-                self.coordinator_uri + "/v1/announcement",
-                self._announce_body(),
-                policy=rpc.RpcPolicy(
-                    timeout_s=self._announce_timeout, retries=0
-                ),
-            )
-        except Exception:
-            pass
+        """One best-effort, no-retry announcement to every coordinator
+        (drain flips state immediately; failures fall back to the
+        regular loop)."""
+        body = self._announce_body()
+        for uri in self.coordinator_uris:
+            try:
+                resp = rpc.call_json(
+                    "PUT",
+                    uri + "/v1/announcement",
+                    body,
+                    policy=rpc.RpcPolicy(
+                        timeout_s=self._announce_timeout, retries=0
+                    ),
+                )
+                self._saw_boot(resp)
+            except Exception:
+                pass
+
+    def _saw_boot(self, resp) -> None:
+        """Record the announce ack's coordinator boot nonce — the
+        orphan reaper's liveness evidence per minting incarnation."""
+        boot = (resp or {}).get("boot") if isinstance(resp, dict) else None
+        if boot:
+            self._boot_seen[str(boot)] = time.monotonic()
 
     #: announce backoff cap: a worker never goes quieter than this, so
     #: a recovered coordinator re-discovers it within ~2 TTLs
@@ -610,29 +655,43 @@ class WorkerServer:
         )
 
     def _announce_loop(self):
-        """Heartbeat to discovery. A healthy loop announces every
-        ``announcement.interval-s``; after consecutive failures the
-        delay backs off exponentially (capped, resetting on success) —
-        a fleet of workers must not hammer a restarting coordinator in
-        lockstep (thundering herd)."""
-        fails = 0
+        """Heartbeat to discovery — EVERY coordinator, each with its
+        own failure count. A healthy loop announces every
+        ``announcement.interval-s``; after consecutive failures to one
+        coordinator its delay backs off exponentially (capped,
+        resetting on success) — a fleet of workers must not hammer a
+        restarting coordinator in lockstep (thundering herd). With
+        peers, one dead coordinator backs ITS cadence off without
+        quieting the heartbeats the live ones depend on: the loop
+        wakes at the soonest per-coordinator due time."""
+        fails = {u: 0 for u in self.coordinator_uris}
+        due = {u: 0.0 for u in self.coordinator_uris}
         while not self._shutting_down:
-            try:
-                # the loop IS the retry policy: no rpc-level retries,
-                # or backoff would stack on backoff
-                rpc.call_json(
-                    "PUT",
-                    self.coordinator_uri + "/v1/announcement",
-                    self._announce_body(),
-                    policy=rpc.RpcPolicy(
-                        timeout_s=self._announce_timeout, retries=0
-                    ),
+            now = time.monotonic()
+            body = self._announce_body()
+            for uri in self.coordinator_uris:
+                if now < due[uri]:
+                    continue
+                try:
+                    # the loop IS the retry policy: no rpc-level
+                    # retries, or backoff would stack on backoff
+                    resp = rpc.call_json(
+                        "PUT",
+                        uri + "/v1/announcement",
+                        body,
+                        policy=rpc.RpcPolicy(
+                            timeout_s=self._announce_timeout, retries=0
+                        ),
+                    )
+                    self._saw_boot(resp)
+                    fails[uri] = 0
+                except Exception:
+                    fails[uri] += 1
+                    REGISTRY.counter("worker.announce_failures").update()
+                due[uri] = time.monotonic() + self._announce_backoff(
+                    fails[uri]
                 )
-                fails = 0
-            except Exception:
-                fails += 1
-                REGISTRY.counter("worker.announce_failures").update()
-            delay = self._announce_backoff(fails)
+            delay = max(min(due.values()) - time.monotonic(), 0.05)
             # sleep in short slices so shutdown is prompt even when
             # backed far off
             deadline = time.monotonic() + delay
@@ -641,6 +700,36 @@ class WorkerServer:
                 and time.monotonic() < deadline
             ):
                 time.sleep(min(0.2, delay))
+
+    def _reaper_loop(self) -> None:
+        """Orphan-task reaper (``task.orphan-ttl-s``): delete tasks
+        whose minting coordinator incarnation (the boot nonce embedded
+        in every qid) has not been heard from — announce ack or new
+        task — within the TTL. Rides the ONE task-teardown primitive
+        (delete_task), so buffers, reservations, and in-slice segment
+        entries all free."""
+        while not self._shutting_down:
+            time.sleep(min(self._orphan_ttl_s / 4.0, 1.0))
+            now = time.monotonic()
+            with self._lock:
+                snap = [
+                    (tid, t.spec.query_id, t.created_ts)
+                    for tid, t in self.tasks.items()
+                ]
+            for tid, qid, created in snap:
+                boot = task_ids.boot_of_query(qid)
+                if not boot:
+                    continue  # not a coordinator-minted qid: never reap
+                seen = max(self._boot_seen.get(boot, 0.0), created)
+                if now - seen <= self._orphan_ttl_s:
+                    continue
+                if self.delete_task(tid):
+                    REGISTRY.counter("worker.orphans_reaped").update()
+                    log.warning(
+                        "node=%s reaped orphan task %s (coordinator "
+                        "boot %s silent %.1fs)",
+                        self.node_id, tid, boot, now - seen,
+                    )
 
     def _fault_kill(self) -> None:
         """Abrupt crash for the fault plane's ``kill_worker`` action:
@@ -665,6 +754,14 @@ class WorkerServer:
             spec, pool=self.memory_pool, node_id=self.node_id,
             spool=self.spool,
         )
+        # orphan-reaper bookkeeping: the task itself is liveness
+        # evidence for its minting coordinator boot (a coordinator
+        # actively scheduling is not an orphan-maker even if this
+        # worker's announce acks lag)
+        task.created_ts = time.monotonic()
+        boot = task_ids.boot_of_query(spec.query_id)
+        if boot:
+            self._boot_seen[boot] = task.created_ts
         with self._lock:
             self.tasks[spec.task_id] = task
         threading.Thread(

@@ -565,6 +565,27 @@ class NodeConfig:
         # crash-safe admission journal; a restarted coordinator replays
         # it and re-admits every non-terminal query
         "coordinator.journal-path": str,
+        # multi-coordinator control plane (server/lease.py): comma-
+        # separated peer coordinator URIs. Set, the journal path
+        # becomes a SHARED control directory — this coordinator
+        # journals under <path>/<node.id>/, publishes a TTL'd lease
+        # file carrying its admission/memory/QoS occupancy and open
+        # statement ids, announces itself to every peer
+        # (role=coordinator), and claims + resumes a dead peer's open
+        # queries when that peer's lease expires (fencing epoch
+        # prevents split-brain double-claims). Unset (the default) the
+        # lease plane never constructs — single-coordinator deploys
+        # are bit-exact pre-HA.
+        "coordinator.peers": str,
+        # lease TTL: a coordinator lease not renewed for this long is
+        # expired and its journal claimable (renewal runs at TTL/3)
+        "lease.ttl-s": float,
+        # worker orphan-task reaper: tasks whose minting coordinator
+        # incarnation (the qid boot nonce) has not heartbeated for
+        # this long are DELETEd through the normal teardown path,
+        # releasing their buffer-pool reservations. <=0 (the default)
+        # disables the reaper — bit-exact pre-reaper behavior.
+        "task.orphan-ttl-s": float,
         # elastic worker pool (server.pool): autoscaler bounds, control
         # cadence, and hysteresis (consecutive idle ticks before a
         # scale-down, cooldown after any scaling action)

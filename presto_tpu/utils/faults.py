@@ -33,6 +33,7 @@ Rule spec (all match fields optional; empty matches everything)::
        {"action": "mem_pressure", "node": "worker-ab",
         "budget": 65536},
        {"action": "suspend_storm", "owner": "q_c1_", "count": 3},
+       {"action": "kill_coordinator", "node": "coord-b", "owner": "q_c3_"},
      ]}
 
 ``count`` bounds how many times a rule fires (default unlimited),
@@ -76,6 +77,15 @@ DRAIN_ACTIONS = ("kill_worker_draining",)
 #: query, which is how the controller's re-suspend hysteresis
 #: (``qos.resume-grace-s`` immunity after a resume) is tested
 QOS_ACTIONS = ("suspend_storm",)
+#: actions injected at the coordinator query-execution hook
+#: (server.coordinator): ``kill_coordinator`` crashes the WHOLE
+#: coordinator — lease renewal stops, the socket closes abruptly, the
+#: journal goes silent mid-query — exactly the failure the
+#: multi-coordinator failover plane must absorb. Owner-matched like
+#: the reserve rules (``owner`` = query-id substring) plus ``node``
+#: (coordinator-id substring), so a 3-coordinator chaos test kills
+#: one specific admitter on one specific query, deterministically.
+COORD_ACTIONS = ("kill_coordinator",)
 #: actions injected at the MemoryPool reserve hook (utils.memory):
 #: ``reserve_fail`` forces a pool reservation failure at the Nth
 #: matched reserve (skip/count bound it); ``mem_pressure`` shrinks the
@@ -121,6 +131,7 @@ class FaultRule:
             | set(DRAIN_ACTIONS)
             | set(MEM_ACTIONS)
             | set(QOS_ACTIONS)
+            | set(COORD_ACTIONS)
         )
         if rule.action not in known_actions:
             raise ValueError(f"unknown fault action: {rule.action!r}")
@@ -281,6 +292,33 @@ class FaultPlane:
             return ("reserve_fail", None)
         return None
 
+    def on_coordinator(
+        self, node_id: str, query_id: str, kill=None
+    ) -> None:
+        """Coordinator query-execution hook: a ``kill_coordinator``
+        rule crashes the coordinator (``kill`` stops lease renewal and
+        closes the socket abruptly — journal writes go silent, exactly
+        like a process death) and raises into the matched query's
+        execution thread. The query stays OPEN in the dead journal, so
+        a lease-fenced peer resumes it."""
+        for rule in self.rules:
+            if rule.action not in COORD_ACTIONS:
+                continue
+            if rule.method or rule.url or rule.task:
+                continue  # scoped rules stay in their own hooks
+            if rule.node and rule.node not in node_id:
+                continue
+            if rule.owner and rule.owner not in query_id:
+                continue
+            if not self._fire(rule):
+                continue
+            if kill is not None:
+                kill()
+            raise FaultInjectedError(
+                f"injected coordinator kill: {node_id} "
+                f"(query {query_id})"
+            )
+
     def on_drain(self, node_id: str, kill=None) -> None:
         """Worker drain hook: a ``kill_worker_draining`` rule crashes
         the worker mid-drain (abrupt socket close via ``kill``, then
@@ -339,6 +377,16 @@ def maybe_inject_drain(node_id: str, kill=None) -> None:
     plane = _PLANE
     if plane is not None:
         plane.on_drain(node_id, kill=kill)
+
+
+def maybe_inject_coordinator(
+    node_id: str, query_id: str, kill=None
+) -> None:
+    """Coordinator query-execution hook (server.coordinator): a
+    ``kill_coordinator`` rule crashes the coordinator and raises."""
+    plane = _PLANE
+    if plane is not None:
+        plane.on_coordinator(node_id, query_id, kill=kill)
 
 
 def maybe_inject_qos(query_id: str) -> bool:

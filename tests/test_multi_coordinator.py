@@ -1,0 +1,675 @@
+"""Multi-coordinator control plane (ISSUE 17): shared admission,
+live query failover, and orphan-state reaping.
+
+Acceptance surface:
+
+- lease claim / expiry / fencing units (server/lease.py): atomic-rename
+  renewal, exactly-one-winner claims, stale-claim supersede, fenced
+  writes rejected (split-brain structurally impossible);
+- 2-coordinator shared-quota admission: a worker-side hog admitted via
+  peer A trips the cluster resource-group limit for peer B;
+- kill-coordinator-mid-load chaos: zero failed queries, exact results,
+  and a statement URI minted by the dead coordinator survives TWO
+  bounces through the cross-coordinator alias chain;
+- client spray: round-robin statement distribution, re-target on
+  connection failure, and the fast "statement gone on every
+  coordinator" verdict (no reconnect-budget spin on a dead alias);
+- worker orphan-task reaper (``task.orphan-ttl-s``) and history-epoch
+  persistence (a failed-over coordinator keeps its learned plans).
+
+Single-coordinator deploys must stay bit-exact: the lease plane is
+never constructed without ``coordinator.peers``.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from presto_tpu.plan.history import QueryHistoryStore
+from presto_tpu.server import (
+    CoordinatorServer,
+    PrestoTpuClient,
+    WorkerServer,
+)
+from presto_tpu.server.client import QueryFailed
+from presto_tpu.server.journal import CoordinatorJournal
+from presto_tpu.server.lease import FencedError, LeasePlane
+from presto_tpu.server.protocol import FragmentSpec
+from presto_tpu.session import NodeConfig
+from presto_tpu.utils import faults
+from presto_tpu.utils.metrics import REGISTRY
+
+REGION_SQL = "select count(*) as c from tpch.tiny.region"
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plane():
+    yield
+    faults.configure(None)
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _mk_coords(
+    tmp_path,
+    n=2,
+    ttl=0.75,
+    extra=None,
+    start=True,
+    **coord_kwargs,
+):
+    """N coordinators sharing one control directory (lease files +
+    per-coordinator journal segments), each listing the others as
+    ``coordinator.peers``. Ports are pre-reserved so every peer list
+    is known at construction."""
+    ctl = str(tmp_path / "ctl")
+    ports = _free_ports(n)
+    uris = [f"http://127.0.0.1:{p}" for p in ports]
+    coords = []
+    for i in range(n):
+        cfg = {
+            "node.id": f"coord-{i}",
+            "coordinator.journal-path": ctl,
+            "coordinator.peers": ",".join(
+                u for j, u in enumerate(uris) if j != i
+            ),
+            "lease.ttl-s": str(ttl),
+        }
+        cfg.update(extra or {})
+        c = CoordinatorServer(
+            port=ports[i], config=NodeConfig(cfg), **coord_kwargs
+        )
+        if start:
+            c.start()
+        coords.append(c)
+    return coords
+
+
+def _teardown(coords, workers=()):
+    faults.configure(None)
+    for w in workers:
+        w.shutdown(graceful=False)
+    for c in coords:
+        try:
+            c.shutdown()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------- lease units
+
+
+def test_lease_renew_peers_and_expiry(tmp_path):
+    d = str(tmp_path / "ctl")
+    a = LeasePlane(d, "c-a", uri="http://a", ttl_s=0.3)
+    b = LeasePlane(d, "c-b", uri="http://b", ttl_s=0.3)
+    a.renew({"qids": ["q_c1_aaaaaa"]})
+    b.renew()
+    # peers() excludes self and carries the state payload through
+    (pa,) = b.peers()
+    assert pa.owner == "c-a" and pa.uri == "http://a"
+    assert pa.state == {"qids": ["q_c1_aaaaaa"]}
+    assert [p.owner for p in a.peers()] == ["c-b"]
+    assert not a.is_expired(b.read_lease("c-b"))
+    time.sleep(0.4)  # both leases age past the TTL
+    assert a.peers(live_only=True) == []
+    assert a.is_expired(a.read_lease("c-b"))
+    b.renew()  # a heartbeat revives the lease
+    assert [p.owner for p in a.peers(live_only=True)] == ["c-b"]
+
+
+def test_lease_claim_exactly_one_winner(tmp_path):
+    d = str(tmp_path / "ctl")
+    dead = LeasePlane(d, "c-dead", ttl_s=0.2)
+    dead.renew()
+    a = LeasePlane(d, "c-a", ttl_s=0.2)
+    b = LeasePlane(d, "c-b", ttl_s=0.2)
+    # a live owner is not claimable
+    a.renew()
+    assert b.claim_expired("c-a") is None
+    # an absent owner (never leased / retired) is not claimable
+    assert b.claim_expired("c-ghost") is None
+    time.sleep(0.3)
+    a.renew()  # a's own lease must be live for its claim to stand
+    before = REGISTRY.counter("lease.claims").total
+    ca = a.claim_expired("c-dead")
+    assert ca is not None and ca.claimant == "c-a"
+    assert ca.epoch == dead.epoch + 1  # strictly above the dead lease
+    assert REGISTRY.counter("lease.claims").total == before + 1
+    # O_EXCL picked exactly one winner: b loses while a's claim stands
+    assert b.claim_expired("c-dead") is None
+    a.check_fence(ca)  # the winner's fence holds
+    # retire clears both files: nothing left to claim or fence
+    a.retire("c-dead")
+    assert a.read_lease("c-dead") is None
+    assert b.claim_expired("c-dead") is None
+    with pytest.raises(FencedError):
+        a.check_fence(ca)
+
+
+def test_lease_split_brain_stale_claim_superseded(tmp_path):
+    """Split-brain fencing: claimant A stalls past its own TTL, B
+    supersedes the stale claim at a strictly higher epoch, and every
+    write A still intends is rejected by its fence check."""
+    d = str(tmp_path / "ctl")
+    dead = LeasePlane(d, "c-dead", ttl_s=0.2)
+    dead.renew()
+    a = LeasePlane(d, "c-a", ttl_s=0.2)
+    b = LeasePlane(d, "c-b", ttl_s=0.2)
+    time.sleep(0.3)
+    a.renew()
+    ca = a.claim_expired("c-dead")
+    assert ca is not None
+    # A goes silent: its lease expires, so its claim is STALE
+    time.sleep(0.3)
+    b.renew()
+    cb = b.claim_expired("c-dead")
+    assert cb is not None and cb.claimant == "c-b"
+    assert cb.epoch > ca.epoch  # superseded strictly above
+    fenced_before = REGISTRY.counter("lease.fenced_writes").total
+    with pytest.raises(FencedError):
+        a.check_fence(ca)  # the stalled claimant may write NOTHING
+    assert (
+        REGISTRY.counter("lease.fenced_writes").total
+        == fenced_before + 1
+    )
+    b.check_fence(cb)  # the superseding claimant proceeds
+
+
+def test_lease_epoch_monotonic_across_restarts(tmp_path):
+    d = str(tmp_path / "ctl")
+    p1 = LeasePlane(d, "c-x", ttl_s=0.2)
+    assert p1.epoch == 1
+    p1.renew()
+    # a restart rejoins strictly above its previous incarnation
+    p2 = LeasePlane(d, "c-x", ttl_s=0.2)
+    assert p2.epoch == 2
+    p2.renew()
+    # ... and strictly above any claim a survivor fenced it at
+    time.sleep(0.3)
+    y = LeasePlane(d, "c-y", ttl_s=0.2)
+    y.renew()
+    cy = y.claim_expired("c-x")
+    assert cy is not None and cy.epoch == 3
+    p3 = LeasePlane(d, "c-x", ttl_s=0.2)
+    assert p3.epoch == 4
+
+
+def test_lease_stop_withdraws_instead_of_expiring(tmp_path):
+    d = str(tmp_path / "ctl")
+    a = LeasePlane(d, "c-a", ttl_s=0.2)
+    b = LeasePlane(d, "c-b", ttl_s=0.2)
+    a.renew()
+    b.renew()
+    a.stop()  # clean shutdown: the lease file is GONE, not expiring
+    assert b.read_lease("c-a") is None
+    time.sleep(0.3)
+    assert b.claim_expired("c-a") is None  # nothing to claim
+
+
+# ------------------------------------------- journal claim/alias frames
+
+
+def test_journal_claim_and_alias_frames_replay(tmp_path):
+    j = CoordinatorJournal(str(tmp_path / "j"))
+    j.record_submit("q_c1_aaaaaa", "select 1")
+    j.record_alias("q_c9_dddddd", "q_c1_aaaaaa")
+    j.record_claim("coord-7", 5)
+    state = CoordinatorJournal(str(tmp_path / "j")).replay()
+    assert [r["qid"] for r in state.open] == ["q_c1_aaaaaa"]
+    assert state.aliases == {"q_c9_dddddd": "q_c1_aaaaaa"}
+    assert state.claim is not None
+    assert state.claim["claimant"] == "coord-7"
+    assert state.claim["epoch"] == 5
+
+
+# -------------------------------------------------------- client spray
+
+
+def test_client_sprays_statements_round_robin(tmp_path):
+    c1 = CoordinatorServer().start()
+    c2 = CoordinatorServer().start()
+    try:
+        cl = PrestoTpuClient([c1.uri, c2.uri], timeout_s=60)
+        for _ in range(2):
+            assert cl.execute(REGION_SQL).rows() == [(5,)]
+        # one statement landed on each coordinator
+        assert len(c1.queries) == 1 and len(c2.queries) == 1
+    finally:
+        _teardown([c1, c2])
+
+
+def test_client_post_retargets_dead_coordinator(tmp_path):
+    (dead_port,) = _free_ports(1)
+    c = CoordinatorServer().start()
+    try:
+        cl = PrestoTpuClient(
+            [f"http://127.0.0.1:{dead_port}", c.uri], timeout_s=60
+        )
+        before = REGISTRY.counter("client.spray_retargets").total
+        # round-robin starts at the dead peer: connection refused must
+        # re-target the POST (never delivered => no duplicate query)
+        assert cl.execute(REGION_SQL).rows() == [(5,)]
+        assert (
+            REGISTRY.counter("client.spray_retargets").total
+            == before + 1
+        )
+        assert len(c.queries) == 1
+    finally:
+        _teardown([c])
+
+
+def test_client_statement_gone_everywhere_fails_fast(tmp_path):
+    """404 from EVERY coordinator = alias chain exhausted: surface
+    QueryFailed immediately instead of spinning the full reconnect
+    budget. A single-coordinator client keeps the legacy behavior
+    (HTTP errors surface as-is)."""
+    import urllib.error
+
+    c1 = CoordinatorServer().start()
+    c2 = CoordinatorServer().start()
+    try:
+        cl = PrestoTpuClient(
+            [c1.uri, c2.uri], timeout_s=60, reconnect_attempts=50
+        )
+        url = f"{c1.uri}/v1/statement/q_c9_ffffff/0"
+        t0 = time.monotonic()
+        with pytest.raises(QueryFailed, match="statement gone"):
+            cl._get_with_reconnect(url, time.monotonic() + 60)
+        # fast verdict: one sweep, not 50 backoff rounds
+        assert time.monotonic() - t0 < 10.0
+        solo = PrestoTpuClient(c1.uri, timeout_s=60)
+        with pytest.raises(urllib.error.HTTPError):
+            solo._get_with_reconnect(url, time.monotonic() + 60)
+    finally:
+        _teardown([c1, c2])
+
+
+# ------------------------------------------------ single-node bit-exact
+
+
+def test_no_peers_means_no_lease_plane(tmp_path):
+    """The bit-exact guard: without ``coordinator.peers`` the lease
+    plane is never constructed and the journal lives at the configured
+    path itself (not a per-coordinator subdirectory)."""
+    jp = str(tmp_path / "jr")
+    c = CoordinatorServer(
+        config=NodeConfig({"coordinator.journal-path": jp})
+    )
+    try:
+        assert c.lease is None
+        assert c.journal is not None and c.journal.path == jp
+        assert c.locate_peer("q_c1_aaaaaa") == ""
+    finally:
+        c.shutdown()
+    # peers without a journal path: nothing to share through => no plane
+    c2 = CoordinatorServer(
+        config=NodeConfig({"coordinator.peers": "http://127.0.0.1:9"})
+    )
+    try:
+        assert c2.lease is None and c2.journal is None
+    finally:
+        c2.shutdown()
+
+
+# ------------------------------------------------- shared admission
+
+
+def _fake_query(coord, qid, group=None):
+    from presto_tpu.server.coordinator import _Query
+
+    q = _Query(qid, "select 1")
+    q.state = "RUNNING"
+    q.resource_group = group
+    coord.queries[qid] = q
+    return q
+
+
+def _report(limit=1 << 20, queries=None):
+    return {
+        "limit": limit,
+        "reserved": sum(q["bytes"] for q in (queries or {}).values()),
+        "queries": queries or {},
+        "blocked": [],
+    }
+
+
+def test_shared_group_quota_trips_across_admitters(tmp_path):
+    """THE shared-admission acceptance: a worker-side memory hog
+    admitted via coordinator A counts against the resource-group
+    quota coordinator B enforces — `softMemoryLimit` holds across N
+    admitters, not per process."""
+    rg = {
+        "rootGroups": [
+            {"name": "etl", "hardConcurrencyLimit": 4,
+             "softMemoryLimit": "1KB"},
+        ],
+    }
+    ca, cb = _mk_coords(
+        tmp_path, n=2, start=False, resource_groups=dict(rg)
+    )
+    try:
+        hog = _fake_query(ca, "q_c1_abcdef", group="etl")
+        # the worker heartbeats EVERY coordinator: both arbiters hold
+        # the hog's worker-side bytes
+        rep = _report(queries={"q_c1_abcdef": {"bytes": 4096,
+                                               "peak": 4096}})
+        ca.arbiter.observe("w1", rep)
+        cb.arbiter.observe("w1", rep)
+        # before A publishes its lease state, B knows nothing of the
+        # hog's group membership
+        assert cb._group_memory("etl") == 0
+        ca.lease.renew(ca._lease_state())
+        # B folds A's published group occupancy: the hog's qid rides
+        # the lease payload, its bytes ride the worker heartbeat
+        assert cb._group_memory("etl") == 4096
+        g = cb.resource_groups.groups["etl"]
+        assert cb.resource_groups._over_memory(g) is True
+        # A's local-pool report joins B's cluster admission view
+        assert "coord:coord-0" in cb.arbiter._view()
+        # ... and B can point a sprayed client at the hog's owner
+        assert cb.locate_peer("q_c1_abcdef") == ca.uri
+        assert cb.locate_peer("q_c9_zzzzzz") == ""
+        _ = hog
+    finally:
+        _teardown([ca, cb])
+
+
+def test_peer_coordinators_in_nodes_view_never_schedulable(tmp_path):
+    c0, c1 = _mk_coords(tmp_path, n=2, ttl=0.75)
+    workers = []
+    try:
+        w = WorkerServer(coordinator_uri=[c0.uri, c1.uri]).start()
+        workers.append(w)
+        # peers announce through the worker channel (role=coordinator)
+        _wait(
+            lambda: "coord-1" in c0.workers and "coord-0" in c1.workers,
+            msg="peer coordinator announcements",
+        )
+        _wait(
+            lambda: w.node_id in c0.workers and w.node_id in c1.workers,
+            msg="worker announced to both coordinators",
+        )
+        rows = c0.local.execute(
+            "select node_id, coordinator from system.runtime.nodes"
+        ).rows()
+        by_id = dict(rows)
+        assert by_id["coord-1"] is True
+        assert by_id[w.node_id] is False
+        # visible, but NEVER schedulable: no tasks route to a peer
+        for c in (c0, c1):
+            sched = [x.node_id for x in c.active_workers()]
+            assert w.node_id in sched
+            assert not any(n.startswith("coord-") for n in sched)
+    finally:
+        _teardown([c0, c1], workers)
+
+
+# --------------------------------------------------- live failover
+
+
+def test_failover_resumes_dead_peers_queued_queries(tmp_path):
+    """A survivor claims an expired peer's journal and resumes its
+    open queries under new qids, with the old statement ids aliased
+    to the resumed runs."""
+    c0, c1 = _mk_coords(tmp_path, n=2, ttl=0.6, max_concurrent_queries=1)
+    try:
+        c0._admit.acquire()  # pin submissions QUEUED on c0
+        qs = [c0.submit(REGION_SQL) for _ in range(2)]
+        assert all(q.state == "QUEUED" for q in qs)
+        claims_before = REGISTRY.counter(
+            "coordinator.failover_claims"
+        ).total
+        c0._fault_kill()  # abrupt: lease EXPIRES, journal stays open
+        _wait(
+            lambda: c1.failover_claims == 1,
+            timeout=20,
+            msg="survivor claims the dead lease",
+        )
+        assert (
+            REGISTRY.counter("coordinator.failover_claims").total
+            == claims_before + 1
+        )
+        assert c1.failover_resumed == 2
+        for q in qs:
+            rq = c1.lookup_query(q.qid)  # dead-boot qid -> resumed run
+            assert rq is not None and rq.qid != q.qid
+            assert rq.done.wait(60)
+            assert rq.state == "FINISHED", rq.error
+            assert rq.rows == [[5]]
+        # fully failed over: the dead lease + claim were retired, so
+        # nothing re-claims (and a c0 restart would rejoin fresh)
+        assert c1.lease.read_lease("coord-0") is None
+        assert c1.failover_claims == 1
+    finally:
+        _teardown([c0, c1])
+
+
+def test_kill_coordinator_chaos_zero_failed_queries(tmp_path):
+    """THE chaos acceptance: 3 coordinators under concurrent sprayed
+    client load; the fault plane kills one mid-query. Zero failed
+    queries, exact results — open queries resume on a peer and
+    statement URIs keep resolving through the alias chain."""
+    coords = _mk_coords(tmp_path, n=3, ttl=0.75)
+    try:
+        uris = [c.uri for c in coords]
+        faults.configure({
+            "rules": [
+                {"action": "kill_coordinator", "node": "coord-0",
+                 "count": 1},
+            ],
+        })
+        results, errors = [], []
+
+        def run_queries():
+            cl = PrestoTpuClient(
+                uris, timeout_s=90, reconnect_attempts=16
+            )
+            try:
+                for _ in range(3):
+                    results.append(cl.execute(REGION_SQL).rows())
+            except Exception as e:  # noqa: BLE001 - the assertion
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run_queries, daemon=True)
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "load hung"
+        assert errors == [], errors
+        assert results == [[(5,)]] * 9
+        # the kill fired and exactly one survivor claimed the journal.
+        # The claim is ASYNCHRONOUS to the load: when every in-flight
+        # statement rode the 503/connection re-target path, the last
+        # client can finish before the dead lease even expires — wait
+        # for the scan, don't assert the instantaneous count
+        survivors = coords[1:]
+        _wait(
+            lambda: sum(c.failover_claims for c in survivors) == 1,
+            msg="survivor claim of coord-0's journal",
+        )
+        # the query the kill interrupted had journaled its submit frame
+        # (and could not journal a finish) — the claimant resumes it
+        _wait(
+            lambda: sum(c.failover_resumed for c in survivors) >= 1,
+            msg="claimant resume of the interrupted query",
+        )
+    finally:
+        _teardown(coords)
+
+
+def test_statement_uri_survives_two_failover_bounces(tmp_path):
+    """A statement URI minted by coordinator 0 keeps resolving after
+    its query failed over TWICE (coord-0 dies, the claimant dies too):
+    transitive alias frames collapse the chain onto the live run."""
+    coords = _mk_coords(
+        tmp_path, n=3, ttl=0.6, max_concurrent_queries=1
+    )
+    c0, c1, c2 = coords
+    try:
+        # survivors' single admission slot is held, so each resumed
+        # run stays QUEUED (open in the claimant's journal) until the
+        # final survivor is released
+        c1._admit.acquire()
+        c2._admit.acquire()
+        faults.configure({
+            "rules": [
+                {"action": "kill_coordinator", "node": "coord-0",
+                 "count": 1},
+            ],
+        })
+        out, errors = [], []
+
+        def run_query():
+            cl = PrestoTpuClient(
+                [c0.uri, c1.uri, c2.uri],
+                timeout_s=120,
+                reconnect_attempts=40,
+            )
+            try:
+                out.append(cl.execute(REGION_SQL))
+            except Exception as e:  # noqa: BLE001 - the assertion
+                errors.append(e)
+
+        t = threading.Thread(target=run_query, daemon=True)
+        t.start()  # round-robin starts at c0: the kill rule fires
+        _wait(
+            lambda: c1.failover_claims + c2.failover_claims == 1,
+            timeout=20,
+            msg="first failover claim",
+        )
+        s1, s2 = (c1, c2) if c1.failover_claims else (c2, c1)
+        _wait(
+            lambda: s1.failover_resumed == 1,
+            msg="first resume journaled",
+        )
+        s1._fault_kill()  # bounce TWO: the claimant dies as well
+        _wait(
+            lambda: s2.failover_claims >= 1,
+            timeout=20,
+            msg="second failover claim",
+        )
+        _wait(
+            lambda: s2.failover_resumed >= 1,
+            msg="second resume journaled",
+        )
+        s2._admit.release()  # let the twice-resumed run execute
+        t.join(timeout=120)
+        assert not t.is_alive(), "client never completed"
+        assert errors == [], errors
+        (res,) = out
+        assert res.rows() == [(5,)]
+        # the ORIGINAL c0-minted qid still routes on the final survivor
+        q = s2.lookup_query(res.query_id)
+        assert q is not None, "boot-1 qid lost after two bounces"
+        assert q.state == "FINISHED", q.error
+        assert q.rows == [[5]]
+    finally:
+        _teardown(coords)
+
+
+# ------------------------------------------------- orphan-task reaper
+
+
+def test_worker_reaps_orphaned_tasks(tmp_path):
+    w = WorkerServer(
+        config=NodeConfig({"task.orphan-ttl-s": "0.5"})
+    ).start()
+    try:
+        before = REGISTRY.counter("worker.orphans_reaped").total
+        # a coordinator-minted task whose boot nonce never heartbeats
+        w.create_task(FragmentSpec(
+            task_id="t-orphan", query_id="q_c1_deadbe",
+            fragment=None, partition_scan=0, split_start=0,
+            split_end=0,
+        ))
+        # a non-coordinator qid carries no boot nonce: NEVER reaped
+        w.create_task(FragmentSpec(
+            task_id="t-local", query_id="adhoc",
+            fragment=None, partition_scan=0, split_start=0,
+            split_end=0,
+        ))
+        _wait(
+            lambda: "t-orphan" not in w.tasks,
+            timeout=20,
+            msg="orphan reaped",
+        )
+        assert (
+            REGISTRY.counter("worker.orphans_reaped").total
+            == before + 1
+        )
+        assert "t-local" in w.tasks
+    finally:
+        w.shutdown(graceful=False)
+
+
+def test_task_creation_refreshes_boot_liveness(tmp_path):
+    """An actively scheduling coordinator is not an orphan-maker: each
+    created task refreshes its boot's last-seen time, so a busy boot
+    with laggy announce acks keeps its earlier tasks alive."""
+    w = WorkerServer(
+        config=NodeConfig({"task.orphan-ttl-s": "1.0"})
+    ).start()
+    try:
+        w.create_task(FragmentSpec(
+            task_id="t-1", query_id="q_c1_aaaaaa", fragment=None,
+            partition_scan=0, split_start=0, split_end=0,
+        ))
+        deadline = time.monotonic() + 2.0
+        i = 0
+        while time.monotonic() < deadline:
+            i += 1
+            w.create_task(FragmentSpec(
+                task_id=f"t-fresh-{i}", query_id="q_c2_aaaaaa",
+                fragment=None, partition_scan=0, split_start=0,
+                split_end=0,
+            ))
+            time.sleep(0.3)
+        # the boot kept minting tasks: t-1 outlived its own TTL window
+        assert "t-1" in w.tasks
+    finally:
+        w.shutdown(graceful=False)
+
+
+# -------------------------------------------- history-epoch durability
+
+
+def test_history_epochs_persist_across_store_reload(tmp_path):
+    """PR 15's documented limit, closed: the per-fingerprint epoch is
+    written beside each record and restored at load — a failed-over
+    (or restarted) coordinator keeps its learned plans instead of
+    serving cold-epoch cache hits."""
+    store = QueryHistoryStore(str(tmp_path), divergence_factor=4.0)
+    store.record_query("s1", "q", {"n1": {"rows": 100, "label": "x"}})
+    store.record_query("s1", "q", {"n1": {"rows": 1000, "label": "x"}})
+    assert store.epoch_of("n1") == 2
+    reloaded = QueryHistoryStore(str(tmp_path), divergence_factor=4.0)
+    assert reloaded.epoch_of("n1") == 2
+    assert reloaded.learned_rows("n1") == 1000.0

@@ -84,6 +84,7 @@ def test_every_rule_registered(repo_findings):
         "ingest-frames",
         "reserve-sites",
         "qos-plane",
+        "lease-plane",
         "exchange-plane",
         "adaptive-plane",
         "metric-names",
@@ -891,6 +892,79 @@ def test_qos_plane_rule_clean_fixtures(tmp_path):
         )
     )
     assert not analysis.run_passes(str(tmp_path), rules=["qos-plane"])
+
+
+def test_lease_plane_rule_flags_rogue_sites(tmp_path):
+    """The lease plane's privileged constructs flag outside
+    server/lease.py + the coordinator: construction, expiry claims,
+    fence checks, renewal, and the on-disk lease-/claim- file-name
+    prefixes. Journal claim/alias frames flag with the journal rule."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            plane = LeasePlane("/tmp/x", "coord-1")
+            plane.renew({"qids": []})
+            claim = plane.claim_expired("coord-2")
+            plane.check_fence(claim)
+            name = "lease-coord-1.json"
+            cname = "claim-coord-2.json"
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["lease-plane"])
+    assert len(found) == 6
+    assert all(f.rule == "lease-plane" for f in found)
+    (tmp_path / "rogue2.py").write_text(
+        textwrap.dedent(
+            """
+            j = journal.record_claim("coord-1", 3)
+            journal.record_alias("q_c1_aaaaaa", "q_c1_bbbbbb")
+            """
+        )
+    )
+    found = analysis.run_passes(str(tmp_path), rules=["journal-sites"])
+    assert {f.path.split("/")[-1] for f in found} == {"rogue2.py"}
+    assert len(found) == 2
+
+
+def test_lease_plane_rule_clean_fixtures(tmp_path):
+    """The audited modules and attribute/flag reads never flag."""
+    srv = tmp_path / "server"
+    srv.mkdir()
+    (srv / "lease.py").write_text(
+        textwrap.dedent(
+            """
+            _LEASE_PREFIX = "lease-"
+            _CLAIM_PREFIX = "claim-"
+
+            class LeasePlane:
+                def renew(self, state=None):
+                    pass
+            """
+        )
+    )
+    (srv / "coordinator.py").write_text(
+        textwrap.dedent(
+            """
+            def loop(coord):
+                coord.lease.renew(coord._lease_state())
+                claim = coord.lease.claim_expired("coord-2")
+                coord.lease.check_fence(claim)
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(coord):
+                # reads of the audited names are fine
+                has = coord.lease is not None
+                ttl = coord.lease.ttl_s if has else 0.0
+                return has, ttl
+            """
+        )
+    )
+    assert not analysis.run_passes(str(tmp_path), rules=["lease-plane"])
 
 
 def test_history_shim_clean_and_flags(tmp_path):

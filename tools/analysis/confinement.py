@@ -244,6 +244,10 @@ _RECORD_METHS = {
     "record_finish",
     "record_prepare",
     "record_deallocate",
+    # multi-coordinator failover frames: a claimant stamps the claimed
+    # journal and aliases the dead incarnation's qids into its own
+    "record_claim",
+    "record_alias",
 }
 
 
@@ -320,6 +324,67 @@ def journal_pass(modules: List[core.Module], src_dir: str):
                         node.lineno,
                         "journal segment-name prefix outside "
                         "server/journal.py",
+                    )
+                )
+    return findings
+
+
+# --------------------------------------------------------- lease plane
+
+_LEASE = "server/lease.py"
+#: the lease plane's privileged constructs and their one audited
+#: consumer: construction, expiry claims, fencing checks, and renewal
+#: all happen from the coordinator's lease loop / failover path. A
+#: rogue claim site elsewhere could steal a live journal; a write
+#: path that skips check_fence() could double-resume a query after
+#: its claim was superseded (split-brain).
+_LEASE_CONSUMERS = {_LEASE, "server/coordinator.py"}
+_LEASE_METHS = ("LeasePlane", "claim_expired", "check_fence", "renew")
+
+
+@core.register(
+    "lease-plane",
+    "lease construction/claims/fencing confined to server/lease.py + "
+    "the coordinator (split-brain safety); lease-/claim- file-name "
+    "prefixes to server/lease.py",
+)
+def lease_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        frame_ok = mod.rel == _LEASE
+        for node in mod.nodes:
+            if isinstance(node, ast.Call):
+                term = core.terminal_name(node.func)
+                if (
+                    term in _LEASE_METHS
+                    and mod.rel not in _LEASE_CONSUMERS
+                ):
+                    findings.append(
+                        mod.finding(
+                            "lease-plane",
+                            node.lineno,
+                            f"lease construct {term}() outside the "
+                            "audited modules (server/lease.py, "
+                            "server/coordinator.py) — route through "
+                            "presto_tpu.server.lease",
+                        )
+                    )
+            elif (
+                not frame_ok
+                and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and (
+                    node.value.startswith("lease-")
+                    or node.value.startswith("claim-")
+                )
+            ):
+                findings.append(
+                    mod.finding(
+                        "lease-plane",
+                        node.lineno,
+                        "lease/claim file-name prefix outside "
+                        "server/lease.py — peers must agree on ONE "
+                        "on-disk naming scheme",
                     )
                 )
     return findings
