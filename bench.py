@@ -924,9 +924,14 @@ def _partitioned_join_line(backend: str) -> dict:
     backend. The ICI window must move ZERO bytes through the
     pages_wire shuffle (``exchange.http_shuffle_bytes`` flat) while
     ``exchange.ici_bytes_elided`` grows — the win is asserted from
-    counters, not claimed. Reuses the PR 11 backend discipline: the
-    caller probed the backend (``_probe_backend``/``_force_cpu``) and
-    a cluster that cannot boot emits ``skip_line`` — never value 0."""
+    counters, not claimed. The single-program PR adds a third window
+    (``exchange.single-program=false`` = the per-source-gather ICI
+    path) and the device-plane contract ``fewer_dispatches_ok``:
+    one collective program per stage must cost strictly fewer
+    ``device.dispatches`` than a gather pass per source. Reuses the
+    PR 11 backend discipline: the caller probed the backend
+    (``_probe_backend``/``_force_cpu``) and a cluster that cannot
+    boot emits ``skip_line`` — never value 0."""
     import time as _time
 
     import jax
@@ -949,8 +954,13 @@ def _partitioned_join_line(backend: str) -> dict:
     iters = 3
     n_workers = 4
 
-    def run_cluster(ici_on: bool):
-        cfg = {"exchange.ici-enabled": "true" if ici_on else "false"}
+    def run_cluster(ici_on: bool, single_program: bool = True):
+        cfg = {
+            "exchange.ici-enabled": "true" if ici_on else "false",
+            "exchange.single-program": (
+                "true" if single_program else "false"
+            ),
+        }
         coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
         workers = []
         try:
@@ -985,15 +995,27 @@ def _partitioned_join_line(backend: str) -> dict:
                 w.shutdown(graceful=False)
             coord.shutdown()
 
+    from presto_tpu.utils.telemetry import device_snapshot
+
     http0 = REGISTRY.counter("exchange.http_shuffle_bytes").total
+    dev0 = device_snapshot()
     rows_http, http_s = run_cluster(False)
+    dev1 = device_snapshot()
     http_during_off = (
         REGISTRY.counter("exchange.http_shuffle_bytes").total - http0
     )
+    # per-source-gather ICI window (exchange.single-program=false =
+    # the pre-single-program per-source ici_fetch path) — the
+    # dispatch baseline the collective program must beat
+    psrc0 = device_snapshot()
+    rows_psrc, psrc_s = run_cluster(True, single_program=False)
+    psrc1 = device_snapshot()
     http1 = REGISTRY.counter("exchange.http_shuffle_bytes").total
     elided0 = REGISTRY.counter("exchange.ici_bytes_elided").total
     edges0 = REGISTRY.counter("exchange.ici_edges").total
+    collective0 = REGISTRY.counter("exchange.collective_stages").total
     rows_ici, ici_s = run_cluster(True)
+    dev2 = device_snapshot()
     http_during_ici = (
         REGISTRY.counter("exchange.http_shuffle_bytes").total - http1
     )
@@ -1001,20 +1023,47 @@ def _partitioned_join_line(backend: str) -> dict:
         REGISTRY.counter("exchange.ici_bytes_elided").total - elided0
     )
     edges = REGISTRY.counter("exchange.ici_edges").total - edges0
+    collective = (
+        REGISTRY.counter("exchange.collective_stages").total
+        - collective0
+    )
+    # per-mode device-plane deltas (utils/telemetry.py): the single-
+    # program contract is FEWER dispatches per query than the
+    # per-source-gather ICI path it replaces — one collective program
+    # per stage instead of a gather pass per source. The HTTP window's
+    # dispatch delta is reported for visibility but is NOT the bar:
+    # HTTP exchanges host-side (serialize/wire/deserialize), so its
+    # device-dispatch count is low by construction; the device plane
+    # only competes against itself.
+    http_disp = int(dev1["dispatches"] - dev0["dispatches"])
+    psrc_disp = int(psrc1["dispatches"] - psrc0["dispatches"])
+    ici_disp = int(dev2["dispatches"] - psrc1["dispatches"])
+    http_h2d = int(dev1["h2d_bytes"] - dev0["h2d_bytes"])
+    psrc_h2d = int(psrc1["h2d_bytes"] - psrc0["h2d_bytes"])
+    ici_h2d = int(dev2["h2d_bytes"] - psrc1["h2d_bytes"])
     return {
         "metric": "partitioned_join_shuffle_8dev",
         "value": round(ici_s, 4),
         "unit": "s",
         "ici_wall_s": round(ici_s, 4),
+        "per_source_wall_s": round(psrc_s, 4),
         "http_wall_s": round(http_s, 4),
         "speedup": round(http_s / ici_s, 3) if ici_s > 0 else None,
         "ici_beats_http": ici_s < http_s,
         "ici_bytes_elided": int(elided),
         "ici_edges": int(edges),
+        "collective_stages": int(collective),
+        "ici_dispatches": ici_disp,
+        "per_source_dispatches": psrc_disp,
+        "http_dispatches": http_disp,
+        "ici_h2d_bytes": ici_h2d,
+        "per_source_h2d_bytes": psrc_h2d,
+        "http_h2d_bytes": http_h2d,
+        "fewer_dispatches_ok": ici_disp < psrc_disp,
         "http_shuffle_bytes_during_ici": int(http_during_ici),
         "http_shuffle_bytes_during_http": int(http_during_off),
         "zero_wire_bytes_ok": elided > 0 and http_during_ici == 0,
-        "results_equal": rows_http == rows_ici,
+        "results_equal": rows_http == rows_ici == rows_psrc,
         "workers": n_workers,
         "n_devices": len(jax.devices()),
         "backend": backend,

@@ -170,10 +170,13 @@ def empty_summary(keys) -> FilterSummary:
     )
 
 
-def subset_summary(columns) -> FilterSummary:
+def subset_summary(columns, rows: int = -1) -> FilterSummary:
     """Summary over a subset of an existing summary's columns (the
-    coordinator's constraint-eligible projection)."""
-    return FilterSummary(columns=tuple(columns))
+    coordinator's constraint-eligible projection). ``rows`` carries
+    the source summary's observed build cardinality when the subset
+    still describes the same build scan (the adaptive probe-build
+    reuse path); the default -1 keeps it unknown."""
+    return FilterSummary(columns=tuple(columns), rows=rows)
 
 
 # ------------------------------------------------- host-side summarize

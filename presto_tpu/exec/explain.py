@@ -328,6 +328,20 @@ def render_distributed_analyze(
         "pad waste "
         f"{pad_waste_pct(qstats.device_pad_rows, qstats.device_live_rows):.1f}%"
     )
+    # per-edge exchange transport mix (server/exchange_spi.py): how
+    # each upstream partition actually travelled — in-slice ICI
+    # segment, serialized HTTP wire, or durable-spool re-serve —
+    # including the coordinator's own ICI gather edges
+    if (
+        qstats.exchange_ici_edges
+        or qstats.exchange_http_edges
+        or qstats.exchange_spool_edges
+    ):
+        lines.append(
+            f"exchange: ici {qstats.exchange_ici_edges}, "
+            f"http {qstats.exchange_http_edges}, "
+            f"spool {qstats.exchange_spool_edges}"
+        )
     for st in qstats.stages:
         r = st.rollup()
         lines.append(

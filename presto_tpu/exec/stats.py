@@ -158,6 +158,13 @@ class TaskStats:
     #: pages this task's programs produced/staged
     device_pad_rows: int = 0
     device_live_rows: int = 0
+    #: per-EDGE exchange transport outcomes of this (merge/join) task:
+    #: upstream partitions consumed over the in-slice ICI segment, the
+    #: serialized HTTP wire, or re-served from the durable spool
+    #: (server/exchange_spi.py — EXPLAIN ANALYZE's "exchange:" line)
+    exchange_ici_edges: int = 0
+    exchange_http_edges: int = 0
+    exchange_spool_edges: int = 0
     #: this attempt was a speculative (backup) launch of a straggling
     #: range — winners and losers both carry the flag in the rollup
     speculative: bool = False
@@ -236,6 +243,15 @@ class StageStats:
             ),
             "device_live_rows": sum(
                 t.device_live_rows for t in self.tasks
+            ),
+            "exchange_ici_edges": sum(
+                t.exchange_ici_edges for t in self.tasks
+            ),
+            "exchange_http_edges": sum(
+                t.exchange_http_edges for t in self.tasks
+            ),
+            "exchange_spool_edges": sum(
+                t.exchange_spool_edges for t in self.tasks
             ),
             "failed_tasks": sum(
                 1 for t in self.tasks if t.state == "FAILED"
@@ -321,6 +337,14 @@ class QueryStats:
     device_d2h_bytes: int = 0
     device_pad_rows: int = 0
     device_live_rows: int = 0
+    #: per-EDGE exchange transport mix (server/exchange_spi.py):
+    #: upstream partitions consumed over the in-slice ICI segment /
+    #: the HTTP wire / the durable spool across the query's merge and
+    #: join tasks, plus the coordinator's own ICI gather edges —
+    #: EXPLAIN ANALYZE's "exchange:" line
+    exchange_ici_edges: int = 0
+    exchange_http_edges: int = 0
+    exchange_spool_edges: int = 0
     #: task-side spill bytes already folded into spilled_bytes
     #: (roll_up delta bookkeeping, like the dynamic-filter fields)
     _spill_from_tasks: int = 0
@@ -451,6 +475,9 @@ class QueryStats:
                 "device_d2h_bytes",
                 "device_pad_rows",
                 "device_live_rows",
+                "exchange_ici_edges",
+                "exchange_http_edges",
+                "exchange_spool_edges",
             ):
                 task_sum = sum(
                     getattr(t, attr, 0)
@@ -530,6 +557,16 @@ class QueryStats:
             ),
         }
 
+    def exchange_dict(self) -> dict:
+        """The query's per-edge exchange transport section (QueryInfo
+        and the EXPLAIN ANALYZE "exchange:" line read this one
+        shape)."""
+        return {
+            "ici_edges": self.exchange_ici_edges,
+            "http_edges": self.exchange_http_edges,
+            "spool_edges": self.exchange_spool_edges,
+        }
+
     def _operators_dicts(self) -> List[dict]:
         """Serialized operator rollup. The merge walks every stage/
         task/operator, and ``to_dict`` runs on EVERY client status
@@ -590,6 +627,9 @@ class QueryStats:
             # so JSONL event-sink consumers keep parsing (asserted in
             # tests/test_telemetry.py)
             "device": self.device_dict(),
+            # per-edge exchange transport mix (additive, like the
+            # device section)
+            "exchange": self.exchange_dict(),
             # per-operator actuals (merged local + worker tasks): the
             # history store's write path reads this same record
             "operators": self._operators_dicts(),

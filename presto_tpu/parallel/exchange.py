@@ -365,6 +365,307 @@ def ici_partition_counts(page: Page, dest: jnp.ndarray) -> jnp.ndarray:
     )[1:]
 
 
+# --------------------------------------------------------------------
+# Single-program collective stages (exchange-plane tentpole): when a
+# merge stage's producers all share the mesh, the N-per-source gather
+# passes above (``ici_append`` in a host loop) collapse into ONE
+# compiled program whose ``jax.lax.all_to_all`` IS the exchange.
+#
+# The host contributes three dispatches per stage (a counts pass, the
+# collective program, one take per partition) instead of
+# 2 x batches x partitions; row order and zero-padding are pinned to
+# the per-source path (flat batch order, stable within destination),
+# so the output is bit-identical to ``device_merge`` and therefore to
+# the HTTP wire path's payload concatenation.
+
+_COLLECTIVE_AXIS = "xparts"
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+#: compiled collective-gather programs, keyed by (nparts, caps, column
+#: signature, mesh devices) — one compile per stage *shape*, reused by
+#: every merge task of the stage and by later stages of the same shape
+_COLLECTIVE_PROGRAMS: Dict[tuple, object] = {}
+
+
+@partial(jax.jit, static_argnames=("nparts",))
+def collective_counts(pages, dests, nparts: int) -> jnp.ndarray:
+    """Per-batch per-partition live-row counts, shape
+    ``(len(pages), nparts)`` — ONE dispatch sizes the whole stage's
+    collective buffers (vs one ``ici_partition_counts`` per batch)."""
+    per = []
+    for pg, dest in zip(pages, dests):
+        live = pg.row_mask()
+        d = jnp.where(live, dest.astype(jnp.int32), jnp.int32(-1))
+        per.append(
+            jax.ops.segment_sum(
+                jnp.ones((pg.capacity,), jnp.int32),
+                d + 1,
+                num_segments=nparts + 1,
+            )[1:]
+        )
+    return jnp.stack(per)
+
+
+def _collective_signature(pages, dests, remaps) -> tuple:
+    """Static shape fingerprint of a batch set: the compile-cache key
+    half that the input pytrees determine. ``remaps`` is one dict per
+    batch (each producer batch remaps through its OWN dictionary)."""
+    sig = []
+    for pg, dest, rmps in zip(pages, dests, remaps):
+        cols = []
+        for name, blk in zip(pg.names, pg.blocks):
+            rmp = rmps.get(name)
+            cols.append(
+                (
+                    name,
+                    str(blk.data.dtype),
+                    tuple(blk.data.shape[1:]),
+                    blk.valid is not None,
+                    None if rmp is None else int(rmp.shape[0]),
+                )
+            )
+        sig.append((int(pg.capacity), str(dest.dtype), tuple(cols)))
+    return tuple(sig)
+
+
+def _concat_routed(pages, dests, remaps, dtypes, nparts, total_pad):
+    """Trace-time concat of every batch's columns + destinations into
+    flat ``(total_pad,)`` leaves: dead/padding rows carry the trash
+    destination ``nparts``, dictionary ids pass through their union
+    remap, and every column lands on its schema dtype."""
+    ds = []
+    for pg, dest in zip(pages, dests):
+        live = pg.row_mask()
+        ds.append(jnp.where(live, dest.astype(jnp.int32), jnp.int32(nparts)))
+    D = jnp.concatenate(ds)
+    pad = total_pad - D.shape[0]
+    if pad:
+        D = jnp.concatenate([D, jnp.full((pad,), nparts, jnp.int32)])
+
+    names = pages[0].names
+    any_valid = {
+        name: any(pg.block(name).valid is not None for pg in pages)
+        for name in names
+    }
+    cols, vals, vnames = [], [], []
+    for name in names:
+        parts = []
+        vparts = []
+        for pg, rmps in zip(pages, remaps):
+            blk = pg.block(name)
+            d = blk.data
+            rmp = rmps.get(name)
+            if rmp is not None:
+                d = rmp[
+                    jnp.clip(d.astype(jnp.int64), 0, rmp.shape[0] - 1)
+                ]
+            parts.append(d.astype(dtypes[name]))
+            if any_valid[name]:
+                vparts.append(
+                    blk.valid
+                    if blk.valid is not None
+                    else jnp.ones((pg.capacity,), jnp.bool_)
+                )
+        col = jnp.concatenate(parts)
+        if pad:
+            col = jnp.concatenate(
+                [col, jnp.zeros((pad,) + col.shape[1:], col.dtype)]
+            )
+        cols.append(col)
+        if any_valid[name]:
+            v = jnp.concatenate(vparts)
+            if pad:
+                v = jnp.concatenate([v, jnp.zeros((pad,), jnp.bool_)])
+            vals.append(v)
+            vnames.append(name)
+    return cols, vals, tuple(vnames), D
+
+
+def _route_flat(flat, order, slot, nslots):
+    """Scatter sorted rows into their partition slots (zero slab, OOB
+    dropped) — shared by the fused variant and each shard_map rank."""
+    data_s = flat[order]
+    return (
+        jnp.zeros((nslots,) + flat.shape[1:], flat.dtype)
+        .at[slot]
+        .set(data_s, mode="drop")
+    )
+
+
+def _dest_slots(D, nparts: int, seg_cap: int):
+    """Stable destination grouping: sort rows by destination, compute
+    each row's offset within its destination, and the flat slot
+    ``dest * seg_cap + offset`` (trash/overflow rows land OOB)."""
+    n = D.shape[0]
+    order = jnp.argsort(D, stable=True)
+    d_s = D[order]
+    offset = jnp.arange(n, dtype=jnp.int32) - jnp.searchsorted(
+        d_s, d_s, side="left"
+    ).astype(jnp.int32)
+    slot = d_s.astype(jnp.int64) * seg_cap + offset
+    sendable = (d_s < nparts) & (offset < seg_cap)
+    slot = jnp.where(sendable, slot, nparts * seg_cap)
+    counts = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), D, num_segments=nparts + 1
+    )[:nparts]
+    return order, slot, counts
+
+
+def _make_collective_program(
+    sig, dtype_items, nparts: int, out_cap: int, mesh
+):
+    """Compile the stage's single collective program.
+
+    With a mesh (>= nparts devices): the concatenated rows shard over
+    the ``xparts`` axis and each rank bucket-scatters its rows by
+    destination, ``jax.lax.all_to_all`` moves every bucket to its
+    owner rank, and each rank compacts what it received — the exchange
+    happens in-program, device-to-device. Without a mesh the same
+    routing runs as one fused argsort-scatter (still a single
+    program, no collective). Both return per-column stacked
+    ``(nparts, out_cap)`` slabs, partition p's rows on row p in flat
+    batch order, zero-padded past the partition's count."""
+    dtypes = dict(dtype_items)
+
+    def run(pages, dests, remaps):
+        if mesh is not None:
+            total = sum(pg.capacity for pg in pages)
+            shard_cap = -(-total // nparts)
+            total_pad = nparts * shard_cap
+        else:
+            total_pad = sum(pg.capacity for pg in pages)
+        cols, vals, vnames, D = _concat_routed(
+            pages, dests, remaps, dtypes, nparts, total_pad
+        )
+        names = pages[0].names
+
+        if mesh is None:
+            order, slot, _ = _dest_slots(D, nparts, out_cap)
+            out = {}
+            for name, col in zip(names, cols):
+                out[name] = _route_flat(
+                    col, order, slot, nparts * out_cap
+                ).reshape((nparts, out_cap) + col.shape[1:])
+            for name, v in zip(vnames, vals):
+                out[name + "#valid"] = _route_flat(
+                    v, order, slot, nparts * out_cap
+                ).reshape(nparts, out_cap)
+            return out
+
+        def rank(cols, vals, D):
+            order, slot, counts = _dest_slots(D, nparts, shard_cap)
+            # counts[j] rows leave this rank for rank j; after the
+            # exchange, out_counts[i] rows arrived from rank i
+            out_counts = jax.lax.all_to_all(
+                counts, _COLLECTIVE_AXIS, 0, 0
+            )
+            live_recv = segmented_live_mask(out_counts, shard_cap)
+            (sel,) = jnp.nonzero(
+                live_recv, size=out_cap, fill_value=nparts * shard_cap
+            )
+
+            def exchange(flat):
+                sent = _route_flat(flat, order, slot, nparts * shard_cap)
+                recv = jax.lax.all_to_all(
+                    sent.reshape((nparts, shard_cap) + flat.shape[1:]),
+                    _COLLECTIVE_AXIS,
+                    0,
+                    0,
+                ).reshape((nparts * shard_cap,) + flat.shape[1:])
+                # compact received rank-major segments to the dense
+                # zero-padded prefix (OOB sel = padding -> fill 0)
+                return recv.at[sel].get(mode="fill", fill_value=0)
+
+            return (
+                tuple(exchange(c) for c in cols),
+                tuple(exchange(v) for v in vals),
+            )
+
+        spec = jax.sharding.PartitionSpec(_COLLECTIVE_AXIS)
+        mapped = _shard_map(
+            rank,
+            mesh=mesh,
+            in_specs=(
+                tuple(spec for _ in cols),
+                tuple(spec for _ in vals),
+                spec,
+            ),
+            out_specs=(
+                tuple(spec for _ in cols),
+                tuple(spec for _ in vals),
+            ),
+        )
+        ocols, ovals = mapped(tuple(cols), tuple(vals), D)
+        out = {}
+        for name, col in zip(names, ocols):
+            out[name] = col.reshape((nparts, out_cap) + col.shape[2:])
+        for name, v in zip(vnames, ovals):
+            out[name + "#valid"] = v.reshape(nparts, out_cap)
+        return out
+
+    return jax.jit(run)
+
+
+def collective_gather(pages, dests, remaps, dtypes, nparts: int, out_cap: int):
+    """THE single-program exchange: route every batch's rows to their
+    destination partitions in one compiled program.
+
+    ``pages`` are dictionary-stripped producer pages in flat batch
+    order, ``dests`` their ``bucket_dest`` vectors, ``remaps`` one
+    dict per batch of column name -> union-dictionary id remap
+    (absent = identity, applied in-program), ``dtypes`` column name ->
+    target numpy dtype. Returns
+    ``{name: (nparts, out_cap, ...), name + "#valid": ...}`` stacked
+    slabs. Raises on trace/compile failure — callers fail open to the
+    per-source ``ici_append`` path."""
+    sig = _collective_signature(pages, dests, remaps)
+    dtype_items = tuple(sorted((k, str(v)) for k, v in dtypes.items()))
+    devices = jax.devices()
+    use_mesh = nparts > 1 and len(devices) >= nparts
+    key = (
+        nparts,
+        out_cap,
+        sig,
+        dtype_items,
+        tuple(id(d) for d in devices[:nparts]) if use_mesh else None,
+    )
+    fn = _COLLECTIVE_PROGRAMS.get(key)
+    if fn is None:
+        import numpy as np
+
+        mesh = (
+            jax.sharding.Mesh(
+                np.array(devices[:nparts]), (_COLLECTIVE_AXIS,)
+            )
+            if use_mesh
+            else None
+        )
+        fn = _make_collective_program(
+            sig, dtype_items, nparts, out_cap, mesh
+        )
+        _COLLECTIVE_PROGRAMS[key] = fn
+    return fn(pages, dests, remaps)
+
+
+@partial(jax.jit, static_argnames=("names", "pcap"))
+def collective_take(out, names: tuple, part, pcap: int):
+    """Slice one partition's rows out of the stacked collective output
+    (static per-partition capacity ``pcap`` keeps the downstream
+    fragment's capacity buckets identical to the per-source path)."""
+    res = {}
+    for name in names:
+        v = out.get(name + "#valid")
+        res[name] = {
+            "data": out[name][part][:pcap],
+            "valid": None if v is None else v[part][:pcap],
+        }
+    return res
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def ici_append(
     out: Dict[str, dict],

@@ -39,6 +39,7 @@ from presto_tpu.server.protocol import FragmentSpec
 from presto_tpu.server.scheduler import (
     assign_ranges,
     plan_stage,
+    select_exchange_edges,
     select_exchange_transport,
     stable_workers,
 )
@@ -145,6 +146,11 @@ class _Query:
         #: logical-task sequence for deterministic attempt ids
         #: (server.task_ids — the spool recovery key space)
         self._task_seq = itertools.count(0)
+        #: adaptive partitioned->broadcast handoff: build-subtree
+        #: fingerprint -> (FilterSummary, summarized keys) observed by
+        #: the probe stage, reused by the replicated join's
+        #: dynamic-filter plane instead of a second summary stage
+        self._df_probe_reuse: Dict[str, tuple] = {}
         self._task_stage: Dict[str, StageStats] = {}
         self._recorded: set = set()
         self._adopted = False  # registered in the runner's QueryHistory
@@ -497,6 +503,26 @@ class CoordinatorServer:
             self.local.session.set(
                 "exchange_ici_enabled", bool(ici_on)
             )
+        # single-program collective stages: tier-1
+        # exchange.single-program seeds the session default (on by
+        # default; only meaningful when the ICI gate above is on)
+        sp_on = (
+            config.get("exchange.single-program") if config else None
+        )
+        if sp_on is not None:
+            self.local.session.set(
+                "exchange_single_program", bool(sp_on)
+            )
+        # the coordinator's own slice announcement — the ICI gather
+        # edge (exchange_spi.ici_gather) compares it to the root
+        # stage's planned slice; config override first so tests can
+        # pin topology, else derived from the local device mesh
+        from presto_tpu.server import exchange_spi as _spi
+
+        self.slice_id = str(
+            (config.get("exchange.slice-id") if config else None)
+            or _spi.default_slice_id()
+        )
         # parameterized plan cache (plan/canonical.py): tier-1 keys
         # bound the statement-level LRU and seed the session default
         pce = config.get("plan.cache-entries") if config else None
@@ -1525,6 +1551,20 @@ class CoordinatorServer:
         return self.spool is not None and self._retry_policy() in (
             "TASK",
             "QUERY",
+        )
+
+    def _select_transport(self, workers, schemas) -> str:
+        """Stage transport decision, delegated to the scheduler: the
+        per-EDGE dominant-slice rule when single-program collective
+        stages are on (the default), the legacy all-or-nothing
+        per-stage rule otherwise."""
+        enabled = bool(self.local.session.get("exchange_ici_enabled"))
+        if bool(self.local.session.get("exchange_single_program")):
+            return select_exchange_edges(
+                workers, enabled, schemas=schemas
+            )
+        return select_exchange_transport(
+            workers, enabled, schemas=schemas
         )
 
     def _retry_spec(
@@ -2702,26 +2742,59 @@ class CoordinatorServer:
             # partitionable scan: skip, keep today's plan
             return None
         ndv = int(session.get("dynamic_filtering_ndv_limit"))
-        wait_s = float(session.get("dynamic_filtering_wait_ms")) / 1000.0
-        if wait_s <= 0:
-            # "don't wait" knob: no budget to ever read a summary, so
-            # don't pay for posting + aborting a build stage either
-            REGISTRY.counter("dynamic_filter.wait_expired").update()
-            return None
-        t0 = time.monotonic()
-        with q.trace.span("dynfilter"):
-            summary = self._run_dynfilter_summary(
-                q, bstage, workers,
-                [rk for _, rk in pairs], ndv,
-                deadline=t0 + wait_s,
-            )
-        waited_ms = (time.monotonic() - t0) * 1000.0
-        REGISTRY.distribution("dynamic_filter.wait_ms").add(waited_ms)
-        with q._stats_lock:
-            q.stats.dynamic_filter_wait_ms += waited_ms
+        # adaptive partitioned->broadcast handoff: the probe stage
+        # already summarized THIS build subtree — reorder its observed
+        # per-key columns onto the keys requested here instead of
+        # paying a second summary stage (and its wait budget)
+        summary = None
+        want = [rk for _, rk in pairs]
+        if q._df_probe_reuse:
+            from presto_tpu.plan import history as plan_history
+
+            try:
+                stash = q._df_probe_reuse.get(
+                    plan_history.node_fingerprint(J.right)
+                )
+            except Exception:
+                stash = None
+            if stash is not None:
+                s_sum, s_keys = stash
+                if set(want) <= set(s_keys):
+                    summary = dynfilter.subset_summary(
+                        (
+                            s_sum.columns[s_keys.index(rk)]
+                            for rk in want
+                        ),
+                        rows=s_sum.rows,
+                    )
+                    REGISTRY.counter(
+                        "dynamic_filter.summary_reused"
+                    ).update()
         if summary is None:
-            REGISTRY.counter("dynamic_filter.wait_expired").update()
-            return None
+            wait_s = (
+                float(session.get("dynamic_filtering_wait_ms")) / 1000.0
+            )
+            if wait_s <= 0:
+                # "don't wait" knob: no budget to ever read a summary,
+                # so don't pay for posting + aborting a build stage
+                # either
+                REGISTRY.counter("dynamic_filter.wait_expired").update()
+                return None
+            t0 = time.monotonic()
+            with q.trace.span("dynfilter"):
+                summary = self._run_dynfilter_summary(
+                    q, bstage, workers, want, ndv,
+                    deadline=t0 + wait_s,
+                )
+            waited_ms = (time.monotonic() - t0) * 1000.0
+            REGISTRY.distribution("dynamic_filter.wait_ms").add(
+                waited_ms
+            )
+            with q._stats_lock:
+                q.stats.dynamic_filter_wait_ms += waited_ms
+            if summary is None:
+                REGISTRY.counter("dynamic_filter.wait_expired").update()
+                return None
         REGISTRY.counter("dynamic_filter.built").update()
         # adaptive execution: the merged summary's observed build
         # cardinality is runtime TRUTH about the estimate this join's
@@ -3152,7 +3225,15 @@ class CoordinatorServer:
             )
         except Exception:
             pass
-        return {"estimate": est, "observed": int(summary.rows)}
+        # the summary itself rides along: a partitioned->broadcast
+        # switch hands it to the replicated join's dynamic-filter
+        # plane so the build subtree is not summarized twice
+        return {
+            "estimate": est,
+            "observed": int(summary.rows),
+            "summary": summary,
+            "keys": tuple(keys),
+        }
 
     # ------------------------------------------------------- stage runner
 
@@ -3514,6 +3595,17 @@ class CoordinatorServer:
                             f"{obs['estimate']:.0f} rows, observed "
                             f"{obs['observed']})",
                         )
+                        # hand the probe's observed summary to the
+                        # replicated join's dynamic-filter plane (the
+                        # build subtree was JUST summarized — running
+                        # the summary stage again would pay the wait
+                        # twice for the same evidence)
+                        try:
+                            q._df_probe_reuse[
+                                plan_history.node_fingerprint(J.right)
+                            ] = (obs["summary"], obs["keys"])
+                        except Exception:
+                            pass
                         skip.add(id(J))
                         continue
                     nparts = self._adaptive_nparts(
@@ -3667,12 +3759,12 @@ class CoordinatorServer:
         created: List[tuple] = []
         clock = threading.Lock()
         # transport selection (the scheduler owns it): both producer
-        # stages and the join stage ride the same decision — either
+        # stages and the join stage carry the same slice id — either
         # side's schema being ICI-ineligible keeps the whole exchange
-        # on the HTTP wire
-        ici_slice = select_exchange_transport(
+        # on the HTTP wire, but a lone cross-slice worker settles its
+        # own edges to HTTP at run time (per-edge selection)
+        ici_slice = self._select_transport(
             workers,
-            bool(self.local.session.get("exchange_ici_enabled")),
             schemas=(
                 dict(side_stages[0].worker_fragment.output_schema()),
                 dict(side_stages[1].worker_fragment.output_schema()),
@@ -3840,10 +3932,10 @@ class CoordinatorServer:
         merge_stage = self._new_stage(q, "merge")
         # transport selection (the scheduler owns it): co-located
         # producer/merge workers exchange partitions as device
-        # collectives; "" keeps the serialized HTTP wire
-        ici_slice = select_exchange_transport(
+        # collectives; "" keeps the serialized HTTP wire, and a lone
+        # cross-slice worker settles its own edges at run time
+        ici_slice = self._select_transport(
             workers,
-            bool(self.local.session.get("exchange_ici_enabled")),
             schemas=(dict(worker_fragment.output_schema()),),
         )
         if ici_slice:
@@ -4406,7 +4498,15 @@ class CoordinatorServer:
         """Token-acked page pulls until X-Complete (exchange client):
         the shared rpc.pull_pages loop, with a stall hook that polls
         task status so a FAILED task surfaces its worker-side error
-        text. Monotonic-clock deadline (see _wait_task)."""
+        text. Monotonic-clock deadline (see _wait_task).
+
+        ICI gather edge: when the pulled task's stage was planned on
+        this coordinator's own slice (single-partition root output,
+        single-program mode), the result is taken straight from the
+        in-slice segment — no serialization, no HTTP page loop. The
+        HTTP pull below stays the fallback either way (a worker whose
+        output missed the ICI lane materializes lazily on first
+        read), and the task is still DELETEd by the caller."""
 
         def stall():
             st = self._rpc_json(
@@ -4417,6 +4517,51 @@ class CoordinatorServer:
                     f"task on {w.node_id} failed: {st.get('error')}"
                 )
             time.sleep(0.05)
+
+        if (
+            spec.ici_slice
+            and spec.ici_slice == self.slice_id
+            and bool(self.local.session.get("exchange_single_program"))
+        ):
+            from presto_tpu.server import exchange_spi
+
+            def probe() -> bool:
+                # liveness + terminality probe for the segment wait:
+                # FAILED surfaces the worker error; a FINISHED task
+                # returns False so the gather re-checks seal-or-never
+                # instead of spinning to the deadline
+                try:
+                    st = self._rpc_json(
+                        "GET", f"{w.uri}/v1/task/{spec.task_id}/status"
+                    )
+                except Exception:
+                    return False
+                if st.get("state") == "FAILED":
+                    raise RuntimeError(
+                        f"task on {w.node_id} failed: "
+                        f"{st.get('error')}"
+                    )
+                return st.get("state") not in ("FINISHED", "ABORTED")
+
+            got = exchange_spi.ici_gather(
+                self.slice_id,
+                spec,
+                time.monotonic()
+                + float(
+                    self.local.session.get("query_max_run_time_s")
+                ),
+                probe,
+                fold=self.local._fold_device_stat,
+            )
+            if got is not None:
+                q = self.queries.get(spec.query_id)
+                if q is not None:
+                    # the gather edge is a coordinator-side consume:
+                    # fold it under the delta-guard lock like the
+                    # other coordinator-local stat additions
+                    with q.stats._roll_lock:
+                        q.stats.exchange_ici_edges += 1
+                return got
 
         try:
             return rpc.pull_pages(

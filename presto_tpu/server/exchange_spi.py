@@ -61,6 +61,18 @@ def _fetch_dest(dest, nr: int):
     return arr[:nr].astype(np.int64)
 
 
+def _count_dispatch(n: int = 1, fold=None) -> None:
+    """Device-program launch accounting for the exchange lane's
+    compiled kernels, on the same choke-point counters the fragment
+    runner feeds; ``fold`` is the runner's per-query stat folder
+    (``_fold_device_stat``) when the caller has one — EXPLAIN ANALYZE's
+    per-query ``device.dispatches`` is the proof the single-program
+    path dispatches less."""
+    DEVICE.count_dispatch(n)
+    if fold is not None:
+        fold(device_dispatches=n)
+
+
 def default_slice_id() -> str:
     """Slice identity announced on discovery: co-location means ONE
     host process driving one device mesh (the in-slice segment is
@@ -284,7 +296,7 @@ def _serialize_partition_slices(payload, schema, nrows, buckets):
         yield int(b), pages_wire.serialize_page(cols, n), n
 
 
-def emit_partitioned(task, out, *, slice_id: str, pool) -> None:
+def emit_partitioned(task, out, *, slice_id: str, pool, fold=None) -> None:
     """The ONE partitioned-output emit (reference:
     PartitionedOutputOperator): routes this batch onto the transport
     the scheduler chose for the stage.
@@ -324,6 +336,7 @@ def emit_partitioned(task, out, *, slice_id: str, pool) -> None:
         dest = X.bucket_dest(
             stripped, crc, jnp.asarray(spec.n_partitions), keys
         )
+        _count_dispatch(1, fold)
         nbytes = page_nbytes(out) + int(dest.nbytes)
         if pool is not None:
             # same accounting as HTTP shuffle buffers: the pages are
@@ -371,13 +384,26 @@ def emit_partitioned(task, out, *, slice_id: str, pool) -> None:
             # durable tee: serialized frames on the shared spool dir,
             # sliced by the SAME device-computed destinations (the
             # device and host hashes are pinned equal, but recovery
-            # must match what live consumers gathered, not re-derive)
-            payload, schema, nr = S._page_to_payload(out)
-            bk = _fetch_dest(dest, nr)
-            for part, frame, _ in _serialize_partition_slices(
-                payload, schema, nr, bk
-            ):
-                task._spool.append(spec.task_id, part, frame)
+            # must match what live consumers gathered, not re-derive).
+            # With a drain attached the SPL1 serialization runs on its
+            # background thread — durability stops charging the device
+            # loop; the pre-commit flush keeps commit-marker-last.
+            spool = task._spool
+            tid = spec.task_id
+
+            def tee(page=out, dvec=dest):
+                payload, schema, nr = S._page_to_payload(page)
+                bk = _fetch_dest(dvec, nr)
+                for part, frame, _ in _serialize_partition_slices(
+                    payload, schema, nr, bk
+                ):
+                    spool.append(tid, part, frame)
+
+            drain = getattr(task, "_spool_drain", None)
+            if drain is not None:
+                drain.submit(tid, tee)
+            else:
+                tee()
         return
 
     if ici_wanted:
@@ -415,6 +441,70 @@ def _ici_emit_ok(spec, out, slice_id: str) -> bool:
     )
 
 
+def emit_gather(task, out, *, slice_id: str, pool, fold=None) -> bool:
+    """Single-partition (gather) output onto the ICI lane: when the
+    root stage is co-located with the coordinator, its final gather is
+    one more ICI edge — the output page stays device-resident under an
+    all-zero destination vector and the coordinator takes partition 0
+    straight from the segment, no serialization and no HTTP.
+
+    Returns True when the batch entered the segment (or was empty —
+    the seal carries 'complete, zero rows'), False when the ICI lane
+    cannot carry this page: the caller keeps the serialized buffer
+    path, which is always correct.
+    """
+    import jax.numpy as jnp
+
+    from presto_tpu.exec.staging import page_nbytes
+
+    spec = task.spec
+    if (
+        slice_id == ""
+        or spec.ici_slice != slice_id
+        or spec.n_partitions != 1
+        or not _page_eligible(out)
+    ):
+        if spec.ici_slice and spec.n_partitions == 1:
+            REGISTRY.counter("exchange.ici_fallbacks").update()
+        return False
+    n = int(out.num_valid)
+    if n == 0:
+        return True
+    dest = jnp.zeros((out.capacity,), jnp.int32)
+    nbytes = page_nbytes(out) + int(dest.nbytes)
+    if pool is not None:
+        pool.reserve(task.buf_key, nbytes)
+
+    def consumed(part: int) -> None:
+        with task.cond:
+            if part < len(task.complete_served):
+                task.complete_served[part] = True
+
+    SEGMENT.publish(
+        slice_id,
+        spec.task_id,
+        1,
+        out,
+        dest,
+        nbytes,
+        on_consumed=consumed,
+    )
+    with task.cond:
+        aborted = task.state == "ABORTED"
+    if aborted:
+        # same DELETE race discipline as emit_partitioned
+        freed = SEGMENT.discard(spec.task_id)
+        if pool is not None and freed:
+            pool.release(task.buf_key, freed)
+        raise RuntimeError("task aborted")
+    wire_bytes = n * _wire_row_bytes(out)
+    REGISTRY.counter("exchange.ici_bytes_elided").update(wire_bytes)
+    with task.cond:
+        task.stats.output_rows += n
+        task.stats.output_bytes += wire_bytes
+    return True
+
+
 def seal_task(slice_id: str, task_id: str, nparts: int) -> None:
     """Producer FINISHED cleanly: seal before the terminal state is
     visible (same ordering as the spool commit — FINISHED must imply
@@ -423,8 +513,11 @@ def seal_task(slice_id: str, task_id: str, nparts: int) -> None:
 
 
 def discard_task(task_id: str) -> int:
-    """Task failed/aborted/DELETEd: drop its segment entry; returns
-    bytes to release from the task's pool reservation."""
+    """Task failed/aborted/DELETEd: drop its segment entry (and any
+    collective-stage slabs built over it — a retried producer's new
+    attempt republishes different batches); returns bytes to release
+    from the task's pool reservation."""
+    COLLECTIVE.discard_task(task_id)
     return SEGMENT.discard(task_id)
 
 
@@ -565,7 +658,9 @@ def ici_batches_to_payloads(batches, part: int, schema):
     return out
 
 
-def device_merge(batches_by_source, part: int, schema, max_rows=None):
+def device_merge(
+    batches_by_source, part: int, schema, max_rows=None, fold=None
+):
     """Build the merge task's input page ON DEVICE from ICI batches:
     per-source partition rows gather-scattered into one zero-padded
     buffer (``parallel.exchange.ici_append``), dictionary ids remapped
@@ -595,10 +690,11 @@ def device_merge(batches_by_source, part: int, schema, max_rows=None):
     count_vecs = jax.device_get(
         [X.ici_partition_counts(pg, d) for pg, d in flat]
     )
-    if DEVICE.enabled:
-        DEVICE.count_d2h(
-            sum(int(np.asarray(c).nbytes) for c in count_vecs)
-        )
+    d2h = sum(int(np.asarray(c).nbytes) for c in count_vecs)
+    DEVICE.count_d2h(d2h)
+    _count_dispatch(len(flat), fold)
+    if fold is not None:
+        fold(device_d2h_bytes=d2h)
     counts = [int(np.asarray(c)[part]) for c in count_vecs]
     total = int(sum(counts))
     if max_rows is not None and total > max_rows:
@@ -662,6 +758,7 @@ def device_merge(batches_by_source, part: int, schema, max_rows=None):
             jnp.asarray(offset, jnp.int32),
             remaps,
         )
+        _count_dispatch(1, fold)
         offset += cnt
 
     blocks = []
@@ -685,3 +782,372 @@ def device_merge(batches_by_source, part: int, schema, max_rows=None):
         names=names,
     )
     return page, total
+
+
+# ------------------------------------------------- collective stages
+
+
+class _CollectiveCache:
+    """One single-program exchange per (slice, producer set): the
+    first merge task of a stage builds the collective program's output
+    slabs (ONE ``shard_map``/``all_to_all`` dispatch for every batch of
+    every producer — ``parallel.exchange.collective_gather``); sibling
+    merge tasks take their partitions from the same slabs instead of
+    re-gathering per source. Entries wrap device arrays and die when
+    every partition is served or when any producer task is discarded
+    (a retried attempt republishes different batches). Build failures
+    and size refusals are cached too, so siblings fail open to the
+    per-source path without re-tracing."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._entries: Dict[tuple, dict] = {}
+
+    def lookup(self, key, builder):
+        """The built stage entry for ``key`` (or None when the build
+        failed/refused). The first caller builds OUTSIDE the lock;
+        concurrent siblings wait on the condition instead of building
+        twice."""
+        with self._cond:
+            while True:
+                e = self._entries.get(key)
+                if e is None:
+                    e = {
+                        "state": "building",
+                        "entry": None,
+                        "served": set(),
+                    }
+                    self._entries[key] = e
+                    break
+                if e["state"] == "building":
+                    self._cond.wait(1.0)
+                    continue
+                return e["entry"]
+        built = None
+        try:
+            built = builder()
+        except Exception as exc:
+            log.info(
+                "collective stage build failed (%s); "
+                "falling back to the per-source gather",
+                exc,
+            )
+            REGISTRY.counter("exchange.collective_fallbacks").update()
+        with self._cond:
+            e["state"] = "ready" if built is not None else "failed"
+            e["entry"] = built
+            self._cond.notify_all()
+        return built
+
+    def served(self, key, part: int, nparts: int) -> None:
+        with self._cond:
+            e = self._entries.get(key)
+            if e is None or e["state"] == "building":
+                return
+            e["served"].add(int(part))
+            if len(e["served"]) >= int(nparts):
+                self._entries.pop(key, None)
+
+    def discard_task(self, task_id: str) -> None:
+        with self._cond:
+            for k in [k for k in self._entries if task_id in k[1]]:
+                self._entries.pop(k, None)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"entries": len(self._entries)}
+
+
+#: the ONE collective-stage cache of this process (= this slice)
+COLLECTIVE = _CollectiveCache()
+
+
+def _build_collective(flat, batch_src, schema, nparts, max_rows, fold):
+    """Dispatch the single-program exchange over ``flat`` (all batches
+    of all ICI sources, source-major order): one counts program sizes
+    the slabs, one collective program routes every row — versus one
+    counts + one append program PER BATCH on the per-source path.
+    Returns the stage entry dict, or None when any partition would
+    exceed ``max_rows`` (the caller degrades to the grouped host
+    merge, the same memory funnel the per-source path applies)."""
+    import jax
+    import jax.numpy as jnp
+
+    from presto_tpu.exec.staging import bucket_capacity
+    from presto_tpu.parallel import exchange as X
+
+    names = tuple(schema.keys())
+    pages = tuple(X.strip_dictionaries(pg) for pg, _ in flat)
+    dests = tuple(d for _, d in flat)
+
+    # per-column union dictionary + per-batch remap tables — the
+    # sorted-union searchsorted discipline merge_payloads pins; the
+    # remap itself applies IN-PROGRAM
+    union: Dict[str, Optional[list]] = {}
+    has_valid: Dict[str, bool] = {}
+    for name in names:
+        dicts = []
+        anyv = False
+        for pg, _ in flat:
+            blk = pg.block(name)
+            if blk.dictionary is not None:
+                dicts.append(tuple(blk.dictionary.values))
+            if blk.valid is not None:
+                anyv = True
+        union[name] = sorted(set().union(*dicts)) if dicts else None
+        has_valid[name] = anyv
+    remaps = []
+    for pg, _ in flat:
+        rm = {}
+        for name in names:
+            u = union[name]
+            blk = pg.block(name)
+            if u is not None and blk.dictionary is not None:
+                uarr = np.asarray(u, object)
+                vals = np.asarray(blk.dictionary.values, object)
+                rm[name] = jnp.asarray(
+                    np.searchsorted(uarr, vals).astype(np.int64)
+                )
+        remaps.append(rm)
+
+    counts = np.asarray(
+        jax.device_get(X.collective_counts(pages, dests, nparts))
+    )
+    _count_dispatch(1, fold)
+    DEVICE.count_d2h(int(counts.nbytes))
+    if fold is not None:
+        fold(device_d2h_bytes=int(counts.nbytes))
+    totals = counts.sum(axis=0)
+    peak = int(totals.max(initial=0))
+    if max_rows is not None and peak > max_rows:
+        return None
+    out_cap = bucket_capacity(peak)
+    dtypes = {name: schema[name].np_dtype for name in names}
+    out = X.collective_gather(
+        pages, dests, tuple(remaps), dtypes, nparts, out_cap
+    )
+    _count_dispatch(1, fold)
+    REGISTRY.counter("exchange.collective_stages").update()
+    return {
+        "out": out,
+        "counts": counts,
+        "totals": totals,
+        "union": union,
+        "names": names,
+        "batch_src": tuple(batch_src),
+    }
+
+
+def _collective_page(entry, part: int, schema, fold):
+    """One partition of a built stage entry as a Page — a single
+    static-shape slice program per partition, same union dictionary,
+    row order (flat batch order) and capacity bucket as
+    :func:`device_merge`."""
+    import jax.numpy as jnp
+
+    from presto_tpu.exec.staging import bucket_capacity
+    from presto_tpu.page import Block, Dictionary, Page
+
+    from presto_tpu.parallel import exchange as X
+
+    total = int(entry["totals"][part])
+    pcap = bucket_capacity(total)
+    taken = X.collective_take(
+        entry["out"],
+        entry["names"],
+        jnp.asarray(part, jnp.int32),
+        pcap,
+    )
+    _count_dispatch(1, fold)
+    blocks = []
+    for name in entry["names"]:
+        u = entry["union"][name]
+        blocks.append(
+            Block(
+                data=taken[name]["data"],
+                valid=taken[name]["valid"],
+                dtype=schema[name],
+                dictionary=(
+                    Dictionary(np.asarray(u, object))
+                    if u is not None
+                    else None
+                ),
+            )
+        )
+    return (
+        Page(
+            blocks=tuple(blocks),
+            num_valid=jnp.asarray(total, jnp.int32),
+            names=entry["names"],
+        ),
+        total,
+    )
+
+
+def _collective_flat(batches_by_source):
+    """Source-major flattening shared by the collective entry points —
+    the flat batch order IS the output row order, so it must match the
+    merge task's source order exactly."""
+    flat: List[tuple] = []
+    batch_src: List[int] = []
+    for i, src in enumerate(batches_by_source):
+        for b in src:
+            flat.append(b)
+            batch_src.append(i)
+    return flat, batch_src
+
+
+def collective_merge(
+    slice_id: str,
+    srcs,
+    batches_by_source,
+    part: int,
+    schema,
+    nparts: int,
+    max_rows=None,
+    fold=None,
+):
+    """Single-program variant of :func:`device_merge`: ONE collective
+    dispatch routes every source's batches for ALL partitions at once;
+    this merge task takes partition ``part`` from the shared slabs.
+    Bit-identical output (union dictionaries, flat-batch row order,
+    zero-padded capacity bucket). Returns ``(page, total)`` or None —
+    the caller falls back to :func:`device_merge` (then the grouped
+    host merge), the PR 14 per-source path."""
+    flat, batch_src = _collective_flat(batches_by_source)
+    if not flat:
+        return None
+    key = (slice_id, tuple(srcs), int(nparts))
+    entry = COLLECTIVE.lookup(
+        key,
+        lambda: _build_collective(
+            flat, batch_src, schema, nparts, max_rows, fold
+        ),
+    )
+    got = None
+    if entry is not None:
+        try:
+            got = _collective_page(entry, part, schema, fold)
+        except Exception as exc:
+            log.info(
+                "collective take failed (%s); per-source fallback", exc
+            )
+            REGISTRY.counter("exchange.collective_fallbacks").update()
+    COLLECTIVE.served(key, part, nparts)
+    return got
+
+
+def collective_payloads(
+    slice_id: str,
+    srcs,
+    batches_by_source,
+    part: int,
+    schema,
+    nparts: int,
+    fold=None,
+):
+    """Mixed-transport splice: the ICI sources' share of ``part`` out
+    of the SAME collective program, degraded to host wire payloads —
+    one (possibly empty) ``[(payload, schema, nrows), ...]`` list per
+    source, index-aligned with ``batches_by_source`` — ready to
+    interleave with the HTTP sources' payloads under
+    ``merge_payloads``'s union-merge discipline (bit-equal to the wire
+    path). Returns None when the collective program is unavailable;
+    the caller degrades to :func:`ici_batches_to_payloads` per
+    source."""
+    from presto_tpu.exec import streaming as S
+
+    flat, batch_src = _collective_flat(batches_by_source)
+    if not flat:
+        return None
+    key = (slice_id, tuple(srcs), int(nparts))
+    entry = COLLECTIVE.lookup(
+        key,
+        lambda: _build_collective(
+            flat, batch_src, schema, nparts, None, fold
+        ),
+    )
+    got = None
+    if entry is not None:
+        try:
+            page, total = _collective_page(entry, part, schema, fold)
+            payload, pschema, nr = S._page_to_payload(page)
+            out = []
+            start = 0
+            nsrc = len(batches_by_source)
+            for i in range(nsrc):
+                n_i = int(
+                    sum(
+                        entry["counts"][b][part]
+                        for b in range(len(flat))
+                        if entry["batch_src"][b] == i
+                    )
+                )
+                if n_i:
+                    mask = np.zeros((nr,), bool)
+                    mask[start : start + n_i] = True
+                    out.append(
+                        [
+                            (
+                                S._slice_payload(
+                                    payload, pschema, mask
+                                ),
+                                pschema,
+                                n_i,
+                            )
+                        ]
+                    )
+                else:
+                    out.append([])
+                start += n_i
+            got = out
+        except Exception as exc:
+            log.info(
+                "collective splice failed (%s); per-source fallback",
+                exc,
+            )
+            REGISTRY.counter("exchange.collective_fallbacks").update()
+    COLLECTIVE.served(key, part, nparts)
+    return got
+
+
+def ici_gather(slice_id: str, spec, deadline: float, probe, fold=None):
+    """Coordinator half of the ICI gather edge: when the root stage's
+    single-partition output is co-located, take it straight from the
+    segment — the final gather stops paying serialization + HTTP.
+
+    Returns host payloads ``[(payload, schema, nrows), ...]`` (the
+    shape the result assembly consumes), or None — the caller falls
+    back to the HTTP pull, which remains fully correct (the worker
+    lazily materializes the segment on first HTTP read)."""
+    if (
+        not slice_id
+        or spec.ici_slice != slice_id
+        or spec.n_partitions != 1
+    ):
+        return None
+    src = spec.task_id
+    last_probe = 0.0
+    while True:
+        st = SEGMENT.peek(slice_id, src)
+        if st == "sealed":
+            got = SEGMENT.take(slice_id, src, 0)
+            if got is not None:
+                REGISTRY.counter("exchange.ici_edges").update()
+                return ici_batches_to_payloads(got, 0, None)
+            break
+        if st == "foreign":
+            break
+        now = time.monotonic()
+        if now > deadline:
+            break
+        if now - last_probe > 0.5:
+            last_probe = now
+            alive = probe()
+            if alive is False:
+                if SEGMENT.peek(slice_id, src) == "sealed":
+                    continue
+                break
+        SEGMENT.wait(0.05)
+    REGISTRY.counter("exchange.ici_fallbacks").update()
+    return None

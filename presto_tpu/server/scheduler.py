@@ -265,6 +265,53 @@ def select_exchange_transport(
     return slice_id
 
 
+def select_exchange_edges(
+    workers, enabled: bool, schemas=()
+) -> str:
+    """Per-EDGE transport selection for one partitioned exchange
+    stage — the successor of :func:`select_exchange_transport`'s
+    all-or-nothing rule, and like it the ONE place that decides ICI vs
+    HTTP (the exchange-plane confinement rule pins selection here).
+
+    Returns the DOMINANT slice: the largest group of ACTIVE workers
+    announcing the same non-empty slice id, provided at least two
+    workers share it (a single worker has no in-slice peer to exchange
+    with) and every exchanged schema is ICI-transportable. Workers
+    outside the dominant slice no longer veto the stage — the slice id
+    still rides ``FragmentSpec.ici_slice``, and each EDGE settles
+    per-worker at run time: a producer whose own slice does not match
+    emits on the HTTP lane (``exchange.ici_fallbacks``), and a
+    consumer simply misses the segment for that source and pulls HTTP
+    — so a lone cross-slice worker rides HTTP on its own edges without
+    taxing the co-located pairs. DRAINING/INACTIVE workers are
+    excluded from the count (their edges degrade at drain time), but
+    do not demote the rest. Ties break deterministically (largest
+    count, then lexicographically greatest slice id)."""
+    from presto_tpu.parallel.exchange import MAX_ICI_PARTS
+
+    if not enabled or not workers:
+        return ""
+    if len(workers) > MAX_ICI_PARTS:
+        return ""
+    counts: dict = {}
+    for w in workers:
+        if getattr(w, "state", "ACTIVE") != "ACTIVE":
+            continue
+        sid = getattr(w, "slice_id", "")
+        if sid:
+            counts[sid] = counts.get(sid, 0) + 1
+    if not counts:
+        return ""
+    best, n = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+    if n < 2:
+        return ""
+    for schema in schemas:
+        for t in schema.values():
+            if t.is_array or t.is_map or t.is_row:
+                return ""
+    return best
+
+
 def assign_ranges(total_rows: int, n_ranges: int) -> List[Tuple[int, int]]:
     """Contiguous row ranges of the partitioned scan. The coordinator
     over-partitions (n_ranges = workers x split_queue_factor) and lets

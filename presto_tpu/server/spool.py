@@ -53,10 +53,137 @@ _FRAME = struct.Struct("<II")
 DEFAULT_SPOOL_BYTES = 1 << 30
 #: default time-to-live for committed attempts (exchange.spool-ttl-s)
 DEFAULT_TTL_S = 600.0
+#: default queue depth for the background tee drain
+#: (exchange.spool-drain-depth)
+DEFAULT_DRAIN_DEPTH = 64
 
 #: ``{task_attempt_id}.{partition}.pages`` — task ids contain dots, so
 #: the partition is the LAST dot-separated field before the suffix
 _PAGES_RE = re.compile(r"^(?P<task>.+)\.(?P<part>\d+)\.pages$")
+
+
+class SpoolDrain:
+    """Background executor for the spool tee: the retry-TASK tee's
+    SPL1 serialization (device->host fetch + partition slicing + frame
+    writes) runs on ONE daemon thread per worker instead of the
+    producer's device loop — durability stops charging the exchange
+    hot path.
+
+    Contract with the spool:
+
+    - **Single appender preserved.** Every append of a drained task
+      funnels through the one drain thread (worker.offer_page routes
+      its inline tee here too when a drain is attached), so the
+      spool's one-appender-per-``(task, part)`` file discipline holds
+      even when a task's batches mix ICI and HTTP lanes.
+    - **Commit-marker-last preserved.** The worker calls
+      :meth:`flush` BEFORE ``spool.commit`` — the marker is still
+      written after every frame of the attempt is on disk, and a
+      failed tee unit surfaces at flush so the worker discards the
+      attempt instead of committing a hole.
+    - **Bounded.** ``submit`` applies backpressure (the producer
+      waits) when ``depth`` units are queued: the drain bounds memory,
+      it never drops durability work. After :meth:`close` (worker
+      shutdown) units run inline on the caller.
+    """
+
+    def __init__(self, depth: int = DEFAULT_DRAIN_DEPTH):
+        self.depth = max(1, int(depth))
+        self._cond = threading.Condition()
+        self._queue: List[tuple] = []  # (task_id, unit fn)
+        self._pending: Dict[str, int] = {}  # task -> queued + running
+        self._failed: Dict[str, str] = {}
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="spool-drain", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, task_id: str, fn) -> None:
+        """Queue one tee unit (a zero-argument closure owning its page
+        references); blocks while the queue is at depth."""
+        with self._cond:
+            while len(self._queue) >= self.depth and not self._closed:
+                self._cond.wait(0.1)
+            if not self._closed:
+                self._queue.append((task_id, fn))
+                self._pending[task_id] = (
+                    self._pending.get(task_id, 0) + 1
+                )
+                REGISTRY.counter("spool.drain_units").update()
+                self._cond.notify_all()
+                return
+        # closed: shutdown path — durability outlives the drain thread
+        fn()
+
+    def flush(self, task_id: str, timeout: float = 60.0) -> None:
+        """Wait until every unit of ``task_id`` has run; raises when
+        any unit failed (or the wait times out) so the caller discards
+        the spool attempt instead of committing it."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending.get(task_id, 0) > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"spool drain flush timed out for {task_id}"
+                    )
+                self._cond.wait(min(left, 0.1))
+            err = self._failed.pop(task_id, None)
+        if err is not None:
+            raise RuntimeError(
+                f"spool drain unit failed for {task_id}: {err}"
+            )
+
+    def forget(self, task_id: str) -> None:
+        """Drop queued units of a dead task (its spool attempt is
+        being discarded anyway; a unit already running just finishes
+        against the doomed attempt)."""
+        with self._cond:
+            self._queue = [
+                (t, fn) for t, fn in self._queue if t != task_id
+            ]
+            self._pending.pop(task_id, None)
+            self._failed.pop(task_id, None)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.2)
+                if not self._queue:
+                    return  # closed and drained
+                task_id, fn = self._queue.pop(0)
+                self._cond.notify_all()
+            err = None
+            try:
+                fn()
+            except Exception as exc:  # surfaced at flush
+                err = f"{type(exc).__name__}: {exc}"
+            with self._cond:
+                left = self._pending.get(task_id, 0) - 1
+                if left > 0:
+                    self._pending[task_id] = left
+                else:
+                    self._pending.pop(task_id, None)
+                if err is not None:
+                    self._failed[task_id] = err
+                self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "queued": len(self._queue),
+                "tasks": len(self._pending),
+                "depth": self.depth,
+            }
 
 
 class ExchangeSpool:

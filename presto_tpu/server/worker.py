@@ -38,7 +38,11 @@ from presto_tpu.exec.stats import TaskStats
 from presto_tpu.plan import nodes as N
 from presto_tpu.server import exchange_spi, pages_wire, rpc, task_ids
 from presto_tpu.server.protocol import FragmentSpec
-from presto_tpu.server.spool import ExchangeSpool
+from presto_tpu.server.spool import (
+    DEFAULT_DRAIN_DEPTH,
+    ExchangeSpool,
+    SpoolDrain,
+)
 from presto_tpu.utils import devicediag, faults, tracing
 from presto_tpu.utils.metrics import REGISTRY
 
@@ -77,7 +81,7 @@ def _offer_chunked(task: "_Task", cols, n: int) -> None:
 class _Task:
     def __init__(
         self, spec: FragmentSpec, pool=None, node_id: str = "",
-        spool: "ExchangeSpool" = None,
+        spool: "ExchangeSpool" = None, drain: "SpoolDrain" = None,
     ):
         self.spec = spec
         self.state = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|ABORTED
@@ -106,6 +110,10 @@ class _Task:
         #: task's PARTITIONED output pages so a consumer can re-serve
         #: them after this worker dies; committed at FINISH
         self._spool = spool if spec.spool and nparts > 1 else None
+        #: background tee drain: when attached, EVERY spool append of
+        #: this task funnels through its one thread (single-appender
+        #: contract), and _run_task flushes it before the commit
+        self._spool_drain = drain if self._spool is not None else None
         self.spooled = False  # committed to the spool
         #: per-partition "consumer saw X-Complete" flags — the drain
         #: protocol waits on these (a draining worker must not exit
@@ -203,11 +211,21 @@ class _Task:
         # the spool tee runs OUTSIDE task.cond: disk I/O under the
         # condition would block the result-serving handler threads
         # behind every spooled page. Safe because pages are immutable
-        # once buffered, the producer thread is the only appender per
-        # (task, part), and commit (in _run_task's finally) cannot run
-        # until every offer_page call has returned
+        # once buffered, the appends of one (task, part) all run on one
+        # thread (the producer, or the drain when one is attached —
+        # routing through the drain here keeps that true even when a
+        # task's batches mix ICI and HTTP lanes), and commit (in
+        # _run_task's finally) flushes the drain first
         if self._spool is not None:
-            self._spool.append(self.spec.task_id, part, page)
+            if self._spool_drain is not None:
+                spool, tid = self._spool, self.spec.task_id
+
+                def tee(page=page, part=part):
+                    spool.append(tid, part, page)
+
+                self._spool_drain.submit(tid, tee)
+            else:
+                self._spool.append(self.spec.task_id, part, page)
 
     def ack_below(self, token: int, part: int = 0) -> None:
         """Consumer side: pulling token N acks pages < N.
@@ -397,6 +415,32 @@ class WorkerServer:
         # directory every node mounts (exchange.spool-path); None when
         # unconfigured — retry_policy=NONE never touches it
         self.spool = ExchangeSpool.from_config(config)
+        # off-hot-path spool tee: one background drain thread per
+        # worker batches the retry-TASK tee's SPL1 serialization so
+        # durability stops charging the device loop; _run_task flushes
+        # it before the commit marker (commit-marker-last unchanged)
+        self.spool_drain = (
+            SpoolDrain(
+                int(
+                    config.get(
+                        "exchange.spool-drain-depth",
+                        DEFAULT_DRAIN_DEPTH,
+                    )
+                    if config
+                    else DEFAULT_DRAIN_DEPTH
+                )
+            )
+            if self.spool is not None
+            else None
+        )
+        # single-program collective stages: gate for the one-dispatch
+        # shard_map exchange + the ICI coordinator-gather publish (the
+        # collective path always fails open to the per-source gather)
+        self.single_program = bool(
+            config.get("exchange.single-program", True)
+            if config
+            else True
+        )
         # in-slice collective shuffle (server/exchange_spi.py): the
         # slice identity this worker announces — workers sharing one
         # slice exchange partitioned output device-to-device through
@@ -469,6 +513,8 @@ class WorkerServer:
                 time.sleep(0.05)
         # Only handshake with serve_forever if it actually ran (see
         # CoordinatorServer.shutdown).
+        if self.spool_drain is not None:
+            self.spool_drain.close()
         if self._serve_thread.is_alive():
             self.httpd.shutdown()
         self.httpd.server_close()
@@ -504,7 +550,7 @@ class WorkerServer:
         with self._lock:
             tasks = list(self.tasks.values())
         for t in tasks:
-            if t.spec.n_partitions > 1 and t.spec.ici_slice:
+            if t.spec.ici_slice:
                 with t.cond:
                     finished = t.state == "FINISHED"
                 if finished:
@@ -752,7 +798,7 @@ class WorkerServer:
             raise WorkerDraining("worker is draining")
         task = _Task(
             spec, pool=self.memory_pool, node_id=self.node_id,
-            spool=self.spool,
+            spool=self.spool, drain=self.spool_drain,
         )
         # orphan-reaper bookkeeping: the task itself is liveness
         # evidence for its minting coordinator boot (a coordinator
@@ -818,31 +864,52 @@ class WorkerServer:
             if task._spool is not None:
                 try:
                     if outcome == "FINISHED" and task.state != "ABORTED":
+                        # drain flush BEFORE the commit marker: every
+                        # teed frame must be on disk (and none failed)
+                        # when the marker appears — a failed unit
+                        # raises here and the attempt is discarded
+                        # below instead of committed with a hole
+                        if task._spool_drain is not None:
+                            task._spool_drain.flush(task.spec.task_id)
                         task._spool.commit(task.spec.task_id)
                         task.spooled = True
                     else:
+                        if task._spool_drain is not None:
+                            task._spool_drain.forget(task.spec.task_id)
                         task._spool.discard(task.spec.task_id)
                 except Exception:
                     log.warning(
                         "node=%s spool seal failed for %s",
                         self.node_id, task.spec.task_id, exc_info=True,
                     )
+                    try:
+                        task._spool.discard(task.spec.task_id)
+                    except Exception:
+                        pass
             # in-slice exchange segment: seal BEFORE the terminal state
             # is visible (FINISHED implies the device copy is complete,
             # the spool-commit ordering). A DRAINING worker immediately
             # degrades its ICI edges to HTTP — consumers that have not
             # taken their partition yet fall back to the wire
             if (
-                task.spec.n_partitions > 1
-                and task.spec.ici_slice
+                task.spec.ici_slice
                 and task.spec.ici_slice == self.slice_id
+                and (
+                    task.spec.n_partitions > 1
+                    or getattr(task, "_ici_gather", False)
+                )
             ):
+                # gather (single-partition) tasks seal only when their
+                # output actually rode the ICI lane: sealing an empty
+                # entry while real pages sit in the serialized buffer
+                # would read as 'complete, zero rows' to the
+                # coordinator's in-slice gather
                 try:
                     if outcome == "FINISHED" and task.state != "ABORTED":
                         exchange_spi.seal_task(
                             self.slice_id,
                             task.spec.task_id,
-                            task.spec.n_partitions,
+                            max(task.spec.n_partitions, 1),
                         )
                         if self._draining:
                             self._materialize_ici(task)
@@ -1013,9 +1080,9 @@ class WorkerServer:
                 return exchange_spi.emit_partitioned(
                     task, out,
                     slice_id=self.slice_id, pool=self.memory_pool,
+                    fold=self.runner._fold_device_stat,
                 )
-            cols, n = pages_wire.page_to_wire_columns(out)
-            _offer_chunked(task, cols, n)
+            self._emit_result(task, out)
 
         def finish_summary() -> None:
             """Merge per-batch summaries into the task's one summary
@@ -1086,6 +1153,30 @@ class WorkerServer:
                 emit(f.result())
         finish_summary()
 
+    def _emit_result(self, task: "_Task", out) -> None:
+        """Root-stage (single-partition) result emit: when the
+        coordinator's gather is co-located and the single-program gate
+        is on, the output page stays device-resident — the final
+        gather becomes one more ICI edge. Everything else keeps the
+        serialized chunk-and-offer buffer, and an HTTP puller of an
+        ICI-published task still sees real pages through the lazy
+        materialize in the results handler."""
+        if (
+            task.spec.ici_slice
+            and self.single_program
+            and exchange_spi.emit_gather(
+                task, out,
+                slice_id=self.slice_id, pool=self.memory_pool,
+                fold=self.runner._fold_device_stat,
+            )
+        ):
+            # seal-eligibility latch: only a task whose output rode
+            # the ICI lane may seal at FINISH (see _run_task)
+            task._ici_gather = True
+            return
+        cols, n = pages_wire.page_to_wire_columns(out)
+        _offer_chunked(task, cols, n)
+
     def _ici_probe(self, uri: str, src_task: str):
         """Liveness probe for the in-slice fetch wait: is the producer
         attempt still working toward a seal? Control-plane only (one
@@ -1106,48 +1197,105 @@ class WorkerServer:
 
     def _merge_group_page(self, task: "_Task", entries, rschema):
         """Resolve one merge group's tagged transport entries into the
-        RemoteSource leaf's input: an all-ICI group merges ON DEVICE
-        (``exchange_spi.device_merge`` — same union dictionary, row
-        order, and capacity bucket as the wire path, so the fragment
+        RemoteSource leaf's input: an all-ICI group merges ON DEVICE —
+        first through the stage's single collective program
+        (``exchange_spi.collective_merge``: ONE shard_map/all_to_all
+        dispatch shared by every partition of the stage), falling open
+        to the per-source ``exchange_spi.device_merge`` gather when
+        the collective trace is unavailable (same union dictionary,
+        row order, and capacity bucket either way, so the fragment
         compiles and computes identically); a mixed or oversized group
-        degrades to host payloads. Returns ``(page, None)`` for the
-        device lane or ``(None, payloads)`` for the legacy host
-        lanes."""
-        if entries and all(k == "ici" for k, _ in entries):
-            try:
-                res = exchange_spi.device_merge(
-                    [b for _, b in entries],
-                    task.spec.partition,
-                    rschema,
-                    max_rows=int(
-                        self.runner.session.get("max_device_rows")
-                    ),
-                )
-            except Exception:
-                REGISTRY.counter("exchange.ici_merge_errors").update()
-                log.warning(
-                    "node=%s device merge failed; degrading to host "
-                    "merge", self.node_id, exc_info=True,
-                )
-                res = None
+        degrades to host payloads, with the ICI sources' share still
+        spliced out of the collective program when possible. Returns
+        ``(page, None)`` for the device lane or ``(None, payloads)``
+        for the legacy host lanes."""
+        max_rows = int(self.runner.session.get("max_device_rows"))
+        fold = self.runner._fold_device_stat
+        ici_srcs = tuple(s for k, _, s in entries if k == "ici")
+        if entries and len(ici_srcs) == len(entries):
+            res = None
+            if self.single_program:
+                try:
+                    res = exchange_spi.collective_merge(
+                        self.slice_id,
+                        ici_srcs,
+                        [b for _, b, _ in entries],
+                        task.spec.partition,
+                        rschema,
+                        task.spec.n_partitions,
+                        max_rows=max_rows,
+                        fold=fold,
+                    )
+                except Exception:
+                    REGISTRY.counter(
+                        "exchange.collective_fallbacks"
+                    ).update()
+                    log.warning(
+                        "node=%s collective merge failed; degrading "
+                        "to per-source gather", self.node_id,
+                        exc_info=True,
+                    )
+                    res = None
+            if res is None:
+                try:
+                    res = exchange_spi.device_merge(
+                        [b for _, b, _ in entries],
+                        task.spec.partition,
+                        rschema,
+                        max_rows=max_rows,
+                        fold=fold,
+                    )
+                except Exception:
+                    REGISTRY.counter(
+                        "exchange.ici_merge_errors"
+                    ).update()
+                    log.warning(
+                        "node=%s device merge failed; degrading to "
+                        "host merge", self.node_id, exc_info=True,
+                    )
+                    res = None
             if res is not None:
                 page, total = res
                 with task.cond:
                     task.stats.input_rows += total
                 return page, None
+        spliced = None
+        if self.single_program and ici_srcs:
+            try:
+                spliced = exchange_spi.collective_payloads(
+                    self.slice_id,
+                    ici_srcs,
+                    [b for k, b, _ in entries if k == "ici"],
+                    task.spec.partition,
+                    rschema,
+                    task.spec.n_partitions,
+                    fold=fold,
+                )
+            except Exception:
+                REGISTRY.counter(
+                    "exchange.collective_fallbacks"
+                ).update()
+                log.warning(
+                    "node=%s collective splice failed; per-source "
+                    "fallback", self.node_id, exc_info=True,
+                )
+                spliced = None
         payloads = []
-        for kind, val in entries:
+        si = 0
+        for kind, val, _src in entries:
             if kind == "http":
                 payloads.extend(val)
+                continue
+            if spliced is not None:
+                conv = spliced[si]
+                si += 1
             else:
                 conv = exchange_spi.ici_batches_to_payloads(
                     val, task.spec.partition, rschema
                 )
-                with task.cond:
-                    task.stats.input_rows += sum(
-                        n for _, _, n in conv
-                    )
-                payloads.extend(conv)
+            with task.cond:
+                task.stats.input_rows += sum(n for _, _, n in conv)
+            payloads.extend(conv)
         return None, payloads
 
     def _spool_partition(self, task: "_Task", logical_key: str):
@@ -1285,11 +1433,12 @@ class WorkerServer:
                     )
                     if got_ici is not None:
                         by_group.setdefault(group, []).append(
-                            ("ici", got_ici)
+                            ("ici", got_ici, src_task)
                         )
                         task.stats.staging_ms += (
                             time.perf_counter() - t_pull
                         ) * 1000.0
+                        task.stats.exchange_ici_edges += 1
                         abandoned.pop(lk, None)
                         pulled.add(tuple(src))
                         pulled_logical.add(lk)
@@ -1299,6 +1448,7 @@ class WorkerServer:
                         uri, src_task, spec.partition,
                         self.runner.session, policy=self._rpc_policy,
                     )
+                    task.stats.exchange_http_edges += 1
                 except Exception as e:
                     got = (
                         self._spool_partition(task, lk)
@@ -1314,8 +1464,11 @@ class WorkerServer:
                             pulled.add(tuple(src))
                             continue
                         raise
+                    task.stats.exchange_spool_edges += 1
                 abandoned.pop(lk, None)
-                by_group.setdefault(group, []).append(("http", got))
+                by_group.setdefault(group, []).append(
+                    ("http", got, src_task)
+                )
                 task.stats.staging_ms += (
                     time.perf_counter() - t_pull
                 ) * 1000.0
@@ -1378,8 +1531,7 @@ class WorkerServer:
                     time.perf_counter() - t_exec
                 ) * 1000.0
                 self.memory_pool.release(spec.query_id, staged)
-            cols, n = pages_wire.page_to_wire_columns(out)
-            _offer_chunked(task, cols, n)
+            self._emit_result(task, out)
             return
         if len(remotes) != 1:
             raise RuntimeError(
@@ -1404,8 +1556,7 @@ class WorkerServer:
                     time.perf_counter() - t_exec
                 ) * 1000.0
                 self.memory_pool.release(spec.query_id, staged)
-            cols, n = pages_wire.page_to_wire_columns(out)
-            _offer_chunked(task, cols, n)
+            self._emit_result(task, out)
             return
         # same grouped-execution discipline as the coordinator gather:
         # a partition beyond max_device_rows sub-buckets and merges one
@@ -1435,8 +1586,7 @@ class WorkerServer:
                     time.perf_counter() - t_exec
                 ) * 1000.0
                 self.memory_pool.release(spec.query_id, staged)
-        cols, n = pages_wire.page_to_wire_columns(out)
-        _offer_chunked(task, cols, n)
+        self._emit_result(task, out)
 
     # ------------------------------------------------------------- status
 
@@ -1625,7 +1775,6 @@ def _make_handler(worker: WorkerServer):
                         )
                         need_mat = (
                             state == "FINISHED"
-                            and t.spec.n_partitions > 1
                             and bool(t.spec.ici_slice)
                             and not t._ici_mat_done
                         )

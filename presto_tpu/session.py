@@ -397,6 +397,22 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             bool,
             False,
         ),
+        PropertyMetadata(
+            "exchange_single_program",
+            "Single-program collective stages (parallel/exchange.py): "
+            "when every producer of a partitioned stage shares the "
+            "mesh, the exchange compiles to ONE shard_map program "
+            "whose all_to_all moves every partition in-program (one "
+            "collective dispatch per stage instead of a per-source "
+            "gather pass), transport settles per-EDGE (a lone "
+            "cross-slice worker rides HTTP without demoting the "
+            "co-located pairs), and the coordinator's final gather "
+            "rides the ICI lane when the root stage is co-located. "
+            "False = PR-14 per-source gather + all-or-nothing stage "
+            "transport. Seeded by tier-1 exchange.single-program",
+            bool,
+            True,
+        ),
     ]
 }
 
@@ -517,6 +533,16 @@ class NodeConfig:
         # exchange segment actually requires
         "exchange.ici-enabled": bool,
         "exchange.slice-id": str,
+        # single-program collective stages (PR 18): when every producer
+        # of a partitioned stage shares the mesh, compile ONE
+        # shard_map/all_to_all program per stage instead of per-source
+        # gather passes, and publish single-partition (gather) root
+        # output on the ICI lane too (true by default; the collective
+        # path fails open to the per-source gather). The drain depth
+        # bounds the background spool-tee queue (retry_policy=TASK)
+        # before producers feel backpressure.
+        "exchange.single-program": bool,
+        "exchange.spool-drain-depth": int,
         # parameterized plan cache (plan/canonical.py): LRU entry bound
         # of the statement-level cache, and the enable_plan_cache
         # session default seed

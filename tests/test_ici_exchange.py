@@ -479,6 +479,182 @@ def test_cross_slice_worker_keeps_http():
         _teardown(coord, workers)
 
 
+# ------------------------------------- per-edge transport (mixed mix)
+
+
+def test_select_exchange_edges_rules():
+    """The per-EDGE successor of the all-or-nothing rule: the DOMINANT
+    slice (largest ACTIVE group >= 2) wins; outsiders no longer veto;
+    DRAINING workers are excluded but don't demote the rest; nested
+    schemas and oversized fan-outs still keep the wire."""
+    from presto_tpu import types as T
+    from presto_tpu.parallel.exchange import MAX_ICI_PARTS
+    from presto_tpu.server.scheduler import select_exchange_edges
+
+    class W:
+        def __init__(self, slice_id, state="ACTIVE"):
+            self.slice_id = slice_id
+            self.state = state
+
+    schema = {"a": T.BIGINT, "b": T.VARCHAR}
+    pair = [W("s1"), W("s1")]
+    assert select_exchange_edges(pair, True, (schema,)) == "s1"
+    # a lone cross-slice worker no longer demotes the stage
+    assert (
+        select_exchange_edges(pair + [W("s2")], True, (schema,))
+        == "s1"
+    )
+    assert (
+        select_exchange_edges(pair + [W("")], True, (schema,)) == "s1"
+    )
+    # a DRAINING peer is excluded from the count, not a veto
+    assert (
+        select_exchange_edges(
+            pair + [W("s1", state="DRAINING")], True, (schema,)
+        )
+        == "s1"
+    )
+    # no pair anywhere -> the wire (a lone worker has no in-slice peer)
+    assert select_exchange_edges([W("s1"), W("s2")], True, (schema,)) == ""
+    assert select_exchange_edges([W(""), W("")], True, (schema,)) == ""
+    # deterministic tie-break: count first, then greatest slice id
+    assert (
+        select_exchange_edges(
+            [W("s1"), W("s1"), W("s2"), W("s2")], True, (schema,)
+        )
+        == "s2"
+    )
+    # gate off / nested schema / oversized fan-out keep the wire
+    assert select_exchange_edges(pair, False, (schema,)) == ""
+    nested = {"a": T.array(T.BIGINT)}
+    assert select_exchange_edges(pair, True, (schema, nested)) == ""
+    big = [W("s1") for _ in range(MAX_ICI_PARTS + 1)]
+    assert select_exchange_edges(big, True, (schema,)) == ""
+
+
+def test_mixed_transport_stage_per_edge_ici_and_http():
+    """The mixed-transport acceptance battery: one cross-slice worker
+    in an otherwise co-located cluster. The dominant pair's edges ride
+    the segment (ICI edges counted, bytes elided), the outsider's
+    edges ride HTTP (http edges counted, wire bytes move), and the
+    spliced results are bit-equal to the all-HTTP run."""
+    cfg = {"exchange.ici-enabled": "true"}
+    coord = CoordinatorServer(config=NodeConfig(dict(cfg))).start()
+    workers = [
+        WorkerServer(
+            coordinator_uri=coord.uri, config=NodeConfig(dict(cfg))
+        ).start()
+        for _ in range(2)
+    ] + [
+        WorkerServer(
+            coordinator_uri=coord.uri,
+            config=NodeConfig(
+                dict(cfg, **{"exchange.slice-id": "other-slice"})
+            ),
+        ).start()
+    ]
+    _wait_workers(coord, 3)
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        client.execute(
+            "set session join_distribution_type = PARTITIONED"
+        )
+        for sql in (AGG_SQL, JOIN_SQL):
+            client.execute(
+                "set session exchange_ici_enabled = false"
+            )
+            rows_http = [tuple(r) for r in client.execute(sql).rows()]
+
+            client.execute("set session exchange_ici_enabled = true")
+            e0 = _counter("exchange.ici_edges")
+            b0 = _counter("exchange.ici_bytes_elided")
+            res = client.execute(sql)
+            rows_mixed = [tuple(r) for r in res.rows()]
+            assert rows_mixed == rows_http, (
+                f"mixed transports changed answers: {sql}"
+            )
+            # per-edge mix observed end-to-end: the co-located pair's
+            # edges rode the segment (zero wire bytes — elided grows),
+            # the outsider's edges rode HTTP
+            assert _counter("exchange.ici_edges") > e0, sql
+            assert _counter("exchange.ici_bytes_elided") > b0, sql
+            info = client.query_info(res.query_id)
+            assert info["exchange"]["ici_edges"] > 0, sql
+            assert info["exchange"]["http_edges"] > 0, sql
+    finally:
+        _teardown(coord, workers)
+
+
+def test_collective_trace_failure_falls_open_to_per_source():
+    """A collective program that fails to trace must not fail the
+    stage: the cache records the failure once, every consumer degrades
+    to the PR-14 per-source gather path, and answers are unchanged."""
+    import presto_tpu.server.exchange_spi as spi
+
+    coord, ws = _mk_cluster(2, {"exchange.ici-enabled": "true"})
+    orig = spi._build_collective
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        client.execute(
+            "set session join_distribution_type = PARTITIONED"
+        )
+        client.execute("set session exchange_ici_enabled = true")
+        expected = [
+            tuple(r) for r in coord.local.execute(JOIN_SQL).rows()
+        ]
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic collective trace failure")
+
+        spi._build_collective = boom
+        f0 = _counter("exchange.collective_fallbacks")
+        e0 = _counter("exchange.ici_edges")
+        rows = [tuple(r) for r in client.execute(JOIN_SQL).rows()]
+        assert rows == expected
+        assert _counter("exchange.collective_fallbacks") > f0
+        # the fallback stays on the ICI lane (per-source gather), not
+        # the wire
+        assert _counter("exchange.ici_edges") > e0
+    finally:
+        spi._build_collective = orig
+        _teardown(coord, ws)
+
+
+def test_single_program_collective_stage_and_gather():
+    """Single-program mode end-to-end on a co-located cluster: the
+    shuffle compiles to ONE collective program per stage
+    (exchange.collective_stages counts), and the coordinator's final
+    gather rides the ICI lane instead of the serialized HTTP pull."""
+    coord, ws = _mk_cluster(2, {"exchange.ici-enabled": "true"})
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=300)
+        client.execute(
+            "set session join_distribution_type = PARTITIONED"
+        )
+        client.execute("set session exchange_ici_enabled = true")
+        expected = [
+            tuple(r) for r in coord.local.execute(AGG_SQL).rows()
+        ]
+        c0 = _counter("exchange.collective_stages")
+        res = client.execute(AGG_SQL)
+        assert [tuple(r) for r in res.rows()] == expected
+        assert _counter("exchange.collective_stages") > c0
+        info = client.query_info(res.query_id)
+        # merge-task edges + the coordinator's own gather edges all
+        # rode ICI; nothing fell back to the wire
+        assert info["exchange"]["ici_edges"] > 0
+        assert info["exchange"]["http_edges"] == 0
+        # single-program off: same answers through the per-source path
+        client.execute(
+            "set session exchange_single_program = false"
+        )
+        assert [
+            tuple(r) for r in client.execute(AGG_SQL).rows()
+        ] == expected
+    finally:
+        _teardown(coord, ws)
+
+
 # --------------------------------------- pages_wire floor satellite
 
 

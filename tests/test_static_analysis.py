@@ -717,14 +717,22 @@ def test_exchange_plane_rule_flags_rogue_sites(tmp_path):
             out = ici_append(out, page, dest, 0, 0, {})
             seg = IciSegment()
             emit_partitioned(task, page, slice_id="s", pool=None)
+            ok = emit_gather(task, page, slice_id="s", pool=None)
             got = ici_fetch("s", spec, "t", 0.0, probe)
             merged = device_merge(batches, 0, schema)
+            c = collective_counts(pages, dests, 4)
+            o = collective_gather(pages, dests, (), {}, 4, 1024)
+            p = collective_take(o, ("k",), 0, 256)
+            m = collective_merge("s", srcs, batches, 0, schema, 4)
+            pl = collective_payloads("s", srcs, batches, 0, schema, 4)
+            gathered = ici_gather("s", spec, 0.0, probe)
             t = select_exchange_transport(workers, True, ())
+            e = select_exchange_edges(workers, True, ())
             """
         )
     )
     found = analysis.run_passes(str(tmp_path), rules=["exchange-plane"])
-    assert len(found) == 9
+    assert len(found) == 17
     assert all(f.rule == "exchange-plane" for f in found)
 
 
@@ -744,6 +752,9 @@ def test_exchange_plane_rule_clean_fixtures(tmp_path):
 
             def replicate(page, n, axis):
                 return jax.lax.all_gather(page, axis)
+
+            def collective_gather(pages, dests, remaps, dt, n, cap):
+                return jax.lax.all_to_all(pages, "xparts", 0, 0)
             """
         )
     )
@@ -756,6 +767,11 @@ def test_exchange_plane_rule_clean_fixtures(tmp_path):
                 dest = bucket_dest(out, {}, 4, ("k",))
                 SEGMENT = IciSegment()
                 return dest
+
+            def merge(slice_id, srcs, batches, part, schema, n):
+                counts = collective_counts(batches, None, n)
+                out = collective_gather(batches, None, (), {}, n, 64)
+                return collective_take(out, ("k",), part, 64)
             """
         )
     )

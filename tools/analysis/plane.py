@@ -200,19 +200,31 @@ _WORKER = "server/worker.py"
 _EXCHANGE_CALLS = {
     "all_to_all": {_EXCHANGE},
     "all_gather": {_EXCHANGE},
+    "shard_map": {_EXCHANGE, "parallel/distributed_runner.py"},
     "bucket_dest": {_EXCHANGE, _EXCHANGE_SPI},
     "ici_append": {_EXCHANGE, _EXCHANGE_SPI},
     "ici_partition_counts": {_EXCHANGE, _EXCHANGE_SPI},
     "wire_crc_table": {_EXCHANGE, _EXCHANGE_SPI},
     "partition_exchange": {_EXCHANGE, "parallel/distributed_runner.py"},
+    # single-program collective kernels: constructed in
+    # parallel/exchange.py, driven only by the exchange SPI
+    "collective_counts": {_EXCHANGE, _EXCHANGE_SPI},
+    "collective_gather": {_EXCHANGE, _EXCHANGE_SPI},
+    "collective_take": {_EXCHANGE, _EXCHANGE_SPI},
     "IciSegment": {_EXCHANGE_SPI},
     "emit_partitioned": {_EXCHANGE_SPI, _WORKER},
+    "emit_gather": {_EXCHANGE_SPI, _WORKER},
     "ici_fetch": {_EXCHANGE_SPI, _WORKER},
     "device_merge": {_EXCHANGE_SPI, _WORKER},
+    "collective_merge": {_EXCHANGE_SPI, _WORKER},
+    "collective_payloads": {_EXCHANGE_SPI, _WORKER},
     "ici_batches_to_payloads": {_EXCHANGE_SPI, _WORKER},
     "serialize_ici_frames": {_EXCHANGE_SPI, _WORKER},
     "buffer_frames": {_EXCHANGE_SPI, _WORKER},
+    # the coordinator's half of the ICI gather edge
+    "ici_gather": {_EXCHANGE_SPI, "server/coordinator.py"},
     "select_exchange_transport": {_SCHEDULER, "server/coordinator.py"},
+    "select_exchange_edges": {_SCHEDULER, "server/coordinator.py"},
 }
 
 
@@ -340,8 +352,9 @@ _TELEMETRY_CALLS = {
     # federation/sampler construction: the coordinator's boot seam
     "MetricsFederation": {_TELEMETRY, _COORDINATOR},
     "MetricsSampler": {_TELEMETRY, _COORDINATOR},
-    # increment choke points
-    "count_dispatch": {_TELEMETRY, _RUNNER},
+    # increment choke points (the exchange SPI counts its collective
+    # and gather dispatches through the same audited name)
+    "count_dispatch": {_TELEMETRY, _RUNNER, _EXCHANGE_SPI},
     "count_compile": {_TELEMETRY, _RUNNER},
     "count_h2d": {_TELEMETRY, _STAGING},
     "count_d2h": {_TELEMETRY, _RUNNER, _STAGING, _EXCHANGE_SPI},

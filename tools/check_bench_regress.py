@@ -43,8 +43,10 @@ from typing import Dict, List, Optional, Tuple
 
 #: units where a SMALLER value is the regression
 _HIGHER_BETTER = {"rows/s", "queries/s", "qps", "x", "queries"}
-#: units where a LARGER value is the regression
-_LOWER_BETTER = {"ms", "s", "seconds"}
+#: units where a LARGER value is the regression (dispatches/bytes:
+#: the exchange-plane device accounting — per-query dispatch counts
+#: and transfer bytes regress upward)
+_LOWER_BETTER = {"ms", "s", "seconds", "dispatches", "bytes"}
 
 
 def is_skipped(line: dict) -> bool:
