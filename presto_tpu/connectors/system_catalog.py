@@ -40,6 +40,9 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             # micro-batched serving: answered by a shared vmapped
             # dispatch (QueryStats.batched)
             "batched": T.BOOLEAN,
+            # serving-plane result reuse: answered from the snapshot-
+            # keyed result cache (fresh or bounded-stale serve)
+            "cached": T.BOOLEAN,
             "retries": T.BIGINT,
             "input_rows": T.BIGINT,
             "input_bytes": T.BIGINT,
@@ -227,6 +230,7 @@ class SystemConnector(Connector):
                     "execution_ms": q.execution_ms,
                     "compile_cache_hit": q.compile_cache_hit,
                     "batched": q.batched,
+                    "cached": q.result_cache in ("hit", "stale"),
                     "retries": q.retries,
                     "input_rows": q.input_rows,
                     "input_bytes": q.input_bytes,
@@ -364,6 +368,24 @@ class SystemConnector(Connector):
                     "entries": s["entries"],
                     "bytes": 0,  # plans are small host objects
                     "budget_bytes": 0,
+                    "hits": s["hits"],
+                    "misses": s["misses"],
+                    "evictions": s["evictions"],
+                }
+            )
+        # serving-plane result cache (server/result_cache.py): the
+        # snapshot-keyed entries the coordinator serves without
+        # planning or dispatch (attached by the embedding coordinator;
+        # None on plain runners)
+        rc = getattr(self._runner, "result_cache", None)
+        if rc is not None:
+            s = rc.stats()
+            rows.append(
+                {
+                    "cache": "result.cache",
+                    "entries": s["entries"],
+                    "bytes": s["bytes"],
+                    "budget_bytes": s["budget_bytes"],
                     "hits": s["hits"],
                     "misses": s["misses"],
                     "evictions": s["evictions"],

@@ -282,6 +282,24 @@ def render_distributed_analyze(
             f"micro-batch: {qstats.batch_size}-way "
             "(one device dispatch served the group)"
         )
+    rc_status = getattr(qstats, "result_cache", "")
+    if rc_status:
+        # serving-plane result reuse (server/result_cache.py): HIT /
+        # STALE annotate the snapshot vector the entry was pinned on
+        # and the result's age; MISS = consulted, executed normally
+        if rc_status == "miss":
+            lines.append("result cache: MISS")
+        else:
+            lines.append(
+                f"result cache: {rc_status.upper()} "
+                f"(snapshot {qstats.result_cache_snapshot}, "
+                f"age {qstats.result_cache_age_ms:.0f}ms)"
+            )
+    if getattr(qstats, "mview_rewritten", ""):
+        lines.append(
+            f"materialized view rewrite: {qstats.mview_rewritten} "
+            "(aggregate scan answered from the maintained view)"
+        )
     # adaptive execution: every replan / mid-query strategy decision
     # this statement took ("REPLANNED (epoch N→M) ..." / "SWITCHED
     # broadcast→partitioned ...")

@@ -229,6 +229,11 @@ class LocalQueryRunner:
         # materialized views (exec/mview.py): registry created lazily
         # at the first MV statement — plain query paths pay nothing
         self._mview_registry = None
+        # serving-plane result cache (server/result_cache.py):
+        # attached by the embedding coordinator when
+        # result-cache.enabled could ever gate on; None = the write
+        # fan-in below skips it, bit-exact pre-cache
+        self.result_cache = None
         # streaming ingest lane (server/ingest.py): attached by the
         # embedding coordinator (ingest.wal-path) or tests; None =
         # the legacy write path, bit-exact pre-ingest
@@ -472,6 +477,12 @@ class LocalQueryRunner:
         self.plan_cache.invalidate(handle)
         if self._mview_registry is not None:
             self._mview_registry.note_write(handle)
+        # the serving-plane result cache rides the same seam: a write
+        # (legacy or ingest commit) marks every cached result scanning
+        # the table STALE — served only within the session's bounded-
+        # staleness window, dropped otherwise
+        if self.result_cache is not None:
+            self.result_cache.note_write(handle)
 
     def _resolve_write_handle(self, parts):
         from presto_tpu.connectors.spi import TableHandle
@@ -729,6 +740,24 @@ class LocalQueryRunner:
         statement plans (``mview.max-staleness-s``)."""
         if self._mview_registry is not None:
             self._mview_registry.read_gate(stmt)
+            # MV-aware rewrite (session mview_auto_rewrite): an
+            # eligible aggregate over a base table rewrites onto the
+            # maintained view BEFORE canonicalization, so plan-cache
+            # keys derive from what actually executes. The match/gate
+            # logic is the audited seam in server/result_cache.py;
+            # any failure falls open to the original statement.
+            if self.session.get("mview_auto_rewrite"):
+                from presto_tpu.server.result_cache import mview_rewrite
+
+                rewritten = mview_rewrite(
+                    stmt, self._mview_registry, self.session
+                )
+                if rewritten is not None:
+                    stmt, mv = rewritten
+                    qs = self._active_qs
+                    if qs is not None:
+                        with self._qs_mu:
+                            qs.mview_rewritten = ".".join(mv.parts)
         plan, hit, key = self._plan_cached(stmt)
         if hit:
             # a server embedding this runner installs its QueryStats as

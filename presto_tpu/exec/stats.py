@@ -295,6 +295,18 @@ class QueryStats:
     #: same-fingerprint members rode that one dispatch
     batched: bool = False
     batch_size: int = 0
+    #: serving-plane result reuse (server/result_cache.py): "" = the
+    #: cache was never consulted (lane off / non-SELECT), "hit" =
+    #: answered with zero planning and zero dispatch, "stale" =
+    #: bounded-stale serve (background refresh spawned), "miss" =
+    #: consulted, executed normally, entry stored. age/snapshot carry
+    #: the EXPLAIN ANALYZE annotation ("result cache: HIT (snapshot
+    #: v12, age 340ms)"); mview_rewritten names the view an eligible
+    #: aggregate scan was rewritten onto (tier b), "" = no rewrite
+    result_cache: str = ""
+    result_cache_age_ms: float = 0.0
+    result_cache_snapshot: str = ""
+    mview_rewritten: str = ""
     #: adaptive execution (ROADMAP item 2): replanned = a statement-
     #: cache hit was judged epoch-stale and re-optimized against
     #: today's learned cardinalities; adapted = the runtime decision
@@ -557,6 +569,17 @@ class QueryStats:
             ),
         }
 
+    def result_cache_dict(self) -> dict:
+        """The query's result-reuse section (QueryInfo, the event
+        sink, and the EXPLAIN ANALYZE "result cache:" line read this
+        one shape)."""
+        return {
+            "status": self.result_cache,
+            "age_ms": self.result_cache_age_ms,
+            "snapshot": self.result_cache_snapshot,
+            "mview_rewritten": self.mview_rewritten,
+        }
+
     def exchange_dict(self) -> dict:
         """The query's per-edge exchange transport section (QueryInfo
         and the EXPLAIN ANALYZE "exchange:" line read this one
@@ -630,6 +653,8 @@ class QueryStats:
             # per-edge exchange transport mix (additive, like the
             # device section)
             "exchange": self.exchange_dict(),
+            # serving-plane result reuse (additive, same discipline)
+            "result_cache": self.result_cache_dict(),
             # per-operator actuals (merged local + worker tasks): the
             # history store's write path reads this same record
             "operators": self._operators_dicts(),

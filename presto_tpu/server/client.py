@@ -95,6 +95,11 @@ class PrestoTpuClient:
         #: response headers — the coordinator stays stateless, and
         #: EXECUTE reaches its zero-recompile plan-cache fast lane.
         self.prepared: Dict[str, str] = {}
+        #: memoized wire form of ``prepared`` (the header value every
+        #: request replays): rebuilt only when the map MUTATES — a
+        #: serving loop EXECUTEing one hot statement re-encodes
+        #: nothing per request. None = dirty.
+        self._prepared_header: Optional[str] = None
 
     def execute(self, sql: str) -> ClientResult:
         first = self._post_statement(sql.encode())
@@ -241,10 +246,22 @@ class PrestoTpuClient:
     def _absorb_prepared_headers(self, headers) -> None:
         added = headers.get_all(protocol.ADDED_PREPARE_HEADER)
         if added:
-            self.prepared.update(protocol.decode_prepared(added))
+            # absorb once per (client, name): an echo of a statement
+            # the map already carries verbatim must not dirty the
+            # memoized request header (the common case — the server
+            # echoes at most the first page, but a retried page read
+            # can replay it)
+            fresh = {
+                n: s
+                for n, s in protocol.decode_prepared(added).items()
+                if self.prepared.get(n) != s
+            }
+            if fresh:
+                self.prepared.update(fresh)
+                self._prepared_header = None
         dropped = headers.get(protocol.DEALLOCATED_PREPARE_HEADER)
-        if dropped:
-            self.prepared.pop(dropped, None)
+        if dropped and self.prepared.pop(dropped, None) is not None:
+            self._prepared_header = None
 
     # ----------------------------------------------------- observability
 
@@ -275,10 +292,13 @@ class PrestoTpuClient:
             "X-Presto-User": self.user,
         }
         if self.prepared:
-            headers[protocol.PREPARED_STATEMENT_HEADER] = ",".join(
-                protocol.encode_prepared(n, s)
-                for n, s in self.prepared.items()
-            )
+            hdr = self._prepared_header
+            if hdr is None:
+                hdr = self._prepared_header = ",".join(
+                    protocol.encode_prepared(n, s)
+                    for n, s in self.prepared.items()
+                )
+            headers[protocol.PREPARED_STATEMENT_HEADER] = hdr
         return rpc.call(
             "POST", url, body,
             policy=self.rpc_policy,

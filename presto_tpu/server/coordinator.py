@@ -183,6 +183,13 @@ class _Query:
         #: PREPARE in this query / name dropped by a DEALLOCATE
         self.added_prepare: Optional[Tuple[str, str]] = None
         self.deallocated_prepare: Optional[str] = None
+        #: serving-plane result reuse (server/result_cache.py): the
+        #: minted fingerprint×literal key, the statement it was minted
+        #: from (a background refresh re-plans it), and the plan whose
+        #: pinned snapshot handles key the stored entry
+        self._rc_key: Optional[tuple] = None
+        self._rc_stmt = None
+        self._rc_plan = None
 
     def fail(self, error: str) -> None:
         """Terminal rejection/kill close-out — one place for the
@@ -581,6 +588,38 @@ class CoordinatorServer:
             self.local.mview_registry.max_staleness_s = float(mv_stale)
         if mv_inc is not None:
             self.local.mview_registry.incremental_enabled = bool(mv_inc)
+        # serving-plane result reuse (server/result_cache.py): tier-1
+        # result-cache.* / mview.auto-rewrite keys seed the session
+        # gates; the ONE coordinator cache constructs unconditionally
+        # (idle = zero bytes, zero lookups — the session gate decides
+        # whether any path consults it) so the write fan-in and
+        # system.runtime.caches always see a stable object
+        from presto_tpu.server.result_cache import ResultCache
+
+        rc_on = config.get("result-cache.enabled") if config else None
+        if rc_on is not None:
+            self.local.session.set("enable_result_cache", bool(rc_on))
+        rc_stale = (
+            config.get("result-cache.max-staleness-s")
+            if config
+            else None
+        )
+        if rc_stale is not None:
+            self.local.session.set(
+                "result_cache_max_staleness_s", float(rc_stale)
+            )
+        mv_rw = config.get("mview.auto-rewrite") if config else None
+        if mv_rw is not None:
+            self.local.session.set("mview_auto_rewrite", bool(mv_rw))
+        rc_bytes = config.get("result-cache.bytes") if config else None
+        self.result_cache = ResultCache(
+            self.local,
+            parse_bytes(rc_bytes)
+            if rc_bytes is not None
+            else 256 * 1024 * 1024,
+            pool=self.memory_pool,
+        )
+        self.local.result_cache = self.result_cache
         # constructed in start(), AFTER the embedder registered its
         # catalogs (WAL replay resolves tables through them) and
         # alongside journal recovery — recover before serving
@@ -2020,7 +2059,17 @@ class CoordinatorServer:
                 res = self.local.execute(q.sql)
             self._store_result(q, res)
             return
-        res = self._run_select(q, stmt, workers)
+        res = None
+        if bool(self.local.session.get("enable_result_cache")):
+            # tier-a in front of distributed dispatch (the EXPLAIN
+            # ANALYZE branch above bypasses on purpose: an analyze
+            # always executes)
+            res = self._result_cache_lookup(q, stmt, adopt=True)
+            if res is None:
+                res = self._run_select(q, stmt, workers)
+                self._result_cache_store(q, q._rc_plan, res)
+        else:
+            res = self._run_select(q, stmt, workers)
         self._store_result(q, res)
 
     #: coordinator-global prepared registry bound (names cycle on a
@@ -2096,7 +2145,12 @@ class CoordinatorServer:
         bound = _bind_param_markers(inner, stmt.params)
         workers = self.active_workers()
         if isinstance(bound, A.Select) and workers:
-            res = self._run_select(q, bound, workers)
+            res = None
+            if bool(self.local.session.get("enable_result_cache")):
+                res = self._result_cache_lookup(q, bound, adopt=True)
+            if res is None:
+                res = self._run_select(q, bound, workers)
+                self._result_cache_store(q, q._rc_plan, res)
         else:
             # plan_cached marks q.stats.plan_cache_hit through the
             # thread-local stats sink _execute_query installed
@@ -2158,13 +2212,26 @@ class CoordinatorServer:
         for the legacy path to duplicate."""
         runner = self.local
         wait_ms = float(runner.session.get("microbatch_wait_ms"))
-        if wait_ms <= 0:
+        rc_on = bool(runner.session.get("enable_result_cache"))
+        if wait_ms <= 0 and not rc_on:
             return None
         if adopt:
             runner.history.adopt(q.stats)
             q._adopted = True
+        if rc_on:
+            # result cache UNDER the batch queue: a hot fingerprint's
+            # first batch executes ONCE, every later statement answers
+            # here with zero planning and zero dispatch
+            res = self._result_cache_lookup(q, stmt)
+            if res is not None:
+                return res
         plan, _hit, key = runner.plan_cached_keyed(stmt)
-        if key is not None and runner.microbatch_plan_eligible(plan):
+        res = None
+        if (
+            wait_ms > 0
+            and key is not None
+            and runner.microbatch_plan_eligible(plan)
+        ):
             max_size = min(
                 int(runner.session.get("microbatch_max")), 128
             )
@@ -2176,12 +2243,110 @@ class CoordinatorServer:
                 max_size,
                 no_wait=q._admission_parked,
             )
-            if res is not None:
-                return res
-        # ineligible statement, empty window, or a lane that fell out
-        # of the batch: the one scalar path (capacity retries, error
-        # surfacing, full materialization)
-        return runner.execute_plan(plan, qs=q.stats)
+        if res is None:
+            # ineligible statement, empty window, or a lane that fell
+            # out of the batch: the one scalar path (capacity retries,
+            # error surfacing, full materialization)
+            res = runner.execute_plan(plan, qs=q.stats)
+        if rc_on:
+            self._result_cache_store(q, plan, res)
+        return res
+
+    def _result_cache_lookup(self, q: _Query, stmt, adopt=False):
+        """Tier-a lookup in front of planning and dispatch: -> a
+        served result on a usable entry (fresh, or stale within the
+        session's bounded-staleness window — which also spawns the ONE
+        background refresh), else None with the minted key stashed on
+        ``q`` for the post-execution store. Every failure lane
+        degrades to a miss."""
+        rc = self.result_cache
+        if rc is None:
+            return None
+        from presto_tpu.server import result_cache as rc_mod
+
+        key = rc_mod.statement_key(stmt, self.local.session)
+        q._rc_key = key
+        q._rc_stmt = stmt
+        if key is None:
+            return None
+        max_stale = float(
+            self.local.session.get("result_cache_max_staleness_s")
+        )
+        got = rc.get(key, max_staleness_s=max_stale)
+        if got is None:
+            q.stats.result_cache = "miss"
+            return None
+        entry, stale = got
+        if adopt and not q._adopted:
+            # the distributed path adopts inside _run_select, which a
+            # hit never reaches — system.runtime.queries must still
+            # see the query
+            self.local.history.adopt(q.stats)
+            q._adopted = True
+        q.stats.result_cache = "stale" if stale else "hit"
+        q.stats.result_cache_age_ms = (
+            time.time() - entry.created_at
+        ) * 1000.0
+        q.stats.result_cache_snapshot = entry.snapshot_label
+        q.stats.output_rows = len(entry.rows)
+        if stale:
+            self._spawn_result_refresh(entry)
+        return rc_mod.CachedResult(entry.columns, entry.rows)
+
+    def _result_cache_store(self, q: _Query, plan, res) -> None:
+        """Post-execution put: the entry keys on the statement key
+        minted at lookup and the snapshot vector pinned into the
+        executed plan. No-op (fail open) without a key, on any
+        non-cacheable scan, or on estimation errors."""
+        rc = self.result_cache
+        key = getattr(q, "_rc_key", None)
+        if rc is None or key is None or plan is None or res is None:
+            return
+        try:
+            from presto_tpu.plan import canonical
+
+            rc.put(
+                key,
+                q._rc_stmt,
+                res.columns,
+                res.rows(),
+                canonical.plan_handles(plan),
+            )
+        except Exception:
+            pass
+
+    def _spawn_result_refresh(self, entry) -> None:
+        """Tier-c background refresh: exactly ONE re-execution per
+        stale entry (per-entry CAS), off the serving hot path, through
+        the normal plan/execute seam — the rewrite and snapshot
+        pinning re-apply themselves, and the re-put replaces the stale
+        entry with a fresh vector."""
+        rc = self.result_cache
+        if rc is None or not rc.claim_refresh(entry):
+            return
+
+        def _refresh():
+            try:
+                runner = self.local
+                plan, _hit, _key = runner.plan_cached_keyed(entry.stmt)
+                res = runner.execute_plan(plan)
+                from presto_tpu.plan import canonical
+
+                rc.put(
+                    entry.key,
+                    entry.stmt,
+                    res.columns,
+                    res.rows(),
+                    canonical.plan_handles(plan),
+                )
+            except Exception:
+                pass
+            finally:
+                rc.finish_refresh(entry)
+
+        threading.Thread(
+            target=_refresh, name="result-cache-refresh", daemon=True
+        ).start()
 
     def _run_select(self, q: _Query, stmt, workers):
         """Distributed SELECT: plan -> fragment -> schedule stages ->
@@ -2207,6 +2372,9 @@ class CoordinatorServer:
             # worker re-hoists locally, so literal-variant fragments
             # hit the WORKER compile caches too
             plan, q.stats.plan_cache_hit = self.local.plan_cached(stmt)
+            # result-cache store site (the caller): the entry keys on
+            # THIS plan's snapshot-pinned scan handles
+            q._rc_plan = plan
             if plan.bound_values:
                 from presto_tpu.plan import canonical
 
@@ -4899,9 +5067,16 @@ def _make_handler(coord: CoordinatorServer):
                 if q.added_prepare is not None:
                     from presto_tpu.server import protocol
 
-                    extra[protocol.ADDED_PREPARE_HEADER] = (
-                        protocol.encode_prepared(*q.added_prepare)
-                    )
+                    name, text = q.added_prepare
+                    # echo once: only on the FIRST result page, and
+                    # only when the client's replayed map does not
+                    # already carry the identical statement — a client
+                    # that knows the name must not re-absorb (and
+                    # re-serialize) it on every page of every request
+                    if token == 0 and q.prepared.get(name) != text:
+                        extra[protocol.ADDED_PREPARE_HEADER] = (
+                            protocol.encode_prepared(name, text)
+                        )
                 if q.deallocated_prepare is not None:
                     from presto_tpu.server import protocol
 

@@ -275,6 +275,46 @@ SYSTEM_SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _positive("microbatch_max"),
         ),
         PropertyMetadata(
+            "enable_result_cache",
+            "Serving-plane result reuse (server/result_cache.py): "
+            "SELECT results cache on the canonical statement "
+            "fingerprint x hoisted-literal vector x the snapshot ids "
+            "pinned at plan time; a hit is zero planning and zero "
+            "dispatch, invalidation is a snapshot/write-generation "
+            "compare through the one audited write seam. False (the "
+            "default) = bit-exact pre-cache behavior; every lane "
+            "fails open to normal execution. Tier-1 twins: "
+            "result-cache.enabled, result-cache.bytes",
+            bool,
+            False,
+        ),
+        PropertyMetadata(
+            "result_cache_max_staleness_s",
+            "Bounded-stale serving for cached SELECT results (the "
+            "mview.max-staleness-s discipline generalized to tier-c "
+            "reads): a result-cache entry invalidated by a write may "
+            "still answer for this many seconds after going stale "
+            "while ONE background refresh re-executes. 0 (the "
+            "default) = stale entries never serve. Tier-1 twin: "
+            "result-cache.max-staleness-s",
+            float,
+            0.0,
+            _non_negative("result_cache_max_staleness_s"),
+        ),
+        PropertyMetadata(
+            "mview_auto_rewrite",
+            "MV-aware scan rewrite (server/result_cache.py): an "
+            "eligible single-table aggregate SELECT whose shape "
+            "matches a registered materialized view reads the "
+            "maintained view instead of re-aggregating the base, "
+            "without naming it — under the mview.max-staleness-s "
+            "read-gate discipline (gate off = only provably-current "
+            "views rewrite). False (the default) = no rewriting. "
+            "Tier-1 twin: mview.auto-rewrite",
+            bool,
+            False,
+        ),
+        PropertyMetadata(
             "enable_operator_stats",
             "Trace per-operator output-row counters (plus static "
             "capacity/page-bytes) out of every compiled program and "
@@ -637,6 +677,15 @@ class NodeConfig:
         # (false = every maintenance event is a full refresh)
         "mview.max-staleness-s": float,
         "mview.incremental-enabled": bool,
+        # serving-plane result reuse (server/result_cache.py): the
+        # master gate (false = bit-exact pre-cache), the LRU byte
+        # budget charged to the MemoryPool's result-cache owner, the
+        # bounded-stale serving window for invalidated entries, and
+        # the MV-aware scan-rewrite gate
+        "result-cache.enabled": bool,
+        "result-cache.bytes": str,
+        "result-cache.max-staleness-s": float,
+        "mview.auto-rewrite": bool,
         # tail-latency QoS plane (server/qos.py): the master gate
         # (false = bit-exact legacy admission), the post-resume grace
         # during which a resumed query is immune to re-suspension, and

@@ -1,0 +1,673 @@
+"""Serving-plane result reuse (server/result_cache.py + the
+coordinator serving seam + the local_runner planning seam).
+
+Contracts under test:
+
+- ``result-cache.enabled=false`` (the default) is bit-exact pre-PR:
+  zero cache consultation, scalar-shaped compile keys, empty
+  ``result_cache`` status in QueryInfo, identical results.
+- Hit/miss flow: a repeated statement answers from the cache with
+  ZERO device dispatches, distinct hoisted literals mint distinct
+  keys, and non-cacheable (system.runtime.*) scans are never stored.
+- Invalidation: a legacy INSERT and a streaming-ingest commit both
+  mark entries stale through the one audited write seam; a reader
+  NEVER sees a pre-commit result beyond its session staleness bound.
+- Stale-tolerant serving: within ``result_cache_max_staleness_s`` the
+  stale entry serves (counted) while ONE background refresh
+  re-executes and replaces it.
+- MV-aware rewrite: every eligible aggregate shape answers
+  bit-identically with ``mview_auto_rewrite`` on vs off, and the
+  rewrite actually retargets the scan onto the maintained view.
+- Microbatch interplay: the first concurrent round of a hot
+  fingerprint executes once and populates; the second round is all
+  hits with zero dispatches.
+- Kill-coordinator chaos: a failed-over peer starts COLD — no stale
+  entry ever crosses a coordinator boundary.
+- Observability: result_cache.* metrics, the ``result.cache`` row of
+  system.runtime.caches, the ``cached`` column of
+  system.runtime.queries, the EXPLAIN ANALYZE line, the QueryInfo /
+  JSONL event section (legacy fields intact), and the PR 6 follow-up:
+  prepared-statement headers are absorbed once and re-encoded only
+  when the map actually changed.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import create_connector
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.exec.local_runner import LocalQueryRunner
+from presto_tpu.exec.staging import CatalogManager
+from presto_tpu.server import result_cache as rc_mod
+from presto_tpu.server.coordinator import CoordinatorServer
+from presto_tpu.server.result_cache import ResultCache
+from presto_tpu.session import NodeConfig
+from presto_tpu.sql import parse_statement
+from presto_tpu.utils.metrics import REGISTRY
+from presto_tpu.utils.telemetry import device_snapshot
+
+POINT = (
+    "select c_custkey, c_name, c_acctbal "
+    "from tpch.tiny.customer where c_custkey = ?"
+)
+PREPARED = {"point": POINT}
+
+
+def _mem_runner():
+    """A runner with a fresh writable memory catalog beside tpch."""
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_connector("tpch"))
+    mem = create_connector("memory")
+    catalogs.register("mem", mem)
+    return LocalQueryRunner(catalogs=catalogs), mem
+
+
+def _events(runner, mem, name="ev"):
+    mem.create_table(
+        TableHandle("mem", "default", name),
+        {"k": T.BIGINT, "v": T.BIGINT},
+    )
+    runner.execute(
+        f"insert into mem.default.{name} values "
+        "(1, 10), (1, 20), (2, 5), (3, 7)"
+    )
+    return TableHandle("mem", "default", name)
+
+
+def _coord(enabled=True, **session):
+    """An unstarted coordinator (local dispatch) with a writable
+    memory catalog; the result cache toggles per test."""
+    coord = CoordinatorServer()
+    mem = create_connector("memory")
+    coord.local.catalogs.register("mem", mem)
+    if enabled:
+        coord.local.session.set("enable_result_cache", True)
+    for k, v in session.items():
+        coord.local.session.set(k, v)
+    return coord, mem
+
+
+def _run(coord, sql, prepared=None):
+    q = coord.submit(sql, prepared=dict(prepared or {}))
+    assert q.done.wait(120)
+    assert q.state == "FINISHED", q.error
+    return q
+
+
+def _submit_concurrent(coord, sqls, prepared=None):
+    out = [None] * len(sqls)
+    barrier = threading.Barrier(len(sqls))
+
+    def run(i):
+        barrier.wait(30)
+        q = coord.submit(sqls[i], prepared=dict(prepared or {}))
+        q.done.wait(180)
+        out[i] = q
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(sqls))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(240)
+    return out
+
+
+# --------------------------------------------------------- off = legacy
+
+
+def test_off_by_default_bit_exact():
+    """Default config: the cache is never consulted, never populated;
+    compile keys stay scalar-shaped; QueryInfo carries the (empty)
+    additive section; results match a plain runner."""
+    coord, _ = _coord(enabled=False)
+    try:
+        sql = "select count(*) as c from tpch.tiny.region"
+        expected = [list(r) for r in LocalQueryRunner().execute(sql).rows()]
+        h0 = REGISTRY.counter("result_cache.hits").total
+        m0 = REGISTRY.counter("result_cache.misses").total
+        for _ in range(2):
+            q = _run(coord, sql)
+            assert q.rows == [expected[0]]
+            assert q.stats.result_cache == ""
+            d = q.stats.to_dict()
+            assert d["result_cache"] == {
+                "status": "",
+                "age_ms": 0.0,
+                "snapshot": "",
+                "mview_rewritten": "",
+            }
+        assert REGISTRY.counter("result_cache.hits").total == h0
+        assert REGISTRY.counter("result_cache.misses").total == m0
+        assert coord.result_cache.stats()["entries"] == 0
+        for key in coord.local._compiled:
+            assert len(key) == 4 and "batch" not in key
+    finally:
+        coord.shutdown()
+
+
+# -------------------------------------------------------- hit/miss flow
+
+
+def test_hit_zero_dispatch_and_distinct_literal_keys():
+    coord, mem = _coord()
+    try:
+        _events(coord.local, mem)
+        sql1 = "select sum(v) as s from mem.default.ev where k = 1"
+        sql2 = "select sum(v) as s from mem.default.ev where k = 2"
+        q1 = _run(coord, sql1)
+        assert q1.stats.result_cache == "miss"
+        assert q1.rows == [[30]]
+        d0 = device_snapshot()["dispatches"]
+        q2 = _run(coord, sql1)
+        assert device_snapshot()["dispatches"] == d0, (
+            "a result-cache hit must dispatch NOTHING"
+        )
+        assert q2.stats.result_cache == "hit"
+        assert q2.stats.result_cache_age_ms >= 0.0
+        assert q2.stats.result_cache_snapshot
+        assert q2.rows == q1.rows
+        assert q2.stats.output_rows == 1
+        # same canonical shape, different hoisted literal: its OWN key
+        q3 = _run(coord, sql2)
+        assert q3.stats.result_cache == "miss"
+        assert q3.rows == [[5]]
+        st = coord.result_cache.stats()
+        assert st["entries"] == 2
+        assert st["hits"] == 1 and st["misses"] == 2
+        assert st["bytes"] > 0
+    finally:
+        coord.shutdown()
+
+
+def test_non_cacheable_system_scan_never_stored():
+    coord, _ = _coord()
+    try:
+        sql = "select node_id from system.runtime.nodes"
+        for _ in range(2):
+            q = _run(coord, sql)
+            assert q.stats.result_cache == "miss"
+        assert coord.result_cache.stats()["entries"] == 0
+    finally:
+        coord.shutdown()
+
+
+# --------------------------------------------------------- invalidation
+
+
+def test_insert_invalidates_strict_session_never_stale():
+    """Staleness bound 0 (the default): a write means the very next
+    read re-executes and sees the post-write rows."""
+    coord, mem = _coord()
+    try:
+        _events(coord.local, mem)
+        sql = "select sum(v) as s from mem.default.ev"
+        assert _run(coord, sql).rows == [[42]]
+        assert _run(coord, sql).stats.result_cache == "hit"
+        _run(coord, "insert into mem.default.ev values (9, 100)")
+        q = _run(coord, sql)
+        assert q.stats.result_cache == "miss"
+        assert q.rows == [[142]]
+    finally:
+        coord.shutdown()
+
+
+def test_ingest_commit_bounded_staleness_contract():
+    """THE invalidation acceptance: an ingest commit lands mid-flight;
+    a bounded-stale session may see the pre-commit result only within
+    its bound, NEVER beyond it."""
+    from presto_tpu.server.ingest import IngestManager
+
+    coord, mem = _coord(result_cache_max_staleness_s=0.5)
+    tmp = None
+    try:
+        import tempfile
+
+        tmp = tempfile.mkdtemp(prefix="rc-wal-")
+        _events(coord.local, mem)
+        ing = IngestManager(coord.local, tmp, start_thread=False)
+        sql = "select sum(v) as s from mem.default.ev"
+        assert _run(coord, sql).rows == [[42]]  # populate
+        ing.append("mem.default.ev", columns={"k": [5], "v": [58]})
+        ing.commit_tick()  # fold: snapshot minted, fan-in fires
+        q_stale = _run(coord, sql)
+        assert q_stale.stats.result_cache == "stale"
+        assert q_stale.rows == [[42]]  # bounded-stale pre-commit serve
+        assert coord.result_cache.stats()["stale_served"] == 1
+        time.sleep(0.6)  # past the bound
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            q = _run(coord, sql)
+            assert q.rows == [[100]], (
+                "pre-commit result served beyond the staleness bound"
+            )
+            if q.stats.result_cache == "hit":
+                break  # the background refresh landed a fresh entry
+            time.sleep(0.05)
+        ing.close(final_flush=False)
+    finally:
+        coord.shutdown()
+
+
+def test_stale_serve_spawns_one_refresh_then_hits_fresh():
+    coord, mem = _coord(result_cache_max_staleness_s=30.0)
+    try:
+        _events(coord.local, mem)
+        sql = "select sum(v) as s from mem.default.ev"
+        _run(coord, sql)
+        _run(coord, "insert into mem.default.ev values (4, 8)")
+        q = _run(coord, sql)
+        assert q.stats.result_cache == "stale"
+        assert q.rows == [[42]]
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if coord.result_cache.stats()["refreshes"] == 1:
+                break
+            time.sleep(0.05)
+        assert coord.result_cache.stats()["refreshes"] == 1
+        q2 = _run(coord, sql)
+        assert q2.stats.result_cache == "hit"
+        assert q2.rows == [[50]]  # the refresh replaced the entry
+    finally:
+        coord.shutdown()
+
+
+# ----------------------------------------------------- microbatch × cache
+
+
+def test_microbatch_first_round_populates_second_all_hits():
+    coord, _ = _coord()
+    try:
+        coord.local.session.set("microbatch_wait_ms", 200.0)
+        coord.local.session.set("microbatch_max", 32)
+        # warm plan/compile so round 1 isn't racing a cold XLA compile
+        _run(coord, "execute point using 3", PREPARED)
+        vals = [5, 118, 700, 42, 1499, 12]
+        sqls = [f"execute point using {v}" for v in vals]
+        qs1 = _submit_concurrent(coord, sqls, PREPARED)
+        for q in qs1:
+            assert q.state == "FINISHED", q.error
+            assert q.stats.result_cache == "miss"
+        st = coord.result_cache.stats()
+        assert st["entries"] == len(vals) + 1
+        d0 = device_snapshot()["dispatches"]
+        b0 = REGISTRY.counter("serving.batches").total
+        qs2 = _submit_concurrent(coord, sqls, PREPARED)
+        assert device_snapshot()["dispatches"] == d0, (
+            "the all-hit round must not touch the device"
+        )
+        assert REGISTRY.counter("serving.batches").total == b0
+        for q1, q2 in zip(qs1, qs2):
+            assert q2.state == "FINISHED", q2.error
+            assert q2.stats.result_cache == "hit"
+            assert q2.rows == q1.rows
+    finally:
+        coord.shutdown()
+
+
+def test_hot_fingerprint_collapses_to_one_execution():
+    """N concurrent clients of ONE fingerprint: the first round
+    executes once (one batch), later statements answer from the
+    cache."""
+    coord, _ = _coord()
+    try:
+        coord.local.session.set("microbatch_wait_ms", 150.0)
+        _run(coord, "execute point using 3", PREPARED)
+        sqls = ["execute point using 77"] * 8
+        qs = _submit_concurrent(coord, sqls, PREPARED)
+        rows0 = qs[0].rows
+        for q in qs:
+            assert q.state == "FINISHED", q.error
+            assert q.rows == rows0
+        # one resident entry for the hot key (beside the warmup's)
+        assert coord.result_cache.stats()["entries"] == 2
+        d0 = device_snapshot()["dispatches"]
+        qs2 = _submit_concurrent(coord, sqls, PREPARED)
+        assert device_snapshot()["dispatches"] == d0
+        assert all(q.stats.result_cache == "hit" for q in qs2)
+    finally:
+        coord.shutdown()
+
+
+# ------------------------------------------------------- MV-aware rewrite
+
+
+MV_SQL = (
+    "create materialized view mem.default.mv as "
+    "select k, sum(v) as sv, count(*) as c, min(v) as mn, "
+    "max(v) as mx from mem.default.ev group by k"
+)
+ELIGIBLE_SHAPES = [
+    "select k, sum(v) as sv, count(*) as c, min(v) as mn, max(v) as mx"
+    " from mem.default.ev group by k",
+    "select k, sum(v) as sv from mem.default.ev group by k",
+    "select count(*) as c, k from mem.default.ev group by k",  # reorder
+    "select k, max(v) from mem.default.ev group by k",  # unaliased
+]
+INELIGIBLE_SHAPES = [
+    # filter the MV does not maintain
+    "select k, sum(v) as sv from mem.default.ev where k > 1 group by k",
+    # aggregate the MV does not maintain
+    "select k, avg(v) as a from mem.default.ev group by k",
+    # no grouping
+    "select sum(v) as sv from mem.default.ev",
+]
+
+
+def test_mview_rewrite_bit_equality_every_shape():
+    runner, mem = _mem_runner()
+    _events(runner, mem)
+    runner.execute(MV_SQL)
+    runner.execute("refresh materialized view mem.default.mv")
+    for sql in ELIGIBLE_SHAPES + INELIGIBLE_SHAPES:
+        off = sorted(runner.execute(sql).rows())
+        runner.session.set("mview_auto_rewrite", True)
+        on = sorted(runner.execute(sql).rows())
+        runner.session.set("mview_auto_rewrite", False)
+        assert off == on, sql
+    # the eligible shapes really retargeted: their plan-cache key is
+    # the REWRITTEN statement scanning the view
+    runner.session.set("mview_auto_rewrite", True)
+    for sql in ELIGIBLE_SHAPES:
+        _p, _h, key = runner.plan_cached_keyed(parse_statement(sql))
+        assert "'mv'" in (key or ""), sql
+    for sql in INELIGIBLE_SHAPES:
+        _p, _h, key = runner.plan_cached_keyed(parse_statement(sql))
+        assert "'mv'" not in (key or ""), sql
+
+
+def test_mview_rewrite_staleness_gate_discipline():
+    """A dirty/stale view only rewrites under an explicit read gate —
+    a base-table reader never opts into staleness silently."""
+    runner, mem = _mem_runner()
+    _events(runner, mem)
+    runner.execute(MV_SQL)
+    runner.execute("refresh materialized view mem.default.mv")
+    runner.session.set("mview_auto_rewrite", True)
+    sql = "select k, sum(v) as sv from mem.default.ev group by k"
+    _p, _h, key = runner.plan_cached_keyed(parse_statement(sql))
+    assert "'mv'" in (key or "")
+    runner.execute("insert into mem.default.ev values (8, 1)")
+    # base epoch moved past the view state + no gate: NO rewrite, and
+    # the reader sees the new row immediately
+    _p, _h, key = runner.plan_cached_keyed(parse_statement(sql))
+    assert "'mv'" not in (key or "")
+    assert (8, 1) in {
+        (k, s) for k, s in runner.execute(sql).rows()
+    }
+
+
+def test_mview_rewrite_surfaces_in_stats_via_coordinator():
+    """Tier (b) composes with tier (a): the rewritten execution is
+    attributed on the serving stats, and the result-cache entry keys
+    on the ORIGINAL statement, so the repeat is a plain hit."""
+    coord, mem = _coord()
+    try:
+        coord.local.session.set("mview_auto_rewrite", True)
+        _events(coord.local, mem)
+        coord.local.execute(MV_SQL)
+        coord.local.execute("refresh materialized view mem.default.mv")
+        sql = "select k, sum(v) as sv from mem.default.ev group by k"
+        q = _run(coord, sql)
+        assert q.stats.mview_rewritten == "mem.default.mv"
+        assert sorted(q.rows) == [[1, 30], [2, 5], [3, 7]]
+        q2 = _run(coord, sql)
+        assert q2.stats.result_cache == "hit"
+        assert sorted(q2.rows) == sorted(q.rows)
+    finally:
+        coord.shutdown()
+
+
+# ----------------------------------------------------- kill-coordinator
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_kill_coordinator_failover_starts_cold(tmp_path):
+    """Chaos: the rebooted/failed-over coordinator's result cache is
+    COLD — cached results never survive a coordinator death, so a
+    survivor can never serve a dead peer's stale entry."""
+    from presto_tpu.utils import faults
+
+    ctl = str(tmp_path / "ctl")
+    ports = _free_ports(2)
+    uris = [f"http://127.0.0.1:{p}" for p in ports]
+    coords = []
+    for i in range(2):
+        cfg = NodeConfig(
+            {
+                "node.id": f"coord-{i}",
+                "coordinator.journal-path": ctl,
+                "coordinator.peers": ",".join(
+                    u for j, u in enumerate(uris) if j != i
+                ),
+                "lease.ttl-s": "0.6",
+                "result-cache.enabled": "true",
+            }
+        )
+        coords.append(
+            CoordinatorServer(port=ports[i], config=cfg).start()
+        )
+    c0, c1 = coords
+    try:
+        sql = "select count(*) as c from tpch.tiny.region"
+        assert _run(c0, sql).stats.result_cache == "miss"
+        assert _run(c0, sql).stats.result_cache == "hit"
+        assert c0.result_cache.stats()["entries"] == 1
+        c0._fault_kill()  # abrupt: the lease expires
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if c1.failover_claims >= 1:
+                break
+            time.sleep(0.05)
+        assert c1.failover_claims >= 1
+        # the survivor serves the same statement from a COLD cache
+        assert c1.result_cache.stats()["entries"] == 0
+        q = _run(c1, sql)
+        assert q.stats.result_cache == "miss"
+        assert q.rows == [[5]]
+        assert _run(c1, sql).stats.result_cache == "hit"
+    finally:
+        faults.configure(None)
+        for c in coords:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+
+
+# -------------------------------------------------- eviction / budget
+
+
+def test_lru_eviction_byte_budget_and_pool_mirror():
+    from presto_tpu.plan import canonical
+    from presto_tpu.utils.memory import MemoryPool
+
+    runner, mem = _mem_runner()
+    _events(runner, mem)
+    pool = MemoryPool(1 << 20)
+    rc = ResultCache(runner, budget_bytes=3000, pool=pool)
+
+    def entry(i):
+        stmt = parse_statement(
+            f"select sum(v) as s from mem.default.ev where k = {i}"
+        )
+        key = rc_mod.statement_key(stmt, runner.session)
+        plan, _h, _k = runner.plan_cached_keyed(stmt)
+        res = runner.execute_plan(plan)
+        handles = canonical.plan_handles(plan)
+        return key, stmt, res, handles
+
+    keys = []
+    for i in range(12):
+        key, stmt, res, handles = entry(i)
+        assert rc.put(key, stmt, res.columns, res.rows(), handles)
+        keys.append(key)
+        assert rc.bytes <= rc.budget_bytes
+        assert pool.used_bytes("result-cache") == rc.bytes
+    st = rc.stats()
+    assert st["evictions"] > 0
+    assert st["entries"] < 12
+    # LRU: the oldest resident was evicted, the newest survives
+    assert rc.get(keys[0]) is None
+    assert rc.get(keys[-1]) is not None
+    rc.clear()
+    assert rc.bytes == 0
+    assert pool.used_bytes("result-cache") == 0
+
+
+def test_oversized_entry_skipped_never_thrashes():
+    runner, mem = _mem_runner()
+    _events(runner, mem)
+    rc = ResultCache(runner, budget_bytes=3000)
+    stmt = parse_statement("select k, v from mem.default.ev")
+    key = rc_mod.statement_key(stmt, runner.session)
+    plan, _h, _k = runner.plan_cached_keyed(stmt)
+    from presto_tpu.plan import canonical
+
+    handles = canonical.plan_handles(plan)
+    big = [[i, "x" * 64] for i in range(50)]  # > budget // 8
+    assert not rc.put(key, stmt, ("k", "v"), big, handles)
+    assert rc.stats()["entries"] == 0 and rc.bytes == 0
+
+
+# ------------------------------------------------------- observability
+
+
+def test_runtime_views_explain_and_jsonl_events(tmp_path):
+    from presto_tpu.exec.explain import render_query_analyze
+    from presto_tpu.exec.stats import JsonlQueryEventListener
+
+    coord, mem = _coord()
+    path = tmp_path / "events.jsonl"
+    coord.local.history.add_listener(JsonlQueryEventListener(str(path)))
+    try:
+        _events(coord.local, mem)
+        sql = "select sum(v) as s from mem.default.ev"
+        _run(coord, sql)
+        hit = _run(coord, sql)
+        # system.runtime.caches: the result.cache row
+        rows = coord.local.execute(
+            "select cache, entries, hits, misses "
+            "from system.runtime.caches"
+        ).rows()
+        by_name = {r[0]: r for r in rows}
+        assert "result.cache" in by_name
+        assert by_name["result.cache"][1] == 1  # one resident entry
+        assert by_name["result.cache"][2] >= 1
+        # system.runtime.queries: the cached column
+        qrows = coord.local.execute(
+            "select query_id, cached from system.runtime.queries"
+        ).rows()
+        cached = {qid for qid, c in qrows if c}
+        assert hit.stats.query_id in cached
+        # EXPLAIN ANALYZE line
+        text = render_query_analyze(hit.stats)
+        assert "result cache: HIT (snapshot" in text
+        assert "age" in text
+        # JSONL events: legacy fields intact + the additive section
+        recs = [
+            json.loads(line)
+            for line in path.read_text().splitlines()
+        ]
+        for rec in recs:
+            for field in (
+                "event", "query_id", "state", "elapsed_ms",
+                "planning_ms", "execution_ms", "input_rows",
+                "output_rows", "operators", "stages",
+                "peak_memory_bytes",
+            ):
+                assert field in rec, field
+            assert set(rec["result_cache"]) == {
+                "status", "age_ms", "snapshot", "mview_rewritten",
+            }
+        assert any(
+            r["result_cache"]["status"] == "hit" for r in recs
+        )
+    finally:
+        coord.shutdown()
+
+
+def test_metrics_families_move():
+    coord, mem = _coord()
+    try:
+        _events(coord.local, mem)
+        h0 = REGISTRY.counter("result_cache.hits").total
+        m0 = REGISTRY.counter("result_cache.misses").total
+        b0 = REGISTRY.counter("result_cache.bytes").total
+        sql = "select count(*) as c from mem.default.ev"
+        _run(coord, sql)
+        _run(coord, sql)
+        assert REGISTRY.counter("result_cache.hits").total == h0 + 1
+        assert REGISTRY.counter("result_cache.misses").total == m0 + 1
+        assert REGISTRY.counter("result_cache.bytes").total > b0
+    finally:
+        coord.shutdown()
+
+
+# ------------------------------------- PR 6 follow-up: header absorption
+
+
+def test_prepared_header_absorbed_once_and_memoized():
+    """EXECUTE must not re-serialize the full client prepared map per
+    request: the server echoes X-Presto-Added-Prepare only on the
+    first page of the PREPARE, and the client re-encodes its request
+    header only when the map actually changed."""
+    from presto_tpu.server import protocol
+    from presto_tpu.server.client import PrestoTpuClient
+
+    coord = CoordinatorServer().start()
+    try:
+        client = PrestoTpuClient(coord.uri, timeout_s=120)
+        encodes = []
+        real_encode = protocol.encode_prepared
+
+        def counting_encode(name, text):
+            encodes.append(name)
+            return real_encode(name, text)
+
+        protocol.encode_prepared = counting_encode
+        try:
+            client.execute(f"prepare point from {POINT}")
+            assert client.prepared == {"point": POINT}
+            # the server echoed the added statement exactly once (the
+            # PREPARE's first page) — one server-side encode
+            assert encodes.count("point") == 1
+            for v in (3, 7, 11):
+                rows = client.execute(f"execute point using {v}").rows()
+                assert rows and rows[0][0] == v
+            # plus ONE client-side encode when the map first changed:
+            # the request header is memoized across every later
+            # request, not re-serialized per EXECUTE
+            hdr = client._prepared_header
+            assert hdr is not None
+            assert encodes.count("point") == 2
+            before = list(encodes)
+            client.execute("execute point using 42")
+            # no re-encode for a warm map, and replayed echo headers
+            # (the statement is already in the map verbatim) did not
+            # dirty the memo
+            assert encodes == before
+            assert client._prepared_header is hdr
+        finally:
+            protocol.encode_prepared = real_encode
+    finally:
+        coord.shutdown()

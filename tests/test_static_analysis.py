@@ -85,6 +85,7 @@ def test_every_rule_registered(repo_findings):
         "reserve-sites",
         "qos-plane",
         "lease-plane",
+        "result-cache-plane",
         "exchange-plane",
         "adaptive-plane",
         "metric-names",
@@ -981,6 +982,85 @@ def test_lease_plane_rule_clean_fixtures(tmp_path):
         )
     )
     assert not analysis.run_passes(str(tmp_path), rules=["lease-plane"])
+
+
+def test_result_cache_plane_rule_flags_rogue_sites(tmp_path):
+    """The result-reuse plane's privileged constructs flag outside
+    server/result_cache.py + its audited consumers: cache
+    construction, key minting, snapshot-vector probing, the MV
+    rewrite seam, and the refresh CAS pair."""
+    (tmp_path / "rogue.py").write_text(
+        textwrap.dedent(
+            """
+            rc = ResultCache(runner, 1 << 20)
+            key = statement_key(stmt, session)
+            vec = snapshot_vector(handles, catalogs)
+            got = mview_rewrite(stmt, registry, session)
+            ok = rc.claim_refresh(entry)
+            rc.finish_refresh(entry)
+            """
+        )
+    )
+    found = analysis.run_passes(
+        str(tmp_path), rules=["result-cache-plane"]
+    )
+    assert len(found) == 6
+    assert all(f.rule == "result-cache-plane" for f in found)
+
+
+def test_result_cache_plane_rule_clean_fixtures(tmp_path):
+    """The audited modules and attribute/stats reads never flag."""
+    srv = tmp_path / "server"
+    srv.mkdir()
+    (srv / "result_cache.py").write_text(
+        textwrap.dedent(
+            """
+            def statement_key(stmt, session):
+                return None
+
+            def snapshot_vector(handles, catalogs):
+                return ()
+
+            class ResultCache:
+                pass
+            """
+        )
+    )
+    (srv / "coordinator.py").write_text(
+        textwrap.dedent(
+            """
+            def seed(coord, runner, budget):
+                coord.result_cache = ResultCache(runner, budget)
+                key = statement_key(stmt, runner.session)
+                if coord.result_cache.claim_refresh(entry):
+                    coord.result_cache.finish_refresh(entry)
+            """
+        )
+    )
+    ex = tmp_path / "exec"
+    ex.mkdir()
+    (ex / "local_runner.py").write_text(
+        textwrap.dedent(
+            """
+            def plan_seam(stmt, registry, session):
+                return mview_rewrite(stmt, registry, session)
+            """
+        )
+    )
+    (tmp_path / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            def f(coord):
+                # reads of the audited names are fine
+                rc = coord.result_cache
+                st = rc.stats() if rc is not None else {}
+                return st.get("hits", 0)
+            """
+        )
+    )
+    assert not analysis.run_passes(
+        str(tmp_path), rules=["result-cache-plane"]
+    )
 
 
 def test_history_shim_clean_and_flags(tmp_path):

@@ -509,6 +509,55 @@ def qos_pass(modules: List[core.Module], src_dir: str):
     return findings
 
 
+# ----------------------------------------------------- result-cache plane
+
+_RC = "server/result_cache.py"
+_RC_COORD = {_RC, "server/coordinator.py"}
+
+#: the serving-plane reuse tier's privileged constructs and their
+#: audited callers: cache construction and the fingerprint×snapshot
+#: key minting are reachable only from the coordinator (a second
+#: cache, or a key minted elsewhere, would fork the freshness
+#: contract); the MV rewrite seam only from server/result_cache.py
+#: itself and the ONE planning seam in exec/local_runner.py
+#: (plan_cached_keyed) — a rogue rewrite site could serve MV state a
+#: base-table reader never opted into.
+_RC_CALLS = {
+    "ResultCache": _RC_COORD,
+    "statement_key": _RC_COORD,
+    "snapshot_vector": {_RC},
+    "mview_rewrite": {_RC, "exec/local_runner.py"},
+    "claim_refresh": _RC_COORD,
+    "finish_refresh": _RC_COORD,
+}
+
+
+@core.register(
+    "result-cache-plane",
+    "result-cache construction, fingerprint×snapshot key minting, and "
+    "the MV rewrite seam confined to server/result_cache.py + audited "
+    "consumers (coordinator serving seam; local_runner planning seam)",
+)
+def result_cache_pass(modules: List[core.Module], src_dir: str):
+    findings = []
+    for mod in modules:
+        for call in _walk_calls(mod):
+            term = core.terminal_name(call.func)
+            allowed = _RC_CALLS.get(term)
+            if allowed is None or mod.rel in allowed:
+                continue
+            findings.append(
+                mod.finding(
+                    "result-cache-plane",
+                    call.lineno,
+                    f"result-cache construct {term}() outside its "
+                    f"audited modules ({', '.join(sorted(allowed))}) "
+                    "— route through presto_tpu.server.result_cache",
+                )
+            )
+    return findings
+
+
 # ------------------------------------------------------------- reserve
 
 _RESERVE_ALLOWED = {
@@ -522,6 +571,10 @@ _RESERVE_ALLOWED = {
     # buffer key — the same owner the worker's HTTP shuffle buffers
     # use, released by the same DELETE/drop path
     "server/exchange_spi.py",
+    # the serving-plane result cache byte-budgets its entries under
+    # the pool's "result-cache" owner (non-blocking try_reserve only:
+    # a cache fill must never stall or kill a query)
+    "server/result_cache.py",
 }
 
 

@@ -41,8 +41,10 @@ import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
-#: units where a SMALLER value is the regression
-_HIGHER_BETTER = {"rows/s", "queries/s", "qps", "x", "queries"}
+#: units where a SMALLER value is the regression (hits: the serving
+#: result-cache hit count — a cache that silently stopped hitting is
+#: a serving regression even when raw qps survives)
+_HIGHER_BETTER = {"rows/s", "queries/s", "qps", "x", "queries", "hits"}
 #: units where a LARGER value is the regression (dispatches/bytes:
 #: the exchange-plane device accounting — per-query dispatch counts
 #: and transfer bytes regress upward)
