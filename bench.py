@@ -912,6 +912,116 @@ def _streaming_ingest_line(backend: str) -> dict:
     }
 
 
+def _lakehouse_restart_recovery_line(backend: str) -> dict:
+    """Durable lakehouse ingest with a scripted bounce mid-commit
+    (the crash-safe manifest PR): a producer streams acked
+    micro-batches through the ingest lane with the lakehouse tee on;
+    mid-window the publish is killed at the ``_current`` pointer swap
+    (the worst of the three pipeline points — data files and manifest
+    already landed) and the coordinator-side manager is abandoned,
+    then a FRESH incarnation over the same WAL + lakehouse dirs
+    restores from the manifest tip and replays the acked tail.
+    Reports sustained ingest rows/s, the recovery wall, and the
+    contract ``acked_batches_lost == 0`` — every batch acked before
+    the kill is readable after it. Backend-tagged; boot failures emit
+    a skipped line, never a fake zero."""
+    import tempfile
+
+    from presto_tpu import types as T
+    from presto_tpu.connectors import create_connector
+    from presto_tpu.connectors.spi import TableHandle
+    from presto_tpu.exec.local_runner import LocalQueryRunner
+    from presto_tpu.exec.staging import CatalogManager
+    from presto_tpu.server.ingest import IngestManager
+    from presto_tpu.utils import faults
+
+    batch_rows, window_s = 200, 2.0
+
+    def boot(wal: str, lake: str):
+        catalogs = CatalogManager()
+        mem = create_connector("memory")
+        catalogs.register("mem", mem)
+        runner = LocalQueryRunner(catalogs=catalogs)
+        ing = IngestManager(
+            runner, wal, start_thread=False, lakehouse_path=lake
+        )
+        return runner, mem, ing
+
+    def table_rows(runner) -> int:
+        return runner.execute(
+            "select count(*) from mem.default.events"
+        ).rows()[0][0]
+
+    with tempfile.TemporaryDirectory() as td:
+        wal, lake = td + "/wal", td + "/lake"
+        runner, mem, ing = boot(wal, lake)
+        mem.create_table(
+            TableHandle("mem", "default", "events"),
+            {"k": T.BIGINT, "v": T.BIGINT},
+        )
+        acked = 0  # rows whose append() returned before the kill
+        i = 0
+        t0 = time.monotonic()
+        stop = t0 + window_s
+        while time.monotonic() < stop:
+            ing.append(
+                "mem.default.events",
+                columns={
+                    "k": [
+                        (i * batch_rows + j) % 64
+                        for j in range(batch_rows)
+                    ],
+                    "v": [1] * batch_rows,
+                },
+            )
+            acked += batch_rows
+            i += 1
+            if i % 4 == 0:
+                ing.flush()
+        wall = time.monotonic() - t0
+        # the scripted bounce: kill the publish at the pointer swap,
+        # then abandon this incarnation without another flush
+        faults.configure(
+            {"rules": [
+                {"action": "io_error", "path": "_current", "count": 1}
+            ]}
+        )
+        try:
+            ing.append(
+                "mem.default.events",
+                columns={"k": [0], "v": [1]},
+            )
+            acked += 1
+            ing.flush()
+        finally:
+            faults.configure(None)
+        ing.close(final_flush=False)
+
+        t1 = time.monotonic()
+        runner2, _mem2, ing2 = boot(wal, lake)  # restore + replay
+        recovery_s = time.monotonic() - t1
+        ing2.flush()  # commit the replayed acked tail
+        recovered = table_rows(runner2)
+        stats = ing2.stats()
+        ing2.close(final_flush=False)
+    return {
+        "metric": "lakehouse_restart_recovery",
+        "value": round(acked / wall, 1),
+        "unit": "rows/s",
+        "window_s": round(wall, 2),
+        "acked_rows": acked,
+        "recovered_rows": recovered,
+        # THE contract: every row acked before the kill — including
+        # the batch whose publish died at the pointer swap — is
+        # readable after recovery
+        "acked_batches_lost": max(acked - recovered, 0),
+        "recovery_ms": round(recovery_s * 1000.0, 1),
+        "replayed_batches": stats.get("replayed", 0),
+        "contract_ok": recovered == acked,
+        "backend": backend,
+    }
+
+
 def _qos_line(backend: str) -> dict:
     """Tail-latency QoS measurement (the QoS-plane PR): interactive
     point-lookup p99 WITH a concurrent analytic scan load in the same
@@ -1653,6 +1763,13 @@ def main() -> None:
                     "multi_coordinator_failover_qps", e, "queries/s"
                 )
             )
+        # durable lakehouse: sustained acked ingest with a scripted
+        # bounce killed at the _current pointer swap — the contract is
+        # acked_batches_lost == 0 after restore + tail replay
+        try:
+            _emit(_lakehouse_restart_recovery_line(backend))
+        except Exception as e:
+            _emit(skip_line("lakehouse_restart_recovery", e))
     if not run_all:
         return
 

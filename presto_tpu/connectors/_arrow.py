@@ -100,6 +100,10 @@ def arrow_column_to_payload(arr, t: T.DataType):
         data = np.asarray(
             combined.cast(pa.int64()).fill_null(0), dtype=np.int64
         )
+    elif t.name == "boolean":
+        # fill_null(0) would try pa.scalar(0, bool) and fail — the
+        # fill value must be a python bool for boolean arrays
+        data = np.asarray(combined.fill_null(False), dtype=t.np_dtype)
     else:
         data = np.asarray(
             combined.fill_null(0), dtype=t.np_dtype

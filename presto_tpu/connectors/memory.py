@@ -167,6 +167,31 @@ class MemoryConnector(Connector):
             ] = n
         return n
 
+    def restore_snapshots(
+        self, handle: TableHandle, pairs
+    ) -> None:
+        """Re-register historical ``(snapshot id, row count)`` pairs
+        recovered from a durable manifest chain (restart restore) —
+        time travel over the append-only prefix survives the process.
+        Counts are clamped to the live rows; ids merge in ascending
+        order with whatever the restore already committed."""
+        key = (handle.schema, handle.table)
+        entry = self._store.tables.get(key)
+        if entry is None:
+            return
+        _schema, cols = entry
+        live = len(next(iter(cols.values()))) if cols else 0
+        with self._snap_mu:
+            snaps = self._store.snapshots.setdefault(
+                key, OrderedDict()
+            )
+            merged = dict(snaps)
+            for sid, n in pairs:
+                merged[int(sid)] = min(int(n), live)
+            snaps.clear()
+            for sid in sorted(merged):
+                snaps[sid] = merged[sid]
+
     def current_snapshot_id(self, handle: TableHandle) -> Optional[int]:
         with self._snap_mu:
             snaps = self._store.snapshots.get(
@@ -182,9 +207,19 @@ class MemoryConnector(Connector):
         (unversioned) appends since its last commit serves unpinned —
         legacy writes keep their read-your-writes semantics, and
         isolation resumes at the next ingest commit."""
-        if handle.snapshot is not None:
-            return handle
         key = (handle.schema, handle.table)
+        if handle.snapshot is not None:
+            # an EXPLICIT pin (FOR VERSION AS OF) must resolve to a
+            # committed snapshot — an unknown id would silently serve
+            # the live table as if it were history
+            with self._snap_mu:
+                snaps = self._store.snapshots.get(key)
+                if snaps is None or handle.snapshot not in snaps:
+                    raise KeyError(
+                        f"snapshot {handle.snapshot} is not available "
+                        f"for {handle.schema}.{handle.table}"
+                    )
+            return handle
         with self._snap_mu:
             snaps = self._store.snapshots.get(key)
             if not snaps:

@@ -26,7 +26,7 @@ selectivities, exactly as it does for any stats-less connector.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from presto_tpu import types as T
 from presto_tpu.connectors._arrow import (
@@ -41,6 +41,7 @@ from presto_tpu.connectors.spi import (
     TableHandle,
     TableStats,
 )
+from presto_tpu.server.manifests import LakehouseConnectorMixin
 
 
 class _OrcMetadata(ConnectorMetadata):
@@ -49,21 +50,34 @@ class _OrcMetadata(ConnectorMetadata):
 
     def list_schemas(self) -> List[str]:
         root = self._conn.root
-        return sorted(
-            d
-            for d in os.listdir(root)
-            if os.path.isdir(os.path.join(root, d))
-        )
+        out = set(self._conn.lake_list_schemas())
+        try:
+            out.update(
+                d
+                for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))
+            )
+        except OSError:
+            pass
+        return sorted(out)
 
     def list_tables(self, schema: str) -> List[str]:
         d = os.path.join(self._conn.root, schema)
-        return sorted(
-            fn[: -len(".orc")]
-            for fn in os.listdir(d)
-            if fn.endswith(".orc")
-        )
+        out = set(self._conn.lake_list_tables(schema))
+        try:
+            out.update(
+                fn[: -len(".orc")]
+                for fn in os.listdir(d)
+                if fn.endswith(".orc")
+            )
+        except OSError:
+            pass
+        return sorted(out)
 
     def get_table_schema(self, handle: TableHandle) -> Dict[str, T.DataType]:
+        lake = self._conn.lake_schema(handle)
+        if lake is not None:
+            return lake
         f = self._conn._file(handle)
         schema = f.schema
         return {
@@ -73,19 +87,35 @@ class _OrcMetadata(ConnectorMetadata):
 
     def get_table_stats(self, handle: TableHandle) -> TableStats:
         # row count from the ORC footer; pyarrow exposes no per-column
-        # min/max for ORC (see module docstring)
+        # min/max for ORC (see module docstring). Manifest-backed
+        # tables DO get min/max — the manifest carries them
+        lake = self._conn.lake_table_stats(handle)
+        if lake is not None:
+            return lake
         f = self._conn._file(handle)
         return TableStats(row_count=float(f.nrows), columns={})
 
 
-class OrcConnector(Connector):
-    """Catalog over ``root/<schema>/<table>.orc`` files."""
+class OrcConnector(LakehouseConnectorMixin, Connector):
+    """Catalog over ``root/<schema>/<table>.orc`` files, plus
+    manifest-backed snapshot tables when ``lakehouse`` is set."""
 
     def prunes_splits(self) -> bool:
         return True  # per-stripe min/max prune splits
 
-    def __init__(self, root: str = ".", **config):
+    def __init__(
+        self,
+        root: str = ".",
+        lakehouse: Optional[str] = None,
+        catalog: Optional[str] = None,
+        target_file_bytes: Optional[int] = None,
+        **config,
+    ):
         self.root = root
+        self._init_lakehouse(
+            lakehouse, catalog=catalog,
+            target_file_bytes=target_file_bytes,
+        )
         self._metadata = _OrcMetadata(self)
         self._files: Dict[TableHandle, object] = {}
         self._offsets: Dict[TableHandle, List[int]] = {}
@@ -173,6 +203,9 @@ class OrcConnector(Connector):
         never decoded again."""
         from presto_tpu.connectors.spi import RangeSet
 
+        lake = self.lake_splits(handle, target_split_rows, constraint)
+        if lake is not None:
+            return lake
         offs = self._stripe_offsets(handle)
         total = offs[-1]
         n_stripes = len(offs) - 1
@@ -216,6 +249,9 @@ class OrcConnector(Connector):
     ) -> Dict[str, object]:
         import pyarrow as pa
 
+        lake = self.lake_page_source(split, columns)
+        if lake is not None:
+            return lake
         f = self._file(split.table)
         schema = self._metadata.get_table_schema(split.table)
         offs = self._stripe_offsets(split.table)

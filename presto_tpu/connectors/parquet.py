@@ -13,13 +13,17 @@ dictionary ids (strings never touch the device; SURVEY.md §7 "Strings
 on TPU"). Arrow's columnar layout makes this a zero-copy handoff for
 the numeric columns.
 
-Layout: ``root/<schema>/<table>.parquet``.
+Layout: ``root/<schema>/<table>.parquet``. With the ``lakehouse``
+config (a manifest-store root), the catalog ADDITIONALLY serves
+manifest-committed snapshot tables — versioned, time-travelable, and
+writable through the ingest lane — via the shared lakehouse surface
+in ``server/manifests.py``; plain file tables stay bit-exact legacy.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from presto_tpu import types as T
 from presto_tpu.connectors._arrow import (
@@ -36,6 +40,7 @@ from presto_tpu.connectors.spi import (
     TableHandle,
     TableStats,
 )
+from presto_tpu.server.manifests import LakehouseConnectorMixin
 
 
 def rowgroup_matches(stats, domain) -> bool:
@@ -76,21 +81,34 @@ class _ParquetMetadata(ConnectorMetadata):
 
     def list_schemas(self) -> List[str]:
         root = self._conn.root
-        return sorted(
-            d
-            for d in os.listdir(root)
-            if os.path.isdir(os.path.join(root, d))
-        )
+        out = set(self._conn.lake_list_schemas())
+        try:
+            out.update(
+                d
+                for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d))
+            )
+        except OSError:
+            pass
+        return sorted(out)
 
     def list_tables(self, schema: str) -> List[str]:
         d = os.path.join(self._conn.root, schema)
-        return sorted(
-            fn[: -len(".parquet")]
-            for fn in os.listdir(d)
-            if fn.endswith(".parquet")
-        )
+        out = set(self._conn.lake_list_tables(schema))
+        try:
+            out.update(
+                fn[: -len(".parquet")]
+                for fn in os.listdir(d)
+                if fn.endswith(".parquet")
+            )
+        except OSError:
+            pass
+        return sorted(out)
 
     def get_table_schema(self, handle: TableHandle) -> Dict[str, T.DataType]:
+        lake = self._conn.lake_schema(handle)
+        if lake is not None:
+            return lake
         pf = self._conn._file(handle)
         return {
             f.name: _arrow_to_engine_type(f.type)
@@ -100,7 +118,11 @@ class _ParquetMetadata(ConnectorMetadata):
     def get_table_stats(self, handle: TableHandle) -> TableStats:
         """Row count + per-column min/max straight from the parquet
         footer (zero data reads) — the optimizer's range-selectivity
-        and join-sizing inputs."""
+        and join-sizing inputs. Manifest-backed tables answer from
+        the pinned manifest instead (same inputs, zero file opens)."""
+        lake = self._conn.lake_table_stats(handle)
+        if lake is not None:
+            return lake
         pf = self._conn._file(handle)
         md = pf.metadata
         cols: Dict[str, ColumnStats] = {}
@@ -136,16 +158,28 @@ class _ParquetMetadata(ConnectorMetadata):
         return TableStats(row_count=float(md.num_rows), columns=cols)
 
 
-class ParquetConnector(Connector):
-    """Catalog over ``root/<schema>/<table>.parquet`` files."""
+class ParquetConnector(LakehouseConnectorMixin, Connector):
+    """Catalog over ``root/<schema>/<table>.parquet`` files, plus
+    manifest-backed snapshot tables when ``lakehouse`` is set."""
 
     def prunes_splits(self) -> bool:
         return True  # row-group footer min/max prune splits
 
-    def __init__(self, root: str = ".", **config):
+    def __init__(
+        self,
+        root: str = ".",
+        lakehouse: Optional[str] = None,
+        catalog: Optional[str] = None,
+        target_file_bytes: Optional[int] = None,
+        **config,
+    ):
         self.root = root
         self._metadata = _ParquetMetadata(self)
         self._files: Dict[TableHandle, object] = {}
+        self._init_lakehouse(
+            lakehouse, catalog=catalog,
+            target_file_bytes=target_file_bytes,
+        )
 
     def metadata(self):
         return self._metadata
@@ -176,7 +210,11 @@ class ParquetConnector(Connector):
         protocol stays format-agnostic. Row groups whose footer
         min/max statistics cannot satisfy the pushed ``constraint``
         (dynamic-filter RangeSets / value sets) produce no splits —
-        those rows are never read."""
+        those rows are never read. Manifest-backed tables prune at
+        the FILE level from manifest min/max instead."""
+        lake = self.lake_splits(handle, target_split_rows, constraint)
+        if lake is not None:
+            return lake
         pf = self._file(handle)
         md = pf.metadata
         # constraint column -> row-group column index (once per call)
@@ -224,6 +262,9 @@ class ParquetConnector(Connector):
     ) -> Dict[str, object]:
         import pyarrow.parquet as pq
 
+        lake = self.lake_page_source(split, columns)
+        if lake is not None:
+            return lake
         pf = self._file(split.table)
         schema = self._metadata.get_table_schema(split.table)
         # map the row range back onto row groups, then TRIM the read to

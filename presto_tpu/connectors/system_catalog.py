@@ -149,6 +149,20 @@ _SCHEMAS: Dict[str, Dict[str, Dict[str, T.DataType]]] = {
             "suspensions": T.BIGINT,
             "resumes": T.BIGINT,
         },
+        # durable lakehouse (server/manifests.py): one row per
+        # manifest-committed table — tip snapshot id, retained
+        # snapshot count, live file/byte/row footprint, and whether
+        # the tip is a compaction ('compacted'), compaction is due
+        # ('pending'), or neither ('none')
+        "snapshots": {
+            "table": T.VARCHAR,
+            "snapshot_id": T.BIGINT,
+            "snapshots": T.BIGINT,
+            "files": T.BIGINT,
+            "bytes": T.BIGINT,
+            "rows": T.BIGINT,
+            "compaction": T.VARCHAR,
+        },
         # cluster memory governance (server/memory_arbiter.py): one
         # row per node (query_id '') + one per (node, query) holder,
         # plus KILLED rows for the arbiter's victim decisions
@@ -273,6 +287,8 @@ class SystemConnector(Connector):
             # plane off (or plain local runner): an empty view, not an
             # error — dashboards can always select from it
             return qos.view_rows() if qos is not None else []
+        if key == ("runtime", "snapshots"):
+            return self._snapshot_rows()
         if key == ("runtime", "query_history"):
             store = getattr(self._runner, "history_store", None)
             return store.snapshot() if store is not None else []
@@ -319,6 +335,35 @@ class SystemConnector(Connector):
                             "retries": t.retries,
                         }
                     )
+        return out
+
+    def _snapshot_rows(self):
+        """Per-table tip state of every mounted manifest store
+        (server/manifests.py): the ingest lane's store plus any
+        lakehouse-configured file connector, deduplicated by root —
+        the common deployment points them at the SAME directory.
+        Empty when no lakehouse is configured (plain WAL ingest or
+        no ingest at all): a view, never an error."""
+        if self._runner is None:
+            return []
+        stores = {}
+        ing = getattr(self._runner, "ingest", None)
+        store = getattr(ing, "store", None)
+        if store is not None:
+            stores[store.root] = store
+        for name in self._runner.catalogs.names():
+            conn = self._runner.catalogs.get(name)
+            cstore = getattr(conn, "manifest_store", None)
+            if cstore is not None:
+                stores.setdefault(cstore.root, cstore)
+        out = []
+        for store in stores.values():
+            for tk in store.tables():
+                try:
+                    out.append(store.table_stats(tk))
+                except OSError:
+                    continue  # torn directory mid-GC: skip the row
+        out.sort(key=lambda r: r["table"])
         return out
 
     def _cache_rows(self):

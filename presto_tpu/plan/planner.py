@@ -32,7 +32,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from presto_tpu import types as T
 from presto_tpu import expr as E
 from presto_tpu import functions
-from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.connectors.spi import Connector, TableHandle
 from presto_tpu.exec.staging import bucket_capacity
 from presto_tpu.ops.aggregation import AggCall
 from presto_tpu.ops.sort import SortKey
@@ -575,13 +575,35 @@ class _Planner:
                 catalog, schema = rel.parts[0], rel.parts[1]
             handle = TableHandle(catalog, schema, name)
             conn = self.catalogs.get(catalog)
-            # snapshot-capable connectors (streaming ingest) pin the
-            # scan to the tip committed version HERE, once per plan:
-            # every split, staged page, and capacity retry then reads
-            # one immutable prefix — readers never see a torn batch,
-            # and long scans are isolated from concurrent commits.
-            # Default connectors return the handle unchanged.
-            handle = conn.pin_snapshot(handle)
+            if rel.version is not None:
+                # FOR VERSION AS OF: construct the handle already
+                # pinned — pin_snapshot then VALIDATES the id against
+                # the connector's committed history (KeyError for an
+                # unknown snapshot) instead of picking the tip. A
+                # connector without snapshot support inherits the
+                # default pin_snapshot, which ignores the pin and
+                # would silently serve live rows — reject it here.
+                handle = dataclasses.replace(
+                    handle, snapshot=rel.version
+                )
+                if type(conn).pin_snapshot is Connector.pin_snapshot:
+                    raise PlanningError(
+                        f"catalog {catalog!r} does not support "
+                        "FOR VERSION AS OF"
+                    )
+                try:
+                    handle = conn.pin_snapshot(handle)
+                except KeyError as e:
+                    raise PlanningError(str(e.args[0]) if e.args else str(e))
+            else:
+                # snapshot-capable connectors (streaming ingest) pin
+                # the scan to the tip committed version HERE, once per
+                # plan: every split, staged page, and capacity retry
+                # then reads one immutable prefix — readers never see
+                # a torn batch, and long scans are isolated from
+                # concurrent commits. Default connectors return the
+                # handle unchanged.
+                handle = conn.pin_snapshot(handle)
             tschema = conn.metadata().get_table_schema(handle)
             node = N.TableScanNode(
                 handle=handle,

@@ -623,10 +623,30 @@ class CoordinatorServer:
         # constructed in start(), AFTER the embedder registered its
         # catalogs (WAL replay resolves tables through them) and
         # alongside journal recovery — recover before serving
+        lake_fb = (
+            config.get("lakehouse.target-file-bytes") if config else None
+        )
         self._ingest_cfg = (
             (
                 ing_path,
                 float(config.get("ingest.commit-interval-ms", 50.0)),
+                {
+                    "lakehouse_path": config.get("lakehouse.path"),
+                    "lakehouse_target_file_bytes": (
+                        parse_bytes(lake_fb)
+                        if lake_fb is not None
+                        else None
+                    ),
+                    "lakehouse_compaction_interval_s": float(
+                        config.get("lakehouse.compaction.interval-s", 0.0)
+                    ),
+                    "lakehouse_compaction_min_files": int(
+                        config.get("lakehouse.compaction.min-files", 4)
+                    ),
+                    "lakehouse_orphan_ttl_s": float(
+                        config.get("lakehouse.orphan-ttl-s", 86400.0)
+                    ),
+                },
             )
             if ing_path
             else None
@@ -854,9 +874,9 @@ class CoordinatorServer:
         if self._ingest_cfg is not None and self.ingest is None:
             from presto_tpu.server.ingest import IngestManager
 
-            path, interval = self._ingest_cfg
+            path, interval, lake_kw = self._ingest_cfg
             self.ingest = IngestManager(
-                self.local, path, commit_interval_ms=interval
+                self.local, path, commit_interval_ms=interval, **lake_kw
             )
         # time-series sampler (telemetry.sample-interval-s > 0): a
         # daemon loop folding node scrapes into the metrics_history

@@ -58,6 +58,7 @@ import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from presto_tpu.utils import faults
 from presto_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger("presto_tpu.journal")
@@ -276,6 +277,7 @@ class CoordinatorJournal:
                 self._seg_seq += 1
                 self._cur_count = 0
             try:
+                faults.maybe_inject_io("write", self._cur_segment())
                 with open(self._cur_segment(), "a", encoding="utf-8") as f:
                     if rotate:
                         # checkpoint compaction: the fresh segment
@@ -302,6 +304,12 @@ class CoordinatorJournal:
                         REGISTRY.counter("journal.checkpoints").update()
                     f.write(line + "\n")
                     f.flush()
+                    # durable-before-acknowledged: a recorded claim
+                    # or admission the caller acts on must survive
+                    # power loss, not just process death — flush
+                    # alone leaves the frame in the page cache
+                    faults.maybe_inject_io("fsync", self._cur_segment())
+                    os.fsync(f.fileno())
                 self._cur_count += 1
                 if rotate:
                     self._gc_segments()

@@ -569,6 +569,16 @@ class QosController:
             "suspended_ms": getattr(q, "qos_suspended_ms", 0.0),
         }
 
+    def background_idle(self) -> bool:
+        """May low-priority background work (lakehouse compaction,
+        server/ingest.py) run now? True when no query is running or
+        queued in any lane — background rewrites yield to ANY live
+        foreground work rather than competing for device time."""
+        with self._cond:
+            return not self._running and all(
+                not g.queue for g in self._groups.values()
+            )
+
     def lane_occupancy(self) -> dict:
         """Per-lane live occupancy — the QoS share of this
         coordinator's lease payload (server/lease.py): peers fold it

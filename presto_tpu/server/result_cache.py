@@ -231,8 +231,17 @@ class ResultCache:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
-                gen_ok = entry.gens == tuple(
-                    self._gen.get(tk, 0) for tk, _s in entry.vector
+                # generation compare covers UNVERSIONED tables only:
+                # a snapshot-pinned table invalidates by snapshot-id
+                # compare below instead (precise, and durable across
+                # processes via the manifest chain) — the process-
+                # local generation counter neither survives restart
+                # nor sees a peer coordinator's commits, while the
+                # re-pinned snapshot id does both
+                gen_ok = all(
+                    g == self._gen.get(tk, 0)
+                    for (tk, s), g in zip(entry.vector, entry.gens)
+                    if s is None
                 )
         if entry is None:
             self._miss()

@@ -245,6 +245,7 @@ class ExchangeSpool:
         behind one instance lock on the exchange hot path."""
         fn = self._pages_file(task_id, part)
         new = not os.path.exists(fn)
+        faults.maybe_inject_io("write", fn)
         with open(fn, "ab") as f:
             if new:
                 f.write(_MAGIC)
@@ -255,10 +256,35 @@ class ExchangeSpool:
 
     def commit(self, task_id: str) -> None:
         """Mark the attempt complete — the marker is written LAST, so a
-        crash mid-spool leaves an uncommitted (never served) attempt."""
+        crash mid-spool leaves an uncommitted (never served) attempt.
+
+        Durable-before-acknowledged: every pages file is fsynced
+        BEFORE the marker, and the marker before returning — a
+        power loss after commit() must not leave a servable marker
+        pointing at page frames still in the page cache (once per
+        task, never per page: the tee stays off the hot path)."""
+        prefix = task_id + "."
+        for fn in self._listdir():
+            if fn.startswith(prefix) and fn.endswith(".pages"):
+                p = os.path.join(self.path, fn)
+                faults.maybe_inject_io("fsync", p)
+                try:
+                    fd = os.open(p, os.O_RDONLY)
+                except FileNotFoundError:
+                    # vanished mid-scan (concurrent discard/GC): the
+                    # marker below still only covers surviving files
+                    continue
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
         with self._lock:
-            with open(self._ok_file(task_id), "wb") as f:
+            ok = self._ok_file(task_id)
+            faults.maybe_inject_io("write", ok)
+            with open(ok, "wb") as f:
                 f.write(b"ok")
+                f.flush()
+                os.fsync(f.fileno())
         REGISTRY.counter("spool.commits").update()
         # GC at commit (once per task), not per appended page: the
         # tee sits on the exchange hot path and must not pay a

@@ -671,6 +671,17 @@ class NodeConfig:
         # cadence folding pending micro-batches into snapshots
         "ingest.wal-path": str,
         "ingest.commit-interval-ms": float,
+        # durable lakehouse (server/manifests.py): root of the
+        # manifest-committed table format (unset = no manifests, no
+        # compaction thread; ingest commits stay WAL-only bit-exact),
+        # the data-file size compaction targets, background-compaction
+        # cadence and trigger threshold, and the orphan GC TTL (also
+        # the time-travel retention window)
+        "lakehouse.path": str,
+        "lakehouse.target-file-bytes": str,
+        "lakehouse.compaction.interval-s": float,
+        "lakehouse.compaction.min-files": int,
+        "lakehouse.orphan-ttl-s": float,
         # materialized views (exec/mview.py): the staleness bound the
         # read gate enforces over views of legacy-written bases, and
         # the master switch for incremental (delta-merge) maintenance

@@ -166,6 +166,8 @@ class ExtractExpr(Node):
 class TableRef(Node):
     parts: Tuple[str, ...]  # [catalog.][schema.]table
     alias: Optional[str] = None
+    #: FOR VERSION AS OF <id> — pin a committed snapshot (time travel)
+    version: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

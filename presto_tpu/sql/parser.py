@@ -679,8 +679,30 @@ class _Parser:
         parts = [self.expect_ident()]
         while self.accept_op("."):
             parts.append(self.expect_ident())
+        # FOR VERSION AS OF <id> — time travel to a committed snapshot
+        # (VERSION and OF are plain identifiers, FOR/AS are keywords)
+        version = None
+        if self.peek_kw("for"):
+            self.advance()
+            w = self.expect_ident()
+            if w.lower() != "version":
+                raise ParseError(
+                    f"expected VERSION after FOR, got {w!r}"
+                )
+            self.expect_kw("as")
+            w = self.expect_ident()
+            if w.lower() != "of":
+                raise ParseError(
+                    f"expected OF after FOR VERSION AS, got {w!r}"
+                )
+            lit = self.parse_expr()
+            if not isinstance(lit, ast.NumberLit) or not lit.text.isdigit():
+                raise ParseError(
+                    "FOR VERSION AS OF requires an integer snapshot id"
+                )
+            version = int(lit.text)
         alias = self._relation_alias()
-        return ast.TableRef(tuple(parts), alias)
+        return ast.TableRef(tuple(parts), alias, version)
 
     def _relation_alias(self) -> Optional[str]:
         if self.accept_kw("as"):

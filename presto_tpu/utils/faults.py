@@ -93,6 +93,14 @@ COORD_ACTIONS = ("kill_coordinator",)
 #: the low-memory killer and host-spill paths chaos-testable without
 #: real HBM exhaustion
 MEM_ACTIONS = ("reserve_fail", "mem_pressure")
+#: actions injected at durable-write sites (manifest publishes, WAL /
+#: journal / spool appends): ``io_error`` raises ``OSError`` at the
+#: Nth matched write/fsync/rename whose path contains ``path`` —
+#: disk-full and torn-write chaos without real disk pressure. The
+#: ``op`` field narrows the stage ("write", "fsync", "rename";
+#: "" = any), so a lakehouse test can fail exactly the ``_current``
+#: pointer swap and nothing else
+IO_ACTIONS = ("io_error",)
 
 
 class FaultInjectedError(ConnectionError):
@@ -111,6 +119,8 @@ class FaultRule:
     node: str = ""  # node-id substring (task + reserve hooks)
     task: str = ""  # task-id substring (task hook)
     owner: str = ""  # pool-owner/query-id substring (reserve hook)
+    path: str = ""  # file-path substring (io hook)
+    op: str = ""  # io stage: "write"/"fsync"/"rename" ("" = any)
     delay_s: float = 0.0
     count: int = -1  # firings remaining (-1 = unlimited)
     skip: int = 0  # matches to pass through before firing
@@ -132,6 +142,7 @@ class FaultRule:
             | set(MEM_ACTIONS)
             | set(QOS_ACTIONS)
             | set(COORD_ACTIONS)
+            | set(IO_ACTIONS)
         )
         if rule.action not in known_actions:
             raise ValueError(f"unknown fault action: {rule.action!r}")
@@ -292,6 +303,26 @@ class FaultPlane:
             return ("reserve_fail", None)
         return None
 
+    def on_io(self, op: str, path: str) -> None:
+        """Durable-write hook (manifest publishes, WAL/journal/spool
+        appends): an ``io_error`` rule raises ``OSError`` at the Nth
+        matched ``op`` whose path contains ``path`` — the caller must
+        degrade exactly as it would on a real disk-full/EIO."""
+        for rule in self.rules:
+            if rule.action not in IO_ACTIONS:
+                continue
+            if rule.method or rule.url or rule.node or rule.task:
+                continue  # scoped rules stay in their own hooks
+            if rule.op and rule.op != op:
+                continue
+            if rule.path and rule.path not in path:
+                continue
+            if not self._fire(rule):
+                continue
+            raise OSError(
+                f"injected io_error: {op} {path}"
+            )
+
     def on_coordinator(
         self, node_id: str, query_id: str, kill=None
     ) -> None:
@@ -394,6 +425,15 @@ def maybe_inject_qos(query_id: str) -> bool:
     preemption trigger against this query (``suspend_storm``)."""
     plane = _PLANE
     return plane is not None and plane.on_qos(query_id)
+
+
+def maybe_inject_io(op: str, path: str) -> None:
+    """Durable-write hook (server.manifests publishes, WAL/journal/
+    spool appends): an ``io_error`` rule raises ``OSError`` at the
+    matched write/fsync/rename."""
+    plane = _PLANE
+    if plane is not None:
+        plane.on_io(op, path)
 
 
 def maybe_inject_reserve(node_id: str, owner: str):

@@ -112,6 +112,24 @@ def test_journal_roundtrip_and_replay(tmp_path):
     # closing the survivor empties the next replay
     j2.record_finish("q_c2_aaa", "RESUMED")
     assert CoordinatorJournal(str(tmp_path / "j")).replay().open == []
+    # injected io_error on an append: the journal degrades to
+    # best-effort (a full disk never fails admission) — that frame is
+    # lost, but every frame that did land still replays
+    from presto_tpu.utils import faults
+
+    faults.configure(
+        {"rules": [{"action": "io_error", "path": "journal-", "op": "write"}]}
+    )
+    try:
+        j2.record_submit("q_c3_aaa", "select 3")
+    finally:
+        faults.configure(None)
+    assert CoordinatorJournal(str(tmp_path / "j")).replay().open == []
+    j2.record_submit("q_c4_aaa", "select 4")
+    assert [
+        r["qid"]
+        for r in CoordinatorJournal(str(tmp_path / "j")).replay().open
+    ] == ["q_c4_aaa"]
 
 
 def test_journal_torn_and_corrupt_line_tolerance(tmp_path):

@@ -164,6 +164,22 @@ def test_spool_roundtrip_and_attempt_dedup(tmp_path):
     # discard drops an attempt entirely
     sp.discard(a0)
     assert sp.serve("q_c1.prod.0", 0) == [b"dup-zero"]
+    # disk-full on the marker write (injected io_error): the attempt
+    # stays uncommitted — never served — and a retried commit after
+    # the transient clears publishes it cleanly
+    a2 = "q_c1.prod.9.a0"
+    sp.append(a2, 0, b"late")
+    faults.configure(
+        {"rules": [{"action": "io_error", "path": ".ok", "op": "write"}]}
+    )
+    try:
+        with pytest.raises(OSError):
+            sp.commit(a2)
+        assert sp.serve("q_c1.prod.9", 0) is None
+    finally:
+        faults.configure(None)
+    sp.commit(a2)
+    assert sp.serve("q_c1.prod.9", 0) == [b"late"]
 
 
 def test_spool_checksum_detects_on_disk_corruption(tmp_path):

@@ -128,6 +128,77 @@ BLOCKING_ALLOWLIST = [
         "(the hot exchange path never touches the spool reader)",
     ),
     Allow(
+        "server/journal.py",
+        "CoordinatorJournal._append",
+        "os.fsync",
+        "durable-before-acknowledged: the claim/admission frame must "
+        "reach stable storage inside the same critical section that "
+        "ordered it — fsync after releasing would let a later frame's "
+        "sync overtake an earlier unsynced one (see the open entry)",
+    ),
+    Allow(
+        "server/spool.py",
+        "ExchangeSpool.commit",
+        "os.fsync",
+        "the marker fsync rides the same commit-vs-GC critical "
+        "section as its write (see the open entry): a synced marker "
+        "over pages GC already unlinked is the half-commit the "
+        "ordering exists to prevent",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.append",
+        "os.fsync",
+        "durable-before-acknowledged: the batch frame is acked to "
+        "the producer when append returns, so the sync must complete "
+        "under the same lane lock that fixed its on-disk order",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager._flush_lane",
+        "os.fsync",
+        "the commit frame's sync is the durability point of the "
+        "snapshot id it mints — it cannot move outside the lane lock "
+        "without letting a concurrent append reorder against it",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.record_mview",
+        "os.fsync",
+        "definition frames are acked-durable like data frames; the "
+        "sync shares the log lock that orders create against drop",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.record_mview_drop",
+        "os.fsync",
+        "drop frames sync under the same log lock as create frames "
+        "(see record_mview)",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.compaction_tick",
+        "open",
+        "_commit_mu is held across the whole compaction publish BY "
+        "DESIGN: compaction must not race an ingest commit minting "
+        "the same snapshot id, and the background lane only runs "
+        "when the QoS plane reports the cluster idle",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.compaction_tick",
+        "os.fsync",
+        "the compaction publish (data files, manifest, pointer) "
+        "syncs under _commit_mu — same reasoning as its open entry",
+    ),
+    Allow(
+        "server/ingest.py",
+        "IngestManager.compaction_tick",
+        "os.replace",
+        "the compaction pointer swap is atomic-rename under "
+        "_commit_mu — same reasoning as its open entry",
+    ),
+    Allow(
         "server/worker.py",
         "WorkerServer._materialize_ici",
         "jax.device_get",

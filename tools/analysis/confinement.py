@@ -458,6 +458,88 @@ def ingest_pass(modules: List[core.Module], src_dir: str):
     return findings
 
 
+# ------------------------------------------------------ manifest plane
+
+_MANIFESTS = "server/manifests.py"
+
+#: ManifestStore construction is privileged but has audited consumers:
+#: the ingest lane (the one writer) and the lakehouse mixin inside the
+#: manifest module itself (file connectors reach it ONLY through
+#: ``_init_lakehouse``)
+_MANIFEST_STORE_OK = {_MANIFESTS, "server/ingest.py"}
+
+
+@core.register(
+    "manifest-plane",
+    "lakehouse manifest frame construction/parse, the _current pointer "
+    "swap, and data-file/manifest publication confined to "
+    "server/manifests.py (crash-safe commit protocol)",
+)
+def manifest_pass(modules: List[core.Module], src_dir: str):
+    """The durable-lakehouse twin of ``ingest-frames``: the crc32
+    frame helpers (``_manifest_frame``/``_parse_manifest_line``), the
+    three publication seams (``_write_data_file``/``_write_manifest``/
+    ``_swap_current`` — the exact kill-ordering the chaos suite
+    certifies), and the on-disk ``_current`` pointer name stay inside
+    server/manifests.py. A second pointer-swap site elsewhere could
+    publish a manifest whose data files were never fsynced — the
+    half-commit the whole format exists to rule out. ManifestStore
+    itself constructs only in the audited consumers (the ingest lane;
+    connectors go through the mixin)."""
+    findings = []
+    for mod in modules:
+        frame_ok = mod.rel == _MANIFESTS
+        for node in mod.nodes:
+            if isinstance(node, ast.Call):
+                term = core.terminal_name(node.func)
+                if not frame_ok and term in (
+                    "_manifest_frame",
+                    "_parse_manifest_line",
+                    "_write_data_file",
+                    "_write_manifest",
+                    "_swap_current",
+                ):
+                    findings.append(
+                        mod.finding(
+                            "manifest-plane",
+                            node.lineno,
+                            f"manifest-plane internal {term}() outside "
+                            "server/manifests.py — the commit protocol "
+                            "(fsync ordering, pointer-swap-last) is "
+                            "audited in ONE module",
+                        )
+                    )
+                elif (
+                    term == "ManifestStore"
+                    and mod.rel not in _MANIFEST_STORE_OK
+                ):
+                    findings.append(
+                        mod.finding(
+                            "manifest-plane",
+                            node.lineno,
+                            "ManifestStore() outside the audited "
+                            "consumers (server/ingest.py; connectors "
+                            "attach via LakehouseConnectorMixin."
+                            "_init_lakehouse)",
+                        )
+                    )
+            elif (
+                not frame_ok
+                and isinstance(node, ast.Constant)
+                and node.value == "_current"
+            ):
+                findings.append(
+                    mod.finding(
+                        "manifest-plane",
+                        node.lineno,
+                        "lakehouse _current pointer name outside "
+                        "server/manifests.py — readers and the swap "
+                        "must agree on ONE on-disk pointer",
+                    )
+                )
+    return findings
+
+
 # ----------------------------------------------------------- qos plane
 
 _QOS = "server/qos.py"
